@@ -1,0 +1,40 @@
+"""``paddle._C_ops``-style fast-path namespace (SURVEY.md §2.1 "Pybind layer").
+
+In the reference this is the generated pybind module that skips Python-level
+dispatch. Here the op registry *is* the dispatch table, so this module simply
+projects it as attributes — kept for source compatibility of ported code
+(``_C_ops.matmul(x, y, False, False)``) and for the ``final_state_*`` aliases.
+"""
+
+from __future__ import annotations
+
+import sys as _sys
+from types import ModuleType as _ModuleType
+
+from .ops.registry import OPS as _OPS
+
+
+class _COpsModule(_ModuleType):
+    def __getattr__(self, name):
+        key = name
+        if key.startswith("final_state_"):
+            key = key[len("final_state_"):]
+        inplace = key.endswith("_") and key[:-1] in _OPS
+        if inplace:
+            key = key[:-1]
+        if key in _OPS:
+            fn = _OPS[key].fn
+            if inplace:
+                def _inplace(x, *args, _fn=fn, **kw):
+                    out = _fn(x, *args, **kw)
+                    return x._inplace_set(out._value)
+
+                return _inplace
+            return fn
+        raise AttributeError(f"_C_ops has no op {name!r}")
+
+    def __dir__(self):
+        return sorted(_OPS)
+
+
+_sys.modules[__name__].__class__ = _COpsModule

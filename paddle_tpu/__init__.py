@@ -1,0 +1,115 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A ground-up re-design of the capabilities of the reference framework
+(wishgale/Paddle, a PaddlePaddle fork — see SURVEY.md) for TPU hardware:
+jax/XLA is the device runtime + kernel library + graph compiler, Pallas
+provides the hand-tuned kernels, ``jax.sharding`` over device meshes is the
+distributed substrate, and the dygraph-style eager API with ``.backward()``
+runs on a tape of XLA VJPs.
+
+Public surface mirrors ``import paddle`` where it makes sense
+(``paddle_tpu.to_tensor``, ``paddle_tpu.nn.Layer``, ``paddle_tpu.optimizer``,
+``paddle_tpu.distributed`` …).
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import flags as _flags_mod
+from .flags import get_flags, set_flags
+
+from .core import (
+    CPUPlace,
+    CUDAPlace,
+    CustomPlace,
+    Place,
+    TPUPlace,
+    Tensor,
+    enable_grad,
+    get_device,
+    is_compiled_with_tpu,
+    no_grad,
+    set_device,
+    set_grad_enabled,
+    to_tensor,
+)
+from .core.dtype import (
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    dtype,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+)
+from .core.autograd import is_grad_enabled
+
+# op corpus onto the top-level namespace (paddle.add, paddle.matmul, ...)
+from .ops import *  # noqa: F401,F403
+from .ops import creation, linalg, logic, manipulation, math, reduction  # noqa: F401
+from .ops.registry import all_ops
+
+from .framework.random import get_rng_state, seed, set_rng_state
+from .framework.io import load, save
+
+from . import _C_ops  # noqa: F401
+from . import autograd  # noqa: F401
+from . import device  # noqa: F401
+# M1 modules (imported lazily below once present): nn, optimizer, io, metric,
+# vision, hapi, jit, amp, static
+for _m in ("nn", "optimizer", "io", "metric", "vision", "jit", "amp", "static"):
+    try:
+        __import__(f"{__name__}.{_m}")
+    except ImportError as _e:  # pragma: no cover - only during bootstrap
+        if f"paddle_tpu.{_m}" not in str(_e) and _m not in str(_e):
+            raise
+try:
+    from .hapi.model import Model  # noqa: F401
+    from .nn.layer.layers import ParamAttr  # noqa: F401
+except ImportError:  # pragma: no cover - bootstrap only
+    pass
+
+import jax as _jax
+
+
+def is_compiled_with_cuda() -> bool:
+    return any(d.platform == "gpu" for d in _jax.devices())
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def in_dynamic_mode() -> bool:
+    """Eager (dygraph) mode is the default and only global mode; static-style
+    execution happens per-function via ``paddle_tpu.jit.to_static``."""
+    return True
+
+
+def disable_static():
+    pass
+
+
+def enable_static():
+    from .enforce import raise_unimplemented
+
+    raise_unimplemented(
+        "Global static-graph mode (use @paddle_tpu.jit.to_static per function; "
+        "XLA jit is the graph engine)"
+    )
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,
+         only_inputs=True, allow_unused=False):
+    """``paddle.grad`` analog over the eager tape."""
+    from .autograd import grad as _grad
+
+    return _grad(outputs, inputs, grad_outputs, retain_graph, create_graph,
+                 only_inputs, allow_unused)

@@ -1,0 +1,156 @@
+"""``paddle.autograd`` surface: backward / grad / PyLayer / hooks.
+
+Reference: ``python/paddle/autograd/`` + the eager engine entry points
+(SURVEY.md §2.1 "Eager autograd engine").
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import jax
+
+from ..core import autograd as _engine
+from ..core.autograd import GradNode, enable_grad, is_grad_enabled, no_grad, set_grad_enabled
+from ..core.tensor import Tensor
+from ..enforce import InvalidArgumentError, raise_unimplemented
+
+__all__ = [
+    "backward",
+    "grad",
+    "PyLayer",
+    "PyLayerContext",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = _as_list(tensors)
+    grad_tensors = _as_list(grad_tensors) or None
+    _engine.run_backward(tensors, grad_tensors, retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph: Optional[bool] = None,
+    create_graph: bool = False,
+    only_inputs: bool = True,
+    allow_unused: bool = False,
+    no_grad_vars=None,
+) -> List[Optional[Tensor]]:
+    """``paddle.grad``: gradients of ``outputs`` w.r.t. ``inputs`` without
+    touching ``.grad`` accumulators."""
+    if create_graph:
+        raise_unimplemented("paddle.grad(create_graph=True) (double grad)")
+    outputs = _as_list(outputs)
+    inputs = _as_list(inputs)
+    grad_outputs = _as_list(grad_outputs) or None
+    retain = True if retain_graph is None else retain_graph
+    raw = _engine.run_backward(
+        outputs, grad_outputs, retain_graph=retain, capture=inputs, accumulate_leaves=False
+    )
+    result: List[Optional[Tensor]] = []
+    for t, g in zip(inputs, raw):
+        if g is None:
+            if not allow_unused:
+                raise InvalidArgumentError(
+                    f"Input tensor {t.name} is unreachable from outputs "
+                    "(pass allow_unused=True to get None)."
+                )
+            result.append(None)
+        else:
+            result.append(Tensor(g, stop_gradient=True))
+    return result
+
+
+class PyLayerContext:
+    """Context passed to PyLayer.forward/backward (``PyLayerContext`` analog)."""
+
+    def __init__(self):
+        self._saved: tuple = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayer:
+    """User-defined differentiable op (``paddle.autograd.PyLayer``).
+
+    Subclass with ``forward(ctx, *args)`` and ``backward(ctx, *grads)`` static
+    methods; apply via ``MyOp.apply(*args)``. The backward is spliced into the
+    eager tape as a custom GradNode.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(out, (tuple, list))
+        outs = (out,) if single else tuple(out)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        diff_inputs = [t for t in tensor_inputs if not t.stop_gradient]
+        if not is_grad_enabled() or not diff_inputs:
+            return out
+
+        in_edges = []
+        for t in diff_inputs:
+            if t._grad_node is not None:
+                in_edges.append(("node", t._grad_node, t._out_index))
+            else:
+                in_edges.append(("leaf", t, 0))
+
+        def vjp_fn(cot):
+            cots = (cot,) if single else tuple(cot)
+            with no_grad():
+                gin = cls.backward(ctx, *[Tensor(c, stop_gradient=True) for c in cots])
+            gin = (gin,) if isinstance(gin, Tensor) else tuple(gin)
+            vals = [g._value if isinstance(g, Tensor) else g for g in gin]
+            # align to diff_inputs: PyLayer.backward returns one grad per
+            # *tensor* input; filter to the differentiable ones
+            if len(vals) == len(tensor_inputs) and len(tensor_inputs) != len(diff_inputs):
+                vals = [v for t, v in zip(tensor_inputs, vals) if not t.stop_gradient]
+            return tuple(vals)
+
+        node = GradNode(
+            cls.__name__,
+            vjp_fn,
+            in_edges,
+            n_outputs=len(outs),
+            out_avals=[(o._value.shape, o._value.dtype) for o in outs],
+        )
+        wrapped = []
+        for i, o in enumerate(outs):
+            t = Tensor(o._value, stop_gradient=False, name=f"{cls.__name__}.out")
+            t._grad_node = node
+            t._out_index = i
+            wrapped.append(t)
+        return wrapped[0] if single else tuple(wrapped)

@@ -1,0 +1,14 @@
+from . import autograd, dtype, place, tensor
+from .autograd import enable_grad, is_grad_enabled, no_grad, set_grad_enabled
+from .dtype import *  # noqa: F401,F403
+from .place import (
+    CPUPlace,
+    CUDAPlace,
+    CustomPlace,
+    Place,
+    TPUPlace,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .tensor import Tensor, to_tensor

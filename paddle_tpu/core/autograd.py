@@ -1,0 +1,259 @@
+"""Eager autograd engine.
+
+TPU-native counterpart of the reference's eager autograd
+(``paddle/fluid/eager/``: ``AutogradMeta`` / ``GradNodeBase`` /
+``egr::Backward()``; SURVEY.md §2.1, §3.1). Instead of per-op hand-written
+grad kernels, every recorded op captures a VJP closure from ``jax.vjp`` — XLA
+compiles both directions. ``backward()`` runs the same reverse-topological
+walk over the recorded graph as ``egr::Backward``, with gradient accumulation
+for multi-use tensors and per-tensor hooks.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "GradNode",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+    "backward",
+]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _state.enabled = bool(mode)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """``paddle.no_grad`` analog: disable tape recording."""
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+# An input edge is either ("node", producer_GradNode, output_index) for an
+# intermediate, or ("leaf", tensor) for a graph leaf (parameter / input with
+# stop_gradient=False). Mirrors the reference's Edge{GradNode*, slot}.
+Edge = Tuple[str, Any, int]
+
+
+class GradNode:
+    """One recorded op: holds the VJP closure and edges to producers.
+
+    Counterpart of the generated ``*GradNode`` classes in
+    ``paddle/fluid/eager/api/generated/`` — but the body is a jax VJP.
+    """
+
+    __slots__ = ("name", "vjp_fn", "in_edges", "n_outputs", "out_avals", "hooks", "__weakref__")
+
+    def __init__(
+        self,
+        name: str,
+        vjp_fn: Callable,
+        in_edges: List[Edge],
+        n_outputs: int,
+        out_avals: List[Any],
+    ):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.in_edges = in_edges
+        self.n_outputs = n_outputs
+        self.out_avals = out_avals  # (shape, dtype) per output, for zero cotangents
+        self.hooks: Dict[int, List[Callable]] = {}  # out slot -> grad hooks
+
+    def release(self):
+        self.vjp_fn = None
+
+
+def _topo_order(roots: Sequence[GradNode]) -> List[GradNode]:
+    """Reverse-topological order (consumers before producers)."""
+    order: List[GradNode] = []
+    seen = set()
+    # iterative DFS with post-order
+    stack: List[Tuple[GradNode, bool]] = [(r, False) for r in roots]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for kind, target, _ in node.in_edges:
+            if kind == "node" and id(target) not in seen:
+                stack.append((target, False))
+    order.reverse()  # consumers first
+    return order
+
+
+def run_backward(
+    tensors: Sequence[Any],
+    grad_tensors: Optional[Sequence[Any]] = None,
+    retain_graph: bool = False,
+    capture: Optional[Sequence[Any]] = None,
+    accumulate_leaves: bool = True,
+) -> Optional[List[Optional[Any]]]:
+    """Shared reverse-pass engine (``egr::Backward`` / ``egr::Grad`` analog).
+
+    When ``capture`` is None: accumulates into ``.grad`` of leaf tensors.
+    When ``capture`` is a list of tensors: returns their raw gradients (list
+    aligned with ``capture``, None where unreached) — the ``paddle.grad`` path.
+    """
+    from .tensor import Tensor  # local import to avoid cycle
+
+    tensors = list(tensors)
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    # capture bookkeeping: intermediates by (id(node), slot), leaves by id(t)
+    cap_node: Dict[Tuple[int, int], List[int]] = {}
+    cap_leaf: Dict[int, List[int]] = {}
+    captured: List[Optional[Any]] = []
+    if capture is not None:
+        captured = [None] * len(capture)
+        for j, t in enumerate(capture):
+            if t._grad_node is not None:
+                cap_node.setdefault((id(t._grad_node), t._out_index), []).append(j)
+            else:
+                cap_leaf.setdefault(id(t), []).append(j)
+
+    def _store_leaf(t, g):
+        for j in cap_leaf.get(id(t), ()):
+            captured[j] = g if captured[j] is None else captured[j] + g
+        if accumulate_leaves and not t.stop_gradient:
+            _accumulate_leaf(t, g)
+
+    # cotangent store: id(node) -> [cotangent or None per output slot]
+    cots: Dict[int, List[Optional[jax.Array]]] = {}
+    roots: List[GradNode] = []
+
+    def seed(t: Tensor, g):
+        if g is None:
+            if t.size != 1:
+                raise ValueError(
+                    "backward() on a non-scalar tensor requires grad_tensors "
+                    f"(shape {t.shape})"
+                )
+            g = jnp.ones_like(t.value)
+        else:
+            g = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._grad_node
+        if node is None:
+            _store_leaf(t, g)
+            return
+        slots = cots.setdefault(id(node), [None] * node.n_outputs)
+        slots[t._out_index] = g if slots[t._out_index] is None else slots[t._out_index] + g
+        roots.append(node)
+
+    for t, g in zip(tensors, grad_tensors):
+        seed(t, g)
+
+    if not roots:
+        return captured if capture is not None else None
+
+    for node in _topo_order(roots):
+        slots = cots.pop(id(node), None)
+        if slots is None:
+            continue
+        for i, hooks in node.hooks.items():
+            if slots[i] is None:
+                continue
+            from .tensor import Tensor as _T
+
+            for hook in hooks:
+                out = hook(_T(slots[i], stop_gradient=True))
+                if out is not None:
+                    slots[i] = out.value if isinstance(out, _T) else out
+        for i, s in enumerate(slots):
+            if s is None:
+                continue
+            for j in cap_node.get((id(node), i), ()):
+                captured[j] = s if captured[j] is None else captured[j] + s
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"Trying to backward through op '{node.name}' a second time "
+                "(the graph was freed). Pass retain_graph=True."
+            )
+        # fill missing output cotangents with zeros
+        full = []
+        for i, s in enumerate(slots):
+            if s is None:
+                shape, dt = node.out_avals[i]
+                s = jnp.zeros(shape, dt)
+            full.append(s)
+        out_cot = full[0] if node.n_outputs == 1 else tuple(full)
+        in_cots = node.vjp_fn(out_cot)
+        if not retain_graph:
+            node.release()
+        for (kind, target, idx), g in zip(node.in_edges, in_cots):
+            if g is None:
+                continue
+            if kind == "leaf":
+                t = target() if isinstance(target, weakref.ref) else target
+                if t is not None:
+                    _store_leaf(t, g)
+            else:
+                tslots = cots.setdefault(id(target), [None] * target.n_outputs)
+                tslots[idx] = g if tslots[idx] is None else tslots[idx] + g
+    return captured if capture is not None else None
+
+
+def backward(
+    tensors: Sequence[Any],
+    grad_tensors: Optional[Sequence[Any]] = None,
+    retain_graph: bool = False,
+) -> None:
+    """Reverse pass accumulating into leaf ``.grad`` (``egr::Backward``)."""
+    run_backward(tensors, grad_tensors, retain_graph)
+
+
+def _accumulate_leaf(t, g) -> None:
+    from .tensor import Tensor
+
+    for hook in t._hooks:
+        out = hook(Tensor(g, stop_gradient=True))
+        if out is not None:
+            g = out.value if isinstance(out, Tensor) else out
+    if t.grad is None:
+        t.grad = Tensor(g, stop_gradient=True)
+    else:
+        t.grad = Tensor(t.grad.value + g, stop_gradient=True)

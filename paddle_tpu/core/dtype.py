@@ -1,0 +1,99 @@
+"""Data types.
+
+Counterpart of ``phi::DataType`` (``paddle/phi/common/data_type.h``,
+SURVEY.md §2.1): canonical dtype names mapping onto jax/numpy dtypes,
+including bfloat16 (the TPU-native compute type).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "dtype",
+    "float16",
+    "bfloat16",
+    "float32",
+    "float64",
+    "int8",
+    "uint8",
+    "int16",
+    "int32",
+    "int64",
+    "bool_",
+    "complex64",
+    "complex128",
+    "convert_dtype",
+    "is_floating_dtype",
+    "is_integer_dtype",
+]
+
+# dtypes are exposed as numpy dtype objects (what jax uses natively).
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+uint8 = jnp.uint8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+dtype = np.dtype  # ``paddle.dtype`` analog
+
+_NAME_MAP = {
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "int8": int8,
+    "uint8": uint8,
+    "int16": int16,
+    "int32": int32,
+    "int": int32,
+    "int64": int64,
+    "long": int64,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+
+def convert_dtype(dt: Union[str, Any]) -> Any:
+    """Normalize a dtype spec (name, numpy dtype, jnp scalar type) to a jnp
+    type, canonicalized for the backend (int64→int32 / float64→float32 when
+    x64 is off — int32 is the TPU-native index type)."""
+    if dt is None:
+        return None
+    if isinstance(dt, str):
+        key = dt.lower()
+        if key in _NAME_MAP:
+            dt = _NAME_MAP[key]
+        else:
+            raise ValueError(f"Unknown dtype name {dt!r}")
+    try:
+        import jax.dtypes
+
+        return jax.dtypes.canonicalize_dtype(jnp.dtype(dt)).type
+    except TypeError:
+        raise ValueError(f"Cannot convert {dt!r} to a dtype")
+
+
+def is_floating_dtype(dt: Any) -> bool:
+    return jnp.issubdtype(jnp.dtype(dt), jnp.floating)
+
+
+def is_integer_dtype(dt: Any) -> bool:
+    return jnp.issubdtype(jnp.dtype(dt), jnp.integer)

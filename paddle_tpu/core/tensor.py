@@ -1,0 +1,328 @@
+"""The Tensor.
+
+TPU-native counterpart of ``phi::DenseTensor`` + Python ``paddle.Tensor``
+(``paddle/phi/core/dense_tensor.h`` + pybind eager tensor; SURVEY.md §2.1).
+A ``Tensor`` is a thin mutable wrapper over a ``jax.Array`` (or a jax tracer
+while inside ``jit``): XLA/PJRT owns layout, memory and device placement
+(replacing the reference's allocator stack), while this wrapper carries the
+framework-level state the reference keeps in ``AutogradMeta`` — ``stop_gradient``,
+``.grad``, hooks, name, persistable — and the dygraph in-place semantics
+(methods like ``add_`` rebind the underlying immutable array).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..enforce import InvalidArgumentError
+from . import autograd
+from .dtype import convert_dtype, is_floating_dtype
+from .place import CPUPlace, CUDAPlace, Place, TPUPlace, device_for_place, expected_place
+
+__all__ = ["Tensor", "to_tensor"]
+
+_tensor_counter = [0]
+
+
+def _auto_name(prefix="tensor"):
+    _tensor_counter[0] += 1
+    return f"{prefix}_{_tensor_counter[0]}"
+
+
+class Tensor:
+    """Mutable framework tensor over an immutable jax value."""
+
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_out_index",
+        "_hooks",
+        "name",
+        "persistable",
+        "trainable",
+        "__weakref__",
+    )
+
+    def __init__(
+        self,
+        value: Any,
+        stop_gradient: bool = True,
+        name: Optional[str] = None,
+        persistable: bool = False,
+    ):
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self._hooks = []
+        self.name = name or _auto_name()
+        self.persistable = persistable
+        self.trainable = not stop_gradient
+
+    # -- raw value access ---------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    # -- metadata (TensorMeta analog) --------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self._value.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self) -> Place:
+        devs = getattr(self._value, "devices", None)
+        if devs is None:
+            return expected_place()
+        dev = next(iter(self._value.devices()))
+        kind = {"cpu": CPUPlace, "tpu": TPUPlace, "axon": TPUPlace, "gpu": CUDAPlace}.get(
+            dev.platform, CPUPlace
+        )
+        return kind(dev.id)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    def numel(self) -> int:
+        return self.size
+
+    def dim(self) -> int:
+        return self.ndim
+
+    def is_floating_point(self) -> bool:
+        return is_floating_dtype(self.dtype)
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item() if hasattr(self._value, "item") else self._value
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.item())
+
+    def __len__(self):
+        if not self._value.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        try:
+            data = np.array2string(self.numpy(), precision=6, separator=", ", threshold=64)
+        except Exception:
+            data = f"<{type(self._value).__name__}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"place={self.place}, stop_gradient={sg},\n       {data})"
+        )
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor: Optional["Tensor"] = None, retain_graph: bool = False):
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self):  # paddle spelling
+        self.grad = None
+
+    def register_hook(self, hook):
+        """Hook runs on this tensor's gradient during backward. For
+        intermediates it can rewrite the flowing gradient; for leaves it runs
+        before accumulation into ``.grad``."""
+        if self._grad_node is not None:
+            self._grad_node.hooks.setdefault(self._out_index, []).append(hook)
+        else:
+            self._hooks.append(hook)
+        return hook
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def clone(self) -> "Tensor":
+        from ..ops.dispatch import run_op
+
+        return run_op("clone", lambda x: x + jnp.zeros((), self._value.dtype), self)
+
+    # -- device / dtype movement -------------------------------------------
+    def to(self, device=None, dtype=None, blocking=True) -> "Tensor":
+        # dtype casts and device moves both go through run_op so autograd is
+        # preserved (jax.device_put is differentiable).
+        from ..ops.dispatch import run_op
+        from .place import _parse_device
+
+        target_dt = convert_dtype(dtype) if dtype is not None else None
+        dev = device_for_place(_parse_device(device)) if device is not None else None
+
+        def f(a):
+            if target_dt is not None:
+                a = a.astype(target_dt)
+            if dev is not None and not isinstance(a, jax.core.Tracer):
+                a = jax.device_put(a, dev)
+            return a
+
+        t = run_op("to", f, self)
+        t.name = self.name
+        if self.stop_gradient:
+            t.stop_gradient = True
+        return t
+
+    def cpu(self) -> "Tensor":
+        return self.to("cpu")
+
+    def cuda(self, device_id: int = 0) -> "Tensor":
+        return self.to(f"gpu:{device_id}")
+
+    def tpu(self, device_id: int = 0) -> "Tensor":
+        return self.to(f"tpu:{device_id}")
+
+    def astype(self, dt) -> "Tensor":
+        from ..ops.dispatch import run_op
+
+        target = convert_dtype(dt)
+        return run_op("cast", lambda x: x.astype(target), self)
+
+    def cast(self, dt) -> "Tensor":
+        return self.astype(dt)
+
+    # -- in-place machinery (dygraph mutation over immutable arrays) -------
+    def _inplace_set(self, new_value) -> "Tensor":
+        """Rebind the underlying array (the dygraph ``x.add_(y)`` discipline).
+
+        In-place ops on tensors that participate in an active autograd graph
+        would corrupt saved VJP residuals, mirroring the reference's inplace
+        version-counter check — so we forbid them on non-leaf tensors.
+        """
+        if self._grad_node is not None:
+            raise InvalidArgumentError(
+                f"In-place update on non-leaf tensor {self.name} would "
+                "invalidate its autograd graph."
+            )
+        self._value = new_value
+        return self
+
+    def copy_(self, other: "Tensor") -> "Tensor":
+        val = other._value if isinstance(other, Tensor) else jnp.asarray(other)
+        return self._inplace_set(val.astype(self._value.dtype))
+
+    def set_value(self, value) -> "Tensor":
+        val = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+        return self._inplace_set(val.astype(self._value.dtype))
+
+    def zero_(self) -> "Tensor":
+        return self._inplace_set(jnp.zeros_like(self._value))
+
+    def fill_(self, v) -> "Tensor":
+        return self._inplace_set(jnp.full_like(self._value, v))
+
+    def scale_(self, s) -> "Tensor":
+        return self._inplace_set(self._value * s)
+
+    def add_(self, other) -> "Tensor":
+        o = other._value if isinstance(other, Tensor) else other
+        return self._inplace_set(self._value + o)
+
+    def subtract_(self, other) -> "Tensor":
+        o = other._value if isinstance(other, Tensor) else other
+        return self._inplace_set(self._value - o)
+
+    def multiply_(self, other) -> "Tensor":
+        o = other._value if isinstance(other, Tensor) else other
+        return self._inplace_set(self._value * o)
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx):
+        from ..ops.dispatch import run_op
+
+        idx = _unwrap_index(idx)
+        return run_op("slice", lambda x: x[idx], self)
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        v = value._value if isinstance(value, Tensor) else value
+        self._inplace_set(self._value.at[idx].set(v))
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # Arithmetic dunders are attached by paddle_tpu.ops._tensor_methods at
+    # import time (single source: the op registry), keeping this class free of
+    # per-op code — the ``_C_ops`` fast-path discipline.
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+    return idx
+
+
+def to_tensor(
+    data: Any,
+    dtype: Optional[Any] = None,
+    place: Optional[Union[str, Place]] = None,
+    stop_gradient: bool = True,
+) -> Tensor:
+    """``paddle.to_tensor`` analog."""
+    from .place import _parse_device
+
+    if isinstance(data, Tensor):
+        val = data._value
+    elif isinstance(data, (jax.Array,)):
+        val = data
+    else:
+        val = np.asarray(data)
+        # paddle defaults python floats to fp32, ints to int64; jax x64 is off
+        # so int64 becomes int32 — acceptable TPU-native default.
+        if val.dtype == np.float64 and dtype is None:
+            val = val.astype(np.float32)
+    dt = convert_dtype(dtype) if dtype is not None else None
+    if place is None:
+        dev = device_for_place(expected_place())
+    else:
+        dev = device_for_place(place if isinstance(place, Place) else _parse_device(place))
+    if isinstance(val, jax.Array) and not isinstance(val, jax.core.Tracer):
+        arr = jax.device_put(val.astype(dt) if dt is not None else val, dev)
+    elif isinstance(val, jax.core.Tracer):
+        arr = val.astype(dt) if dt is not None else val
+    else:
+        arr = jax.device_put(jnp.asarray(val, dtype=dt), dev)
+    return Tensor(arr, stop_gradient=stop_gradient)

@@ -1,0 +1,120 @@
+"""``paddle.device`` surface: device management + memory stats.
+
+Reference: ``python/paddle/device/`` (SURVEY.md §2.1 Place/DeviceContext and
+§5.5 memory observability). Memory stats come from PJRT via
+``jax.Device.memory_stats()`` instead of the reference's allocator counters.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import jax
+
+from ..core.place import (
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    _devices_for_type,
+    device_for_place,
+    expected_place,
+    get_device,
+    set_device,
+)
+
+__all__ = [
+    "set_device", "get_device", "get_all_devices", "device_count",
+    "synchronize", "max_memory_allocated", "max_memory_reserved",
+    "memory_allocated", "memory_reserved", "empty_cache", "tpu", "cuda",
+]
+
+
+def get_all_devices() -> List[str]:
+    out = []
+    for d in jax.devices():
+        kind = "tpu" if d.platform in ("tpu", "axon") else d.platform
+        out.append(f"{kind}:{d.id}")
+    return out
+
+
+def device_count(device_type: Optional[str] = None) -> int:
+    if device_type is None:
+        device_type = expected_place().device_type
+    return len(_devices_for_type(device_type))
+
+
+def synchronize(device: Union[str, Place, None] = None) -> None:
+    """Block until all queued work on the device is done (stream sync analog).
+
+    XLA/PJRT has no user-visible streams; syncing = blocking on a trivial
+    transfer from the device."""
+    import jax.numpy as jnp
+
+    place = expected_place() if device is None else device
+    if isinstance(place, str):
+        from ..core.place import _parse_device
+
+        place = _parse_device(place)
+    jax.device_put(jnp.zeros(()), device_for_place(place)).block_until_ready()
+
+
+def _mem_stats(place: Optional[Place] = None) -> dict:
+    dev = device_for_place(place or expected_place())
+    try:
+        return dev.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    return int(_mem_stats().get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    return int(_mem_stats().get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    s = _mem_stats()
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    return max_memory_allocated(device)
+
+
+def empty_cache() -> None:
+    """XLA owns the allocator; nothing to flush. Kept for API parity."""
+
+
+class _DeviceNamespace:
+    """``paddle.device.cuda`` / ``paddle.device.tpu`` sub-namespace."""
+
+    def __init__(self, kind: str):
+        self._kind = kind
+
+    def device_count(self) -> int:
+        return device_count(self._kind)
+
+    def synchronize(self, device=None) -> None:
+        synchronize(device)
+
+    def max_memory_allocated(self, device=None) -> int:
+        return max_memory_allocated(device)
+
+    def max_memory_reserved(self, device=None) -> int:
+        return max_memory_reserved(device)
+
+    def memory_allocated(self, device=None) -> int:
+        return memory_allocated(device)
+
+    def memory_reserved(self, device=None) -> int:
+        return memory_reserved(device)
+
+    def empty_cache(self) -> None:
+        empty_cache()
+
+
+tpu = _DeviceNamespace("tpu")
+cuda = _DeviceNamespace("gpu")

@@ -1,0 +1,75 @@
+"""``paddle.save`` / ``paddle.load``.
+
+Reference: ``python/paddle/framework/io.py`` (SURVEY.md §5.4) — a
+pickle-compatible container format for ``state_dict`` nests. Arrays are
+stored as numpy; on load they are placed on the current device. Distributed /
+sharded checkpointing (orbax-backed, reshard-on-load) lives in
+``paddle_tpu.distributed.checkpoint``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["save", "load"]
+
+_MAGIC = "paddle_tpu.save.v1"
+
+
+def _to_storable(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": obj.numpy(), "stop_gradient": obj.stop_gradient,
+                "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_storable(v) for v in obj)
+    return obj
+
+
+def _from_storable(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            t = to_tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name", t.name)
+            return t
+        return {k: _from_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_storable(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4) -> None:
+    """Save a (possibly nested) object containing Tensors to ``path``."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    payload = {"magic": _MAGIC, "obj": _to_storable(obj)}
+    with open(path, "wb") as f:
+        pickle.dump(payload, f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False) -> Any:
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if not (isinstance(payload, dict) and payload.get("magic") == _MAGIC):
+        return payload  # foreign pickle: return as-is
+    obj = payload["obj"]
+    if return_numpy:
+        def np_of(o):
+            if isinstance(o, dict):
+                if o.get("__tensor__"):
+                    return o["data"]
+                return {k: np_of(v) for k, v in o.items()}
+            if isinstance(o, (list, tuple)):
+                return type(o)(np_of(v) for v in o)
+            return o
+
+        return np_of(obj)
+    return _from_storable(obj)

@@ -1,0 +1,53 @@
+"""Global RNG state.
+
+The reference keeps per-device cuRAND/Philox generators
+(``paddle.seed``, ``get_rng_state``/``set_rng_state``; SURVEY.md §2.1).
+JAX randomness is functional (explicit keys), so this module provides the
+stateful facade: a global key that is split on every consumption, with
+save/restore for determinism fixtures and the TP rng-state-tracker
+(``get_rng_state_tracker`` analog lives in distributed.fleet).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List
+
+import jax
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "next_key", "fold_in"]
+
+_lock = threading.Lock()
+_state = {"key": jax.random.key(0), "seed": 0}
+
+
+def seed(s: int):
+    """``paddle.seed`` analog: reset the global generator."""
+    with _lock:
+        _state["key"] = jax.random.key(int(s))
+        _state["seed"] = int(s)
+    return s
+
+
+def get_rng_state() -> Any:
+    with _lock:
+        return _state["key"]
+
+
+def set_rng_state(key: Any) -> None:
+    with _lock:
+        _state["key"] = key
+
+
+def next_key():
+    """Consume the global stream: returns a fresh subkey."""
+    with _lock:
+        _state["key"], sub = jax.random.split(_state["key"])
+        return sub
+
+
+def fold_in(data: int):
+    """Derive (without consuming) a key folded with ``data`` — used for
+    deterministic per-rank / per-layer streams."""
+    with _lock:
+        return jax.random.fold_in(_state["key"], data)

@@ -1,0 +1,30 @@
+"""Op corpus: the PHI-kernel-library equivalent (SURVEY.md §2.1).
+
+Every op is a thin, registered lowering to jax/XLA primitives; fused/Pallas
+kernels live in ``paddle_tpu.ops.pallas``.
+"""
+
+from . import creation, linalg, logic, manipulation, math, reduction
+from .creation import *  # noqa: F401,F403
+from .dispatch import run_op  # noqa: F401
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .registry import OPS, all_ops, get_op, register_op  # noqa: F401
+
+from . import _tensor_methods
+
+_tensor_methods.attach()
+
+__all__ = list(
+    dict.fromkeys(
+        creation.__all__
+        + math.__all__
+        + reduction.__all__
+        + manipulation.__all__
+        + logic.__all__
+        + linalg.__all__
+    )
+)

@@ -1,0 +1,96 @@
+"""Attach op methods & dunders to Tensor.
+
+The reference exposes tensor methods from pybind (``eager_method.cc``) plus
+monkey-patching in ``python/paddle/tensor/__init__.py`` — same discipline
+here: the op corpus is the single source, and this module wires it onto the
+``Tensor`` class at import time.
+"""
+
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from . import creation, linalg, logic, manipulation, math, reduction
+
+
+def attach():
+    T = Tensor
+    # arithmetic dunders
+    T.__add__ = lambda s, o: math.add(s, o)
+    T.__radd__ = lambda s, o: math.add(o, s)
+    T.__sub__ = lambda s, o: math.subtract(s, o)
+    T.__rsub__ = lambda s, o: math.subtract(o, s)
+    T.__mul__ = lambda s, o: math.multiply(s, o)
+    T.__rmul__ = lambda s, o: math.multiply(o, s)
+    T.__truediv__ = lambda s, o: math.divide(s, o)
+    T.__rtruediv__ = lambda s, o: math.divide(o, s)
+    T.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    T.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
+    T.__mod__ = lambda s, o: math.mod(s, o)
+    T.__pow__ = lambda s, o: math.pow(s, o)
+    T.__rpow__ = lambda s, o: math.pow(o, s)
+    T.__matmul__ = lambda s, o: math.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: math.matmul(o, s)
+    T.__neg__ = lambda s: math.neg(s)
+    T.__abs__ = lambda s: math.abs(s)
+    T.__invert__ = lambda s: logic.bitwise_not(s)
+    T.__and__ = lambda s, o: logic.bitwise_and(s, o)
+    T.__or__ = lambda s, o: logic.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: logic.bitwise_xor(s, o)
+    # comparisons
+    T.__eq__ = lambda s, o: logic.equal(s, o)
+    T.__ne__ = lambda s, o: logic.not_equal(s, o)
+    T.__lt__ = lambda s, o: logic.less_than(s, o)
+    T.__le__ = lambda s, o: logic.less_equal(s, o)
+    T.__gt__ = lambda s, o: logic.greater_than(s, o)
+    T.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    T.__hash__ = object.__hash__  # identity hash despite __eq__, like paddle
+
+    # method surface (paddle.Tensor methods)
+    for mod in (math, reduction, manipulation, logic, creation, linalg):
+        for name in getattr(mod, "__all__", []):
+            fn = getattr(mod, name)
+            if not callable(fn) or hasattr(T, name):
+                continue
+            setattr(T, name, fn)
+
+    # aliases / specialisations
+    T.add = math.add
+    T.t = lambda s: manipulation.transpose(s)
+    T.mT = property(lambda s: manipulation.swapaxes(s, -1, -2))
+    T.T = property(lambda s: manipulation.transpose(s))
+    T.pow = math.pow
+    T.abs = math.abs
+    T.sum = reduction.sum
+    T.mean = reduction.mean
+    T.max = reduction.max
+    T.min = reduction.min
+    T.unsqueeze = manipulation.unsqueeze
+    T.squeeze = manipulation.squeeze
+    T.reshape = manipulation.reshape
+    T.flatten = manipulation.flatten
+    T.transpose = manipulation.transpose
+    T.matmul = math.matmul
+    T.norm = reduction.norm
+    T.split = manipulation.split
+    T.chunk = manipulation.chunk
+    T.gather = manipulation.gather
+    T.topk = manipulation.topk
+    T.argmax = reduction.argmax
+    T.argmin = reduction.argmin
+    T.argsort = manipulation.argsort
+    T.sort = manipulation.sort
+    T.tile = manipulation.tile
+    T.expand = manipulation.expand
+    T.flip = manipulation.flip
+    T.roll = manipulation.roll
+    T.where = lambda s, x, y: manipulation.where(s, x, y)
+    T.exp = math.exp
+    T.log = math.log
+    T.sqrt = math.sqrt
+    T.rsqrt = math.rsqrt
+    T.tanh = math.tanh
+    T.sigmoid = lambda s: math.reciprocal(math.add(math.exp(math.neg(s)), 1.0))
+    T.clip = math.clip
+    T.scale = math.scale
+    T.cumsum = math.cumsum
+    T.clone = T.clone  # defined on class

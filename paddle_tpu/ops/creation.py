@@ -1,0 +1,230 @@
+"""Tensor creation ops (reference: ``paddle/phi/kernels`` full/arange/... and
+``python/paddle/tensor/creation.py``; SURVEY.md §2.1)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, to_tensor
+from ..framework.random import next_key
+from .registry import register_op
+
+__all__ = [
+    "zeros", "ones", "full", "empty", "zeros_like", "ones_like", "full_like",
+    "empty_like", "arange", "linspace", "logspace", "eye", "diag", "diagflat",
+    "tril", "triu", "meshgrid", "rand", "randn", "randint", "randperm",
+    "uniform", "normal", "standard_normal", "bernoulli", "multinomial",
+    "one_hot", "assign", "clone_",
+]
+
+
+def _shape(shape) -> tuple:
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype, default=jnp.float32):
+    return convert_dtype(dtype) if dtype is not None else default
+
+
+@register_op()
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return to_tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+@register_op()
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return to_tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+@register_op()
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = jnp.asarray(fill_value).dtype
+        if dtype == jnp.float64:
+            dtype = jnp.float32
+    return to_tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+@register_op()
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+@register_op()
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    return to_tensor(jnp.zeros_like(x._value, dtype=_dt(dtype, x._value.dtype)))
+
+
+@register_op()
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    return to_tensor(jnp.ones_like(x._value, dtype=_dt(dtype, x._value.dtype)))
+
+
+@register_op()
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    return to_tensor(jnp.full_like(x._value, fill_value, dtype=_dt(dtype, x._value.dtype)))
+
+
+@register_op()
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+@register_op()
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, float):
+            dtype = dtype or "float32"
+    return to_tensor(jnp.arange(start, end, step, dtype=_dt(dtype, jnp.int32)))
+
+
+@register_op()
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    return to_tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+@register_op()
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return to_tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+@register_op()
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return to_tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+@register_op()
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    from .dispatch import run_op
+
+    def f(a):
+        d = jnp.diag(a, k=offset)
+        if a.ndim == 1 and padding_value != 0:
+            mask = jnp.eye(*d.shape, k=offset, dtype=bool)
+            d = jnp.where(mask, d, padding_value)
+        return d
+
+    return run_op("diag", f, x)
+
+
+@register_op()
+def diagflat(x, offset=0, name=None) -> Tensor:
+    from .dispatch import run_op
+
+    return run_op("diagflat", lambda a: jnp.diagflat(a, k=offset), x)
+
+
+@register_op()
+def tril(x, diagonal=0, name=None) -> Tensor:
+    from .dispatch import run_op
+
+    return run_op("tril", lambda a: jnp.tril(a, k=diagonal), x)
+
+
+@register_op()
+def triu(x, diagonal=0, name=None) -> Tensor:
+    from .dispatch import run_op
+
+    return run_op("triu", lambda a: jnp.triu(a, k=diagonal), x)
+
+
+@register_op()
+def meshgrid(*args, name=None) -> List[Tensor]:
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = jnp.meshgrid(*[a._value for a in args], indexing="ij")
+    return [to_tensor(o) for o in outs]
+
+
+# -- random ------------------------------------------------------------------
+
+@register_op(differentiable=False)
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return to_tensor(jax.random.uniform(next_key(), _shape(shape), _dt(dtype)))
+
+
+@register_op(differentiable=False)
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return to_tensor(jax.random.normal(next_key(), _shape(shape), _dt(dtype)))
+
+
+standard_normal = randn
+
+
+@register_op(differentiable=False)
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return to_tensor(
+        jax.random.randint(next_key(), _shape(shape), low, high, dtype=_dt(dtype, jnp.int32))
+    )
+
+
+@register_op(differentiable=False)
+def randperm(n, dtype=None, name=None) -> Tensor:
+    return to_tensor(jax.random.permutation(next_key(), int(n)).astype(_dt(dtype, jnp.int32)))
+
+
+@register_op(differentiable=False)
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    key = jax.random.key(seed) if seed else next_key()
+    return to_tensor(jax.random.uniform(key, _shape(shape), _dt(dtype), minval=min, maxval=max))
+
+
+@register_op(differentiable=False)
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return to_tensor(jax.random.normal(next_key(), shp) * s + m)
+    return to_tensor(jax.random.normal(next_key(), _shape(shape or (1,))) * std + mean)
+
+
+@register_op(differentiable=False)
+def bernoulli(x, name=None) -> Tensor:
+    return to_tensor(
+        jax.random.bernoulli(next_key(), x._value).astype(x._value.dtype)
+    )
+
+
+@register_op(differentiable=False)
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    logits = jnp.log(jnp.clip(x._value, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1, shape=logits.shape[:-1] + (num_samples,))
+    else:
+        key = next_key()
+        g = jax.random.gumbel(key, logits.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return to_tensor(out)
+
+
+@register_op(differentiable=False)
+def one_hot(x, num_classes, name=None) -> Tensor:
+    return to_tensor(jax.nn.one_hot(x._value, num_classes, dtype=jnp.float32))
+
+
+@register_op()
+def assign(x, output=None, name=None) -> Tensor:
+    val = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        return output._inplace_set(val)
+    return to_tensor(val)
+
+
+def clone_(x: Tensor) -> Tensor:
+    return x.clone()

@@ -1,0 +1,157 @@
+"""Op dispatch: the eager execution fast path.
+
+TPU-native counterpart of the reference's PHI dispatch + generated eager
+forward functions (``KernelFactory::SelectKernelOrThrowError`` +
+``*_ad_func``; SURVEY.md §2.1, §3.1). There is no kernel-key selection here
+because XLA/PJRT owns kernel choice per backend; what remains of the
+reference's dispatch responsibilities is exactly what this module does:
+
+* run the op's pure function over the unwrapped ``jax.Array`` values,
+* decide differentiability (any input with ``stop_gradient=False``),
+* record a ``GradNode`` with the op's VJP (replacing generated grad nodes),
+* apply debug hooks (``FLAGS_check_nan_inf``-equivalent NaN scanning).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from .. import flags
+from ..core import autograd
+from ..core.autograd import GradNode
+from ..core.dtype import is_floating_dtype
+from ..core.tensor import Tensor
+
+__all__ = ["run_op", "as_tensor_args"]
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _check_nan_inf(name: str, arrays: Sequence[Any]) -> None:
+    for i, a in enumerate(arrays):
+        if _is_tracer(a) or not is_floating_dtype(a.dtype):
+            continue
+        bad = jnp.logical_or(jnp.isnan(a), jnp.isinf(a)).any()
+        if bool(bad):
+            raise FloatingPointError(
+                f"Operator '{name}' output #{i} contains NaN/Inf "
+                f"(shape {a.shape}, dtype {a.dtype}). "
+                "Set FLAGS_check_nan_inf=0 to disable this check."
+            )
+
+
+def run_op(
+    name: str,
+    pure_fn: Callable,
+    *tensors: Tensor,
+    n_diff_outputs: Optional[int] = None,
+) -> Union[Tensor, Tuple[Tensor, ...]]:
+    """Execute ``pure_fn(*arrays)`` over the inputs' values, with autograd.
+
+    ``pure_fn`` must be a pure jax function closed over any non-tensor attrs,
+    taking one array per entry in ``tensors`` (positionally) and returning an
+    array or tuple of arrays. ``n_diff_outputs``: if set, only the first N
+    outputs are differentiable (the rest are aux ints, e.g. argmax indices).
+    """
+    arrays = [t._value for t in tensors]
+    diff_idx = (
+        [
+            i
+            for i, t in enumerate(tensors)
+            if not t.stop_gradient and is_floating_dtype(arrays[i].dtype)
+        ]
+        if autograd.is_grad_enabled()
+        else []
+    )
+
+    if not diff_idx:
+        out = pure_fn(*arrays)
+        return _wrap(name, out, record=None, n_diff_outputs=n_diff_outputs)
+
+    frozen = list(arrays)
+
+    def f(*diff_arrays):
+        full = list(frozen)
+        for i, a in zip(diff_idx, diff_arrays):
+            full[i] = a
+        return pure_fn(*full)
+
+    out, vjp_fn = jax.vjp(f, *(arrays[i] for i in diff_idx))
+
+    in_edges: List[autograd.Edge] = []
+    for i in diff_idx:
+        t = tensors[i]
+        if t._grad_node is not None:
+            in_edges.append(("node", t._grad_node, t._out_index))
+        else:
+            in_edges.append(("leaf", t, 0))
+
+    return _wrap(name, out, record=(vjp_fn, in_edges), n_diff_outputs=n_diff_outputs)
+
+
+def _wrap(name, out, record, n_diff_outputs):
+    single = not isinstance(out, (tuple, list))
+    outs = (out,) if single else tuple(out)
+
+    if flags.get_flags("check_nan_inf")["check_nan_inf"]:
+        _check_nan_inf(name, outs)
+
+    n_diff = len(outs) if n_diff_outputs is None else n_diff_outputs
+    result = []
+    node = None
+    if record is not None:
+        vjp_fn, in_edges = record
+        if not single and n_diff == len(outs) == 1:
+            # pure_fn returned a 1-tuple: jax.vjp expects a 1-tuple cotangent
+            # but the engine hands a bare array for single-output nodes.
+            inner1 = vjp_fn
+
+            def vjp_fn(cot, _inner=inner1):
+                return _inner((cot,))
+
+        elif n_diff < len(outs):
+            # wrap vjp to drop aux cotangents: callers seed only diff outputs
+            import numpy as np
+
+            inner = vjp_fn
+            # integer aux outputs need float0 cotangents under jax.vjp
+            aux_zeros = tuple(
+                jnp.zeros(o.shape, o.dtype)
+                if is_floating_dtype(o.dtype)
+                else np.zeros(o.shape, jax.dtypes.float0)
+                for o in outs[n_diff:]
+            )
+
+            def vjp_fn(cot, _inner=inner, _aux=aux_zeros, _single=(n_diff == 1)):
+                cots = (cot,) if _single else tuple(cot)
+                full = cots + _aux
+                return _inner(full if len(full) > 1 else full[0])
+
+        node = GradNode(
+            name,
+            vjp_fn,
+            in_edges,
+            n_outputs=n_diff,
+            out_avals=[(o.shape, o.dtype) for o in outs[:n_diff]],
+        )
+
+    for i, o in enumerate(outs):
+        differentiable = record is not None and i < n_diff and is_floating_dtype(o.dtype)
+        t = Tensor(o, stop_gradient=not differentiable, name=f"{name}.out")
+        if differentiable:
+            t._grad_node = node
+            t._out_index = i
+        result.append(t)
+    return result[0] if single else tuple(result)
+
+
+def as_tensor_args(*args) -> List[Tensor]:
+    """Coerce python scalars / numpy arrays to Tensors (broadcast-friendly)."""
+    from ..core.tensor import to_tensor
+
+    return [a if isinstance(a, Tensor) else to_tensor(a) for a in args]

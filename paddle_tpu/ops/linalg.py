@@ -1,0 +1,188 @@
+"""Linear algebra ops — ``paddle.linalg`` surface.
+
+Reference: ``paddle/phi/kernels`` (cholesky, svd, eigh, …, backed by cuSOLVER/
+MAGMA on GPU) + ``python/paddle/tensor/linalg.py``. Here they lower to
+``jax.numpy.linalg`` / ``jax.scipy.linalg`` (XLA custom calls on TPU/CPU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from .dispatch import run_op
+from .registry import register_op
+
+__all__ = [
+    "cholesky", "inv", "det", "slogdet", "svd", "qr", "eigh", "eigvalsh",
+    "eig", "eigvals", "matrix_power", "matrix_rank", "pinv", "solve",
+    "triangular_solve", "cholesky_solve", "lstsq", "lu", "cond", "cov",
+    "corrcoef", "householder_product", "multi_dot", "norm",
+]
+
+
+@register_op()
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+
+    return run_op("cholesky", f, x)
+
+
+@register_op()
+def inv(x, name=None):
+    return run_op("inv", lambda a: jnp.linalg.inv(a), x)
+
+
+@register_op()
+def det(x, name=None):
+    return run_op("det", lambda a: jnp.linalg.det(a), x)
+
+
+@register_op()
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet], axis=0)
+
+    return run_op("slogdet", f, x)
+
+
+@register_op()
+def svd(x, full_matrices=False, name=None):
+    def f(a):
+        return jnp.linalg.svd(a, full_matrices=full_matrices)
+
+    return run_op("svd", f, x)
+
+
+@register_op()
+def qr(x, mode="reduced", name=None):
+    return run_op("qr", lambda a: jnp.linalg.qr(a, mode=mode), x)
+
+
+@register_op()
+def eigh(x, UPLO="L", name=None):
+    return run_op("eigh", lambda a: jnp.linalg.eigh(a, UPLO=UPLO), x)
+
+
+@register_op()
+def eigvalsh(x, UPLO="L", name=None):
+    return run_op("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+@register_op(differentiable=False)
+def eig(x, name=None):
+    import numpy as np
+
+    w, v = np.linalg.eig(x.numpy())  # CPU path, like reference (no GPU eig)
+    return to_tensor(w), to_tensor(v)
+
+
+@register_op(differentiable=False)
+def eigvals(x, name=None):
+    import numpy as np
+
+    return to_tensor(np.linalg.eigvals(x.numpy()))
+
+
+@register_op()
+def matrix_power(x, n, name=None):
+    return run_op("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+@register_op(differentiable=False)
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return run_op(
+        "matrix_rank",
+        lambda a: jnp.linalg.matrix_rank(a, rtol=tol),
+        x,
+    )
+
+
+@register_op()
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return run_op("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x)
+
+
+@register_op()
+def solve(x, y, name=None):
+    return run_op("solve", lambda a, b: jnp.linalg.solve(a, b), x, y)
+
+
+@register_op()
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return run_op("triangular_solve", f, x, y)
+
+
+@register_op()
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+
+    return run_op("cholesky_solve", f, x, y)
+
+
+@register_op(differentiable=False)
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x._value, y._value, rcond=rcond)
+    return to_tensor(sol), to_tensor(res), to_tensor(rank), to_tensor(sv)
+
+
+@register_op(differentiable=False)
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(x._value)
+    if get_infos:
+        return to_tensor(lu_), to_tensor(piv.astype(jnp.int32)), to_tensor(jnp.zeros((), jnp.int32))
+    return to_tensor(lu_), to_tensor(piv.astype(jnp.int32))
+
+
+@register_op(differentiable=False)
+def cond(x, p=None, name=None):
+    return run_op("cond", lambda a: jnp.linalg.cond(a, p=p), x)
+
+
+@register_op()
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return run_op(
+        "cov", lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), x
+    )
+
+
+@register_op()
+def corrcoef(x, rowvar=True, name=None):
+    return run_op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+@register_op()
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
+
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i].at[..., i].set(1.0))
+            v = v[..., :, None]
+            h = jnp.eye(m, dtype=a.dtype) - t[..., i][..., None, None] * (v @ jnp.swapaxes(v, -1, -2))
+            return q @ h
+
+        for i in range(n):
+            q = body(i, q)
+        return q[..., :, :n]
+
+    return run_op("householder_product", f, x, tau)
+
+
+def multi_dot(tensors, name=None):
+    return run_op("multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs), *tensors)
+
+
+from .reduction import norm  # re-export under paddle.linalg.norm

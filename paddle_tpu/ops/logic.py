@@ -1,0 +1,83 @@
+"""Comparison & logical ops (reference: ``paddle/phi/kernels/*/compare_*``,
+``logical_*``; Python surface ``python/paddle/tensor/logic.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from .dispatch import run_op
+from .math import _coerce
+from .registry import register_op
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor", "isclose",
+    "allclose", "equal_all", "is_empty", "is_tensor",
+]
+
+
+def _cmp(op_name, fn):
+    def op(x, y, name=None):
+        x = _coerce(x, y)
+        y = _coerce(y, x)
+        return run_op(op_name, fn, x, y)
+
+    op.__name__ = op_name
+    return register_op(op_name, differentiable=False)(op)
+
+
+equal = _cmp("equal", lambda a, b: a == b)
+not_equal = _cmp("not_equal", lambda a, b: a != b)
+greater_than = _cmp("greater_than", lambda a, b: a > b)
+greater_equal = _cmp("greater_equal", lambda a, b: a >= b)
+less_than = _cmp("less_than", lambda a, b: a < b)
+less_equal = _cmp("less_equal", lambda a, b: a <= b)
+logical_and = _cmp("logical_and", lambda a, b: jnp.logical_and(a, b))
+logical_or = _cmp("logical_or", lambda a, b: jnp.logical_or(a, b))
+logical_xor = _cmp("logical_xor", lambda a, b: jnp.logical_xor(a, b))
+bitwise_and = _cmp("bitwise_and", lambda a, b: a & b)
+bitwise_or = _cmp("bitwise_or", lambda a, b: a | b)
+bitwise_xor = _cmp("bitwise_xor", lambda a, b: a ^ b)
+
+
+@register_op(differentiable=False)
+def logical_not(x, name=None):
+    return run_op("logical_not", lambda a: jnp.logical_not(a), _coerce(x))
+
+
+@register_op(differentiable=False)
+def bitwise_not(x, name=None):
+    return run_op("bitwise_not", lambda a: ~a, _coerce(x))
+
+
+@register_op(differentiable=False)
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return run_op(
+        "isclose",
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        _coerce(x), _coerce(y),
+    )
+
+
+@register_op(differentiable=False)
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return run_op(
+        "allclose",
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        _coerce(x), _coerce(y),
+    )
+
+
+@register_op(differentiable=False)
+def equal_all(x, y, name=None):
+    return run_op("equal_all", lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def is_empty(x, name=None):
+    return to_tensor(x.size == 0)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

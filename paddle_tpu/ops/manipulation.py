@@ -1,0 +1,572 @@
+"""Shape / layout / indexing manipulation ops.
+
+Reference: ``paddle/phi/kernels`` (reshape, transpose, concat, gather/scatter,
+…) + ``python/paddle/tensor/manipulation.py`` (SURVEY.md §2.1). All lower to
+XLA ops that are free (reshape/transpose fold into layouts) or fuse well.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..enforce import InvalidArgumentError
+from .dispatch import run_op
+from .registry import register_op
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "transpose", "moveaxis", "swapaxes",
+    "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "concat", "stack",
+    "split", "chunk", "unbind", "tile", "expand", "expand_as", "broadcast_to",
+    "broadcast_tensors", "flip", "rot90", "roll", "gather", "gather_nd",
+    "scatter", "scatter_nd_add", "index_select", "index_sample", "index_add",
+    "index_put", "masked_select", "masked_fill", "where", "nonzero",
+    "take_along_axis", "put_along_axis", "sort", "argsort", "topk", "unique",
+    "unique_consecutive", "searchsorted", "bucketize", "pad", "repeat_interleave",
+    "diagonal", "tensordot", "einsum", "unstack", "strided_slice", "crop",
+    "tolist", "chunk", "dsplit", "hsplit", "vsplit", "as_real", "as_complex",
+    "view", "view_as", "atleast_1d", "atleast_2d", "atleast_3d",
+]
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int,)):
+        return (shape,)
+    return tuple(int(s) if not isinstance(s, Tensor) else int(s.item()) for s in shape)
+
+
+@register_op()
+def reshape(x, shape, name=None):
+    shp = _shape_arg(shape)
+    return run_op("reshape", lambda a: jnp.reshape(a, shp), x)
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace_set(jnp.reshape(x._value, _shape_arg(shape)))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    from ..core.dtype import convert_dtype
+
+    return run_op("view_dtype", lambda a: a.view(convert_dtype(shape_or_dtype)), x)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+@register_op()
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis if start_axis >= 0 else start_axis + nd
+        e = stop_axis if stop_axis >= 0 else stop_axis + nd
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1 :]
+        return jnp.reshape(a, new_shape)
+
+    return run_op("flatten", f, x)
+
+
+@register_op()
+def transpose(x, perm=None, name=None):
+    if perm is None:
+        return run_op("transpose", lambda a: jnp.transpose(a), x)
+    p = tuple(perm)
+    return run_op("transpose", lambda a: jnp.transpose(a, p), x)
+
+
+@register_op()
+def moveaxis(x, source, destination, name=None):
+    return run_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+@register_op()
+def swapaxes(x, axis0, axis1, name=None):
+    return run_op("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+@register_op()
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        axes = tuple(ax for ax in axes if a.shape[ax] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return run_op("squeeze", f, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._inplace_set(squeeze(x.detach(), axis)._value)
+
+
+@register_op()
+def unsqueeze(x, axis, name=None):
+    axes = (axis,) if isinstance(axis, int) else tuple(
+        int(a.item()) if isinstance(a, Tensor) else int(a) for a in axis
+    )
+    return run_op("unsqueeze", lambda a: jnp.expand_dims(a, axes), x)
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._inplace_set(unsqueeze(x.detach(), axis)._value)
+
+
+@register_op()
+def concat(x: Sequence[Tensor], axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    tensors = list(x)
+    return run_op("concat", lambda *arrs: jnp.concatenate(arrs, axis=axis), *tensors)
+
+
+@register_op()
+def stack(x: Sequence[Tensor], axis=0, name=None):
+    tensors = list(x)
+    return run_op("stack", lambda *arrs: jnp.stack(arrs, axis=axis), *tensors)
+
+
+@register_op()
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise InvalidArgumentError(
+                f"split: dimension {axis} (size {dim}) is not divisible by "
+                f"num_or_sections={num_or_sections}"
+            )
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [s if s != -1 else None for s in num_or_sections]
+        import builtins
+
+        known = builtins.sum(s for s in sizes if s is not None)
+        sizes = [s if s is not None else dim - known for s in sizes]
+    offsets = [0]
+    for s in sizes:
+        offsets.append(offsets[-1] + s)
+    idx = [(offsets[i], offsets[i + 1]) for i in range(len(sizes))]
+
+    def f(a):
+        return tuple(jax.lax.slice_in_dim(a, lo, hi, axis=axis) for lo, hi in idx)
+
+    return list(run_op("split", f, x))
+
+
+@register_op()
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def dsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=2)
+
+
+def hsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=0)
+
+
+@register_op()
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+
+    def f(a):
+        return tuple(jnp.squeeze(s, axis) for s in jnp.split(a, n, axis=axis))
+
+    return list(run_op("unbind", f, x))
+
+
+unstack = unbind
+
+
+@register_op()
+def tile(x, repeat_times, name=None):
+    rt = _shape_arg(repeat_times)
+    return run_op("tile", lambda a: jnp.tile(a, rt), x)
+
+
+@register_op()
+def expand(x, shape, name=None):
+    shp = _shape_arg(shape)
+
+    def f(a):
+        target = tuple(
+            a.shape[i - (len(shp) - a.ndim)] if s == -1 else s for i, s in enumerate(shp)
+        )
+        return jnp.broadcast_to(a, target)
+
+    return run_op("expand", f, x)
+
+
+@register_op()
+def expand_as(x, y, name=None):
+    return run_op("expand_as", lambda a, b: jnp.broadcast_to(a, b.shape), x, y)
+
+
+@register_op()
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    arrs = jnp.broadcast_arrays(*[t._value for t in inputs])
+    shp = arrs[0].shape
+    return [expand(t, shp) for t in inputs]
+
+
+@register_op()
+def flip(x, axis, name=None):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return run_op("flip", lambda a: jnp.flip(a, axis=axes), x)
+
+
+@register_op()
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return run_op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+@register_op()
+def roll(x, shifts, axis=None, name=None):
+    return run_op("roll", lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+# -- gather / scatter --------------------------------------------------------
+
+@register_op()
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return run_op("gather", lambda a, i: jnp.take(a, i, axis=axis), x, index)
+
+
+@register_op()
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        k = idx.shape[-1]
+        flat = tuple(idx[..., j] for j in range(k))
+        return a[flat]
+
+    return run_op("gather_nd", f, x, index)
+
+
+@register_op()
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        return a.at[i].add(u)
+
+    return run_op("scatter", f, x, index, updates)
+
+
+@register_op()
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, u):
+        k = idx.shape[-1]
+        flat = tuple(idx[..., j] for j in range(k))
+        return a.at[flat].add(u)
+
+    return run_op("scatter_nd_add", f, x, index, updates)
+
+
+@register_op()
+def index_select(x, index, axis=0, name=None):
+    return run_op("index_select", lambda a, i: jnp.take(a, i, axis=axis), x, index)
+
+
+@register_op()
+def index_sample(x, index, name=None):
+    return run_op(
+        "index_sample", lambda a, i: jnp.take_along_axis(a, i, axis=1), x, index
+    )
+
+
+@register_op()
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        vmoved = jnp.moveaxis(v, axis, 0)
+        out = moved.at[i].add(vmoved)
+        return jnp.moveaxis(out, 0, axis)
+
+    return run_op("index_add", f, x, index, value)
+
+
+@register_op()
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(a, v, *idx):
+        if accumulate:
+            return a.at[idx].add(v)
+        return a.at[idx].set(v)
+
+    return run_op("index_put", f, x, value, *indices)
+
+
+@register_op()
+def masked_select(x, mask, name=None):
+    # dynamic-shaped output: mask is resolved host-side (not jittable, like
+    # the reference CPU path), but the gather itself goes through run_op so
+    # gradients flow back into x.
+    import numpy as np
+
+    flat_idx = np.nonzero(np.asarray(mask._value).reshape(-1))[0]
+    return run_op(
+        "masked_select", lambda a: jnp.take(a.reshape(-1), flat_idx), x
+    )
+
+
+@register_op()
+def masked_fill(x, mask, value, name=None):
+    v = value._value if isinstance(value, Tensor) else value
+    return run_op("masked_fill", lambda a, m: jnp.where(m, v, a), x, mask)
+
+
+@register_op()
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    from .math import _coerce
+
+    x = _coerce(x, y if isinstance(y, Tensor) else None)
+    y = _coerce(y, x)
+    return run_op("where", lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+@register_op(differentiable=False)
+def nonzero(x, as_tuple=False, name=None):
+    idx = jnp.nonzero(x._value)  # host sync; dynamic shape like reference
+    if as_tuple:
+        return tuple(to_tensor(i) for i in idx)
+    return to_tensor(jnp.stack(idx, axis=1))
+
+
+@register_op()
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return run_op(
+        "take_along_axis", lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices
+    )
+
+
+@register_op()
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape) if jnp.ndim(v) else jnp.full(i.shape, v, a.dtype)
+        ii = jnp.meshgrid(*[jnp.arange(s) for s in i.shape], indexing="ij")
+        ii[axis] = i
+        if reduce == "add":
+            return a.at[tuple(ii)].add(v)
+        if reduce == "multiply":
+            return a.at[tuple(ii)].multiply(v)
+        return a.at[tuple(ii)].set(v)
+
+    return run_op("put_along_axis", f, arr, indices, values)
+
+
+# -- sort / search -----------------------------------------------------------
+
+@register_op()
+def sort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+
+    return run_op("sort", f, x)
+
+
+@register_op(differentiable=False)
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        i = jnp.argsort(a, axis=axis)
+        i = jnp.flip(i, axis=axis) if descending else i
+        return i
+
+    return run_op("argsort", f, x)
+
+
+@register_op()
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def f(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        src = moved if largest else -moved
+        v, i = jax.lax.top_k(src, k)
+        if not largest:
+            v = -v
+        return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+
+    return run_op("topk", f, x, n_diff_outputs=1)
+
+
+@register_op(differentiable=False)
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, name=None):
+    res = jnp.unique(
+        x._value, return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts, axis=axis,
+    )
+    if not (return_index or return_inverse or return_counts):
+        return to_tensor(res)
+    return tuple(to_tensor(r) for r in res)
+
+
+@register_op(differentiable=False)
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    a = x.numpy()
+    import numpy as np
+
+    if axis is None:
+        a = a.reshape(-1)
+    keep = np.ones(a.shape[0], bool)
+    keep[1:] = (a[1:] != a[:-1]).reshape(a.shape[0] - 1, -1).any(axis=-1) if a.ndim > 1 else a[1:] != a[:-1]
+    out = to_tensor(a[keep])
+    results = [out]
+    if return_inverse:
+        results.append(to_tensor(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, a.shape[0]))
+        results.append(to_tensor(counts))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+@register_op(differentiable=False)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+
+    def f(s, v):
+        r = jnp.searchsorted(s, v, side=side)
+        return r.astype(jnp.int32)
+
+    return run_op("searchsorted", f, sorted_sequence, values)
+
+
+@register_op(differentiable=False)
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+# -- padding / misc ----------------------------------------------------------
+
+@register_op()
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad = _shape_arg(pad) if not isinstance(pad, (list, tuple)) else list(pad)
+
+    def f(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle/torch semantics: (low, high) pairs apply starting from
+            # the LAST dim backwards — pad[0:2] pads dim -1, pad[2:4] dim -2…
+            k = len(pad) // 2
+            cfg = [(0, 0)] * nd
+            for i in range(k):
+                cfg[nd - 1 - i] = (pad[2 * i], pad[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=jmode)
+
+    return run_op("pad", f, x)
+
+
+@register_op()
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        # repeats is host-side data (determines output shape); close over it
+        # so gradients still flow through x.
+        import numpy as np
+
+        r = np.asarray(repeats._value)
+        return run_op("repeat_interleave", lambda a: jnp.repeat(a, r, axis=axis), x)
+    return run_op("repeat_interleave", lambda a: jnp.repeat(a, repeats, axis=axis), x)
+
+
+@register_op()
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return run_op("diagonal", lambda a: jnp.diagonal(a, offset, axis1, axis2), x)
+
+
+@register_op()
+def tensordot(x, y, axes=2, name=None):
+    return run_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+@register_op()
+def einsum(equation, *operands, name=None):
+    ops = list(operands[0]) if len(operands) == 1 and isinstance(operands[0], (list, tuple)) else list(operands)
+    return run_op("einsum", lambda *arrs: jnp.einsum(equation, *arrs), *ops)
+
+
+@register_op()
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        idx = [slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice(s, e, st)
+        return a[tuple(idx)]
+
+    return run_op("strided_slice", f, x)
+
+
+@register_op()
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _shape_arg(shape)
+    offs = _shape_arg(offsets) if offsets is not None else (0,) * len(shp)
+
+    def f(a):
+        idx = tuple(slice(o, o + (s if s != -1 else a.shape[i] - o)) for i, (o, s) in enumerate(zip(offs, shp)))
+        return a[idx]
+
+    return run_op("crop", f, x)
+
+
+def as_real(x, name=None):
+    return run_op("as_real", lambda a: jnp.stack([a.real, a.imag], axis=-1), x)
+
+
+def as_complex(x, name=None):
+    return run_op("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def atleast_1d(*xs, name=None):
+    outs = [reshape(x, [-1]) if x.ndim == 0 else x for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*xs, name=None):
+    outs = []
+    for x in xs:
+        while x.ndim < 2:
+            x = unsqueeze(x, 0)
+        outs.append(x)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*xs, name=None):
+    outs = []
+    for x in xs:
+        while x.ndim < 3:
+            x = unsqueeze(x, -1) if x.ndim >= 2 else unsqueeze(x, 0)
+        outs.append(x)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tolist(x):
+    return x.tolist()

@@ -1,0 +1,262 @@
+"""Elementwise & matmul math ops.
+
+Reference: ``paddle/phi/kernels/*/elementwise_*`` , ``matmul_kernel`` and the
+Python surface ``python/paddle/tensor/math.py`` (SURVEY.md §2.1). Each op is a
+thin pure-jax lowering; XLA fuses elementwise chains into matmul epilogues on
+TPU, which is why there are no hand-fused variants here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from .dispatch import run_op
+from .registry import register_op
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "float_power", "matmul", "mm", "bmm", "dot", "inner", "outer",
+    "addmm", "neg", "abs", "sign", "exp", "expm1", "log", "log2", "log10",
+    "log1p", "sqrt", "rsqrt", "square", "reciprocal", "sin", "cos", "tan",
+    "asin", "acos", "atan", "atan2", "sinh", "cosh", "tanh", "asinh", "acosh",
+    "atanh", "floor", "ceil", "round", "trunc", "frac", "clip", "maximum",
+    "minimum", "fmax", "fmin", "erf", "erfinv", "lerp", "lgamma", "digamma",
+    "logit", "logaddexp", "hypot", "nan_to_num", "deg2rad", "rad2deg",
+    "cumsum", "cumprod", "cummax", "cummin", "diff", "trace", "kron",
+    "isnan", "isinf", "isfinite", "scale", "stanh", "rsqrt_",
+    "increment", "multiplex", "gcd", "lcm",
+]
+
+
+def _coerce(x, other=None):
+    """Coerce a python scalar / ndarray to Tensor (dtype-following)."""
+    if isinstance(x, Tensor):
+        return x
+    if other is not None and isinstance(other, Tensor):
+        return to_tensor(jnp.asarray(x, dtype=other._value.dtype))
+    return to_tensor(x)
+
+
+def _binary(op_name, fn):
+    def op(x, y, name=None):
+        x = _coerce(x, y)
+        y = _coerce(y, x)
+        return run_op(op_name, fn, x, y)
+
+    op.__name__ = op_name
+    return register_op(op_name)(op)
+
+
+def _unary(op_name, fn, differentiable=True):
+    def op(x, name=None):
+        return run_op(op_name, fn, _coerce(x))
+
+    op.__name__ = op_name
+    return register_op(op_name, differentiable=differentiable)(op)
+
+
+add = _binary("add", lambda a, b: a + b)
+subtract = _binary("subtract", lambda a, b: a - b)
+multiply = _binary("multiply", lambda a, b: a * b)
+divide = _binary("divide", lambda a, b: a / b)
+floor_divide = _binary("floor_divide", lambda a, b: jnp.floor_divide(a, b))
+mod = _binary("mod", lambda a, b: jnp.mod(a, b))
+remainder = mod
+pow = _binary("pow", lambda a, b: jnp.power(a, b))
+float_power = _binary("float_power", lambda a, b: jnp.float_power(a, b))
+maximum = _binary("maximum", lambda a, b: jnp.maximum(a, b))
+minimum = _binary("minimum", lambda a, b: jnp.minimum(a, b))
+fmax = _binary("fmax", lambda a, b: jnp.fmax(a, b))
+fmin = _binary("fmin", lambda a, b: jnp.fmin(a, b))
+atan2 = _binary("atan2", lambda a, b: jnp.arctan2(a, b))
+logaddexp = _binary("logaddexp", lambda a, b: jnp.logaddexp(a, b))
+hypot = _binary("hypot", lambda a, b: jnp.hypot(a, b))
+gcd = _binary("gcd", lambda a, b: jnp.gcd(a, b))
+lcm = _binary("lcm", lambda a, b: jnp.lcm(a, b))
+
+neg = _unary("neg", lambda a: -a)
+abs = _unary("abs", lambda a: jnp.abs(a))
+sign = _unary("sign", lambda a: jnp.sign(a))
+exp = _unary("exp", lambda a: jnp.exp(a))
+expm1 = _unary("expm1", lambda a: jnp.expm1(a))
+log = _unary("log", lambda a: jnp.log(a))
+log2 = _unary("log2", lambda a: jnp.log2(a))
+log10 = _unary("log10", lambda a: jnp.log10(a))
+log1p = _unary("log1p", lambda a: jnp.log1p(a))
+sqrt = _unary("sqrt", lambda a: jnp.sqrt(a))
+rsqrt = _unary("rsqrt", lambda a: jax.lax.rsqrt(a))
+square = _unary("square", lambda a: jnp.square(a))
+reciprocal = _unary("reciprocal", lambda a: 1.0 / a)
+sin = _unary("sin", lambda a: jnp.sin(a))
+cos = _unary("cos", lambda a: jnp.cos(a))
+tan = _unary("tan", lambda a: jnp.tan(a))
+asin = _unary("asin", lambda a: jnp.arcsin(a))
+acos = _unary("acos", lambda a: jnp.arccos(a))
+atan = _unary("atan", lambda a: jnp.arctan(a))
+sinh = _unary("sinh", lambda a: jnp.sinh(a))
+cosh = _unary("cosh", lambda a: jnp.cosh(a))
+tanh = _unary("tanh", lambda a: jnp.tanh(a))
+asinh = _unary("asinh", lambda a: jnp.arcsinh(a))
+acosh = _unary("acosh", lambda a: jnp.arccosh(a))
+atanh = _unary("atanh", lambda a: jnp.arctanh(a))
+floor = _unary("floor", lambda a: jnp.floor(a))
+ceil = _unary("ceil", lambda a: jnp.ceil(a))
+round = _unary("round", lambda a: jnp.round(a))
+trunc = _unary("trunc", lambda a: jnp.trunc(a))
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+erf = _unary("erf", lambda a: jax.scipy.special.erf(a))
+erfinv = _unary("erfinv", lambda a: jax.scipy.special.erfinv(a))
+lgamma = _unary("lgamma", lambda a: jax.scipy.special.gammaln(a))
+digamma = _unary("digamma", lambda a: jax.scipy.special.digamma(a))
+deg2rad = _unary("deg2rad", lambda a: jnp.deg2rad(a))
+rad2deg = _unary("rad2deg", lambda a: jnp.rad2deg(a))
+isnan = _unary("isnan", lambda a: jnp.isnan(a), differentiable=False)
+isinf = _unary("isinf", lambda a: jnp.isinf(a), differentiable=False)
+isfinite = _unary("isfinite", lambda a: jnp.isfinite(a), differentiable=False)
+stanh = _unary("stanh", lambda a: 1.7159 * jnp.tanh(a * 2.0 / 3.0))
+
+
+@register_op()
+def logit(x, eps=None, name=None):
+    def f(a):
+        b = jnp.clip(a, eps, 1 - eps) if eps else a
+        return jnp.log(b / (1 - b))
+
+    return run_op("logit", f, _coerce(x))
+
+
+@register_op()
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return run_op("clip", lambda a: jnp.clip(a, lo, hi), _coerce(x))
+
+
+@register_op()
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+
+    def f(a):
+        out = a * s + bias if bias_after_scale else (a + bias) * s
+        return out
+
+    return run_op("scale", f, _coerce(x))
+
+
+@register_op()
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return run_op("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+    return run_op("lerp", lambda a, b: a + weight * (b - a), x, y)
+
+
+@register_op()
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return run_op(
+        "nan_to_num", lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x
+    )
+
+
+# -- matmul family -----------------------------------------------------------
+
+@register_op()
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return a @ b
+
+    return run_op("matmul", f, x, y)
+
+
+@register_op()
+def mm(x, y, name=None):
+    return run_op("mm", lambda a, b: a @ b, x, y)
+
+
+@register_op()
+def bmm(x, y, name=None):
+    return run_op("bmm", lambda a, b: jnp.einsum("bij,bjk->bik", a, b), x, y)
+
+
+@register_op()
+def dot(x, y, name=None):
+    return run_op("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+@register_op()
+def inner(x, y, name=None):
+    return run_op("inner", lambda a, b: jnp.inner(a, b), x, y)
+
+
+@register_op()
+def outer(x, y, name=None):
+    return run_op("outer", lambda a, b: jnp.outer(a, b), x, y)
+
+
+@register_op()
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return run_op("addmm", lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+@register_op()
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return run_op("trace", lambda a: jnp.trace(a, offset, axis1, axis2), x)
+
+
+@register_op()
+def kron(x, y, name=None):
+    return run_op("kron", lambda a, b: jnp.kron(a, b), x, y)
+
+
+# -- scans -------------------------------------------------------------------
+
+@register_op()
+def cumsum(x, axis=None, dtype=None, name=None):
+    return run_op("cumsum", lambda a: jnp.cumsum(a, axis=axis), x)
+
+
+@register_op()
+def cumprod(x, dim=None, dtype=None, name=None):
+    return run_op("cumprod", lambda a: jnp.cumprod(a, axis=dim), x)
+
+
+@register_op()
+def cummax(x, axis=None, name=None):
+    ax = -1 if axis is None else axis
+    v = run_op("cummax", lambda a: jax.lax.cummax(a, axis=ax if ax >= 0 else a.ndim + ax), x)
+    return v
+
+
+@register_op()
+def cummin(x, axis=None, name=None):
+    ax = -1 if axis is None else axis
+    return run_op("cummin", lambda a: jax.lax.cummin(a, axis=ax if ax >= 0 else a.ndim + ax), x)
+
+
+@register_op()
+def diff(x, n=1, axis=-1, name=None):
+    return run_op("diff", lambda a: jnp.diff(a, n=n, axis=axis), x)
+
+
+@register_op()
+def increment(x, value=1.0, name=None):
+    return x._inplace_set(x._value + value)
+
+
+@register_op()
+def multiplex(inputs, index, name=None):
+    stacked = jnp.stack([t._value for t in inputs], axis=0)
+    idx = index._value.reshape(-1)
+    rows = jnp.arange(stacked.shape[1])
+    return to_tensor(stacked[idx, rows])
+
+
+def rsqrt_(x):
+    return x._inplace_set(jax.lax.rsqrt(x._value))

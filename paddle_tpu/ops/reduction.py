@@ -1,0 +1,182 @@
+"""Reduction ops (reference: ``paddle/phi/kernels/*/reduce_*``, ``funcs/ReduceKernel``;
+Python surface ``python/paddle/tensor/stat.py``/``math.py``; SURVEY.md §2.1)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from .dispatch import run_op
+from .registry import register_op
+
+__all__ = [
+    "sum", "mean", "max", "min", "amax", "amin", "prod", "std", "var",
+    "argmax", "argmin", "all", "any", "count_nonzero", "logsumexp", "median",
+    "nanmedian", "nansum", "nanmean", "norm", "quantile", "mode", "kthvalue",
+]
+
+
+def _axis(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, list):
+        axis = tuple(axis)
+    return axis
+
+
+@register_op()
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return run_op("sum", lambda a: jnp.sum(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+@register_op()
+def mean(x, axis=None, keepdim=False, name=None):
+    return run_op("mean", lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+@register_op()
+def max(x, axis=None, keepdim=False, name=None):
+    return run_op("max", lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+@register_op()
+def min(x, axis=None, keepdim=False, name=None):
+    return run_op("min", lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+amax = max
+amin = min
+
+
+@register_op()
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return run_op("prod", lambda a: jnp.prod(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+@register_op()
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return run_op("std", lambda a: jnp.std(a, axis=_axis(axis), ddof=ddof, keepdims=keepdim), x)
+
+
+@register_op()
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return run_op("var", lambda a: jnp.var(a, axis=_axis(axis), ddof=ddof, keepdims=keepdim), x)
+
+
+@register_op(differentiable=False)
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        r = jnp.argmax(a, axis=_axis(axis), keepdims=keepdim if axis is not None else False)
+        return r
+
+    return run_op("argmax", f, x)
+
+
+@register_op(differentiable=False)
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        r = jnp.argmin(a, axis=_axis(axis), keepdims=keepdim if axis is not None else False)
+        return r
+
+    return run_op("argmin", f, x)
+
+
+@register_op(differentiable=False)
+def all(x, axis=None, keepdim=False, name=None):
+    return run_op("all", lambda a: jnp.all(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+@register_op(differentiable=False)
+def any(x, axis=None, keepdim=False, name=None):
+    return run_op("any", lambda a: jnp.any(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+@register_op(differentiable=False)
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return run_op(
+        "count_nonzero",
+        lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim),
+        x,
+    )
+
+
+@register_op()
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return run_op(
+        "logsumexp", lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis), keepdims=keepdim), x
+    )
+
+
+@register_op()
+def median(x, axis=None, keepdim=False, name=None):
+    return run_op("median", lambda a: jnp.median(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+@register_op()
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return run_op("nanmedian", lambda a: jnp.nanmedian(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+@register_op()
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return run_op("nansum", lambda a: jnp.nansum(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+@register_op()
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return run_op("nanmean", lambda a: jnp.nanmean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+@register_op()
+def norm(x, p=2, axis=None, keepdim=False, name=None):
+    def f(a):
+        if p == "fro" or (p == 2 and axis is None):
+            return jnp.sqrt(jnp.sum(a * a, axis=_axis(axis), keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=_axis(axis), keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=_axis(axis), keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=_axis(axis), keepdims=keepdim) ** (1.0 / p)
+
+    return run_op("norm", f, x)
+
+
+@register_op()
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return run_op("quantile", lambda a: jnp.quantile(a, q, axis=_axis(axis), keepdims=keepdim), x)
+
+
+@register_op(differentiable=False)
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        eq = moved[..., :, None] == moved[..., None, :]
+        counts = eq.sum(-1)
+        best = jnp.argmax(counts, axis=-1)
+        vals = jnp.take_along_axis(moved, best[..., None], axis=-1)[..., 0]
+        idx = jnp.argmax(moved == vals[..., None], axis=-1)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+
+    return run_op("mode", f, x, n_diff_outputs=1)
+
+
+@register_op()
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        order = jnp.argsort(a, axis=axis)
+        i = jnp.take(order, k - 1, axis=axis)
+        v = jnp.take_along_axis(a, jnp.expand_dims(i, axis), axis=axis)
+        if not keepdim:
+            v = jnp.squeeze(v, axis)
+            idx = i
+        else:
+            idx = jnp.expand_dims(i, axis)
+        return v, idx
+
+    return run_op("kthvalue", f, x, n_diff_outputs=1)

@@ -1,0 +1,41 @@
+"""Test bootstrap: force an 8-device virtual CPU platform.
+
+Mirrors the reference's test strategy (SURVEY.md §4): all distributed logic
+must be exercisable on one host without accelerators — their Gloo fallback is
+our XLA host-platform multi-device trick. Must run before jax initializes.
+"""
+
+import os
+
+# The dev machine pins JAX_PLATFORMS=axon (TPU via the axon PJRT plugin) and
+# /root/.axon_site/sitecustomize.py imports jax at interpreter startup — so
+# env vars alone are too late. jax is imported but its backends are not yet
+# initialized when conftest loads, so runtime config updates still work.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", (
+    "tests must run on the virtual CPU platform; jax was initialized on "
+    f"{jax.devices()[0].platform} before conftest could redirect it"
+)
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for distributed tests"
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    import paddle_tpu as paddle
+
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

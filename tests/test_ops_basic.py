@@ -1,0 +1,210 @@
+"""Op unit tests — OpTest pattern (SURVEY.md §4 op unit tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+RNG = np.random.RandomState(7)
+
+
+class TestElementwise:
+    def test_add(self):
+        a, b = RNG.randn(3, 4), RNG.randn(3, 4)
+        check_output(paddle.add, np.add, [a, b])
+        check_grad(paddle.add, [a, b])
+
+    def test_broadcast_add(self):
+        a, b = RNG.randn(3, 4), RNG.randn(4)
+        check_output(paddle.add, np.add, [a, b])
+        check_grad(paddle.add, [a, b])
+
+    def test_mul_div_sub(self):
+        a, b = RNG.randn(2, 5), RNG.rand(2, 5) + 1.0
+        check_output(paddle.multiply, np.multiply, [a, b])
+        check_output(paddle.subtract, np.subtract, [a, b])
+        check_output(paddle.divide, np.divide, [a, b])
+        check_grad(paddle.divide, [a, b])
+
+    def test_unary(self):
+        a = RNG.rand(3, 4) + 0.5
+        check_output(paddle.exp, np.exp, [a])
+        check_output(paddle.log, np.log, [a])
+        check_output(paddle.sqrt, np.sqrt, [a])
+        check_output(paddle.tanh, np.tanh, [a])
+        check_grad(paddle.log, [a])
+        check_grad(paddle.tanh, [a])
+
+    def test_pow_scalar(self):
+        a = RNG.rand(3, 3) + 0.5
+        out = paddle.pow(paddle.to_tensor(a.astype("float32")), 2.0)
+        np.testing.assert_allclose(out.numpy(), a**2, rtol=1e-5)
+
+    def test_clip(self):
+        a = RNG.randn(4, 4)
+        check_output(paddle.clip, lambda x: np.clip(x, -0.5, 0.5), [a], attrs=dict(min=-0.5, max=0.5))
+
+
+class TestMatmul:
+    def test_matmul_2d(self):
+        a, b = RNG.randn(3, 4), RNG.randn(4, 5)
+        check_output(paddle.matmul, np.matmul, [a, b])
+        check_grad(paddle.matmul, [a, b])
+
+    def test_matmul_transpose(self):
+        a, b = RNG.randn(4, 3), RNG.randn(4, 5)
+        check_output(
+            paddle.matmul, lambda x, y: x.T @ y, [a, b], attrs=dict(transpose_x=True)
+        )
+
+    def test_batched(self):
+        a, b = RNG.randn(2, 3, 4), RNG.randn(2, 4, 5)
+        check_output(paddle.bmm, np.matmul, [a, b])
+
+
+class TestReduction:
+    def test_sum_mean(self):
+        a = RNG.randn(3, 4, 5)
+        check_output(paddle.sum, np.sum, [a])
+        check_output(paddle.mean, np.mean, [a])
+        check_output(paddle.sum, lambda x: x.sum(axis=1), [a], attrs=dict(axis=1))
+        check_output(
+            paddle.mean, lambda x: x.mean(axis=(0, 2), keepdims=True), [a],
+            attrs=dict(axis=[0, 2], keepdim=True),
+        )
+        check_grad(paddle.mean, [a], attrs=dict(axis=1))
+
+    def test_max_min_argmax(self):
+        a = RNG.randn(6, 7)
+        check_output(paddle.max, lambda x: x.max(axis=1), [a], attrs=dict(axis=1))
+        check_output(paddle.argmax, lambda x: x.argmax(axis=1), [a], attrs=dict(axis=1))
+        check_output(paddle.argmin, lambda x: x.argmin(), [a])
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as np_lse  # available via jax deps? fallback below
+
+        a = RNG.randn(3, 4)
+        check_output(paddle.logsumexp, lambda x: np_lse(x, axis=-1), [a], attrs=dict(axis=-1))
+
+    def test_std_var(self):
+        a = RNG.randn(5, 6)
+        check_output(paddle.std, lambda x: x.std(ddof=1), [a])
+        check_output(paddle.var, lambda x: x.var(axis=0, ddof=1), [a], attrs=dict(axis=0))
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = RNG.randn(2, 3, 4)
+        check_output(paddle.reshape, lambda x: x.reshape(6, 4), [a], attrs=dict(shape=[6, 4]))
+        check_output(
+            paddle.transpose, lambda x: x.transpose(2, 0, 1), [a], attrs=dict(perm=[2, 0, 1])
+        )
+        check_grad(paddle.transpose, [a], attrs=dict(perm=[2, 0, 1]))
+
+    def test_concat_stack_split(self):
+        a, b = RNG.randn(2, 3), RNG.randn(2, 3)
+        out = paddle.concat([paddle.to_tensor(a, dtype="float32"), paddle.to_tensor(b, dtype="float32")], axis=1)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], 1), rtol=1e-6)
+        st = paddle.stack([paddle.to_tensor(a, dtype="float32"), paddle.to_tensor(b, dtype="float32")])
+        assert st.shape == [2, 2, 3]
+        parts = paddle.split(paddle.to_tensor(a, dtype="float32"), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+
+    def test_concat_grad(self):
+        a, b = RNG.randn(2, 3), RNG.randn(2, 3)
+        ta = paddle.to_tensor(a.astype("float32"), stop_gradient=False)
+        tb = paddle.to_tensor(b.astype("float32"), stop_gradient=False)
+        loss = paddle.sum(paddle.concat([ta, tb], axis=0) ** 2)
+        loss.backward()
+        np.testing.assert_allclose(ta.grad.numpy(), 2 * a, rtol=1e-5)
+        np.testing.assert_allclose(tb.grad.numpy(), 2 * b, rtol=1e-5)
+
+    def test_gather_scatter(self):
+        a = RNG.randn(5, 3)
+        idx = np.array([0, 2, 4])
+        check_output(paddle.gather, lambda x, i: x[i], [a, idx])
+        t = paddle.to_tensor(a.astype("float32"))
+        up = paddle.to_tensor(np.ones((3, 3), "float32"))
+        out = paddle.scatter(t, paddle.to_tensor(idx), up)
+        want = a.copy()
+        want[idx] = 1.0
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-6)
+
+    def test_where_topk_sort(self):
+        a = RNG.randn(4, 6)
+        cond = a > 0
+        check_output(
+            lambda c, x: paddle.where(c, x, paddle.zeros_like(x)),
+            lambda c, x: np.where(c, x, 0),
+            [cond, a],
+        )
+        v, i = paddle.topk(paddle.to_tensor(a.astype("float32")), k=2, axis=1)
+        want = np.sort(a, axis=1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(v.numpy(), want, rtol=1e-6)
+        check_output(paddle.sort, lambda x: np.sort(x, axis=-1), [a])
+
+    def test_pad(self):
+        a = RNG.randn(2, 3)
+        check_output(
+            paddle.pad, lambda x: np.pad(x, ((0, 0), (1, 2))), [a],
+            attrs=dict(pad=[1, 2], mode="constant"),
+        )
+
+    def test_take_along_put_along(self):
+        a = RNG.randn(3, 4)
+        idx = np.argsort(a, axis=1)
+        check_output(
+            paddle.take_along_axis,
+            lambda x, i: np.take_along_axis(x, i, 1),
+            [a, idx], attrs=dict(axis=1),
+        )
+
+
+class TestLogic:
+    def test_compare(self):
+        a, b = RNG.randn(3, 3), RNG.randn(3, 3)
+        check_output(paddle.greater_than, np.greater, [a, b])
+        check_output(paddle.less_equal, np.less_equal, [a, b])
+        assert bool(paddle.allclose(paddle.to_tensor(a, dtype="float32"), paddle.to_tensor(a, dtype="float32")))
+
+
+class TestLinalg:
+    def test_inv_det_solve(self):
+        a = RNG.randn(4, 4) + 4 * np.eye(4)
+        b = RNG.randn(4, 2)
+        check_output(paddle.inv, np.linalg.inv, [a], rtol=1e-4)
+        check_output(paddle.det, np.linalg.det, [a], rtol=1e-4)
+        check_output(paddle.solve, np.linalg.solve, [a, b], rtol=1e-4)
+
+    def test_cholesky_qr(self):
+        m = RNG.randn(4, 4)
+        a = m @ m.T + 4 * np.eye(4)
+        check_output(paddle.cholesky, np.linalg.cholesky, [a], rtol=1e-4)
+        q, r = paddle.qr(paddle.to_tensor(m.astype("float32")))
+        np.testing.assert_allclose((q.matmul(r)).numpy(), m, atol=1e-4)
+
+    def test_einsum(self):
+        a, b = RNG.randn(2, 3), RNG.randn(3, 4)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a, dtype="float32"), paddle.to_tensor(b, dtype="float32"))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+class TestCreation:
+    def test_basic(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        assert paddle.full([2], 7).numpy().tolist() == [7, 7]
+        assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+
+    def test_random_reproducible(self):
+        paddle.seed(42)
+        a = paddle.randn([4, 4]).numpy()
+        paddle.seed(42)
+        b = paddle.randn([4, 4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_one_hot(self):
+        out = paddle.one_hot(paddle.to_tensor([0, 2, 1]), 3)
+        np.testing.assert_allclose(out.numpy(), np.eye(3)[[0, 2, 1]])
