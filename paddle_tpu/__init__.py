@@ -60,21 +60,29 @@ from .framework.random import get_rng_state, seed, set_rng_state
 from .framework.io import load, save
 
 from . import _C_ops  # noqa: F401
+from . import amp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import device  # noqa: F401
-# M1 modules (imported lazily below once present): nn, optimizer, io, metric,
-# vision, hapi, jit, amp, static
-for _m in ("nn", "optimizer", "io", "metric", "vision", "jit", "amp", "static"):
-    try:
-        __import__(f"{__name__}.{_m}")
-    except ImportError as _e:  # pragma: no cover - only during bootstrap
-        if f"paddle_tpu.{_m}" not in str(_e) and _m not in str(_e):
-            raise
-try:
-    from .hapi.model import Model  # noqa: F401
-    from .nn.layer.layers import ParamAttr  # noqa: F401
-except ImportError:  # pragma: no cover - bootstrap only
-    pass
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import metric  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import profiler  # noqa: F401
+from . import static  # noqa: F401
+from . import vision  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .nn.layer.layers import ParamAttr  # noqa: F401
+from .ops import linalg  # noqa: F401
+
+# paddle.DataParallel / distributed entry points live in paddle_tpu.distributed
+# (imported lazily to keep single-process import light)
+
+
+def DataParallel(layers, **kwargs):
+    from .distributed.parallel import DataParallel as _DP
+
+    return _DP(layers, **kwargs)
 
 import jax as _jax
 

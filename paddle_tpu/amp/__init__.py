@@ -1,0 +1,190 @@
+"""Automatic mixed precision.
+
+Reference: ``python/paddle/amp/`` (SURVEY.md §2.1 AMP): O1 autocast with
+white/black op lists applied at the C++ dispatch layer, O2 pure-low-precision
+with master weights, ``GradScaler`` dynamic loss scaling. TPU-native notes:
+bf16 is the native compute type (no loss scaling needed — GradScaler becomes
+a near-no-op for bf16 but keeps full fp16 semantics), and the autocast hook
+lives in ``ops.dispatch.run_op``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, List, Optional, Set
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["auto_cast", "autocast", "decorate", "GradScaler",
+           "amp_state", "WHITE_LIST", "BLACK_LIST"]
+
+# Ops that hit the MXU — always worth computing in low precision (the
+# reference's white list: conv/matmul family).
+WHITE_LIST: Set[str] = {
+    "matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "addmm", "scaled_dot_product_attention", "embedding",
+}
+# Numerically sensitive ops kept in fp32 (reference's black list).
+BLACK_LIST: Set[str] = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax",
+    "log_softmax", "cross_entropy", "bce", "bce_logits", "nll_loss", "kl_div",
+    "layer_norm", "batch_norm", "rms_norm", "group_norm", "instance_norm",
+    "sum", "mean", "norm", "cumsum", "softmax_with_cross_entropy",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.white = WHITE_LIST
+        self.black = BLACK_LIST
+
+
+amp_state = _AmpState()
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """O1: white-listed ops run in low precision; O2: everything except the
+    black list."""
+    prev = (amp_state.enabled, amp_state.dtype, amp_state.level,
+            amp_state.white, amp_state.black)
+    amp_state.enabled = bool(enable)
+    amp_state.dtype = convert_dtype(dtype)
+    amp_state.level = level
+    white = set(WHITE_LIST)
+    black = set(BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    amp_state.white = white
+    amp_state.black = black
+    try:
+        yield
+    finally:
+        (amp_state.enabled, amp_state.dtype, amp_state.level,
+         amp_state.white, amp_state.black) = prev
+
+
+autocast = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the low-precision dtype; Adam-family
+    optimizers keep fp32 master moments via ``multi_precision``."""
+    dt = convert_dtype(dtype)
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if p.is_floating_point():
+                    p._inplace_set(p._value.astype(dt))
+    if optimizers is None:
+        return models if single_model else model_list
+    single_opt = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if single_opt else list(optimizers)
+    for o in opt_list:
+        if hasattr(o, "_multi_precision"):
+            o._multi_precision = True
+    return (models if single_model else model_list,
+            optimizers if single_opt else opt_list)
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: ``python/paddle/amp/grad_scaler.py``
+    over ``check_finite_and_unscale`` + ``update_loss_scaling`` kernels)."""
+
+    def __init__(self, enable=True, init_loss_scaling=65536.0,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        from ..ops.math import multiply
+
+        return multiply(var, self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._unscaled:
+            return
+        self._unscaled = True
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._params():
+            if p.grad is not None:
+                g = p.grad._value * inv
+                found = found or bool(jnp.logical_not(jnp.isfinite(g)).any())
+                p.grad._inplace_set(g)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+        self._unscaled = False
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        self._unscaled = False
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return to_tensor(self._scale)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)

@@ -1,0 +1,69 @@
+"""``paddle.distributed`` surface (reference: ``python/paddle/distributed/``;
+SURVEY.md §2.2). Mesh-first TPU-native design: process groups map to mesh
+axes, collectives are XLA ops, hybrid parallel lives in ``fleet``."""
+
+from .collective import (
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    alltoall,
+    barrier,
+    broadcast,
+    get_default_group,
+    get_group,
+    irecv,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from .env import (
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+)
+from .parallel import DataParallel
+
+__all__ = [
+    "ReduceOp", "Group", "new_group", "get_group", "get_default_group",
+    "all_reduce", "all_gather", "all_gather_object", "reduce",
+    "reduce_scatter", "broadcast", "scatter", "alltoall", "all_to_all",
+    "send", "recv", "isend", "irecv", "barrier", "ParallelEnv", "get_rank",
+    "get_world_size", "init_parallel_env", "is_initialized", "DataParallel",
+    "spawn", "launch",
+]
+
+
+def spawn(func, args=(), nprocs=-1, **options):
+    """``paddle.distributed.spawn`` analog (multiprocessing launcher)."""
+    import multiprocessing as mp
+    import os
+
+    if nprocs == -1:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    procs = []
+    for rank in range(nprocs):
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+        }
+
+        def target(rank=rank, env=env):
+            os.environ.update(env)
+            func(*args)
+
+        p = mp.Process(target=target)
+        p.start()
+        procs.append(p)
+    for p in procs:
+        p.join()
+        if p.exitcode != 0:
+            raise RuntimeError(f"spawned process exited with {p.exitcode}")

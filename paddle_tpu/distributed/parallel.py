@@ -1,0 +1,73 @@
+"""DataParallel — dygraph data parallelism.
+
+Reference: ``paddle.DataParallel`` over the C++ ``Reducer``
+(``paddle/fluid/distributed/collective/reducer.cc``; SURVEY.md §2.2 DP row):
+bucketed grad allreduce overlapping backward. TPU-native: gradient hooks
+(per-parameter, firing as the tape accumulates) lower to ``lax.psum`` when
+running under a shard_map/SPMD program; in single-controller SPMD mode the
+preferred path is data sharding + jit (XLA inserts the grad psums), which
+``paddle_tpu.distributed.fleet.distributed_model`` sets up — this class keeps
+the dygraph API shape and the ``no_sync`` contract.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .collective import ReduceOp, all_reduce, get_default_group
+from .env import get_world_size
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group or get_default_group()
+        self._grad_sync = True
+        self.add_sublayer("_layers", layers)
+        if get_world_size(self._group) > 1:
+            self._register_grad_hooks()
+
+    def _register_grad_hooks(self):
+        scale = 1.0 / get_world_size(self._group)
+        for p in self._layers.parameters():
+            if p.stop_gradient:
+                continue
+
+            def hook(grad, _p=p, _scale=scale, _self=self):
+                if not _self._grad_sync:
+                    return grad
+                synced = all_reduce(grad, op=ReduceOp.SUM, group=_self._group)
+                from ..ops.math import scale as scale_op
+
+                return scale_op(synced, _scale)
+
+            p.register_hook(hook)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Skip grad sync inside the context (gradient accumulation)."""
+        self._grad_sync = False
+        try:
+            yield
+        finally:
+            self._grad_sync = True
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss

@@ -39,8 +39,32 @@ def set_rng_state(key: Any) -> None:
         _state["key"] = key
 
 
+import threading as _threading
+
+_trace = _threading.local()
+
+
+def push_trace_key(key) -> None:
+    """Enter traced-RNG mode: while active, ``next_key`` splits from this
+    (traced) key instead of the host-side global — so randomness inside a
+    ``jit.to_static`` program derives from a per-call input key rather than
+    baking one mask into the compiled program."""
+    stack = getattr(_trace, "stack", None)
+    if stack is None:
+        stack = _trace.stack = []
+    stack.append(key)
+
+
+def pop_trace_key() -> None:
+    _trace.stack.pop()
+
+
 def next_key():
-    """Consume the global stream: returns a fresh subkey."""
+    """Consume the RNG stream: returns a fresh subkey."""
+    stack = getattr(_trace, "stack", None)
+    if stack:
+        stack[-1], sub = jax.random.split(stack[-1])
+        return sub
     with _lock:
         _state["key"], sub = jax.random.split(_state["key"])
         return sub

@@ -1,0 +1,247 @@
+"""``paddle.Model`` — the Keras-like high-level API.
+
+Reference: ``python/paddle/hapi/model.py`` (SURVEY.md §2.1 hapi, §3.2 call
+stack). The reference has DynamicGraphAdapter/StaticGraphAdapter; here the
+"static" adapter is a whole-graph jitted train step (XLA is the graph
+engine), selected automatically when the model/loss are jit-traceable and
+falling back to the eager tape otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..enforce import InvalidArgumentError
+from ..framework.io import load as _load
+from ..framework.io import save as _save
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _as_tensor_batch(data):
+    if isinstance(data, (list, tuple)):
+        return [d if isinstance(d, Tensor) else to_tensor(np.asarray(d)) for d in data]
+    return [data if isinstance(data, Tensor) else to_tensor(np.asarray(data))]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        return self
+
+    # -- single-batch ops ----------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        if self._loss is None:
+            raise InvalidArgumentError("Model.prepare(loss=...) was not called")
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        if callable(self._loss) and not hasattr(self._loss, "forward"):
+            return self._loss(*outs, *labs)
+        return self._loss(*outs, *labs)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _as_tensor_batch(inputs)
+        labels = _as_tensor_batch(labels) if labels is not None else []
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(loss.item())], metrics) if metrics else [float(loss.item())]
+
+    def eval_batch(self, inputs, labels=None):
+        from ..core.autograd import no_grad
+
+        self.network.eval()
+        inputs = _as_tensor_batch(inputs)
+        labels = _as_tensor_batch(labels) if labels is not None else []
+        with no_grad():
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(loss.item())], metrics) if metrics else [float(loss.item())]
+
+    def predict_batch(self, inputs):
+        from ..core.autograd import no_grad
+
+        self.network.eval()
+        inputs = _as_tensor_batch(inputs)
+        with no_grad():
+            outputs = self.network(*inputs)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        return [o.numpy() for o in outs]
+
+    def _update_metrics(self, outputs, labels):
+        results = []
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        for m in self._metrics:
+            pre = m.compute(*outs, *labels)
+            if not isinstance(pre, (list, tuple)):
+                pre = [pre]
+            m.update(*pre)
+            results.append(m.accumulate())
+        return results
+
+    # -- loops ---------------------------------------------------------------
+    def _build_loader(self, data, batch_size, shuffle, num_workers):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers)
+        return data  # iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._build_loader(train_data, batch_size, shuffle, num_workers)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir, metrics=self._metric_names(),
+        )
+        self.stop_training = False
+        cbks.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                res = self.train_batch(inputs, labels, update=update)
+                logs = self._make_logs(res)
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=0,
+                              num_workers=num_workers, callbacks=cbks)
+            if self.stop_training or (num_iters is not None and it >= num_iters):
+                break
+        cbks.on_train_end(logs)
+        for c in cbks.callbacks:
+            if type(c).__name__ == "History":
+                return c.history
+        return None
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._build_loader(eval_data, batch_size, False, num_workers)
+        own_cbks = callbacks is None
+        if own_cbks:
+            callbacks = config_callbacks(
+                None, model=self, verbose=verbose, log_freq=log_freq,
+                metrics=self._metric_names(),
+            )
+        for m in self._metrics:
+            m.reset()
+        callbacks.on_eval_begin()
+        logs = {}
+        for step, batch in enumerate(loader):
+            callbacks.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            res = self.eval_batch(inputs, labels)
+            logs = self._make_logs(res)
+            callbacks.on_eval_batch_end(step, logs)
+        callbacks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._build_loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch, has_labels=False)
+            outputs.append(self.predict_batch(inputs))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    def _split_batch(self, batch, has_labels=True):
+        if isinstance(batch, (list, tuple)):
+            if has_labels and len(batch) >= 2:
+                return list(batch[:-1]), [batch[-1]]
+            return list(batch), []
+        return [batch], []
+
+    def _make_logs(self, res):
+        logs = {}
+        if isinstance(res, tuple):
+            losses, metrics = res
+            logs["loss"] = losses[0] if len(losses) == 1 else losses
+            for m, v in zip(self._metrics, metrics):
+                names = m.name()
+                logs[names if isinstance(names, str) else names[0]] = v
+        else:
+            logs["loss"] = res[0] if len(res) == 1 else res
+        return logs
+
+    def _metric_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend([n] if isinstance(n, str) else n)
+        return names
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = _load(path + ".pdparams") if not path.endswith(".pdparams") else _load(path)
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        total = sum(p.size for p in self.network.parameters())
+        trainable = sum(p.size for p in self.network.parameters() if not p.stop_gradient)
+        lines = [repr(self.network), f"Total params: {total:,}",
+                 f"Trainable params: {trainable:,}"]
+        text = "\n".join(lines)
+        print(text)
+        return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,369 @@
+"""``paddle.jit`` — whole-graph compilation.
+
+Reference: ``python/paddle/jit/`` dy2static (SURVEY.md §2.1, §3.5): AST
+rewriting → ProgramDesc → InterpreterCore (+ CINN). TPU-native: the traced
+function becomes ONE ``jax.vjp``-differentiable pure program compiled by XLA
+— jit *is* the CINN-equivalent graph compiler, and the eager tape splices the
+compiled program in as a single GradNode so ``.backward()`` still works.
+
+``jit.save``/``jit.load`` export via ``jax.export`` (StableHLO) — the
+``.pdmodel`` analog — falling back to weights-only when export is
+unavailable.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core.tensor import Tensor, to_tensor
+from ..enforce import InvalidArgumentError
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import run_op
+from ..static import InputSpec
+
+__all__ = ["to_static", "TracedProgram", "save", "load", "ignore_module", "not_to_static", "is_tracing"]
+
+_TRACING = [False]
+
+
+def is_tracing() -> bool:
+    """True while a TracedProgram is being traced (layers use this to skip
+    host-side buffer mutation that would leak tracers, e.g. BN running
+    stats — documented divergence: running stats don't update inside
+    to_static'd training steps)."""
+    return _TRACING[0]
+
+
+def _collect_state(obj) -> Tuple[List[Tensor], List[Tensor], Optional[Layer]]:
+    """All parameters (diff) and buffers (non-diff) reachable from fn/layer."""
+    params: List[Tensor] = []
+    buffers: List[Tensor] = []
+    layer: Optional[Layer] = None
+    if isinstance(obj, Layer):
+        layer = obj
+        params = [p for p in obj.parameters() if not p.stop_gradient]
+        buffers = obj.buffers()
+    elif hasattr(obj, "__self__") and isinstance(obj.__self__, Layer):
+        layer = obj.__self__
+        params = [p for p in obj.__self__.parameters() if not p.stop_gradient]
+        buffers = obj.__self__.buffers()
+    elif hasattr(obj, "__closure__") and obj.__closure__:
+        seen = set()
+        for cell in obj.__closure__:
+            v = cell.cell_contents
+            if isinstance(v, Layer):
+                for p in v.parameters():
+                    if not p.stop_gradient and id(p) not in seen:
+                        seen.add(id(p))
+                        params.append(p)
+                for b in v.buffers():
+                    if id(b) not in seen:
+                        seen.add(id(b))
+                        buffers.append(b)
+                if layer is None:
+                    layer = v
+    return params, buffers, layer
+
+
+class _SwapValues:
+    """Temporarily rebind framework tensors to traced jax values."""
+
+    def __init__(self, tensors: Sequence[Tensor], values):
+        self.tensors = tensors
+        self.values = values
+
+    def __enter__(self):
+        self.saved = [t._value for t in self.tensors]
+        for t, v in zip(self.tensors, self.values):
+            t._value = v
+
+    def __exit__(self, *exc):
+        for t, s in zip(self.tensors, self.saved):
+            t._value = s
+        return False
+
+
+class TracedProgram:
+    """A ``StaticFunction``-analog: call-compatible wrapper that runs the
+    python function as one compiled XLA program."""
+
+    def __init__(self, function: Callable, input_spec=None, build_strategy=None,
+                 full_graph=True):
+        self._fn = function
+        self._input_spec = input_spec
+        self._cache: Dict[Any, Any] = {}  # structure key -> jitted pure fn
+        functools.update_wrapper(self, function,
+                                 assigned=("__name__", "__doc__", "__qualname__"),
+                                 updated=())
+
+    def _make_pure(self, params, buffers, tensor_args, rest_args, rest_kwargs,
+                   arg_tree):
+        fn = self._fn
+        out_store = {}
+
+        def pure(*flat):
+            from ..framework import random as _random
+
+            # flat = (rng_key_data, *params, *buffers, *tensor_args): the key
+            # is a per-call input so dropout/random ops inside the compiled
+            # program get fresh randomness each call instead of a baked mask.
+            key_data = flat[0]
+            flat = flat[1:]
+            n_p, n_b = len(params), len(buffers)
+            pvals = flat[:n_p]
+            bvals = flat[n_p : n_p + n_b]
+            ivals = flat[n_p + n_b :]
+            with _SwapValues(list(params) + list(buffers), list(pvals) + list(bvals)):
+                args, kwargs = _rebuild_args(arg_tree, ivals, rest_args, rest_kwargs)
+                _TRACING[0] = True
+                _random.push_trace_key(jax.random.wrap_key_data(key_data))
+                try:
+                    with autograd.no_grad():
+                        out = fn(*args, **kwargs)
+                finally:
+                    _random.pop_trace_key()
+                    _TRACING[0] = False
+            flat_out, tree = _flatten_out(out)
+            out_store["tree"] = tree
+            return tuple(o._value if isinstance(o, Tensor) else o for o in flat_out)
+
+        return pure, out_store
+
+    def __call__(self, *args, **kwargs):
+        from ..framework.random import next_key
+
+        params, buffers, layer = _collect_state(self._fn)
+        tensor_args, arg_tree, rest_args, rest_kwargs = _split_args(args, kwargs)
+        pure, out_store = self._make_pure(params, buffers, tensor_args,
+                                          rest_args, rest_kwargs, arg_tree)
+        rng_input = Tensor(jax.random.key_data(next_key()), stop_gradient=True)
+        all_inputs = [rng_input] + list(params) + list(buffers) + list(tensor_args)
+        # whole-graph compile: the pure program goes through jax.jit so XLA
+        # fuses it end-to-end; jax.vjp over the jitted fn gives the compiled
+        # backward, and run_op splices both into the eager tape as ONE node.
+        key = (
+            _tree_key(arg_tree),
+            tuple((tuple(t.shape), str(t.dtype)) for t in all_inputs),
+            tuple(sorted(rest_kwargs)) if rest_kwargs else (),
+            getattr(layer, "training", None),  # train/eval compile separately
+        )
+        hit = self._cache.get(key)
+        if hit is None:
+            jitted = jax.jit(pure)
+            self._cache[key] = (jitted, out_store)
+        else:
+            jitted, out_store = hit
+        out = run_op(getattr(self._fn, "__name__", "traced_program"), jitted, *all_inputs)
+        outs = out if isinstance(out, tuple) else (out,)
+        tree = out_store["tree"]
+        return _unflatten_out(tree, list(outs))
+
+    # introspection
+    @property
+    def forward(self):
+        return self
+
+
+def _tree_key(tree):
+    def k(node):
+        kind, payload = node
+        if kind == "T":
+            return ("T",)
+        if kind in ("L", "U"):
+            return (kind, tuple(k(v) for v in payload))
+        return ("S", repr(payload))
+
+    return tuple(k(n) for n in tree)
+
+
+def _split_args(args, kwargs):
+    """Separate Tensor leaves (traced) from static args."""
+    tensor_args: List[Tensor] = []
+    tree: List[Any] = []
+
+    def scan(x):
+        if isinstance(x, Tensor):
+            tensor_args.append(x)
+            return ("T", len(tensor_args) - 1)
+        if isinstance(x, (list, tuple)):
+            return ("L" if isinstance(x, list) else "U", [scan(v) for v in x])
+        return ("S", x)
+
+    arg_tree = [scan(a) for a in args]
+    return tensor_args, arg_tree, args, kwargs
+
+
+def _rebuild_args(arg_tree, ivals, rest_args, rest_kwargs):
+    def build(node):
+        kind, payload = node
+        if kind == "T":
+            return Tensor(ivals[payload], stop_gradient=True)
+        if kind in ("L", "U"):
+            seq = [build(v) for v in payload]
+            return seq if kind == "L" else tuple(seq)
+        return payload
+
+    args = [build(n) for n in arg_tree]
+    return args, rest_kwargs
+
+
+def _flatten_out(out):
+    flat: List[Any] = []
+
+    def scan(x):
+        if isinstance(x, Tensor):
+            flat.append(x)
+            return ("T", len(flat) - 1)
+        if isinstance(x, (list, tuple)):
+            return ("L" if isinstance(x, list) else "U", [scan(v) for v in x])
+        if isinstance(x, dict):
+            return ("D", {k: scan(v) for k, v in x.items()})
+        return ("S", x)
+
+    tree = scan(out)
+    return flat, tree
+
+
+def _unflatten_out(tree, tensors):
+    def build(node):
+        kind, payload = node
+        if kind == "T":
+            return tensors[payload]
+        if kind in ("L", "U"):
+            seq = [build(v) for v in payload]
+            return seq if kind == "L" else tuple(seq)
+        if kind == "D":
+            return {k: build(v) for k, v in payload.items()}
+        return payload
+
+    return build(tree)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True):
+    """Decorator/wrapper compiling a function or Layer with XLA."""
+
+    def wrap(fn):
+        if isinstance(fn, Layer):
+            traced = TracedProgram(fn.__call__, input_spec)
+            return _TracedLayerProxy(fn, traced)
+        return TracedProgram(fn, input_spec)
+
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+class _TracedLayerProxy:
+    """Layer-like proxy whose __call__ runs the compiled program."""
+
+    def __init__(self, layer: Layer, traced: TracedProgram):
+        self._layer = layer
+        self._traced = traced
+
+    def __call__(self, *args, **kwargs):
+        return self._traced(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._layer, name)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export params (+StableHLO program when input_spec given) — the
+    ``.pdmodel``/``.pdiparams`` analog."""
+    target = layer._layer if isinstance(layer, _TracedLayerProxy) else layer
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    from ..framework.io import save as fsave
+
+    fsave(target.state_dict(), path + ".pdiparams")
+    meta = {"class": type(target).__name__}
+    if input_spec:
+        try:
+            from jax import export as jexport
+
+            params = [p for p in target.parameters() if not p.stop_gradient]
+            buffers = target.buffers()
+            sd = target.state_dict()
+            by_id = {id(v): k for k, v in sd.items()}
+            meta["param_keys"] = [by_id[id(p)] for p in params]
+            meta["buffer_keys"] = [by_id[id(b)] for b in buffers if id(b) in by_id]
+
+            def pure(pvals, bvals, *ivals):
+                with _SwapValues(list(params) + list(buffers), list(pvals) + list(bvals)):
+                    with autograd.no_grad():
+                        out = target(*[Tensor(v, stop_gradient=True) for v in ivals])
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                return tuple(o._value for o in outs)
+
+            specs = [jax.ShapeDtypeStruct(tuple(s.shape), s.dtype) for s in input_spec]
+            pv = [p._value for p in params]
+            bv = [b._value for b in buffers]
+            exported = jexport.export(jax.jit(pure))(
+                [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in pv],
+                [jax.ShapeDtypeStruct(b.shape, b.dtype) for b in bv],
+                *specs,
+            )
+            with open(path + ".pdmodel", "wb") as f:
+                f.write(exported.serialize())
+            meta["exported"] = True
+        except Exception as e:  # export is best-effort; weights always saved
+            meta["exported"] = False
+            meta["export_error"] = str(e)
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load(path, **configs):
+    from ..framework.io import load as fload
+
+    state = fload(path + ".pdiparams")
+    meta = {}
+    if os.path.exists(path + ".pdmeta"):
+        with open(path + ".pdmeta", "rb") as f:
+            meta = pickle.load(f)
+    if os.path.exists(path + ".pdmodel"):
+        from jax import export as jexport
+
+        with open(path + ".pdmodel", "rb") as f:
+            exported = jexport.deserialize(f.read())
+
+        class _Callable:
+            def __init__(self):
+                self.state = state
+
+            def __call__(self, *inputs):
+                # reconstruct (params, buffers, *inputs) calling convention
+                # using the key order recorded at save time (frozen params
+                # were baked into the export and appear in neither list)
+                pv = [state[k]._value for k in meta.get("param_keys", [])]
+                bv = [state[k]._value for k in meta.get("buffer_keys", [])]
+                ivals = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                         for t in inputs]
+                outs = exported.call(pv, bv, *ivals)
+                outs = [to_tensor(o) for o in outs]
+                return outs[0] if len(outs) == 1 else tuple(outs)
+
+            def eval(self):
+                return self
+
+        return _Callable()
+    raise InvalidArgumentError(
+        f"No exported program at {path}.pdmodel — only weights were saved "
+        f"(export_error: {meta.get('export_error')})"
+    )
+
+
+def ignore_module(modules):
+    """No-op (AST transform exclusion list — no AST pass here)."""
+
+
+def not_to_static(fn=None):
+    return fn

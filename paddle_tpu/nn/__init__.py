@@ -1,0 +1,29 @@
+"""``paddle.nn`` surface (reference: ``python/paddle/nn/``)."""
+
+from . import functional, initializer
+from .layer.activation import *  # noqa: F401,F403
+from .layer.activation import __all__ as _act_all
+from .layer.common import *  # noqa: F401,F403
+from .layer.common import __all__ as _common_all
+from .layer.container import *  # noqa: F401,F403
+from .layer.container import __all__ as _container_all
+from .layer.conv import *  # noqa: F401,F403
+from .layer.conv import __all__ as _conv_all
+from .layer.layers import Layer, ParamAttr, Parameter
+from .layer.loss import *  # noqa: F401,F403
+from .layer.loss import __all__ as _loss_all
+from .layer.norm import *  # noqa: F401,F403
+from .layer.norm import __all__ as _norm_all
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.pooling import __all__ as _pool_all
+from .layer.rnn import *  # noqa: F401,F403
+from .layer.rnn import __all__ as _rnn_all
+from .layer.transformer import *  # noqa: F401,F403
+from .layer.transformer import __all__ as _tfm_all
+from .utils import clip_grad_norm_, clip_grad_value_, parameters_to_vector, vector_to_parameters
+
+__all__ = (
+    ["Layer", "Parameter", "ParamAttr", "functional", "initializer"]
+    + _act_all + _common_all + _container_all + _conv_all + _loss_all
+    + _norm_all + _pool_all + _rnn_all + _tfm_all
+)

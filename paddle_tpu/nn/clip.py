@@ -1,0 +1,75 @@
+"""Gradient clipping (reference: ``python/paddle/nn/clip.py`` —
+``ClipGradByGlobalNorm`` et al., consumed by optimizers)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads: List[Tuple]) -> List[Tuple]:
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+            else:
+                out.append((p, jnp.clip(g, self.min, self.max)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+            scale = jnp.where(norm > self.clip_norm, self.clip_norm / norm, 1.0)
+            out.append((p, (g * scale).astype(g.dtype)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Scale all grads by clip_norm/global_norm when exceeded. Under hybrid
+    parallel, HybridParallelClipGrad extends this with cross-mesh-axis psums
+    (SURVEY.md §2.2 HybridParallelOptimizer)."""
+
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def _global_norm(self, grads):
+        return jnp.sqrt(
+            sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in grads)
+        )
+
+    def __call__(self, params_grads):
+        clippable = [(p, g) for p, g in params_grads
+                     if g is not None and getattr(p, "need_clip", True)]
+        if not clippable:
+            return params_grads
+        gnorm = self._global_norm([g for _, g in clippable])
+        scale = jnp.where(gnorm > self.clip_norm, self.clip_norm / (gnorm + 1e-6), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, (g * scale).astype(g.dtype)))
+        return out

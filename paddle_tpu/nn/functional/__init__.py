@@ -1,0 +1,953 @@
+"""``paddle.nn.functional`` — neural-net functional ops.
+
+Reference: ``python/paddle/nn/functional/`` over PHI kernels (conv, pool,
+norm, losses; SURVEY.md §2.1). Convolutions lower to
+``lax.conv_general_dilated`` (XLA maps them onto the MXU), pooling to
+``lax.reduce_window``, attention to the Pallas flash-attention kernel on TPU
+(``paddle_tpu.ops.pallas``) with an XLA fallback elsewhere.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dtype import convert_dtype
+from ...core.tensor import Tensor, to_tensor
+from ...enforce import InvalidArgumentError
+from ...framework.random import next_key
+from ...ops.dispatch import run_op
+from ...ops import manipulation as _manip
+
+__all__ = [
+    # activations
+    "relu", "relu6", "gelu", "silu", "swish", "sigmoid", "log_sigmoid",
+    "tanh", "softmax", "log_softmax", "leaky_relu", "elu", "selu", "celu",
+    "prelu", "hardtanh", "hardshrink", "hardsigmoid", "hardswish", "mish",
+    "softplus", "softshrink", "softsign", "tanhshrink", "thresholded_relu",
+    "glu", "gumbel_softmax", "maxout",
+    # linear / conv / pool
+    "linear", "bilinear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+    "conv2d_transpose", "conv3d_transpose", "max_pool1d", "max_pool2d",
+    "max_pool3d", "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_max_pool2d",
+    "unfold", "interpolate", "upsample", "pixel_shuffle",
+    # norm / dropout / embedding
+    "batch_norm", "layer_norm", "instance_norm", "group_norm", "rms_norm",
+    "local_response_norm", "normalize", "dropout", "dropout2d", "dropout3d",
+    "alpha_dropout", "embedding", "one_hot", "label_smooth",
+    # losses
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_similarity", "ctc_loss", "sigmoid_focal_loss", "square_error_cost",
+    # attention
+    "scaled_dot_product_attention", "sequence_mask", "pad",
+]
+
+Axis = Union[int, Sequence[int]]
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v) if len(v) == n else tuple(v) * n
+    return (v,) * n
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+def _act(name, fn):
+    def op(x, name=None):
+        return run_op(name_, fn, x)
+
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+relu = _act("relu", lambda a: jax.nn.relu(a))
+relu6 = _act("relu6", lambda a: jnp.clip(a, 0, 6))
+silu = _act("silu", lambda a: jax.nn.silu(a))
+swish = silu
+sigmoid = _act("sigmoid", lambda a: jax.nn.sigmoid(a))
+log_sigmoid = _act("log_sigmoid", lambda a: jax.nn.log_sigmoid(a))
+tanh = _act("tanh", lambda a: jnp.tanh(a))
+hardsigmoid = _act("hardsigmoid", lambda a: jnp.clip(a / 6.0 + 0.5, 0.0, 1.0))
+hardswish = _act("hardswish", lambda a: a * jnp.clip(a + 3, 0, 6) / 6)
+mish = _act("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+softsign = _act("softsign", lambda a: a / (1 + jnp.abs(a)))
+tanhshrink = _act("tanhshrink", lambda a: a - jnp.tanh(a))
+
+
+def gelu(x, approximate=False, name=None):
+    return run_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    dt = convert_dtype(dtype) if dtype else None
+
+    def f(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.softmax(a, axis=axis)
+
+    return run_op("softmax", f, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    dt = convert_dtype(dtype) if dtype else None
+
+    def f(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return run_op("log_softmax", f, x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return run_op("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return run_op("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return run_op("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return run_op("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+    return run_op("prelu", f, x, weight)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return run_op("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return run_op("hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return run_op(
+        "softplus",
+        lambda a: jnp.where(a * beta > threshold, a, jax.nn.softplus(a * beta) / beta),
+        x,
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return run_op(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)),
+        x,
+    )
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return run_op("thresholded_relu", lambda a: jnp.where(a > threshold, a, value), x)
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+
+    return run_op("glu", f, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    g = jax.random.gumbel(next_key(), tuple(x.shape), x._value.dtype)
+
+    def f(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = y_hard + jax.lax.stop_gradient(y) - y + y  # straight-through
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+
+    return run_op("gumbel_softmax", f, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        c = a.shape[axis]
+        new = list(a.shape)
+        new[axis] = c // groups
+        new.insert(axis + 1, groups)
+        return jnp.max(a.reshape(new), axis=axis + 1)
+
+    return run_op("maxout", f, x)
+
+
+# ---------------------------------------------------------------------------
+# linear / conv / pool
+# ---------------------------------------------------------------------------
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, W shape [in, out] (paddle convention)."""
+    if bias is None:
+        return run_op("linear", lambda a, w: a @ w, x, weight)
+    return run_op("linear", lambda a, w, b: a @ w + b, x, weight, bias)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bm,omn,bn->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return run_op("bilinear", f, *args)
+
+
+def _conv_nd(
+    x, weight, bias, stride, padding, dilation, groups, nd, data_format, op_name
+):
+    strides = _pair(stride, nd)
+    dils = _pair(dilation, nd)
+    if isinstance(padding, str):
+        pad = padding.upper()  # SAME / VALID
+    elif isinstance(padding, (list, tuple)) and len(padding) == 2 * nd:
+        pad = [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    else:
+        p = _pair(padding, nd)
+        pad = [(pi, pi) for pi in p]
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spatial = "DHW"[3 - nd :] if nd < 3 else "DHW"
+    spatial = {1: "W", 2: "HW", 3: "DHW"}[nd]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, "OI" + spatial, lhs_spec)
+    )
+
+    def f(a, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad, rhs_dilation=dils,
+            dimension_numbers=dn, feature_group_count=groups,
+        )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return run_op(op_name, f, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NLC" if data_format == "NLC" else "NCL"
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, fmt, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format, "conv3d")
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, nd, data_format, op_name):
+    strides = _pair(stride, nd)
+    dils = _pair(dilation, nd)
+    p = _pair(padding, nd)
+    spatial = {1: "W", 2: "HW", 3: "DHW"}[nd]
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    # paddle weight layout for transpose conv: [in, out/groups, *k]
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, "IO" + spatial, lhs_spec)
+    )
+    pad = [(di * (k - 1) - pi, di * (k - 1) - pi + op_)
+           for pi, di, k, op_ in zip(
+               p, dils, weight.shape[2:], _pair(output_padding, nd))]
+
+    def f(a, w, *rest):
+        # grad-of-conv formulation: dilate the input by `stride`, convolve with
+        # the spatially-flipped kernel ("IO" spec swaps in/out channels)
+        w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=(1,) * nd, padding=pad, lhs_dilation=strides,
+            rhs_dilation=dils, dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return run_op(op_name, f, *args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1, data_format, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 2, data_format, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3, data_format, "conv3d_transpose")
+
+
+def _pool_nd(x, kernel, stride, padding, nd, kind, ceil_mode, exclusive,
+             data_format, op_name):
+    ks = _pair(kernel, nd)
+    st = _pair(stride if stride is not None else kernel, nd)
+    pd = _pair(padding, nd)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    if channel_last:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pads = ((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),)
+    else:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+
+    def f(a):
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pads)
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
+        if exclusive and any(p > 0 for p in pd):
+            ones = jnp.ones_like(a)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+            return s / cnt
+        return s / float(np.prod(ks))
+
+    return run_op(op_name, f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCL", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, "max", ceil_mode, True, data_format, "max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, "max", ceil_mode, True, data_format, "max_pool2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, "max", ceil_mode, True, data_format, "max_pool3d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, "avg", ceil_mode, exclusive, data_format, "avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, "avg", ceil_mode, exclusive, data_format, "avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, "avg", ceil_mode, exclusive, data_format, "avg_pool3d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max")
+
+
+def _adaptive_pool(x, output_size, nd, kind):
+    out_sz = _pair(output_size, nd)
+    in_sz = tuple(x.shape[-nd:])
+    if any(i % o != 0 for i, o in zip(in_sz, out_sz)):
+        raise InvalidArgumentError(
+            f"adaptive pool: input spatial {in_sz} not divisible by output {out_sz}"
+        )
+    ks = tuple(i // o for i, o in zip(in_sz, out_sz))
+    return _pool_nd(x, ks, ks, 0, nd, kind, False, True, "NCHW", f"adaptive_{kind}_pool")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = _pair(kernel_sizes)
+    st = _pair(strides)
+    pd = _pair(paddings)
+    dl = _pair(dilations)
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+        oh = (h + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (w + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                patches.append(
+                    a[:, :, di : di + (oh - 1) * st[0] + 1 : st[0],
+                      dj : dj + (ow - 1) * st[1] + 1 : st[1]]
+                )
+        out = jnp.stack(patches, axis=2)  # N, C, k*k, OH, OW
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return run_op("unfold", f, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW", name=None):
+    nd = x.ndim - 2
+    in_sz = x.shape[2:] if data_format.startswith("NC") else x.shape[1:-1]
+    if size is None:
+        sf = _pair(scale_factor, nd)
+        size = tuple(int(i * s) for i, s in zip(in_sz, sf))
+    else:
+        size = tuple(_pair(size, nd))
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+              "trilinear": "linear", "linear": "linear", "area": "linear"}[mode]
+
+    def f(a):
+        if data_format.startswith("NC"):
+            shape = a.shape[:2] + size
+        else:
+            shape = (a.shape[0],) + size + (a.shape[-1],)
+        return jax.image.resize(a, shape, method=method)
+
+    return run_op("interpolate", f, x)
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c // (r * r), r, r, h, w)
+        a = a.transpose(0, 1, 4, 2, 5, 3)
+        return a.reshape(n, c // (r * r), h * r, w * r)
+
+    return run_op("pixel_shuffle", f, x)
+
+
+# ---------------------------------------------------------------------------
+# normalisation / dropout / embedding
+# ---------------------------------------------------------------------------
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    """BatchNorm. In training mode also updates running stats in-place
+    (paddle semantics: running = momentum*running + (1-momentum)*batch)."""
+    channel_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    use_batch_stats = training and not use_global_stats
+
+    from ...jit import is_tracing
+
+    if use_batch_stats and not is_tracing():
+        # update running stats (host-side in-place on the buffer tensors);
+        # skipped under to_static tracing — tracers must not leak into buffers
+        with_mean = jnp.mean(x._value, axis=axes)
+        with_var = jnp.var(x._value, axis=axes)
+        running_mean._inplace_set(momentum * running_mean._value + (1 - momentum) * with_mean)
+        running_var._inplace_set(momentum * running_var._value + (1 - momentum) * with_var)
+
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+
+    def f(a, *rest):
+        i = 0
+        if use_batch_stats:
+            m = jnp.mean(a, axis=axes)
+            v = jnp.var(a, axis=axes)
+        else:
+            m, v = running_mean._value, running_var._value
+        out = (a - m.reshape(shape)) / jnp.sqrt(v.reshape(shape) + epsilon)
+        if weight is not None:
+            out = out * rest[0].reshape(shape)
+            i = 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return run_op("batch_norm", f, *args)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    ndim = len(normalized_shape)
+    axes = tuple(range(x.ndim - ndim, x.ndim))
+
+    def f(a, *rest):
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) / jnp.sqrt(v + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * rest[0]
+            i = 1
+        if bias is not None:
+            out = out + rest[i]
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return run_op("layer_norm", f, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (LLaMA-style) — reference exposes it via fused kernels
+    (``paddle/phi/kernels/fusion``); on TPU XLA fuses this chain anyway."""
+
+    def f(a, *rest):
+        dt = a.dtype
+        a32 = a.astype(jnp.float32)
+        out = a32 * jax.lax.rsqrt(jnp.mean(a32 * a32, axis=-1, keepdims=True) + epsilon)
+        out = out.astype(dt)
+        if rest:
+            out = out * rest[0]
+        return out
+
+    args = [x] + ([weight] if weight is not None else [])
+    return run_op("rms_norm", f, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    axes = tuple(range(2, x.ndim))
+    c = x.shape[1]
+    shape = [1, c] + [1] * (x.ndim - 2)
+
+    def f(a, *rest):
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) / jnp.sqrt(v + eps)
+        i = 0
+        if weight is not None:
+            out = out * rest[0].reshape(shape)
+            i = 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return run_op("instance_norm", f, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    c = x.shape[1]
+    shape = [1, c] + [1] * (x.ndim - 2)
+
+    def f(a, *rest):
+        n = a.shape[0]
+        g = a.reshape((n, num_groups, c // num_groups) + a.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        v = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - m) / jnp.sqrt(v + epsilon)).reshape(a.shape)
+        i = 0
+        if weight is not None:
+            out = out * rest[0].reshape(shape)
+            i = 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return run_op("group_norm", f, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def f(a):
+        sq = a * a
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[1] = (half, size - 1 - half)
+        padded = jnp.pad(sq, pads)
+        window = [1] * a.ndim
+        window[1] = size
+        s = jax.lax.reduce_window(padded, 0.0, jax.lax.add, tuple(window), (1,) * a.ndim, "VALID")
+        return a / jnp.power(k + alpha * s, beta)
+
+    return run_op("local_response_norm", f, x)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+
+    return run_op("normalize", f, x)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else to_tensor(x)
+    if p == 1.0:
+        from ...ops.creation import zeros_like
+
+        return zeros_like(x)
+    shape = tuple(x.shape)
+    if axis is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, shape)
+
+    def f(a):
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0)
+        return jnp.where(keep, a, 0.0)
+
+    return run_op("dropout", f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axes = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=list(axes), training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axes = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=list(axes), training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha_p = -1.7580993408473766
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(x.shape))
+    a_coef = (1.0 - p + p * alpha_p**2 * (1.0 - p)) ** -0.5
+    b_coef = -a_coef * p * alpha_p
+
+    def f(a):
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+
+    return run_op("alpha_dropout", f, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(w):
+        out = jnp.take(w, x._value, axis=0)
+        if padding_idx is not None:
+            mask = (x._value == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return run_op("embedding", f, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.creation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(a):
+        k = a.shape[-1]
+        if prior_dist is not None:
+            return (1 - epsilon) * a + epsilon * prior_dist._value
+        return (1 - epsilon) * a + epsilon / k
+
+    return run_op("label_smooth", f, label)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """Softmax cross-entropy (reference: ``c_softmax_with_cross_entropy`` CPU/GPU
+    kernels + ``python/paddle/nn/functional/loss.py``)."""
+
+    def f(logits, *rest):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.clip(logits, 1e-30, None)
+        )
+        if soft_label:
+            lab = label._value
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                lab = (1 - label_smoothing) * lab + label_smoothing / k
+            loss = -jnp.sum(lab * logp, axis=axis)
+        else:
+            lab = label._value
+            if lab.ndim == logp.ndim:
+                lab = jnp.squeeze(lab, axis)
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                oh = jax.nn.one_hot(lab, k, dtype=logp.dtype)
+                oh = (1 - label_smoothing) * oh + label_smoothing / k
+                loss = -jnp.sum(oh * logp, axis=axis)
+            else:
+                loss = -jnp.take_along_axis(
+                    logp, jnp.expand_dims(lab, axis), axis=axis
+                ).squeeze(axis)
+            if ignore_index >= 0:
+                mask = lab != ignore_index
+                loss = jnp.where(mask, loss, 0.0)
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
+        if weight is not None:
+            w = rest[0]
+            lab_idx = label._value
+            if lab_idx.ndim == logp.ndim:
+                lab_idx = jnp.squeeze(lab_idx, axis)
+            loss = loss * jnp.take(w, lab_idx)
+        return _reduce(loss, reduction)
+
+    args = [input] + ([weight] if weight is not None else [])
+    return run_op("cross_entropy", f, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, t, *rest):
+        eps = 1e-12
+        loss = -(t * jnp.log(jnp.clip(p, eps, None)) + (1 - t) * jnp.log(jnp.clip(1 - p, eps, None)))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return run_op("bce", f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, t, *rest):
+        i = 0
+        loss = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pos_weight is not None:
+            pw = rest[i]
+            i += 1
+            log_w = (pw - 1) * t + 1
+            loss = loss * log_w
+        if weight is not None:
+            loss = loss * rest[i]
+        return _reduce(loss, reduction)
+
+    args = [logit, label]
+    if pos_weight is not None:
+        args.append(pos_weight)
+    if weight is not None:
+        args.append(weight)
+    return run_op("bce_logits", f, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return run_op("mse_loss", lambda a, b: _reduce((a - b) ** 2, reduction), input, label)
+
+
+def square_error_cost(input, label, name=None):
+    return run_op("square_error_cost", lambda a, b: (a - b) ** 2, input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return run_op("l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def f(logp, *rest):
+        lab = label._value
+        loss = -jnp.take_along_axis(logp, lab[..., None], axis=-1).squeeze(-1)
+        if rest:
+            loss = loss * jnp.take(rest[0], lab)
+        if ignore_index >= 0:
+            mask = lab != ignore_index
+            loss = jnp.where(mask, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
+        return _reduce(loss, reduction)
+
+    args = [input] + ([weight] if weight is not None else [])
+    return run_op("nll_loss", f, *args)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = a - b
+        loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d, delta * (jnp.abs(d) - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return run_op("smooth_l1", f, input, label)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, t):
+        loss = t * (jnp.log(jnp.clip(t, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return run_op("kl_div", f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return run_op(
+        "margin_ranking",
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        input, other, label,
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return run_op(
+        "hinge_embedding",
+        lambda a, y: _reduce(jnp.where(y == 1, a, jnp.maximum(0.0, margin - a)), reduction),
+        input, label,
+    )
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return jnp.sum(a * b, axis=axis) / jnp.maximum(na * nb, eps)
+
+    return run_op("cosine_similarity", f, x1, x2)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, t):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if normalizer is not None:
+            loss = loss / normalizer._value
+        return _reduce(loss, reduction)
+
+    return run_op("sigmoid_focal_loss", f, logit, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    from ...enforce import raise_unimplemented
+
+    raise_unimplemented("ctc_loss")
+
+
+# ---------------------------------------------------------------------------
+# attention / misc
+# ---------------------------------------------------------------------------
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """Flash attention. Inputs [batch, seq, heads, head_dim] (paddle layout).
+
+    On TPU uses the Pallas flash-attention kernel
+    (``paddle_tpu/ops/pallas/flash_attention.py``); elsewhere an XLA softmax
+    attention that XLA fuses well.
+    """
+    from ...ops.pallas import flash_attention as fa
+
+    args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+
+    def f(q, k, v, *rest):
+        mask = rest[0] if rest else None
+        return fa.dot_product_attention(q, k, v, mask=mask, is_causal=is_causal)
+
+    out = run_op("scaled_dot_product_attention", f, *args)
+    if dropout_p > 0.0 and training:
+        out = dropout(out, dropout_p, training=training)
+    return out
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    ml = int(maxlen) if maxlen is not None else int(np.max(np.asarray(lengths._value)))
+    dt = convert_dtype(dtype)
+
+    def f(l):
+        return (jnp.arange(ml)[None, :] < l[..., None]).astype(dt)
+
+    return run_op("sequence_mask", f, lengths)
+
+
+from ...ops.manipulation import pad  # re-export: paddle.nn.functional.pad

@@ -1,0 +1,1 @@
+from . import activation, common, container, conv, layers, loss, norm, pooling, rnn, transformer

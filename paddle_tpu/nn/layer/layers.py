@@ -1,0 +1,382 @@
+"""``paddle.nn.Layer`` — the module base class.
+
+Reference: ``python/paddle/nn/layer/layers.py`` (SURVEY.md §2.1 "Python
+tensor/nn/optimizer API"): parameter/sublayer registration via attribute
+assignment, buffers, forward hooks, ``state_dict``/``set_state_dict``,
+train/eval modes, ``apply``/``to``. Parameters are leaf Tensors with
+``stop_gradient=False`` so the eager tape reaches them.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+from ...core.dtype import convert_dtype
+from ...core.tensor import Tensor, to_tensor
+from ...enforce import InvalidArgumentError
+from .. import initializer as I
+
+__all__ = ["Layer", "Parameter", "ParamAttr"]
+
+
+class Parameter(Tensor):
+    """Trainable tensor (``paddle.base.framework.EagerParamBase`` analog)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip", "is_distributed")
+
+    def __init__(self, value, name=None, trainable=True, need_clip=True):
+        super().__init__(value, stop_gradient=not trainable, name=name, persistable=True)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.need_clip = need_clip
+        self.is_distributed = False
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+class ParamAttr:
+    """Parameter attribute bundle (``paddle.ParamAttr``)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=None,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        if isinstance(attr, I.Initializer):
+            return ParamAttr(initializer=attr)
+        raise InvalidArgumentError(f"Cannot convert {attr!r} to ParamAttr")
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, hook_id: int):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    """Base class for all network layers."""
+
+    def __init__(self, name_scope: Optional[str] = None, dtype: str = "float32"):
+        self.training = True
+        self._dtype = dtype
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+        self._parameters: "collections.OrderedDict[str, Parameter]" = collections.OrderedDict()
+        self._sub_layers: "collections.OrderedDict[str, Layer]" = collections.OrderedDict()
+        self._buffers: "collections.OrderedDict[str, Tensor]" = collections.OrderedDict()
+        self._non_persistable_buffer_names: set = set()
+        self._forward_pre_hooks: "collections.OrderedDict[int, Callable]" = collections.OrderedDict()
+        self._forward_post_hooks: "collections.OrderedDict[int, Callable]" = collections.OrderedDict()
+        self._hook_id = 0
+
+    # -- construction helpers ----------------------------------------------
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype: Optional[str] = None,
+        is_bias: bool = False,
+        default_initializer=None,
+    ) -> Optional[Parameter]:
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtype or self._dtype
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierUniform()
+        value = init(shape, convert_dtype(dtype))
+        p = Parameter(value, name=attr.name, trainable=attr.trainable,
+                      need_clip=attr.need_clip)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        return p
+
+    def create_tensor(self, shape=None, dtype=None, name=None):
+        import jax.numpy as jnp
+
+        shape = shape or []
+        return to_tensor(jnp.zeros(shape, convert_dtype(dtype or self._dtype)))
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is not None and not isinstance(parameter, Tensor):
+            raise InvalidArgumentError(f"add_parameter expects a Tensor, got {type(parameter)}")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor], persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- attribute interception ---------------------------------------------
+    def __setattr__(self, name: str, value: Any):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            layers[name] = value
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif buffers is not None and name in buffers:
+            buffers[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        # only called when normal lookup fails
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- traversal ----------------------------------------------------------
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            if not include_sublayers and layer is not self:
+                continue
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (name + "." + pname if name else pname), p
+
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False,
+                        layers_set=None) -> Iterator[Tuple[str, "Layer"]]:
+        layers_set = layers_set if layers_set is not None else set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = prefix + "." + name if prefix else name
+            yield from sub.named_sublayers(prefix=sub_prefix, include_self=True,
+                                           layers_set=layers_set)
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True
+                      ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            if not include_sublayers and layer is not self:
+                continue
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (name + "." + bname if name else bname), b
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    # -- modes / functional map --------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn: Callable[["Layer"], None]):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        """Move/cast all parameters and buffers in place."""
+        from ...core.place import _parse_device, device_for_place
+        import jax
+
+        dev = device_for_place(_parse_device(device)) if device is not None else None
+        dt = convert_dtype(dtype) if dtype is not None else None
+        for p in self.parameters():
+            val = p._value
+            if dt is not None and p.is_floating_point():
+                val = val.astype(dt)
+            if dev is not None:
+                val = jax.device_put(val, dev)
+            p._inplace_set(val)
+        for b in self.buffers():
+            val = b._value
+            if dt is not None and b.is_floating_point():
+                val = val.astype(dt)
+            if dev is not None:
+                val = jax.device_put(val, dev)
+            b._inplace_set(val)
+        if dt is not None:
+            self._dtype = str(np.dtype(dt))
+        return self
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    # -- hooks --------------------------------------------------------------
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- call ---------------------------------------------------------------
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError(
+            f"Layer {type(self).__name__} does not implement forward()"
+        )
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            mod_str = repr(sub)
+            mod_str = "\n".join("  " + l for l in mod_str.split("\n"))
+            lines.append(f"  ({name}): {mod_str.strip()}")
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   structured_name_prefix: str = "", use_hook: bool = True
+                   ) -> Dict[str, Tensor]:
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters():
+            dest[structured_name_prefix + name] = p
+        for name, layer in self.named_sublayers(include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                key = (name + "." + bname if name else bname)
+                dest[structured_name_prefix + key] = b
+        return dest
+
+    def set_state_dict(self, state_dict: Dict[str, Any], use_structured_name: bool = True):
+        """Load values into existing parameters/buffers (shape-checked)."""
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for key, value in state_dict.items():
+            if key not in own:
+                unexpected.append(key)
+                continue
+            target = own[key]
+            val = value._value if isinstance(value, Tensor) else np.asarray(value)
+            if tuple(target.shape) != tuple(val.shape):
+                raise InvalidArgumentError(
+                    f"Shape mismatch for {key}: checkpoint {tuple(val.shape)} vs "
+                    f"model {tuple(target.shape)}"
+                )
+            import jax.numpy as jnp
+
+            target._inplace_set(jnp.asarray(val, dtype=target._value.dtype))
+        for key in own:
+            if key not in state_dict:
+                missing.append(key)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def full_name(self) -> str:
+        return self._name_scope

@@ -1,0 +1,253 @@
+"""Recurrent layers (reference: ``python/paddle/nn/layer/rnn.py`` over cuDNN
+RNN kernels). TPU-native: the time loop is a ``lax.scan`` so XLA compiles one
+fused step; weights follow paddle's per-gate concat layout."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, to_tensor
+from ...ops.dispatch import run_op
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["SimpleRNN", "LSTM", "GRU", "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell"]
+
+
+class RNNCellBase(Layer):
+    pass
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        k = 1.0 / np.sqrt(hidden_size)
+        init = I.Uniform(-k, k)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+        self.activation = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = to_tensor(jnp.zeros((inputs.shape[0], self.hidden_size)))
+        act = self.activation
+
+        def f(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = run_op("rnn_cell", f, inputs, states, self.weight_ih, self.weight_hh,
+                   self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        k = 1.0 / np.sqrt(hidden_size)
+        init = I.Uniform(-k, k)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            z = to_tensor(jnp.zeros((inputs.shape[0], self.hidden_size)))
+            states = (z, z)
+        h_prev, c_prev = states
+        hs = self.hidden_size
+
+        def f(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f_, g, o = jnp.split(gates, 4, axis=-1)
+            i, f_, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f_), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f_ * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h, c = run_op("lstm_cell", f, inputs, h_prev, c_prev, self.weight_ih,
+                      self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        k = 1.0 / np.sqrt(hidden_size)
+        init = I.Uniform(-k, k)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = to_tensor(jnp.zeros((inputs.shape[0], self.hidden_size)))
+
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn)
+            return (1 - z) * n + z * h
+
+        h = run_op("gru_cell", f, inputs, states, self.weight_ih, self.weight_hh,
+                   self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class _RNNBase(Layer):
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        self.num_directions = ndir
+        gate_mult = {"RNN": 1, "LSTM": 4, "GRU": 3}[self.MODE]
+        k = 1.0 / np.sqrt(hidden_size)
+        init = I.Uniform(-k, k)
+        for layer in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if layer == 0 else hidden_size * ndir
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                self.add_parameter("weight_ih" + sfx, self.create_parameter(
+                    [gate_mult * hidden_size, in_sz], weight_ih_attr, default_initializer=init))
+                self.add_parameter("weight_hh" + sfx, self.create_parameter(
+                    [gate_mult * hidden_size, hidden_size], weight_hh_attr, default_initializer=init))
+                self.add_parameter("bias_ih" + sfx, self.create_parameter(
+                    [gate_mult * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init))
+                self.add_parameter("bias_hh" + sfx, self.create_parameter(
+                    [gate_mult * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init))
+        self.activation = activation
+
+    def _cell_step(self, mode, act):
+        if mode == "LSTM":
+            def step(carry, x, wi, wh, bi, bh):
+                h, c = carry
+                gates = x @ wi.T + bi + h @ wh.T + bh
+                i, f_, g, o = jnp.split(gates, 4, axis=-1)
+                i, f_, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f_), jax.nn.sigmoid(o)
+                g = jnp.tanh(g)
+                c = f_ * c + i * g
+                h = o * jnp.tanh(c)
+                return (h, c), h
+        elif mode == "GRU":
+            def step(carry, x, wi, wh, bi, bh):
+                h = carry
+                gi = x @ wi.T + bi
+                gh = h @ wh.T + bh
+                ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+                hr, hz, hn = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                n = jnp.tanh(in_ + r * hn)
+                h = (1 - z) * n + z * h
+                return h, h
+        else:
+            a = jnp.tanh if act == "tanh" else jax.nn.relu
+
+            def step(carry, x, wi, wh, bi, bh):
+                h = a(x @ wi.T + bi + carry @ wh.T + bh)
+                return h, h
+
+        return step
+
+    def forward(self, inputs, initial_states=None):
+        mode = self.MODE
+        step = self._cell_step(mode, self.activation)
+        time_major = self.time_major
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+
+        params = []
+        for layer in range(nl):
+            for d in range(nd):
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                params.append(tuple(
+                    self._parameters[n + sfx]
+                    for n in ("weight_ih", "weight_hh", "bias_ih", "bias_hh")
+                ))
+
+        tensor_params = [p for group in params for p in group]
+
+        def f(x, *flat_params):
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # [T, B, in]
+            T, B = x.shape[0], x.shape[1]
+            idx = 0
+            out = x
+            final_h, final_c = [], []
+            for layer in range(nl):
+                dir_outs = []
+                for d in range(nd):
+                    wi, wh, bi, bh = flat_params[idx : idx + 4]
+                    idx += 4
+                    seq = out[::-1] if d == 1 else out
+                    if mode == "LSTM":
+                        carry0 = (jnp.zeros((B, hs), x.dtype), jnp.zeros((B, hs), x.dtype))
+                    else:
+                        carry0 = jnp.zeros((B, hs), x.dtype)
+
+                    def scan_fn(carry, xt, _wi=wi, _wh=wh, _bi=bi, _bh=bh):
+                        return step(carry, xt, _wi, _wh, _bi, _bh)
+
+                    carry, ys = jax.lax.scan(scan_fn, carry0, seq)
+                    if d == 1:
+                        ys = ys[::-1]
+                    dir_outs.append(ys)
+                    if mode == "LSTM":
+                        final_h.append(carry[0])
+                        final_c.append(carry[1])
+                    else:
+                        final_h.append(carry)
+                out = jnp.concatenate(dir_outs, axis=-1) if nd == 2 else dir_outs[0]
+            y = out if time_major else jnp.swapaxes(out, 0, 1)
+            h = jnp.stack(final_h, axis=0)
+            if mode == "LSTM":
+                c = jnp.stack(final_c, axis=0)
+                return y, h, c
+            return y, h
+
+        outs = run_op(f"{mode.lower()}", f, inputs, *tensor_params)
+        if mode == "LSTM":
+            y, h, c = outs
+            return y, (h, c)
+        y, h = outs
+        return y, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN"
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"

@@ -1,0 +1,47 @@
+"""``paddle.nn.utils`` (reference: ``python/paddle/nn/utils/``)."""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, to_tensor
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector", "vector_to_parameters"]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple)) else [parameters])
+              if p.grad is not None]
+    if not params:
+        return to_tensor(0.0)
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p.grad._value)) for p in params]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(p.grad._value) ** norm_type) for p in params]
+        )) ** (1.0 / norm_type)
+    clip_coef = jnp.clip(max_norm / (total + 1e-6), None, 1.0)
+    for p in params:
+        p.grad._inplace_set(p.grad._value * clip_coef)
+    return to_tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = parameters if isinstance(parameters, (list, tuple)) else [parameters]
+    for p in params:
+        if p.grad is not None:
+            p.grad._inplace_set(jnp.clip(p.grad._value, -clip_value, clip_value))
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    return to_tensor(jnp.concatenate([p._value.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec: Tensor, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p._inplace_set(vec._value[offset : offset + n].reshape(p._value.shape))
+        offset += n

@@ -59,6 +59,32 @@ def run_op(
     outputs are differentiable (the rest are aux ints, e.g. argmax indices).
     """
     arrays = [t._value for t in tensors]
+
+    # AMP autocast hook (the reference's C++ dispatch-level autocast): cast
+    # inputs according to the active white/black lists before execution.
+    from ..amp import amp_state
+
+    if amp_state.enabled:
+        lo = amp_state.dtype
+        casts = [None] * len(arrays)
+        if name in amp_state.black:
+            for i, a in enumerate(arrays):
+                if is_floating_dtype(a.dtype) and a.dtype in (jnp.bfloat16, jnp.float16):
+                    casts[i] = jnp.float32
+        elif name in amp_state.white or amp_state.level == "O2":
+            for i, a in enumerate(arrays):
+                if is_floating_dtype(a.dtype) and a.dtype == jnp.float32:
+                    casts[i] = lo
+        if any(c is not None for c in casts):
+            # fold the cast INTO the differentiated function so VJP cotangent
+            # dtypes match the uncast inputs (cast-grad = cast-back)
+            orig_fn = pure_fn
+
+            def pure_fn(*xs, _casts=tuple(casts), _orig=orig_fn):
+                return _orig(*[
+                    x.astype(c) if c is not None else x for x, c in zip(xs, _casts)
+                ])
+
     diff_idx = (
         [
             i

@@ -1,0 +1,10 @@
+"""Pallas TPU kernels — the fused-kernel library.
+
+TPU-native counterpart of the reference's ``paddle/phi/kernels/fusion``
+(flash_attn, fused_rope, fused adamw; SURVEY.md §2.1 "Fused kernels"). XLA
+already fuses elementwise chains; these kernels cover what XLA's default
+codegen doesn't: flash attention (tiled online softmax in VMEM) and, later,
+ring attention over ICI.
+"""
+
+from . import flash_attention

@@ -1,0 +1,188 @@
+"""Flash attention.
+
+Counterpart of the reference's ``flash_attn`` fused kernel
+(``paddle/phi/kernels/fusion`` wrapping the FlashAttention CUDA lib;
+SURVEY.md §2.1). Two paths:
+
+* ``_pallas_flash_attention`` — tiled online-softmax kernel in VMEM for TPU
+  (MXU-sized q/k blocks, numerically stable running max/sum rescaling).
+* ``_xla_attention`` — plain jnp formulation for CPU tests and as the
+  reference implementation; XLA fuses it reasonably but materialises the
+  [S, S] score matrix.
+
+Layout convention (paddle flash_attn): [batch, seq, num_heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import flags
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _xla_attention(q, k, v, mask=None, is_causal=False, scale=None):
+    # q,k,v: [B, S, H, D] -> scores over S
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if is_causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(causal, logits, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel (forward). Grid: (batch*heads, q_blocks); the kv loop runs
+# inside the kernel with a running (max, sum) online softmax.
+# ---------------------------------------------------------------------------
+
+def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        # q_ref: [1, block_q, d]; k_ref/v_ref: [1, S, d] (this head's K/V)
+        qb = q_ref[0].astype(jnp.float32) * scale
+        S = k_ref.shape[1]
+        q_idx = pl.program_id(1)
+
+        def body(start, carry):
+            acc, m_prev, l_prev = carry
+            kb = k_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
+            s = qb @ kb.T  # [block_q, block_k]
+            if is_causal:
+                q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                k_pos = start * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+            m_cur = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            # guard fully-masked rows (m == -inf)
+            m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            p = jnp.exp(s - m_safe[:, None])
+            p = jnp.where(jnp.isfinite(s), p, 0.0)
+            alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[:, None] + p @ vb
+            return acc, m_new, l_new
+
+        n_k = S // block_k
+        if is_causal:
+            # only blocks up to the diagonal contribute
+            last = jax.lax.div(
+                (q_idx + 1) * block_q + block_k - 1, jnp.int32(block_k)
+            )
+            n_iter = jnp.minimum(n_k, last)
+        else:
+            n_iter = n_k
+        acc0 = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
+        m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((block_q,), jnp.float32)
+        acc, m, l = jax.lax.fori_loop(0, n_iter, body, (acc0, m0, l0))
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+    return kernel
+
+
+def _pallas_flash_attention(q, k, v, is_causal=False, scale=None,
+                            block_q: int = 256, block_k: int = 256):
+    """Forward flash attention via Pallas. [B, S, H, D] layout."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        return _xla_attention(q, k, v, is_causal=is_causal, scale=scale)
+
+    # fold batch & heads into the grid's first axis: [B*H, S, D]
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    kernel = _make_pallas_fwd(block_q, block_k, is_causal, scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.jit, static_argnames=("is_causal", "use_pallas"))
+def _dispatch(q, k, v, mask, is_causal, use_pallas):
+    if use_pallas and mask is None:
+        return _pallas_flash_attention(q, k, v, is_causal=is_causal)
+    return _xla_attention(q, k, v, mask=mask, is_causal=is_causal)
+
+
+def dot_product_attention(q, k, v, mask=None, is_causal=False):
+    """Public entry: picks Pallas on TPU (when enabled and mask-free),
+    XLA reference elsewhere. Differentiable (backward via XLA autodiff of the
+    reference path when pallas is active — see flash_attention custom VJP
+    TODO in M3 notes)."""
+    use_pallas = (
+        _on_tpu()
+        and flags.get_flags("use_pallas_kernels")["use_pallas_kernels"]
+        and mask is None
+    )
+    if use_pallas:
+        return _flash_custom_vjp(q, k, v, is_causal)
+    return _xla_attention(q, k, v, mask=mask, is_causal=is_causal)
+
+
+# custom VJP: pallas forward, XLA-recompute backward (flash-style backward
+# kernel lands with M3 perf work; recompute keeps memory at O(S) not O(S^2)
+# only in the forward — backward materialises scores per-head).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_custom_vjp(q, k, v, is_causal):
+    return _pallas_flash_attention(q, k, v, is_causal=is_causal)
+
+
+def _flash_fwd(q, k, v, is_causal):
+    return _pallas_flash_attention(q, k, v, is_causal=is_causal), (q, k, v)
+
+
+def _flash_bwd(is_causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(q_, k_, v_, is_causal=is_causal), q, k, v)
+    return vjp(g)
+
+
+_flash_custom_vjp.defvjp(_flash_fwd, _flash_bwd)

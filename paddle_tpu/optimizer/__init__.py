@@ -1,0 +1,10 @@
+"""``paddle.optimizer`` surface."""
+
+from . import lr
+from .adam import Adam, AdamW, Lamb
+from .optimizer import SGD, Adadelta, Adagrad, Momentum, Optimizer, RMSProp
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Lamb", "Adagrad",
+    "Adadelta", "RMSProp", "lr",
+]

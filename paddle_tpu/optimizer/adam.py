@@ -1,0 +1,128 @@
+"""Adam / AdamW / Lamb (reference: ``python/paddle/optimizer/adamw.py`` +
+fused multi-tensor adam kernels in ``paddle/phi/kernels/fusion`` — here the
+fusion is the whole-pytree donated jit in ``Optimizer.step``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["Adam", "AdamW", "Lamb"]
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._multi_precision = multi_precision
+
+    def _use_master(self, p):
+        return self._multi_precision and p._value.dtype in (jnp.bfloat16, jnp.float16)
+
+    def _state_names(self):
+        if self._multi_precision:
+            return ["moment1", "moment2", "master"]
+        return ["moment1", "moment2"]
+
+    def _init_state(self, p):
+        dt = jnp.float32 if self._multi_precision else p._value.dtype
+        st = {
+            "moment1": jnp.zeros(p._value.shape, dt),
+            "moment2": jnp.zeros(p._value.shape, dt),
+        }
+        if self._multi_precision:
+            # fp32 master copy: updates accumulate in fp32 so sub-bf16-ulp
+            # steps aren't rounded away; the low-precision param is a cast view
+            st["master"] = p._value.astype(jnp.float32)
+        return st
+
+    def _update_one(self, p, g, state, lr, step, extras=None):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        gf = g.astype(state["moment1"].dtype)
+        m = b1 * state["moment1"] + (1 - b1) * gf
+        v = b2 * state["moment2"] + (1 - b2) * gf * gf
+        stepf = step.astype(jnp.float32)
+        mhat = m / (1 - b1**stepf)
+        vhat = v / (1 - b2**stepf)
+        upd = lr * mhat / (jnp.sqrt(vhat) + eps)
+        new_state = {"moment1": m, "moment2": v}
+        if self._multi_precision:
+            master = state["master"] - upd.astype(jnp.float32)
+            new_state["master"] = master
+            return master.astype(p.dtype), new_state
+        return p - upd.astype(p.dtype), new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (the transformer-pretraining default;
+    BASELINE config 2 pairs it with flash-attn)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._wd = float(weight_decay)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_weight_decay_to_grad(self):
+        return False
+
+    def _per_param_extras(self, p):
+        decay = self._wd
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            decay = 0.0
+        return {"decay": jnp.float32(decay)}
+
+    def _update_one(self, p, g, state, lr, step, extras=None):
+        new_p, new_state = super()._update_one(p, g, state, lr, step)
+        if self._multi_precision and "master" in new_state:
+            master = new_state["master"] - lr * extras["decay"] * state["master"]
+            new_state["master"] = master
+            return master.astype(p.dtype), new_state
+        new_p = new_p - (lr * extras["decay"]).astype(p.dtype) * p
+        return new_p, new_state
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._wd = lamb_weight_decay
+
+    def _state_names(self):
+        return ["moment1", "moment2"]
+
+    def _init_state(self, p):
+        return {
+            "moment1": jnp.zeros_like(p._value, jnp.float32),
+            "moment2": jnp.zeros_like(p._value, jnp.float32),
+        }
+
+    def _update_one(self, p, g, state, lr, step, extras=None):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        gf = g.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * gf
+        v = b2 * state["moment2"] + (1 - b2) * gf * gf
+        stepf = step.astype(jnp.float32)
+        mhat = m / (1 - b1**stepf)
+        vhat = v / (1 - b2**stepf)
+        r = mhat / (jnp.sqrt(vhat) + eps) + self._wd * p.astype(jnp.float32)
+        w_norm = jnp.sqrt(jnp.sum(p.astype(jnp.float32) ** 2))
+        r_norm = jnp.sqrt(jnp.sum(r**2))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p.astype(jnp.float32) - lr * trust * r).astype(p.dtype), {
+            "moment1": m, "moment2": v,
+        }

@@ -1,0 +1,314 @@
+"""Optimizer base + SGD family.
+
+Reference: ``python/paddle/optimizer/optimizer.py`` (SURVEY.md §2.1). The
+reference's perf trick is fused multi-tensor kernels (``fused_adamw``); the
+TPU-native equivalent here is one ``jax.jit``-compiled update over the whole
+parameter pytree with **donated** buffers — XLA fuses the elementwise update
+chain across all parameters and reuses the parameter memory in place.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..enforce import InvalidArgumentError
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp"]
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class Optimizer:
+    """Base optimizer over the eager tape's ``.grad`` accumulators."""
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                raise InvalidArgumentError("param groups not supported yet; pass a flat list")
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, float):
+            self._l2_coeff = weight_decay
+        elif isinstance(weight_decay, L2Decay):
+            self._l2_coeff = weight_decay.coeff
+        else:
+            self._l2_coeff = 0.0
+        self._accumulators: Dict[int, Dict[str, Any]] = {}
+        self._step_count = 0
+        self._jit_update = None  # cached jitted fused step
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate.get_lr())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise InvalidArgumentError("set_lr not allowed when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    # -- state ---------------------------------------------------------------
+    def _state_names(self) -> List[str]:
+        return []
+
+    def _init_state(self, p: Tensor) -> Dict[str, jax.Array]:
+        return {}
+
+    def _ensure_state(self, p: Tensor) -> Dict[str, Any]:
+        st = self._accumulators.get(id(p))
+        if st is None:
+            st = self._init_state(p)
+            self._accumulators[id(p)] = st
+        return st
+
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"LR_Scheduler": {}, "master_weights": {}}
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        params = self._params()
+        for i, p in enumerate(params):
+            st = self._accumulators.get(id(p))
+            if st is None:
+                continue
+            for k, v in st.items():
+                out[f"{p.name}.{k}"] = to_tensor(v) if not isinstance(v, Tensor) else v
+        out["@step"] = self._step_count
+        return out
+
+    def set_state_dict(self, state: Dict[str, Any]):
+        if isinstance(self._learning_rate, LRScheduler) and state.get("LR_Scheduler"):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+        self._step_count = int(state.get("@step", 0))
+        params = {p.name: p for p in self._params()}
+        # group state entries per stored param name, preserving order
+        grouped: Dict[str, Dict[str, Any]] = {}
+        for key, val in state.items():
+            if key in ("LR_Scheduler", "master_weights", "@step"):
+                continue
+            pname, _, sname = key.rpartition(".")
+            grouped.setdefault(pname, {})[sname] = (
+                val._value if isinstance(val, Tensor) else jnp.asarray(val)
+            )
+        matched = [n for n in grouped if n in params]
+        if grouped and not matched:
+            # Auto-generated tensor names are process-global, so a resumed
+            # process may have shifted names — fall back to positional
+            # mapping (state-dict insertion order vs parameter order).
+            ordered = list(self._params())
+            for (pname, st_vals), p in zip(grouped.items(), ordered):
+                st = self._ensure_state(p)
+                st.update(st_vals)
+            return
+        for pname in matched:
+            p = params[pname]
+            st = self._ensure_state(p)
+            st.update(grouped[pname])
+
+    # -- grads ---------------------------------------------------------------
+    def _params(self) -> List[Tensor]:
+        if self._parameter_list is None:
+            raise InvalidArgumentError(
+                "Optimizer was created without a parameters list"
+            )
+        return [p for p in self._parameter_list if not p.stop_gradient]
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._params():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- the fused step -------------------------------------------------------
+    def _update_one(self, p, g, state: Dict[str, Any], lr, step, extras=None):
+        """Pure per-parameter update: returns (new_p, new_state)."""
+        raise NotImplementedError
+
+    def _per_param_extras(self, p) -> Dict[str, Any]:
+        """Per-parameter traced scalars (e.g. AdamW's decay coefficient) —
+        passed through the jit as data so host-side per-param decisions don't
+        bake into the compiled program."""
+        return {}
+
+    def _apply_weight_decay_to_grad(self) -> bool:
+        """L2-style decay folded into the gradient (Adam/SGD semantics)."""
+        return True
+
+    def step(self):
+        params = self._params()
+        pgs = [(p, p.grad._value) for p in params if p.grad is not None]
+        if not pgs:
+            return
+        if self._grad_clip is not None:
+            pgs = self._grad_clip(pgs)
+        lr = self.get_lr()
+        self._step_count += 1
+        states = [self._ensure_state(p) for p, _ in pgs]
+        state_keys = self._state_names()
+
+        if self._jit_update is None:
+            update_one = self._update_one
+            l2 = self._l2_coeff
+            decay_in_grad = self._apply_weight_decay_to_grad()
+
+            @functools.partial(jax.jit, donate_argnums=(0, 2))
+            def fused(pvals, gvals, svals, evals, lr_, step_):
+                new_p, new_s = [], []
+                for p, g, s, e in zip(pvals, gvals, svals, evals):
+                    g = g.astype(p.dtype) if g.dtype != p.dtype else g
+                    if l2 and decay_in_grad:
+                        g = g + l2 * p
+                    np_, ns_ = update_one(p, g, s, lr_, step_, e)
+                    new_p.append(np_)
+                    new_s.append(ns_)
+                return new_p, new_s
+
+            self._jit_update = fused
+
+        pvals = [p._value for p, _ in pgs]
+        gvals = [g for _, g in pgs]
+        svals = [{k: s[k] for k in state_keys} for s in states]
+        evals = [self._per_param_extras(p) for p, _ in pgs]
+        new_p, new_s = self._jit_update(
+            pvals, gvals, svals, evals, jnp.float32(lr), jnp.int32(self._step_count)
+        )
+        for (p, _), np_, ns_ in zip(pgs, new_p, new_s):
+            p._inplace_set(np_)
+            self._accumulators[id(p)] = ns_
+
+    @jax.named_scope("optimizer_minimize")
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def _set_parameters(self, parameters):
+        self._parameter_list = list(parameters)
+        self._jit_update = None
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update_one(self, p, g, state, lr, step, extras=None):
+        return p - lr.astype(p.dtype) * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _state_names(self):
+        return ["velocity"]
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p._value)}
+
+    def _update_one(self, p, g, state, lr, step, extras=None):
+        mu = self._momentum
+        v = mu * state["velocity"] + g
+        if self._nesterov:
+            upd = g + mu * v
+        else:
+            upd = v
+        return p - lr.astype(p.dtype) * upd, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _state_names(self):
+        return ["moment"]
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p._value, self._init_val)}
+
+    def _update_one(self, p, g, state, lr, step, extras=None):
+        m = state["moment"] + g * g
+        return p - lr.astype(p.dtype) * g / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _state_names(self):
+        return ["avg_squared_grad", "avg_squared_update"]
+
+    def _init_state(self, p):
+        return {
+            "avg_squared_grad": jnp.zeros_like(p._value),
+            "avg_squared_update": jnp.zeros_like(p._value),
+        }
+
+    def _update_one(self, p, g, state, lr, step, extras=None):
+        rho, eps = self._rho, self._epsilon
+        ag = rho * state["avg_squared_grad"] + (1 - rho) * g * g
+        upd = g * jnp.sqrt(state["avg_squared_update"] + eps) / jnp.sqrt(ag + eps)
+        au = rho * state["avg_squared_update"] + (1 - rho) * upd * upd
+        return p - lr.astype(p.dtype) * upd, {
+            "avg_squared_grad": ag, "avg_squared_update": au,
+        }
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _state_names(self):
+        return ["mean_square", "mean_grad", "momentum"]
+
+    def _init_state(self, p):
+        return {
+            "mean_square": jnp.zeros_like(p._value),
+            "mean_grad": jnp.zeros_like(p._value),
+            "momentum": jnp.zeros_like(p._value),
+        }
+
+    def _update_one(self, p, g, state, lr, step, extras=None):
+        rho, eps, mu = self._rho, self._epsilon, self._momentum
+        ms = rho * state["mean_square"] + (1 - rho) * g * g
+        mg = state["mean_grad"]
+        if self._centered:
+            mg = rho * mg + (1 - rho) * g
+            denom = jnp.sqrt(ms - mg * mg + eps)
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = mu * state["momentum"] + lr.astype(p.dtype) * g / denom
+        return p - mom, {"mean_square": ms, "mean_grad": mg, "momentum": mom}

@@ -1,0 +1,173 @@
+"""``paddle.profiler`` over the XLA/xprof stack.
+
+Reference: ``python/paddle/profiler/`` + C++ host/CUPTI tracers
+(SURVEY.md §5.1). On TPU, libtpu/XLA already emit the device timeline
+(xplane); this module wraps ``jax.profiler`` with the reference's API shape:
+``Profiler(targets, scheduler)``, ``RecordEvent``, chrome-trace export
+(TensorBoard 'trace viewer' via the xplane dump directory).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import os
+import time
+from typing import Callable, Iterable, Optional, Tuple, Union
+
+import jax
+
+__all__ = ["ProfilerTarget", "ProfilerState", "Profiler", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed: int = 0, ready: int = 0, record: int = 1,
+                   repeat: int = 0, skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    cycle = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+class Profiler:
+    def __init__(self, targets: Optional[Iterable[ProfilerTarget]] = None,
+                 scheduler: Union[Callable, Tuple[int, int], None] = None,
+                 on_trace_ready: Optional[Callable] = None,
+                 timer_only: bool = False, record_shapes: bool = False,
+                 profile_memory: bool = False, with_flops: bool = False,
+                 log_dir: Optional[str] = None):
+        if isinstance(scheduler, tuple):
+            start, end = scheduler
+            scheduler = make_scheduler(closed=start, ready=0, record=end - start,
+                                       repeat=1)
+        self._scheduler = scheduler or (lambda step: ProfilerState.RECORD)
+        self._on_trace_ready = on_trace_ready
+        self._log_dir = log_dir or os.path.join(os.getcwd(), "profiler_log")
+        self._step = 0
+        self._running = False
+        self._timer_only = timer_only
+        self._step_times = []
+        self._last = None
+
+    def start(self):
+        self._state = self._scheduler(self._step)
+        if self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
+                and not self._timer_only:
+            jax.profiler.start_trace(self._log_dir)
+            self._running = True
+        self._last = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._running:
+            jax.profiler.stop_trace()
+            self._running = False
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._step_times.append(now - self._last)
+        self._last = now
+        self._step += 1
+        new_state = self._scheduler(self._step)
+        if self._timer_only:
+            return
+        if self._running and new_state == ProfilerState.CLOSED:
+            self.stop()
+        elif not self._running and new_state in (ProfilerState.RECORD,
+                                                 ProfilerState.RECORD_AND_RETURN):
+            jax.profiler.start_trace(self._log_dir)
+            self._running = True
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        n = len(self._step_times)
+        if not n:
+            print("No steps recorded.")
+            return
+        import numpy as np
+
+        ts = np.asarray(self._step_times) * 1000
+        print(f"steps: {n}  avg: {ts.mean():.3f}ms  p50: {np.percentile(ts, 50):.3f}ms "
+              f"p99: {np.percentile(ts, 99):.3f}ms  trace dir: {self._log_dir}")
+
+    def export_chrome_tracing(self, dir_name: Optional[str] = None,
+                              worker_name: Optional[str] = None):
+        """The xplane protos under log_dir are TensorBoard/Perfetto loadable —
+        that directory is the chrome-trace artifact."""
+        return self._log_dir
+
+    export = export_chrome_tracing
+
+
+class RecordEvent:
+    """Named range in the device/host timeline (reference RAII RecordEvent →
+    ``jax.profiler.TraceAnnotation``)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def begin(self):
+        self._ann.__enter__()
+
+    def end(self):
+        self._ann.__exit__(None, None, None)
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof: Profiler):
+        return dir_name
+
+    return handler
+
+
+def load_profiler_result(filename: str):
+    from ..enforce import raise_unimplemented
+
+    raise_unimplemented("load_profiler_result (open the trace dir in TensorBoard)")

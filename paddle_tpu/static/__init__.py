@@ -1,0 +1,39 @@
+"""``paddle.static`` — minimal static-graph surface.
+
+The reference's static graph engine (ProgramDesc + InterpreterCore,
+SURVEY.md §2.1) is replaced by XLA: ``paddle_tpu.jit.to_static`` compiles a
+whole traced function with ``jax.jit``. This module keeps the
+source-compatibility pieces that still make sense (``InputSpec``) and
+raises clearly for Program-construction APIs that do not.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ..core.dtype import convert_dtype
+from ..enforce import raise_unimplemented
+
+__all__ = ["InputSpec"]
+
+
+class InputSpec:
+    """Shape/dtype spec for jit tracing (reference:
+    ``python/paddle/static/input.py``). ``None`` dims mean dynamic in the
+    reference; XLA requires static shapes, so they become bucketing keys."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def __getattr__(name):
+    raise_unimplemented(
+        f"paddle.static.{name} (global static graph mode; use "
+        "paddle_tpu.jit.to_static — XLA is the graph engine)"
+    )

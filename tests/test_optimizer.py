@@ -1,0 +1,175 @@
+"""Optimizer / LR scheduler / grad clip tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _quadratic_problem():
+    paddle.seed(3)
+    target = np.random.RandomState(0).randn(8).astype("float32")
+    w = nn.Parameter(paddle.zeros([8])._value)
+
+    def loss_fn():
+        diff = w - paddle.to_tensor(target)
+        return paddle.sum(diff * diff)
+
+    return w, target, loss_fn
+
+
+@pytest.mark.parametrize("opt_cls,kwargs,steps,tol", [
+    (paddle.optimizer.SGD, dict(learning_rate=0.1), 200, 1e-3),
+    (paddle.optimizer.Momentum, dict(learning_rate=0.05, momentum=0.9), 200, 1e-3),
+    (paddle.optimizer.Adam, dict(learning_rate=0.1), 300, 1e-2),
+    (paddle.optimizer.AdamW, dict(learning_rate=0.1, weight_decay=0.0), 300, 1e-2),
+    (paddle.optimizer.RMSProp, dict(learning_rate=0.05), 300, 1e-2),
+])
+def test_convergence(opt_cls, kwargs, steps, tol):
+    w, target, loss_fn = _quadratic_problem()
+    opt = opt_cls(parameters=[w], **kwargs)
+    for _ in range(steps):
+        loss = loss_fn()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_allclose(w.numpy(), target, atol=tol * 10, rtol=tol * 10)
+    assert float(loss.item()) < tol
+
+
+def test_lamb_decreases_loss():
+    # Lamb's trust-ratio scaling is built for large-batch nets, not a tiny
+    # quadratic — assert strong decrease rather than convergence-to-target.
+    w, target, loss_fn = _quadratic_problem()
+    first = float(loss_fn().item())
+    opt = paddle.optimizer.Lamb(learning_rate=0.1, lamb_weight_decay=0.0,
+                                parameters=[w])
+    for _ in range(300):
+        loss = loss_fn()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss.item()) < first / 10
+
+
+def test_adam_matches_numpy_reference():
+    paddle.seed(0)
+    w0 = np.random.RandomState(1).randn(4).astype("float32")
+    g = np.random.RandomState(2).randn(4).astype("float32")
+    w = nn.Parameter(paddle.to_tensor(w0)._value)
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[w])
+    # single step with fixed grad
+    w.grad = paddle.to_tensor(g)
+    opt.step()
+    # numpy adam step 1
+    m = 0.1 * g
+    v = 0.001 * g * g
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    want = w0 - 0.01 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(w.numpy(), want, rtol=1e-5, atol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    w0 = np.ones(4, "float32")
+    w = nn.Parameter(paddle.to_tensor(w0)._value)
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[w])
+    w.grad = paddle.to_tensor(np.zeros(4, "float32"))
+    opt.step()
+    # zero grad => moments stay 0, update is pure decay: w - lr*wd*w
+    np.testing.assert_allclose(w.numpy(), w0 - 0.1 * 0.5 * w0, rtol=1e-6)
+
+
+def test_adamw_apply_decay_param_fun():
+    w1 = nn.Parameter(paddle.ones([2])._value)
+    w1.name = "w_decay"
+    w2 = nn.Parameter(paddle.ones([2])._value)
+    w2.name = "b_nodecay"
+    opt = paddle.optimizer.AdamW(
+        learning_rate=0.1, weight_decay=0.5, parameters=[w1, w2],
+        apply_decay_param_fun=lambda n: not n.startswith("b_"))
+    z = paddle.to_tensor(np.zeros(2, "float32"))
+    w1.grad = z
+    w2.grad = z.clone()
+    opt.step()
+    assert w1.numpy()[0] < 1.0
+    np.testing.assert_allclose(w2.numpy(), 1.0)
+
+
+def test_optimizer_state_dict_roundtrip():
+    w, _, loss_fn = _quadratic_problem()
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+    for _ in range(3):
+        loss_fn().backward()
+        opt.step()
+        opt.clear_grad()
+    sd = opt.state_dict()
+    w2, _, loss_fn2 = _quadratic_problem()
+    w2.name = w.name
+    opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w2])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 3
+    m = opt2._accumulators[id(w2)]["moment1"]
+    np.testing.assert_allclose(
+        np.asarray(m), np.asarray(opt._accumulators[id(w)]["moment1"]), rtol=1e-6)
+
+
+def test_grad_clip_global_norm():
+    from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+
+    w, _, loss_fn = _quadratic_problem()
+    opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=[w],
+                               grad_clip=ClipGradByGlobalNorm(0.1))
+    big = paddle.to_tensor(np.full(8, 100.0, "float32"))
+    w.grad = big
+    pgs = opt._grad_clip([(w, w.grad._value)])
+    clipped_norm = float(np.sqrt((np.asarray(pgs[0][1]) ** 2).sum()))
+    np.testing.assert_allclose(clipped_norm, 0.1, rtol=1e-3)
+
+
+def test_lr_schedulers():
+    from paddle_tpu.optimizer import lr
+
+    s = lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    vals = [s.last_lr]
+    for _ in range(4):
+        s.step()
+        vals.append(s.last_lr)
+    np.testing.assert_allclose(vals[:5], [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    c = lr.CosineAnnealingDecay(1.0, T_max=10)
+    c.step(10)
+    np.testing.assert_allclose(c.last_lr, 0.0, atol=1e-9)
+
+    w = lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+    w.step(5)
+    np.testing.assert_allclose(w.last_lr, 0.05)
+    w.step(20)
+    np.testing.assert_allclose(w.last_lr, 0.1)
+
+    n = lr.NoamDecay(d_model=64, warmup_steps=100, learning_rate=1.0)
+    n.step(50)
+    lr_50 = n.last_lr
+    n.step(100)
+    assert n.last_lr > lr_50  # still warming up at 50
+
+
+def test_scheduler_drives_optimizer():
+    from paddle_tpu.optimizer import lr
+
+    sched = lr.StepDecay(0.5, step_size=1, gamma=0.1)
+    w = nn.Parameter(paddle.ones([1])._value)
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[w])
+    assert opt.get_lr() == 0.5
+    sched.step()
+    assert abs(opt.get_lr() - 0.05) < 1e-9
+
+
+def test_clear_grad():
+    w, _, loss_fn = _quadratic_problem()
+    loss_fn().backward()
+    opt = paddle.optimizer.SGD(0.1, parameters=[w])
+    assert w.grad is not None
+    opt.clear_grad()
+    assert w.grad is None
