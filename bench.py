@@ -1,0 +1,94 @@
+"""Headline benchmark: transformer pretraining throughput on one TPU chip.
+
+Workload = BASELINE config 2 (ERNIE/BERT-base-budget pretraining with
+flash-attention + AdamW): a ~110M-parameter decoder
+(``paddle_tpu.models.llama.LlamaConfig.bert_base_equiv``), bf16 compute with
+fp32 master weights, full train step (fwd + bwd + global-norm clip + AdamW)
+as ONE jitted XLA program with donated buffers.
+
+Baseline: BASELINE.md gives no reference measurement (the reference repo
+publishes none); the north star is "match A100". Public ballpark for an A100
+on a 110M-param causal LM at ~50% MFU is ≈190k tokens/s (312 TF/s fp16 × 0.5
+÷ ~0.8 GFLOPs/token fwd+bwd). ``vs_baseline`` = measured tokens/s ÷ 190_000.
+
+Prints exactly one JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+A100_BALLPARK_TOKENS_PER_S = 190_000.0
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def run(batch: int, seq: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
+
+    cfg = llama.LlamaConfig.bert_base_equiv(max_seq_len=seq)
+    dev = jax.devices()
+    log(f"devices: {dev}")
+    mesh = create_hybrid_mesh(devices=dev[:1])  # single chip
+    params = llama.init_params(cfg)
+    opt_state = llama.init_opt_state(params)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    rng = np.random.RandomState(0)
+    tokens = jnp.array(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    step = llama.make_sharded_train_step(cfg, mesh, lr=1e-4)
+
+    # warmup / compile
+    params, opt_state, loss = step(params, opt_state, tokens, tokens)
+    jax.block_until_ready(loss)
+    params, opt_state, loss = step(params, opt_state, tokens, tokens)
+    jax.block_until_ready(loss)
+    log(f"warmup loss {float(loss):.4f}; params {n_params/1e6:.1f}M")
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, tokens, tokens)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    set_mesh(None)
+
+    tokens_per_s = iters * batch * seq / dt
+    flops_per_token = 6.0 * n_params  # fwd+bwd matmul FLOPs estimate
+    mfu = tokens_per_s * flops_per_token / 197e12  # v5e bf16 peak ≈197 TF/s
+    log(f"{tokens_per_s:,.0f} tokens/s, step {dt/iters*1e3:.1f} ms, "
+        f"MFU≈{mfu:.1%} (v5e)")
+    return tokens_per_s
+
+
+def main():
+    for batch in (32, 16, 8, 4):
+        try:
+            tokens_per_s = run(batch, 512)
+            break
+        except Exception as e:  # OOM etc. → retry smaller
+            log(f"batch {batch} failed: {type(e).__name__}: {e}")
+    else:
+        print(json.dumps({
+            "metric": "bert_base_equiv_pretrain_throughput", "value": 0.0,
+            "unit": "tokens/sec", "vs_baseline": 0.0, "error": "all batch sizes failed",
+        }))
+        return
+    print(json.dumps({
+        "metric": "bert_base_equiv_pretrain_throughput",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tokens_per_s / A100_BALLPARK_TOKENS_PER_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

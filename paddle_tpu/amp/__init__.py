@@ -97,8 +97,23 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
     single_opt = not isinstance(optimizers, (list, tuple))
     opt_list = [optimizers] if single_opt else list(optimizers)
     for o in opt_list:
-        if hasattr(o, "_multi_precision"):
+        if hasattr(o, "_multi_precision") and not o._multi_precision:
             o._multi_precision = True
+            # upgrade accumulators created before decoration: the state
+            # layout changes (adds 'master', moments become fp32), and the
+            # cached fused step was compiled for the old layout
+            o._jit_update = None
+            if getattr(o, "_parameter_list", None):
+                by_id = {id(p): p for p in o._parameter_list}
+                for pid, st in list(o._accumulators.items()):
+                    p = by_id.get(pid)
+                    if p is None:
+                        continue
+                    for k, v in list(st.items()):
+                        if hasattr(v, "astype"):
+                            st[k] = v.astype(jnp.float32)
+                    if "master" not in st:
+                        st["master"] = p._value.astype(jnp.float32)
     return (models if single_model else model_list,
             optimizers if single_opt else opt_list)
 

@@ -1,0 +1,12 @@
+"""paddle_tpu.models — flagship model families (functional SPMD cores).
+
+Reference counterpart: the PaddleNLP / PaddleClas ecosystem models named by
+BASELINE configs (ERNIE/BERT pretraining, LLaMA with sharding+TP; SURVEY.md
+§2.4). These are the pure-functional, mesh-sharded training cores; the
+eager/Layer-API model zoo lives in ``paddle_tpu.vision.models`` and the
+``paddle_tpu.nn`` transformer layers.
+"""
+
+from . import llama  # noqa: F401
+
+__all__ = ["llama"]

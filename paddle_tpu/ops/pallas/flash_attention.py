@@ -58,7 +58,11 @@ def _xla_attention(q, k, v, mask=None, is_causal=False, scale=None):
 # inside the kernel with a running (max, sum) online softmax.
 # ---------------------------------------------------------------------------
 
-def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float):
+def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
+                     causal_offset: int = 0):
+    """``causal_offset`` aligns the causal diagonal when sq != sk (KV-cache
+    decode): query row i sits at absolute position i + offset, matching the
+    XLA fallback's ``tril(..., k=sk-sq)`` convention."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -74,7 +78,7 @@ def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float):
             vb = v_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
             s = qb @ kb.T  # [block_q, block_k]
             if is_causal:
-                q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                q_pos = causal_offset + q_idx * block_q + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 0
                 )
                 k_pos = start * block_k + jax.lax.broadcasted_iota(
@@ -96,7 +100,8 @@ def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float):
         if is_causal:
             # only blocks up to the diagonal contribute
             last = jax.lax.div(
-                (q_idx + 1) * block_q + block_k - 1, jnp.int32(block_k)
+                causal_offset + (q_idx + 1) * block_q + block_k - 1,
+                jnp.int32(block_k),
             )
             n_iter = jnp.minimum(n_k, last)
         else:
@@ -130,7 +135,8 @@ def _pallas_flash_attention(q, k, v, is_causal=False, scale=None,
     kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
 
-    kernel = _make_pallas_fwd(block_q, block_k, is_causal, scale)
+    kernel = _make_pallas_fwd(block_q, block_k, is_causal, scale,
+                              causal_offset=sk - sq)
     out = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
@@ -143,13 +149,6 @@ def _pallas_flash_attention(q, k, v, is_causal=False, scale=None,
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
     )(qr, kr, vr)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-
-
-@functools.partial(jax.jit, static_argnames=("is_causal", "use_pallas"))
-def _dispatch(q, k, v, mask, is_causal, use_pallas):
-    if use_pallas and mask is None:
-        return _pallas_flash_attention(q, k, v, is_causal=is_causal)
-    return _xla_attention(q, k, v, mask=mask, is_causal=is_causal)
 
 
 def dot_product_attention(q, k, v, mask=None, is_causal=False):

@@ -101,28 +101,47 @@ class Lamb(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
         self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._multi_precision = multi_precision
 
     def _state_names(self):
+        if self._multi_precision:
+            return ["moment1", "moment2", "master"]
         return ["moment1", "moment2"]
 
     def _init_state(self, p):
-        return {
-            "moment1": jnp.zeros_like(p._value, jnp.float32),
-            "moment2": jnp.zeros_like(p._value, jnp.float32),
+        st = {
+            "moment1": jnp.zeros(p._value.shape, jnp.float32),
+            "moment2": jnp.zeros(p._value.shape, jnp.float32),
         }
+        if self._multi_precision:
+            st["master"] = p._value.astype(jnp.float32)
+        return st
+
+    def _per_param_extras(self, p):
+        # BERT-recipe: LayerNorm/bias params excluded from LAMB decay
+        decay = self._wd
+        if self._exclude_fn is not None and self._exclude_fn(p.name):
+            decay = 0.0
+        return {"decay": jnp.float32(decay)}
 
     def _update_one(self, p, g, state, lr, step, extras=None):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        wd = extras["decay"] if extras else jnp.float32(self._wd)
+        pf = (state["master"] if self._multi_precision
+              else p.astype(jnp.float32))
         gf = g.astype(jnp.float32)
         m = b1 * state["moment1"] + (1 - b1) * gf
         v = b2 * state["moment2"] + (1 - b2) * gf * gf
         stepf = step.astype(jnp.float32)
         mhat = m / (1 - b1**stepf)
         vhat = v / (1 - b2**stepf)
-        r = mhat / (jnp.sqrt(vhat) + eps) + self._wd * p.astype(jnp.float32)
-        w_norm = jnp.sqrt(jnp.sum(p.astype(jnp.float32) ** 2))
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * pf
+        w_norm = jnp.sqrt(jnp.sum(pf**2))
         r_norm = jnp.sqrt(jnp.sum(r**2))
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
-        return (p.astype(jnp.float32) - lr * trust * r).astype(p.dtype), {
-            "moment1": m, "moment2": v,
-        }
+        new_pf = pf - lr * trust * r
+        new_state = {"moment1": m, "moment2": v}
+        if self._multi_precision:
+            new_state["master"] = new_pf
+        return new_pf.astype(p.dtype), new_state

@@ -1,0 +1,30 @@
+"""paddle_tpu.parallel — the TPU-native SPMD substrate.
+
+This is the functional core that the ``paddle.distributed`` compatibility
+surface (fleet, meta_parallel, sharding) is built on. Reference counterpart:
+the C++ distributed core (``paddle/fluid/distributed/collective/``,
+``paddle/phi/core/distributed/auto_parallel/``; SURVEY.md §2.2) — but
+designed mesh-first: process groups are mesh axes, collectives are XLA HLO
+ops scheduled by the compiler over ICI, and parallelism strategies are
+sharding rules over one ``jax.sharding.Mesh``.
+"""
+
+from .mesh import (
+    HYBRID_AXES,
+    create_hybrid_mesh,
+    get_mesh,
+    mesh_axis_size,
+    named_sharding,
+    set_mesh,
+    with_sharding_constraint,
+)
+
+__all__ = [
+    "HYBRID_AXES",
+    "create_hybrid_mesh",
+    "get_mesh",
+    "set_mesh",
+    "mesh_axis_size",
+    "named_sharding",
+    "with_sharding_constraint",
+]

@@ -1,0 +1,97 @@
+"""Hybrid device mesh — the TPU-native ``HybridCommunicateGroup`` substrate.
+
+Reference counterpart: ``python/paddle/distributed/fleet/base/topology.py``
+(``CommunicateTopology`` / ``HybridCommunicateGroup``; SURVEY.md §2.2) which
+builds per-axis NCCL process groups over the N-D rank grid. On TPU the same
+topology is ONE ``jax.sharding.Mesh`` whose named axes are the parallelism
+axes; XLA lowers collectives onto ICI rings per axis, so there is nothing to
+"create" per group — an axis name *is* a process group.
+
+Axis order follows the reference's hybrid order [dp, pp, sharding, mp, sep]
+so rank math matches ``paddle.distributed.fleet``'s coordinate layout.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# the reference's hybrid-parallel axis order (outermost → innermost):
+# data, pipeline, zero-sharding, tensor(model), sequence(sep)
+HYBRID_AXES: Tuple[str, ...] = ("dp", "pp", "sharding", "mp", "sep")
+
+_GLOBAL_MESH: Optional[Mesh] = None
+
+
+def create_hybrid_mesh(
+    dp: int = 1,
+    pp: int = 1,
+    sharding: int = 1,
+    mp: int = 1,
+    sep: int = 1,
+    devices: Optional[Sequence] = None,
+    set_as_global: bool = True,
+) -> Mesh:
+    """Build the hybrid mesh over ``devices`` (default: all jax devices).
+
+    Degrees must multiply to the device count. Axis placement matters on real
+    hardware: the innermost axes (mp, sep) get the fastest ICI neighbours,
+    matching the reference's convention of putting tensor-parallel on NVLink.
+    """
+    if devices is None:
+        devices = jax.devices()
+    degrees = {"dp": dp, "pp": pp, "sharding": sharding, "mp": mp, "sep": sep}
+    total = int(np.prod(list(degrees.values())))
+    if total != len(devices):
+        raise ValueError(
+            f"hybrid degrees {degrees} multiply to {total} but "
+            f"{len(devices)} devices are available"
+        )
+    shape = tuple(degrees[a] for a in HYBRID_AXES)
+    arr = np.asarray(devices).reshape(shape)
+    mesh = Mesh(arr, HYBRID_AXES)
+    if set_as_global:
+        set_mesh(mesh)
+    return mesh
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _GLOBAL_MESH
+
+
+def mesh_axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or _GLOBAL_MESH
+    if mesh is None or axis not in mesh.axis_names:
+        return 1
+    return mesh.shape[axis]
+
+
+def named_sharding(spec: PartitionSpec, mesh: Optional[Mesh] = None
+                   ) -> Optional[NamedSharding]:
+    """NamedSharding on the (given or global) mesh; None when no mesh."""
+    mesh = mesh or _GLOBAL_MESH
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, spec)
+
+
+def with_sharding_constraint(x, spec: PartitionSpec, mesh: Optional[Mesh] = None):
+    """Sharding hint for XLA GSPMD; no-op without a mesh (single chip/tests).
+
+    This is the TPU-native analog of the reference's explicit collective ops
+    inside parallel layers (``c_identity`` / ``mp_allreduce_sum``): instead of
+    calling a collective, we constrain layouts and let GSPMD insert the
+    collective where layouts change.
+    """
+    mesh = mesh or _GLOBAL_MESH
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -134,3 +134,21 @@ def test_profiler_smoke(tmp_path):
     p.step()
     p.stop()
     p.summary()
+
+
+def test_amp_decorate_after_step():
+    """decorate() after the optimizer has already stepped must upgrade the
+    existing accumulators to the multi-precision layout (regression: KeyError
+    'master' on the post-decorate step)."""
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    out = net(paddle.randn([2, 4]))
+    out.sum().backward()
+    opt.step()
+    opt.clear_grad()
+    net, opt = paddle.amp.decorate(net, opt, level="O2")
+    out = net(paddle.randn([2, 4]).astype("bfloat16"))
+    out.sum().backward()
+    opt.step()  # must not raise
+    st = opt._accumulators[id(net.weight)]
+    assert "master" in st and st["moment1"].dtype.name == "float32"

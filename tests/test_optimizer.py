@@ -173,3 +173,39 @@ def test_clear_grad():
     assert w.grad is not None
     opt.clear_grad()
     assert w.grad is None
+
+
+def test_lamb_exclude_from_weight_decay():
+    """exclude_from_weight_decay_fn must actually zero the decay for matched
+    params (regression: the arg was silently discarded)."""
+    w = nn.Parameter(paddle.ones([4])._value, name="norm_w")
+    opt_ex = paddle.optimizer.Lamb(
+        learning_rate=0.1, lamb_weight_decay=0.5, parameters=[w],
+        exclude_from_weight_decay_fn=lambda n: "norm" in n)
+    opt_ex._ensure_state(w)
+    assert float(opt_ex._per_param_extras(w)["decay"]) == 0.0
+
+    w2 = nn.Parameter(paddle.ones([4])._value, name="dense_w")
+    assert float(opt_ex._per_param_extras(w2)["decay"]) == 0.5
+
+    # zero grad + decay excluded → param unchanged; included → decayed
+    for p, opt, moved in [
+        (w, opt_ex, False),
+    ]:
+        p.clear_grad()
+        loss = (p * 0.0).sum()
+        loss.backward()
+        opt.step()
+        changed = not np.allclose(p.numpy(), 1.0)
+        assert changed == moved, (p.name, p.numpy())
+
+
+def test_lamb_multi_precision():
+    w = nn.Parameter(paddle.ones([8]).astype("bfloat16")._value)
+    opt = paddle.optimizer.Lamb(learning_rate=0.01, parameters=[w],
+                                multi_precision=True)
+    loss = (w.astype("float32") ** 2).sum()
+    loss.backward()
+    opt.step()
+    st = opt._accumulators[id(w)]
+    assert "master" in st and st["master"].dtype.name == "float32"
