@@ -31,6 +31,8 @@ from .env import (
     is_initialized,
 )
 from .parallel import DataParallel
+from . import fleet, sharding
+from .sharding import group_sharded_parallel, save_group_sharded_model
 
 __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "get_default_group",

@@ -1,0 +1,33 @@
+"""``paddle.distributed.fleet`` surface (reference: ``python/paddle/
+distributed/fleet/``; SURVEY.md §2.2). The facade delegates to a singleton
+``Fleet`` exactly like the reference; hybrid parallelism is carried by the
+global ``jax.sharding.Mesh`` the facade builds."""
+
+from . import meta_optimizers, meta_parallel, utils
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+)
+from .fleet import (
+    Fleet,
+    barrier_worker,
+    distributed_model,
+    distributed_optimizer,
+    fleet,
+    init,
+    is_first_worker,
+    worker_index,
+    worker_num,
+)
+from .meta_parallel import get_rng_state_tracker
+from .recompute import recompute, recompute_sequential
+
+__all__ = [
+    "Fleet", "fleet", "init", "distributed_model", "distributed_optimizer",
+    "worker_index", "worker_num", "is_first_worker", "barrier_worker",
+    "DistributedStrategy", "CommunicateTopology", "HybridCommunicateGroup",
+    "get_hybrid_communicate_group", "get_rng_state_tracker", "recompute",
+    "recompute_sequential", "meta_parallel", "meta_optimizers",
+]

@@ -1,0 +1,254 @@
+"""Hybrid-parallel topology: rank math + per-axis groups over the device mesh.
+
+Reference counterpart: ``python/paddle/distributed/fleet/base/topology.py``
+(``CommunicateTopology`` / ``HybridCommunicateGroup``; SURVEY.md §2.2) which
+builds an N-D rank grid and one NCCL process group per axis slice. TPU-native
+mapping: the grid IS a ``jax.sharding.Mesh`` (built by
+``paddle_tpu.parallel.create_hybrid_mesh``); a "process group" for an axis is
+a :class:`paddle_tpu.distributed.Group` bound to that mesh axis name — XLA
+lowers any collective issued on it onto the ICI ring of that axis. The
+coordinate math is kept identical to the reference (axis order
+[dp, pp, sharding, mp, sep]) so rank layouts, checkpoint shard names and log
+messages line up with what a Fleet user expects.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ....parallel.mesh import HYBRID_AXES, create_hybrid_mesh, get_mesh
+from ...collective import Group, new_group
+from ...env import ParallelEnv
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup",
+           "get_hybrid_communicate_group", "set_hybrid_communicate_group"]
+
+# the active hybrid group (the reference's _HYBRID_PARALLEL_GROUP global)
+_HYBRID_PARALLEL_GROUP: Optional["HybridCommunicateGroup"] = None
+
+
+def get_hybrid_communicate_group() -> Optional["HybridCommunicateGroup"]:
+    return _HYBRID_PARALLEL_GROUP
+
+
+def set_hybrid_communicate_group(hcg: Optional["HybridCommunicateGroup"]) -> None:
+    global _HYBRID_PARALLEL_GROUP
+    _HYBRID_PARALLEL_GROUP = hcg
+
+# reference name ↔ mesh axis name
+_NAME_TO_AXIS = {
+    "data": "dp",
+    "pipe": "pp",
+    "sharding": "sharding",
+    "model": "mp",
+    "sep": "sep",
+}
+_AXIS_TO_NAME = {v: k for k, v in _NAME_TO_AXIS.items()}
+
+
+class CommunicateTopology:
+    """Pure N-D coordinate math over the hybrid rank grid."""
+
+    def __init__(
+        self,
+        hybrid_group_names: Sequence[str] = ("data", "pipe", "sharding", "model", "sep"),
+        dims: Sequence[int] = (1, 1, 1, 1, 1),
+    ):
+        assert len(hybrid_group_names) == len(dims)
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(int(d) for d in dims)
+        self.coordinate = list(itertools.product(*(range(d) for d in self._dims)))
+        self._coord2rank = {c: i for i, c in enumerate(self.coordinate)}
+        self._rank2coord = {i: c for c, i in self._coord2rank.items()}
+        self._world = int(np.prod(self._dims))
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank: int) -> Tuple[int, ...]:
+        return self._rank2coord[rank]
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        coord = dict(zip(self._parallel_names, self.get_coord(global_rank)))
+        coord.update(kwargs)
+        return self.get_rank(**coord)
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All global ranks whose coordinate on ``axis_name`` equals index."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(
+            rank for coord, rank in self._coord2rank.items() if coord[axis] == index
+        )
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """Rank groups that communicate along ``axis_name``: one list per
+        slice through the grid varying only that axis (the reference's
+        per-axis process-group enumeration)."""
+        axis = self._parallel_names.index(axis_name)
+        other = [n for n in self._parallel_names if n != axis_name]
+        other_dims = [self.get_dim(n) for n in other]
+        groups = []
+        for fixed in itertools.product(*(range(d) for d in other_dims)):
+            coord = dict(zip(other, fixed))
+            ranks = []
+            for i in range(self.get_dim(axis_name)):
+                coord[axis_name] = i
+                ranks.append(self.get_rank(**coord))
+            groups.append(ranks)
+        return groups
+
+
+class HybridCommunicateGroup:
+    """Per-axis communicators over the hybrid mesh.
+
+    Construction also (re)builds the global ``jax.sharding.Mesh`` when the
+    requested degrees differ from the current one, so Fleet users get the
+    mesh "for free" exactly like the reference gets NCCL groups for free
+    from ``fleet.init``.
+    """
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None,
+                 dp: int = 1, pp: int = 1, sharding: int = 1, mp: int = 1,
+                 sep: int = 1):
+        if topology is None:
+            topology = CommunicateTopology(
+                ("data", "pipe", "sharding", "model", "sep"),
+                (dp, pp, sharding, mp, sep),
+            )
+        self._topo = topology
+        self.global_rank = ParallelEnv().rank
+        self._dp = topology.get_dim("data")
+        self._pp = topology.get_dim("pipe")
+        self._sharding = topology.get_dim("sharding")
+        self._mp = topology.get_dim("model")
+        self._sep = topology.get_dim("sep")
+        self.nranks = topology.world_size()
+
+        mesh = get_mesh()
+        want = (self._dp, self._pp, self._sharding, self._mp, self._sep)
+        if mesh is None or tuple(mesh.shape[a] for a in HYBRID_AXES) != want:
+            import jax
+
+            if self.nranks > len(jax.devices()):
+                raise ValueError(
+                    f"hybrid degrees (dp={self._dp}, pp={self._pp}, "
+                    f"sharding={self._sharding}, mp={self._mp}, sep={self._sep}) "
+                    f"need {self.nranks} devices but only "
+                    f"{len(jax.devices())} are visible")
+            create_hybrid_mesh(dp=self._dp, pp=self._pp,
+                               sharding=self._sharding, mp=self._mp,
+                               sep=self._sep,
+                               devices=jax.devices()[: self.nranks])
+
+        coord = self._topo.get_coord(min(self.global_rank, self.nranks - 1))
+        self._coord = dict(zip(self._topo.get_hybrid_group_names(), coord))
+
+        self._groups: Dict[str, Group] = {}
+        for name, axis in _NAME_TO_AXIS.items():
+            if self._topo.get_dim(name) > 1:
+                # the slice through the grid containing this rank
+                comm_lists = self._topo.get_comm_list(name)
+                ranks = next((g for g in comm_lists if self.global_rank in g),
+                             comm_lists[0])
+            else:
+                ranks = [self.global_rank]
+            self._groups[name] = new_group(ranks=ranks, axis_name=axis)
+        set_hybrid_communicate_group(self)
+
+    @property
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_parallel_mode(self) -> str:
+        if self._mp == 1 and self._pp == 1 and self._sharding == 1 and self._dp > 1:
+            return "data"
+        if self._sharding > 1 and self._mp == 1 and self._pp == 1:
+            return "sharding"
+        if self._pp > 1:
+            return "pipeline"
+        if self._mp > 1:
+            return "model"
+        return "single"
+
+    # --- data parallel ---
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp
+
+    def get_data_parallel_rank(self) -> int:
+        return self._coord["data"]
+
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["data"]
+
+    def get_data_parallel_group_src_rank(self) -> int:
+        return self._groups["data"].ranks[0]
+
+    # --- model (tensor) parallel ---
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp
+
+    def get_model_parallel_rank(self) -> int:
+        return self._coord["model"]
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["model"]
+
+    def get_model_parallel_group_src_rank(self) -> int:
+        return self._groups["model"].ranks[0]
+
+    # --- pipeline parallel ---
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp
+
+    def get_stage_id(self) -> int:
+        return self._coord["pipe"]
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pipe"]
+
+    def is_first_stage(self) -> bool:
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self) -> bool:
+        return self.get_stage_id() == self._pp - 1
+
+    # --- sharding (ZeRO) ---
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._sharding
+
+    def get_sharding_parallel_rank(self) -> int:
+        return self._coord["sharding"]
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sharding_parallel_group_src_rank(self) -> int:
+        return self._groups["sharding"].ranks[0]
+
+    # --- sep (sequence/context) ---
+    def get_sep_parallel_world_size(self) -> int:
+        return self._sep
+
+    def get_sep_parallel_rank(self) -> int:
+        return self._coord["sep"]
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups["sep"]
+
+    def get_rank_from_stage(self, stage_id: int, **kwargs) -> int:
+        return self._topo.get_rank_from_stage(self.global_rank, pipe=stage_id, **kwargs)

@@ -1,0 +1,14 @@
+"""Meta-optimizers (reference: ``python/paddle/distributed/fleet/
+meta_optimizers/``; SURVEY.md §2.2). The static-graph program-rewriting
+meta-optimizers (AMPOptimizer, RecomputeOptimizer, ...) are realized in this
+framework as jit-level transforms (amp.auto_cast, fleet.recompute, sharding
+specs) — the dygraph wrappers below are the API-visible classes."""
+
+from .dygraph_optimizer import (
+    DygraphShardingOptimizer,
+    HybridParallelClipGrad,
+    HybridParallelOptimizer,
+)
+
+__all__ = ["HybridParallelOptimizer", "HybridParallelClipGrad",
+           "DygraphShardingOptimizer"]

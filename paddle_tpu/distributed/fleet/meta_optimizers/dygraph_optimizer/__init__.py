@@ -1,0 +1,8 @@
+from .dygraph_sharding_optimizer import DygraphShardingOptimizer
+from .hybrid_parallel_optimizer import (
+    HybridParallelClipGrad,
+    HybridParallelOptimizer,
+)
+
+__all__ = ["HybridParallelOptimizer", "HybridParallelClipGrad",
+           "DygraphShardingOptimizer"]

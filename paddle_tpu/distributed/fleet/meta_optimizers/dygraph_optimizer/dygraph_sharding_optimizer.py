@@ -1,0 +1,26 @@
+"""DygraphShardingOptimizer — ZeRO stage 1 (optimizer-state sharding).
+
+Reference counterpart: ``python/paddle/distributed/fleet/meta_optimizers/
+dygraph_optimizer/dygraph_sharding_optimizer.py`` (SURVEY.md §2.2): each
+sharding-group rank owns a subset of parameters' optimizer states, steps only
+those, then broadcasts updated params from their owner.
+
+TPU-native mapping: ownership → layout. Every accumulator is stored sharded
+over the ('dp','sharding') mesh axes; the fused update step is computed where
+the state lives (XLA partitions the elementwise update by the state's
+sharding), and the updated parameter's layout change back to its own spec is
+the reference's post-step broadcast. One class serves both the
+``fleet.distributed_optimizer`` path and direct construction.
+"""
+
+from __future__ import annotations
+
+from .hybrid_parallel_optimizer import HybridParallelOptimizer
+
+__all__ = ["DygraphShardingOptimizer"]
+
+
+class DygraphShardingOptimizer(HybridParallelOptimizer):
+    def __init__(self, optimizer, hcg=None, using_param_groups=False, **kw):
+        super().__init__(optimizer, hcg=hcg, strategy=None)
+        self._sharding_stage = max(self._sharding_stage, 1)

@@ -1,0 +1,20 @@
+"""meta_parallel (reference: ``python/paddle/distributed/fleet/
+meta_parallel/``; SURVEY.md §2.2): the hybrid-parallel building blocks."""
+
+from .parallel_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RNGStatesTracker,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+)
+from .pipeline_parallel import PipelineParallel
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy", "RNGStatesTracker",
+           "get_rng_state_tracker", "model_parallel_random_seed",
+           "LayerDesc", "SharedLayerDesc", "PipelineLayer",
+           "PipelineParallel"]

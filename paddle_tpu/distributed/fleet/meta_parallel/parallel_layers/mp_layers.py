@@ -1,0 +1,279 @@
+"""Megatron-style tensor(model)-parallel layers, TPU-native.
+
+Reference counterpart: ``python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/mp_layers.py`` (``VocabParallelEmbedding``,
+``ColumnParallelLinear``, ``RowParallelLinear``, ``ParallelCrossEntropy``;
+SURVEY.md §2.2 TP row), which hand-codes the collectives: ``c_identity``
+before column-parallel matmuls, ``mp_allreduce_sum`` after row-parallel ones,
+and the ``c_softmax_with_cross_entropy`` vocab-parallel loss kernel.
+
+TPU-native design — sharding rules, not collectives:
+
+* Each layer creates its parameter **sharded over the ``mp`` mesh axis**
+  (column-parallel: shard the output dim; row-parallel: shard the input
+  dim; vocab-parallel: shard the vocab dim) by placing the param with a
+  ``NamedSharding`` on the global hybrid mesh.
+* The forward is the plain dense computation plus **sharding constraints**
+  on activations. XLA GSPMD inserts exactly the collectives the reference
+  writes by hand — the all-reduce after a row-parallel matmul materializes
+  where the layout changes from partial-sum to replicated — and can fuse or
+  reschedule them, which hand-written collectives forbid.
+* The same modules work unsharded (no mesh / mp=1): every constraint is a
+  no-op, so tests and single-chip runs need no separate code path.
+
+This means numerics are *identical* to the dense layer by construction — the
+reference needs parity tests between TP and dense implementations; here the
+sharded layer IS the dense layer plus layout hints.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..... import nn
+from .....nn import functional as F
+from .....core.tensor import Tensor
+from .....nn.layer.layers import Layer, ParamAttr
+from .....ops.dispatch import run_op
+from .....parallel.mesh import (
+    get_mesh,
+    mesh_axis_size,
+    named_sharding,
+    with_sharding_constraint,
+)
+from ...base.topology import get_hybrid_communicate_group
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy"]
+
+
+def _mp_degree() -> int:
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.get_model_parallel_world_size()
+    return mesh_axis_size("mp")
+
+
+def _constrain(t, spec: P):
+    """Differentiable, Tensor-aware sharding constraint (tape-recorded op).
+
+    Eagerly this is a ``device_put`` reshard; under a trace it is GSPMD's
+    ``with_sharding_constraint``. Both have identity VJPs with the same
+    layout, so gradients flow with matching shardings.
+    """
+    sh = named_sharding(spec)
+    if sh is None:
+        return t
+
+    def f(v):
+        # device_put works both eagerly (resharding transfer) and under any
+        # trace (stages a sharding-change op, like with_sharding_constraint,
+        # but without committing the *input* to the mesh's device set — the
+        # eager tape's VJP traces see single-device concrete inputs).
+        return jax.device_put(v, sh)
+
+    if isinstance(t, Tensor):
+        return run_op("shard_constraint", f, t)
+    return f(t)
+
+
+def _on_mesh(t, spec: Optional[P] = None):
+    """Bring an input onto the mesh (replicated unless ``spec`` given) so
+    eager ops can mix it with mesh-sharded parameters — XLA requires one
+    consistent device set per computation. No-op for values already placed
+    on the mesh's device set or when no mesh is active."""
+    sh = named_sharding(spec if spec is not None
+                        else P(*([None] * (t.ndim if hasattr(t, "ndim") else 0))))
+    if sh is None:
+        return t
+    if isinstance(t, Tensor):
+        v = t._value
+        if isinstance(v, jax.core.Tracer) or (
+                hasattr(v, "sharding") and v.sharding == sh):
+            return t
+        return run_op("shard_constraint", lambda a: jax.device_put(a, sh), t) \
+            if not t.stop_gradient else Tensor(jax.device_put(v, sh),
+                                               stop_gradient=True)
+    return jax.device_put(t, sh)
+
+
+def _place_param(param, spec: P):
+    """Pin a parameter's storage to the mesh with the given PartitionSpec.
+
+    The reference allocates each rank's *slice*; under GSPMD the parameter
+    stays one logical array whose shards live distributed — ``state_dict``
+    and optimizers see the full array, which is why no mp-aware checkpoint
+    merging pass is needed on load (SURVEY.md §5.4's merge tooling becomes
+    orbax's native resharding).
+    """
+    sh = named_sharding(spec)
+    if sh is not None and param is not None:
+        param._inplace_set(jax.device_put(param._value, sh))
+    return param
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over the ``mp`` axis.
+
+    Reference behavior (mp_layers.py): each rank holds a vocab slice, masks
+    out-of-range ids, looks up, then all-reduces. GSPMD derives the same
+    gather-from-sharded-operand program from ``take`` on a row-sharded table.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 weight_attr=None, mp_group=None, name=None):
+        super().__init__(name)
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.world_size = _mp_degree()
+        if num_embeddings % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"vocab size {num_embeddings} must be divisible by mp degree {self.world_size}")
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        _place_param(self.weight, P("mp", None))
+
+    def forward(self, x):
+        x = _on_mesh(x)
+        out = F.embedding(x, self.weight)
+        return _constrain(out, P(*([None] * out.ndim)))
+
+    def extra_repr(self):
+        return f"{self.num_embeddings}, {self.embedding_dim}, mp={self.world_size}"
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with the output dim sharded over ``mp``.
+
+    ``gather_output=True`` re-replicates the output (the reference's
+    ``c_allgather``); ``False`` leaves it mp-sharded for a following
+    RowParallelLinear — expressed as the activation constraint
+    ``P(..., 'mp')`` that keeps GSPMD from inserting any collective at all.
+    """
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, gather_output: bool = True,
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None):
+        super().__init__(name)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.world_size = _mp_degree()
+        if out_features % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"out_features {out_features} must be divisible by mp degree {self.world_size}")
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        _place_param(self.weight, P(None, "mp"))
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=ParamAttr._to_attr(None), is_bias=True)
+            _place_param(self.bias, P("mp"))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        x = _on_mesh(x)
+        y = F.linear(x, self.weight, self.bias)
+        spec = [None] * y.ndim
+        if not self.gather_output:
+            spec[-1] = "mp"
+        return _constrain(y, P(*spec))
+
+    def extra_repr(self):
+        return (f"in={self.in_features}, out={self.out_features}, "
+                f"gather_output={self.gather_output}, mp={self.world_size}")
+
+
+class RowParallelLinear(Layer):
+    """Linear with the input dim sharded over ``mp``.
+
+    ``input_is_parallel=True`` asserts the input arrives mp-sharded on its
+    last dim (from a ColumnParallelLinear with ``gather_output=False``).
+    The matmul then produces partial sums per shard; the layout change to
+    replicated output is GSPMD's all-reduce — the reference's explicit
+    ``mp_allreduce_sum``.
+    """
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, input_is_parallel: bool = False,
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None):
+        super().__init__(name)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.world_size = _mp_degree()
+        if in_features % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"in_features {in_features} must be divisible by mp degree {self.world_size}")
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        _place_param(self.weight, P("mp", None))
+        if has_bias:
+            # bias is added after the (implicit) all-reduce → replicated
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=ParamAttr._to_attr(None), is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            spec = [None] * x.ndim
+            spec[-1] = "mp"
+            x = _on_mesh(x, P(*spec))
+        else:
+            x = _on_mesh(x)
+        y = F.linear(x, self.weight, self.bias)
+        return _constrain(y, P(*([None] * y.ndim)))
+
+    def extra_repr(self):
+        return (f"in={self.in_features}, out={self.out_features}, "
+                f"input_is_parallel={self.input_is_parallel}, mp={self.world_size}")
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over vocab-parallel logits.
+
+    Reference: ``c_softmax_with_cross_entropy`` — a fused kernel that
+    computes softmax statistics with an all-reduce over the mp group so no
+    rank materializes the full vocab. GSPMD derives the same program from
+    the ordinary logsumexp-based loss on logits constrained to
+    ``P(..., 'mp')``: the max/sum reductions over the sharded vocab axis
+    become mp-axis all-reduces.
+    """
+
+    def __init__(self, mp_group=None, name=None, ignore_index: int = -100):
+        super().__init__(name)
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        spec = [None] * input.ndim
+        spec[-1] = "mp"
+        logits = _constrain(input, P(*spec))
+
+        ignore = self.ignore_index
+        lb = label._value if isinstance(label, Tensor) else jnp.asarray(label)
+        if lb.ndim == input.ndim:
+            lb = jnp.squeeze(lb, -1)
+        if not isinstance(lb, jax.core.Tracer):
+            sh = named_sharding(P(*([None] * lb.ndim)))
+            if sh is not None:
+                lb = jax.device_put(lb, sh)
+
+        def f(lg):
+            lg32 = lg.astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(lg32, axis=-1)
+            lb_ = jnp.clip(lb, 0, lg.shape[-1] - 1)
+            picked = jnp.take_along_axis(lg32, lb_[..., None], axis=-1)[..., 0]
+            loss = lse - picked
+            loss = jnp.where(lb == ignore, 0.0, loss)
+            return loss[..., None]  # the reference keeps a trailing dim
+
+        return run_op("c_softmax_with_cross_entropy", f, logits)

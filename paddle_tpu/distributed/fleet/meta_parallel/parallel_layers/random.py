@@ -1,0 +1,101 @@
+"""RNG state tracker for tensor-parallel dropout determinism.
+
+Reference counterpart: ``get_rng_state_tracker`` in
+``python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py``
+(SURVEY.md §2.2 TP row): dropout inside TP regions must use a *different*
+stream per mp-rank (masks on sharded activations must differ) while dropout
+outside TP regions uses the *same* stream on every mp-rank (replicated
+activations need identical masks).
+
+TPU-native mapping: streams are independent JAX PRNG keys derived by
+``fold_in`` — there is no device generator state to save/restore, so "adding
+a state" is deriving a named key and tracking it. Under single-controller
+GSPMD the distinction still matters for ``shard_map`` regions and for
+multi-process execution, and model-parallel layers consult the tracker the
+same way the reference's do.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+
+from .....framework import random as frandom
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "LOCAL_SEED", "GLOBAL_SEED"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+LOCAL_SEED = "local_seed"
+GLOBAL_SEED = "global_seed"
+
+
+class RNGStatesTracker:
+    """Named independent PRNG streams with a context-manager switch."""
+
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name!r} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.key(int(seed))
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        """Run the body consuming the named stream instead of the global."""
+        if name not in self.states_:
+            # lazily derive from a process-stable hash (Python's str hash is
+            # salted per process — crc32 is not) so use without an explicit
+            # model_parallel_random_seed() call is deterministic across runs
+            # and identical in every process
+            import zlib
+
+            self.states_[name] = jax.random.fold_in(
+                jax.random.key(0), zlib.crc32(name.encode()) % (2 ** 31)
+            )
+        orig = frandom.get_rng_state()
+        frandom.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = frandom.get_rng_state()
+            frandom.set_rng_state(orig)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed: int = 1024):
+    """Seed the tracker the way the reference does: local (per-mp-rank)
+    stream = seed folded with the mp rank; global stream = seed itself."""
+    from ...base.topology import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    mp_rank = hcg.get_model_parallel_rank() if hcg is not None else 0
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    frandom.seed(seed)
+    tracker.add(GLOBAL_SEED, seed)
+    tracker.add(LOCAL_SEED, seed + 1 + mp_rank)
+    tracker.add(MODEL_PARALLEL_RNG, seed + 1024 + mp_rank)

@@ -1,0 +1,124 @@
+"""Pipeline-parallel execution: ``PipelineParallel.train_batch``.
+
+Reference counterpart: ``python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py`` (SURVEY.md §2.2 PP row, §3.4): a host-driven 1F1B
+scheduler — warmup forwards, steady-state one-forward-one-backward, cooldown
+backwards — with P2P activation send/recv between stage ranks and gradient
+merging across micro-batches.
+
+TPU-native redesign. The reference needs 1F1B because each rank owns only
+its stage and must interleave to bound activation memory. Under a
+single-controller mesh the same two goals — bounded activation liveness and
+cross-stage overlap — are met differently:
+
+* **Numerics**: 1F1B is *exactly* gradient accumulation over micro-batches
+  (the schedule changes execution order, not math). ``train_batch`` splits
+  the batch into ``accumulate_steps`` micro-batches and accumulates grads —
+  loss/grad parity with the reference holds step-for-step.
+* **Memory**: per-micro-batch backward releases activations just like 1F1B's
+  early backwards; recompute_interval adds activation checkpointing.
+* **Overlap**: when the model's stages are placed on the ``pp`` mesh axis
+  (PipelineLayer pins stage params to pp slices), XLA sees a chain of
+  stage-local computations joined by layout changes (collective-permute over
+  ICI) and pipelines micro-batches across stages inside one compiled step —
+  the compiler plays the role of the reference's hand-written scheduler.
+  The whole-graph ``lax.scan``-over-microbatches path used by
+  ``paddle_tpu.models.llama`` is the high-performance variant.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ....core.tensor import Tensor
+from ....nn.layer.layers import Layer
+from .pp_layers import PipelineLayer
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers: PipelineLayer, hcg, strategy):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        pcfg = getattr(strategy, "pipeline_configs", None)
+        self.micro_batch_size = getattr(pcfg, "micro_batch_size", 1)
+        self.accumulate_steps = getattr(pcfg, "accumulate_steps", 1)
+        self.total_loss = None
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def _split_micro(self, data, n: int) -> List[Any]:
+        """Split a global batch into n micro-batches. ``array_split``
+        tolerates a non-divisible final batch (the reference sizes by
+        micro_batch_size and hits the same remainder at epoch end)."""
+
+        def split_one(t):
+            if isinstance(t, Tensor):
+                import jax.numpy as jnp
+
+                return [Tensor(c) for c in jnp.array_split(t._value, n, axis=0)
+                        if c.shape[0] > 0]
+            return [t] * n
+
+        if isinstance(data, (tuple, list)):
+            cols = [split_one(t) for t in data]
+            k = min(len(c) for c in cols)
+            return [tuple(c[i] for c in cols) for i in range(k)]
+        return split_one(data)
+
+    def train_batch(self, data, optimizer=None, lr_scheduler=None, scaler=None):
+        """One global batch: micro-batch loop with grad accumulation, then a
+        single optimizer step — loss-equivalent to the reference's 1F1B."""
+        n = max(int(self.accumulate_steps), 1)
+        micros = self._split_micro(data, n)
+        total = None
+        for mb in micros:
+            x, y = (mb if isinstance(mb, tuple) else (mb, None))
+            out = self._layers(x)
+            if self._layers._loss_fn is not None and y is not None:
+                loss = self._layers._loss_fn(out, y)
+            else:
+                loss = out
+            loss = loss / n if n > 1 else loss
+            if scaler is not None:
+                scaler.scale(loss).backward()
+            else:
+                loss.backward()
+            total = loss.detach() if total is None else total + loss.detach()
+        self.total_loss = total
+        if optimizer is not None:
+            if scaler is not None:
+                scaler.step(optimizer)
+                scaler.update()
+            else:
+                optimizer.step()
+            optimizer.clear_grad()
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+        return total
+
+    def eval_batch(self, data, compute_loss: bool = True):
+        n = max(int(self.accumulate_steps), 1)
+        micros = self._split_micro(data, n)
+        total, outputs = None, []
+        for mb in micros:
+            x, y = (mb if isinstance(mb, tuple) else (mb, None))
+            out = self._layers(x)
+            if compute_loss and self._layers._loss_fn is not None and y is not None:
+                out = self._layers._loss_fn(out, y)
+                total = out.detach() if total is None else total + out.detach()
+            else:
+                outputs.append(out)
+        if total is not None:
+            return total
+        if len(outputs) == 1:
+            return outputs[0]
+        import paddle_tpu as _paddle
+
+        return _paddle.concat(outputs, axis=0)

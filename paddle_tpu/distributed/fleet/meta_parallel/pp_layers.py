@@ -1,0 +1,213 @@
+"""Pipeline-parallel model declaration: LayerDesc / SharedLayerDesc /
+PipelineLayer.
+
+Reference counterpart: ``python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/pp_layers.py`` (SURVEY.md §2.2 PP row): the model is declared
+as a flat list of ``LayerDesc``s; ``PipelineLayer`` segments them across pp
+stages (uniform by count or weighted by a seg method), instantiates only the
+local stage's layers, and registers ``SharedLayerDesc`` params (tied
+embeddings) with cross-stage grad sync.
+
+TPU-native differences:
+
+* **Single-controller**: every stage's layers are instantiated in this
+  process (there is no "remote rank owning other layers"). HBM is bounded
+  by **partitioning every stage parameter over the ``pp`` mesh axis** (its
+  first pp-divisible dim), so per-device memory matches the reference's
+  per-rank stage partitioning. This is layout-parallelism rather than
+  stage *locality*: the locality-true, scan-over-stages compiled pipeline
+  lives in ``paddle_tpu.models.llama`` (stacked layer axis sharded over
+  ``pp``) — the path benchmarked for PP performance.
+* **Tied layers need no grad allreduce**: a ``SharedLayerDesc`` resolves to
+  literally the same Layer object in both stages; the tape accumulates both
+  contributions into one ``.grad`` — the reference's explicit tied-embedding
+  allreduce falls out of autograd.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ....nn.layer.layers import Layer
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    """Deferred layer constructor (build only when the stage needs it)."""
+
+    def __init__(self, layer_func: Callable, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not (isinstance(layer_func, type) and issubclass(layer_func, Layer)) \
+                and not callable(layer_func):
+            raise TypeError("LayerDesc expects a Layer subclass or callable")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({getattr(self.layer_func, '__name__', self.layer_func)})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A layer whose parameters are shared across stages (tied embeddings).
+
+    ``forward_func`` lets the second occurrence reuse the weights differently
+    (e.g. embedding matmul as the LM head).
+    """
+
+    def __init__(self, key: str, layer_func: Callable, forward_func=None,
+                 shared_weight_attr: str = "weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class _SharedCall(Layer):
+    """Wrapper running a shared layer through its alternate forward_func."""
+
+    def __init__(self, shared: Layer, forward_func, weight_attr: str):
+        super().__init__()
+        # register as sublayer so .parameters() still finds the weights once
+        self.shared = shared
+        self._forward_func = forward_func
+        self._weight_attr = weight_attr
+
+    def forward(self, x):
+        if self._forward_func is None:
+            return self.shared(x)
+        return self._forward_func(self.shared, x)
+
+
+class PipelineLayer(Layer):
+    """Segments a LayerDesc list into pipeline stages.
+
+    Segmentation follows the reference: ``seg_method='uniform'`` balances by
+    layer count; ``'layer:<Name>'`` balances by occurrences of the named
+    layer class (the transformer-block-aware split).
+    """
+
+    def __init__(self, layers: Sequence[Any], num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method: str = "uniform",
+                 recompute_interval: int = 0, num_virtual_pipeline_stages: int = 1,
+                 **kwargs):
+        super().__init__()
+        from ..base.topology import get_hybrid_communicate_group
+
+        self._topo = topology or get_hybrid_communicate_group()
+        if num_stages is None:
+            num_stages = (self._topo.get_pipe_parallel_world_size()
+                          if self._topo is not None else 1)
+        self._num_stages = int(num_stages)
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._virtual_pp_degree = num_virtual_pipeline_stages
+        self._descs = list(layers)
+        self.segment_parts = self._segment(seg_method)
+
+        # build all stages (single-controller), sharing SharedLayerDesc by key
+        self._shared: Dict[str, Layer] = {}
+        self.run_functions: List[Any] = []
+        built: List[Layer] = []
+        for i, d in enumerate(self._descs):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    self._shared[d.layer_name] = d.build_layer()
+                    layer = self._shared[d.layer_name]
+                else:
+                    layer = _SharedCall(self._shared[d.layer_name],
+                                        d.forward_func, d.shared_weight_attr)
+                built.append(layer)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(d)
+            else:
+                raise TypeError(f"unsupported pipeline entry: {d!r}")
+        for i, l in enumerate(built):
+            if isinstance(l, Layer):
+                self.add_sublayer(str(i), l)
+        self.run_functions = built
+        self._partition_params_over_pp()
+
+    def _partition_params_over_pp(self):
+        """Bound per-device HBM: shard each parameter over the ``pp`` axis
+        on its first pp-divisible dim (replicated when nothing divides)."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from ....parallel.mesh import get_mesh, named_sharding
+
+        mesh = get_mesh()
+        if mesh is None or "pp" not in mesh.axis_names or \
+                mesh.shape["pp"] <= 1:
+            return
+        pp = mesh.shape["pp"]
+        for p in self.parameters():
+            v = p._value
+            for i, d in enumerate(v.shape):
+                if d % pp == 0 and d > 0:
+                    spec = [None] * v.ndim
+                    spec[i] = "pp"
+                    p._inplace_set(
+                        jax.device_put(v, named_sharding(P(*spec))))
+                    break
+
+    # --- segmentation ---
+    def _segment(self, seg_method: str) -> List[int]:
+        n, s = len(self._descs), self._num_stages * self._virtual_pp_degree
+        if seg_method.startswith("layer:"):
+            name = seg_method.split(":", 1)[1]
+            weights = []
+            for d in self._descs:
+                fn = d.layer_func if isinstance(d, LayerDesc) else type(d)
+                weights.append(1 if getattr(fn, "__name__", "") == name else 0)
+            total = sum(weights)
+            if total == 0:
+                weights = [1] * n
+                total = n
+            # contiguous split with balanced cumulative weight
+            bounds = [0]
+            target, acc, need = total / s, 0, 1
+            for i, w in enumerate(weights):
+                acc += w
+                while need < s and acc >= need * target - 1e-9:
+                    bounds.append(i + 1)
+                    need += 1
+            while len(bounds) < s + 1:
+                bounds.append(n)
+            bounds[-1] = n
+            return bounds
+        # uniform by count
+        per = math.ceil(n / s)
+        bounds = [min(i * per, n) for i in range(s)] + [n]
+        return bounds
+
+    def get_stage_from_index(self, idx: int) -> int:
+        for stage in range(len(self.segment_parts) - 1):
+            if self.segment_parts[stage] <= idx < self.segment_parts[stage + 1]:
+                return stage % self._num_stages
+        return self._num_stages - 1
+
+    def stage_layers(self, stage: int) -> List[Any]:
+        lo, hi = self.segment_parts[stage], self.segment_parts[stage + 1]
+        return self.run_functions[lo:hi]
+
+    @property
+    def num_stages(self) -> int:
+        return self._num_stages
+
+    def forward(self, x):
+        """Full-model forward (all stages in order) — correct on any mesh;
+        parameters stay pp-partitioned (see _partition_params_over_pp)."""
+        for stage in range(len(self.segment_parts) - 1):
+            for fn in self.stage_layers(stage):
+                x = fn(x) if not isinstance(x, tuple) else fn(*x)
+        return x

@@ -1,0 +1,121 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference counterpart: ``python/paddle/distributed/fleet/recompute/
+recompute.py`` (SURVEY.md §2.2): a PyLayer that stores inputs + RNG state in
+forward, and in backward restores the RNG state, replays the forward under
+grad mode, and backprops through the replay. ``recompute_sequential`` chunks
+a Sequential; used by PP and sharding to bound activation memory.
+
+TPU-native notes: on the whole-graph jit path the same feature is
+``jax.checkpoint`` (used by ``paddle_tpu.models.llama`` per layer); this
+module provides the eager/Layer-API equivalent with identical semantics,
+including the RNG capture the reference implements with its
+``get_rng_state_tracker`` save/restore.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from ....autograd import PyLayer
+from ....core.tensor import Tensor
+from ....framework import random as frandom
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def _detached(args):
+    out = []
+    for a in args:
+        if isinstance(a, Tensor):
+            d = a.detach()
+            d.stop_gradient = a.stop_gradient
+            out.append(d)
+        else:
+            out.append(a)
+    return out
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function(*args)`` without storing intermediate activations;
+    recompute them during backward.
+
+    ``use_reentrant`` and ``preserve_rng_state`` follow the reference's
+    defaults (True)."""
+    preserve_rng = kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", None)
+    if kwargs:
+        raise ValueError(f"unsupported kwargs to recompute: {sorted(kwargs)}")
+
+    class _Recompute(PyLayer):
+        @staticmethod
+        def forward(ctx, *inner_args):
+            ctx.fwd_args = inner_args
+            if preserve_rng:
+                ctx.rng_state = frandom.get_rng_state()
+            out = function(*inner_args)
+            ctx.single = not isinstance(out, (tuple, list))
+            return out
+
+        @staticmethod
+        def backward(ctx, *grads):
+            from ....autograd import backward as autograd_backward
+            from ....autograd import enable_grad
+
+            if preserve_rng:
+                saved = frandom.get_rng_state()
+                frandom.set_rng_state(ctx.rng_state)
+            try:
+                # replay forward WITH grad tracking on detached inputs; the
+                # backward accumulates into ALL leaves — including the
+                # parameters ``function`` closes over — exactly like the
+                # reference's in-backward paddle.autograd.backward call.
+                replay_in = _detached(ctx.fwd_args)
+                with enable_grad():  # PyLayer.backward runs under no_grad
+                    out = function(*replay_in)
+                outs = (out,) if not isinstance(out, (tuple, list)) else tuple(out)
+                diff_outs = [o for o in outs if isinstance(o, Tensor)
+                             and not o.stop_gradient]
+                autograd_backward(diff_outs, [g for o, g in zip(outs, grads)
+                                              if isinstance(o, Tensor)
+                                              and not o.stop_gradient])
+            finally:
+                if preserve_rng:
+                    frandom.set_rng_state(saved)
+            result = [t.grad if t.grad is not None else None
+                      for t in replay_in
+                      if isinstance(t, Tensor) and not t.stop_gradient]
+            return tuple(result) if len(result) != 1 else result[0]
+
+    return _Recompute.apply(*args)
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """Chunked recompute over a Sequential (reference:
+    ``recompute_sequential``): split ``functions`` into ``segments`` chunks,
+    each recomputed as a unit."""
+    segments = int(ctx.get("segments", 1)) if isinstance(ctx, dict) else int(ctx)
+    if hasattr(functions, "sublayers") and not isinstance(functions, (list, tuple)):
+        layers = list(functions.children()) or [functions]
+    else:
+        layers = list(functions)
+    n = len(layers)
+    per = max(n // max(segments, 1), 1)
+
+    def run_chunk(chunk):
+        def f(*xs):
+            x = xs[0] if len(xs) == 1 else xs
+            for l in chunk:
+                x = l(x)
+            return x
+
+        return f
+
+    x = args
+    i = 0
+    while i < n:
+        chunk = layers[i: i + per]
+        x = recompute(run_chunk(chunk), *(x if isinstance(x, tuple) else (x,)),
+                      **kwargs)
+        i += per
+    return x

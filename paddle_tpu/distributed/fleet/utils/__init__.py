@@ -1,0 +1,18 @@
+from . import hybrid_parallel_util, sequence_parallel_utils
+from .hybrid_parallel_util import fused_allreduce_gradients
+from .sequence_parallel_utils import (
+    AllGatherOp,
+    ColumnSequenceParallelLinear,
+    GatherOp,
+    ReduceScatterOp,
+    RowSequenceParallelLinear,
+    ScatterOp,
+    mark_as_sequence_parallel_parameter,
+    register_sequence_parallel_allreduce_hooks,
+)
+
+__all__ = ["fused_allreduce_gradients", "ScatterOp", "GatherOp",
+           "AllGatherOp", "ReduceScatterOp",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear"]

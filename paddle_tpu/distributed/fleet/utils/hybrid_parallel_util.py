@@ -1,0 +1,62 @@
+"""Hybrid-parallel gradient-sync helpers.
+
+Reference counterpart: ``python/paddle/distributed/fleet/utils/
+hybrid_parallel_util.py`` (SURVEY.md §2.2 "Fused comm utils"):
+``fused_allreduce_gradients`` fuses parameter grads into flat buffers and
+all-reduces them over the data-parallel group — the manual grad-sync call
+used by models that disable the DataParallel reducer.
+
+TPU-native: gradients of globally-sharded computations are already global
+sums over dp (XLA inserts the reductions inside backward), so the fused
+all-reduce is an identity; what remains useful — and is implemented — is the
+layout half: re-placing grads onto the mesh so subsequent sharded optimizer
+programs keep one device set.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ....parallel.mesh import get_mesh, named_sharding
+
+__all__ = ["fused_allreduce_gradients", "sharding_reduce_gradients",
+           "broadcast_input_data", "broadcast_mp_parameters",
+           "broadcast_dp_parameters", "broadcast_sharding_parameters"]
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """Ensure grads live on the hybrid mesh (the reductions themselves are
+    already inside XLA's backward)."""
+    mesh = get_mesh()
+    if mesh is None:
+        return
+    for p in parameter_list:
+        g = getattr(p, "grad", None)
+        if g is None:
+            continue
+        v = g._value
+        if hasattr(v, "sharding") and len(v.sharding.device_set) == mesh.size:
+            continue
+        g._inplace_set(jax.device_put(v, named_sharding(P(*([None] * v.ndim)))))
+
+
+def sharding_reduce_gradients(parameter_list, hcg=None):
+    fused_allreduce_gradients(parameter_list, hcg)
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    """Single-controller: every "rank" sees the same input batch already."""
+    return inputs if not kwargs else (inputs, kwargs)
+
+
+def broadcast_mp_parameters(model, hcg=None):
+    """No-op under GSPMD: one logical parameter, not per-rank copies."""
+
+
+def broadcast_dp_parameters(model, hcg=None):
+    """No-op under GSPMD (see broadcast_mp_parameters)."""
+
+
+def broadcast_sharding_parameters(model, hcg=None):
+    """No-op under GSPMD (see broadcast_mp_parameters)."""

@@ -1,0 +1,143 @@
+"""Sequence parallelism (Megatron-SP) utilities.
+
+Reference counterpart: ``python/paddle/distributed/fleet/utils/
+sequence_parallel_utils.py`` (SURVEY.md §2.2 SP row, §5.7): autograd
+functions ``ScatterOp``/``GatherOp``/``AllGatherOp``/``ReduceScatterOp``
+that move activations between seq-sharded (outside TP matmuls) and
+full-seq (inside them) layouts, plus ``mark_as_sequence_parallel_parameter``
+and ``register_sequence_parallel_allreduce_hooks`` to sync LayerNorm/bias
+params across the TP group.
+
+TPU-native mapping: the four ops are **layout changes on the seq dim** over
+the ``mp`` axis, expressed as sharding constraints — the VJP pairs
+(scatter↔gather, all_gather↔reduce_scatter) fall out of GSPMD's transpose
+rules instead of hand-written backward classes. LN-param sync is unnecessary
+(params are single logical arrays; their grads already sum globally), so the
+mark/hook APIs are no-op markers kept for source compatibility, and
+documented as such.
+
+``ColumnSequenceParallelLinear``/``RowSequenceParallelLinear`` compose the
+same matmuls as the mp_layers versions but with seq-sharded input/output —
+the layouts the reference achieves with explicit allgather/reduce-scatter.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ....ops.dispatch import run_op
+from ....parallel.mesh import mesh_axis_size, named_sharding
+from ..meta_parallel.parallel_layers.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    _constrain,
+    _on_mesh,
+)
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "mark_as_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+]
+
+# activations are [B, S, H] by convention (seq dim = 1), matching the
+# reference's scatter/gather axis
+_SEQ_AXIS = 1
+
+
+def _seq_spec(ndim: int, axis_name: str = "mp") -> P:
+    spec = [None] * ndim
+    spec[_SEQ_AXIS] = axis_name
+    return P(*spec)
+
+
+def _full_spec(ndim: int) -> P:
+    return P(*([None] * ndim))
+
+
+class _SpecOp:
+    """Callable matching the reference's autograd-function interface:
+    ``out = ScatterOp.apply(x)``."""
+
+    forward_spec = None  # fn(ndim) -> P
+
+    @classmethod
+    def apply(cls, x, axis_name: str = "mp"):
+        return _constrain(x, cls.spec(x.ndim, axis_name))
+
+    def __new__(cls, x, *a, **k):  # allow ScatterOp(x) call style too
+        return cls.apply(x, *a, **k)
+
+
+class ScatterOp(_SpecOp):
+    """Full seq → seq-sharded (forward of the reference's ScatterOp; its
+    backward, gather, is the GSPMD transpose)."""
+
+    @staticmethod
+    def spec(ndim, axis_name="mp"):
+        return _seq_spec(ndim, axis_name)
+
+
+class GatherOp(_SpecOp):
+    """Seq-sharded → full seq."""
+
+    @staticmethod
+    def spec(ndim, axis_name="mp"):
+        return _full_spec(ndim)
+
+
+class AllGatherOp(GatherOp):
+    """Alias semantics: all-gather seq shards before a TP matmul; backward
+    is reduce-scatter (GSPMD transpose)."""
+
+
+class ReduceScatterOp(ScatterOp):
+    """Partial-sum full-seq → summed seq-sharded; backward all-gather."""
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """No-op marker under GSPMD (grads of shared LN params are already
+    global sums); kept so reference model code runs unchanged."""
+    parameter.sequence_parallel = True
+    return parameter
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               use_main_grad=False):
+    """No-op under GSPMD — see mark_as_sequence_parallel_parameter."""
+    return model
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Column-parallel matmul taking seq-sharded input: the implicit
+    all-gather on seq happens where the layout changes (the reference's
+    explicit AllGatherOp before the matmul)."""
+
+    def forward(self, x):
+        x = _on_mesh(x, _seq_spec(x.ndim))
+        x = _constrain(x, _full_spec(x.ndim))  # gather seq for the matmul
+        y = super().forward(x)
+        return y
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row-parallel matmul emitting seq-sharded output: the post-matmul
+    collective becomes a reduce-scatter instead of an all-reduce (the
+    layout-aware optimization SP exists for)."""
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            spec = [None] * x.ndim
+            spec[-1] = "mp"
+            x = _on_mesh(x, P(*spec))
+        else:
+            x = _on_mesh(x)
+        from ....nn import functional as F
+
+        y = F.linear(x, self.weight, self.bias)
+        return _constrain(y, _seq_spec(y.ndim))

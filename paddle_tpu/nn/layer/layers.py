@@ -25,7 +25,8 @@ __all__ = ["Layer", "Parameter", "ParamAttr"]
 class Parameter(Tensor):
     """Trainable tensor (``paddle.base.framework.EagerParamBase`` analog)."""
 
-    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip", "is_distributed")
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip",
+                 "is_distributed", "sequence_parallel", "split_axis")
 
     def __init__(self, value, name=None, trainable=True, need_clip=True):
         super().__init__(value, stop_gradient=not trainable, name=name, persistable=True)

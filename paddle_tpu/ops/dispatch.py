@@ -45,6 +45,46 @@ def _check_nan_inf(name: str, arrays: Sequence[Any]) -> None:
             )
 
 
+def _harmonize_device_sets(arrays):
+    """One consistent device set per eager computation (XLA requirement).
+
+    Under hybrid parallel some operands live sharded/replicated across the
+    global mesh (TP params, ZeRO states) while fresh host data is committed
+    to one device. The reference never faces this — each rank's tensors all
+    live on its own GPU — but a single-controller mesh program must lift the
+    single-device operands onto the mesh (replicated) before mixing. No-op
+    without a mesh or when all device sets already agree.
+    """
+    from ..parallel.mesh import get_mesh, named_sharding
+    from jax.sharding import PartitionSpec
+
+    mesh = get_mesh()
+    if mesh is None:
+        return arrays
+    n_mesh = mesh.size
+    if n_mesh == 1:
+        return arrays
+    on_mesh = False
+    off_mesh = False
+    for a in arrays:
+        if _is_tracer(a) or not hasattr(a, "sharding"):
+            continue
+        if len(a.sharding.device_set) == n_mesh:
+            on_mesh = True
+        else:
+            off_mesh = True
+    if not (on_mesh and off_mesh):
+        return arrays
+    out = []
+    for a in arrays:
+        if not _is_tracer(a) and hasattr(a, "sharding") and \
+                len(a.sharding.device_set) != n_mesh:
+            a = jax.device_put(
+                a, named_sharding(PartitionSpec(*([None] * a.ndim))))
+        out.append(a)
+    return out
+
+
 def run_op(
     name: str,
     pure_fn: Callable,
@@ -59,6 +99,7 @@ def run_op(
     outputs are differentiable (the rest are aux ints, e.g. argmax indices).
     """
     arrays = [t._value for t in tensors]
+    arrays = _harmonize_device_sets(arrays)
 
     # AMP autocast hook (the reference's C++ dispatch-level autocast): cast
     # inputs according to the active white/black lists before execution.
