@@ -40,7 +40,8 @@ __all__ = [
     "reduce_scatter", "broadcast", "scatter", "alltoall", "all_to_all",
     "send", "recv", "isend", "irecv", "barrier", "ParallelEnv", "get_rank",
     "get_world_size", "init_parallel_env", "is_initialized", "DataParallel",
-    "spawn", "launch",
+    "spawn", "launch", "fleet", "sharding", "group_sharded_parallel",
+    "save_group_sharded_model",
 ]
 
 

@@ -223,6 +223,11 @@ class RowParallelLinear(Layer):
         else:
             self.bias = None
 
+    def _out_spec(self, ndim: int) -> P:
+        """Output layout; overridden by RowSequenceParallelLinear (seq-
+        sharded output → reduce-scatter instead of all-reduce)."""
+        return P(*([None] * ndim))
+
     def forward(self, x):
         if self.input_is_parallel:
             spec = [None] * x.ndim
@@ -231,7 +236,7 @@ class RowParallelLinear(Layer):
         else:
             x = _on_mesh(x)
         y = F.linear(x, self.weight, self.bias)
-        return _constrain(y, P(*([None] * y.ndim)))
+        return _constrain(y, self._out_spec(y.ndim))
 
     def extra_repr(self):
         return (f"in={self.in_features}, out={self.out_features}, "

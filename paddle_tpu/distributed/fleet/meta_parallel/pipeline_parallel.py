@@ -72,20 +72,33 @@ class PipelineParallel(Layer):
             return [tuple(c[i] for c in cols) for i in range(k)]
         return split_one(data)
 
+    def _num_micro(self, data) -> int:
+        n = max(int(self.accumulate_steps), 1)
+        if n == 1 and self.micro_batch_size and self.micro_batch_size > 0:
+            first = data[0] if isinstance(data, (tuple, list)) else data
+            if isinstance(first, Tensor):
+                n = max(first.shape[0] // int(self.micro_batch_size), 1)
+        return n
+
     def train_batch(self, data, optimizer=None, lr_scheduler=None, scaler=None):
         """One global batch: micro-batch loop with grad accumulation, then a
         single optimizer step — loss-equivalent to the reference's 1F1B."""
-        n = max(int(self.accumulate_steps), 1)
-        micros = self._split_micro(data, n)
+        micros = self._split_micro(data, self._num_micro(data))
+        # weight each micro-loss by its share of the global batch so the
+        # accumulated gradient equals the full-batch mean even when the
+        # split is uneven or chunks were dropped (short last batch)
+        sizes = [float(mb[0].shape[0]) if isinstance(mb, tuple)
+                 else float(mb.shape[0]) for mb in micros]
+        total_rows = sum(sizes) or 1.0
         total = None
-        for mb in micros:
+        for mb, rows in zip(micros, sizes):
             x, y = (mb if isinstance(mb, tuple) else (mb, None))
             out = self._layers(x)
             if self._layers._loss_fn is not None and y is not None:
                 loss = self._layers._loss_fn(out, y)
             else:
                 loss = out
-            loss = loss / n if n > 1 else loss
+            loss = loss * (rows / total_rows)
             if scaler is not None:
                 scaler.scale(loss).backward()
             else:
@@ -104,8 +117,7 @@ class PipelineParallel(Layer):
         return total
 
     def eval_batch(self, data, compute_loss: bool = True):
-        n = max(int(self.accumulate_steps), 1)
-        micros = self._split_micro(data, n)
+        micros = self._split_micro(data, self._num_micro(data))
         total, outputs = None, []
         for mb in micros:
             x, y = (mb if isinstance(mb, tuple) else (mb, None))

@@ -152,6 +152,11 @@ class PipelineLayer(Layer):
         pp = mesh.shape["pp"]
         for p in self.parameters():
             v = p._value
+            # don't clobber layouts installed by TP/ZeRO layers (e.g. a
+            # ColumnParallelLinear weight already sharded over 'mp')
+            if hasattr(v, "sharding") and not v.sharding.is_fully_replicated \
+                    and len(v.sharding.device_set) > 1:
+                continue
             for i, d in enumerate(v.shape):
                 if d % pp == 0 and d > 0:
                     spec = [None] * v.ndim

@@ -53,9 +53,7 @@ def recompute(function, *args, **kwargs):
             ctx.fwd_args = inner_args
             if preserve_rng:
                 ctx.rng_state = frandom.get_rng_state()
-            out = function(*inner_args)
-            ctx.single = not isinstance(out, (tuple, list))
-            return out
+            return function(*inner_args)
 
         @staticmethod
         def backward(ctx, *grads):
@@ -82,9 +80,17 @@ def recompute(function, *args, **kwargs):
             finally:
                 if preserve_rng:
                     frandom.set_rng_state(saved)
-            result = [t.grad if t.grad is not None else None
-                      for t in replay_in
-                      if isinstance(t, Tensor) and not t.stop_gradient]
+            # inputs unreached by the replayed backward (e.g. the function
+            # only differentiates its closed-over params) get zero grads —
+            # a None here would crash PyLayer's vjp wrapper
+            import jax.numpy as jnp
+
+            result = []
+            for t in replay_in:
+                if isinstance(t, Tensor) and not t.stop_gradient:
+                    result.append(t.grad if t.grad is not None
+                                  else Tensor(jnp.zeros_like(t._value),
+                                              stop_gradient=True))
             return tuple(result) if len(result) != 1 else result[0]
 
     return _Recompute.apply(*args)

@@ -128,16 +128,8 @@ class ColumnSequenceParallelLinear(ColumnParallelLinear):
 class RowSequenceParallelLinear(RowParallelLinear):
     """Row-parallel matmul emitting seq-sharded output: the post-matmul
     collective becomes a reduce-scatter instead of an all-reduce (the
-    layout-aware optimization SP exists for)."""
+    layout-aware optimization SP exists for). Only the output spec differs
+    from RowParallelLinear."""
 
-    def forward(self, x):
-        if self.input_is_parallel:
-            spec = [None] * x.ndim
-            spec[-1] = "mp"
-            x = _on_mesh(x, P(*spec))
-        else:
-            x = _on_mesh(x)
-        from ....nn import functional as F
-
-        y = F.linear(x, self.weight, self.bias)
-        return _constrain(y, _seq_spec(y.ndim))
+    def _out_spec(self, ndim: int) -> P:
+        return _seq_spec(ndim)

@@ -1,0 +1,55 @@
+"""MoE gates: naive softmax top-k, GShard top-2, Switch top-1.
+
+Reference counterpart: ``python/paddle/incubate/distributed/models/moe/
+gate/`` (SURVEY.md §2.2 EP row): gating networks producing expert
+assignments, capacity-bounded, with a load-balancing auxiliary loss.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from .....nn import functional as F
+from .....nn.layer.layers import Layer
+
+__all__ = ["NaiveGate", "GShardGate", "SwitchGate"]
+
+
+class NaiveGate(Layer):
+    """Linear router + softmax top-k (the reference's NaiveGate)."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 top_k: int = 2):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert * world_size
+        self.top_k = top_k
+        self.gate_weight = self.create_parameter([d_model, self.num_expert])
+
+    def forward(self, x):
+        """x: [T, H] tokens → (gate_probs [T, E], logits [T, E])."""
+        logits = F.linear(x, self.gate_weight)
+        probs = F.softmax(logits, axis=-1)
+        return probs, logits
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with GShard's load-balance aux loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2,
+                 capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, top_k=top_k)
+        self.capacity_factor = capacity[0] if isinstance(capacity, (tuple, list)) \
+            else float(capacity)
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 (Switch Transformer) gate."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=1,
+                 capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, top_k=1)
+        self.capacity_factor = capacity[0] if isinstance(capacity, (tuple, list)) \
+            else float(capacity)

@@ -1,0 +1,122 @@
+"""MoELayer — mixture-of-experts with expert parallelism.
+
+Reference counterpart: ``python/paddle/incubate/distributed/models/moe/
+moe_layer.py`` (SURVEY.md §2.2 EP row): top-k gated dispatch where tokens
+travel to their experts via ``global_scatter``/``global_gather`` (an
+all-to-all across the expert-parallel group), with capacity bounding and a
+load-balancing auxiliary loss.
+
+TPU-native design: dispatch/combine are **einsums against a capacity-bounded
+one-hot dispatch tensor** (the GShard formulation — dense, MXU-friendly,
+static shapes for XLA) instead of index-based scatter; expert parallelism is
+the *layout* of the dispatched [E, C, H] tensor — sharding E over a mesh
+axis makes XLA emit exactly the all-to-all the reference calls explicitly
+(token-sharded [T, ...] → expert-sharded [E, ...] is an a2a resharding).
+The auxiliary loss follows GShard: E * Σ_e (mean gate prob_e × mean
+dispatch-fraction_e), exposed as ``layer.l_aux`` like the reference.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .....core.tensor import Tensor
+from .....nn.layer.layers import Layer
+from .....ops.dispatch import run_op
+from .....parallel.mesh import mesh_axis_size
+from .gate import GShardGate, NaiveGate, SwitchGate
+
+__all__ = ["MoELayer"]
+
+
+class MoELayer(Layer):
+    """``MoELayer(d_model, experts, gate="gshard", top_k=2)``.
+
+    ``experts``: a list/LayerList of expert Layers (each maps [*, H]→[*, H]).
+    ``gate``: "naive" | "gshard" | "switch", or a constructed gate Layer.
+    """
+
+    def __init__(self, d_model: int, experts: Sequence[Layer],
+                 gate="gshard", top_k: int = 2, capacity_factor: float = 1.25,
+                 moe_group=None, mp_group=None, recompute_interval: int = 0,
+                 name=None):
+        super().__init__(name)
+        self.d_model = d_model
+        self.experts = list(experts)
+        self.num_expert = len(self.experts)
+        for i, e in enumerate(self.experts):
+            self.add_sublayer(f"expert_{i}", e)
+        if isinstance(gate, str):
+            cls = {"naive": NaiveGate, "gshard": GShardGate,
+                   "switch": SwitchGate}[gate]
+            gate = cls(d_model, self.num_expert, top_k=top_k)
+        self.gate = gate
+        self.top_k = 1 if isinstance(gate, SwitchGate) else top_k
+        self.capacity_factor = capacity_factor
+        self.l_aux = None
+
+    def _capacity(self, num_tokens: int) -> int:
+        cap = int(math.ceil(self.top_k * num_tokens * self.capacity_factor
+                            / self.num_expert))
+        return max(cap, 4)
+
+    def forward(self, x):
+        orig_shape = list(x.shape)
+        H = orig_shape[-1]
+        E, K = self.num_expert, self.top_k
+        tokens = x.reshape([-1, H]) if hasattr(x, "reshape") else x
+        T = tokens.shape[0]
+        C = self._capacity(T)
+
+        probs, logits = self.gate(tokens)
+
+        def build_dispatch(p):
+            """[T, E] probs → (dispatch [T, E, C] bool, combine [T, E, C])."""
+            topv, topi = jax.lax.top_k(p, K)  # [T, K]
+            # normalise the selected gate weights (GShard top-2 behaviour)
+            topv = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
+            onehot = jax.nn.one_hot(topi, E, dtype=p.dtype)  # [T, K, E]
+            # position of each (token, slot) within its expert's queue:
+            # cumulative count over tokens, per expert, per k-slot priority
+            flat = onehot.transpose(1, 0, 2)  # [K, T, E] k-major priority
+            pos = jnp.cumsum(flat.reshape(K * T, E), axis=0) - flat.reshape(K * T, E)
+            pos = pos.reshape(K, T, E).transpose(1, 0, 2)  # [T, K, E]
+            keep = (pos < C) * onehot  # capacity-dropped slots zeroed
+            pos_c = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=p.dtype)
+            # dispatch[t, e, c] = token t occupies slot c of expert e
+            dispatch = jnp.einsum("tke,tkec->tec", keep, pos_c)
+            combine = jnp.einsum("tk,tke,tkec->tec", topv, keep, pos_c)
+            # aux loss (GShard): E * Σ_e mean-prob_e × top-1-fraction_e
+            me = jnp.mean(p, axis=0)  # [E]
+            ce = jnp.mean(onehot[:, 0], axis=0)  # [E]
+            aux = E * jnp.sum(me * ce)
+            return dispatch, combine, aux
+
+        dispatch, combine, aux = run_op(
+            "moe_dispatch", build_dispatch, probs, n_diff_outputs=3)
+        self.l_aux = aux
+
+        # [T, E, C] × [T, H] → [E, C, H]; sharding E over a mesh axis turns
+        # this contraction into the reference's global_scatter all-to-all
+        def to_experts(d, t):
+            return jnp.einsum("tec,th->ech", d, t)
+
+        expert_in = run_op("moe_scatter", to_experts, dispatch, tokens)
+
+        outs = []
+        for e, expert in enumerate(self.experts):
+            outs.append(expert(expert_in[e]))
+        from .....ops.manipulation import stack
+
+        expert_out = stack(outs, axis=0)  # [E, C, H]
+
+        def from_experts(c, eo):
+            return jnp.einsum("tec,ech->th", c, eo)
+
+        y = run_op("moe_gather", from_experts, combine, expert_out)
+        return y.reshape(orig_shape)

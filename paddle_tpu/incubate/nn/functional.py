@@ -1,0 +1,175 @@
+"""``paddle.incubate.nn.functional`` — fused-op entry points.
+
+Reference counterpart: ``python/paddle/incubate/nn/functional/`` exposing
+the fused CUDA kernels (``fused_attention``, ``fused_feedforward``,
+``fused_rotary_position_embedding``, ``fused_rms_norm``,
+``fused_linear``; SURVEY.md §2.1 "Fused kernels"). TPU-native: the fusions
+the reference hand-writes are XLA's job — these wrappers express the math
+in fusion-friendly form (and route attention to the Pallas flash kernel);
+the API names exist so reference model code ports unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...ops.dispatch import run_op
+from ...ops.pallas.flash_attention import dot_product_attention
+
+__all__ = ["fused_linear", "fused_rms_norm", "fused_layer_norm",
+           "fused_rotary_position_embedding", "fused_feedforward",
+           "flash_attention", "fused_multi_head_attention"]
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """GEMM+bias epilogue (reference: ``fused_gemm_epilogue``); XLA fuses
+    the bias add into the matmul epilogue on its own."""
+    if transpose_weight:
+        from ...ops.manipulation import transpose
+
+        weight = transpose(weight, [1, 0])
+    return F.linear(x, weight, bias)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon: float = 1e-6,
+                   begin_norm_axis: int = -1, name=None):
+    return F.rms_norm(x, norm_weight, epsilon=epsilon) if norm_bias is None \
+        else F.rms_norm(x, norm_weight, epsilon=epsilon) + norm_bias
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon: float = 1e-5,
+                     begin_norm_axis: int = -1, name=None):
+    return F.layer_norm(x, x.shape[begin_norm_axis:], weight=norm_weight,
+                        bias=norm_bias, epsilon=epsilon)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    name=None):
+    """RoPE applied to q (and k) — reference: ``fused_rope`` kernel.
+
+    q/k: [B, S, H, D]. When sin/cos are None they are computed with the
+    standard 10000^(-2i/D) frequencies."""
+
+    def rope_one(t, sin_, cos_):
+        B, S, H, D = t.shape
+        tf = t.astype(jnp.float32)
+        if use_neox_rotary_style:
+            half = tf.reshape(B, S, H, 2, D // 2)
+            x1, x2 = half[..., 0, :], half[..., 1, :]
+            rx1 = x1 * cos_ - x2 * sin_
+            rx2 = x2 * cos_ + x1 * sin_
+            out = jnp.stack([rx1, rx2], axis=-2).reshape(B, S, H, D)
+        else:
+            x1 = tf[..., 0::2]
+            x2 = tf[..., 1::2]
+            rx1 = x1 * cos_ - x2 * sin_
+            rx2 = x2 * cos_ + x1 * sin_
+            out = jnp.stack([rx1, rx2], axis=-1).reshape(B, S, H, D)
+        return out.astype(t.dtype)
+
+    def make_sin_cos(S, D, dtype):
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+        pos = jnp.arange(S, dtype=jnp.float32)
+        ang = jnp.outer(pos, inv)  # [S, D/2]
+        return jnp.sin(ang)[None, :, None, :], jnp.cos(ang)[None, :, None, :]
+
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        S, D = t.shape[1], t.shape[-1]
+        if sin is None or cos is None:
+            s_, c_ = make_sin_cos(S, D, t.dtype)
+        else:
+            s_ = sin._value if isinstance(sin, Tensor) else jnp.asarray(sin)
+            c_ = cos._value if isinstance(cos, Tensor) else jnp.asarray(cos)
+            if s_.ndim == 2:  # [S, D/2] → broadcastable
+                s_, c_ = s_[None, :, None, :], c_[None, :, None, :]
+        outs.append(run_op("fused_rope", lambda a, s=s_, c=c_: rope_one(a, s, c), t))
+    return tuple(outs)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode="upscale_in_train",
+                      name=None):
+    """Transformer FFN block (reference: ``fused_feedforward`` kernel):
+    residual + LN + linear-act-dropout-linear-dropout, pre- or post-LN."""
+    residual = x
+    if pre_layer_norm and ln1_scale is not None:
+        x = F.layer_norm(x, x.shape[-1:], weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    y = F.linear(x, linear1_weight, linear1_bias)
+    y = getattr(F, activation)(y)
+    y = F.dropout(y, p=dropout1_rate, training=training, mode=mode)
+    y = F.linear(y, linear2_weight, linear2_bias)
+    y = F.dropout(y, p=dropout2_rate, training=training, mode=mode)
+    out = residual + y
+    if not pre_layer_norm and ln2_scale is not None:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln2_scale,
+                           bias=ln2_bias, epsilon=ln2_epsilon)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention signature over the Pallas
+    kernel ([B, S, H, D] layout, like the reference's flash_attn)."""
+    out = run_op(
+        "flash_attention",
+        lambda q, k, v: dot_product_attention(q, k, v, is_causal=causal),
+        query, key, value,
+    )
+    return out, None  # (out, softmax) — softmax never materialised (flash)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               name=None):
+    """Reference ``fused_attention``: LN→QKV→MHA→proj→dropout→residual."""
+    residual = x
+    if pre_layer_norm and pre_ln_scale is not None:
+        x = F.layer_norm(x, x.shape[-1:], weight=pre_ln_scale,
+                         bias=pre_ln_bias, epsilon=pre_ln_epsilon)
+    B, S, H = x.shape
+    # qkv_weight: [3, num_heads, head_dim, H] (reference layout)
+    n_heads = qkv_weight.shape[1]
+    head_dim = qkv_weight.shape[2]
+
+    def mha(xa, wa, *rest):
+        bias = rest[0] if len(rest) else None
+        w = wa.reshape(3 * n_heads * head_dim, H).T  # [H, 3*Hd]
+        qkv = xa @ w
+        if bias is not None:
+            qkv = qkv + bias.reshape(-1)
+        qkv = qkv.reshape(B, S, 3, n_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        o = dot_product_attention(q, k, v, is_causal=False)
+        return o.reshape(B, S, n_heads * head_dim)
+
+    args = [x, qkv_weight] + ([qkv_bias] if qkv_bias is not None else [])
+    o = run_op("fused_attention_qkv", mha, *args)
+    o = F.linear(o, linear_weight, linear_bias)
+    o = F.dropout(o, p=dropout_rate, training=training, mode=mode)
+    out = o + residual if add_residual else o
+    if not pre_layer_norm and ln_scale is not None:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln_scale, bias=ln_bias,
+                           epsilon=ln_epsilon)
+    return out

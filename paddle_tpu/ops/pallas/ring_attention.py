@@ -1,0 +1,135 @@
+"""Ring attention — context parallelism for long sequences.
+
+Reference counterpart: PaddleNLP's ``RingFlashAttention`` (SURVEY.md §2.2
+SEP/CP row, §5.7): the sequence is sharded over the context-parallel group;
+each rank holds a K/V chunk and ring-passes it around the group, merging
+partial attention results with online-softmax (max/sum) rescaling, so no
+rank ever materialises the full sequence.
+
+TPU-native design: the ring is ``jax.lax.ppermute`` over a mesh axis —
+XLA overlaps the permute (ICI neighbour exchange) with the per-chunk
+attention compute, which is precisely the overlap the reference hand-codes
+with async P2P isend/irecv. The per-chunk compute reuses the flash-attention
+formulation; the cross-chunk merge is the same online-softmax algebra the
+kernel uses *within* chunks.
+
+Layout convention matches ``flash_attention``: [batch, seq, heads, dim],
+with seq already sharded over ``axis_name`` (use inside ``shard_map``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ring_attention", "RingFlashAttention", "context_parallel_attention"]
+
+
+def _chunk_attention(q, k, v, scale, q_offset, k_offset, is_causal):
+    """Unnormalised attention of local q against one K/V chunk.
+
+    Returns (acc, m, l): fp32 weighted values, running max, running sum —
+    the online-softmax partial state. Offsets are *global* sequence
+    positions of element 0 of q / k, used for causal masking across chunks.
+    """
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if is_causal:
+        sq, sk = q.shape[1], k.shape[1]
+        q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where((q_pos >= k_pos)[None, None], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)  # [B, H, Sq]
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [B, H, Sq]
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def _merge(acc, m, l, acc2, m2, l2):
+    """Online-softmax merge of two partial attention states."""
+    m_new = jnp.maximum(m, m2)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    a1 = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    a2 = jnp.where(jnp.isfinite(m2), jnp.exp(m2 - m_safe), 0.0)
+    return (
+        acc * a1[..., None] + acc2 * a2[..., None],
+        m_new,
+        l * a1 + l2 * a2,
+    )
+
+
+def ring_attention(q, k, v, axis_name: str = "sep", is_causal: bool = False,
+                   scale: Optional[float] = None):
+    """Ring attention over the ``axis_name`` mesh axis (call inside
+    shard_map with q/k/v seq-sharded). Exact — numerically equal to full
+    attention over the gathered sequence."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    q_offset = idx * s_local
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        acc, m, l, k_cur, v_cur = carry
+        # chunk i currently held came from rank (idx - i) mod n
+        src = jax.lax.rem(idx - i + n, n)
+        acc2, m2, l2 = _chunk_attention(
+            q, k_cur, v_cur, scale, q_offset, src * s_local, is_causal)
+        acc, m, l = _merge(acc, m, l, acc2, m2, l2)
+        # pass K/V along the ring (skippable on the last step, but keeping
+        # it unconditional lets XLA pipeline the permute under the compute)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (acc, m, l, k_nxt, v_nxt), None
+
+    acc0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+    m0 = jnp.full((b, h, s_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local), jnp.float32)
+    # scan (not fori_loop): reverse-mode differentiable, static trip count
+    (acc, m, l, _, _), _ = jax.lax.scan(
+        step, (acc0, m0, l0, k, v), jnp.arange(n))
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l[..., None]  # [B, H, S, D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+# PaddleNLP-compatible alias
+RingFlashAttention = ring_attention
+
+
+def context_parallel_attention(q, k, v, mesh=None, axis_name: str = "sep",
+                               is_causal: bool = False):
+    """GSPMD-level entry: q/k/v are *global* arrays; shard the seq dim over
+    ``axis_name`` and run ring attention under shard_map. Falls back to
+    plain attention when the axis has size 1 / no mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    from ...parallel.mesh import get_mesh
+    from .flash_attention import _xla_attention
+
+    mesh = mesh or get_mesh()
+    if mesh is None or axis_name not in mesh.axis_names or \
+            mesh.shape[axis_name] <= 1:
+        return _xla_attention(q, k, v, is_causal=is_causal)
+    n = mesh.shape[axis_name]
+    if q.shape[1] % n:
+        return _xla_attention(q, k, v, is_causal=is_causal)
+
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis_name,
+                          is_causal=is_causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -295,3 +295,49 @@ class TestHybridOptimizer:
         # moment accumulators exist and step ran with sharded placement
         st = opt._accumulators[id(lin.weight)]
         assert "moment1" in st or len(st) > 0
+
+
+class TestReviewRegressions:
+    def test_recompute_input_unused(self):
+        """Input not reached by the function's output → zero grad, no crash."""
+        from paddle_tpu.distributed.fleet import recompute
+
+        lin = paddle.nn.Linear(4, 4)
+        const = paddle.to_tensor(np.ones((2, 4), dtype="float32"))
+
+        def f(x):
+            return lin(const)  # ignores x entirely
+
+        x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"),
+                             stop_gradient=False)
+        loss = paddle.mean(recompute(f, x))
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.zeros((2, 4)))
+        assert lin.weight.grad is not None
+
+    def test_uneven_micro_batch_loss_weighting(self, mp4_mesh):
+        """4 rows with accumulate_steps=8: loss must equal the full-batch
+        mean, not half of it (review finding: k/n scaling bug)."""
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc,
+            PipelineLayer,
+            PipelineParallel,
+        )
+
+        paddle.seed(9)
+        descs = [LayerDesc(paddle.nn.Linear, 8, 8)]
+
+        def loss_fn(out, y):
+            return paddle.mean((out - y) ** 2)
+
+        pl = PipelineLayer(layers=descs, num_stages=1, loss_fn=loss_fn)
+        strategy = DistributedStrategy()
+        strategy.pipeline_configs = {"accumulate_steps": 8}
+        hcg = fleet.get_hybrid_communicate_group()
+        pp = PipelineParallel(pl, hcg, strategy)
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        loss = pp.train_batch((x, y))
+        full = paddle.mean((pl.run_functions[0](x) - y) ** 2)
+        np.testing.assert_allclose(float(loss.numpy()), float(full.numpy()),
+                                   rtol=1e-5)

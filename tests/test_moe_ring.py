@@ -1,0 +1,142 @@
+"""MoE + ring attention + incubate fused ops tests (SURVEY.md §2.2 EP row,
+§5.7 ring/context parallelism)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
+
+
+class TestMoE:
+    def _make(self, d_model=16, n_experts=4, top_k=2):
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+        experts = [paddle.nn.Linear(d_model, d_model) for _ in range(n_experts)]
+        return MoELayer(d_model, experts, gate="gshard", top_k=top_k,
+                        capacity_factor=4.0)
+
+    def test_forward_shape_and_aux(self):
+        paddle.seed(31)
+        moe = self._make()
+        x = paddle.to_tensor(np.random.randn(2, 8, 16).astype("float32"))
+        y = moe(x)
+        assert list(y.shape) == [2, 8, 16]
+        assert moe.l_aux is not None
+        assert float(moe.l_aux.numpy()) > 0
+
+    def test_large_capacity_routes_all_tokens(self):
+        """With capacity >> tokens/expert, every token reaches its top-1
+        expert: output equals gate-weighted expert mixture."""
+        paddle.seed(32)
+        moe = self._make(top_k=1)
+        x = paddle.to_tensor(np.random.randn(1, 4, 16).astype("float32"))
+        y = moe(x)
+        # manual reference: route each token through its argmax expert
+        tokens = x.numpy().reshape(-1, 16)
+        logits = tokens @ moe.gate.gate_weight.numpy()
+        probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        top = probs.argmax(-1)
+        ref = np.zeros_like(tokens)
+        for t in range(4):
+            e = top[t]
+            w = moe.experts[e].weight.numpy()
+            b = moe.experts[e].bias.numpy()
+            ref[t] = tokens[t] @ w + b  # top-1 weight normalised to 1.0
+        np.testing.assert_allclose(y.numpy().reshape(-1, 16), ref,
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_backward_reaches_experts_and_gate(self):
+        paddle.seed(33)
+        moe = self._make()
+        x = paddle.to_tensor(np.random.randn(2, 8, 16).astype("float32"),
+                             stop_gradient=False)
+        y = moe(x)
+        loss = paddle.mean(y ** 2) + 0.01 * moe.l_aux
+        loss.backward()
+        assert moe.gate.gate_weight.grad is not None
+        assert any(e.weight.grad is not None for e in moe.experts)
+        assert x.grad is not None
+
+
+class TestRingAttention:
+    @pytest.fixture
+    def sep_mesh(self):
+        mesh = create_hybrid_mesh(sep=8)
+        yield mesh
+        set_mesh(None)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_parity_vs_full_attention(self, sep_mesh, causal):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.flash_attention import _xla_attention
+        from paddle_tpu.ops.pallas.ring_attention import (
+            context_parallel_attention,
+        )
+
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(2, 64, 4, 16), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 64, 4, 16), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 64, 4, 16), jnp.float32)
+        out = context_parallel_attention(q, k, v, is_causal=causal)
+        ref = _xla_attention(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_grad_parity(self, sep_mesh):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.flash_attention import _xla_attention
+        from paddle_tpu.ops.pallas.ring_attention import (
+            context_parallel_attention,
+        )
+
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(1, 32, 2, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(1, 32, 2, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(1, 32, 2, 8), jnp.float32)
+        g1 = jax.grad(lambda *a: jnp.sum(
+            context_parallel_attention(*a, is_causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: jnp.sum(
+            _xla_attention(*a, is_causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+
+class TestIncubateFused:
+    def test_fused_rope_matches_manual(self):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_rotary_position_embedding,
+        )
+
+        rng = np.random.RandomState(3)
+        q = paddle.to_tensor(rng.randn(2, 8, 2, 16).astype("float32"))
+        k = paddle.to_tensor(rng.randn(2, 8, 2, 16).astype("float32"))
+        qo, ko, _ = fused_rotary_position_embedding(q, k)
+        assert list(qo.shape) == [2, 8, 2, 16]
+        # position 0 is unrotated
+        np.testing.assert_allclose(qo.numpy()[:, 0], q.numpy()[:, 0],
+                                   rtol=1e-5, atol=1e-6)
+        assert not np.allclose(qo.numpy()[:, 1], q.numpy()[:, 1])
+
+    def test_fused_feedforward(self):
+        from paddle_tpu.incubate.nn.functional import fused_feedforward
+
+        rng = np.random.RandomState(4)
+        x = paddle.to_tensor(rng.randn(2, 4, 8).astype("float32"))
+        w1 = paddle.to_tensor(rng.randn(8, 32).astype("float32"))
+        w2 = paddle.to_tensor(rng.randn(32, 8).astype("float32"))
+        out = fused_feedforward(x, w1, w2, dropout1_rate=0.0, dropout2_rate=0.0)
+        ref = x.numpy() + np.maximum(x.numpy() @ w1.numpy(), 0) @ w2.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+    def test_flash_attention_api(self):
+        from paddle_tpu.incubate.nn.functional import flash_attention
+
+        rng = np.random.RandomState(5)
+        q = paddle.to_tensor(rng.randn(1, 16, 2, 8).astype("float32"))
+        out, _ = flash_attention(q, q, q, causal=True)
+        assert list(out.shape) == [1, 16, 2, 8]
