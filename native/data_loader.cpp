@@ -1,0 +1,96 @@
+// Native prefetch queue for the data pipeline.
+//
+// Reference counterpart: paddle/fluid/operators/reader/buffered_reader.cc
+// (SURVEY.md §2.1 "Data pipeline"): a C++ double-buffered reader that
+// prefetches batches ahead of the consumer and overlaps H2D transfer.
+// TPU-native role: the host-side half of that design — a bounded MPMC
+// blob queue whose blocking push/pop happen in native code, so Python
+// worker threads hand off batches without GIL-held waits (ctypes releases
+// the GIL for the duration of the call) and the training loop overlaps
+// input pipeline with device steps. Device transfer overlap itself is
+// jax.device_put_async / donation territory, handled in Python.
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+
+namespace {
+
+class BlobQueue {
+ public:
+  explicit BlobQueue(int capacity) : cap_(capacity) {}
+
+  // returns 0 ok, -1 timeout, -2 closed
+  int push(const uint8_t* data, int len, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [this] { return closed_ || static_cast<int>(q_.size()) < cap_; };
+    if (!not_full_.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred))
+      return -1;
+    if (closed_) return -2;
+    q_.emplace_back(reinterpret_cast<const char*>(data), len);
+    not_empty_.notify_one();
+    return 0;
+  }
+
+  // returns blob size (may exceed cap → caller re-pops with bigger buffer
+  // via peek semantics), -1 timeout, -2 closed-and-drained
+  int pop(uint8_t* buf, int cap, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [this] { return closed_ || !q_.empty(); };
+    if (!not_empty_.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred))
+      return -1;
+    if (q_.empty()) return -2;  // closed and drained
+    std::string& front = q_.front();
+    int n = static_cast<int>(front.size());
+    if (n > cap) return n;  // tell caller the needed size; blob stays queued
+    std::memcpy(buf, front.data(), n);
+    q_.pop_front();
+    not_full_.notify_one();
+    return n;
+  }
+
+  int size() {
+    std::lock_guard<std::mutex> g(mu_);
+    return static_cast<int>(q_.size());
+  }
+
+  void close() {
+    std::lock_guard<std::mutex> g(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+ private:
+  int cap_;
+  bool closed_ = false;
+  std::deque<std::string> q_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dl_queue_create(int capacity) { return new BlobQueue(capacity); }
+
+int dl_queue_push(void* h, const uint8_t* data, int len, int timeout_ms) {
+  return static_cast<BlobQueue*>(h)->push(data, len, timeout_ms);
+}
+
+int dl_queue_pop(void* h, uint8_t* buf, int cap, int timeout_ms) {
+  return static_cast<BlobQueue*>(h)->pop(buf, cap, timeout_ms);
+}
+
+int dl_queue_size(void* h) { return static_cast<BlobQueue*>(h)->size(); }
+
+void dl_queue_close(void* h) { static_cast<BlobQueue*>(h)->close(); }
+
+void dl_queue_destroy(void* h) { delete static_cast<BlobQueue*>(h); }
+
+}  // extern "C"

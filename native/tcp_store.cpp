@@ -1,0 +1,374 @@
+// TCPStore — native rendezvous key-value store.
+//
+// Reference counterpart: paddle/fluid/distributed/store/tcp_store.cc
+// (SURVEY.md §2.2 "TCPStore / bootstrap"): a rank-0-hosted TCP KV store used
+// to exchange bootstrap data (coordinator addresses, barrier counters)
+// before any collective backend exists. TPU-native role: the same — it
+// bootstraps jax.distributed (coordinator discovery), provides cross-process
+// barriers for the launcher/elastic manager, and carries small control-plane
+// blobs. Exposed to Python via a C ABI consumed with ctypes
+// (paddle_tpu/distributed/store.py).
+//
+// Protocol (little-endian, length-prefixed):
+//   request : u8 op | u32 klen | key bytes | u64 arg | u32 vlen | val bytes
+//   response: i64 ret | u32 vlen | val bytes
+//   ops: 1=SET 2=GET(blocking, arg=timeout_ms) 3=ADD(arg=delta)
+//        4=WAIT(arg=timeout_ms) 5=DELETE 6=NUMKEYS
+//
+// Single daemon thread, poll()-driven, one pending-request queue per
+// blocked GET/WAIT (no thread-per-connection; the store serves O(1k) ranks
+// of tiny messages — throughput is irrelevant, robustness matters).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+int64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             Clock::now().time_since_epoch())
+      .count();
+}
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Request {
+  uint8_t op;
+  std::string key;
+  uint64_t arg;
+  std::string val;
+};
+
+bool read_request(int fd, Request* out) {
+  uint8_t op;
+  if (!recv_all(fd, &op, 1)) return false;
+  uint32_t klen;
+  if (!recv_all(fd, &klen, 4) || klen > (1u << 20)) return false;
+  std::string key(klen, '\0');
+  if (klen && !recv_all(fd, &key[0], klen)) return false;
+  uint64_t arg;
+  if (!recv_all(fd, &arg, 8)) return false;
+  uint32_t vlen;
+  if (!recv_all(fd, &vlen, 4) || vlen > (1u << 26)) return false;
+  std::string val(vlen, '\0');
+  if (vlen && !recv_all(fd, &val[0], vlen)) return false;
+  out->op = op;
+  out->key.swap(key);
+  out->arg = arg;
+  out->val.swap(val);
+  return true;
+}
+
+bool write_response(int fd, int64_t ret, const std::string& val) {
+  uint32_t vlen = static_cast<uint32_t>(val.size());
+  if (!send_all(fd, &ret, 8)) return false;
+  if (!send_all(fd, &vlen, 4)) return false;
+  if (vlen && !send_all(fd, val.data(), vlen)) return false;
+  return true;
+}
+
+struct Waiter {
+  int fd;
+  uint8_t op;  // GET or WAIT
+  std::string key;
+  int64_t deadline_ms;
+};
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) : port_(port) {}
+
+  bool start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+      return false;
+    if (::listen(listen_fd_, 512) < 0) return false;
+    if (port_ == 0) {
+      socklen_t len = sizeof(addr);
+      ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+      port_ = ntohs(addr.sin_port);
+    }
+    running_.store(true);
+    thread_ = std::thread([this] { loop(); });
+    return true;
+  }
+
+  void stop() {
+    running_.store(false);
+    if (thread_.joinable()) thread_.join();
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+    for (int fd : clients_) ::close(fd);
+  }
+
+  int port() const { return port_; }
+
+ private:
+  void loop() {
+    while (running_.load()) {
+      std::vector<pollfd> fds;
+      fds.push_back({listen_fd_, POLLIN, 0});
+      for (int fd : clients_) fds.push_back({fd, POLLIN, 0});
+      int rc = ::poll(fds.data(), fds.size(), 50);
+      if (rc < 0) continue;
+      if (fds[0].revents & POLLIN) {
+        int c = ::accept(listen_fd_, nullptr, nullptr);
+        if (c >= 0) {
+          int one = 1;
+          ::setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          clients_.push_back(c);
+        }
+      }
+      std::vector<int> dead;
+      for (size_t i = 1; i < fds.size(); ++i) {
+        if (fds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
+          if (!serve_one(fds[i].fd)) dead.push_back(fds[i].fd);
+        }
+      }
+      for (int fd : dead) {
+        ::close(fd);
+        clients_.erase(std::remove(clients_.begin(), clients_.end(), fd),
+                       clients_.end());
+        waiters_.erase(
+            std::remove_if(waiters_.begin(), waiters_.end(),
+                           [fd](const Waiter& w) { return w.fd == fd; }),
+            waiters_.end());
+      }
+      flush_waiters();
+    }
+  }
+
+  bool serve_one(int fd) {
+    Request req;
+    if (!read_request(fd, &req)) return false;
+    switch (req.op) {
+      case 1:  // SET
+        data_[req.key] = req.val;
+        return write_response(fd, 0, "");
+      case 2:  // GET (blocking until key exists or timeout)
+      case 4:  // WAIT
+      {
+        auto it = data_.find(req.key);
+        if (it != data_.end())
+          return write_response(fd, 0, req.op == 2 ? it->second : "");
+        waiters_.push_back({fd, req.op, req.key,
+                            now_ms() + static_cast<int64_t>(req.arg)});
+        return true;  // deferred
+      }
+      case 3: {  // ADD
+        auto& slot = data_[req.key];
+        int64_t cur = 0;
+        if (slot.size() == 8) std::memcpy(&cur, slot.data(), 8);
+        cur += static_cast<int64_t>(req.arg);
+        slot.assign(reinterpret_cast<char*>(&cur), 8);
+        flush_waiters();
+        return write_response(fd, cur, "");
+      }
+      case 5:  // DELETE
+        return write_response(fd, data_.erase(req.key) ? 1 : 0, "");
+      case 6:  // NUMKEYS
+        return write_response(fd, static_cast<int64_t>(data_.size()), "");
+      default:
+        return write_response(fd, -1, "");
+    }
+  }
+
+  void flush_waiters() {
+    int64_t t = now_ms();
+    std::vector<Waiter> keep;
+    for (auto& w : waiters_) {
+      auto it = data_.find(w.key);
+      if (it != data_.end()) {
+        write_response(w.fd, 0, w.op == 2 ? it->second : "");
+      } else if (t >= w.deadline_ms) {
+        write_response(w.fd, -1, "");
+      } else {
+        keep.push_back(w);
+      }
+    }
+    waiters_.swap(keep);
+  }
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread thread_;
+  std::vector<int> clients_;
+  std::vector<Waiter> waiters_;
+  std::map<std::string, std::string> data_;
+};
+
+class StoreClient {
+ public:
+  bool connect_to(const char* host, int port, int timeout_ms) {
+    int64_t deadline = now_ms() + timeout_ms;
+    do {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+        ::close(fd_);
+        return false;
+      }
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+        int one = 1;
+        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd_);
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    } while (now_ms() < deadline);
+    return false;
+  }
+
+  // returns ret code; fills val
+  int64_t rpc(uint8_t op, const char* key, uint64_t arg, const uint8_t* val,
+              uint32_t vlen, std::string* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+    if (!send_all(fd_, &op, 1) || !send_all(fd_, &klen, 4) ||
+        !send_all(fd_, key, klen) || !send_all(fd_, &arg, 8) ||
+        !send_all(fd_, &vlen, 4) || (vlen && !send_all(fd_, val, vlen)))
+      return -2;
+    int64_t ret;
+    uint32_t rlen;
+    if (!recv_all(fd_, &ret, 8) || !recv_all(fd_, &rlen, 4)) return -2;
+    out->resize(rlen);
+    if (rlen && !recv_all(fd_, &(*out)[0], rlen)) return -2;
+    return ret;
+  }
+
+  void close_fd() {
+    if (fd_ >= 0) ::close(fd_);
+    fd_ = -1;
+  }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tcp_store_server_start(int port) {
+  auto* s = new StoreServer(port);
+  if (!s->start()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int tcp_store_server_port(void* h) {
+  return static_cast<StoreServer*>(h)->port();
+}
+
+void tcp_store_server_stop(void* h) {
+  auto* s = static_cast<StoreServer*>(h);
+  s->stop();
+  delete s;
+}
+
+void* tcp_store_client_connect(const char* host, int port, int timeout_ms) {
+  auto* c = new StoreClient();
+  if (!c->connect_to(host, port, timeout_ms)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void tcp_store_client_close(void* h) {
+  auto* c = static_cast<StoreClient*>(h);
+  c->close_fd();
+  delete c;
+}
+
+int tcp_store_set(void* h, const char* key, const uint8_t* data, int len) {
+  std::string out;
+  return static_cast<int>(static_cast<StoreClient*>(h)->rpc(
+      1, key, 0, data, static_cast<uint32_t>(len), &out));
+}
+
+// returns value length, or -1 timeout, -2 io error; copies min(len, cap)
+int tcp_store_get(void* h, const char* key, int timeout_ms, uint8_t* buf,
+                  int cap) {
+  std::string out;
+  int64_t ret = static_cast<StoreClient*>(h)->rpc(
+      2, key, static_cast<uint64_t>(timeout_ms), nullptr, 0, &out);
+  if (ret != 0) return static_cast<int>(ret);
+  int n = std::min<int>(static_cast<int>(out.size()), cap);
+  if (n > 0) std::memcpy(buf, out.data(), n);
+  return static_cast<int>(out.size());
+}
+
+long long tcp_store_add(void* h, const char* key, long long delta) {
+  std::string out;
+  return static_cast<StoreClient*>(h)->rpc(
+      3, key, static_cast<uint64_t>(delta), nullptr, 0, &out);
+}
+
+int tcp_store_wait(void* h, const char* key, int timeout_ms) {
+  std::string out;
+  return static_cast<int>(static_cast<StoreClient*>(h)->rpc(
+      4, key, static_cast<uint64_t>(timeout_ms), nullptr, 0, &out));
+}
+
+int tcp_store_delete(void* h, const char* key) {
+  std::string out;
+  return static_cast<int>(static_cast<StoreClient*>(h)->rpc(
+      5, key, 0, nullptr, 0, &out));
+}
+
+long long tcp_store_num_keys(void* h) {
+  std::string out;
+  return static_cast<StoreClient*>(h)->rpc(6, "", 0, nullptr, 0, &out);
+}
+
+}  // extern "C"

@@ -46,6 +46,9 @@ class Tensor:
         "name",
         "persistable",
         "trainable",
+        # auto-parallel dist attrs (reference: DistTensor.dist_attr)
+        "process_mesh",
+        "placements",
         "__weakref__",
     )
 
@@ -65,6 +68,10 @@ class Tensor:
         self.name = name or _auto_name()
         self.persistable = persistable
         self.trainable = not stop_gradient
+        # auto-parallel dist attrs: None on dense tensors (reference:
+        # DistTensor.dist_attr defaults), set by shard_tensor/reshard
+        self.process_mesh = None
+        self.placements = None
 
     # -- raw value access ---------------------------------------------------
     @property

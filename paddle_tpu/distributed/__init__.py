@@ -31,7 +31,19 @@ from .env import (
     is_initialized,
 )
 from .parallel import DataParallel
-from . import fleet, sharding
+from . import auto_parallel, fleet, launch, sharding
+from .store import TCPStore
+from .auto_parallel import (
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_tensor,
+)
 from .sharding import group_sharded_parallel, save_group_sharded_model
 
 __all__ = [
@@ -41,7 +53,9 @@ __all__ = [
     "send", "recv", "isend", "irecv", "barrier", "ParallelEnv", "get_rank",
     "get_world_size", "init_parallel_env", "is_initialized", "DataParallel",
     "spawn", "launch", "fleet", "sharding", "group_sharded_parallel",
-    "save_group_sharded_model",
+    "save_group_sharded_model", "auto_parallel", "ProcessMesh", "Placement",
+    "Shard", "Replicate", "Partial", "shard_tensor", "dtensor_from_fn",
+    "reshard", "shard_layer", "TCPStore",
 ]
 
 

@@ -1,0 +1,18 @@
+from .api import (
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    get_mesh,
+    reshard,
+    set_mesh,
+    shard_layer,
+    shard_tensor,
+    to_placements,
+)
+
+__all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+           "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+           "to_placements", "get_mesh", "set_mesh"]

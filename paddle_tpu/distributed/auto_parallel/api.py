@@ -1,0 +1,293 @@
+"""Auto-parallel (semi-automatic) API: ProcessMesh, placements, shard_tensor.
+
+Reference counterpart: ``python/paddle/distributed/auto_parallel/`` +
+``paddle/phi/core/distributed/auto_parallel/`` (SURVEY.md §2.2
+"Auto-parallel"): ``shard_tensor(x, mesh, [Shard(0), Replicate()])`` builds a
+C++ ``DistTensor{local_tensor, dist_attr}``; per-op SPMD rules infer output
+shardings; a reshard machinery converts between placements.
+
+TPU-native mapping — this subsystem is where the reference re-implements
+what XLA GSPMD already is:
+
+* ``ProcessMesh``       → ``jax.sharding.Mesh`` (held by the wrapper).
+* ``Shard(d)/Replicate/Partial`` placements → ``PartitionSpec`` entries.
+* ``DistTensor``        → a ``jax.Array`` with a ``NamedSharding`` — the
+  "local tensor + dist attr" pair IS jax's sharded array model.
+* per-op SPMD rules     → GSPMD sharding propagation inside jit.
+* reshard (s→r, r→s, p→r, cross-mesh) → ``jax.device_put`` to the target
+  ``NamedSharding`` (XLA emits all-gather / dynamic-slice / all-reduce /
+  send-recv as needed).
+
+So the API surface here is thin and faithful, while the engine underneath is
+the compiler. ``dist_attr``/placements are recoverable from any Tensor via
+its value's sharding.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ...core.tensor import Tensor, to_tensor
+
+__all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+           "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+           "get_mesh", "set_mesh", "to_placements"]
+
+
+class Placement:
+    """Base placement type (reference: ``paddle.distributed.Placement``)."""
+
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return False
+
+    def is_replicate(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self._dim = int(dim)
+
+    def get_dim(self) -> int:
+        return self._dim
+
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return dim is None or dim == self._dim
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o._dim == self._dim
+
+    def __hash__(self):
+        return hash(("shard", self._dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self._dim})"
+
+
+class Replicate(Placement):
+    def is_replicate(self) -> bool:
+        return True
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    """Pending-reduction placement. A materialised jax.Array is never
+    partial (XLA resolves partial sums inside programs), so resharding a
+    Partial placement is performed as Replicate; the class exists for
+    placement-spec parity and SPMD-rule tests."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __eq__(self, o):
+        return isinstance(o, Partial) and o.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """N-D logical process grid (reference: ``dist.ProcessMesh``), backed by
+    a ``jax.sharding.Mesh`` over the device array."""
+
+    def __init__(self, mesh: Union[Sequence, np.ndarray],
+                 dim_names: Optional[Sequence[str]] = None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._shape = list(arr.shape)
+        self._dim_names = list(dim_names)
+        self._process_ids = arr.reshape(-1).tolist()
+        devices = np.asarray(jax.devices())
+        if arr.size > devices.size:
+            raise ValueError(
+                f"ProcessMesh needs {arr.size} devices, have {devices.size}")
+        self._jax_mesh = Mesh(devices[np.asarray(arr)], tuple(self._dim_names))
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return list(self._process_ids)
+
+    @property
+    def mesh(self):
+        return self._jax_mesh
+
+    def get_dim_size(self, name: str) -> int:
+        return self._shape[self._dim_names.index(name)]
+
+    def __eq__(self, o):
+        return isinstance(o, ProcessMesh) and o._shape == self._shape and \
+            o._process_ids == self._process_ids
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+
+
+_GLOBAL_PROCESS_MESH: Optional[ProcessMesh] = None
+
+
+def set_mesh(mesh: Optional[ProcessMesh]) -> None:
+    global _GLOBAL_PROCESS_MESH
+    _GLOBAL_PROCESS_MESH = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _GLOBAL_PROCESS_MESH
+
+
+def _placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh,
+                        ndim: int) -> P:
+    """[Shard(0), Replicate()] over mesh dims → PartitionSpec per *tensor*
+    dim (the transpose the reference's dist_attr stores as dims_mapping)."""
+    entries: List = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.get_dim()
+            name = mesh.dim_names[mesh_dim]
+            if entries[d] is None:
+                entries[d] = name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (name,)
+            else:
+                entries[d] = (entries[d], name)
+    return P(*entries)
+
+
+def to_placements(value, mesh: ProcessMesh) -> List[Placement]:
+    """Recover placements from a jax.Array's sharding (dist_attr readback)."""
+    sh = getattr(value, "sharding", None)
+    out: List[Placement] = [Replicate() for _ in mesh.dim_names]
+    if not isinstance(sh, NamedSharding):
+        return out
+    spec = sh.spec
+    for tensor_dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for name in names:
+            if name in mesh.dim_names:
+                out[mesh.dim_names.index(name)] = Shard(tensor_dim)
+    return out
+
+
+def _put(t: Tensor, sharding: NamedSharding) -> Tensor:
+    """Autograd-preserving placement: the device_put is a tape-recorded op
+    (identity VJP), so resharding inside a differentiable computation does
+    not detach the graph."""
+    from ...ops.dispatch import run_op
+
+    if t.stop_gradient or t._grad_node is None:
+        # leaf (or non-diff) input → a fresh *leaf* dist tensor, matching
+        # the reference where shard_tensor of data/params yields a leaf
+        # that accumulates .grad itself
+        return Tensor(jax.device_put(t._value, sharding),
+                      stop_gradient=t.stop_gradient)
+    # intermediate value → tape-recorded reshard (identity VJP) so the
+    # upstream graph stays attached
+    return run_op("reshard", lambda v: jax.device_put(v, sharding), t)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None):
+    """``dist.shard_tensor``: place ``data`` on ``mesh`` with ``placements``.
+
+    Returns an ordinary Tensor whose value carries the NamedSharding — the
+    DistTensor. Works on Tensor, ndarray, or scalar input.
+    """
+    t = data if isinstance(data, Tensor) else to_tensor(data, dtype=dtype)
+    if stop_gradient is not None:
+        t.stop_gradient = stop_gradient
+    spec = _placements_to_spec(placements, mesh, t.ndim)
+    out = _put(t, NamedSharding(mesh.mesh, spec))
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements: Sequence[Placement],
+                    *args, **kwargs):
+    """Build a sharded tensor directly from a creation fn (e.g.
+    ``paddle.ones``) — jit with out_shardings constructs each shard on its
+    own device, never materialising the global value on one (the reference
+    avoids the same materialisation with per-rank local init)."""
+
+    def raw():
+        out = fn(*args, **kwargs)
+        return out._value if isinstance(out, Tensor) else out
+
+    ndim = len(jax.eval_shape(raw).shape)
+    spec = _placements_to_spec(placements, mesh, ndim)
+    sharded = jax.jit(raw, out_shardings=NamedSharding(mesh.mesh, spec))()
+    out = Tensor(sharded, stop_gradient=True)
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
+
+
+def reshard(dist_tensor, mesh: ProcessMesh, placements: Sequence[Placement]):
+    """Convert between placements/meshes (reference: the reshard machinery
+    in ``phi/core/distributed/auto_parallel/reshard/`` with one class per
+    transition; here every transition is one device_put)."""
+    t = dist_tensor if isinstance(dist_tensor, Tensor) else to_tensor(dist_tensor)
+    spec = _placements_to_spec(placements, mesh, t.ndim)
+    out = _put(t, NamedSharding(mesh.mesh, spec))
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """``dist.shard_layer``: apply ``shard_fn(name, layer, mesh)`` to every
+    sublayer to place its parameters (default: replicate everything)."""
+
+    def default_shard_fn(name, sublayer, mesh):
+        for pname, param in sublayer.named_parameters(include_sublayers=False):
+            sharded = shard_tensor(param, mesh,
+                                   [Replicate() for _ in mesh.dim_names])
+            param._inplace_set(sharded._value)
+
+    fn = shard_fn or default_shard_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh))
+    return layer

@@ -74,11 +74,23 @@ class PipelineParallel(Layer):
 
     def _num_micro(self, data) -> int:
         n = max(int(self.accumulate_steps), 1)
-        if n == 1 and self.micro_batch_size and self.micro_batch_size > 0:
-            first = data[0] if isinstance(data, (tuple, list)) else data
-            if isinstance(first, Tensor):
+        # micro_batch_size only drives the split when the user set it to
+        # something meaningful (>1) and didn't configure accumulate_steps —
+        # the default (1, 1) strategy must mean "single pass", not row-wise
+        if n == 1 and self.micro_batch_size and self.micro_batch_size > 1:
+            first = self._first_tensor(data)
+            if first is not None:
                 n = max(first.shape[0] // int(self.micro_batch_size), 1)
         return n
+
+    @staticmethod
+    def _first_tensor(data):
+        if isinstance(data, (tuple, list)):
+            for t in data:
+                if isinstance(t, Tensor):
+                    return t
+            return None
+        return data if isinstance(data, Tensor) else None
 
     def train_batch(self, data, optimizer=None, lr_scheduler=None, scaler=None):
         """One global batch: micro-batch loop with grad accumulation, then a
@@ -87,8 +99,10 @@ class PipelineParallel(Layer):
         # weight each micro-loss by its share of the global batch so the
         # accumulated gradient equals the full-batch mean even when the
         # split is uneven or chunks were dropped (short last batch)
-        sizes = [float(mb[0].shape[0]) if isinstance(mb, tuple)
-                 else float(mb.shape[0]) for mb in micros]
+        sizes = []
+        for mb in micros:
+            t = self._first_tensor(mb)
+            sizes.append(float(t.shape[0]) if t is not None else 1.0)
         total_rows = sum(sizes) or 1.0
         total = None
         for mb, rows in zip(micros, sizes):

@@ -1,0 +1,19 @@
+"""``python -m paddle_tpu.distributed.launch`` — the process launcher.
+
+Reference counterpart: ``python/paddle/distributed/launch/`` (SURVEY.md
+§2.2 "Launcher", §5.3): ``Context`` (args + env), a collective controller
+that rendezvouses nodes, spawns one worker process per device with the
+``PADDLE_*`` env contract, streams per-rank logs to ``log/workerlog.N``,
+watches children, and (elastic mode) restarts the pod on failure.
+
+TPU-native notes: on TPU pods one *process per host* drives all local chips
+(single-controller SPMD), so ``--nproc_per_node`` defaults to 1 instead of
+the reference's one-per-GPU; multi-host rendezvous bootstraps
+``jax.distributed`` via the master endpoint (our native TCPStore hosts the
+barrier). The env contract is kept verbatim so reference training scripts
+launch unchanged.
+"""
+
+from .main import Context, launch, main
+
+__all__ = ["launch", "main", "Context"]

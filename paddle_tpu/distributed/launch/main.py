@@ -1,0 +1,217 @@
+"""Launcher implementation: Context → Pod of worker Containers.
+
+Reference counterpart: ``python/paddle/distributed/launch/main.py`` +
+``controllers/collective.py`` + ``job/pod.py`` (SURVEY.md §2.2): argument/env
+context, worker spawn with the PADDLE_* contract, log files, watch loop,
+elastic restart.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = ["Context", "Container", "Pod", "CollectiveController", "launch",
+           "main"]
+
+
+@dataclass
+class Context:
+    """Parsed launcher configuration (args override env)."""
+
+    script: str = ""
+    script_args: List[str] = field(default_factory=list)
+    nproc_per_node: int = 1
+    ips: List[str] = field(default_factory=lambda: ["127.0.0.1"])
+    master: str = ""
+    rank: int = -1
+    log_dir: str = "log"
+    devices: str = ""
+    elastic_level: int = 0
+    max_restart: int = 3
+    run_mode: str = "collective"
+
+    @classmethod
+    def parse(cls, argv: Optional[List[str]] = None) -> "Context":
+        p = argparse.ArgumentParser(
+            prog="paddle_tpu.distributed.launch",
+            description="Launch distributed training (reference CLI shape)")
+        p.add_argument("--nproc_per_node", "--nprocs", type=int, default=None,
+                       help="worker processes per node (TPU default: 1 — one "
+                            "controller drives all local chips)")
+        p.add_argument("--ips", type=str, default="127.0.0.1",
+                       help="comma-separated host list")
+        p.add_argument("--master", type=str, default="",
+                       help="rendezvous endpoint ip:port (default: first ip)")
+        p.add_argument("--rank", type=int, default=-1,
+                       help="this node's rank in --ips (default: inferred)")
+        p.add_argument("--log_dir", type=str, default="log")
+        p.add_argument("--devices", "--gpus", type=str, default="",
+                       help="visible device ids for this node")
+        p.add_argument("--elastic_level", type=int, default=0,
+                       help=">=1: restart the pod on worker failure")
+        p.add_argument("--max_restart", type=int, default=3)
+        p.add_argument("--run_mode", type=str, default="collective",
+                       choices=["collective", "ps"])
+        p.add_argument("script", type=str)
+        p.add_argument("script_args", nargs=argparse.REMAINDER)
+        a = p.parse_args(argv)
+        return cls(
+            script=a.script, script_args=a.script_args,
+            nproc_per_node=a.nproc_per_node if a.nproc_per_node else 1,
+            ips=[s.strip() for s in a.ips.split(",") if s.strip()],
+            master=a.master, rank=a.rank, log_dir=a.log_dir,
+            devices=a.devices, elastic_level=a.elastic_level,
+            max_restart=a.max_restart, run_mode=a.run_mode,
+        )
+
+
+class Container:
+    """One worker process + its log file (reference: ``job/container.py``)."""
+
+    def __init__(self, cmd: List[str], env: Dict[str, str], log_path: str):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self._log_file = None
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+        self._log_file = open(self.log_path, "ab")
+        full_env = dict(os.environ)
+        full_env.update(self.env)
+        self.proc = subprocess.Popen(
+            self.cmd, env=full_env, stdout=self._log_file,
+            stderr=subprocess.STDOUT)
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self, timeout: float = 10.0):
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        if self._log_file:
+            self._log_file.close()
+            self._log_file = None
+
+
+class Pod:
+    """All containers of this node (reference: ``job/pod.py``)."""
+
+    def __init__(self):
+        self.containers: List[Container] = []
+
+    def add(self, c: Container):
+        self.containers.append(c)
+
+    def start(self):
+        for c in self.containers:
+            c.start()
+
+    def watch(self) -> int:
+        """Block until any worker exits; returns its code (0 = all done)."""
+        while True:
+            alive = 0
+            for c in self.containers:
+                rc = c.poll()
+                if rc is None:
+                    alive += 1
+                elif rc != 0:
+                    return rc
+            if alive == 0:
+                return 0
+            time.sleep(0.5)
+
+    def stop(self):
+        for c in self.containers:
+            c.terminate()
+
+
+class CollectiveController:
+    """Builds the env contract and runs the pod (reference:
+    ``controllers/collective.py``)."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+
+    def _node_rank(self) -> int:
+        if self.ctx.rank >= 0:
+            return self.ctx.rank
+        return int(os.environ.get("PADDLE_NODE_RANK", "0"))
+
+    def build_pod(self) -> Pod:
+        ctx = self.ctx
+        nnodes = len(ctx.ips)
+        node_rank = self._node_rank()
+        nproc = ctx.nproc_per_node
+        world = nnodes * nproc
+        master = ctx.master or f"{ctx.ips[0]}:49170"
+        endpoints = [f"{ip}:{49171 + i}" for ip in ctx.ips
+                     for i in range(nproc)]
+        pod = Pod()
+        for local in range(nproc):
+            rank = node_rank * nproc + local
+            env = {
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local),
+                "PADDLE_MASTER": master,
+                "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+                "PADDLE_NODE_RANK": str(node_rank),
+            }
+            if ctx.devices:
+                env["TPU_VISIBLE_DEVICES"] = ctx.devices
+                env["CUDA_VISIBLE_DEVICES"] = ctx.devices
+            # workers get python's sys.path[0] = the *script's* dir, not the
+            # launcher's cwd — propagate cwd so source-tree imports resolve
+            env["PYTHONPATH"] = os.pathsep.join(
+                p for p in (os.getcwd(), os.environ.get("PYTHONPATH", ""))
+                if p)
+            cmd = [sys.executable, "-u", ctx.script] + ctx.script_args
+            log = os.path.join(ctx.log_dir, f"workerlog.{local}")
+            pod.add(Container(cmd, env, log))
+        return pod
+
+    def run(self) -> int:
+        restarts = 0
+        while True:
+            pod = self.build_pod()
+            pod.start()
+            rc = pod.watch()
+            pod.stop()
+            if rc == 0:
+                return 0
+            if self.ctx.elastic_level >= 1 and restarts < self.ctx.max_restart:
+                restarts += 1
+                print(f"[launch] worker failed (exit {rc}); elastic restart "
+                      f"{restarts}/{self.ctx.max_restart}", file=sys.stderr)
+                time.sleep(1.0)
+                continue
+            return rc
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    ctx = Context.parse(argv)
+    controller = CollectiveController(ctx)
+
+    def on_signal(sig, frame):
+        sys.exit(128 + sig)
+
+    signal.signal(signal.SIGTERM, on_signal)
+    return controller.run()
+
+
+def main():
+    sys.exit(launch())

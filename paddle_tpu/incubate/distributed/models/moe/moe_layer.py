@@ -19,16 +19,13 @@ dispatch-fraction_e), exposed as ``layer.l_aux`` like the reference.
 from __future__ import annotations
 
 import math
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
-from .....core.tensor import Tensor
 from .....nn.layer.layers import Layer
 from .....ops.dispatch import run_op
-from .....parallel.mesh import mesh_axis_size
 from .gate import GShardGate, NaiveGate, SwitchGate
 
 __all__ = ["MoELayer"]
@@ -42,7 +39,8 @@ class MoELayer(Layer):
     """
 
     def __init__(self, d_model: int, experts: Sequence[Layer],
-                 gate="gshard", top_k: int = 2, capacity_factor: float = 1.25,
+                 gate="gshard", top_k: int = 2,
+                 capacity_factor: Optional[float] = None,
                  moe_group=None, mp_group=None, recompute_interval: int = 0,
                  name=None):
         super().__init__(name)
@@ -57,7 +55,10 @@ class MoELayer(Layer):
             gate = cls(d_model, self.num_expert, top_k=top_k)
         self.gate = gate
         self.top_k = 1 if isinstance(gate, SwitchGate) else top_k
-        self.capacity_factor = capacity_factor
+        # precedence: explicit arg > the gate's configured capacity > default
+        if capacity_factor is None:
+            capacity_factor = getattr(gate, "capacity_factor", 1.25)
+        self.capacity_factor = float(capacity_factor)
         self.l_aux = None
 
     def _capacity(self, num_tokens: int) -> int:

@@ -56,6 +56,11 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     q/k: [B, S, H, D]. When sin/cos are None they are computed with the
     standard 10000^(-2i/D) frequencies."""
 
+    pos_ids = None
+    if position_ids is not None:
+        pos_ids = position_ids._value if isinstance(position_ids, Tensor) \
+            else jnp.asarray(position_ids)
+
     def rope_one(t, sin_, cos_):
         B, S, H, D = t.shape
         tf = t.astype(jnp.float32)
@@ -75,6 +80,15 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
 
     def make_sin_cos(S, D, dtype):
         inv = 1.0 / (10000.0 ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+        if pos_ids is not None:
+            # KV-cache decode: absolute positions supplied by the caller
+            pos = pos_ids.astype(jnp.float32)  # [S] or [B, S]
+            ang = pos[..., None] * inv  # [..., S, D/2]
+            if ang.ndim == 2:  # [S, D/2]
+                return (jnp.sin(ang)[None, :, None, :],
+                        jnp.cos(ang)[None, :, None, :])
+            return (jnp.sin(ang)[:, :, None, :],  # [B, S, 1, D/2]
+                    jnp.cos(ang)[:, :, None, :])
         pos = jnp.arange(S, dtype=jnp.float32)
         ang = jnp.outer(pos, inv)  # [S, D/2]
         return jnp.sin(ang)[None, :, None, :], jnp.cos(ang)[None, :, None, :]
@@ -90,7 +104,16 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         else:
             s_ = sin._value if isinstance(sin, Tensor) else jnp.asarray(sin)
             c_ = cos._value if isinstance(cos, Tensor) else jnp.asarray(cos)
-            if s_.ndim == 2:  # [S, D/2] → broadcastable
+            s_ = s_.reshape(-1, s_.shape[-1])  # [S_max, D/2]
+            c_ = c_.reshape(-1, c_.shape[-1])
+            if pos_ids is not None:
+                # gather the caller's table rows at the absolute positions
+                s_, c_ = jnp.take(s_, pos_ids, 0), jnp.take(c_, pos_ids, 0)
+                if s_.ndim == 3:  # [B, S, D/2]
+                    s_, c_ = s_[:, :, None, :], c_[:, :, None, :]
+                else:
+                    s_, c_ = s_[None, :, None, :], c_[None, :, None, :]
+            else:
                 s_, c_ = s_[None, :, None, :], c_[None, :, None, :]
         outs.append(run_op("fused_rope", lambda a, s=s_, c=c_: rope_one(a, s, c), t))
     return tuple(outs)
@@ -152,6 +175,12 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
     # qkv_weight: [3, num_heads, head_dim, H] (reference layout)
     n_heads = qkv_weight.shape[1]
     head_dim = qkv_weight.shape[2]
+    mask_val = None
+    if attn_mask is not None:
+        mask_val = attn_mask._value if isinstance(attn_mask, Tensor) \
+            else jnp.asarray(attn_mask)
+        while mask_val.ndim < 4:  # broadcast to [B, n_heads, S, S]
+            mask_val = mask_val[None]
 
     def mha(xa, wa, *rest):
         bias = rest[0] if len(rest) else None
@@ -161,7 +190,7 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
             qkv = qkv + bias.reshape(-1)
         qkv = qkv.reshape(B, S, 3, n_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        o = dot_product_attention(q, k, v, is_causal=False)
+        o = dot_product_attention(q, k, v, mask=mask_val, is_causal=False)
         return o.reshape(B, S, n_heads * head_dim)
 
     args = [x, qkv_weight] + ([qkv_bias] if qkv_bias is not None else [])

@@ -55,32 +55,30 @@ def _harmonize_device_sets(arrays):
     single-device operands onto the mesh (replicated) before mixing. No-op
     without a mesh or when all device sets already agree.
     """
-    from ..parallel.mesh import get_mesh, named_sharding
-    from jax.sharding import PartitionSpec
+    from jax.sharding import NamedSharding, PartitionSpec
 
-    mesh = get_mesh()
-    if mesh is None:
-        return arrays
-    n_mesh = mesh.size
-    if n_mesh == 1:
-        return arrays
-    on_mesh = False
-    off_mesh = False
+    # target = the mesh of the largest multi-device operand (TP/ZeRO param
+    # or dist tensor); operands on any *different* device set get replicated
+    # onto it (compare sets, not sizes: two disjoint 4-device meshes must
+    # harmonize too)
+    mesh = None
+    mesh_devs = None
     for a in arrays:
-        if _is_tracer(a) or not hasattr(a, "sharding"):
+        if _is_tracer(a):
             continue
-        if len(a.sharding.device_set) == n_mesh:
-            on_mesh = True
-        else:
-            off_mesh = True
-    if not (on_mesh and off_mesh):
+        sh = getattr(a, "sharding", None)
+        if isinstance(sh, NamedSharding) and len(sh.device_set) > 1 and (
+                mesh is None or len(sh.device_set) > len(mesh_devs)):
+            mesh = sh.mesh
+            mesh_devs = sh.device_set
+    if mesh is None:
         return arrays
     out = []
     for a in arrays:
         if not _is_tracer(a) and hasattr(a, "sharding") and \
-                len(a.sharding.device_set) != n_mesh:
+                a.sharding.device_set != mesh_devs:
             a = jax.device_put(
-                a, named_sharding(PartitionSpec(*([None] * a.ndim))))
+                a, NamedSharding(mesh, PartitionSpec(*([None] * a.ndim))))
         out.append(a)
     return out
 

@@ -1,0 +1,137 @@
+"""auto_parallel API tests (reference: test/auto_parallel/ — placement
+semantics, shard_tensor round trips, reshard transitions; SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+@pytest.fixture
+def mesh2x4():
+    return dist.ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+
+
+class TestPlacements:
+    def test_types(self):
+        assert dist.Shard(0).is_shard()
+        assert dist.Shard(1).is_shard(1)
+        assert not dist.Shard(1).is_shard(0)
+        assert dist.Replicate().is_replicate()
+        assert dist.Partial().is_partial()
+        assert dist.Shard(0) == dist.Shard(0)
+        assert dist.Shard(0) != dist.Shard(1)
+
+    def test_process_mesh(self, mesh2x4):
+        assert mesh2x4.shape == [2, 4]
+        assert mesh2x4.dim_names == ["x", "y"]
+        assert mesh2x4.process_ids == list(range(8))
+        assert mesh2x4.get_dim_size("y") == 4
+
+
+class TestShardTensor:
+    def test_shard_and_read_back(self, mesh2x4):
+        x = paddle.to_tensor(np.random.randn(8, 16).astype("float32"))
+        d = dist.shard_tensor(x, mesh2x4, [dist.Shard(0), dist.Shard(1)])
+        np.testing.assert_allclose(d.numpy(), x.numpy())
+        pls = dist.auto_parallel.to_placements(d._value, mesh2x4)
+        assert pls[0] == dist.Shard(0)
+        assert pls[1] == dist.Shard(1)
+
+    def test_replicate(self, mesh2x4):
+        x = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        d = dist.shard_tensor(x, mesh2x4, [dist.Replicate(), dist.Replicate()])
+        assert d._value.sharding.is_fully_replicated
+        pls = dist.auto_parallel.to_placements(d._value, mesh2x4)
+        assert all(p.is_replicate() for p in pls)
+
+    def test_reshard_transition(self, mesh2x4):
+        x = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+        d = dist.shard_tensor(x, mesh2x4, [dist.Shard(0), dist.Replicate()])
+        r = dist.reshard(d, mesh2x4, [dist.Replicate(), dist.Shard(1)])
+        np.testing.assert_allclose(r.numpy(), x.numpy())
+        pls = dist.auto_parallel.to_placements(r._value, mesh2x4)
+        assert pls[0].is_replicate() and pls[1] == dist.Shard(1)
+
+    def test_dtensor_from_fn(self, mesh2x4):
+        d = dist.dtensor_from_fn(paddle.ones, mesh2x4,
+                                 [dist.Shard(0), dist.Replicate()], [8, 4])
+        np.testing.assert_allclose(d.numpy(), np.ones((8, 4)))
+
+    def test_ops_on_dist_tensors(self, mesh2x4):
+        """GSPMD propagates shardings through ordinary ops (the reference's
+        per-op SPMD rules)."""
+        a = dist.shard_tensor(
+            paddle.to_tensor(np.random.randn(8, 16).astype("float32")),
+            mesh2x4, [dist.Shard(0), dist.Replicate()])
+        b = dist.shard_tensor(
+            paddle.to_tensor(np.random.randn(16, 4).astype("float32")),
+            mesh2x4, [dist.Replicate(), dist.Replicate()])
+        c = paddle.matmul(a, b)
+        np.testing.assert_allclose(
+            c.numpy(), a.numpy() @ b.numpy(), rtol=2e-5, atol=1e-5)
+
+    def test_backward_through_dist_tensor(self, mesh2x4):
+        a = dist.shard_tensor(
+            paddle.to_tensor(np.random.randn(8, 4).astype("float32"),
+                             stop_gradient=False),
+            mesh2x4, [dist.Shard(0), dist.Replicate()], stop_gradient=False)
+        loss = paddle.mean(a * a)
+        loss.backward()
+        assert a.grad is not None
+        np.testing.assert_allclose(a.grad.numpy(), 2 * a.numpy() / a.numpy().size,
+                                   rtol=1e-5)
+
+
+class TestShardLayer:
+    def test_shard_layer_places_params(self, mesh2x4):
+        layer = paddle.nn.Linear(16, 8)
+
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in sublayer.named_parameters(include_sublayers=False):
+                if p.ndim == 2:
+                    d = dist.shard_tensor(p, mesh,
+                                          [dist.Replicate(), dist.Shard(1)])
+                    p._inplace_set(d._value)
+
+        dist.shard_layer(layer, mesh2x4, shard_fn)
+        assert not layer.weight._value.sharding.is_fully_replicated
+        x = paddle.to_tensor(np.random.randn(4, 16).astype("float32"))
+        y = layer(x)
+        ref = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+        np.testing.assert_allclose(y.numpy(), ref, rtol=2e-5, atol=2e-5)
+
+
+class TestReviewRegressions:
+    def test_reshard_preserves_autograd(self, mesh2x4):
+        x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"),
+                             stop_gradient=False)
+        y = x * 2
+        r = dist.reshard(y, mesh2x4, [dist.Shard(0), dist.Replicate()])
+        loss = paddle.mean(r * r)
+        loss.backward()
+        assert x.grad is not None
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   8 * x.numpy() / x.numpy().size, rtol=1e-5)
+
+    def test_dense_tensor_dist_attrs_default_none(self):
+        t = paddle.to_tensor([1.0])
+        assert t.process_mesh is None
+        assert t.placements is None
+
+    def test_disjoint_mesh_harmonization(self):
+        m1 = dist.ProcessMesh(np.arange(4), dim_names=["x"])
+        m2 = dist.ProcessMesh(np.arange(4, 8), dim_names=["x"])
+        a = dist.shard_tensor(
+            paddle.to_tensor(np.ones((8, 2), dtype="float32")), m1, [dist.Shard(0)])
+        b = dist.shard_tensor(
+            paddle.to_tensor(np.ones((8, 2), dtype="float32")), m2, [dist.Shard(0)])
+        c = paddle.add(a, b)
+        np.testing.assert_allclose(c.numpy(), 2 * np.ones((8, 2)))
+
+    def test_dtensor_from_fn_sharded_output(self, mesh2x4):
+        d = dist.dtensor_from_fn(paddle.ones, mesh2x4,
+                                 [dist.Shard(0), dist.Replicate()], [8, 4])
+        assert not d._value.sharding.is_fully_replicated
+        np.testing.assert_allclose(d.numpy(), np.ones((8, 4)))

@@ -1,0 +1,165 @@
+"""Native runtime tests: C++ TCPStore, blob queue, launcher (reference test
+strategy SURVEY.md §4: all distributed plumbing exercisable on one host —
+loopback store, local process pods)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore, load_native
+
+
+class TestTCPStore:
+    def test_set_get_roundtrip(self):
+        s = TCPStore(is_master=True, world_size=1)
+        s.set("k", b"value-bytes")
+        assert s.get("k") == b"value-bytes"
+        s.close()
+
+    def test_add_counter(self):
+        s = TCPStore(is_master=True, world_size=1)
+        assert s.add("c", 5) == 5
+        assert s.add("c", 7) == 12
+        s.close()
+
+    def test_get_blocks_until_set(self):
+        s = TCPStore(is_master=True, world_size=1)
+        got = []
+
+        def waiter():
+            c = TCPStore(port=s.port, world_size=1)
+            got.append(c.get("late", timeout_ms=5000))
+            c.close()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+
+        time.sleep(0.3)
+        s.set("late", b"arrived")
+        t.join(timeout=10)
+        assert got == [b"arrived"]
+        s.close()
+
+    def test_wait_timeout(self):
+        s = TCPStore(is_master=True, world_size=1)
+        with pytest.raises(TimeoutError):
+            s.wait("never", timeout_ms=200)
+        s.close()
+
+    def test_barrier_three_ranks(self):
+        s = TCPStore(is_master=True, world_size=3)
+        passed = []
+
+        def rank(i):
+            c = TCPStore(port=s.port, world_size=3)
+            c.barrier("b", timeout_ms=5000)
+            passed.append(i)
+            c.close()
+
+        ts = [threading.Thread(target=rank, args=(i,)) for i in (1, 2)]
+        [t.start() for t in ts]
+        s.barrier("b", timeout_ms=5000)
+        [t.join(timeout=10) for t in ts]
+        assert sorted(passed) == [1, 2]
+        s.close()
+
+    def test_delete_and_num_keys(self):
+        s = TCPStore(is_master=True, world_size=1)
+        s.set("a", b"1")
+        s.set("b", b"2")
+        assert s.num_keys() == 2
+        assert s.delete_key("a")
+        assert s.num_keys() == 1
+        s.close()
+
+    def test_large_value(self):
+        s = TCPStore(is_master=True, world_size=1)
+        blob = os.urandom(1 << 20)  # 1 MiB > initial 64 KiB client buffer
+        s.set("big", blob)
+        assert s.get("big") == blob
+        s.close()
+
+
+class TestBlobQueue:
+    def test_push_pop_fifo(self):
+        import ctypes
+
+        lib = load_native()
+        q = lib.dl_queue_create(4)
+        for i in range(3):
+            data = f"batch{i}".encode()
+            assert lib.dl_queue_push(q, data, len(data), 1000) == 0
+        assert lib.dl_queue_size(q) == 3
+        for i in range(3):
+            buf = ctypes.create_string_buffer(64)
+            n = lib.dl_queue_pop(q, buf, 64, 1000)
+            assert buf.raw[:n] == f"batch{i}".encode()
+        lib.dl_queue_close(q)
+        lib.dl_queue_destroy(q)
+
+    def test_pop_timeout(self):
+        lib = load_native()
+        import ctypes
+
+        q = lib.dl_queue_create(2)
+        buf = ctypes.create_string_buffer(8)
+        assert lib.dl_queue_pop(q, buf, 8, 100) == -1  # timeout
+        lib.dl_queue_close(q)
+        assert lib.dl_queue_pop(q, buf, 8, 100) == -2  # closed+drained
+        lib.dl_queue_destroy(q)
+
+    def test_bounded_capacity_blocks_producer(self):
+        lib = load_native()
+        q = lib.dl_queue_create(1)
+        assert lib.dl_queue_push(q, b"x", 1, 100) == 0
+        assert lib.dl_queue_push(q, b"y", 1, 100) == -1  # full → timeout
+        lib.dl_queue_close(q)
+        lib.dl_queue_destroy(q)
+
+
+class TestLauncher:
+    def test_single_proc_launch_env_contract(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent("""
+            import os
+            print("RANK", os.environ["PADDLE_TRAINER_ID"],
+                  "WORLD", os.environ["PADDLE_TRAINERS_NUM"],
+                  "EP", os.environ["PADDLE_CURRENT_ENDPOINT"])
+        """))
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        rc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--log_dir", str(tmp_path / "log"), str(script)],
+            cwd="/root/repo", env=env, timeout=60)
+        assert rc.returncode == 0
+        log = (tmp_path / "log" / "workerlog.0").read_text()
+        assert "RANK 0 WORLD 1" in log
+
+    def test_elastic_restart_on_failure(self, tmp_path):
+        marker = tmp_path / "tries"
+        script = tmp_path / "flaky.py"
+        script.write_text(textwrap.dedent(f"""
+            import os, sys
+            p = {str(marker)!r}
+            n = int(open(p).read()) if os.path.exists(p) else 0
+            open(p, "w").write(str(n + 1))
+            sys.exit(1 if n == 0 else 0)  # fail first run, succeed second
+        """))
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        rc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--elastic_level", "1", "--max_restart", "2",
+             "--log_dir", str(tmp_path / "log"), str(script)],
+            cwd="/root/repo", env=env, timeout=60)
+        assert rc.returncode == 0
+        assert marker.read_text() == "2"
