@@ -1,0 +1,108 @@
+"""Distributed checkpoint: sharded save/load with reshard-on-load.
+
+Reference counterpart: ``python/paddle/distributed/checkpoint/``
+(SURVEY.md §2.2 "Distributed checkpoint", §5.4): every rank writes its shard
+of the (TP/PP/ZeRO-partitioned) state dict plus a metadata manifest; load
+reshards when the target mesh/strategy differs from the saved one — plus the
+Fleet offline merge tools.
+
+TPU-native mapping: **orbax-checkpoint is the engine** (already the standard
+for JAX sharded state): ``save_state_dict`` writes each array's global value
+from its distributed shards (OCDBT format, one logical manifest);
+``load_state_dict`` restores *into the shardings of the passed state dict*,
+so loading a checkpoint saved on one mesh into a model sharded over another
+IS the reshard-on-load path — no offline merge tooling needed, which is the
+point of keeping parameters logical in this framework.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["save_state_dict", "load_state_dict"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def _flatten(state_dict: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    flat = {}
+    for k, v in state_dict.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            flat.update(_flatten(v, key + "/"))
+        elif isinstance(v, Tensor):
+            flat[key] = v._value
+        elif v is not None and not isinstance(v, (str, bytes)):
+            try:
+                flat[key] = np.asarray(v)
+            except Exception:
+                pass
+    return flat
+
+
+def save_state_dict(state_dict: Dict[str, Any], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    unique_id=None, async_save: bool = False) -> None:
+    """Write ``state_dict`` (Tensors may be sharded over any mesh) to
+    ``path``. Signature follows the reference's
+    ``dist.save_state_dict(state_dict, path)``."""
+    flat = _flatten(state_dict)
+    path = os.path.abspath(path)
+    ckptr = _checkpointer()
+    ckptr.save(path, flat, force=True)
+    if not async_save:
+        ckptr.wait_until_finished()
+    ckptr.close()
+
+
+def load_state_dict(state_dict: Dict[str, Any], path: str,
+                    process_group=None, unique_id=None,
+                    offload: bool = False) -> None:
+    """Restore ``path`` into ``state_dict`` IN PLACE, resharding every array
+    to the sharding the corresponding target tensor currently has (the
+    reference's reshard-on-load across different meshes/strategies)."""
+    tensor_targets: Dict[str, Tensor] = {}
+    plain_targets: Dict[str, tuple] = {}  # key → (parent dict, dict key)
+    template: Dict[str, Any] = {}
+
+    def walk(d, prefix=""):
+        for k, v in d.items():
+            key = f"{prefix}{k}"
+            if isinstance(v, dict):
+                walk(v, key + "/")
+            elif isinstance(v, Tensor):
+                tensor_targets[key] = v
+                template[key] = jax.ShapeDtypeStruct(
+                    v._value.shape, v._value.dtype,
+                    sharding=getattr(v._value, "sharding", None))
+            elif v is not None and not isinstance(v, (str, bytes)):
+                try:
+                    template[key] = np.asarray(v)
+                    plain_targets[key] = (d, k)
+                except Exception:
+                    pass
+
+    walk(state_dict)
+    path = os.path.abspath(path)
+    ckptr = _checkpointer()
+    restored = ckptr.restore(path, template)
+    ckptr.close()
+    for k, t in tensor_targets.items():
+        t._inplace_set(restored[k])
+    for k, (parent, pk) in plain_targets.items():
+        val = restored[k]
+        orig = parent[pk]
+        if np.isscalar(orig) or (hasattr(orig, "ndim") and orig.ndim == 0):
+            val = np.asarray(val).reshape(()).item() if not hasattr(
+                orig, "dtype") else np.asarray(val, dtype=orig.dtype).reshape(())
+        parent[pk] = val

@@ -314,6 +314,7 @@ def save(layer, path, input_spec=None, **configs):
             with open(path + ".pdmodel", "wb") as f:
                 f.write(exported.serialize())
             meta["exported"] = True
+            meta["n_inputs"] = len(specs)
         except Exception as e:  # export is best-effort; weights always saved
             meta["exported"] = False
             meta["export_error"] = str(e)

@@ -1,0 +1,99 @@
+"""Distributed checkpoint (reshard-on-load) + inference Predictor tests
+(reference: SURVEY.md §5.4 checkpoint/resume, §3.6 AnalysisPredictor)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import checkpoint as dckpt
+from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
+
+
+class TestDistributedCheckpoint:
+    def test_roundtrip_dense(self, tmp_path):
+        lin = paddle.nn.Linear(8, 4)
+        sd = lin.state_dict()
+        dckpt.save_state_dict(sd, str(tmp_path / "ckpt"))
+        w_orig = lin.weight.numpy().copy()
+        lin.weight.set_value(np.zeros_like(w_orig))
+        dckpt.load_state_dict(lin.state_dict(), str(tmp_path / "ckpt"))
+        np.testing.assert_allclose(lin.weight.numpy(), w_orig)
+
+    def test_reshard_on_load(self, tmp_path):
+        """Save sharded over (dp=8), load into a model sharded over (mp=8):
+        the reference's cross-strategy reshard path."""
+        mesh = create_hybrid_mesh(dp=8)
+        try:
+            paddle.seed(77)
+            m1 = paddle.nn.Linear(16, 8)
+            d = dist.shard_tensor(
+                m1.weight,
+                dist.ProcessMesh(np.arange(8), dim_names=["z"]),
+                [dist.Shard(0)])
+            m1.weight._inplace_set(d._value)
+            w_orig = m1.weight.numpy().copy()
+            dckpt.save_state_dict(m1.state_dict(), str(tmp_path / "c2"))
+
+            paddle.seed(78)
+            m2 = paddle.nn.Linear(16, 8)
+            d2 = dist.shard_tensor(
+                m2.weight,
+                dist.ProcessMesh(np.arange(8), dim_names=["z"]),
+                [dist.Shard(1)])  # DIFFERENT placement than saved
+            m2.weight._inplace_set(d2._value)
+            dckpt.load_state_dict(m2.state_dict(), str(tmp_path / "c2"))
+            np.testing.assert_allclose(m2.weight.numpy(), w_orig)
+            # target sharding preserved (restored INTO Shard(1) layout)
+            pls = dist.auto_parallel.to_placements(
+                m2.weight._value,
+                dist.ProcessMesh(np.arange(8), dim_names=["z"]))
+            assert pls[0] == dist.Shard(1)
+        finally:
+            set_mesh(None)
+
+    def test_nested_state_dict(self, tmp_path):
+        opt_state = {"lr": np.float32(0.1),
+                     "m": {"w": paddle.to_tensor(np.ones((4,), "float32"))}}
+        dckpt.save_state_dict(opt_state, str(tmp_path / "c3"))
+        target = {"lr": np.float32(0.0),
+                  "m": {"w": paddle.to_tensor(np.zeros((4,), "float32"))}}
+        dckpt.load_state_dict(target, str(tmp_path / "c3"))
+        np.testing.assert_allclose(target["m"]["w"].numpy(), np.ones(4))
+
+
+class TestInference:
+    def test_predictor_end_to_end(self, tmp_path):
+        from paddle_tpu import inference as paddle_infer
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(5)
+        net = paddle.nn.Sequential(
+            paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+        prefix = str(tmp_path / "model")
+        paddle.jit.save(net, prefix,
+                        input_spec=[InputSpec([2, 8], "float32")])
+
+        config = paddle_infer.Config(prefix)
+        config.enable_use_gpu(100, 0)
+        predictor = paddle_infer.create_predictor(config)
+        names = predictor.get_input_names()
+        assert len(names) == 1
+        x = np.random.randn(2, 8).astype("float32")
+        predictor.get_input_handle(names[0]).copy_from_cpu(x)
+        assert predictor.run()
+        out = predictor.get_output_handle(
+            predictor.get_output_names()[0]).copy_to_cpu()
+        ref = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=1e-6)
+
+    def test_config_api_surface(self):
+        from paddle_tpu import inference as paddle_infer
+
+        c = paddle_infer.Config("some/prefix")
+        c.switch_ir_optim(True)
+        c.enable_memory_optim()
+        c.enable_tensorrt_engine(max_batch_size=4)
+        c.disable_gpu()
+        assert not c.use_gpu()
+        assert "some/prefix" in c.summary()
