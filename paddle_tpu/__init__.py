@@ -96,22 +96,23 @@ def is_compiled_with_xpu() -> bool:
 
 
 def in_dynamic_mode() -> bool:
-    """Eager (dygraph) mode is the default and only global mode; static-style
-    execution happens per-function via ``paddle_tpu.jit.to_static``."""
-    return True
+    """Eager (dygraph) mode is the default; ``enable_static()`` switches to
+    program-recording mode (see paddle_tpu/static/graph.py)."""
+    from .static.graph import in_static_mode
+
+    return not in_static_mode()
 
 
 def disable_static():
-    pass
+    from .static.graph import disable_static as _ds
+
+    _ds()
 
 
 def enable_static():
-    from .enforce import raise_unimplemented
+    from .static.graph import enable_static as _es
 
-    raise_unimplemented(
-        "Global static-graph mode (use @paddle_tpu.jit.to_static per function; "
-        "XLA jit is the graph engine)"
-    )
+    _es()
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,

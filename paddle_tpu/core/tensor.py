@@ -235,7 +235,16 @@ class Tensor:
         In-place ops on tensors that participate in an active autograd graph
         would corrupt saved VJP residuals, mirroring the reference's inplace
         version-counter check — so we forbid them on non-leaf tensors.
+
+        Static-graph hook: assigning a *symbolic* value (a recorded op's
+        output) onto an eager tensor — BN running-stat updates etc. — keeps
+        the eager value and schedules a replay-time write-back instead.
         """
+        from ..static.graph import _SymbolicValue, register_state_write
+
+        if isinstance(new_value, _SymbolicValue):
+            register_state_write(self, new_value)
+            return self
         if self._grad_node is not None:
             raise InvalidArgumentError(
                 f"In-place update on non-leaf tensor {self.name} would "

@@ -28,26 +28,64 @@ from ...core.tensor import Tensor
 __all__ = ["save_state_dict", "load_state_dict"]
 
 
-def _checkpointer():
+def _checkpointer(asynchronous: bool = False):
     import orbax.checkpoint as ocp
 
+    if asynchronous:
+        return ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
     return ocp.StandardCheckpointer()
 
 
-def _flatten(state_dict: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+def _items(container):
+    """Uniform (key, value) iteration over dicts and lists/tuples — list
+    entries get index keys, so per-param lists survive the round trip."""
+    if isinstance(container, dict):
+        return container.items()
+    return ((str(i), v) for i, v in enumerate(container))
+
+
+def _flatten(state_dict, prefix: str = "") -> Dict[str, Any]:
     flat = {}
-    for k, v in state_dict.items():
+    for k, v in _items(state_dict):
         key = f"{prefix}{k}"
-        if isinstance(v, dict):
+        if isinstance(v, (dict, list, tuple)):
             flat.update(_flatten(v, key + "/"))
         elif isinstance(v, Tensor):
             flat[key] = v._value
-        elif v is not None and not isinstance(v, (str, bytes)):
+        elif v is None or isinstance(v, (str, bytes)):
+            continue  # non-array metadata (e.g. scheduler type tags)
+        else:
             try:
-                flat[key] = np.asarray(v)
-            except Exception:
-                pass
+                arr = np.asarray(v)
+                if arr.dtype == object:
+                    raise TypeError(f"object dtype from {type(v).__name__}")
+            except Exception as e:
+                raise TypeError(
+                    f"state_dict entry '{key}' of type {type(v).__name__} is "
+                    "not checkpointable (expected Tensor/array/number or a "
+                    "dict/list of those)"
+                ) from e
+            flat[key] = arr
     return flat
+
+
+# async saves in flight: [(checkpointer, path)] — drained by
+# wait_async_save() or at interpreter exit (the reference's async
+# save handle/Future)
+_pending_async = []
+
+
+def wait_async_save() -> None:
+    """Block until all async_save=True checkpoints are durable."""
+    while _pending_async:
+        ckptr, _ = _pending_async.pop()
+        ckptr.wait_until_finished()
+        ckptr.close()
+
+
+import atexit as _atexit
+
+_atexit.register(wait_async_save)
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
@@ -55,13 +93,22 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
                     unique_id=None, async_save: bool = False) -> None:
     """Write ``state_dict`` (Tensors may be sharded over any mesh) to
     ``path``. Signature follows the reference's
-    ``dist.save_state_dict(state_dict, path)``."""
+    ``dist.save_state_dict(state_dict, path)``. With ``async_save=True`` the
+    write overlaps training (orbax AsyncCheckpointer); call
+    ``wait_async_save()`` (or rely on the atexit hook) before reading it
+    back."""
     flat = _flatten(state_dict)
     path = os.path.abspath(path)
+    if async_save:
+        import orbax.checkpoint as ocp
+
+        ckptr = _checkpointer(asynchronous=True)
+        ckptr.save(path, args=ocp.args.StandardSave(flat), force=True)
+        _pending_async.append((ckptr, path))
+        return
     ckptr = _checkpointer()
     ckptr.save(path, flat, force=True)
-    if not async_save:
-        ckptr.wait_until_finished()
+    ckptr.wait_until_finished()
     ckptr.close()
 
 
@@ -76,21 +123,26 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
     template: Dict[str, Any] = {}
 
     def walk(d, prefix=""):
-        for k, v in d.items():
+        for k, v in _items(d):
             key = f"{prefix}{k}"
-            if isinstance(v, dict):
+            if isinstance(v, (dict, list, tuple)):
                 walk(v, key + "/")
             elif isinstance(v, Tensor):
                 tensor_targets[key] = v
                 template[key] = jax.ShapeDtypeStruct(
                     v._value.shape, v._value.dtype,
                     sharding=getattr(v._value, "sharding", None))
-            elif v is not None and not isinstance(v, (str, bytes)):
+            elif v is None or isinstance(v, (str, bytes)):
+                continue
+            else:
                 try:
                     template[key] = np.asarray(v)
-                    plain_targets[key] = (d, k)
-                except Exception:
-                    pass
+                    plain_targets[key] = (d, k if isinstance(d, dict) else int(k))
+                except Exception as e:
+                    raise TypeError(
+                        f"state_dict entry '{key}' of type {type(v).__name__} "
+                        "is not checkpointable"
+                    ) from e
 
     walk(state_dict)
     path = os.path.abspath(path)

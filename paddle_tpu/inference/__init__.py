@@ -145,26 +145,34 @@ class Predictor:
                 return t
         raise KeyError(name)
 
+    def _ensure_output(self, i: int) -> "InferTensor":
+        while len(self._outputs) <= i:
+            self._outputs.append(InferTensor(f"output_{len(self._outputs)}"))
+        return self._outputs[i]
+
     def run(self) -> bool:
         args = [t._value for t in self._inputs]
         if any(a is None for a in args):
             raise RuntimeError("copy_from_cpu all inputs before run()")
         out = self._fn(*args)
         outs = out if isinstance(out, (tuple, list)) else [out]
-        self._outputs = []
+        # bind results onto PERSISTENT handles: deployment scripts grab
+        # output handles once (possibly before the first run) and re-read
+        # them after each run(), the paddle_infer pattern
         for i, o in enumerate(outs):
-            h = InferTensor(f"output_{i}")
+            h = self._ensure_output(i)
             h._value = np.asarray(o.numpy() if hasattr(o, "numpy") else o)
-            self._outputs.append(h)
         return True
 
     def get_output_names(self) -> List[str]:
-        return [t.name for t in self._outputs] or ["output_0"]
+        return [t.name for t in self._outputs] or [self._ensure_output(0).name]
 
     def get_output_handle(self, name: str) -> InferTensor:
         for t in self._outputs:
             if t.name == name:
                 return t
+        if name.startswith("output_") and name[7:].isdigit():
+            return self._ensure_output(int(name[7:]))
         raise KeyError(name)
 
 

@@ -487,8 +487,21 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     use_batch_stats = training and not use_global_stats
 
     from ...jit import is_tracing
+    from ...static.graph import is_symbolic
 
-    if use_batch_stats and not is_tracing():
+    if use_batch_stats and is_symbolic(x):
+        # static recording: stat updates become program ops whose outputs are
+        # written back onto the buffers at replay (the _inplace_set hook)
+        def stats_f(a, rm, rv):
+            return (
+                momentum * rm + (1 - momentum) * jnp.mean(a, axis=axes).astype(rm.dtype),
+                momentum * rv + (1 - momentum) * jnp.var(a, axis=axes).astype(rv.dtype),
+            )
+
+        new_m, new_v = run_op("bn_stats", stats_f, x, running_mean, running_var)
+        running_mean._inplace_set(new_m._value)
+        running_var._inplace_set(new_v._value)
+    elif use_batch_stats and not is_tracing():
         # update running stats (host-side in-place on the buffer tensors);
         # skipped under to_static tracing — tracers must not leak into buffers
         with_mean = jnp.mean(x._value, axis=axes)
@@ -499,13 +512,16 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     shape = [1] * x.ndim
     shape[channel_axis] = x.shape[channel_axis]
 
-    def f(a, *rest):
+    # running stats ride as op INPUTS (not closure constants) so static
+    # programs capture the buffers — eval-mode programs then see stats
+    # loaded/updated after the program was built
+    def f(a, rm, rv, *rest):
         i = 0
         if use_batch_stats:
             m = jnp.mean(a, axis=axes)
             v = jnp.var(a, axis=axes)
         else:
-            m, v = running_mean._value, running_var._value
+            m, v = rm, rv
         out = (a - m.reshape(shape)) / jnp.sqrt(v.reshape(shape) + epsilon)
         if weight is not None:
             out = out * rest[0].reshape(shape)
@@ -514,7 +530,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             out = out + rest[i].reshape(shape)
         return out
 
-    args = [x]
+    args = [x, running_mean, running_var]
     if weight is not None:
         args.append(weight)
     if bias is not None:
@@ -653,14 +669,20 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
     if axis is not None:
         axes = (axis,) if isinstance(axis, int) else tuple(axis)
         shape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
-    keep = jax.random.bernoulli(next_key(), 1.0 - p, shape)
+    # the key rides as a tensor INPUT (not a baked closure constant) so both
+    # static programs and to_static traces re-randomize per run: the Executor
+    # refreshes "rngkey*" captures before each replay
+    key_t = Tensor(jax.random.key_data(next_key()), stop_gradient=True,
+                   name="rngkey_dropout")
 
-    def f(a):
+    def f(a, kd):
+        keep = jax.random.bernoulli(jax.random.wrap_key_data(kd), 1.0 - p, shape)
         if mode == "upscale_in_train":
             return jnp.where(keep, a / (1.0 - p), 0.0)
         return jnp.where(keep, a, 0.0)
 
-    return run_op("dropout", f, x)
+    return run_op("dropout", f, x, key_t,
+                  static_attrs={"op_kind": "dropout", "p": p, "mode": mode})
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):

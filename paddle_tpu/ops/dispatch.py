@@ -88,6 +88,7 @@ def run_op(
     pure_fn: Callable,
     *tensors: Tensor,
     n_diff_outputs: Optional[int] = None,
+    static_attrs: Optional[dict] = None,
 ) -> Union[Tensor, Tuple[Tensor, ...]]:
     """Execute ``pure_fn(*arrays)`` over the inputs' values, with autograd.
 
@@ -95,7 +96,17 @@ def run_op(
     taking one array per entry in ``tensors`` (positionally) and returning an
     array or tuple of arrays. ``n_diff_outputs``: if set, only the first N
     outputs are differentiable (the rest are aux ints, e.g. argmax indices).
+
+    Static-graph hook: under ``paddle.enable_static()``, an op touching a
+    symbolic Variable is *recorded* into the default main program instead of
+    executed (the reference's OpDesc-appending; see static/graph.py).
     """
+    from ..static import graph as _sgraph
+
+    if _sgraph.recording_active(tensors):
+        return _sgraph.record(name, pure_fn, tensors, n_diff_outputs,
+                              attrs=static_attrs)
+
     arrays = [t._value for t in tensors]
     arrays = _harmonize_device_sets(arrays)
 

@@ -195,6 +195,25 @@ class Optimizer:
 
     @jax.named_scope("optimizer_minimize")
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static.graph import is_symbolic
+
+        if is_symbolic(loss):
+            # static mode: register the optimize spec on the loss's program —
+            # the Executor computes grads inside the compiled replay and this
+            # optimizer steps through its own donated-jit update (see
+            # static/executor.py)
+            prog = loss.block.program
+            if parameters:
+                params = [p for p in parameters if not p.stop_gradient]
+            elif self._parameter_list is not None:
+                params = self._params()
+            else:
+                params = [t for t in prog.captures.values() if not t.stop_gradient]
+            if self._parameter_list is None:
+                self._parameter_list = params
+            prog._optimize_spec = (self, loss, params)
+            prog._version += 1
+            return None, None
         loss.backward()
         self.step()
         return None, None

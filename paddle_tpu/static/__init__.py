@@ -1,10 +1,11 @@
-"""``paddle.static`` — minimal static-graph surface.
+"""``paddle.static`` — the static-graph surface.
 
-The reference's static graph engine (ProgramDesc + InterpreterCore,
-SURVEY.md §2.1) is replaced by XLA: ``paddle_tpu.jit.to_static`` compiles a
-whole traced function with ``jax.jit``. This module keeps the
-source-compatibility pieces that still make sense (``InputSpec``) and
-raises clearly for Program-construction APIs that do not.
+TPU-native counterpart of the reference's static mode
+(``python/paddle/static/`` over ProgramDesc + InterpreterCore; SURVEY.md §1
+L5b, §2.1). The IR is a recorded list of pure op closures (graph.py), the
+executor is XLA via one jitted replay (executor.py), and control flow lowers
+to ``lax.cond``/``lax.while_loop`` (control_flow.py). ``InputSpec`` doubles
+as the jit-tracing spec, as in the reference.
 """
 
 from __future__ import annotations
@@ -13,8 +14,64 @@ from typing import Any, List, Optional
 
 from ..core.dtype import convert_dtype
 from ..enforce import raise_unimplemented
+from . import nn  # noqa: F401
+from .executor import (
+    CompiledProgram,
+    Executor,
+    Scope,
+    append_backward,
+    global_scope,
+    gradients,
+    scope_guard,
+)
+from .graph import (
+    Block,
+    Program,
+    Variable,
+    data,
+    default_main_program,
+    default_startup_program,
+    enable_static,
+    disable_static,
+    in_static_mode,
+    program_guard,
+)
+from .io import (
+    load,
+    load_inference_model,
+    save,
+    save_inference_model,
+    load_program_state,
+    set_program_state,
+)
 
-__all__ = ["InputSpec"]
+__all__ = [
+    "InputSpec",
+    "data",
+    "Program",
+    "Block",
+    "Variable",
+    "program_guard",
+    "default_main_program",
+    "default_startup_program",
+    "Executor",
+    "Scope",
+    "global_scope",
+    "scope_guard",
+    "append_backward",
+    "gradients",
+    "CompiledProgram",
+    "save",
+    "load",
+    "save_inference_model",
+    "load_inference_model",
+    "load_program_state",
+    "set_program_state",
+    "nn",
+    "cpu_places",
+    "device_guard",
+    "name_scope",
+]
 
 
 class InputSpec:
@@ -32,8 +89,51 @@ class InputSpec:
         return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
 
 
-def __getattr__(name):
-    raise_unimplemented(
-        f"paddle.static.{name} (global static graph mode; use "
-        "paddle_tpu.jit.to_static — XLA is the graph engine)"
-    )
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+
+    import jax
+
+    n = device_count or len([d for d in jax.devices() if d.platform == "cpu"]) or 1
+    return [CPUPlace(i) for i in range(n)]
+
+
+class device_guard:
+    """No-op device scope (XLA places ops; kept for source compat)."""
+
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class ExecutionStrategy:
+    """Kept for source compat; XLA owns scheduling (reference: num_threads,
+    num_iteration_per_drop_scope — all moot under a compiled replay)."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class BuildStrategy:
+    """Kept for source compat; XLA does fusion/memory planning."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = True
+        self.enable_inplace = True

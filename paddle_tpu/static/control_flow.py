@@ -1,0 +1,193 @@
+"""Static control flow: ``cond`` / ``while_loop`` as recorded sub-programs.
+
+Reference: ``paddle/fluid/operators/controlflow/`` (``conditional_block``,
+``while`` ops driving sub-Blocks; SURVEY.md §2.1 Dy2Static row). TPU-native:
+each branch/body is recorded into a *sub-Program* whose replay closure is
+lowered to ``lax.cond`` / ``lax.while_loop`` inside ONE op node of the parent
+program — XLA's structured control flow instead of interpreter sub-blocks.
+Outer Variables referenced by a branch become free vars (extra operands of
+the node); eager tensors (parameters) ride as ordinary captures.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.tensor import Tensor
+from ..enforce import InvalidArgumentError
+from .graph import Program, Variable, default_main_program, is_symbolic, program_guard
+
+__all__ = ["static_cond", "static_while_loop"]
+
+
+def _flatten_outs(out) -> Tuple[List[Tensor], bool]:
+    if isinstance(out, Tensor):
+        return [out], True
+    if isinstance(out, (list, tuple)) and all(isinstance(o, Tensor) for o in out):
+        return list(out), False
+    raise InvalidArgumentError(
+        "control-flow branch must return a Tensor or flat list/tuple of Tensors"
+    )
+
+
+def _record_branch(fn: Callable, placeholders=None, args=()):
+    """Run ``fn`` with a fresh sub-Program as the recording target."""
+    sub = Program(parent=default_main_program())
+    with program_guard(sub, sub):
+        out = fn(*args) if placeholders is None else fn(*placeholders)
+    for node in sub.ops:
+        if node.state_writes:
+            raise InvalidArgumentError(
+                "in-place buffer updates (e.g. BatchNorm running stats) are "
+                "not supported inside static cond/while bodies"
+            )
+    return sub, out
+
+
+def _sub_replayer(sub: Program, out_tensors: Sequence[Tensor]):
+    """A pure function replaying the sub-program.
+
+    Signature: (free_vals, cap_vals, extra_env) -> list of output arrays,
+    where extra_env maps placeholder Variables to values (while-loop carries).
+    """
+    free_list = list(sub._free_vars.values())
+    cap_list = list(sub.captures.values())
+
+    def replay(free_vals, cap_vals, extra_env: Dict[int, jax.Array]):
+        from .executor import _SwapValues, _replay
+
+        with _SwapValues(cap_list, cap_vals):
+            env: Dict[int, Tensor] = {}
+            for v, val in zip(free_list, free_vals):
+                env[id(v)] = Tensor(val, stop_gradient=True, name=v.name)
+            for vid, val in extra_env.items():
+                env[vid] = Tensor(val, stop_gradient=True)
+            with autograd.no_grad():
+                _replay(sub, env)
+            outs = []
+            for t in out_tensors:
+                if is_symbolic(t):
+                    r = env.get(id(t))
+                    if r is None:
+                        raise InvalidArgumentError(
+                            f"branch output '{t.name}' was not computed by the branch"
+                        )
+                    outs.append(r._value)
+                else:
+                    # branch returned an eager tensor (constant w.r.t. branch)
+                    outs.append(t._value)
+        return outs
+
+    return free_list, cap_list, replay
+
+
+def static_cond(pred, true_fn, false_fn):
+    from ..ops.dispatch import run_op
+
+    sub_t, out_t = _record_branch(true_fn)
+    sub_f, out_f = _record_branch(false_fn)
+    flat_t, single_t = _flatten_outs(out_t)
+    flat_f, single_f = _flatten_outs(out_f)
+    if len(flat_t) != len(flat_f) or single_t != single_f:
+        raise InvalidArgumentError("cond branches must return the same structure")
+    for a, b in zip(flat_t, flat_f):
+        if tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype:
+            raise InvalidArgumentError(
+                f"cond branch output mismatch: {a.shape}:{a.dtype} vs "
+                f"{b.shape}:{b.dtype} (XLA requires identical branch signatures)"
+            )
+
+    free_t, caps_t, replay_t = _sub_replayer(sub_t, flat_t)
+    free_f, caps_f, replay_f = _sub_replayer(sub_f, flat_f)
+
+    operands = [pred] + free_t + caps_t + free_f + caps_f
+    n = [1, len(free_t), len(caps_t), len(free_f), len(caps_f)]
+    ofs = [sum(n[: i + 1]) for i in range(len(n))]
+
+    def pure(pred_v, *vals):
+        ft = list(vals[: ofs[1] - 1])
+        ct = list(vals[ofs[1] - 1 : ofs[2] - 1])
+        ff = list(vals[ofs[2] - 1 : ofs[3] - 1])
+        cf = list(vals[ofs[3] - 1 : ofs[4] - 1])
+        out = jax.lax.cond(
+            jnp.asarray(pred_v).reshape(()).astype(bool),
+            lambda: tuple(replay_t(ft, ct, {})),
+            lambda: tuple(replay_f(ff, cf, {})),
+        )
+        return out[0] if single_t else tuple(out)
+
+    return run_op("cond", pure, *operands)
+
+
+def static_while_loop(cond_fn, body, loop_vars):
+    from ..ops.dispatch import run_op
+
+    loop_vars = list(loop_vars)
+    if not all(isinstance(v, Tensor) for v in loop_vars):
+        raise InvalidArgumentError("while_loop loop_vars must be Tensors")
+
+    prog = default_main_program()
+
+    def make_placeholders(sub):
+        return [
+            sub.global_block().create_var(
+                tuple(v.shape), v.dtype, name=f"loop_var_{i}"
+            )
+            for i, v in enumerate(loop_vars)
+        ]
+
+    sub_c = Program(parent=prog)
+    with program_guard(sub_c, sub_c):
+        ph_c = make_placeholders(sub_c)
+        c_out = cond_fn(*ph_c)
+    if not is_symbolic(c_out):
+        raise InvalidArgumentError("while_loop condition must depend on loop_vars")
+
+    sub_b = Program(parent=prog)
+    with program_guard(sub_b, sub_b):
+        ph_b = make_placeholders(sub_b)
+        b_out = body(*ph_b)
+    flat_b, _ = _flatten_outs(b_out if isinstance(b_out, (list, tuple)) else [b_out])
+    if len(flat_b) != len(loop_vars):
+        raise InvalidArgumentError(
+            f"while_loop body returned {len(flat_b)} values for "
+            f"{len(loop_vars)} loop_vars"
+        )
+    for v, o in zip(loop_vars, flat_b):
+        if tuple(v.shape) != tuple(o.shape) or v.dtype != o.dtype:
+            raise InvalidArgumentError(
+                f"while_loop body output {o.shape}:{o.dtype} does not match "
+                f"loop var {v.shape}:{v.dtype} (XLA fixed-point signature)"
+            )
+
+    free_c, caps_c, replay_c = _sub_replayer(sub_c, [c_out])
+    free_b, caps_b, replay_b = _sub_replayer(sub_b, flat_b)
+
+    operands = list(loop_vars) + free_c + caps_c + free_b + caps_b
+    n_loop = len(loop_vars)
+    n_fc, n_cc, n_fb = len(free_c), len(caps_c), len(free_b)
+    ph_c_ids = [id(p) for p in ph_c]
+    ph_b_ids = [id(p) for p in ph_b]
+
+    def pure(*vals):
+        carry0 = tuple(vals[:n_loop])
+        fc = list(vals[n_loop : n_loop + n_fc])
+        cc = list(vals[n_loop + n_fc : n_loop + n_fc + n_cc])
+        fb = list(vals[n_loop + n_fc + n_cc : n_loop + n_fc + n_cc + n_fb])
+        cb = list(vals[n_loop + n_fc + n_cc + n_fb :])
+
+        def cond_fun(carry):
+            (c,) = replay_c(fc, cc, dict(zip(ph_c_ids, carry)))
+            return jnp.asarray(c).reshape(()).astype(bool)
+
+        def body_fun(carry):
+            return tuple(replay_b(fb, cb, dict(zip(ph_b_ids, carry))))
+
+        return jax.lax.while_loop(cond_fun, body_fun, carry0)
+
+    out = run_op("while_loop", pure, *operands)
+    return list(out) if isinstance(out, tuple) else [out]

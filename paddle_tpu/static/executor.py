@@ -1,0 +1,450 @@
+"""Static-graph execution: ``Executor``, ``Scope``, ``append_backward``.
+
+TPU-native counterpart of the reference's ``StandaloneExecutor``/
+``InterpreterCore`` (``paddle/fluid/framework/new_executor/``, SURVEY.md §2.1)
+plus the ``append_backward`` half of ``paddle.static``. The reference's
+executor builds an instruction list on the first run and replays it with its
+own dependency/stream scheduling; here the recorded op list is replayed ONCE
+inside a traced function and handed to XLA, which owns scheduling, fusion,
+memory planning and async dispatch. Donated state buffers give the in-place
+parameter/buffer update semantics of a ``Scope``.
+
+Execution shape per run:
+  fetches, grads, new_state = jit(replay)(state, feeds)
+where ``state`` is the program's captured eager tensors (parameters, BN
+buffers, RNG key feeds). Backward is the same eager tape the dygraph engine
+uses — replay runs ``run_op`` per node, so ``loss.backward()`` inside the
+trace yields the compiled backward; the optimizer then steps OUTSIDE this
+program through its own donated-jit fused update (two XLA programs per step,
+like the reference's separate compute/optimizer instruction streams).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core.tensor import Tensor
+from ..enforce import InvalidArgumentError
+from . import graph
+from .graph import Program, Variable, default_main_program, is_symbolic
+
+__all__ = [
+    "Executor",
+    "Scope",
+    "global_scope",
+    "scope_guard",
+    "append_backward",
+    "gradients",
+    "CompiledProgram",
+]
+
+
+# ---------------------------------------------------------------------------
+# Scope (name -> tensor view; reference: paddle/fluid/framework/scope.h)
+# ---------------------------------------------------------------------------
+
+class _ScopeTensor:
+    """LoDTensor-shaped view over a live framework tensor."""
+
+    def __init__(self, tensor: Tensor):
+        self._t = tensor
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._t._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def shape(self):
+        return list(self._t.shape)
+
+    def set(self, value, place=None):
+        self._t._inplace_set(jnp.asarray(value, self._t._value.dtype))
+
+
+class _ScopeVar:
+    def __init__(self, tensor: Tensor):
+        self._t = tensor
+
+    def get_tensor(self) -> _ScopeTensor:
+        return _ScopeTensor(self._t)
+
+
+class Scope:
+    def __init__(self):
+        self._vars: Dict[str, Tensor] = {}
+
+    def var(self, name: str) -> _ScopeVar:
+        t = self._vars.get(name)
+        if t is None:
+            raise InvalidArgumentError(f"Scope has no variable '{name}'")
+        return _ScopeVar(t)
+
+    def find_var(self, name: str) -> Optional[_ScopeVar]:
+        t = self._vars.get(name)
+        return _ScopeVar(t) if t is not None else None
+
+    def _bind(self, name: str, tensor: Tensor):
+        self._vars[name] = tensor
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    def __init__(self, scope: Scope):
+        self._scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# backward wiring
+# ---------------------------------------------------------------------------
+
+class _GradVar:
+    """Fetchable handle for a gradient (the ``w@GRAD`` var analog)."""
+
+    def __init__(self, name: str, target):
+        self.name = name
+        self.target = target  # capture Tensor or data Variable
+
+    def __repr__(self):
+        return f"GradVar({self.name})"
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Register backward on the loss's program; returns [(param, grad_var)].
+
+    The actual gradient computation happens inside the Executor's single
+    compiled replay (jax VJP over the whole program), not as separately
+    appended ops — this is the XLA-native reading of the reference's
+    backward-op appending.
+    """
+    if not is_symbolic(loss):
+        raise InvalidArgumentError("append_backward expects a static Variable loss")
+    prog = loss.block.program
+    if parameter_list is None:
+        params = [t for t in prog.captures.values() if not t.stop_gradient]
+    else:
+        params = [p for p in parameter_list if not p.stop_gradient]
+    prog._grad_spec = (loss, list(params))
+    out = []
+    for p in params:
+        gv = _GradVar(f"{p.name}@GRAD", p)
+        prog._grad_names[gv.name] = gv
+        out.append((p, gv))
+    prog._version += 1
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Static ``paddle.static.gradients``: d(sum(targets))/d(inputs)."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if len(targets) != 1:
+        raise InvalidArgumentError("gradients: exactly one target supported")
+    loss = targets[0]
+    prog = loss.block.program
+    existing = prog._grad_spec[1] if prog._grad_spec else []
+    prog._grad_spec = (loss, list(dict.fromkeys(list(existing) + list(inputs), None)))
+    out = []
+    for x in inputs:
+        gv = _GradVar(f"{x.name}@GRAD", x)
+        prog._grad_names[gv.name] = gv
+        out.append(gv)
+    prog._version += 1
+    return out
+
+
+class CompiledProgram:
+    """Alias wrapper (reference CompiledProgram; XLA does all build strategy)."""
+
+    def __init__(self, program: Program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+class _SwapValues:
+    def __init__(self, tensors: Sequence[Tensor], values):
+        self.tensors = list(tensors)
+        self.values = list(values)
+
+    def __enter__(self):
+        self.saved = [(t._value, t.grad) for t in self.tensors]
+        for t, v in zip(self.tensors, self.values):
+            t._value = v
+            t.grad = None
+
+    def __exit__(self, *exc):
+        for t, (v, g) in zip(self.tensors, self.saved):
+            t._value = v
+            t.grad = g
+        return False
+
+
+def prune_ops(prog: Program, fetch_vars, keep_state_writes: bool = True):
+    """Backward-reachability pruning (the reference's ``Program._prune``):
+    keep only ops whose outputs feed the fetches (or buffer write-backs)."""
+    needed = {id(v) for v in fetch_vars if isinstance(v, Variable)}
+    keep = []
+    for node in reversed(prog.ops):
+        if any(id(ov) in needed for ov in node.outputs) or (
+            keep_state_writes and node.state_writes
+        ):
+            keep.append(node)
+            for k, r in node.inputs:
+                if k == "v":
+                    needed.add(id(r))
+    return list(reversed(keep))
+
+
+def _replay(prog: Program, env: Dict[int, Tensor], ops=None,
+            apply_state_writes: bool = True):
+    """Execute the recorded op list over live values (tracers under jit)."""
+    from ..ops.dispatch import run_op
+
+    for node in (prog.ops if ops is None else ops):
+        ins = []
+        for kind, ref in node.inputs:
+            if kind == "v":
+                t = env.get(id(ref))
+                if t is None:
+                    raise InvalidArgumentError(
+                        f"Variable '{ref.name}' used before definition — "
+                        "missing from feed?"
+                    )
+                ins.append(t)
+            else:
+                ins.append(ref)
+        outs = run_op(node.name, node.pure_fn, *ins, n_diff_outputs=node.n_diff_outputs)
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        for var, o in zip(node.outputs, outs):
+            env[id(var)] = o
+        if apply_state_writes:
+            for target, var in node.state_writes:
+                # raw rebind (not _inplace_set): the write-back value may
+                # carry a grad node; buffers are leaves so the tape stays
+                # consistent
+                target._value = env[id(var)]._value
+    return env
+
+
+def _resolve_grad(env, target, grad_map):
+    g = grad_map.get(id(target))
+    if g is not None:
+        return g
+    base = target if not isinstance(target, Variable) else env.get(id(target))
+    shape = tuple(target.shape)
+    return jnp.zeros(shape, target._value.dtype if base is None else base._value.dtype)
+
+
+class Executor:
+    """Compiles + runs programs; caches one XLA executable per
+    (program version, feed signature, fetch set)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Any, Any] = {}
+
+    def close(self):
+        self._cache.clear()
+
+    # -- fetch resolution ---------------------------------------------------
+    def _resolve_fetches(self, prog: Program, fetch_list):
+        resolved = []
+        for f in fetch_list or []:
+            if isinstance(f, _GradVar):
+                resolved.append(("grad", f.target))
+            elif isinstance(f, Variable):
+                resolved.append(("var", f))
+            elif isinstance(f, Tensor):  # capture (e.g. a parameter)
+                resolved.append(("cap", f))
+            elif isinstance(f, str):
+                if f in prog._grad_names:
+                    resolved.append(("grad", prog._grad_names[f].target))
+                elif prog.global_block().has_var(f):
+                    resolved.append(("var", prog.global_block().var(f)))
+                else:
+                    cap = next(
+                        (t for t in prog.captures.values() if t.name == f), None
+                    )
+                    if cap is None:
+                        raise InvalidArgumentError(f"fetch '{f}' not found in program")
+                    resolved.append(("cap", cap))
+            else:
+                raise InvalidArgumentError(f"Cannot fetch {type(f).__name__}")
+        return resolved
+
+    # -- compilation --------------------------------------------------------
+    def _build(self, prog: Program, feed_vars, fetches, grad_targets, loss_var):
+        cap_list = list(prog.captures.values())
+
+        def pure(cap_vals, feed_vals):
+            with _SwapValues(cap_list, cap_vals):
+                env: Dict[int, Tensor] = {}
+                grad_data = [t for t in grad_targets if isinstance(t, Variable)]
+                for v, val in zip(feed_vars, feed_vals):
+                    env[id(v)] = Tensor(
+                        val,
+                        stop_gradient=not any(g is v for g in grad_data),
+                        name=v.name,
+                    )
+                _replay(prog, env)
+                grad_map: Dict[int, Any] = {}
+                if grad_targets:
+                    loss_t = env[id(loss_var)]
+                    autograd.backward([loss_t], [None])
+                    for tgt in grad_targets:
+                        holder = env.get(id(tgt)) if isinstance(tgt, Variable) else tgt
+                        if holder is not None and holder.grad is not None:
+                            grad_map[id(tgt)] = holder.grad._value
+                fetch_out = []
+                for kind, ref in fetches:
+                    if kind == "var":
+                        t = env.get(id(ref))
+                        if t is None:
+                            raise InvalidArgumentError(
+                                f"fetch target '{ref.name}' was never computed"
+                            )
+                        fetch_out.append(t._value)
+                    elif kind == "cap":
+                        fetch_out.append(ref._value)
+                    else:
+                        fetch_out.append(_resolve_grad(env, ref, grad_map))
+                grad_out = [_resolve_grad(env, t, grad_map) for t in grad_targets]
+                state_out = [t._value for t in cap_list]
+            return fetch_out, grad_out, state_out
+
+        return jax.jit(pure, donate_argnums=(0,))
+
+    # -- run ----------------------------------------------------------------
+    def run(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list=None,
+        feed_var_name="feed",
+        fetch_var_name="fetch",
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        use_prune: bool = False,
+    ):
+        if isinstance(program, CompiledProgram):
+            program = program._program
+        prog = program if program is not None else default_main_program()
+        feed = feed or {}
+        scope = scope or global_scope()
+
+        if not prog.ops:  # startup programs: parameters are already eager
+            for t in prog.captures.values():
+                scope._bind(t.name, t)
+            return []
+
+        # feed resolution (sorted for a stable cache signature)
+        feed_vars, feed_vals = [], []
+        for name in sorted(feed):
+            if name not in prog._data_vars:
+                raise InvalidArgumentError(
+                    f"feed '{name}' is not a static.data of this program "
+                    f"(declared: {sorted(prog._data_vars)})"
+                )
+            v = prog._data_vars[name]
+            raw = feed[name]
+            val = raw._value if isinstance(raw, Tensor) else jnp.asarray(raw)
+            if val.dtype != v.dtype:
+                val = val.astype(v.dtype)
+            if tuple(val.shape) != tuple(v.shape):
+                raise InvalidArgumentError(
+                    f"feed '{name}' shape {tuple(val.shape)} != declared "
+                    f"{tuple(v.shape)} (XLA static shapes: declare the shape "
+                    "you feed, or build one program per shape)"
+                )
+            feed_vars.append(v)
+            feed_vals.append(val)
+        missing = [n for n in prog._data_vars if n not in feed]
+        if missing:
+            used = {
+                id(r)
+                for node in prog.ops
+                for k, r in node.inputs
+                if k == "v"
+            }
+            really = [n for n in missing if id(prog._data_vars[n]) in used]
+            if really:
+                raise InvalidArgumentError(f"missing feeds: {really}")
+
+        # refresh RNG-key captures so dropout etc. re-randomize per run
+        from ..framework.random import next_key
+
+        for t in prog.captures.values():
+            if t.name.startswith("rngkey"):
+                t._inplace_set(jax.random.key_data(next_key()))
+
+        fetches = self._resolve_fetches(prog, fetch_list)
+
+        opt_spec = prog._optimize_spec
+        grad_targets: List[Any] = []
+        loss_var = None
+        if opt_spec is not None:
+            optimizer, loss_var, params = opt_spec
+            grad_targets = list(params)
+        if prog._grad_spec is not None:
+            gl, gtargets = prog._grad_spec
+            if loss_var is not None and gl is not loss_var:
+                raise InvalidArgumentError(
+                    "append_backward loss differs from minimize loss"
+                )
+            loss_var = gl
+            for t in gtargets:
+                if not any(t is g for g in grad_targets):
+                    grad_targets.append(t)
+
+        key = (
+            id(prog),
+            prog._version,
+            tuple((v.name, tuple(val.shape), str(val.dtype)) for v, val in zip(feed_vars, feed_vals)),
+            tuple((k, id(r)) for k, r in fetches),
+        )
+        jitted = self._cache.get(key)
+        if jitted is None:
+            jitted = self._build(prog, feed_vars, fetches, grad_targets, loss_var)
+            self._cache[key] = jitted
+
+        cap_list = list(prog.captures.values())
+        cap_vals = [t._value for t in cap_list]
+        fetch_vals, grad_vals, state_vals = jitted(cap_vals, feed_vals)
+
+        for t, v in zip(cap_list, state_vals):
+            t._value = v
+            scope._bind(t.name, t)
+
+        if opt_spec is not None:
+            optimizer, _, params = opt_spec
+            gmap = {id(t): gv for t, gv in zip(grad_targets, grad_vals)}
+            for p in params:
+                p.grad = Tensor(gmap[id(p)], stop_gradient=True)
+            optimizer.step()
+            optimizer.clear_grad()
+
+        if return_numpy:
+            return [np.asarray(v) for v in fetch_vals]
+        return [Tensor(v, stop_gradient=True) for v in fetch_vals]

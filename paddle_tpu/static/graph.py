@@ -1,0 +1,404 @@
+"""Static graph core: ``Program``/``Block``/``Variable`` + implicit op recording.
+
+TPU-native counterpart of the reference's ProgramDesc/BlockDesc/OpDesc layer
+(``paddle/fluid/framework/``, SURVEY.md §2.1 "Static framework") and of the
+op-recording half of ``paddle.enable_static()``. The reference serializes ops
+into protobuf and interprets them with InterpreterCore; here the IR is a list
+of recorded *pure closures* (one per dispatched op) whose shapes were inferred
+at record time with ``jax.eval_shape`` (the InferMeta analog), and the
+"interpreter" is XLA: the Executor replays the list once under ``jax.jit`` so
+the whole program — forward, backward and state updates — compiles to a single
+fused TPU executable (see ``executor.py``).
+
+Recording model ("symbolic contagion"): ``static.data`` mints symbolic
+``Variable``s; any op dispatched through ``run_op`` with at least one symbolic
+input is appended to the default main program instead of executing. Ops over
+purely-eager tensors (parameter initialization, optimizer math) still execute
+eagerly — eager tensors touched by recorded ops are interned as *captures*
+(the program's state inputs), which is how parameters enter the program, like
+the reference's persistable vars in a ``Scope``.
+
+XLA requires static shapes, so ``data`` rejects dynamic (None/-1) dims —
+batch-size polymorphism is per-shape program specialization (the Executor
+caches one XLA program per feed signature), the TPU idiom.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from ..enforce import InvalidArgumentError
+
+__all__ = [
+    "Variable",
+    "Program",
+    "Block",
+    "data",
+    "default_main_program",
+    "default_startup_program",
+    "program_guard",
+    "enable_static",
+    "disable_static",
+    "in_static_mode",
+    "is_symbolic",
+]
+
+
+class _SymbolicValue:
+    """Stand-in for a ``jax.Array`` on un-executed ``Variable``s: carries only
+    shape/dtype (the TensorMeta), enough for the Tensor wrapper's metadata
+    properties and for ``jax.eval_shape`` at record time."""
+
+    __slots__ = ("shape", "dtype", "var_name")
+
+    def __init__(self, shape, dtype, var_name=""):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = jnp.dtype(dtype)
+        self.var_name = var_name
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def aval(self):
+        return jax.ShapeDtypeStruct(self.shape, self.dtype)
+
+    def item(self):
+        raise InvalidArgumentError(
+            f"Variable '{self.var_name}' has no value at graph-build time; "
+            "run it with Executor.run(feed=..., fetch_list=[...])."
+        )
+
+    def __array__(self, dtype=None):
+        self.item()
+
+    def __repr__(self):
+        return f"symbolic[{self.dtype.name}{list(self.shape)}]"
+
+
+def is_symbolic(t) -> bool:
+    return isinstance(getattr(t, "_value", None), _SymbolicValue)
+
+
+class Variable(Tensor):
+    """A symbolic tensor inside a ``Program`` (the ``VarDesc`` analog)."""
+
+    __slots__ = ("block", "producer", "is_data")
+
+    def __init__(self, shape, dtype, name, block, stop_gradient=True):
+        super().__init__(
+            _SymbolicValue(shape, dtype, name), stop_gradient=stop_gradient, name=name
+        )
+        self.block = block
+        self.producer = None  # OpNode that outputs this var (None for data)
+        self.is_data = False
+
+    def numpy(self):
+        self._value.item()
+
+    def __repr__(self):
+        return (
+            f"Variable(name={self.name}, shape={self.shape}, "
+            f"dtype={self.dtype.name}, stop_gradient={self.stop_gradient})"
+        )
+
+
+class OpNode:
+    """One recorded op (the ``OpDesc`` analog): a pure closure plus the
+    dataflow wiring. ``inputs`` entries are ``("v", Variable)`` for symbolic
+    operands or ``("c", Tensor)`` for captured eager state."""
+
+    __slots__ = ("name", "pure_fn", "inputs", "outputs", "n_diff_outputs",
+                 "state_writes", "attrs")
+
+    def __init__(self, name, pure_fn, inputs, outputs, n_diff_outputs, attrs=None):
+        self.name = name
+        self.pure_fn = pure_fn
+        self.inputs = inputs
+        self.outputs = outputs
+        self.n_diff_outputs = n_diff_outputs
+        self.attrs = attrs  # op metadata for program passes (e.g. op_kind)
+        # [(eager_tensor, out_var)]: buffer writes (e.g. BN running stats)
+        # applied right after this op during replay
+        self.state_writes: List[Tuple[Tensor, Variable]] = []
+
+    def __repr__(self):
+        ins = ", ".join(
+            (r.name if k == "v" else f"@{r.name}") for k, r in self.inputs
+        )
+        outs = ", ".join(v.name for v in self.outputs)
+        return f"{{{outs}}} = {self.name}({ins})"
+
+
+class Block:
+    """Op/var container (the ``BlockDesc`` analog; one global block — nested
+    control flow lowers to ``lax.cond``/``lax.while_loop`` closures inside a
+    single op node rather than sub-blocks, the XLA idiom)."""
+
+    def __init__(self, program: "Program", idx: int = 0):
+        self.program = program
+        self.idx = idx
+        self.ops: List[OpNode] = []
+        self.vars: Dict[str, Variable] = {}
+
+    def var(self, name: str) -> Variable:
+        v = self.vars.get(name)
+        if v is None:
+            raise InvalidArgumentError(f"Variable '{name}' not found in block {self.idx}")
+        return v
+
+    def has_var(self, name: str) -> bool:
+        return name in self.vars
+
+    def all_parameters(self) -> List[Tensor]:
+        return [t for t in self.program.captures.values() if not t.stop_gradient]
+
+    def create_var(self, shape, dtype, name=None, stop_gradient=True) -> Variable:
+        name = name or f"_generated_var_{len(self.vars)}"
+        v = Variable(shape, dtype, name, self, stop_gradient=stop_gradient)
+        self.vars[name] = v
+        return v
+
+
+class Program:
+    """A recorded computation (the ``ProgramDesc`` analog)."""
+
+    def __init__(self, parent: Optional["Program"] = None):
+        self.blocks = [Block(self, 0)]
+        self.captures: Dict[int, Tensor] = {}  # id(tensor) -> live eager tensor
+        self._data_vars: Dict[str, Variable] = {}
+        self._version = 0
+        self._optimize_spec = None  # (optimizer, loss_var, params)
+        self._grad_spec = None  # (loss_var, targets)
+        self._grad_names: Dict[str, Any] = {}  # "w@GRAD" -> capture/Variable
+        self.random_seed = None
+        # sub-program support (control-flow branches): outer Variables used
+        # inside become free vars = extra operands of the lax.cond/while node
+        self._parent = parent
+        self._free_vars: Dict[int, Variable] = {}
+
+    # -- structure ----------------------------------------------------------
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.blocks)
+
+    @property
+    def ops(self) -> List[OpNode]:
+        return self.global_block().ops
+
+    def list_vars(self):
+        return list(self.global_block().vars.values())
+
+    def all_parameters(self):
+        return self.global_block().all_parameters()
+
+    # -- recording ----------------------------------------------------------
+    def _intern_capture(self, t: Tensor) -> Tensor:
+        if id(t) not in self.captures:
+            self.captures[id(t)] = t
+        return t
+
+    def _append(self, node: OpNode):
+        self.global_block().ops.append(node)
+        self._version += 1
+
+    def clone(self, for_test: bool = False) -> "Program":
+        """Share the op list; a test clone drops optimizer/backward wiring.
+
+        (BN/dropout train-vs-eval behavior is baked into the recorded
+        closures — record the eval program under ``layer.eval()`` instead of
+        cloning when that matters, as the shapes/branches differ.)
+        """
+        p = Program.__new__(Program)
+        p.blocks = self.blocks
+        p.captures = self.captures
+        p._data_vars = self._data_vars
+        p._version = self._version
+        p.random_seed = self.random_seed
+        p._grad_names = {} if for_test else dict(self._grad_names)
+        p._optimize_spec = None if for_test else self._optimize_spec
+        p._grad_spec = None if for_test else self._grad_spec
+        p._parent = self._parent
+        p._free_vars = self._free_vars
+        return p
+
+    def to_string(self, throw_on_error=True, with_details=False) -> str:
+        lines = [f"Program(version={self._version})"]
+        lines += [f"  data: {v.name}{v.shape}:{v.dtype.name}" for v in self._data_vars.values()]
+        lines += [
+            f"  capture: {t.name}{t.shape}:{t.dtype.name}"
+            + (" (trainable)" if not t.stop_gradient else "")
+            for t in self.captures.values()
+        ]
+        lines += [f"  {op!r}" for op in self.ops]
+        if self._optimize_spec:
+            opt, loss, params = self._optimize_spec
+            lines.append(
+                f"  optimize: {type(opt).__name__} on {loss.name} "
+                f"over {len(params)} params"
+            )
+        return "\n".join(lines)
+
+    __str__ = to_string
+
+    def __repr__(self):
+        return f"<Program ops={len(self.ops)} captures={len(self.captures)}>"
+
+
+# ---------------------------------------------------------------------------
+# global mode + default programs (the reference's framework globals)
+# ---------------------------------------------------------------------------
+
+_static_mode = [False]
+_default_main = [Program()]
+_default_startup = [Program()]
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def in_static_mode() -> bool:
+    return _static_mode[0]
+
+
+def default_main_program() -> Program:
+    return _default_main[0]
+
+
+def default_startup_program() -> Program:
+    return _default_startup[0]
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    prev_m, prev_s = _default_main[0], _default_startup[0]
+    _default_main[0] = main_program
+    if startup_program is not None:
+        _default_startup[0] = startup_program
+    try:
+        yield
+    finally:
+        _default_main[0] = prev_m
+        _default_startup[0] = prev_s
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Variable:
+    """Declare a feed slot (reference: ``paddle.static.data``)."""
+    shape = list(shape)
+    for i, s in enumerate(shape):
+        if s is None or (isinstance(s, int) and s < 0):
+            raise InvalidArgumentError(
+                f"static.data('{name}') dim {i} is dynamic ({s}). XLA compiles "
+                "static shapes: declare the concrete size — the Executor "
+                "specializes (and caches) one program per feed shape, so "
+                "varying batch sizes still work by rebuilding the feed var."
+            )
+    prog = default_main_program()
+    blk = prog.global_block()
+    if name in blk.vars:
+        raise InvalidArgumentError(f"static.data name '{name}' already declared")
+    v = Variable(shape, convert_dtype(dtype), name, blk, stop_gradient=True)
+    v.is_data = True
+    blk.vars[name] = v
+    prog._data_vars[name] = v
+    return v
+
+
+# ---------------------------------------------------------------------------
+# the run_op hook
+# ---------------------------------------------------------------------------
+
+def recording_active(tensors: Sequence[Tensor]) -> bool:
+    return _static_mode[0] and any(is_symbolic(t) for t in tensors)
+
+
+def record(
+    name: str,
+    pure_fn: Callable,
+    tensors: Sequence[Tensor],
+    n_diff_outputs: Optional[int],
+    attrs: Optional[dict] = None,
+):
+    """Append one op to the default main program; outputs are fresh symbolic
+    Variables shaped by ``jax.eval_shape`` (InferMeta)."""
+    prog = default_main_program()
+    blk = prog.global_block()
+
+    inputs = []
+    avals = []
+    for t in tensors:
+        if is_symbolic(t):
+            if isinstance(t, Variable) and t.block.program is not prog:
+                owner = t.block.program
+                q = prog
+                while q is not None and q is not owner:
+                    q = q._parent
+                if q is None:
+                    raise InvalidArgumentError(
+                        f"Variable '{t.name}' belongs to a different Program "
+                        "than the current default main program (check "
+                        "program_guard nesting)."
+                    )
+                prog._free_vars.setdefault(id(t), t)
+            inputs.append(("v", t))
+            avals.append(t._value.aval)
+        else:
+            prog._intern_capture(t)
+            inputs.append(("c", t))
+            avals.append(jax.ShapeDtypeStruct(tuple(t._value.shape), t._value.dtype))
+
+    out_shapes = jax.eval_shape(pure_fn, *avals)
+    single = not isinstance(out_shapes, (tuple, list))
+    outs_meta = (out_shapes,) if single else tuple(out_shapes)
+
+    any_diff = any(not t.stop_gradient for t in tensors)
+    n_diff = len(outs_meta) if n_diff_outputs is None else n_diff_outputs
+    node = OpNode(name, pure_fn, inputs, [], n_diff_outputs, attrs=attrs)
+    out_vars = []
+    for i, m in enumerate(outs_meta):
+        v = blk.create_var(
+            m.shape, m.dtype,
+            name=f"{name}_{prog._version}.out{i}",
+            stop_gradient=not (any_diff and i < n_diff),
+        )
+        v.producer = node
+        out_vars.append(v)
+    node.outputs = out_vars
+    prog._append(node)
+    return out_vars[0] if single else tuple(out_vars)
+
+
+def register_state_write(target: Tensor, sym_value: _SymbolicValue) -> None:
+    """Called from ``Tensor._inplace_set`` when a symbolic value is assigned
+    onto an eager tensor during recording (BN running stats etc.): keep the
+    eager value, and schedule a replay-time write-back instead."""
+    prog = default_main_program()
+    var = None
+    # the symbolic value belongs to the output Variable of some recorded node
+    for node in reversed(prog.ops):
+        for ov in node.outputs:
+            if ov._value is sym_value:
+                var = ov
+                node.state_writes.append((target, var))
+                prog._intern_capture(target)
+                prog._version += 1
+                return
+    raise InvalidArgumentError(
+        "In-place assignment of a symbolic value whose producing op is not in "
+        "the current default main program."
+    )

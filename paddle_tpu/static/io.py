@@ -1,0 +1,176 @@
+"""Static-graph persistence (``paddle.static.save/load`` +
+``save/load_inference_model``).
+
+Reference: ``python/paddle/static/io.py`` — pickled parameter files
+(``.pdparams``/``.pdopt``) plus the serialized inference graph
+(``.pdmodel``). TPU-native: parameters pickle by capture name; the inference
+graph serializes as StableHLO via ``jax.export`` of the program's compiled
+replay — a portable, version-stable XLA artifact (the ``.pdmodel`` analog).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core.tensor import Tensor, to_tensor
+from ..enforce import InvalidArgumentError
+from .graph import Program, Variable
+from .executor import _SwapValues, _replay, prune_ops
+
+__all__ = [
+    "save",
+    "load",
+    "save_inference_model",
+    "load_inference_model",
+    "load_program_state",
+    "set_program_state",
+]
+
+
+def _to_eval_node(node):
+    """Convert a train-mode op to its inference form (is_test pass)."""
+    from .graph import OpNode
+
+    kind = (node.attrs or {}).get("op_kind")
+    if kind == "dropout":
+        p, mode = node.attrs["p"], node.attrs["mode"]
+        if mode == "upscale_in_train":
+            fn = lambda a, kd: a  # noqa: E731 — eval dropout is identity
+        else:  # downscale_in_infer: eval scales by keep-prob
+            fn = lambda a, kd, _q=1.0 - p: a * _q  # noqa: E731
+        return OpNode(node.name, fn, node.inputs, node.outputs,
+                      node.n_diff_outputs, attrs=node.attrs)
+    return node
+
+
+def _param_state(program: Program) -> Dict[str, np.ndarray]:
+    return {t.name: np.asarray(t._value) for t in program.captures.values()
+            if not t.name.startswith("rngkey")}
+
+
+def save(program: Program, model_path: str, protocol=4):
+    os.makedirs(os.path.dirname(os.path.abspath(model_path)) or ".", exist_ok=True)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(_param_state(program), f, protocol=protocol)
+    if program._optimize_spec is not None:
+        opt = program._optimize_spec[0]
+        with open(model_path + ".pdopt", "wb") as f:
+            state = {
+                k: np.asarray(v._value) if isinstance(v, Tensor) else v
+                for k, v in opt.state_dict().items()
+                if not isinstance(v, dict)
+            }
+            pickle.dump(state, f, protocol=protocol)
+
+
+def load_program_state(model_path: str) -> Dict[str, np.ndarray]:
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program: Program, state: Dict[str, np.ndarray]):
+    by_name = {t.name: t for t in program.captures.values()}
+    matched = [n for n in state if n in by_name]
+    if state and not matched:
+        # name-counter drift across processes: fall back to positional order
+        caps = [t for t in program.captures.values()
+                if not t.name.startswith("rngkey")]
+        for t, (_, v) in zip(caps, state.items()):
+            t._inplace_set(jnp.asarray(v, t._value.dtype))
+        return
+    for n in matched:
+        t = by_name[n]
+        t._inplace_set(jnp.asarray(state[n], t._value.dtype))
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    set_program_state(program, load_program_state(model_path))
+    opt_path = model_path + ".pdopt"
+    if program._optimize_spec is not None and os.path.exists(opt_path):
+        with open(opt_path, "rb") as f:
+            program._optimize_spec[0].set_state_dict(pickle.load(f))
+
+
+def save_inference_model(path_prefix: str, feed_vars: List[Variable],
+                         fetch_vars, executor=None, program: Optional[Program] = None,
+                         **kwargs):
+    """Export feed→fetch as StableHLO + weights."""
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    prog = program if program is not None else feed_vars[0].block.program
+    os.makedirs(os.path.dirname(os.path.abspath(path_prefix)) or ".", exist_ok=True)
+
+    cap_list = [t for t in prog.captures.values() if not t.name.startswith("rngkey")]
+    # inference graph = backward slice from the fetches, with training-only
+    # side effects (BN stat writes) dropped and train-mode dropout converted
+    # to its eval form — the reference's prune+is_test pass pipeline
+    infer_ops = [
+        _to_eval_node(n) for n in prune_ops(prog, fetch_vars, keep_state_writes=False)
+    ]
+
+    def pure(cap_vals, *feed_vals):
+        with _SwapValues(cap_list, cap_vals):
+            env: Dict[int, Tensor] = {}
+            for v, val in zip(feed_vars, feed_vals):
+                env[id(v)] = Tensor(val, stop_gradient=True, name=v.name)
+            with autograd.no_grad():
+                _replay(prog, env, ops=infer_ops, apply_state_writes=False)
+            out = tuple(env[id(v)]._value for v in fetch_vars)
+        return out
+
+    from jax import export as jexport
+
+    cap_avals = [jax.ShapeDtypeStruct(tuple(t.shape), t.dtype) for t in cap_list]
+    feed_avals = [jax.ShapeDtypeStruct(tuple(v.shape), v.dtype) for v in feed_vars]
+    exported = jexport.export(jax.jit(pure))(cap_avals, *feed_avals)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(
+            {
+                "params": _param_state(prog),
+                "param_order": [t.name for t in cap_list],
+                "feed_names": [v.name for v in feed_vars],
+                "fetch_count": len(fetch_vars),
+            },
+            f,
+        )
+
+
+def load_inference_model(path_prefix: str, executor=None):
+    """Returns (predictor, feed_names, fetch_count-long outputs on call)."""
+    from jax import export as jexport
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+    params = meta["params"]
+    cap_vals = [jnp.asarray(params[n]) for n in meta["param_order"]]
+
+    class _InferenceProgram:
+        feed_names = meta["feed_names"]
+
+        def run(self, feed=None, fetch_list=None, **kw):
+            feeds = [jnp.asarray(
+                feed[n]._value if isinstance(feed[n], Tensor) else feed[n]
+            ) for n in self.feed_names]
+            outs = exported.call(cap_vals, *feeds)
+            return [np.asarray(o) for o in outs]
+
+        def __call__(self, *inputs):
+            vals = [i._value if isinstance(i, Tensor) else jnp.asarray(i)
+                    for i in inputs]
+            outs = exported.call(cap_vals, *vals)
+            outs = [to_tensor(np.asarray(o)) for o in outs]
+            return outs[0] if len(outs) == 1 else tuple(outs)
+
+    prog = _InferenceProgram()
+    return prog, meta["feed_names"], ["fetch_%d" % i for i in range(meta["fetch_count"])]

@@ -1,0 +1,139 @@
+"""``paddle.static.nn`` — layer builders for program construction.
+
+Reference: ``python/paddle/static/nn/common.py`` (SURVEY.md §1 L8/L5b). Each
+builder creates eagerly-initialized parameters (they become program
+*captures*, the persistable-var analog) and dispatches the functional op,
+which the recording hook appends to the default main program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..enforce import InvalidArgumentError
+from ..nn import functional as F
+from ..nn import initializer as I
+from .graph import default_startup_program, in_static_mode
+
+__all__ = ["fc", "embedding", "conv2d", "batch_norm", "cond", "while_loop"]
+
+
+def _make_param(shape, dtype, initializer, name, trainable=True):
+    if initializer is not None and not isinstance(initializer, I.Initializer):
+        # ParamAttr-style holder
+        initializer = getattr(initializer, "initializer", None)
+    init = initializer or I.XavierUniform()
+    val = init(shape, dtype)
+    t = val if isinstance(val, Tensor) else to_tensor(val)
+    t.stop_gradient = not trainable
+    t.trainable = trainable
+    t.persistable = True
+    t.name = name
+    # bind into the startup program's capture set so exe.run(startup) exposes
+    # it via the scope (initialization itself already happened eagerly)
+    default_startup_program()._intern_capture(t)
+    return t
+
+
+_uid = [0]
+
+
+def _unique(prefix):
+    _uid[0] += 1
+    return f"{prefix}_{_uid[0]}"
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Fully-connected layer over flattened trailing dims."""
+    name = name or _unique("fc")
+    if num_flatten_dims < 1:
+        raise InvalidArgumentError("num_flatten_dims must be >= 1")
+    in_features = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_features *= int(s)
+    w = _make_param([in_features, size], x.dtype, weight_attr, f"{name}.w_0")
+    b = None
+    if bias_attr is not False:
+        b = _make_param([size], x.dtype, bias_attr or I.Constant(0.0), f"{name}.b_0")
+    if len(x.shape) > num_flatten_dims + 1:
+        lead = [int(s) for s in x.shape[:num_flatten_dims]]
+        x = x.reshape(lead + [in_features])
+    out = F.linear(x, w, b)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
+              weight_attr=None, dtype="float32", name=None):
+    name = name or _unique("embedding")
+    w = _make_param(list(size), dtype, weight_attr or param_attr or I.XavierNormal(),
+                    f"{name}.w_0")
+    return F.embedding(input, w, padding_idx=padding_idx, sparse=is_sparse)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, data_format="NCHW",
+           name=None):
+    name = name or _unique("conv2d")
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    in_ch = int(input.shape[1] if data_format == "NCHW" else input.shape[-1])
+    w = _make_param(
+        [num_filters, in_ch // groups] + list(filter_size), input.dtype,
+        param_attr, f"{name}.w_0",
+    )
+    b = None
+    if bias_attr is not False:
+        b = _make_param([num_filters], input.dtype, bias_attr or I.Constant(0.0),
+                        f"{name}.b_0")
+    return F.conv2d(input, w, b, stride=stride, padding=padding,
+                    dilation=dilation, groups=groups, data_format=data_format)
+
+
+def batch_norm(input, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW", name=None):
+    name = name or _unique("batch_norm")
+    c = int(input.shape[1] if data_layout == "NCHW" else input.shape[-1])
+    scale = _make_param([c], input.dtype, param_attr or I.Constant(1.0), f"{name}.scale")
+    bias = _make_param([c], input.dtype, bias_attr or I.Constant(0.0), f"{name}.bias")
+    mean = _make_param([c], input.dtype, I.Constant(0.0), f"{name}.mean", trainable=False)
+    var = _make_param([c], input.dtype, I.Constant(1.0), f"{name}.variance", trainable=False)
+    return F.batch_norm(input, mean, var, scale, bias, training=not is_test,
+                        momentum=momentum, epsilon=epsilon, data_format=data_layout)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Conditional. Eager: plain Python branch. Static: both branches are
+    recorded as sub-programs and lowered to one ``lax.cond`` op node — the
+    XLA-native reading of the reference's ``conditional_block`` op pair."""
+    from .control_flow import static_cond
+
+    if in_static_mode():
+        from .graph import is_symbolic
+
+        if is_symbolic(pred):
+            return static_cond(pred, true_fn, false_fn)
+    taken = bool(pred.numpy() if isinstance(pred, Tensor) else pred)
+    return true_fn() if taken else (false_fn() if false_fn else None)
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    """While loop. Eager: Python loop. Static: recorded sub-program lowered
+    to ``lax.while_loop`` (the reference's ``while`` op)."""
+    from .control_flow import static_while_loop
+    from .graph import is_symbolic
+
+    if in_static_mode() and any(
+        is_symbolic(v) for v in loop_vars if isinstance(v, Tensor)
+    ):
+        return static_while_loop(cond_fn, body, loop_vars)
+    vars_ = list(loop_vars)
+    while bool(cond_fn(*vars_).numpy()):
+        out = body(*vars_)
+        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vars_

@@ -97,3 +97,52 @@ class TestInference:
         c.disable_gpu()
         assert not c.use_gpu()
         assert "some/prefix" in c.summary()
+
+
+def test_ckpt_list_entries_roundtrip(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import checkpoint as dckpt
+    import numpy as np
+
+    sd = {
+        "moments": [paddle.to_tensor(np.ones(3, "float32")),
+                    paddle.to_tensor(np.full(2, 2.0, "float32"))],
+        "step": 7,
+    }
+    dckpt.save_state_dict(sd, str(tmp_path / "ck_list"))
+    tgt = {
+        "moments": [paddle.to_tensor(np.zeros(3, "float32")),
+                    paddle.to_tensor(np.zeros(2, "float32"))],
+        "step": 0,
+    }
+    dckpt.load_state_dict(tgt, str(tmp_path / "ck_list"))
+    np.testing.assert_allclose(tgt["moments"][0].numpy(), 1.0)
+    np.testing.assert_allclose(tgt["moments"][1].numpy(), 2.0)
+    assert tgt["step"] == 7
+
+
+def test_ckpt_unpicklable_entry_raises(tmp_path):
+    from paddle_tpu.distributed import checkpoint as dckpt
+    import pytest
+
+    with pytest.raises(TypeError, match="not checkpointable"):
+        dckpt.save_state_dict({"bad": object()}, str(tmp_path / "ck_bad"))
+
+
+def test_predictor_output_handle_before_run(tmp_path):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference
+
+    net = paddle.nn.Linear(4, 2)
+    path = str(tmp_path / "pred_model")
+    paddle.jit.save(net, path, input_spec=[paddle.static.InputSpec([3, 4])])
+    config = inference.Config(path)
+    predictor = inference.create_predictor(config)
+    names = predictor.get_output_names()
+    handle = predictor.get_output_handle(names[0])  # before any run()
+    inp = predictor.get_input_handle(predictor.get_input_names()[0])
+    inp.copy_from_cpu(np.ones((3, 4), "float32"))
+    predictor.run()
+    out = handle.copy_to_cpu()
+    assert out.shape == (3, 2)
