@@ -69,6 +69,7 @@ from . import metric  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
+from . import sparse  # noqa: F401
 from . import static  # noqa: F401
 from . import vision  # noqa: F401
 from .hapi.model import Model  # noqa: F401

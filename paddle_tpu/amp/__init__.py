@@ -150,8 +150,11 @@ class GradScaler:
         self._unscaled = True
         inv = 1.0 / self._scale
         found = False
+        from ..core.autograd import densify_grad_
+
         for p in optimizer._params():
             if p.grad is not None:
+                densify_grad_(p)
                 g = p.grad._value * inv
                 found = found or bool(jnp.logical_not(jnp.isfinite(g)).any())
                 p.grad._inplace_set(g)

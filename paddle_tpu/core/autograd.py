@@ -157,8 +157,12 @@ def run_backward(
                 cap_leaf.setdefault(id(t), []).append(j)
 
     def _store_leaf(t, g):
-        for j in cap_leaf.get(id(t), ()):
-            captured[j] = g if captured[j] is None else captured[j] + g
+        if id(t) in cap_leaf:
+            # paddle.grad() returns dense Tensors; densify sparse cotangents
+            gd = g.to_dense().value if getattr(g, "is_selected_rows", False) \
+                else g
+            for j in cap_leaf.get(id(t), ()):
+                captured[j] = gd if captured[j] is None else captured[j] + gd
         if accumulate_leaves and not t.stop_gradient:
             _accumulate_leaf(t, g)
 
@@ -246,9 +250,39 @@ def backward(
     run_backward(tensors, grad_tensors, retain_graph)
 
 
+def densify_grad_(t) -> None:
+    """Normalize ``t.grad`` to a dense Tensor in place (SelectedRows → dense).
+
+    Consumers that read ``p.grad._value`` (grad clipping, loss unscaling,
+    hybrid-parallel grad sync) call this first so sparse embedding grads
+    work everywhere dense ones do."""
+    if getattr(t.grad, "is_selected_rows", False):
+        t.grad = t.grad.to_dense()
+
+
 def _accumulate_leaf(t, g) -> None:
     from .tensor import Tensor
 
+    # Row-sparse cotangent (SelectedRows, from sparse embedding backward):
+    # kept sparse across accumulation, densified only on mixed accumulation —
+    # mirrors the reference's GradientAccumulation over SelectedRows.
+    if getattr(g, "is_selected_rows", False):
+        if t._hooks:
+            # grad hooks operate on dense Tensors; densify so they still fire
+            g = g.to_dense().value
+        else:
+            if t.grad is None:
+                t.grad = g
+            elif getattr(t.grad, "is_selected_rows", False):
+                t.grad = t.grad.merge(g)
+            else:
+                t.grad = Tensor(t.grad.value + g.to_dense().value,
+                                stop_gradient=True)
+            return
+    if getattr(t.grad, "is_selected_rows", False):
+        # dense grad arriving after a sparse one: normalize the accumulator
+        # to dense and continue through the standard (hook-running) path
+        t.grad = Tensor(t.grad.to_dense().value, stop_gradient=True)
     for hook in t._hooks:
         out = hook(Tensor(g, stop_gradient=True))
         if out is not None:

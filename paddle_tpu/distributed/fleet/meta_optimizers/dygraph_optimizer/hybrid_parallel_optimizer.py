@@ -106,6 +106,8 @@ class HybridParallelOptimizer:
                     get_mesh().size:
                 p._inplace_set(jax.device_put(v, replicated(v)))
             if p.grad is not None:
+                from .....core.autograd import densify_grad_
+                densify_grad_(p)
                 gv = p.grad._value
                 if not hasattr(gv, "sharding") or \
                         len(gv.sharding.device_set) != get_mesh().size:

@@ -717,6 +717,38 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             out = jnp.where(mask, 0.0, out)
         return out
 
+    from ...core import autograd as _ag
+    # SelectedRows grads only for *leaf* weights (the reference's
+    # lookup_table sparse grad has the same constraint: the sparse grad is
+    # an optimizer-facing format, not propagatable through upstream VJPs).
+    if sparse and not weight.stop_gradient and _ag.is_grad_enabled() \
+            and weight._grad_node is None \
+            and not isinstance(weight._value, jax.core.Tracer):
+        # sparse=True (reference: lookup_table sparse grad): hand-written grad
+        # node emitting a SelectedRows cotangent instead of a dense scatter.
+        from ...sparse.selected_rows import SelectedRows
+        from ...core.tensor import Tensor
+
+        ids = x._value
+        out = f(weight._value)
+        height, dim = weight.shape[0], out.shape[-1]
+
+        def vjp_fn(cot):
+            rows = ids.reshape(-1)
+            vals = cot.reshape(-1, dim)
+            if padding_idx is not None:
+                vals = vals * (rows != padding_idx)[:, None].astype(vals.dtype)
+            return (SelectedRows(rows, vals, height),)
+
+        in_edges = [("node", weight._grad_node, weight._out_index)
+                    if weight._grad_node is not None else ("leaf", weight, 0)]
+        node = _ag.GradNode("embedding_sparse_grad", vjp_fn, in_edges, 1,
+                            [(out.shape, out.dtype)])
+        t = Tensor(out, stop_gradient=False)
+        t._grad_node = node
+        t._out_index = 0
+        return t
+
     return run_op("embedding", f, weight)
 
 

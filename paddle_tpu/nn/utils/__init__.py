@@ -12,10 +12,14 @@ __all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector", "vecto
 
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    from ...core.autograd import densify_grad_
+
     params = [p for p in (parameters if isinstance(parameters, (list, tuple)) else [parameters])
               if p.grad is not None]
     if not params:
         return to_tensor(0.0)
+    for p in params:
+        densify_grad_(p)
     if norm_type == float("inf"):
         total = jnp.max(jnp.stack([jnp.max(jnp.abs(p.grad._value)) for p in params]))
     else:
@@ -29,9 +33,12 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=Fals
 
 
 def clip_grad_value_(parameters, clip_value):
+    from ...core.autograd import densify_grad_
+
     params = parameters if isinstance(parameters, (list, tuple)) else [parameters]
     for p in params:
         if p.grad is not None:
+            densify_grad_(p)
             p.grad._inplace_set(jnp.clip(p.grad._value, -clip_value, clip_value))
 
 

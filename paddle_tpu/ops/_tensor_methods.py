@@ -94,3 +94,42 @@ def attach():
     T.scale = math.scale
     T.cumsum = math.cumsum
     T.clone = T.clone  # defined on class
+
+    # dense → sparse conversions (reference: Tensor.to_sparse_coo / pybind
+    # eager_method sparse conversions); lazy import to keep ops→sparse acyclic
+    def _to_sparse_coo(s, sparse_dim):
+        from .. import sparse as _sp
+        import jax.numpy as jnp
+        import numpy as np
+
+        arr = s._value
+        sd = int(sparse_dim)
+        import jax
+
+        if isinstance(arr, jax.core.Tracer):
+            raise ValueError(
+                "Tensor.to_sparse_coo needs concrete values: the sparsity "
+                "pattern is data-dependent and cannot be traced under jit/"
+                "static capture (the reference's DenseToCoo kernel has a "
+                "data-dependent output shape for the same reason).")
+        dense = np.asarray(arr)
+        mask = (dense.reshape(dense.shape[:sd] + (-1,)) != 0).any(-1) \
+            if dense.ndim > sd else dense != 0
+        idx = np.stack(np.nonzero(mask)).astype(np.int64)
+        # gather values through run_op so autograd flows from the sparse
+        # tensor's values back to the dense source
+        vals = run_op_gather(s, idx)
+        return _sp.SparseCooTensor(
+            _sp.to_tensor(jnp.asarray(idx)), vals, list(arr.shape))
+
+    def run_op_gather(s, idx):
+        from .dispatch import run_op
+        import jax.numpy as jnp
+
+        def fn(a):
+            return a[tuple(jnp.asarray(idx))]
+
+        return run_op("dense_to_sparse_values", fn, s)
+
+    T.to_sparse_coo = _to_sparse_coo
+    T.to_sparse_csr = lambda s: _to_sparse_coo(s, 2).to_sparse_csr()

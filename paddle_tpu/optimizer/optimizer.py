@@ -153,7 +153,15 @@ class Optimizer:
 
     def step(self):
         params = self._params()
-        pgs = [(p, p.grad._value) for p in params if p.grad is not None]
+        # SelectedRows grads (sparse embeddings) densify here: default-mode
+        # Adam/SGD touch every row anyway (reference: non-lazy adam over
+        # SelectedRows does the same merge+apply).
+        pgs = [
+            (p, (p.grad.to_dense()._value
+                 if getattr(p.grad, "is_selected_rows", False)
+                 else p.grad._value))
+            for p in params if p.grad is not None
+        ]
         if not pgs:
             return
         if self._grad_clip is not None:

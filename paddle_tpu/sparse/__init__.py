@@ -1,0 +1,552 @@
+"""Sparse tensor API.
+
+TPU-native counterpart of ``paddle.sparse`` + ``phi::SparseCooTensor`` /
+``phi::SparseCsrTensor`` (reference: ``paddle/phi/core/sparse_coo_tensor.h``,
+``paddle/phi/kernels/sparse/``, ``python/paddle/sparse/``; SURVEY.md §2.1
+"Sparse API" / "Other tensor kinds").
+
+Design: a sparse tensor is (indices, values) pairs of ordinary framework
+``Tensor``s with a *static* nnz — XLA needs static shapes, so sparsity is a
+compile-time budget, exactly like the reference's kernels treat nnz as a
+runtime size. All compute lowers to gather / segment-sum jax programs, which
+XLA maps onto the TPU's VPU and (for spmm contraction) MXU; autograd flows
+through the ``values`` Tensor via the standard tape, so ``.backward()`` works
+over sparse ops with no special grad kernels (the reference needs hand-written
+sparse grad kernels; here the VJP of gather/segment_sum *is* that kernel).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..enforce import InvalidArgumentError, enforce as check
+from ..ops.dispatch import run_op
+from .. import ops as _ops
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_sparse", "is_sparse_coo", "is_sparse_csr",
+    # value-preserving unary ops (paddle.sparse surface)
+    "abs", "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "expm1", "relu", "relu6", "leaky_relu", "neg",
+    "pow", "cast", "rad2deg", "deg2rad",
+    # binary / contraction
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "mv", "addmm",
+    "softmax", "transpose", "coalesce", "is_same_shape",
+    "nn",
+]
+
+
+def _as_value(t):
+    return t._value if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+class SparseCooTensor:
+    """COO sparse tensor: ``indices`` [sparse_ndim, nnz] + ``values`` [nnz, ...].
+
+    Mirrors ``phi::SparseCooTensor`` (dense_tensor indices + values + dims).
+    ``values`` participates in autograd; ``indices`` is integral metadata.
+    """
+
+    def __init__(self, indices: Tensor, values: Tensor, shape: Sequence[int],
+                 coalesced: bool = False):
+        self.indices_t = indices
+        self.values_t = values
+        self._shape = tuple(int(s) for s in shape)
+        self._coalesced = coalesced
+
+    # -- meta ---------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dtype(self):
+        return self.values_t.dtype
+
+    @property
+    def sparse_dim(self):
+        return int(self.indices_t.shape[0])
+
+    @property
+    def dense_dim(self):
+        return self.ndim - self.sparse_dim
+
+    @property
+    def stop_gradient(self):
+        return self.values_t.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self.values_t.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self.values_t.grad
+
+    def nnz(self):
+        return int(self.indices_t.shape[1])
+
+    def indices(self) -> Tensor:
+        return self.indices_t
+
+    def values(self) -> Tensor:
+        return self.values_t
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def is_coalesced(self):
+        return self._coalesced
+
+    def backward(self, grad=None):
+        self.values_t.backward(grad)
+
+    # -- conversions ----------------------------------------------------------
+    def to_dense(self) -> Tensor:
+        shape = self._shape
+        sd = self.sparse_dim
+
+        def fn(idx, vals):
+            dense = jnp.zeros(shape, vals.dtype)
+            return dense.at[tuple(idx[d] for d in range(sd))].add(vals)
+
+        return run_op("sparse_to_dense", fn, self.indices_t, self.values_t)
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        check(self.sparse_dim == 2 and self.dense_dim == 0,
+              "to_sparse_csr supports 2-D COO matrices")
+        coo = self.coalesce()
+        rows, cols = coo.indices_t._value[0], coo.indices_t._value[1]
+        nrows = self._shape[0]
+        crows = jnp.cumulative_sum(
+            jnp.bincount(rows, length=nrows), include_initial=True)
+        return SparseCsrTensor(
+            to_tensor(crows.astype(jnp.int32)),
+            to_tensor(cols.astype(jnp.int32)),
+            coo.values_t, self._shape)
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return self
+
+    def coalesce(self) -> "SparseCooTensor":
+        """Sort indices lexicographically and sum duplicates (static nnz)."""
+        if self._coalesced:
+            return self
+        idx = self.indices_t._value
+        flat = jnp.ravel_multi_index(
+            tuple(idx[d] for d in range(self.sparse_dim)),
+            self._shape[: self.sparse_dim], mode="clip")
+        order = jnp.argsort(flat)
+        sflat = flat[order]
+        # unique-by-first-occurrence keeping static nnz: duplicates sum into
+        # their segment leader; trailing slots become empty (index 0, value 0)
+        is_head = jnp.concatenate([jnp.array([True]), sflat[1:] != sflat[:-1]])
+        seg = jnp.cumsum(is_head) - 1
+        nnz = idx.shape[1]
+
+        def fn(vals):
+            sv = vals[order]
+            return jax.ops.segment_sum(sv, seg, num_segments=nnz)
+
+        new_vals = run_op("sparse_coalesce_values", fn, self.values_t)
+        head_flat = jnp.where(is_head, sflat, 0)
+        lead_flat = jnp.zeros((nnz,), flat.dtype).at[seg].max(head_flat)
+        new_idx = jnp.stack(jnp.unravel_index(
+            lead_flat, self._shape[: self.sparse_dim])).astype(jnp.int32)
+        return SparseCooTensor(to_tensor(new_idx), new_vals, self._shape,
+                               coalesced=True)
+
+    def transpose(self, perm):
+        return transpose(self, perm)
+
+    def numpy(self):
+        return np.asarray(self.to_dense()._value)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix: ``crows`` [nrows+1], ``cols`` [nnz], ``values`` [nnz].
+
+    Mirrors ``phi::SparseCsrTensor``. Batched CSR (3-D) follows the reference
+    convention of stacked per-batch crows; only 2-D is implemented here, with
+    batching via vmap at the op level when needed.
+    """
+
+    def __init__(self, crows: Tensor, cols: Tensor, values: Tensor,
+                 shape: Sequence[int]):
+        self.crows_t = crows
+        self.cols_t = cols
+        self.values_t = values
+        self._shape = tuple(int(s) for s in shape)
+        check(len(self._shape) == 2, "SparseCsrTensor supports 2-D matrices")
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dtype(self):
+        return self.values_t.dtype
+
+    @property
+    def stop_gradient(self):
+        return self.values_t.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self.values_t.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self.values_t.grad
+
+    def nnz(self):
+        return int(self.cols_t.shape[0])
+
+    def crows(self):
+        return self.crows_t
+
+    def cols(self):
+        return self.cols_t
+
+    def values(self):
+        return self.values_t
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def backward(self, grad=None):
+        self.values_t.backward(grad)
+
+    def _rows(self):
+        """Expand crows to a per-nnz row id: row r owns nnz slots
+        [crows[r], crows[r+1])."""
+        crows = self.crows_t._value
+        nnz = self.nnz()
+        return jnp.searchsorted(
+            crows, jnp.arange(nnz, dtype=crows.dtype), side="right") - 1
+
+    def to_sparse_coo(self, sparse_dim=2) -> SparseCooTensor:
+        rows = self._rows().astype(jnp.int32)
+        idx = jnp.stack([rows, self.cols_t._value.astype(jnp.int32)])
+        return SparseCooTensor(to_tensor(idx), self.values_t, self._shape,
+                               coalesced=True)
+
+    def to_sparse_csr(self):
+        return self
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def numpy(self):
+        return np.asarray(self.to_dense()._value)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+# -- constructors -------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True) -> SparseCooTensor:
+    """Build a COO tensor (reference: ``paddle.sparse.sparse_coo_tensor``)."""
+    idx = jnp.asarray(_as_value(indices), jnp.int32)
+    check(idx.ndim == 2, "indices must be [sparse_ndim, nnz]")
+    vals = _as_value(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        sparse_shape = [int(d) + 1 for d in np.asarray(idx.max(axis=1))] \
+            if idx.shape[1] else [0] * idx.shape[0]
+        shape = sparse_shape + list(vals.shape[1:])
+    vt = values if isinstance(values, Tensor) else to_tensor(vals)
+    if dtype is not None and vt._value.dtype != vals.dtype:
+        vt = to_tensor(vals)
+    vt.stop_gradient = stop_gradient
+    return SparseCooTensor(to_tensor(idx), vt, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True) -> SparseCsrTensor:
+    """Build a CSR matrix (reference: ``paddle.sparse.sparse_csr_tensor``)."""
+    crows = to_tensor(jnp.asarray(_as_value(crows), jnp.int32))
+    cols = to_tensor(jnp.asarray(_as_value(cols), jnp.int32))
+    vals = _as_value(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    vt = values if isinstance(values, Tensor) and dtype is None else to_tensor(vals)
+    vt.stop_gradient = stop_gradient
+    return SparseCsrTensor(crows, cols, vt, shape)
+
+
+def is_sparse(x):
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x):
+    return isinstance(x, SparseCsrTensor)
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+# -- value-preserving unary ops (zero → zero, so sparsity is preserved) -------
+
+def _unary_factory(name, fn):
+    def op(x, *args, **kwargs):
+        check(is_sparse(x), f"sparse.{name} expects a sparse tensor")
+        new_vals = run_op(f"sparse_{name}",
+                          lambda v: fn(v, *args, **kwargs), x.values_t)
+        return _with_values(x, new_vals)
+
+    op.__name__ = name
+    return op
+
+
+def _with_values(x, new_vals):
+    if is_sparse_coo(x):
+        return SparseCooTensor(x.indices_t, new_vals, x._shape, x._coalesced)
+    return SparseCsrTensor(x.crows_t, x.cols_t, new_vals, x._shape)
+
+
+abs = _unary_factory("abs", jnp.abs)
+sin = _unary_factory("sin", jnp.sin)
+tan = _unary_factory("tan", jnp.tan)
+asin = _unary_factory("asin", jnp.arcsin)
+atan = _unary_factory("atan", jnp.arctan)
+sinh = _unary_factory("sinh", jnp.sinh)
+tanh = _unary_factory("tanh", jnp.tanh)
+asinh = _unary_factory("asinh", jnp.arcsinh)
+atanh = _unary_factory("atanh", jnp.arctanh)
+sqrt = _unary_factory("sqrt", jnp.sqrt)
+square = _unary_factory("square", jnp.square)
+log1p = _unary_factory("log1p", jnp.log1p)
+expm1 = _unary_factory("expm1", jnp.expm1)
+relu = _unary_factory("relu", lambda v: jnp.maximum(v, 0))
+relu6 = _unary_factory("relu6", lambda v: jnp.clip(v, 0, 6))
+neg = _unary_factory("neg", jnp.negative)
+rad2deg = _unary_factory("rad2deg", jnp.rad2deg)
+deg2rad = _unary_factory("deg2rad", jnp.deg2rad)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _with_values(x, run_op(
+        "sparse_leaky_relu",
+        lambda v: jnp.where(v >= 0, v, v * negative_slope), x.values_t))
+
+
+def pow(x, factor):
+    return _with_values(x, run_op(
+        "sparse_pow", lambda v: jnp.power(v, factor), x.values_t))
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..core.dtype import convert_dtype
+    out = x
+    if value_dtype is not None:
+        vd = convert_dtype(value_dtype)
+        out = _with_values(out, run_op(
+            "sparse_cast", lambda v: v.astype(vd), x.values_t))
+    if index_dtype is not None:
+        idt = convert_dtype(index_dtype)
+        if is_sparse_coo(out):
+            out = SparseCooTensor(
+                to_tensor(out.indices_t._value.astype(idt)), out.values_t,
+                out._shape, out._coalesced)
+        else:
+            out = SparseCsrTensor(
+                to_tensor(out.crows_t._value.astype(idt)),
+                to_tensor(out.cols_t._value.astype(idt)),
+                out.values_t, out._shape)
+    return out
+
+
+# -- binary elementwise --------------------------------------------------------
+
+def _binary_coo(name, fn, x: SparseCooTensor, y: SparseCooTensor):
+    check(is_same_shape(x, y), f"sparse.{name}: shape mismatch")
+    # union-pattern combine: concatenate patterns then coalesce. For the
+    # common same-pattern case (e.g. grads) this stays exact; static nnz =
+    # nnz(x)+nnz(y), the XLA-friendly worst case.
+    idx = jnp.concatenate([x.indices_t._value, y.indices_t._value], axis=1)
+    if name in ("add", "subtract"):
+        vals = run_op(
+            f"sparse_{name}",
+            lambda vx, vy: jnp.concatenate(
+                [vx, (vy if name == "add" else -vy)], axis=0),
+            x.values_t, y.values_t)
+        return SparseCooTensor(to_tensor(idx), vals, x._shape).coalesce()
+    # multiply/divide: evaluate other side densely at x's indices
+    xc, yc = x.coalesce(), y.coalesce()
+    gather_idx = tuple(xc.indices_t._value[d] for d in range(xc.sparse_dim))
+    ydense = yc.to_dense()
+    vals = run_op(
+        f"sparse_{name}",
+        lambda vx, yd: fn(vx, yd[gather_idx]),
+        xc.values_t, ydense)
+    return SparseCooTensor(xc.indices_t, vals, x._shape, coalesced=True)
+
+
+def _binary(name, fn):
+    def op(x, y, name_=None):
+        if is_sparse_coo(x) and is_sparse_coo(y):
+            return _binary_coo(name, fn, x, y)
+        if is_sparse_csr(x) and is_sparse_csr(y):
+            return _binary_coo(name, fn, x.to_sparse_coo(),
+                               y.to_sparse_coo()).to_sparse_csr()
+        if is_sparse(x) and isinstance(y, Tensor):
+            return getattr(_ops, name)(x.to_dense(), y)
+        if isinstance(x, Tensor) and is_sparse(y):
+            return getattr(_ops, name)(x, y.to_dense())
+        raise InvalidArgumentError(
+            f"sparse.{name}: unsupported operand types {type(x)}, {type(y)}")
+
+    op.__name__ = name
+    return op
+
+
+add = _binary("add", lambda a, b: a + b)
+subtract = _binary("subtract", lambda a, b: a - b)
+multiply = _binary("multiply", lambda a, b: a * b)
+divide = _binary("divide", lambda a, b: a / b)
+
+
+# -- contractions ---------------------------------------------------------------
+
+def matmul(x, y) -> Tensor:
+    """Sparse @ dense → dense (reference: ``paddle.sparse.matmul`` /
+    ``sparse/gpu/matmul_kernel.cu`` over cusparse SpMM).
+
+    Lowered as gather + segment-sum: contribution[k] = values[k] * y[col[k]],
+    summed per row — a static-shape program whose VJP doubles as the sparse
+    grad kernel (dX = dOut @ Yᵀ at X's pattern, dY = Xᵀ @ dOut).
+    """
+    if isinstance(x, Tensor) and is_sparse(y):
+        # dense @ sparse = (sparseᵀ @ denseᵀ)ᵀ
+        yt = transpose(y.to_sparse_coo() if is_sparse_csr(y) else y, [1, 0])
+        return _ops.transpose(matmul(yt, _ops.transpose(x, _t_perm(x.ndim))),
+                              _t_perm(x.ndim))
+    check(is_sparse(x) and isinstance(y, Tensor), "sparse.matmul(sparse, dense)")
+    coo = x.to_sparse_coo() if is_sparse_csr(x) else x.coalesce()
+    check(coo.sparse_dim == 2 and coo.dense_dim == 0 and y.ndim == 2,
+          "sparse.matmul supports 2-D sparse @ 2-D dense")
+    rows = coo.indices_t._value[0]
+    cols = coo.indices_t._value[1]
+    nrows = coo._shape[0]
+
+    def fn(vals, dense):
+        contrib = vals[:, None] * dense[cols]
+        return jax.ops.segment_sum(contrib, rows, num_segments=nrows)
+
+    return run_op("sparse_matmul", fn, coo.values_t, y)
+
+
+def _t_perm(ndim):
+    p = list(range(ndim))
+    p[-1], p[-2] = p[-2], p[-1]
+    return p
+
+
+def mv(x, vec) -> Tensor:
+    """Sparse matrix @ dense vector (reference: ``paddle.sparse.mv``)."""
+    out = matmul(x, _ops.reshape(vec, [-1, 1]))
+    return _ops.reshape(out, [-1])
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0) -> Tensor:
+    """beta*input + alpha*(x @ y) (reference: ``paddle.sparse.addmm``)."""
+    return _ops.add(_ops.scale(input, beta), _ops.scale(matmul(x, y), alpha))
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask):
+    """(x @ y) evaluated only at mask's sparsity pattern → sparse
+    (reference: ``paddle.sparse.masked_matmul``, cusparse SDDMM)."""
+    check(isinstance(x, Tensor) and isinstance(y, Tensor) and is_sparse(mask),
+          "masked_matmul(dense, dense, sparse_mask)")
+    coo = mask.to_sparse_coo() if is_sparse_csr(mask) else mask.coalesce()
+    rows = coo.indices_t._value[0]
+    cols = coo.indices_t._value[1]
+
+    def fn(a, b):
+        # per-nnz dot product: rows of a × cols of b — batched gather + MXU
+        return jnp.einsum("nk,nk->n", a[rows], b[:, cols].T)
+
+    vals = run_op("sparse_masked_matmul", fn, x, y)
+    out = SparseCooTensor(coo.indices_t, vals, coo._shape, coalesced=True)
+    return out.to_sparse_csr() if is_sparse_csr(mask) else out
+
+
+def softmax(x, axis=-1):
+    """Row-wise softmax over the sparsity pattern (reference:
+    ``paddle.sparse.nn.functional.softmax``); empty rows stay empty."""
+    check(axis in (-1, x.ndim - 1), "sparse softmax supports the last axis")
+    coo = x.to_sparse_coo() if is_sparse_csr(x) else x.coalesce()
+    check(coo.sparse_dim == 2, "sparse softmax supports 2-D matrices")
+    rows = coo.indices_t._value[0]
+    nrows = coo._shape[0]
+
+    def fn(vals):
+        rmax = jax.ops.segment_max(vals, rows, num_segments=nrows)
+        e = jnp.exp(vals - rmax[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=nrows)
+        return e / denom[rows]
+
+    vals = run_op("sparse_softmax", fn, coo.values_t)
+    out = SparseCooTensor(coo.indices_t, vals, coo._shape, coalesced=True)
+    return out.to_sparse_csr() if is_sparse_csr(x) else out
+
+
+def transpose(x, perm):
+    """Permute a COO tensor's dims (reference: ``paddle.sparse.transpose``)."""
+    coo = x.to_sparse_coo() if is_sparse_csr(x) else x
+    check(len(perm) == coo.ndim and coo.dense_dim == 0,
+          "transpose perm must cover all (sparse) dims")
+    idx = coo.indices_t._value[jnp.asarray(perm)]
+    shape = [coo._shape[p] for p in perm]
+    out = SparseCooTensor(to_tensor(idx), coo.values_t, shape)
+    return out.to_sparse_csr() if is_sparse_csr(x) else out
+
+
+def coalesce(x):
+    return x.coalesce()
+
+
+from . import nn  # noqa: E402,F401

@@ -1,0 +1,211 @@
+"""Sparse NN layers.
+
+Reference: ``python/paddle/sparse/nn/`` (ReLU/Softmax activations and the
+submanifold 3-D convolutions used for point clouds, backed by
+``paddle/phi/kernels/sparse/gpu/conv_kernel.cu``; SURVEY.md §2.1).
+
+The submanifold conv here is the TPU formulation: instead of the reference's
+rulebook-gather CUDA kernel, build the neighbor map host-side once per
+sparsity pattern (it is data-layout, not data), then the per-step compute is
+a static gather + batched matmul — MXU-friendly with a static nnz.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..enforce import enforce as check
+from ..nn.layer.layers import Layer
+from ..nn import initializer as init
+from ..ops.dispatch import run_op
+from . import SparseCooTensor, is_sparse, relu as _relu, relu6 as _relu6, \
+    leaky_relu as _leaky_relu, softmax as _softmax
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "SubmConv3D", "Conv3D",
+    "BatchNorm"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return _leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return _softmax(x, self.axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over sparse values' channel dim (reference:
+    ``paddle.sparse.nn.BatchNorm``)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        super().__init__()
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = self.create_parameter([num_features],
+                                            default_initializer=init.Constant(1.0))
+        self.bias = self.create_parameter([num_features], is_bias=True)
+        self._mean = to_tensor(jnp.zeros((num_features,)))
+        self._variance = to_tensor(jnp.ones((num_features,)))
+        self._mean.stop_gradient = True
+        self._variance.stop_gradient = True
+
+    def forward(self, x):
+        check(is_sparse(x), "sparse.nn.BatchNorm expects a sparse tensor")
+        vals = x.values_t
+        if self.training:
+            m = float(self.momentum)
+
+            def fn(v, w, b):
+                mean = v.mean(axis=0)
+                var = v.var(axis=0)
+                return (v - mean) * jax.lax.rsqrt(var + self.epsilon) * w + b, \
+                    mean, var
+
+            out, mean, var = run_op("sparse_batch_norm", fn, vals,
+                                    self.weight, self.bias, n_diff_outputs=1)
+            self._mean._value = m * self._mean._value + (1 - m) * mean._value
+            self._variance._value = (m * self._variance._value
+                                     + (1 - m) * var._value)
+        else:
+            rm, rv = self._mean, self._variance
+
+            def fn(v, w, b, mean, var):
+                return (v - mean) * jax.lax.rsqrt(var + self.epsilon) * w + b
+
+            out = run_op("sparse_batch_norm_eval", fn, vals, self.weight,
+                         self.bias, rm, rv)
+        from . import _with_values
+        return _with_values(x, out)
+
+
+def _neighbor_map(indices: np.ndarray, shape, kernel_size, subm: bool):
+    """Host-side rulebook: for each kernel offset, map input nnz → output nnz.
+
+    Returns (out_indices [4, out_nnz], gathers: list of (in_pos, out_pos)
+    int arrays per kernel offset). Computed once per sparsity pattern —
+    the analog of the reference's GPU rulebook build, but host-side since
+    it is pure index bookkeeping that XLA cannot fuse anyway.
+    """
+    kd, kh, kw = kernel_size
+    coords = indices.T  # [nnz, 4] (batch, z, y, x)
+    key = {tuple(c): i for i, c in enumerate(map(tuple, coords))}
+    if subm:
+        out_coords = coords
+        out_key = key
+    else:
+        seen = {}
+        for c in map(tuple, coords):
+            for dz in range(kd):
+                for dy in range(kh):
+                    for dx in range(kw):
+                        oz = c[1] + dz - kd // 2
+                        oy = c[2] + dy - kh // 2
+                        ox = c[3] + dx - kw // 2
+                        if 0 <= oz < shape[1] and 0 <= oy < shape[2] \
+                                and 0 <= ox < shape[3]:
+                            seen.setdefault((c[0], oz, oy, ox), len(seen))
+        out_coords = np.array(sorted(seen, key=seen.get), dtype=np.int64) \
+            if seen else np.zeros((0, 4), np.int64)
+        out_key = {tuple(c): i for i, c in enumerate(map(tuple, out_coords))}
+    gathers = []
+    for dz in range(kd):
+        for dy in range(kh):
+            for dx in range(kw):
+                ins, outs = [], []
+                for c, i in key.items():
+                    oc = (c[0], c[1] - (dz - kd // 2), c[2] - (dy - kh // 2),
+                          c[3] - (dx - kw // 2))
+                    j = out_key.get(oc)
+                    if j is not None:
+                        ins.append(i)
+                        outs.append(j)
+                gathers.append((np.asarray(ins, np.int32),
+                                np.asarray(outs, np.int32)))
+    return np.ascontiguousarray(out_coords.T), gathers
+
+
+class SubmConv3D(Layer):
+    """Submanifold sparse 3-D conv (reference: ``paddle.sparse.nn.SubmConv3D``).
+
+    Input: SparseCooTensor with indices [4, nnz] = (batch, z, y, x) and
+    values [nnz, in_channels] (NDHWC, the reference's sparse conv layout).
+    """
+
+    _subm = True
+
+    def __init__(self, in_channels, out_channels, kernel_size, padding=0,
+                 bias_attr=None):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 3
+        self.kernel_size = tuple(kernel_size)
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        k = int(np.prod(self.kernel_size))
+        self.weight = self.create_parameter(
+            [k, in_channels, out_channels],
+            default_initializer=init.XavierUniform())
+        self.bias = None if bias_attr is False else \
+            self.create_parameter([out_channels], is_bias=True)
+        self._cache = {}
+
+    def forward(self, x: SparseCooTensor):
+        check(x.sparse_dim == 4 and x.dense_dim == 1,
+              "sparse conv3d expects indices [4, nnz], values [nnz, C]")
+        idx_np = np.asarray(x.indices_t._value)
+        cache_key = (idx_np.tobytes(), tuple(x.shape))
+        if cache_key not in self._cache:
+            self._cache.clear()  # one live pattern per layer instance
+            self._cache[cache_key] = _neighbor_map(
+                idx_np, x.shape, self.kernel_size, self._subm)
+        out_idx, gathers = self._cache[cache_key]
+        out_nnz = out_idx.shape[1]
+
+        def fn(vals, w, *maybe_b):
+            out = jnp.zeros((out_nnz, self.out_channels), vals.dtype)
+            for t, (ins, outs) in enumerate(gathers):
+                if len(ins) == 0:
+                    continue
+                contrib = vals[ins] @ w[t].astype(vals.dtype)
+                out = out.at[outs].add(contrib)
+            if maybe_b:
+                out = out + maybe_b[0].astype(vals.dtype)
+            return out
+
+        args = (x.values_t, self.weight) + \
+            ((self.bias,) if self.bias is not None else ())
+        vals = run_op("submconv3d" if self._subm else "sparse_conv3d",
+                      fn, *args)
+        shape = list(x.shape[:-1]) + [self.out_channels]
+        return SparseCooTensor(to_tensor(jnp.asarray(out_idx)), vals, shape,
+                               coalesced=True)
+
+
+class Conv3D(SubmConv3D):
+    """Full sparse conv (output sites dilate; reference:
+    ``paddle.sparse.nn.Conv3D``). Stride-1 only in this revision."""
+
+    _subm = False

@@ -1,0 +1,74 @@
+"""SelectedRows: row-sparse tensors for embedding gradients.
+
+TPU-native counterpart of ``phi::SelectedRows``
+(``paddle/phi/core/selected_rows.h``; SURVEY.md §2.1 "Other tensor kinds").
+In the reference, ``lookup_table(sparse=True)`` backward emits a SelectedRows
+gradient — only the touched rows — and sparse-aware optimizers apply
+row-sliced updates. Here the representation is (rows [n], values [n, ...cols])
+with a logical ``height``; rows may repeat until :func:`merge_selected_rows`
+(the ``merge_selected_rows`` op) combines duplicates via segment-sum.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SelectedRows", "merge_selected_rows"]
+
+
+class SelectedRows:
+    is_selected_rows = True
+
+    def __init__(self, rows, values, height: int):
+        self.rows = jnp.asarray(rows, jnp.int32).reshape(-1)
+        from ..core.tensor import Tensor
+        self.values = values._value if isinstance(values, Tensor) \
+            else jnp.asarray(values)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return [self.height] + list(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.values.dtype)
+
+    def numpy(self):
+        return np.asarray(self.to_dense()._value)
+
+    def to_dense(self):
+        from ..core.tensor import Tensor
+        dense = jnp.zeros(tuple(self.shape), self.values.dtype)
+        return Tensor(dense.at[self.rows].add(self.values),
+                      stop_gradient=True)
+
+    def merge(self, other: "SelectedRows") -> "SelectedRows":
+        assert self.height == other.height
+        return SelectedRows(
+            jnp.concatenate([self.rows, other.rows]),
+            jnp.concatenate([self.values, other.values]),
+            self.height)
+
+    def scale_(self, factor):
+        self.values = self.values * factor
+        return self
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, n_rows={len(self.rows)}, "
+                f"cols={list(self.values.shape[1:])})")
+
+
+def merge_selected_rows(sr: SelectedRows) -> SelectedRows:
+    """Combine duplicate rows by summation (reference op
+    ``merge_selected_rows``). Keeps static shapes: output row-count equals the
+    number of unique rows (host-side unique — the row set is index metadata)."""
+    rows_np = np.asarray(sr.rows)
+    uniq, inv = np.unique(rows_np, return_inverse=True)
+    vals = jax.ops.segment_sum(sr.values, jnp.asarray(inv),
+                               num_segments=len(uniq))
+    return SelectedRows(jnp.asarray(uniq, jnp.int32), vals, sr.height)

@@ -1,0 +1,263 @@
+"""Sparse API tests (reference test model: ``test/legacy_test/test_sparse_*``:
+numpy/dense parity for conversions, ops, and grads)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _rand_coo(shape, nnz, seed=0, dense_dims=0):
+    rng = np.random.RandomState(seed)
+    sparse_shape = shape[: len(shape) - dense_dims]
+    idx = np.stack([rng.randint(0, s, nnz) for s in sparse_shape])
+    vals = rng.randn(nnz, *shape[len(sparse_shape):]).astype("float32")
+    return idx, vals
+
+
+class TestConstructorsAndConversions:
+    def test_coo_roundtrip(self):
+        idx, vals = _rand_coo((5, 6), 8)
+        st = sparse.sparse_coo_tensor(idx, vals, (5, 6))
+        dense = np.zeros((5, 6), "float32")
+        for k in range(8):
+            dense[idx[0, k], idx[1, k]] += vals[k]
+        np.testing.assert_allclose(st.to_dense().numpy(), dense, rtol=1e-6)
+        assert st.nnz() == 8 and st.sparse_dim == 2 and st.dense_dim == 0
+
+    def test_coalesce_sums_duplicates(self):
+        idx = np.array([[0, 0, 1], [1, 1, 2]])
+        vals = np.array([1.0, 2.0, 3.0], "float32")
+        st = sparse.sparse_coo_tensor(idx, vals, (2, 3)).coalesce()
+        d = st.to_dense().numpy()
+        assert d[0, 1] == 3.0 and d[1, 2] == 3.0
+
+    def test_csr_roundtrip(self):
+        crows = [0, 2, 3, 3]
+        cols = [1, 3, 2]
+        vals = np.array([10.0, 20.0, 30.0], "float32")
+        st = sparse.sparse_csr_tensor(crows, cols, vals, (3, 4))
+        d = st.to_dense().numpy()
+        assert d[0, 1] == 10 and d[0, 3] == 20 and d[1, 2] == 30
+        assert d.sum() == 60
+        coo = st.to_sparse_coo()
+        np.testing.assert_array_equal(coo.indices().numpy(),
+                                      [[0, 0, 1], [1, 3, 2]])
+
+    def test_coo_to_csr(self):
+        idx, vals = _rand_coo((6, 5), 10, seed=3)
+        st = sparse.sparse_coo_tensor(idx, vals, (6, 5))
+        csr = st.to_sparse_csr()
+        np.testing.assert_allclose(csr.to_dense().numpy(),
+                                   st.to_dense().numpy(), rtol=1e-6)
+
+    def test_dense_to_sparse(self):
+        x = paddle.to_tensor(np.array([[0, 1.5], [2.5, 0]], "float32"))
+        st = x.to_sparse_coo(2)
+        assert sparse.is_sparse_coo(st)
+        np.testing.assert_allclose(st.to_dense().numpy(), x.numpy())
+
+
+class TestSparseOps:
+    def test_unary_preserves_pattern(self):
+        idx, vals = _rand_coo((4, 4), 5, seed=1)
+        st = sparse.sparse_coo_tensor(idx, np.abs(vals) + 0.1, (4, 4))
+        out = sparse.sqrt(st)
+        np.testing.assert_allclose(
+            out.to_dense().numpy(),
+            np.sqrt(st.to_dense().numpy() + (st.to_dense().numpy() == 0) * 0)
+            * (st.to_dense().numpy() != 0),
+            rtol=1e-5)
+
+    def test_relu_and_cast(self):
+        idx = np.array([[0, 1], [1, 0]])
+        st = sparse.sparse_coo_tensor(idx, np.array([-1.0, 2.0], "float32"),
+                                      (2, 2))
+        out = sparse.relu(st)
+        assert out.to_dense().numpy()[1, 0] == 2.0
+        assert out.to_dense().numpy()[0, 1] == 0.0
+        c = sparse.cast(st, value_dtype="float16")
+        assert str(c.dtype) == "float16"
+
+    def test_add_subtract(self):
+        ia, va = _rand_coo((5, 5), 6, seed=2)
+        ib, vb = _rand_coo((5, 5), 4, seed=4)
+        a = sparse.sparse_coo_tensor(ia, va, (5, 5))
+        b = sparse.sparse_coo_tensor(ib, vb, (5, 5))
+        np.testing.assert_allclose(
+            sparse.add(a, b).to_dense().numpy(),
+            a.to_dense().numpy() + b.to_dense().numpy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            sparse.subtract(a, b).to_dense().numpy(),
+            a.to_dense().numpy() - b.to_dense().numpy(), rtol=1e-5)
+
+    def test_multiply_same_pattern(self):
+        ia, va = _rand_coo((4, 4), 5, seed=5)
+        a = sparse.sparse_coo_tensor(ia, va, (4, 4))
+        b = sparse.sparse_coo_tensor(ia, va * 2, (4, 4))
+        got = sparse.multiply(a, b).to_dense().numpy()
+        ad = a.coalesce().to_dense().numpy()
+        np.testing.assert_allclose(got, ad * (ad * 2), rtol=1e-5)
+
+    def test_matmul_dense_parity_and_grad(self):
+        idx, vals = _rand_coo((6, 5), 9, seed=6)
+        st = sparse.sparse_coo_tensor(idx, vals, (6, 5), stop_gradient=False)
+        y = paddle.to_tensor(np.random.RandomState(7).randn(5, 3)
+                             .astype("float32"), stop_gradient=False)
+        out = sparse.matmul(st, y)
+        np.testing.assert_allclose(
+            out.numpy(), st.to_dense().numpy() @ y.numpy(), rtol=1e-4)
+        out.backward(paddle.ones_like(out))
+        # dY = Xᵀ @ dOut
+        np.testing.assert_allclose(
+            y.grad.numpy(),
+            st.to_dense().numpy().T @ np.ones((6, 3), "float32"), rtol=1e-4)
+        assert st.grad is not None and st.grad.shape == [9]
+
+    def test_csr_matmul_and_mv(self):
+        crows, cols = [0, 1, 3], [2, 0, 1]
+        vals = np.array([1.0, 2.0, 3.0], "float32")
+        st = sparse.sparse_csr_tensor(crows, cols, vals, (2, 3))
+        y = np.arange(12, dtype="float32").reshape(3, 4)
+        np.testing.assert_allclose(
+            sparse.matmul(st, paddle.to_tensor(y)).numpy(),
+            st.to_dense().numpy() @ y, rtol=1e-5)
+        v = np.array([1.0, 2.0, 3.0], "float32")
+        np.testing.assert_allclose(
+            sparse.mv(st, paddle.to_tensor(v)).numpy(),
+            st.to_dense().numpy() @ v, rtol=1e-5)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(8)
+        a = rng.randn(4, 6).astype("float32")
+        b = rng.randn(6, 4).astype("float32")
+        idx = np.array([[0, 1, 3], [1, 2, 0]])
+        mask = sparse.sparse_coo_tensor(idx, np.ones(3, "float32"), (4, 4))
+        out = sparse.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                                   mask)
+        full = a @ b
+        d = out.to_dense().numpy()
+        for r, c in idx.T:
+            np.testing.assert_allclose(d[r, c], full[r, c], rtol=1e-4)
+        assert (d != 0).sum() == 3
+
+    def test_softmax_rows(self):
+        idx = np.array([[0, 0, 2], [0, 2, 1]])
+        vals = np.array([1.0, 2.0, 5.0], "float32")
+        st = sparse.sparse_coo_tensor(idx, vals, (3, 3))
+        out = sparse.softmax(st).to_dense().numpy()
+        e = np.exp([1.0, 2.0])
+        np.testing.assert_allclose(out[0, [0, 2]], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(out[2, 1], 1.0, rtol=1e-6)
+        assert out[1].sum() == 0  # empty row stays empty
+
+    def test_transpose(self):
+        idx, vals = _rand_coo((3, 5), 4, seed=9)
+        st = sparse.sparse_coo_tensor(idx, vals, (3, 5))
+        np.testing.assert_allclose(
+            sparse.transpose(st, [1, 0]).to_dense().numpy(),
+            st.to_dense().numpy().T, rtol=1e-6)
+
+
+class TestSparseNN:
+    def test_activation_layers(self):
+        idx = np.array([[0, 1], [1, 0]])
+        st = sparse.sparse_coo_tensor(idx, np.array([-3.0, 8.0], "float32"),
+                                      (2, 2))
+        assert sparse.nn.ReLU()(st).to_dense().numpy()[1, 0] == 8.0
+        assert sparse.nn.ReLU6()(st).to_dense().numpy()[1, 0] == 6.0
+
+    def test_batch_norm(self):
+        rng = np.random.RandomState(0)
+        idx = np.stack([rng.randint(0, 4, 16), rng.randint(0, 4, 16)])
+        vals = rng.randn(16, 3).astype("float32") * 4 + 2
+        st = sparse.sparse_coo_tensor(idx, vals, (4, 4, 3))
+        bn = sparse.nn.BatchNorm(3)
+        out = bn(st)
+        v = out.values().numpy()
+        np.testing.assert_allclose(v.mean(axis=0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(v.std(axis=0), 1.0, atol=1e-2)
+
+    def test_subm_conv3d(self):
+        # a single active site with a 1×1×1 kernel == plain linear
+        idx = np.array([[0], [1], [1], [1]])
+        vals = np.array([[1.0, 2.0]], "float32")
+        st = sparse.sparse_coo_tensor(idx, vals, (1, 3, 3, 3, 2))
+        conv = sparse.nn.SubmConv3D(2, 4, kernel_size=1, bias_attr=False)
+        out = conv(st)
+        w = conv.weight.numpy()[0]  # [2, 4]
+        np.testing.assert_allclose(out.values().numpy(),
+                                   vals @ w, rtol=1e-5)
+        assert out.shape == [1, 3, 3, 3, 4]
+
+    def test_subm_conv3d_neighborhood(self):
+        # two adjacent sites, 3×3×3 kernel: each output sees both inputs
+        idx = np.array([[0, 0], [1, 1], [1, 1], [0, 1]])
+        vals = np.array([[1.0], [10.0]], "float32")
+        st = sparse.sparse_coo_tensor(idx, vals, (1, 3, 3, 3, 1))
+        conv = sparse.nn.SubmConv3D(1, 1, kernel_size=3, bias_attr=False)
+        out = conv(st)
+        assert out.nnz() == 2  # submanifold: output pattern == input pattern
+        # grad flows to weight
+        loss = out.values().sum()
+        loss.backward()
+        assert conv.weight.grad is not None
+
+
+class TestSelectedRows:
+    def test_merge(self):
+        from paddle_tpu.sparse.selected_rows import SelectedRows, \
+            merge_selected_rows
+        sr = SelectedRows(rows=[3, 1, 3], values=np.array(
+            [[1.0, 1], [2, 2], [3, 3]], "float32"), height=5)
+        merged = merge_selected_rows(sr)
+        np.testing.assert_array_equal(sorted(merged.rows), [1, 3])
+        d = merged.to_dense().numpy()
+        np.testing.assert_allclose(d[3], [4.0, 4.0])
+        np.testing.assert_allclose(d[1], [2.0, 2.0])
+        assert d.shape == (5, 2)
+
+    def test_sparse_grad_nonleaf_falls_back_dense(self):
+        import paddle_tpu.nn.functional as F
+        w = paddle.to_tensor(np.random.RandomState(0).randn(10, 4)
+                             .astype("float32"), stop_gradient=False)
+        w2 = w * 1.0  # non-leaf: SelectedRows can't cross upstream VJPs
+        x = paddle.to_tensor(np.array([1, 3], "int64"))
+        F.embedding(x, w2, sparse=True).sum().backward()
+        assert not getattr(w.grad, "is_selected_rows", False)
+        assert w.grad.shape == [10, 4]
+
+    def test_sparse_grad_clip_and_paddle_grad(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        emb = nn.Embedding(10, 4, sparse=True)
+        x = paddle.to_tensor(np.array([1, 3], "int64"))
+        emb(x).sum().backward()
+        n = nn.utils.clip_grad_norm_([emb.weight], 1.0)
+        assert float(n.numpy()) > 0
+        w = paddle.to_tensor(np.zeros((10, 4), "float32"), stop_gradient=False)
+        g, = paddle.autograd.grad(F.embedding(x, w, sparse=True).sum(), [w])
+        assert g.numpy().shape == (10, 4)
+
+    def test_sparse_grad_hooks_fire(self):
+        import paddle_tpu.nn as nn
+        emb = nn.Embedding(10, 4, sparse=True)
+        called = []
+        emb.weight.register_hook(lambda t: called.append(t.shape))
+        emb(paddle.to_tensor(np.array([2], "int64"))).sum().backward()
+        assert called == [[10, 4]]  # densified so hooks still run
+
+    def test_embedding_sparse_grad(self):
+        import paddle_tpu.nn as nn
+        emb = nn.Embedding(10, 4, sparse=True)
+        ids = paddle.to_tensor(np.array([1, 3, 1], "int64"))
+        out = emb(ids)
+        out.sum().backward()
+        g = emb.weight.grad
+        from paddle_tpu.sparse.selected_rows import SelectedRows
+        assert isinstance(g, SelectedRows)
+        d = g.to_dense().numpy()
+        np.testing.assert_allclose(d[1], np.full(4, 2.0))
+        np.testing.assert_allclose(d[3], np.full(4, 1.0))
+        assert np.abs(d[[0, 2, 4, 5, 6, 7, 8, 9]]).sum() == 0
