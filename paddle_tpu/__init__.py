@@ -61,6 +61,7 @@ from .framework.io import load, save
 
 from . import _C_ops  # noqa: F401
 from . import amp  # noqa: F401
+from . import fft  # noqa: F401
 from . import autograd  # noqa: F401
 from . import device  # noqa: F401
 from . import io  # noqa: F401
@@ -69,8 +70,10 @@ from . import metric  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
+from . import quantization  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401
+from . import utils  # noqa: F401
 from . import vision  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .nn.layer.layers import ParamAttr  # noqa: F401

@@ -95,5 +95,12 @@ def is_floating_dtype(dt: Any) -> bool:
     return jnp.issubdtype(jnp.dtype(dt), jnp.floating)
 
 
+def is_differentiable_dtype(dt: Any) -> bool:
+    """Float or complex — dtypes whose tensors can carry gradients
+    (complex joins via the fft/linalg op families)."""
+    d = jnp.dtype(dt)
+    return jnp.issubdtype(d, jnp.floating) or jnp.issubdtype(d, jnp.complexfloating)
+
+
 def is_integer_dtype(dt: Any) -> bool:
     return jnp.issubdtype(jnp.dtype(dt), jnp.integer)

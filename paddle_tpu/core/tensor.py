@@ -121,7 +121,17 @@ class Tensor:
 
     # -- conversion ---------------------------------------------------------
     def numpy(self) -> np.ndarray:
-        return np.asarray(self._value)
+        v = self._value
+        if jnp.issubdtype(v.dtype, jnp.complexfloating):
+            # some PJRT transports (the axon TPU tunnel) can't transfer
+            # complex buffers — move real/imag separately and recombine
+            try:
+                return np.asarray(v)
+            except Exception:
+                re = np.asarray(jnp.real(v))
+                im = np.asarray(jnp.imag(v))
+                return (re + 1j * im).astype(np.dtype(v.dtype))
+        return np.asarray(v)
 
     def item(self):
         return self._value.item() if hasattr(self._value, "item") else self._value
@@ -340,5 +350,19 @@ def to_tensor(
     elif isinstance(val, jax.core.Tracer):
         arr = val.astype(dt) if dt is not None else val
     else:
-        arr = jax.device_put(jnp.asarray(val, dtype=dt), dev)
+        if np.issubdtype(np.asarray(val).dtype, np.complexfloating) and (
+                dt is None or jnp.issubdtype(dt, jnp.complexfloating)):
+            # complex device transfer is unsupported on some transports
+            # (axon TPU tunnel — failures surface lazily, so no try/except):
+            # keep complex tensors host-resident, like the fft op family
+            # (see fft._host)
+            if getattr(dev, "platform", "cpu") != "cpu":
+                dev = jax.devices("cpu")[0]
+            # device_put straight from numpy: jnp.asarray would eagerly
+            # materialise on the (accelerator) default device first
+            arr = jax.device_put(np.asarray(val), dev)
+            if dt is not None:
+                arr = arr.astype(dt)
+        else:
+            arr = jax.device_put(jnp.asarray(val, dtype=dt), dev)
     return Tensor(arr, stop_gradient=stop_gradient)

@@ -31,7 +31,7 @@ from .env import (
     is_initialized,
 )
 from .parallel import DataParallel
-from . import auto_parallel, checkpoint, fleet, launch, sharding
+from . import auto_parallel, checkpoint, fleet, launch, ps, rpc, sharding
 from .store import TCPStore
 from .auto_parallel import (
     Partial,

@@ -3,7 +3,7 @@ distributed/fleet/``; SURVEY.md §2.2). The facade delegates to a singleton
 ``Fleet`` exactly like the reference; hybrid parallelism is carried by the
 global ``jax.sharding.Mesh`` the facade builds."""
 
-from . import meta_optimizers, meta_parallel, utils
+from . import elastic, meta_optimizers, meta_parallel, utils
 from .base.distributed_strategy import DistributedStrategy
 from .base.topology import (
     CommunicateTopology,

@@ -1,0 +1,146 @@
+"""Fleet elastic training manager.
+
+Reference counterpart: ``python/paddle/distributed/fleet/elastic/manager.py``
+(SURVEY.md §2.2 "Elastic", §5.3): nodes register in ETCD with TTL
+heartbeats; a watcher detects scale-in/out or dead nodes; all ranks exit and
+the launcher re-rendezvouses with the surviving set.
+
+TPU-native design: membership rides the native C++ ``TCPStore`` (the same
+rendezvous plane as collective bootstrap) instead of ETCD — each node
+heartbeats ``elastic/node/<id>`` with a timestamp; staleness > ``ttl`` means
+dead. The launcher integration point is ``ElasticManager.watch()`` which
+returns a scale event; the launcher then tears the pod down and restarts
+training from the last checkpoint (``launch --elastic_level 1``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ...store import TCPStore
+
+__all__ = ["ElasticManager", "ElasticStatus", "ScaleEvent"]
+
+
+class ElasticStatus:
+    NORMAL = "normal"
+    SCALE_IN = "scale_in"   # a node died / left
+    SCALE_OUT = "scale_out"  # a new node joined
+    EXIT = "exit"
+
+
+@dataclass
+class ScaleEvent:
+    status: str
+    alive: List[str] = field(default_factory=list)
+    joined: List[str] = field(default_factory=list)
+    dead: List[str] = field(default_factory=list)
+
+
+class ElasticManager:
+    """One instance per node. ``start()`` begins heartbeating; ``watch()``
+    polls membership and reports changes against the last-known set."""
+
+    def __init__(self, node_id: str, store: Optional[TCPStore] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, ttl: float = 3.0,
+                 heartbeat_interval: float = 0.5):
+        self.node_id = node_id
+        self.ttl = ttl
+        self.interval = heartbeat_interval
+        self.store = store or TCPStore(host=host, port=port,
+                                       is_master=is_master)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._known: Optional[set] = None
+
+    # --- registration / heartbeat ----------------------------------------
+    def start(self) -> None:
+        self._register()
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+        self._thread.start()
+
+    def _register(self) -> None:
+        # atomic membership: claim a slot index via the store's atomic add,
+        # then write this node's id into the slot — no read-modify-write race
+        # when several nodes register at once
+        slot = self.store.add("elastic/nslots", 1) - 1
+        self.store.set(f"elastic/slot/{slot}", self.node_id)
+        self._heartbeat()
+
+    def _roster(self) -> List[str]:
+        n = self.store.add("elastic/nslots", 0)
+        out = []
+        for i in range(int(n)):
+            try:
+                nid = self.store.get(f"elastic/slot/{i}",
+                                     timeout_ms=200).decode()
+            except (TimeoutError, RuntimeError):
+                continue
+            if nid and nid not in out:  # "" = tombstone (graceful leave)
+                out.append(nid)
+        return out
+
+    def _heartbeat(self) -> None:
+        self.store.set(f"elastic/node/{self.node_id}", str(time.time()))
+
+    def _beat(self) -> None:
+        while not self._stop.is_set():
+            self._heartbeat()
+            self._stop.wait(self.interval)
+
+    # --- watching ---------------------------------------------------------
+    def alive_nodes(self) -> Dict[str, float]:
+        """node_id -> seconds since last heartbeat, for live nodes."""
+        now = time.time()
+        out = {}
+        for nid in self._roster():
+            try:
+                ts = float(self.store.get(f"elastic/node/{nid}",
+                                          timeout_ms=200).decode())
+            except (TimeoutError, RuntimeError, ValueError):
+                continue
+            age = now - ts
+            if age <= self.ttl:
+                out[nid] = age
+        return out
+
+    def watch(self) -> ScaleEvent:
+        """Compare current membership to the previously observed set."""
+        alive = set(self.alive_nodes())
+        if self._known is None:
+            self._known = alive
+            return ScaleEvent(ElasticStatus.NORMAL, alive=sorted(alive))
+        joined = alive - self._known
+        dead = self._known - alive
+        self._known = alive
+        if dead:
+            return ScaleEvent(ElasticStatus.SCALE_IN, alive=sorted(alive),
+                              dead=sorted(dead))
+        if joined:
+            return ScaleEvent(ElasticStatus.SCALE_OUT, alive=sorted(alive),
+                              joined=sorted(joined))
+        return ScaleEvent(ElasticStatus.NORMAL, alive=sorted(alive))
+
+    def leave(self) -> None:
+        """Graceful departure: stop heartbeating and tombstone our slot."""
+        self.stop()
+        n = self.store.add("elastic/nslots", 0)
+        for i in range(int(n)):
+            try:
+                nid = self.store.get(f"elastic/slot/{i}",
+                                     timeout_ms=200).decode()
+            except (TimeoutError, RuntimeError):
+                continue
+            if nid == self.node_id:
+                self.store.set(f"elastic/slot/{i}", "")
+        self.store.delete_key(f"elastic/node/{self.node_id}")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

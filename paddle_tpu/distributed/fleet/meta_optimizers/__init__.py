@@ -9,6 +9,15 @@ from .dygraph_optimizer import (
     HybridParallelClipGrad,
     HybridParallelOptimizer,
 )
+from .strategy_optimizers import (
+    ASPOptimizer,
+    DGCOptimizer,
+    FP16AllReduceOptimizer,
+    GradientMergeOptimizer,
+    LocalSGDOptimizer,
+)
 
 __all__ = ["HybridParallelOptimizer", "HybridParallelClipGrad",
-           "DygraphShardingOptimizer"]
+           "DygraphShardingOptimizer", "GradientMergeOptimizer",
+           "LocalSGDOptimizer", "DGCOptimizer", "ASPOptimizer",
+           "FP16AllReduceOptimizer"]

@@ -1,0 +1,217 @@
+"""Strategy meta-optimizers: GradientMerge, LocalSGD, DGC, ASP, FP16AllReduce.
+
+Reference counterparts (one file each under ``python/paddle/distributed/
+fleet/meta_optimizers/``; SURVEY.md §2.2 "Static-graph meta-optimizers"):
+``gradient_merge_optimizer.py``, ``localsgd_optimizer.py``,
+``dgc_optimizer.py``, ``asp_optimizer.py``, ``fp16_allreduce_optimizer.py``.
+
+The reference implements these as **program-rewriting passes** over the
+static graph. TPU-native design: they are **eager optimizer wrappers** that
+transform ``param.grad`` (and occasionally the params) around the inner
+optimizer's fused-jit step — the transforms themselves are jax functions, so
+under ``paddle.jit.to_static`` they trace into the same XLA program the
+reference's rewritten graph would produce.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor, to_tensor
+from ....optimizer.optimizer import Optimizer
+
+__all__ = ["GradientMergeOptimizer", "LocalSGDOptimizer", "DGCOptimizer",
+           "ASPOptimizer", "FP16AllReduceOptimizer"]
+
+
+class _MetaOptimizer:
+    """Delegating base: inner optimizer drives the actual update."""
+
+    def __init__(self, inner_opt: Optimizer):
+        self._inner_opt = inner_opt
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        # route through THIS wrapper's step() so the meta behavior
+        # (merge/compress/sync) applies on the minimize() API too
+        loss.backward()
+        self.step()
+        return None, None
+
+
+class GradientMergeOptimizer(_MetaOptimizer):
+    """Accumulate grads over ``k_steps`` micro-steps, then apply one real
+    update (reference GradientMergeOptimizer: gradient-merge pass adds
+    accumulator vars + a cond op; here a jnp accumulator per param)."""
+
+    def __init__(self, inner_opt: Optimizer, k_steps: int = 1,
+                 avg: bool = True):
+        super().__init__(inner_opt)
+        self.k_steps = k_steps
+        self.avg = avg
+        self._acc: Dict[int, jax.Array] = {}
+        self._count = 0
+
+    def step(self):
+        self._count += 1
+        params = self._inner_opt._params()
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad._value
+            a = self._acc.get(id(p))
+            self._acc[id(p)] = g if a is None else a + g
+        if self._count < self.k_steps:
+            # not a real step yet: drop this micro-step's grads
+            for p in params:
+                p.grad = None
+            return
+        scale = 1.0 / self.k_steps if self.avg else 1.0
+        for p in params:
+            a = self._acc.pop(id(p), None)
+            if a is not None:
+                p.grad = to_tensor(a * scale)
+        self._count = 0
+        self._inner_opt.step()
+
+
+class LocalSGDOptimizer(_MetaOptimizer):
+    """Step locally every iteration; every ``k_steps`` average the params
+    across the data-parallel group (reference LocalSGDOptimizer)."""
+
+    def __init__(self, inner_opt: Optimizer, k_steps: int = 1,
+                 group=None):
+        super().__init__(inner_opt)
+        self.k_steps = k_steps
+        self._group = group
+        self._count = 0
+
+    def step(self):
+        self._inner_opt.step()
+        self._count += 1
+        if self._count % self.k_steps == 0:
+            from ...collective import ReduceOp, all_reduce, get_world_size
+
+            if get_world_size() > 1:
+                for p in self._inner_opt._params():
+                    all_reduce(p, op=ReduceOp.AVG, group=self._group)
+
+
+class DGCOptimizer(_MetaOptimizer):
+    """Deep Gradient Compression (reference DGCOptimizer / dgc ops): local
+    momentum correction + top-k% magnitude sparsification with residual
+    accumulation. Ramp-up: first ``rampup_begin_step`` steps are dense."""
+
+    def __init__(self, inner_opt: Optimizer, rampup_begin_step: int = 0,
+                 sparsity: float = 0.999, momentum: float = 0.9):
+        super().__init__(inner_opt)
+        self.rampup_begin_step = rampup_begin_step
+        self.sparsity = sparsity
+        self.momentum = momentum
+        self._u: Dict[int, jax.Array] = {}  # momentum buffer
+        self._v: Dict[int, jax.Array] = {}  # residual accumulator
+        self._step = 0
+
+    def _compress(self, pid, g):
+        u = self._u.get(pid)
+        u = g if u is None else self.momentum * u + g
+        v = self._v.get(pid)
+        v = u if v is None else v + u
+        flat = v.reshape(-1)
+        k = max(1, int(flat.size * (1.0 - self.sparsity)))
+        thresh = jax.lax.top_k(jnp.abs(flat), k)[0][-1]
+        mask = jnp.abs(v) >= thresh
+        sparse_g = jnp.where(mask, v, 0.0)
+        # residual keeps the suppressed mass; momentum cleared where sent
+        self._v[pid] = jnp.where(mask, 0.0, v)
+        self._u[pid] = jnp.where(mask, 0.0, u)
+        return sparse_g
+
+    def step(self):
+        self._step += 1
+        if self._step > self.rampup_begin_step:
+            for p in self._inner_opt._params():
+                if p.grad is None:
+                    continue
+                p.grad = to_tensor(self._compress(id(p), p.grad._value))
+        self._inner_opt.step()
+
+
+class ASPOptimizer(_MetaOptimizer):
+    """Automatic SParsity: maintain 2:4 structured sparsity masks (keep the
+    2 largest-magnitude of every 4 consecutive weights on the last dim) and
+    re-apply them after each update (reference ASPOptimizer +
+    ``paddle.incubate.asp``)."""
+
+    def __init__(self, inner_opt: Optimizer, n: int = 2, m: int = 4):
+        super().__init__(inner_opt)
+        self.n, self.m = n, m
+        self._masks: Dict[int, jax.Array] = {}
+
+    @staticmethod
+    def _mask_2_4(w, n, m):
+        shape = w.shape
+        flat = w.reshape(-1)
+        pad = (-flat.size) % m
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros(pad, flat.dtype)])
+        groups = flat.reshape(-1, m)
+        # rank within each group; keep the n largest magnitudes
+        order = jnp.argsort(jnp.abs(groups), axis=1)
+        ranks = jnp.argsort(order, axis=1)
+        mask = (ranks >= m - n).astype(w.dtype)
+        mask = mask.reshape(-1)[: w.size].reshape(shape)
+        return mask
+
+    def prune_model(self, params: Optional[List[Tensor]] = None):
+        """Compute masks from current magnitudes and zero the pruned half."""
+        for p in params or self._inner_opt._params():
+            if p._value.ndim < 2:
+                continue  # biases/norms stay dense (reference behavior)
+            mask = self._mask_2_4(p._value, self.n, self.m)
+            self._masks[id(p)] = mask
+            p._inplace_set(p._value * mask)
+
+    def step(self):
+        if not self._masks:
+            self.prune_model()
+        self._inner_opt.step()
+        for p in self._inner_opt._params():
+            mask = self._masks.get(id(p))
+            if mask is not None:
+                p._inplace_set(p._value * mask)
+
+
+class FP16AllReduceOptimizer(_MetaOptimizer):
+    """Halve grad-sync bandwidth by casting grads to fp16/bf16 before the
+    data-parallel reduction (reference FP16AllReduceOptimizer pass)."""
+
+    def __init__(self, inner_opt: Optimizer, dtype=jnp.bfloat16,
+                 group=None):
+        super().__init__(inner_opt)
+        self.dtype = dtype
+        self._group = group
+
+    def step(self):
+        from ...collective import ReduceOp, all_reduce, get_world_size
+
+        for p in self._inner_opt._params():
+            if p.grad is None:
+                continue
+            orig_dtype = p.grad._value.dtype
+            g16 = to_tensor(p.grad._value.astype(self.dtype))
+            if get_world_size() > 1:
+                all_reduce(g16, op=ReduceOp.AVG, group=self._group)
+            p.grad = to_tensor(g16._value.astype(orig_dtype))
+        self._inner_opt.step()

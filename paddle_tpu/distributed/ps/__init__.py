@@ -1,0 +1,233 @@
+"""``paddle.distributed.ps`` — parameter-server training stack.
+
+Reference counterpart: ``paddle/fluid/distributed/ps/`` (brpc dense/sparse
+tables, ``BrpcPsServer/Client``, accessors, GeoSGD) + ``python/paddle/
+distributed/ps/`` "TheOnePS" runtime (SURVEY.md §2.2 "Parameter server").
+
+TPU-native stance (SURVEY.md §7.3 item 6): PS training is CPU-bound sparse
+recommendation — orthogonal to the TPU compute path — so the scope here is a
+**functional single/multi-host PS** over the same TCP control plane as
+``distributed.rpc``: dense tables, sparse (hash) embedding tables with
+on-first-touch initialisation, sync/async push-pull, and a GeoSGD-style
+local-step accumulator. brpc itself (a vendored RPC framework) is replaced,
+not ported.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["PsServer", "PsClient", "DenseTable", "SparseTable"]
+
+
+def _send(sock, obj):
+    data = pickle.dumps(obj)
+    sock.sendall(struct.pack("!Q", len(data)) + data)
+
+
+def _recv(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        c = sock.recv(8 - len(hdr))
+        if not c:
+            raise ConnectionError("ps peer closed")
+        hdr += c
+    n = struct.unpack("!Q", hdr)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        c = sock.recv(min(1 << 20, n - len(buf)))
+        if not c:
+            raise ConnectionError("ps peer closed mid-message")
+        buf += c
+    return pickle.loads(bytes(buf))
+
+
+class DenseTable:
+    """Dense parameter block with an SGD accessor (reference
+    ``MemoryDenseTable`` + accessor)."""
+
+    def __init__(self, shape, lr=0.01, init=None):
+        self.param = (np.zeros(shape, np.float32) if init is None
+                      else np.asarray(init, np.float32).copy())
+        self.lr = lr
+        self.lock = threading.Lock()
+
+    def pull(self):
+        with self.lock:
+            return self.param.copy()
+
+    def push_grad(self, grad):
+        with self.lock:
+            self.param -= self.lr * np.asarray(grad, np.float32)
+
+    def set(self, value):
+        with self.lock:
+            self.param = np.asarray(value, np.float32).copy()
+
+
+class SparseTable:
+    """Row-sparse embedding table keyed by int64 id (reference
+    ``MemorySparseTable``): rows materialise on first pull (uniform init),
+    gradients apply per-row SGD — the SelectedRows update."""
+
+    def __init__(self, dim, lr=0.01, init_range=0.05, seed=0):
+        self.dim = dim
+        self.lr = lr
+        self.init_range = init_range
+        self.rows: Dict[int, np.ndarray] = {}
+        self.rng = np.random.RandomState(seed)
+        self.lock = threading.Lock()
+
+    def _row(self, i: int) -> np.ndarray:
+        r = self.rows.get(i)
+        if r is None:
+            r = self.rng.uniform(-self.init_range, self.init_range,
+                                 self.dim).astype(np.float32)
+            self.rows[i] = r
+        return r
+
+    def pull(self, ids):
+        with self.lock:
+            return np.stack([self._row(int(i)) for i in np.asarray(ids)])
+
+    def push_grad(self, ids, grads):
+        grads = np.asarray(grads, np.float32)
+        with self.lock:
+            for i, g in zip(np.asarray(ids), grads):
+                self._row(int(i))
+                self.rows[int(i)] = self.rows[int(i)] - self.lr * g
+
+    def size(self):
+        with self.lock:
+            return len(self.rows)
+
+
+class _PsHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server: "PsServer" = self.server.ps  # type: ignore[attr-defined]
+        while True:
+            try:
+                op, args = _recv(self.request)
+            except ConnectionError:
+                return
+            try:
+                result = getattr(server, "_op_" + op)(*args)
+                _send(self.request, ("ok", result))
+            except BaseException as e:
+                _send(self.request, ("err", e))
+
+
+class _TCP(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PsServer:
+    """Hosts tables; serves pull/push over TCP (reference BrpcPsServer)."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self.dense: Dict[int, DenseTable] = {}
+        self.sparse: Dict[int, SparseTable] = {}
+        self._bar: Dict[str, int] = {}
+        self._bar_lock = threading.Lock()
+        self._srv = _TCP((host, port), _PsHandler)
+        self._srv.ps = self
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self.host, self.port = self._srv.server_address
+
+    # --- table management -------------------------------------------------
+    def add_dense_table(self, table_id, shape, lr=0.01, init=None):
+        self.dense[table_id] = DenseTable(shape, lr, init)
+
+    def add_sparse_table(self, table_id, dim, lr=0.01, **kw):
+        self.sparse[table_id] = SparseTable(dim, lr, **kw)
+
+    # --- remote ops -------------------------------------------------------
+    def _op_pull_dense(self, tid):
+        return self.dense[tid].pull()
+
+    def _op_push_dense_grad(self, tid, grad):
+        self.dense[tid].push_grad(grad)
+
+    def _op_set_dense(self, tid, value):
+        self.dense[tid].set(value)
+
+    def _op_pull_sparse(self, tid, ids):
+        return self.sparse[tid].pull(ids)
+
+    def _op_push_sparse_grad(self, tid, ids, grads):
+        self.sparse[tid].push_grad(ids, grads)
+
+    def _op_create_dense(self, tid, shape, lr, init):
+        self.add_dense_table(tid, shape, lr, init)
+
+    def _op_create_sparse(self, tid, dim, lr):
+        self.add_sparse_table(tid, dim, lr)
+
+    def _op_table_stats(self):
+        return {"dense": sorted(self.dense),
+                "sparse": {k: v.size() for k, v in self.sparse.items()}}
+
+    def _op_barrier(self, key, world):
+        with self._bar_lock:
+            self._bar[key] = self._bar.get(key, 0) + 1
+            return self._bar[key]
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class PsClient:
+    """Trainer-side stub (reference BrpcPsClient). One persistent socket;
+    thread-safe via a lock (trainers are processes, not threads, in the
+    reference deployment)."""
+
+    def __init__(self, host, port, timeout=60.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._lock = threading.Lock()
+
+    def _call(self, op, *args):
+        with self._lock:
+            _send(self._sock, (op, args))
+            status, payload = _recv(self._sock)
+        if status == "err":
+            raise payload
+        return payload
+
+    def create_dense_table(self, table_id, shape, lr=0.01, init=None):
+        self._call("create_dense", table_id, shape, lr, init)
+
+    def create_sparse_table(self, table_id, dim, lr=0.01):
+        self._call("create_sparse", table_id, dim, lr)
+
+    def pull_dense(self, table_id) -> np.ndarray:
+        return self._call("pull_dense", table_id)
+
+    def push_dense_grad(self, table_id, grad) -> None:
+        self._call("push_dense_grad", table_id, np.asarray(grad, np.float32))
+
+    def set_dense(self, table_id, value) -> None:
+        self._call("set_dense", table_id, np.asarray(value, np.float32))
+
+    def pull_sparse(self, table_id, ids) -> np.ndarray:
+        return self._call("pull_sparse", table_id, np.asarray(ids, np.int64))
+
+    def push_sparse_grad(self, table_id, ids, grads) -> None:
+        self._call("push_sparse_grad", table_id,
+                   np.asarray(ids, np.int64), np.asarray(grads, np.float32))
+
+    def table_stats(self):
+        return self._call("table_stats")
+
+    def close(self):
+        self._sock.close()

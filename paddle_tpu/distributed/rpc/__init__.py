@@ -1,0 +1,187 @@
+"""``paddle.distributed.rpc`` — point-to-point RPC between workers.
+
+Reference counterpart: ``python/paddle/distributed/rpc/`` +
+``paddle/fluid/distributed/rpc/`` (brpc-backed sync/async RPC for
+heterogeneous workloads; SURVEY.md §2.2 "RPC").
+
+TPU-native design: the data plane (tensors) rides XLA collectives; RPC is a
+**control-plane** channel, so a length-prefixed pickle protocol over TCP
+sockets (one serving thread pool per worker) replaces brpc — no native dep,
+same API: ``init_rpc / rpc_sync / rpc_async / get_worker_info / shutdown``.
+Worker discovery goes through the native C++ ``TCPStore`` (rendezvous at
+``master_endpoint``), exactly like collective bootstrap.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as _fut
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from ..store import TCPStore
+
+__all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_state: Dict[str, Any] = {
+    "server": None, "store": None, "workers": {}, "by_rank": {},
+    "self": None, "pool": None,
+}
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj)
+    sock.sendall(struct.pack("!Q", len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    n = struct.unpack("!Q", hdr)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed mid-message")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            fn, args, kwargs = _recv_msg(self.request)
+        except ConnectionError:
+            return
+        try:
+            result = fn(*args, **(kwargs or {}))
+            _send_msg(self.request, ("ok", result))
+        except BaseException as e:  # ship the exception back to the caller
+            _send_msg(self.request, ("err", e))
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def _reachable_ip(master_host: str) -> str:
+    """The local address peers can dial: the source IP of a route toward the
+    master (no packets sent — connected UDP socket trick)."""
+    if master_host in ("127.0.0.1", "localhost", "0.0.0.0", ""):
+        return "127.0.0.1"
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect((master_host, 9))
+            return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """Start this worker's RPC server and rendezvous with peers.
+
+    Env fallbacks mirror the launcher contract: ``PADDLE_TRAINER_ID``,
+    ``PADDLE_TRAINERS_NUM``, ``PADDLE_MASTER``.
+    """
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+                  if world_size is None else world_size)
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:0")
+    host, port = master_endpoint.rsplit(":", 1)
+
+    server = _Server(("0.0.0.0", 0), _Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    my_port = server.server_address[1]
+    my_ip = os.environ.get("PADDLE_LOCAL_IP") or _reachable_ip(host)
+
+    store = TCPStore(host=host, port=int(port), is_master=(rank == 0),
+                     world_size=world_size)
+    me = WorkerInfo(name, rank, my_ip, my_port)
+    store.set(f"rpc/worker/{rank}", pickle.dumps(me))
+    workers, by_rank = {}, {}
+    for r in range(world_size):
+        info: WorkerInfo = pickle.loads(store.get(f"rpc/worker/{r}"))
+        workers[info.name] = info
+        by_rank[r] = info
+
+    _state.update(server=server, store=store, workers=workers,
+                  by_rank=by_rank, self=me,
+                  pool=_fut.ThreadPoolExecutor(max_workers=8))
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    if name is None:
+        return _state["self"]
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    return list(_state["by_rank"].values())
+
+
+def _invoke(to: str, fn, args, kwargs, timeout: float):
+    info = _state["workers"][to] if isinstance(to, str) else _state["by_rank"][to]
+    with socket.create_connection((info.ip, info.port), timeout=timeout or None) as s:
+        _send_msg(s, (fn, args, kwargs))
+        status, payload = _recv_msg(s)
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 180.0):
+    """Blocking remote call; returns the result (reference ``rpc_sync``)."""
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout: float = 180.0):
+    """Non-blocking remote call; returns a Future with ``.wait()``."""
+    fut = _state["pool"].submit(_invoke, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # paddle Future API compat
+    return fut
+
+
+def shutdown() -> None:
+    """Barrier across workers, then stop serving (reference ``shutdown``)."""
+    store: TCPStore = _state["store"]
+    if store is None:
+        return
+    world = store.world_size
+    store.add("rpc/shutdown", 1)
+    # wait for every rank to arrive before tearing the servers down
+    import time
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        if store.add("rpc/shutdown", 0) >= world:
+            break
+        time.sleep(0.02)
+    _state["pool"].shutdown(wait=True)
+    _state["server"].shutdown()
+    _state["server"].server_close()
+    store.close()
+    _state.update(server=None, store=None, workers={}, by_rank={},
+                  self=None, pool=None)

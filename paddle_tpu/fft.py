@@ -1,0 +1,119 @@
+"""``paddle.fft`` — discrete Fourier transforms.
+
+Reference counterpart: ``python/paddle/fft.py`` backed by the phi fft kernels
+(``paddle/phi/kernels/cpu|gpu/fft_*``, cuFFT on GPU; SURVEY.md §2.1 PHI
+kernel corpus). Here every transform lowers to ``jnp.fft`` — XLA dispatches
+to its native FFT implementation on TPU — wrapped as registered,
+differentiable ops on the eager tape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, to_tensor
+from .ops.dispatch import run_op
+from .ops.registry import register_op
+
+
+def _host(jfn):
+    """Run the transform on the host CPU backend: accelerator transports
+    without complex support (the axon TPU tunnel can neither transfer nor
+    re-feed complex64 buffers) would fail, and complex math is control-plane,
+    not an MXU workload — host execution is the TPU-native placement.
+    ``device_put`` is differentiable, so the op still joins the tape."""
+
+    def wrapped(a, **kw):
+        if jax.default_backend() == "cpu":
+            return jfn(a, **kw)
+        return jfn(jax.device_put(a, jax.devices("cpu")[0]), **kw)
+
+    return wrapped
+
+
+def _run_host_op(op_name, fn, x):
+    """run_op under a CPU default-device scope so eager sub-expressions of
+    the transform (norm constants, the vjp trace) stay off the accelerator."""
+    if jax.default_backend() == "cpu":
+        return run_op(op_name, fn, x)
+    with jax.default_device(jax.devices("cpu")[0]):
+        return run_op(op_name, fn, x)
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    # paddle uses "backward" | "forward" | "ortho" like numpy
+    return norm or "backward"
+
+
+def _wrap1(op_name, jfn, uses_n=True):
+    if uses_n:
+        def op(x, n=None, axis=-1, norm="backward", name=None):
+            return _run_host_op(op_name, lambda a: jfn(a, n=n, axis=axis,
+                                                       norm=_norm(norm)), x)
+    else:
+        def op(x, axes=None, name=None):
+            return _run_host_op(op_name, lambda a: jfn(a, axes=axes), x)
+    op.__name__ = op_name
+    return register_op(op_name)(op)
+
+
+def _wrapn(op_name, jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return _run_host_op(op_name, lambda a: jfn(a, s=s, axes=axes,
+                                                  norm=_norm(norm)), x)
+    op.__name__ = op_name
+    return register_op(op_name)(op)
+
+
+fft = _wrap1("fft", _host(jnp.fft.fft))
+ifft = _wrap1("ifft", _host(jnp.fft.ifft))
+rfft = _wrap1("rfft", _host(jnp.fft.rfft))
+irfft = _wrap1("irfft", _host(jnp.fft.irfft))
+hfft = _wrap1("hfft", _host(jnp.fft.hfft))
+ihfft = _wrap1("ihfft", _host(jnp.fft.ihfft))
+
+fftn = _wrapn("fftn", _host(jnp.fft.fftn))
+ifftn = _wrapn("ifftn", _host(jnp.fft.ifftn))
+rfftn = _wrapn("rfftn", _host(jnp.fft.rfftn))
+irfftn = _wrapn("irfftn", _host(jnp.fft.irfftn))
+
+
+def _wrap2(op_name, jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return _run_host_op(op_name, lambda a: jfn(a, s=s, axes=axes,
+                                                  norm=_norm(norm)), x)
+    op.__name__ = op_name
+    return register_op(op_name)(op)
+
+
+fft2 = _wrap2("fft2", _host(jnp.fft.fft2))
+ifft2 = _wrap2("ifft2", _host(jnp.fft.ifft2))
+rfft2 = _wrap2("rfft2", _host(jnp.fft.rfft2))
+irfft2 = _wrap2("irfft2", _host(jnp.fft.irfft2))
+
+
+@register_op("fftshift")
+def fftshift(x, axes=None, name=None) -> Tensor:
+    return _run_host_op("fftshift", _host(lambda a, **kw: jnp.fft.fftshift(a, axes=axes)), x)
+
+
+@register_op("ifftshift")
+def ifftshift(x, axes=None, name=None) -> Tensor:
+    return _run_host_op("ifftshift", _host(lambda a, **kw: jnp.fft.ifftshift(a, axes=axes)), x)
+
+
+@register_op("fftfreq", differentiable=False)
+def fftfreq(n, d=1.0, dtype=None, name=None) -> Tensor:
+    return to_tensor(jnp.fft.fftfreq(n, d=d).astype(dtype or jnp.float32))
+
+
+@register_op("rfftfreq", differentiable=False)
+def rfftfreq(n, d=1.0, dtype=None, name=None) -> Tensor:
+    return to_tensor(jnp.fft.rfftfreq(n, d=d).astype(dtype or jnp.float32))

@@ -7,6 +7,7 @@ eager/Layer-API model zoo lives in ``paddle_tpu.vision.models`` and the
 ``paddle_tpu.nn`` transformer layers.
 """
 
+from . import bert  # noqa: F401
 from . import llama  # noqa: F401
 
-__all__ = ["llama"]
+__all__ = ["bert", "llama"]

@@ -1,0 +1,318 @@
+"""BERT/ERNIE encoder family — masked-LM pretraining workload, TPU-first.
+
+Reference counterpart: PaddleNLP's BERT/ERNIE pretraining (BASELINE config 2:
+"ERNIE-base/BERT-base pretraining with flash-attention + AdamW"), built on the
+reference's transformer encoder layers (``python/paddle/nn/layer/transformer.py``)
+and Fleet TP layers (``.../meta_parallel/parallel_layers/mp_layers.py``,
+SURVEY.md §2.2).
+
+Same TPU-native design as ``llama.py`` (one pure jitted train step over a
+hybrid Mesh, scan over stacked layers, PartitionSpec-expressed Megatron TP +
+ZeRO, bf16 compute with fp32 master weights, per-layer remat) — but a
+bidirectional encoder: learned position + segment embeddings, post-LN blocks,
+GELU FFN, and a masked-LM loss over a label stream with an ignore index
+(the data pipeline masks 15% of tokens; unmasked positions carry
+``IGNORE_INDEX``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.pallas.flash_attention import dot_product_attention
+from ..parallel.mesh import with_sharding_constraint as wsc
+
+IGNORE_INDEX = -100
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    ln_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    sharding_stage: int = 1
+    remat: bool = True
+    sequence_parallel: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                 num_layers=2, num_heads=4, max_seq_len=64,
+                 dtype=jnp.float32, remat=False)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def bert_base(cls, **kw):
+        return cls(**kw)  # defaults above are base
+
+    @classmethod
+    def bert_large(cls, **kw):
+        d = dict(hidden_size=1024, intermediate_size=4096, num_layers=24,
+                 num_heads=16)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def ernie_base(cls, **kw):
+        """ERNIE 1.0/3.0-base budget (Chinese vocab size, same geometry)."""
+        d = dict(vocab_size=18000, type_vocab_size=4)
+        d.update(kw)
+        return cls(**d)
+
+
+# ---------------------------------------------------------------------------
+# Sharding rules
+# ---------------------------------------------------------------------------
+
+def param_specs(cfg: BertConfig) -> Dict[str, P]:
+    """TP: qkv/fc-in column-parallel (shard output dim on mp), proj/fc-out
+    row-parallel (shard input dim on mp); embeddings vocab-parallel.
+    ZeRO stage 3 shards the remaining dim over ('dp','sharding')."""
+    z = ("dp", "sharding") if cfg.sharding_stage >= 3 else None
+    return {
+        "embed": P("mp", z),            # [V, H] vocab-parallel
+        "pos_embed": P(None, z),        # [S, H]
+        "type_embed": P(None, z),       # [T, H]
+        "ln_embed_g": P(z),             # [H]
+        "ln_embed_b": P(z),
+        "wqkv": P(None, z, "mp"),       # [L, H, 3H] column-parallel
+        "bqkv": P(None, "mp"),          # [L, 3H]
+        "wo": P(None, "mp", z),         # [L, H, H] row-parallel
+        "bo": P(None, z),               # [L, H]
+        "ln1_g": P(None, z), "ln1_b": P(None, z),   # [L, H]
+        "w_in": P(None, z, "mp"),       # [L, H, F]
+        "b_in": P(None, "mp"),          # [L, F]
+        "w_out": P(None, "mp", z),      # [L, F, H]
+        "b_out": P(None, z),            # [L, H]
+        "ln2_g": P(None, z), "ln2_b": P(None, z),
+        "mlm_w": P(z, None),            # [H, H] MLM transform
+        "mlm_b": P(None),
+        "mlm_ln_g": P(None), "mlm_ln_b": P(None),
+        "mlm_bias": P("mp"),            # [V] output bias (embed is tied)
+    }
+
+
+def opt_state_specs(cfg: BertConfig) -> Dict[str, P]:
+    if cfg.sharding_stage < 1:
+        return param_specs(cfg)
+    z = ("dp", "sharding")
+    sp = dict(param_specs(cfg))
+    if cfg.sharding_stage < 3:  # moments always sharded from stage 1 up
+        sp.update({
+            "embed": P("mp", z), "pos_embed": P(None, z),
+            "type_embed": P(None, z), "ln_embed_g": P(z), "ln_embed_b": P(z),
+            "wqkv": P(None, z, "mp"), "wo": P(None, "mp", z),
+            "bo": P(None, z), "ln1_g": P(None, z), "ln1_b": P(None, z),
+            "w_in": P(None, z, "mp"), "w_out": P(None, "mp", z),
+            "b_out": P(None, z), "ln2_g": P(None, z), "ln2_b": P(None, z),
+            "mlm_w": P(z, None),
+        })
+    return sp
+
+
+def init_params(cfg: BertConfig, key: Optional[jax.Array] = None,
+                dtype: Any = None) -> Dict[str, jax.Array]:
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    dtype = dtype or jnp.float32
+    H, F, V, L = (cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
+                  cfg.num_layers)
+    ks = jax.random.split(key, 10)
+    n = jax.random.normal
+    std = 0.02
+    return {
+        "embed": (n(ks[0], (V, H)) * std).astype(dtype),
+        "pos_embed": (n(ks[1], (cfg.max_seq_len, H)) * std).astype(dtype),
+        "type_embed": (n(ks[2], (cfg.type_vocab_size, H)) * std).astype(dtype),
+        "ln_embed_g": jnp.ones((H,), dtype),
+        "ln_embed_b": jnp.zeros((H,), dtype),
+        "wqkv": (n(ks[3], (L, H, 3 * H)) * std).astype(dtype),
+        "bqkv": jnp.zeros((L, 3 * H), dtype),
+        "wo": (n(ks[4], (L, H, H)) * std).astype(dtype),
+        "bo": jnp.zeros((L, H), dtype),
+        "ln1_g": jnp.ones((L, H), dtype), "ln1_b": jnp.zeros((L, H), dtype),
+        "w_in": (n(ks[5], (L, H, F)) * std).astype(dtype),
+        "b_in": jnp.zeros((L, F), dtype),
+        "w_out": (n(ks[6], (L, F, H)) * std).astype(dtype),
+        "b_out": jnp.zeros((L, H), dtype),
+        "ln2_g": jnp.ones((L, H), dtype), "ln2_b": jnp.zeros((L, H), dtype),
+        "mlm_w": (n(ks[7], (H, H)) * std).astype(dtype),
+        "mlm_b": jnp.zeros((H,), dtype),
+        "mlm_ln_g": jnp.ones((H,), dtype), "mlm_ln_b": jnp.zeros((H,), dtype),
+        "mlm_bias": jnp.zeros((V,), dtype),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _ln(x, g, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return y.astype(x.dtype) * g.astype(x.dtype) + b.astype(x.dtype)
+
+
+def _act_spec(cfg: BertConfig) -> P:
+    seq = "sep" if cfg.sequence_parallel else None
+    return P(("dp", "sharding"), seq, None)
+
+
+def _encoder_layer(cfg: BertConfig, x, lp, pad_mask):
+    """Post-LN block. x: [B, S, H]; pad_mask: [B, S] bool (True = real)."""
+    B, S, H = x.shape
+    dt = x.dtype
+    qkv = x @ lp["wqkv"].astype(dt) + lp["bqkv"].astype(dt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(B, S, cfg.num_heads, cfg.head_dim)
+    v = v.reshape(B, S, cfg.num_heads, cfg.head_dim)
+    q = wsc(q, P(("dp", "sharding"), None, "mp", None))
+    # pad_mask [B, S] → broadcastable [B, 1, 1, S] key mask (None keeps the
+    # mask-free pallas fast path)
+    mask = None if pad_mask is None else pad_mask[:, None, None, :]
+    attn = dot_product_attention(q, k, v, mask=mask, is_causal=False)
+    attn = attn.reshape(B, S, H)
+    x = _ln(x + wsc(attn @ lp["wo"].astype(dt) + lp["bo"].astype(dt),
+                    _act_spec(cfg)),
+            lp["ln1_g"], lp["ln1_b"], cfg.ln_eps)
+    h = jax.nn.gelu(x @ lp["w_in"].astype(dt) + lp["b_in"].astype(dt),
+                    approximate=True)
+    x = _ln(x + wsc(h @ lp["w_out"].astype(dt) + lp["b_out"].astype(dt),
+                    _act_spec(cfg)),
+            lp["ln2_g"], lp["ln2_b"], cfg.ln_eps)
+    return x
+
+
+LAYER_KEYS = ("wqkv", "bqkv", "wo", "bo", "ln1_g", "ln1_b",
+              "w_in", "b_in", "w_out", "b_out", "ln2_g", "ln2_b")
+
+
+def encode(params, tokens, cfg: BertConfig, token_type_ids=None,
+           pad_mask=None):
+    """Contextual embeddings. tokens: [B, S] int32 → [B, S, H]."""
+    dt = cfg.dtype
+    B, S = tokens.shape
+    x = params["embed"].astype(dt)[tokens]
+    x = x + params["pos_embed"].astype(dt)[None, :S]
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(tokens)
+    x = x + params["type_embed"].astype(dt)[token_type_ids]
+    x = _ln(x, params["ln_embed_g"], params["ln_embed_b"], cfg.ln_eps)
+    x = wsc(x, _act_spec(cfg))
+
+    layer_weights = {k: params[k] for k in LAYER_KEYS}
+
+    def body(x, lp):
+        return _encoder_layer(cfg, x, lp, pad_mask), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, layer_weights)
+    return x
+
+
+def mlm_logits(params, x, cfg: BertConfig):
+    """MLM head: transform + tied-embedding decoder. x: [B,S,H] → [B,S,V]."""
+    dt = x.dtype
+    h = jax.nn.gelu(x @ params["mlm_w"].astype(dt) + params["mlm_b"].astype(dt),
+                    approximate=True)
+    h = _ln(h, params["mlm_ln_g"], params["mlm_ln_b"], cfg.ln_eps)
+    logits = h @ params["embed"].astype(dt).T + params["mlm_bias"].astype(dt)
+    return wsc(logits, P(("dp", "sharding"), None, "mp"))
+
+
+def forward(params, tokens, cfg: BertConfig, token_type_ids=None,
+            pad_mask=None):
+    x = encode(params, tokens, cfg, token_type_ids, pad_mask)
+    return mlm_logits(params, x, cfg)
+
+
+def loss_fn(params, tokens, labels, cfg: BertConfig):
+    """Masked-LM cross entropy in fp32 over positions where
+    ``labels != IGNORE_INDEX`` (the reference's
+    ``c_softmax_with_cross_entropy`` with ignore_index)."""
+    logits = forward(params, tokens, cfg).astype(jnp.float32)
+    valid = labels != IGNORE_INDEX
+    tgt = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+    per_tok = jnp.where(valid, logz - gold, 0.0)
+    return jnp.sum(per_tok) / jnp.maximum(jnp.sum(valid), 1)
+
+
+# ---------------------------------------------------------------------------
+# Training step — shares the AdamW/clip machinery with llama.py
+# ---------------------------------------------------------------------------
+
+from .llama import init_opt_state  # noqa: E402  (same pytree shape logic)
+from .llama import adamw_update  # noqa: E402
+
+# BERT convention: LayerNorm gains/biases, all biases, and embeddings are
+# exempt from decay (the reference's ``apply_decay_param_fun``).
+NO_DECAY_KEYS = frozenset(
+    k for k in ("embed", "pos_embed", "type_embed", "ln_embed_g",
+                "ln_embed_b", "bqkv", "bo", "ln1_g", "ln1_b", "b_in",
+                "b_out", "ln2_g", "ln2_b", "mlm_b", "mlm_ln_g", "mlm_ln_b",
+                "mlm_bias"))
+
+
+def train_step(params, opt_state, tokens, labels, cfg: BertConfig, lr=1e-4):
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels, cfg)
+    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for g in jax.tree.leaves(grads)))
+    clip = jnp.minimum(1.0, 1.0 / (gnorm + 1e-6))
+    grads = jax.tree.map(lambda g: g * clip, grads)
+    params, opt_state = adamw_update(params, grads, opt_state, lr=lr,
+                                     no_decay_keys=NO_DECAY_KEYS)
+    return params, opt_state, loss
+
+
+def make_sharded_train_step(cfg: BertConfig, mesh, lr=1e-4):
+    from jax.sharding import NamedSharding
+
+    ps = {k: NamedSharding(mesh, v) for k, v in param_specs(cfg).items()}
+    os_spec = {k: NamedSharding(mesh, v)
+               for k, v in opt_state_specs(cfg).items()}
+    opt_sh = {"step": NamedSharding(mesh, P()), "m": os_spec, "v": os_spec}
+    data_sh = NamedSharding(mesh, P(("dp", "sharding"), None))
+
+    step = functools.partial(train_step, cfg=cfg, lr=lr)
+    return jax.jit(
+        step,
+        in_shardings=(ps, opt_sh, data_sh, data_sh),
+        out_shardings=(ps, opt_sh, NamedSharding(mesh, P())),
+        donate_argnums=(0, 1),
+    )
+
+
+def random_mlm_batch(cfg: BertConfig, batch: int, seq: int, seed=0,
+                     mask_rate=0.15, mask_token=103):
+    """Synthetic MLM batch: (tokens-with-[MASK], labels-with-ignore)."""
+    rng = np.random.RandomState(seed)
+    clean = rng.randint(0, cfg.vocab_size, (batch, seq))
+    mask = rng.rand(batch, seq) < mask_rate
+    mask[:, 0] = True  # ensure ≥1 masked position per row
+    tokens = np.where(mask, mask_token % cfg.vocab_size, clean)
+    labels = np.where(mask, clean, IGNORE_INDEX)
+    return (jnp.array(tokens, jnp.int32), jnp.array(labels, jnp.int32))

@@ -276,11 +276,12 @@ NO_DECAY_KEYS = ("ln_attn", "ln_mlp", "ln_f", "embed")
 
 
 def adamw_update(params, grads, opt_state, lr=3e-4, beta1=0.9, beta2=0.95,
-                 eps=1e-8, weight_decay=0.1):
+                 eps=1e-8, weight_decay=0.1, no_decay_keys=None):
     """Fused-AdamW analog: one jitted tree-wide update (the reference's
     multi-tensor fused_adamw kernel; XLA fuses the per-leaf lambdas).
     Norm gains and the embedding are excluded from decay (the reference's
-    ``apply_decay_param_fun`` convention)."""
+    ``apply_decay_param_fun`` convention); callers with different naming
+    (e.g. models/bert.py) pass their own ``no_decay_keys``."""
     step = opt_state["step"] + 1
     t = step.astype(jnp.float32)
     c1 = 1.0 - jnp.power(beta1, t)
@@ -293,7 +294,8 @@ def adamw_update(params, grads, opt_state, lr=3e-4, beta1=0.9, beta2=0.95,
         update = (m / c1) / (jnp.sqrt(v / c2) + eps) + wd * p
         return p - lr * update, m, v
 
-    wds = {k: 0.0 if k in NO_DECAY_KEYS else weight_decay for k in params}
+    nd = NO_DECAY_KEYS if no_decay_keys is None else no_decay_keys
+    wds = {k: 0.0 if k in nd else weight_decay for k in params}
     out = jax.tree.map(upd, wds, params, grads, opt_state["m"],
                        opt_state["v"])
     new_p = jax.tree.map(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))

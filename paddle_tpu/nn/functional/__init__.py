@@ -403,12 +403,41 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 def _adaptive_pool(x, output_size, nd, kind):
     out_sz = _pair(output_size, nd)
     in_sz = tuple(x.shape[-nd:])
-    if any(i % o != 0 for i, o in zip(in_sz, out_sz)):
-        raise InvalidArgumentError(
-            f"adaptive pool: input spatial {in_sz} not divisible by output {out_sz}"
-        )
-    ks = tuple(i // o for i, o in zip(in_sz, out_sz))
-    return _pool_nd(x, ks, ks, 0, nd, kind, False, True, "NCHW", f"adaptive_{kind}_pool")
+    if all(i % o == 0 for i, o in zip(in_sz, out_sz)):
+        ks = tuple(i // o for i, o in zip(in_sz, out_sz))
+        return _pool_nd(x, ks, ks, 0, nd, kind, False, True, "NCHW", f"adaptive_{kind}_pool")
+    # General case (any in/out ratio, incl. upsampling): output cell i pools
+    # over [floor(i*I/O), ceil((i+1)*I/O)). One axis at a time: gather the
+    # max-width window per output index and reduce with a validity mask.
+    def pool_axis(a, axis, I, O):
+        starts = np.floor(np.arange(O) * I / O).astype(np.int64)
+        ends = np.ceil((np.arange(O) + 1) * I / O).astype(np.int64)
+        K = int((ends - starts).max())
+        idx = starts[:, None] + np.arange(K)[None, :]        # [O, K]
+        valid = idx < ends[:, None]
+        idx = np.clip(idx, 0, I - 1)
+
+        def f(v):
+            g = jnp.take(v, jnp.asarray(idx), axis=axis)     # [..., O, K, ...]
+            m = jnp.asarray(valid)
+            m = m.reshape((1,) * (axis % v.ndim) + m.shape +
+                          (1,) * (v.ndim - 1 - (axis % v.ndim)))
+            if kind == "avg":
+                g = jnp.where(m, g, 0.0)
+                return jnp.sum(g, axis=axis + 1) / jnp.sum(
+                    m.astype(g.dtype), axis=axis + 1)
+            g = jnp.where(m, g, -jnp.inf)
+            return jnp.max(g, axis=axis + 1)
+
+        return f
+
+    def f(a):
+        for d in range(nd):
+            axis = a.ndim - nd + d
+            a = pool_axis(a, axis, in_sz[d], out_sz[d])(a)
+        return a
+
+    return run_op(f"adaptive_{kind}_pool", f, x)
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):

@@ -4,7 +4,7 @@ Every op is a thin, registered lowering to jax/XLA primitives; fused/Pallas
 kernels live in ``paddle_tpu.ops.pallas``.
 """
 
-from . import creation, linalg, logic, manipulation, math, reduction
+from . import creation, linalg, logic, manipulation, math, reduction, special
 from .creation import *  # noqa: F401,F403
 from .dispatch import run_op  # noqa: F401
 from .linalg import *  # noqa: F401,F403
@@ -12,6 +12,7 @@ from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .reduction import *  # noqa: F401,F403
+from .special import *  # noqa: F401,F403
 from .registry import OPS, all_ops, get_op, register_op  # noqa: F401
 
 from . import _tensor_methods
@@ -26,5 +27,6 @@ __all__ = list(
         + manipulation.__all__
         + logic.__all__
         + linalg.__all__
+        + special.__all__
     )
 )

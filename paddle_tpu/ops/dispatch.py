@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from .. import flags
 from ..core import autograd
 from ..core.autograd import GradNode
-from ..core.dtype import is_floating_dtype
+from ..core.dtype import is_differentiable_dtype, is_floating_dtype
 from ..core.tensor import Tensor
 
 __all__ = ["run_op", "as_tensor_args"]
@@ -139,7 +139,7 @@ def run_op(
         [
             i
             for i, t in enumerate(tensors)
-            if not t.stop_gradient and is_floating_dtype(arrays[i].dtype)
+            if not t.stop_gradient and is_differentiable_dtype(arrays[i].dtype)
         ]
         if autograd.is_grad_enabled()
         else []
@@ -217,7 +217,7 @@ def _wrap(name, out, record, n_diff_outputs):
         )
 
     for i, o in enumerate(outs):
-        differentiable = record is not None and i < n_diff and is_floating_dtype(o.dtype)
+        differentiable = record is not None and i < n_diff and is_differentiable_dtype(o.dtype)
         t = Tensor(o, stop_gradient=not differentiable, name=f"{name}.out")
         if differentiable:
             t._grad_node = node

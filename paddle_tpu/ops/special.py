@@ -1,0 +1,154 @@
+"""Special-function / statistics ops rounding out the corpus.
+
+Reference counterparts: ``paddle.bincount``/``histogram`` (phi kernels
+``paddle/phi/kernels/cpu|gpu/bincount_kernel.*``, ``histogram_kernel.*``),
+``paddle.cross``, ``paddle.cdist``/``dist``, ``paddle.renorm``,
+``paddle.i0/i0e/i1/i1e``, ``paddle.polygamma``, ``paddle.poisson``
+(SURVEY.md §2.1 PHI kernel corpus).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..framework.random import next_key
+from .dispatch import run_op
+from .registry import register_op
+
+__all__ = [
+    "bincount", "histogram", "histogramdd", "cross", "cdist", "dist",
+    "renorm", "i0", "i0e", "i1", "i1e", "polygamma", "poisson",
+]
+
+
+@register_op(differentiable=False)
+def bincount(x, weights=None, minlength=0, name=None) -> Tensor:
+    xv = x._value
+    # jnp.bincount needs a static length: use minlength or the data max
+    # (concrete here — eager op, not traced).
+    length = max(int(minlength), int(jnp.max(xv)) + 1 if xv.size else 0)
+    w = weights._value if isinstance(weights, Tensor) else weights
+    return to_tensor(jnp.bincount(xv.reshape(-1), weights=None if w is None
+                                  else w.reshape(-1), length=length))
+
+
+@register_op(differentiable=False)
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None) -> Tensor:
+    xv = input._value.reshape(-1).astype(jnp.float32)
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(xv), jnp.max(xv)
+        lo, hi = jnp.where(lo == hi, lo - 0.5, lo), jnp.where(lo == hi, hi + 0.5, hi)
+    else:
+        lo, hi = jnp.float32(min), jnp.float32(max)
+    w = weight._value.reshape(-1) if isinstance(weight, Tensor) else weight
+    hist, _ = jnp.histogram(xv, bins=bins, range=(lo, hi), weights=w,
+                            density=density)
+    return to_tensor(hist)
+
+
+@register_op(differentiable=False)
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    xv = x._value.astype(jnp.float32)
+    w = weights._value if isinstance(weights, Tensor) else weights
+    if isinstance(bins, (list, tuple)) and len(bins) and isinstance(
+            bins[0], Tensor):
+        bins = [b._value for b in bins]
+    hist, edges = jnp.histogramdd(xv, bins=bins, range=ranges, weights=w,
+                                  density=density)
+    return to_tensor(hist), [to_tensor(e) for e in edges]
+
+
+@register_op()
+def cross(x, y, axis=9, name=None) -> Tensor:
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis of size 3
+            ax = next(i for i, d in enumerate(a.shape) if d == 3)
+        return jnp.cross(a, b, axis=ax)
+    return run_op("cross", f, x, y)
+
+
+@register_op()
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None) -> Tensor:
+    """Pairwise p-norm distance [..., P, M] x [..., R, M] -> [..., P, R].
+    Euclidean case uses the matmul expansion (MXU-friendly) like the
+    reference's use_mm_for_euclid_dist mode."""
+    def f(a, b):
+        if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
+            a2 = jnp.sum(a * a, -1)[..., :, None]
+            b2 = jnp.sum(b * b, -1)[..., None, :]
+            sq = a2 + b2 - 2.0 * (a @ jnp.swapaxes(b, -1, -2))
+            return jnp.sqrt(jnp.maximum(sq, 0.0))
+        diff = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 0:
+            return jnp.sum(diff != 0, -1).astype(a.dtype)
+        if jnp.isinf(p):
+            return jnp.max(diff, -1)
+        return jnp.sum(diff ** p, -1) ** (1.0 / p)
+    return run_op("cdist", f, x, y)
+
+
+@register_op()
+def dist(x, y, p=2, name=None) -> Tensor:
+    def f(a, b):
+        d = jnp.abs(a - b).reshape(-1)
+        pf = float(p)
+        if pf == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        if jnp.isinf(pf):
+            return jnp.max(d)
+        return jnp.sum(d ** pf) ** (1.0 / pf)
+    return run_op("dist", f, x, y)
+
+
+@register_op()
+def renorm(x, p, axis, max_norm, name=None) -> Tensor:
+    """Renormalise sub-tensors along ``axis`` whose p-norm exceeds
+    ``max_norm`` (reference ``paddle.renorm``)."""
+    def f(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+    return run_op("renorm", f, x)
+
+
+@register_op()
+def i0(x, name=None) -> Tensor:
+    return run_op("i0", lambda a: jax.scipy.special.i0(a), x)
+
+
+@register_op()
+def i0e(x, name=None) -> Tensor:
+    return run_op("i0e", lambda a: jax.scipy.special.i0e(a), x)
+
+
+@register_op()
+def i1(x, name=None) -> Tensor:
+    return run_op("i1", lambda a: jax.scipy.special.i1(a), x)
+
+
+@register_op()
+def i1e(x, name=None) -> Tensor:
+    return run_op("i1e", lambda a: jax.scipy.special.i1e(a), x)
+
+
+@register_op()
+def polygamma(x, n, name=None) -> Tensor:
+    if n == 0:
+        return run_op("polygamma", lambda a: jax.scipy.special.digamma(a), x)
+    return run_op("polygamma",
+                  lambda a: jax.scipy.special.polygamma(n, a), x)
+
+
+@register_op(differentiable=False)
+def poisson(x, name=None) -> Tensor:
+    return to_tensor(
+        jax.random.poisson(next_key(), x._value).astype(x._value.dtype))

@@ -1,0 +1,336 @@
+"""``paddle.quantization`` — QAT / PTQ framework.
+
+Reference counterpart: ``python/paddle/quantization/`` (SURVEY.md §2.1
+"Quantization"): ``QuantConfig`` (per-layer/per-type quanter config),
+quanters (``FakeQuanterWithAbsMaxObserver``), observers (AbsMax / moving-
+average AbsMax), and the ``QAT``/``PTQ`` quantize→convert workflows.
+
+TPU-native design (not a port):
+
+* Fake-quant is a **straight-through estimator expressed as
+  ``jax.custom_vjp``** — one pure function the eager tape differentiates
+  through, and that whole-graph ``jit`` traces into the XLA program (no
+  Python in the hot path).
+* ``convert`` produces layers holding **real int8 weights** whose forward is
+  an int8×int8→int32 ``lax.dot_general`` (``preferred_element_type``) — the
+  TPU MXU's native int8 path — followed by a per-channel rescale, rather than
+  the reference's simulated dequant-then-fp32-matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import run_op
+
+__all__ = [
+    "QuantConfig", "BaseQuanter", "BaseObserver",
+    "FakeQuanterWithAbsMax", "MovingAverageAbsmaxQuanter",
+    "AbsmaxObserver", "MovingAverageAbsmaxObserver",
+    "QAT", "PTQ", "QuantedLinear", "Int8Linear", "quanter",
+]
+
+
+# ---------------------------------------------------------------------------
+# Fake quantization primitive (STE)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fake_quant(x, scale, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _fake_quant_fwd(x, scale, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    mask = jnp.abs(x) <= s  # pass-through region
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax, (mask, jnp.asarray(scale))
+
+
+def _fake_quant_bwd(bits, res, g):
+    mask, scale = res
+    # STE: identity inside the clip range, zero outside; no grad to scale
+    # (cotangent shape/dtype must match the primal scale, incl. per-channel)
+    return (g * mask.astype(g.dtype), jnp.zeros_like(scale))
+
+
+_fake_quant.defvjp(_fake_quant_fwd, _fake_quant_bwd)
+
+
+def fake_quant(x: Tensor, scale, bits: int = 8) -> Tensor:
+    """Differentiable (STE) fake-quantisation of ``x`` to ``bits`` bits."""
+    sval = scale._value if isinstance(scale, Tensor) else jnp.asarray(scale)
+    return run_op("fake_quantize",
+                  lambda a: _fake_quant(a, sval, bits), x)
+
+
+# ---------------------------------------------------------------------------
+# Observers & quanters
+# ---------------------------------------------------------------------------
+
+class BaseObserver(Layer):
+    """Collects activation statistics during PTQ calibration."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def scales(self):
+        return self._scale
+
+    def forward(self, x):
+        self._observe(x)
+        return x
+
+    def _observe(self, x):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running max(|x|) (reference AbsmaxObserver)."""
+
+    def _observe(self, x):
+        m = float(jnp.max(jnp.abs(x._value)))
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class MovingAverageAbsmaxObserver(BaseObserver):
+    """EMA of max(|x|) (reference MovingAverageAbsMaxObserver)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+
+    def _observe(self, x):
+        m = float(jnp.max(jnp.abs(x._value)))
+        self._scale = (m if self._scale is None
+                       else self.moving_rate * self._scale
+                       + (1 - self.moving_rate) * m)
+
+
+class BaseQuanter(Layer):
+    """Applies fake-quant in the forward pass (QAT)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def scales(self):
+        return self._scale
+
+
+class FakeQuanterWithAbsMax(BaseQuanter):
+    """Per-tensor absmax fake quanter (reference
+    FakeQuanterWithAbsMaxObserver): scale tracks the current batch's absmax
+    with an EMA; forward applies STE fake-quant."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+
+    def forward(self, x):
+        m = float(jnp.max(jnp.abs(x._value)))
+        self._scale = (m if self._scale is None
+                       else self.moving_rate * self._scale
+                       + (1 - self.moving_rate) * m)
+        return fake_quant(x, self._scale, self.quant_bits)
+
+
+MovingAverageAbsmaxQuanter = FakeQuanterWithAbsMax
+
+
+class _QuanterFactory:
+    def __init__(self, cls: Type, **kw):
+        self.cls = cls
+        self.kw = kw
+
+    def instance(self):
+        return self.cls(**self.kw)
+
+
+def quanter(cls_or_name, **kw) -> _QuanterFactory:
+    """Factory helper mirroring the reference's ``quanter()`` decorator
+    usage: ``QuantConfig(activation=quanter(FakeQuanterWithAbsMax))``."""
+    return _QuanterFactory(cls_or_name, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Config
+# ---------------------------------------------------------------------------
+
+class QuantConfig:
+    """Which layers get which activation/weight quanters (reference
+    ``paddle/quantization/config.py``): global default + per-type +
+    per-layer(name) overrides."""
+
+    def __init__(self, activation=None, weight=None):
+        self.default = (activation, weight)
+        self._by_type: Dict[type, tuple] = {}
+        self._by_name: Dict[str, tuple] = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._by_type[t] = (activation, weight)
+
+    def add_name_config(self, names, activation=None, weight=None):
+        for n in (names if isinstance(names, (list, tuple)) else [names]):
+            self._by_name[n] = (activation, weight)
+
+    def config_for(self, name: str, layer: Layer):
+        if name in self._by_name:
+            return self._by_name[name]
+        for t, cfg in self._by_type.items():
+            if isinstance(layer, t):
+                return cfg
+        return self.default
+
+
+# ---------------------------------------------------------------------------
+# Quantized layers
+# ---------------------------------------------------------------------------
+
+class QuantedLinear(Layer):
+    """QAT/PTQ wrapper around ``nn.Linear``: quant(act) @ quant(weight)."""
+
+    def __init__(self, linear, act_quanter=None, weight_quanter=None):
+        super().__init__()
+        self.weight = linear.weight
+        self.bias = linear.bias
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self.bias)
+
+
+class Int8Linear(Layer):
+    """Deployed int8 linear: per-output-channel int8 weights, int8
+    activations, int32 accumulation on the MXU, fp rescale epilogue."""
+
+    def __init__(self, weight_i8: np.ndarray, w_scales: np.ndarray,
+                 act_scale: float, bias=None, bits: int = 8):
+        super().__init__()
+        self.register_buffer("weight_i8", to_tensor(jnp.asarray(weight_i8,
+                                                                jnp.int8)))
+        self.register_buffer("w_scales", to_tensor(jnp.asarray(w_scales,
+                                                               jnp.float32)))
+        self.act_scale = float(act_scale)
+        self.bias = bias
+        self.qmax = float(2 ** (bits - 1) - 1)
+
+    def forward(self, x):
+        wi8 = self.weight_i8._value
+        wsc = self.w_scales._value
+        a_s = self.act_scale
+        qmax = self.qmax
+        bias = None if self.bias is None else self.bias
+
+        def f(a, *maybe_bias):
+            xi8 = jnp.clip(jnp.round(a / a_s * qmax), -qmax, qmax
+                           ).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xi8, wi8, (((a.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (wsc * a_s / (qmax * qmax))
+            if maybe_bias:
+                out = out + maybe_bias[0]
+            return out.astype(a.dtype)
+
+        args = (x,) if bias is None else (x, bias)
+        return run_op("int8_linear", f, *args)
+
+
+# ---------------------------------------------------------------------------
+# Workflows
+# ---------------------------------------------------------------------------
+
+from ..nn.layer.common import Linear  # noqa: E402
+
+
+class _QuantizeWorkflow:
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    @staticmethod
+    def _maybe_copy(model: Layer, inplace: bool) -> Layer:
+        if inplace:
+            return model
+        import copy
+
+        return copy.deepcopy(model)
+
+    def _wrap(self, model: Layer, observer_mode: bool) -> Layer:
+        for name, child in list(model.named_children()):
+            act_f, w_f = self.config.config_for(name, child)
+            if isinstance(child, Linear) and (act_f or w_f):
+                aq = act_f.instance() if act_f else None
+                wq = w_f.instance() if w_f else None
+                setattr(model, name, QuantedLinear(child, aq, wq))
+            else:
+                self._wrap(child, observer_mode)
+        return model
+
+
+class QAT(_QuantizeWorkflow):
+    """Quantization-aware training: insert fake quanters (STE)."""
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        return self._wrap(self._maybe_copy(model, inplace),
+                          observer_mode=False)
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        return _convert(self._maybe_copy(model, inplace))
+
+
+class PTQ(_QuantizeWorkflow):
+    """Post-training quantization: insert observers, calibrate by running
+    forward passes, then ``convert``."""
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        return self._wrap(self._maybe_copy(model, inplace),
+                          observer_mode=True)
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        return _convert(self._maybe_copy(model, inplace))
+
+
+def _convert(model: Layer) -> Layer:
+    """Replace QuantedLinear with real-int8 Int8Linear using collected
+    scales (per-output-channel weight scales recomputed from the weights)."""
+    for name, child in list(model.named_children()):
+        if isinstance(child, QuantedLinear):
+            w = np.asarray(child.weight._value, np.float32)  # [in, out]
+            bits = (child.weight_quanter.quant_bits
+                    if child.weight_quanter else 8)
+            qmax = 2 ** (bits - 1) - 1
+            w_scales = np.maximum(np.abs(w).max(axis=0), 1e-9)  # per out-ch
+            wi8 = np.clip(np.round(w / w_scales * qmax), -qmax, qmax
+                          ).astype(np.int8)
+            aq = child.activation_quanter
+            act_scale = (aq.scales() if aq is not None and aq.scales()
+                         else 1.0)
+            setattr(model, name, Int8Linear(wi8, w_scales, act_scale,
+                                            bias=child.bias, bits=bits))
+        else:
+            _convert(child)
+    return model

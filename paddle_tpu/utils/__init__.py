@@ -1,0 +1,5 @@
+"""``paddle.utils`` — extension loading and misc helpers."""
+
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["cpp_extension"]

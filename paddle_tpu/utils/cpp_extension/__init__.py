@@ -1,0 +1,216 @@
+"""``paddle.utils.cpp_extension`` — runtime-compiled custom C++ ops.
+
+Reference counterpart: ``python/paddle/utils/cpp_extension/`` +
+``paddle/phi/api/ext/`` (``PD_BUILD_OP`` user ops compiled with nvcc/g++ and
+loaded at runtime; SURVEY.md §2.1 "Custom C++ op API").
+
+TPU-native design: the compiled op runs on the **host** and is stitched into
+the XLA program as a host callback (``jax.pure_callback``) — the TPU analog
+of the reference's CPU custom kernels. The C ABI is defined in
+``include/paddle_ext.h`` (one function per op over ``PTTensor`` views).
+Custom autograd: pass ``backward=`` (another C function) and the op joins
+the eager tape with a custom VJP.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import run_op
+from ...ops.registry import register_op
+
+__all__ = ["load", "get_include", "CppExtension", "CustomOpModule"]
+
+_DTYPE_CODE = {np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+               np.dtype(np.int32): 2, np.dtype(np.int64): 3,
+               np.dtype(np.bool_): 4}
+
+
+def get_include() -> str:
+    """Directory containing ``paddle_ext.h`` (reference:
+    ``paddle.utils.cpp_extension.get_include``)."""
+    return os.path.join(os.path.dirname(__file__), "include")
+
+
+class _PTTensor(ctypes.Structure):
+    _fields_ = [("data", ctypes.c_void_p), ("shape", ctypes.c_void_p),
+                ("ndim", ctypes.c_int32), ("dtype", ctypes.c_int32)]
+
+
+def _build(name: str, sources: Sequence[str], extra_cflags: Sequence[str],
+           build_directory: Optional[str]) -> str:
+    """Compile sources into a shared library (content-hash cached)."""
+    srcs = []
+    tmp_files = []
+    for s in sources:
+        if os.path.exists(s):
+            srcs.append(s)
+        else:  # inline source string
+            f = tempfile.NamedTemporaryFile(
+                "w", suffix=".cc", delete=False, prefix=f"{name}_")
+            f.write(s)
+            f.close()
+            srcs.append(f.name)
+            tmp_files.append(f.name)
+    h = hashlib.sha256()
+    for s in srcs:
+        h.update(open(s, "rb").read())
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, f"{name}_{h.hexdigest()[:12]}.so")
+    if not os.path.exists(out):
+        cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+               f"-I{get_include()}", *extra_cflags, "-o", out, *srcs]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{' '.join(cmd)}\n{proc.stderr}")
+    for f in tmp_files:
+        os.unlink(f)
+    return out
+
+
+class CustomOpModule:
+    """Handle over a compiled extension; ``define_op`` wires C functions into
+    the op registry / eager tape."""
+
+    def __init__(self, name: str, lib_path: str):
+        self.name = name
+        self.lib_path = lib_path
+        self._cdll = ctypes.CDLL(lib_path)
+
+    def _call_raw(self, fn_name: str, arrays: List[np.ndarray],
+                  out_specs: List[tuple]) -> List[np.ndarray]:
+        fn = getattr(self._cdll, fn_name)
+        n_in, n_out = len(arrays), len(out_specs)
+        ins = (_PTTensor * max(n_in, 1))()
+        keep = []  # keep ctypes buffers alive through the call
+        for i, a in enumerate(arrays):
+            a = np.ascontiguousarray(a)
+            shape = (ctypes.c_int64 * a.ndim)(*a.shape)
+            keep.append((a, shape))
+            ins[i].data = a.ctypes.data_as(ctypes.c_void_p)
+            ins[i].shape = ctypes.cast(shape, ctypes.c_void_p)
+            ins[i].ndim = a.ndim
+            ins[i].dtype = _DTYPE_CODE[a.dtype]
+        outs = (_PTTensor * max(n_out, 1))()
+        out_arrays = []
+        for i, (shp, dt) in enumerate(out_specs):
+            o = np.empty(shp, dtype=dt)
+            shape = (ctypes.c_int64 * max(o.ndim, 1))(*(o.shape or (0,)))
+            keep.append((o, shape))
+            outs[i].data = o.ctypes.data_as(ctypes.c_void_p)
+            outs[i].shape = ctypes.cast(shape, ctypes.c_void_p)
+            outs[i].ndim = o.ndim
+            outs[i].dtype = _DTYPE_CODE[o.dtype]
+            out_arrays.append(o)
+        fn(ins, n_in, outs, n_out)
+        return out_arrays
+
+    def define_op(self, fn_name: str,
+                  out_shape_fn: Optional[Callable] = None,
+                  backward: Optional[str] = None,
+                  backward_out_shape_fn: Optional[Callable] = None):
+        """Create the Python-callable op.
+
+        ``out_shape_fn(*in_shape_dtype) -> [(shape, dtype), ...]`` infers
+        output shapes (InferMeta analog); defaults to same-as-first-input.
+        ``backward``: name of the C grad function taking (inputs..., grad_out)
+        and writing input gradients.
+        """
+
+        def infer(avals):
+            if out_shape_fn is None:
+                return [(avals[0][0], avals[0][1])]
+            return out_shape_fn(*avals)
+
+        def host_call(*arrays):
+            avals = [(a.shape, a.dtype) for a in arrays]
+            outs = self._call_raw(fn_name, list(arrays), infer(avals))
+            return outs[0] if len(outs) == 1 else tuple(outs)
+
+        def pure(*xs):
+            avals = [(x.shape, np.dtype(str(x.dtype))) for x in xs]
+            specs = infer(avals)
+            result_shape = [jax.ShapeDtypeStruct(s, d) for s, d in specs]
+            out = jax.pure_callback(
+                host_call, result_shape[0] if len(specs) == 1
+                else tuple(result_shape), *xs)
+            return out
+
+        if backward is not None:
+            bwd_infer = backward_out_shape_fn or (
+                lambda *avals: [avals[0]])
+
+            @jax.custom_vjp
+            def op_fn(*xs):
+                return pure(*xs)
+
+            def fwd(*xs):
+                return pure(*xs), xs
+
+            def bwd(res, g):
+                xs = res
+                avals = [(x.shape, np.dtype(str(x.dtype))) for x in xs]
+                specs = bwd_infer(*avals)
+                result_shape = [jax.ShapeDtypeStruct(s, d) for s, d in specs]
+
+                def host_bwd(*arrays):
+                    av = [(a.shape, a.dtype) for a in arrays]
+                    return tuple(self._call_raw(backward, list(arrays),
+                                                bwd_infer(*av[:len(xs)])))
+
+                grads = jax.pure_callback(host_bwd, tuple(result_shape),
+                                          *xs, g)
+                # pad with zeros for non-differentiable trailing inputs
+                grads = tuple(grads) + tuple(
+                    jnp.zeros(x.shape, x.dtype) for x in xs[len(grads):])
+                return grads
+
+            op_fn.defvjp(fwd, bwd)
+            impl = op_fn
+        else:
+            impl = pure
+
+        def op(*tensors):
+            return run_op(f"{self.name}.{fn_name}", impl, *tensors)
+
+        op.__name__ = fn_name
+        register_op(f"custom_{fn_name}")(op)
+        setattr(self, fn_name, op)
+        return op
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cflags: Sequence[str] = (),
+         build_directory: Optional[str] = None, verbose: bool = False
+         ) -> CustomOpModule:
+    """Compile + load a custom op extension (reference:
+    ``paddle.utils.cpp_extension.load``)."""
+    lib = _build(name, sources, extra_cflags, build_directory)
+    return CustomOpModule(name, lib)
+
+
+class CppExtension:
+    """setuptools-style descriptor (reference ``CppExtension``); with no
+    ahead-of-time wheel build here, ``.load()`` JIT-compiles instead."""
+
+    def __init__(self, sources: Sequence[str], name: str = "custom_ext",
+                 extra_compile_args: Sequence[str] = ()):
+        self.name = name
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args)
+
+    def load(self) -> CustomOpModule:
+        return load(self.name, self.sources, self.extra_compile_args)

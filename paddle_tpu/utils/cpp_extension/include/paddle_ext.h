@@ -1,0 +1,57 @@
+/* Custom C++ op ABI for paddle_tpu.
+ *
+ * Reference counterpart: the custom-op header `paddle/phi/api/ext/op_meta_info.h`
+ * (`PD_BUILD_OP`; SURVEY.md §2.1 "Custom C++ op API"). Here the contract is a
+ * plain C ABI: an op is `extern "C" void name(const PTTensor* ins, int n_in,
+ * PTMutableTensor* outs, int n_out)`. Host-side execution only — on TPU the
+ * call runs as an XLA host callback; heavy math belongs in XLA/Pallas, custom
+ * C++ ops cover CPU-side logic (tokenisers, samplers, custom IO).
+ */
+#ifndef PADDLE_TPU_EXT_H
+#define PADDLE_TPU_EXT_H
+
+#include <cstdint>
+
+extern "C" {
+
+/* dtype codes shared with the Python side */
+enum PTDtype : int32_t {
+  PT_FLOAT32 = 0,
+  PT_FLOAT64 = 1,
+  PT_INT32 = 2,
+  PT_INT64 = 3,
+  PT_BOOL = 4,
+};
+
+typedef struct {
+  const void* data;
+  const int64_t* shape;
+  int32_t ndim;
+  int32_t dtype;
+} PTTensor;
+
+typedef struct {
+  void* data;
+  const int64_t* shape;
+  int32_t ndim;
+  int32_t dtype;
+} PTMutableTensor;
+
+typedef void (*PTOpFn)(const PTTensor* ins, int32_t n_in,
+                       PTMutableTensor* outs, int32_t n_out);
+
+}  /* extern "C" */
+
+static inline int64_t pt_numel(const PTTensor* t) {
+  int64_t n = 1;
+  for (int32_t i = 0; i < t->ndim; ++i) n *= t->shape[i];
+  return n;
+}
+
+static inline int64_t pt_numel_mut(const PTMutableTensor* t) {
+  int64_t n = 1;
+  for (int32_t i = 0; i < t->ndim; ++i) n *= t->shape[i];
+  return n;
+}
+
+#endif  /* PADDLE_TPU_EXT_H */

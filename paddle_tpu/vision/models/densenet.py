@@ -1,0 +1,73 @@
+"""DenseNet 121/161/169/201/264 (reference: ``python/paddle/vision/models/densenet.py``)."""
+
+from ... import nn
+from ...ops import manipulation as M
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, inp, growth, bn_size):
+        super().__init__()
+        self.block = nn.Sequential(
+            nn.BatchNorm2D(inp), nn.ReLU(),
+            nn.Conv2D(inp, bn_size * growth, 1, bias_attr=False),
+            nn.BatchNorm2D(bn_size * growth), nn.ReLU(),
+            nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                      bias_attr=False))
+
+    def forward(self, x):
+        return M.concat([x, self.block(x)], axis=1)
+
+
+class _Transition(nn.Sequential):
+    def __init__(self, inp, oup):
+        super().__init__(
+            nn.BatchNorm2D(inp), nn.ReLU(),
+            nn.Conv2D(inp, oup, 1, bias_attr=False),
+            nn.AvgPool2D(2, 2))
+
+
+_CFG = {121: (32, (6, 12, 24, 16), 64), 161: (48, (6, 12, 36, 24), 96),
+        169: (32, (6, 12, 32, 32), 64), 201: (32, (6, 12, 48, 32), 64),
+        264: (32, (6, 12, 64, 48), 64)}
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, num_classes=1000):
+        super().__init__()
+        growth, blocks, init_ch = _CFG[layers]
+        feats = [nn.Sequential(
+            nn.Conv2D(3, init_ch, 7, 2, 3, bias_attr=False),
+            nn.BatchNorm2D(init_ch), nn.ReLU(), nn.MaxPool2D(3, 2, 1))]
+        ch = init_ch
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth, bn_size))
+                ch += growth
+            if i != len(blocks) - 1:
+                feats.append(_Transition(ch, ch // 2))
+                ch //= 2
+        feats.append(nn.BatchNorm2D(ch))
+        feats.append(nn.ReLU())
+        self.features = nn.Sequential(*feats)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        return self.fc(x.flatten(1))
+
+
+def _make(depth):
+    def f(pretrained=False, **kwargs):
+        return DenseNet(layers=depth, **kwargs)
+    return f
+
+
+densenet121 = _make(121)
+densenet161 = _make(161)
+densenet169 = _make(169)
+densenet201 = _make(201)
+densenet264 = _make(264)

@@ -1,0 +1,61 @@
+"""GoogLeNet / InceptionV1 (reference: ``python/paddle/vision/models/googlenet.py``)."""
+
+from ... import nn
+from ...ops import manipulation as M
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class _ConvBN(nn.Sequential):
+    def __init__(self, inp, oup, k, **kw):
+        super().__init__(nn.Conv2D(inp, oup, k, bias_attr=False, **kw),
+                         nn.BatchNorm2D(oup), nn.ReLU())
+
+
+class Inception(nn.Layer):
+    def __init__(self, inp, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = _ConvBN(inp, c1, 1)
+        self.b2 = nn.Sequential(_ConvBN(inp, c3r, 1),
+                                _ConvBN(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_ConvBN(inp, c5r, 1),
+                                _ConvBN(c5r, c5, 3, padding=1))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, 1), _ConvBN(inp, pp, 1))
+
+    def forward(self, x):
+        return M.concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                        axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _ConvBN(3, 64, 7, stride=2, padding=3), nn.MaxPool2D(3, 2, 1),
+            _ConvBN(64, 64, 1), _ConvBN(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, 2, 1))
+        self.inc3 = nn.Sequential(
+            Inception(192, 64, 96, 128, 16, 32, 32),
+            Inception(256, 128, 128, 192, 32, 96, 64), nn.MaxPool2D(3, 2, 1))
+        self.inc4 = nn.Sequential(
+            Inception(480, 192, 96, 208, 16, 48, 64),
+            Inception(512, 160, 112, 224, 24, 64, 64),
+            Inception(512, 128, 128, 256, 24, 64, 64),
+            Inception(512, 112, 144, 288, 32, 64, 64),
+            Inception(528, 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, 2, 1))
+        self.inc5 = nn.Sequential(
+            Inception(832, 256, 160, 320, 32, 128, 128),
+            Inception(832, 384, 192, 384, 48, 128, 128))
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.2)
+        self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.inc5(self.inc4(self.inc3(self.stem(x))))
+        x = self.dropout(self.pool(x).flatten(1))
+        return self.fc(x)
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,53 @@
+"""MobileNetV1 (reference: ``python/paddle/vision/models/mobilenetv1.py``)."""
+
+from ... import nn
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class _DWSep(nn.Layer):
+    """Depthwise-separable conv block (dw 3x3 + pw 1x1, BN+ReLU each)."""
+
+    def __init__(self, inp, oup, stride):
+        super().__init__()
+        self.dw = nn.Sequential(
+            nn.Conv2D(inp, inp, 3, stride, 1, groups=inp, bias_attr=False),
+            nn.BatchNorm2D(inp), nn.ReLU())
+        self.pw = nn.Sequential(
+            nn.Conv2D(inp, oup, 1, bias_attr=False),
+            nn.BatchNorm2D(oup), nn.ReLU())
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        c = lambda ch: max(8, int(ch * scale))
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2)] + \
+              [(512, 1)] * 5 + [(1024, 2), (1024, 1)]
+        layers = [nn.Sequential(
+            nn.Conv2D(3, c(32), 3, 2, 1, bias_attr=False),
+            nn.BatchNorm2D(c(32)), nn.ReLU())]
+        inp = c(32)
+        for oup, s in cfg:
+            layers.append(_DWSep(inp, c(oup), s))
+            inp = c(oup)
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        self.fc = (nn.Linear(c(1024), num_classes)
+                   if num_classes > 0 else None)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,115 @@
+"""MobileNetV3 small/large (reference: ``python/paddle/vision/models/mobilenetv3.py``)."""
+
+from ... import nn
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class SqueezeExcite(nn.Layer):
+    def __init__(self, ch, rd=4):
+        super().__init__()
+        mid = _make_divisible(ch // rd)
+        self.fc1 = nn.Conv2D(ch, mid, 1)
+        self.fc2 = nn.Conv2D(mid, ch, 1)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _InvRes(nn.Layer):
+    def __init__(self, inp, mid, oup, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == oup
+        Act = nn.Hardswish if act == "hswish" else nn.ReLU
+        layers = []
+        if mid != inp:
+            layers += [nn.Conv2D(inp, mid, 1, bias_attr=False),
+                       nn.BatchNorm2D(mid), Act()]
+        layers += [nn.Conv2D(mid, mid, k, stride, k // 2, groups=mid,
+                             bias_attr=False), nn.BatchNorm2D(mid), Act()]
+        if use_se:
+            layers.append(SqueezeExcite(mid))
+        layers += [nn.Conv2D(mid, oup, 1, bias_attr=False),
+                   nn.BatchNorm2D(oup)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# (kernel, exp, out, SE, act, stride) per stage — the paper's tables
+_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hswish", 2), (3, 200, 80, False, "hswish", 1),
+    (3, 184, 80, False, "hswish", 1), (3, 184, 80, False, "hswish", 1),
+    (3, 480, 112, True, "hswish", 1), (3, 672, 112, True, "hswish", 1),
+    (5, 672, 160, True, "hswish", 2), (5, 960, 160, True, "hswish", 1),
+    (5, 960, 160, True, "hswish", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hswish", 2),
+    (5, 240, 40, True, "hswish", 1), (5, 240, 40, True, "hswish", 1),
+    (5, 120, 48, True, "hswish", 1), (5, 144, 48, True, "hswish", 1),
+    (5, 288, 96, True, "hswish", 2), (5, 576, 96, True, "hswish", 1),
+    (5, 576, 96, True, "hswish", 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_ch, scale=1.0, num_classes=1000):
+        super().__init__()
+        c = lambda ch: _make_divisible(ch * scale)
+        layers = [nn.Sequential(
+            nn.Conv2D(3, c(16), 3, 2, 1, bias_attr=False),
+            nn.BatchNorm2D(c(16)), nn.Hardswish())]
+        inp = c(16)
+        for k, exp, out, se, act, s in cfg:
+            layers.append(_InvRes(inp, c(exp), c(out), k, s, se, act))
+            inp = c(out)
+        last_conv = c(cfg[-1][1])
+        layers.append(nn.Sequential(
+            nn.Conv2D(inp, last_conv, 1, bias_attr=False),
+            nn.BatchNorm2D(last_conv), nn.Hardswish()))
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Sequential(
+            nn.Linear(last_conv, last_ch), nn.Hardswish(),
+            nn.Dropout(0.2), nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.pool(self.features(x)).flatten(1)
+        return self.classifier(x)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000):
+        super().__init__(_SMALL, 1024, scale, num_classes)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000):
+        super().__init__(_LARGE, 1280, scale, num_classes)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)

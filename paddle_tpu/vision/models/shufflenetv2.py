@@ -1,0 +1,96 @@
+"""ShuffleNetV2 (reference: ``python/paddle/vision/models/shufflenetv2.py``)."""
+
+from ... import nn
+from ...ops import manipulation as M
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]
+
+
+def _channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = M.reshape(x, [b, groups, c // groups, h, w])
+    x = M.transpose(x, [0, 2, 1, 3, 4])
+    return M.reshape(x, [b, c, h, w])
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, inp, oup, stride):
+        super().__init__()
+        self.stride = stride
+        branch = oup // 2
+        if stride > 1:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride, 1, groups=inp,
+                          bias_attr=False), nn.BatchNorm2D(inp),
+                nn.Conv2D(inp, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), nn.ReLU())
+            in2 = inp
+        else:
+            self.branch1 = None
+            in2 = inp // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in2, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.Conv2D(branch, branch, 3, stride, 1, groups=branch,
+                      bias_attr=False), nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU())
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = M.split(x, [c, c], axis=1)
+            out = M.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = M.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_CFG = {
+    0.25: [24, 24, 48, 96, 512], 0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024], 1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000):
+        super().__init__()
+        ch = _CFG[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, ch[0], 3, 2, 1, bias_attr=False),
+            nn.BatchNorm2D(ch[0]), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, 2, 1)
+        stages = []
+        inp = ch[0]
+        for i, reps in enumerate([4, 8, 4]):
+            oup = ch[i + 1]
+            units = [_ShuffleUnit(inp, oup, 2)]
+            units += [_ShuffleUnit(oup, oup, 1) for _ in range(reps - 1)]
+            stages.append(nn.Sequential(*units))
+            inp = oup
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(inp, ch[4], 1, bias_attr=False),
+            nn.BatchNorm2D(ch[4]), nn.ReLU())
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(ch[4], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        return self.fc(self.pool(x).flatten(1))
+
+
+def _make(scale):
+    def f(pretrained=False, **kwargs):
+        return ShuffleNetV2(scale=scale, **kwargs)
+    return f
+
+
+shufflenet_v2_x0_25 = _make(0.25)
+shufflenet_v2_x0_5 = _make(0.5)
+shufflenet_v2_x1_0 = _make(1.0)
+shufflenet_v2_x1_5 = _make(1.5)
+shufflenet_v2_x2_0 = _make(2.0)

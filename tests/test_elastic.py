@@ -1,0 +1,46 @@
+"""ElasticManager tests: heartbeat membership, dead-node detection,
+scale-out (reference: elastic manager unit tests; SURVEY.md §5.3 —
+tests kill workers to exercise restart)."""
+
+import time
+
+from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                  ElasticStatus)
+
+
+def test_membership_and_scale_events():
+    m0 = ElasticManager("node0", is_master=True, ttl=1.0,
+                        heartbeat_interval=0.2)
+    m0.start()
+    m1 = ElasticManager("node1", port=m0.store.port, ttl=1.0,
+                        heartbeat_interval=0.2)
+    m1.start()
+    time.sleep(0.3)
+
+    ev = m0.watch()  # first observation
+    assert ev.status == ElasticStatus.NORMAL
+    assert ev.alive == ["node0", "node1"]
+
+    # scale-out: node2 joins
+    m2 = ElasticManager("node2", port=m0.store.port, ttl=1.0,
+                        heartbeat_interval=0.2)
+    m2.start()
+    time.sleep(0.3)
+    ev = m0.watch()
+    assert ev.status == ElasticStatus.SCALE_OUT and ev.joined == ["node2"]
+
+    # scale-in: node1 dies (heartbeat stops, TTL expires)
+    m1.stop()
+    time.sleep(1.5)
+    ev = m0.watch()
+    assert ev.status == ElasticStatus.SCALE_IN and "node1" in ev.dead
+    assert "node0" in ev.alive and "node2" in ev.alive
+
+    # graceful leave drops the roster entry immediately
+    m2.leave()
+    time.sleep(1.5)
+    ev = m0.watch()
+    assert ev.status == ElasticStatus.SCALE_IN and ev.dead == ["node2"]
+
+    m0.stop()
+    m0.store.close()

@@ -1,0 +1,129 @@
+"""Tests for paddle.fft and the special/stat op corpus additions.
+
+OpTest pattern (SURVEY.md §4): numpy reference implementations, dtype
+tolerance tables.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+        out = fft.ifft(fft.fft(_t(x))).numpy()
+        np.testing.assert_allclose(out.real, x, atol=1e-5)
+
+    def test_fft_matches_numpy(self):
+        x = np.random.RandomState(1).randn(8).astype(np.float32)
+        np.testing.assert_allclose(fft.fft(_t(x)).numpy(), np.fft.fft(x),
+                                   atol=1e-4)
+
+    def test_rfft_irfft(self):
+        x = np.random.RandomState(2).randn(3, 32).astype(np.float32)
+        np.testing.assert_allclose(fft.rfft(_t(x)).numpy(),
+                                   np.fft.rfft(x, axis=-1), atol=1e-4)
+        np.testing.assert_allclose(fft.irfft(fft.rfft(_t(x))).numpy(), x,
+                                   atol=1e-5)
+
+    def test_fft2_fftn(self):
+        x = np.random.RandomState(3).randn(4, 8, 8).astype(np.float32)
+        np.testing.assert_allclose(fft.fft2(_t(x)).numpy(),
+                                   np.fft.fft2(x), atol=1e-3)
+        np.testing.assert_allclose(fft.fftn(_t(x)).numpy(),
+                                   np.fft.fftn(x), atol=1e-3)
+
+    def test_ortho_norm(self):
+        x = np.random.RandomState(4).randn(16).astype(np.float32)
+        np.testing.assert_allclose(fft.fft(_t(x), norm="ortho").numpy(),
+                                   np.fft.fft(x, norm="ortho"), atol=1e-4)
+
+    def test_shift_freq(self):
+        x = np.arange(8.0, dtype=np.float32)
+        np.testing.assert_allclose(fft.fftshift(_t(x)).numpy(),
+                                   np.fft.fftshift(x))
+        np.testing.assert_allclose(fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, d=0.5).astype(np.float32))
+
+
+class TestSpecialOps:
+    def test_bincount(self):
+        x = np.array([1, 2, 2, 5])
+        np.testing.assert_array_equal(paddle.bincount(_t(x)).numpy(),
+                                      np.bincount(x))
+        w = np.array([0.5, 1.0, 2.0, 0.25], np.float32)
+        np.testing.assert_allclose(
+            paddle.bincount(_t(x), weights=_t(w)).numpy(),
+            np.bincount(x, weights=w), rtol=1e-6)
+
+    def test_histogram(self):
+        x = np.random.RandomState(0).randn(100).astype(np.float32)
+        got = paddle.histogram(_t(x), bins=10, min=-3, max=3).numpy()
+        want, _ = np.histogram(x, bins=10, range=(-3, 3))
+        np.testing.assert_array_equal(got, want)
+
+    def test_cross(self):
+        a = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+        b = np.random.RandomState(2).randn(4, 3).astype(np.float32)
+        np.testing.assert_allclose(paddle.cross(_t(a), _t(b), axis=1).numpy(),
+                                   np.cross(a, b), atol=1e-5)
+
+    def test_cdist_euclidean(self):
+        a = np.random.RandomState(3).randn(5, 4).astype(np.float32)
+        b = np.random.RandomState(4).randn(7, 4).astype(np.float32)
+        want = np.sqrt(((a[:, None] - b[None]) ** 2).sum(-1))
+        np.testing.assert_allclose(paddle.cdist(_t(a), _t(b)).numpy(), want,
+                                   atol=1e-4)
+        # p=1 path
+        want1 = np.abs(a[:, None] - b[None]).sum(-1)
+        np.testing.assert_allclose(paddle.cdist(_t(a), _t(b), p=1.0).numpy(),
+                                   want1, atol=1e-4)
+
+    def test_dist(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([1.5, 1.0, 5.0], np.float32)
+        np.testing.assert_allclose(float(paddle.dist(_t(a), _t(b), p=2)),
+                                   np.linalg.norm(a - b), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(paddle.dist(_t(a), _t(b), p=float("inf"))), 2.0, rtol=1e-6)
+
+    def test_renorm(self):
+        x = np.random.RandomState(5).randn(3, 4, 5).astype(np.float32) * 3
+        out = paddle.renorm(_t(x), p=2.0, axis=0, max_norm=1.0).numpy()
+        norms = np.sqrt((out.reshape(3, -1) ** 2).sum(1))
+        assert np.all(norms <= 1.0 + 1e-4)
+        # rows already under the cap are untouched
+        small = np.full((2, 2), 0.01, np.float32)
+        np.testing.assert_allclose(
+            paddle.renorm(_t(small), 2.0, 0, 5.0).numpy(), small, rtol=1e-6)
+
+    def test_bessel_polygamma(self):
+        x = np.linspace(0.1, 3, 7).astype(np.float32)
+        np.testing.assert_allclose(paddle.i0(_t(x)).numpy(),
+                                   np.i0(x), rtol=1e-4)
+        got = paddle.polygamma(_t(x), 1).numpy()
+        from scipy.special import polygamma as sp  # scipy ships with jax env
+        np.testing.assert_allclose(got, sp(1, x).astype(np.float32),
+                                   rtol=1e-3)
+
+    def test_poisson(self):
+        lam = np.full((2000,), 4.0, np.float32)
+        out = paddle.poisson(_t(lam)).numpy()
+        assert abs(out.mean() - 4.0) < 0.3
+
+    def test_fft_grad(self):
+        """fft ops participate in the eager tape (rfft -> sum is real)."""
+        x = paddle.to_tensor(np.random.RandomState(6).randn(8).astype(
+            np.float32), stop_gradient=False)
+        y = fft.fft(x)
+        loss = paddle.sum(paddle.abs(y))
+        loss.backward()
+        assert x.grad is not None
+        assert np.all(np.isfinite(x.grad.numpy()))

@@ -1,0 +1,119 @@
+"""Strategy meta-optimizer tests: GradientMerge, DGC, ASP, FP16AllReduce,
+LocalSGD (reference: ``test/collective/fleet`` meta-optimizer unit tests)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    ASPOptimizer, DGCOptimizer, FP16AllReduceOptimizer,
+    GradientMergeOptimizer, LocalSGDOptimizer)
+
+
+def _linear_and_data(seed=0):
+    rng = np.random.RandomState(seed)
+    lin = nn.Linear(4, 1)
+    x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 1).astype(np.float32))
+    return lin, x, y
+
+
+def test_gradient_merge_equals_large_batch():
+    """k accumulated micro-steps == one step on the averaged grad."""
+    lin, x, y = _linear_and_data()
+    w0 = lin.weight.numpy().copy()
+
+    # reference: single step with grads averaged over two halves
+    lin_ref, _, _ = _linear_and_data()
+    lin_ref.weight._inplace_set(paddle.to_tensor(w0.copy())._value)
+    lin_ref.bias._inplace_set(paddle.to_tensor(lin.bias.numpy().copy())._value)
+    opt_ref = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin_ref.parameters())
+    loss = paddle.mean((lin_ref(x) - y) ** 2)
+    loss.backward()
+    opt_ref.step()
+
+    opt = GradientMergeOptimizer(
+        paddle.optimizer.SGD(learning_rate=0.1,
+                             parameters=lin.parameters()), k_steps=2)
+    for half in (slice(0, 4), slice(4, 8)):
+        # per-half grads; mean over half-batch then averaged by merge = the
+        # full-batch mean (equal halves)
+        loss = paddle.mean((lin(x[half]) - y[half]) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_allclose(lin.weight.numpy(), lin_ref.weight.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_merge_no_update_midway():
+    lin, x, y = _linear_and_data()
+    w0 = lin.weight.numpy().copy()
+    opt = GradientMergeOptimizer(
+        paddle.optimizer.SGD(learning_rate=0.1,
+                             parameters=lin.parameters()), k_steps=3)
+    loss = paddle.mean((lin(x) - y) ** 2)
+    loss.backward()
+    opt.step()
+    np.testing.assert_allclose(lin.weight.numpy(), w0)  # no real step yet
+
+
+def test_dgc_sparsifies_but_converges():
+    lin, x, y = _linear_and_data()
+    opt = DGCOptimizer(
+        paddle.optimizer.SGD(learning_rate=0.05,
+                             parameters=lin.parameters()),
+        rampup_begin_step=0, sparsity=0.5, momentum=0.0)
+    losses = []
+    for _ in range(60):
+        loss = paddle.mean((lin(x) - y) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_asp_2_4_mask():
+    lin = nn.Linear(8, 8)
+    opt = ASPOptimizer(paddle.optimizer.SGD(
+        learning_rate=0.01, parameters=lin.parameters()))
+    opt.prune_model()
+    w = lin.weight.numpy().reshape(-1, 4)
+    nz = (w != 0).sum(axis=1)
+    assert np.all(nz <= 2), nz
+    # sparsity survives an update step
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype(
+        np.float32))
+    loss = paddle.mean(lin(x) ** 2)
+    loss.backward()
+    opt.step()
+    w2 = lin.weight.numpy().reshape(-1, 4)
+    assert np.all(((w2 != 0).sum(axis=1)) <= 2)
+
+
+def test_fp16_allreduce_single_rank():
+    lin, x, y = _linear_and_data()
+    opt = FP16AllReduceOptimizer(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=lin.parameters()))
+    l0 = None
+    for _ in range(20):
+        loss = paddle.mean((lin(x) - y) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        l0 = l0 or float(loss)
+    assert float(loss) < l0
+
+
+def test_localsgd_single_rank_noop_sync():
+    lin, x, y = _linear_and_data()
+    opt = LocalSGDOptimizer(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=lin.parameters()), k_steps=2)
+    for _ in range(4):
+        loss = paddle.mean((lin(x) - y) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert np.all(np.isfinite(lin.weight.numpy()))

@@ -1,0 +1,81 @@
+"""Parameter-server stack tests (reference test strategy: local brpc
+server+client, SURVEY.md §4 "PS tests" — CPU-only, loopback)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import PsClient, PsServer
+
+
+@pytest.fixture()
+def ps():
+    server = PsServer()
+    client = PsClient(server.host, server.port)
+    yield server, client
+    client.close()
+    server.stop()
+
+
+def test_dense_pull_push(ps):
+    server, client = ps
+    client.create_dense_table(0, shape=(4,), lr=0.1,
+                              init=np.ones(4, np.float32))
+    np.testing.assert_allclose(client.pull_dense(0), np.ones(4))
+    client.push_dense_grad(0, np.full(4, 2.0, np.float32))
+    np.testing.assert_allclose(client.pull_dense(0), np.full(4, 0.8),
+                               rtol=1e-6)
+
+
+def test_sparse_embedding_flow(ps):
+    """Typical recommendation step: pull rows by id, push row grads back."""
+    server, client = ps
+    client.create_sparse_table(1, dim=8, lr=0.5)
+    ids = np.array([3, 99, 3], np.int64)
+    rows = client.pull_sparse(1, ids)
+    assert rows.shape == (3, 8)
+    np.testing.assert_allclose(rows[0], rows[2])  # same id, same row
+    grads = np.zeros((3, 8), np.float32)
+    grads[1] = 1.0
+    client.push_sparse_grad(1, ids, grads)
+    rows2 = client.pull_sparse(1, np.array([99], np.int64))
+    np.testing.assert_allclose(rows2[0], rows[1] - 0.5, rtol=1e-5)
+    assert client.table_stats()["sparse"][1] == 2
+
+
+def test_multi_trainer_async_updates(ps):
+    """Two trainer clients pushing concurrently — async-SGD semantics: all
+    updates land (order-free sum for constant grads)."""
+    server, client = ps
+    client.create_dense_table(2, shape=(2,), lr=1.0,
+                              init=np.zeros(2, np.float32))
+    c2 = PsClient(server.host, server.port)
+
+    def trainer(c, n):
+        for _ in range(n):
+            c.push_dense_grad(2, np.array([1.0, -1.0], np.float32))
+
+    ts = [threading.Thread(target=trainer, args=(c, 50))
+          for c in (client, c2)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    np.testing.assert_allclose(client.pull_dense(2), [-100.0, 100.0])
+    c2.close()
+
+
+def test_trainer_local_train_converges(ps):
+    """End-to-end: linear regression where the trainer computes grads locally
+    and the PS owns the weights (sync pull → grad → push loop)."""
+    server, client = ps
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 4).astype(np.float32)
+    w_true = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    y = X @ w_true
+    client.create_dense_table(3, shape=(4,), lr=0.1,
+                              init=np.zeros(4, np.float32))
+    for _ in range(100):
+        w = client.pull_dense(3)
+        grad = 2 * X.T @ (X @ w - y) / len(X)
+        client.push_dense_grad(3, grad)
+    np.testing.assert_allclose(client.pull_dense(3), w_true, atol=1e-2)

@@ -1,0 +1,110 @@
+"""Quantization subsystem tests (QAT STE, PTQ observers, int8 convert).
+
+Reference test strategy: ``test/quantization/`` — insert quanters, train a
+step, check convert output parity within int8 tolerance.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, quantization as Q
+
+
+def _mlp():
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16)
+            self.relu = nn.ReLU()
+            self.fc2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc2(self.relu(self.fc1(x)))
+
+    return MLP()
+
+
+def test_fake_quant_values_and_ste():
+    x = paddle.to_tensor(np.array([-2.0, -0.5, 0.0, 0.3, 1.7], np.float32),
+                         stop_gradient=False)
+    y = Q.fake_quant(x, scale=1.0, bits=8)
+    got = y.numpy()
+    # values clipped to [-1, 1] and snapped to the 127-level grid
+    assert abs(got[0] + 1.0) < 1e-6 and abs(got[4] - 1.0) < 1e-6
+    np.testing.assert_allclose(got[3], round(0.3 * 127) / 127, rtol=1e-6)
+    paddle.sum(y).backward()
+    g = x.grad.numpy()
+    # STE: grad 1 inside the clip range, 0 outside
+    np.testing.assert_allclose(g, [0.0, 1.0, 1.0, 1.0, 0.0], atol=1e-6)
+
+
+def test_qat_quantize_and_train():
+    model = _mlp()
+    cfg = Q.QuantConfig(activation=Q.quanter(Q.FakeQuanterWithAbsMax),
+                        weight=Q.quanter(Q.FakeQuanterWithAbsMax))
+    qat = Q.QAT(cfg)
+    model = qat.quantize(model)
+    assert isinstance(model.fc1, Q.QuantedLinear)
+    assert isinstance(model.fc2, Q.QuantedLinear)
+
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+    target = paddle.to_tensor(rng.randn(16, 4).astype(np.float32))
+    losses = []
+    for _ in range(10):
+        out = model(x)
+        loss = paddle.mean((out - target) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_ptq_calibrate_convert_parity():
+    model = _mlp()
+    cfg = Q.QuantConfig(activation=Q.quanter(Q.MovingAverageAbsmaxObserver),
+                        weight=None)
+    ptq = Q.PTQ(cfg)
+    model = ptq.quantize(model)
+
+    rng = np.random.RandomState(1)
+    calib = [paddle.to_tensor(rng.randn(32, 8).astype(np.float32))
+             for _ in range(4)]
+    ref_out = [model(x).numpy() for x in calib]  # observers collect scales
+    assert model.fc1.activation_quanter.scales() is not None
+
+    model = ptq.convert(model)
+    assert isinstance(model.fc1, Q.Int8Linear)
+    got = model(calib[0]).numpy()
+    # int8 simulation error stays small relative to activations
+    err = np.abs(got - ref_out[0]).mean() / (np.abs(ref_out[0]).mean() + 1e-9)
+    assert err < 0.05, err
+
+
+def test_int8_linear_matmul_correctness():
+    """Int8Linear must agree with the explicit dequantized computation."""
+    rng = np.random.RandomState(2)
+    w = rng.randn(8, 4).astype(np.float32)
+    w_scales = np.abs(w).max(axis=0)
+    wi8 = np.round(w / w_scales * 127).astype(np.int8)
+    lin = Q.Int8Linear(wi8, w_scales, act_scale=2.0)
+    x = np.clip(rng.randn(5, 8).astype(np.float32), -2, 2)
+    got = lin(paddle.to_tensor(x)).numpy()
+    xi8 = np.round(x / 2.0 * 127).astype(np.int32)
+    want = (xi8 @ wi8.astype(np.int32)) * (w_scales * 2.0 / (127 * 127))
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_type_and_name_config():
+    cfg = Q.QuantConfig()
+    cfg.add_type_config(nn.Linear,
+                        weight=Q.quanter(Q.FakeQuanterWithAbsMax))
+    model = _mlp()
+    model = Q.QAT(cfg).quantize(model)
+    assert isinstance(model.fc1, Q.QuantedLinear)
+    assert model.fc1.activation_quanter is None
+    assert model.fc1.weight_quanter is not None

@@ -1,0 +1,63 @@
+"""paddle.distributed.rpc tests: 2-process loopback RPC (reference test
+strategy SURVEY.md §4: N local processes + loopback rendezvous)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import tests.conftest  # force CPU platform before jax init
+    from paddle_tpu.distributed import rpc
+
+    def double(x):
+        return x * 2
+
+    def concat(a, b=""):
+        return a + b
+
+    rank = int(sys.argv[1])
+    rpc.init_rpc(name=f"worker{{rank}}".format(rank=rank), rank=rank,
+                 world_size=2, master_endpoint="127.0.0.1:{port}")
+    if rank == 0:
+        out = rpc.rpc_sync("worker1", double, args=(21,))
+        assert out == 42, out
+        fut = rpc.rpc_async("worker1", concat, args=("a",),
+                            kwargs={{"b": "bc"}})
+        assert fut.wait() == "abc"
+        infos = rpc.get_all_worker_infos()
+        assert sorted(i.name for i in infos) == ["worker0", "worker1"]
+        # remote exception propagates
+        try:
+            rpc.rpc_sync("worker1", double, args=(None,))
+            raise SystemExit("expected TypeError")
+        except TypeError:
+            pass
+        print("RPC_OK")
+    rpc.shutdown()
+""")
+
+
+def test_rpc_two_process(tmp_path):
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "rpc_worker.py"
+    script.write_text(WORKER.format(port=port, repo=repo))
+    env = dict(os.environ)
+    procs = [subprocess.Popen([sys.executable, str(script), str(r)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, env=env,
+                              cwd=repo, text=True)
+             for r in (0, 1)]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    assert procs[0].returncode == 0, outs[0]
+    assert procs[1].returncode == 0, outs[1]
+    assert "RPC_OK" in outs[0], outs[0]

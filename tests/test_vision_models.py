@@ -1,0 +1,53 @@
+"""Model-zoo smoke tests: forward shapes at reduced resolution.
+
+Reference: ``test/legacy_test/test_vision_models.py`` pattern — construct,
+forward, check logits shape.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _run(model, size=64, classes=10):
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(2, 3, size, size).astype(np.float32))
+    model.eval()
+    out = model(x)
+    assert tuple(out.shape) == (2, classes)
+    assert np.all(np.isfinite(out.numpy()))
+
+
+@pytest.mark.parametrize("factory,size", [
+    (models.alexnet, 96),
+    (models.squeezenet1_0, 64),
+    (models.squeezenet1_1, 64),
+    (models.mobilenet_v1, 64),
+    (models.mobilenet_v3_small, 64),
+    (models.mobilenet_v3_large, 64),
+    (models.shufflenet_v2_x0_5, 64),
+    (models.densenet121, 64),
+    (models.googlenet, 64),
+])
+def test_model_forward(factory, size):
+    _run(factory(num_classes=10), size=size)
+
+
+def test_inception_v3():
+    # inception needs a larger minimum input (stem has three stride-2 stages)
+    _run(models.inception_v3(num_classes=10), size=128)
+
+
+def test_model_zoo_train_mode_batchnorm():
+    """BatchNorm statistics update in train mode without error."""
+    m = models.mobilenet_v1(num_classes=4, scale=0.25)
+    m.train()
+    x = paddle.to_tensor(
+        np.random.RandomState(1).rand(4, 3, 32, 32).astype(np.float32))
+    out = m(x)
+    loss = paddle.mean(out)
+    loss.backward()
+    grads = [p.grad for p in m.parameters() if p.grad is not None]
+    assert len(grads) > 0
