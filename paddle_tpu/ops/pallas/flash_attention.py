@@ -33,12 +33,15 @@ def _on_tpu() -> bool:
 
 
 def _xla_attention(q, k, v, mask=None, is_causal=False, scale=None):
-    # q,k,v: [B, S, H, D] -> scores over S
+    # q,k,v: [B, S, H, D] -> scores over S. Matmuls keep the input dtype
+    # (bf16 on TPU) with fp32 ACCUMULATION via preferred_element_type — the
+    # MXU's native mode; casting inputs to fp32 first would run the matmul
+    # at 1/8 MXU rate (this path is also the flash-VJP's recompute, so it
+    # sets the backward-pass speed).
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     if is_causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
         causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
@@ -49,7 +52,8 @@ def _xla_attention(q, k, v, mask=None, is_causal=False, scale=None):
         else:
             logits = logits + mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v,
+                     preferred_element_type=jnp.float32)
     return out.astype(q.dtype)
 
 
