@@ -73,8 +73,10 @@ from . import profiler  # noqa: F401
 from . import quantization  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401
+from . import text  # noqa: F401
 from . import utils  # noqa: F401
 from . import vision  # noqa: F401
+from . import inference  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .nn.layer.layers import ParamAttr  # noqa: F401
 from .ops import linalg  # noqa: F401

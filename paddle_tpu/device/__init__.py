@@ -118,3 +118,42 @@ class _DeviceNamespace:
 
 tpu = _DeviceNamespace("tpu")
 cuda = _DeviceNamespace("gpu")
+
+
+# ---------------------------------------------------------------------------
+# Custom-device plugins. Reference counterpart: the C-ABI plugin layer
+# (`paddle/phi/backends/custom/custom_device.cc`, `paddle/phi/capi/`;
+# SURVEY.md §2.3 item 24) that lets out-of-tree backends register as
+# CustomPlace('npu') etc. The TPU-native equivalent IS the PJRT plugin ABI:
+# any backend exposing a PJRT C-API plugin (this machine's `axon` TPU tunnel
+# is one) registers with jax and shows up here — no framework-side C code is
+# needed because PJRT already standardises device mgmt/stream/memcpy/compile.
+# ---------------------------------------------------------------------------
+
+_BUILTIN_PLATFORMS = ("cpu", "gpu", "cuda", "tpu")
+
+
+def get_all_custom_device_type() -> List[str]:
+    """Backend names served by out-of-tree PJRT plugins (reference
+    ``paddle.device.get_all_custom_device_type``). Enumerates the registered
+    backend FACTORIES (not ``jax.devices()``, which only lists the default
+    backend — and plugin devices report the generic PJRT platform name,
+    e.g. the axon TPU tunnel's devices say ``tpu``)."""
+    try:
+        from jax._src.xla_bridge import _backend_factories
+
+        return [n for n in _backend_factories if n not in _BUILTIN_PLATFORMS]
+    except ImportError:
+        return []
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    return device_type in get_all_custom_device_type()
+
+
+def register_pjrt_plugin(name: str, library_path: str, options=None) -> None:
+    """Register a PJRT plugin .so as a new device backend (the analog of
+    the reference's ``CustomDevice`` runtime registration)."""
+    from jax._src.xla_bridge import register_plugin
+
+    register_plugin(name, library_path=library_path, options=options or {})

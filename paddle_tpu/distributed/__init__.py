@@ -31,7 +31,9 @@ from .env import (
     is_initialized,
 )
 from .parallel import DataParallel
-from . import auto_parallel, checkpoint, fleet, launch, ps, rpc, sharding
+from . import (auto_parallel, checkpoint, communication, fleet, launch, ps,
+               rpc, sharding)
+from .communication import stream  # noqa: F401
 from .store import TCPStore
 from .auto_parallel import (
     Partial,

@@ -1,0 +1,25 @@
+"""``paddle.distributed.communication`` — collective API package.
+
+Reference counterpart: ``python/paddle/distributed/communication/``
+(SURVEY.md §2.2 "Python comm API"): the plain collectives plus ``stream.*``
+variants with explicit async/stream control.
+"""
+
+from ..collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    alltoall,
+    barrier,
+    broadcast,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from . import stream  # noqa: F401
+
+__all__ = ["ReduceOp", "all_gather", "all_reduce", "alltoall", "barrier",
+           "broadcast", "recv", "reduce", "reduce_scatter", "scatter",
+           "send", "stream"]

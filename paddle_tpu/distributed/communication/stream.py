@@ -1,0 +1,110 @@
+"""``paddle.distributed.communication.stream`` — stream-level collectives.
+
+Reference counterpart: ``python/paddle/distributed/communication/stream/``
+(SURVEY.md §2.2): collectives with ``sync_op``/``use_calc_stream`` control
+over which CUDA stream runs the communication and whether the call blocks.
+
+TPU-native semantics: XLA programs have no user-visible streams — compute/
+communication overlap is the compiler's job (latency-hiding scheduler), and
+dispatch is already asynchronous. ``use_calc_stream=True`` (run on the
+compute stream, i.e. fully inline) is therefore the natural behavior;
+``sync_op=False`` returns a ``Task`` whose ``wait()`` blocks on the result —
+matching the reference's task-future contract over jax's async dispatch.
+"""
+
+from __future__ import annotations
+
+from .. import collective as _c
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
+           "alltoall", "reduce", "send", "recv", "Task"]
+
+
+class Task:
+    """Future for an async collective (reference ``ProcessGroup::Task``)."""
+
+    def __init__(self, tensors):
+        self._tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+
+    def wait(self) -> bool:
+        for t in self._tensors:
+            v = getattr(t, "_value", t)
+            if hasattr(v, "block_until_ready"):
+                v.block_until_ready()
+        return True
+
+    def is_completed(self) -> bool:
+        for t in self._tensors:
+            v = getattr(t, "_value", t)
+            if hasattr(v, "is_ready") and not v.is_ready():
+                return False
+        return True
+
+
+def _writeback(tensor, result):
+    """Preserve the reference's in-place contract: under shard_map the base
+    collectives return a NEW Tensor (tracers can't be rebound through the
+    inplace version check), so copy the result — value and tape linkage —
+    back into the caller's tensor."""
+    from ...core.tensor import Tensor
+
+    if (isinstance(result, Tensor) and isinstance(tensor, Tensor)
+            and result is not tensor):
+        tensor._value = result._value
+        tensor._grad_node = result._grad_node
+        tensor._out_index = getattr(result, "_out_index", 0)
+    return result
+
+
+def _maybe_task(result, tensor, sync_op):
+    result = _writeback(tensor, result)
+    if sync_op:
+        return None
+    return Task(tensor if result is None else result)
+
+
+def all_reduce(tensor, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    out = _c.all_reduce(tensor, op=op, group=group)
+    return _maybe_task(out, tensor, sync_op)
+
+
+def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    out = _c.all_gather(tensor_or_tensor_list, tensor, group=group)
+    return _maybe_task(out, tensor_or_tensor_list, sync_op)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op=_c.ReduceOp.SUM,
+                   group=None, sync_op=True, use_calc_stream=False):
+    out = _c.reduce_scatter(tensor, tensor_or_tensor_list, op=op, group=group)
+    return _maybe_task(out, tensor, sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True,
+              use_calc_stream=False):
+    out = _c.broadcast(tensor, src=src, group=group)
+    return _maybe_task(out, tensor, sync_op)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True,
+             use_calc_stream=False):
+    # reference stream.alltoall argument order is (out, in)
+    out = _c.alltoall(in_tensor_list, out_tensor_list, group=group)
+    return _maybe_task(out, out_tensor_list, sync_op)
+
+
+def reduce(tensor, dst=0, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=False):
+    out = _c.reduce(tensor, dst=dst, op=op, group=group)
+    return _maybe_task(out, tensor, sync_op)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    out = _c.send(tensor, dst=dst, group=group)
+    return _maybe_task(out, tensor, sync_op)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    out = _c.recv(tensor, src=src, group=group)
+    return _maybe_task(out, tensor, sync_op)

@@ -160,6 +160,22 @@ class ASPOptimizer(_MetaOptimizer):
         self._masks: Dict[int, jax.Array] = {}
 
     @staticmethod
+    def prune_params(params, n: int = 2, m: int = 4):
+        """Mask every >=2-D param to n:m sparsity in place; returns
+        {id(param) or name: mask}. Shared by ASPOptimizer and
+        ``paddle.incubate.asp.prune_model``. ``params``: iterable of
+        Tensors or (name, Tensor) pairs."""
+        masks = {}
+        for item in params:
+            name, p = item if isinstance(item, tuple) else (None, item)
+            if p._value.ndim < 2:
+                continue  # biases/norms stay dense (reference behavior)
+            mask = ASPOptimizer._mask_2_4(p._value, n, m)
+            p._inplace_set(p._value * mask)
+            masks[name if name is not None else id(p)] = mask
+        return masks
+
+    @staticmethod
     def _mask_2_4(w, n, m):
         shape = w.shape
         flat = w.reshape(-1)
@@ -176,12 +192,9 @@ class ASPOptimizer(_MetaOptimizer):
 
     def prune_model(self, params: Optional[List[Tensor]] = None):
         """Compute masks from current magnitudes and zero the pruned half."""
-        for p in params or self._inner_opt._params():
-            if p._value.ndim < 2:
-                continue  # biases/norms stay dense (reference behavior)
-            mask = self._mask_2_4(p._value, self.n, self.m)
-            self._masks[id(p)] = mask
-            p._inplace_set(p._value * mask)
+        plist = list(params or self._inner_opt._params())
+        # keys are id(param) for bare-Tensor iterables — exactly our map
+        self._masks.update(self.prune_params(plist, self.n, self.m))
 
     def step(self):
         if not self._masks:

@@ -9,7 +9,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "History", "config_callbacks"]
+           "LRScheduler", "History", "VisualDL", "ReduceLROnPlateau",
+           "config_callbacks"]
 
 
 class Callback:
@@ -187,6 +188,104 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait >= self.patience:
                 self.model.stop_training = True
+
+
+class VisualDL(Callback):
+    """Scalar logger (reference ``paddle.callbacks.VisualDL`` — VisualDL is
+    Paddle's TensorBoard). Without the visualdl package in this image, the
+    scalar stream is written as JSON-lines under ``log_dir`` (one record per
+    step/epoch: {"tag", "step", "value", "wall_time"}), a format the
+    TensorBoard-family tools can ingest via a tiny converter and that tests
+    can read directly."""
+
+    def __init__(self, log_dir="./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._fh = None
+        self._step = 0
+
+    def _write(self, tag, value, step):
+        import json
+
+        if self._fh is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(os.path.join(self.log_dir, "vdlrecords.jsonl"),
+                            "a")
+        try:
+            value = float(np.asarray(value).reshape(-1)[0])
+        except (TypeError, ValueError):
+            return
+        self._fh.write(json.dumps({"tag": tag, "step": step,
+                                   "value": value,
+                                   "wall_time": time.time()}) + "\n")
+        self._fh.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            self._write(f"train/{k}", v, self._step)
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            self._write(f"eval/{k}", v, self._step)
+
+    def on_train_end(self, logs=None):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce optimizer LR when a monitored metric plateaus (reference
+    ``paddle.callbacks.ReduceLROnPlateau``)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = -np.inf if self.mode == "max" else np.inf
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple, np.ndarray)):
+            cur = float(np.asarray(cur).reshape(-1)[0])
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        improved = (cur > self.best + self.min_delta if self.mode == "max"
+                    else cur < self.best - self.min_delta)
+        if improved:
+            self.best = cur
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is not None:
+                    from ..optimizer.lr import LRScheduler as Sched
+
+                    if not isinstance(opt._learning_rate, Sched):
+                        new_lr = max(opt.get_lr() * self.factor, self.min_lr)
+                        opt.set_lr(new_lr)
+                        if self.verbose:
+                            print(f"ReduceLROnPlateau: lr -> {new_lr:.2e}")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
 
 
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,

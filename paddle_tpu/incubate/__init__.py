@@ -1,6 +1,6 @@
 """``paddle.incubate`` namespace (reference: ``python/paddle/incubate/``):
 experimental APIs — MoE expert parallelism and fused-op entry points."""
 
-from . import distributed, nn
+from . import asp, distributed, nn
 
-__all__ = ["distributed", "nn"]
+__all__ = ["asp", "distributed", "nn"]

@@ -1,0 +1,37 @@
+"""``paddle.incubate.asp`` — Automatic SParsity (2:4 structured) helpers.
+
+Reference counterpart: ``python/paddle/incubate/asp/`` + the Fleet
+``ASPOptimizer`` (SURVEY.md §2.2): prune weights to n:m structured sparsity
+and keep the mask enforced through training. The optimizer wrapper lives in
+``paddle_tpu.distributed.fleet.meta_optimizers.ASPOptimizer``; this module
+is the user-facing prune/decorate API.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...distributed.fleet.meta_optimizers.strategy_optimizers import (
+    ASPOptimizer,
+)
+
+__all__ = ["prune_model", "decorate", "calculate_density", "ASPOptimizer"]
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d"):
+    """Prune every >=2-D parameter of ``model`` to n:m sparsity in place and
+    return {param_name: mask}. (``mask_algo`` kept for API parity; the
+    magnitude-based 1-D grouping is the only algorithm implemented.)"""
+    return ASPOptimizer.prune_params(model.named_parameters(), n, m)
+
+
+def decorate(optimizer, n: int = 2, m: int = 4) -> ASPOptimizer:
+    """Wrap ``optimizer`` so the n:m mask is re-applied after each step."""
+    return ASPOptimizer(optimizer, n=n, m=m)
+
+
+def calculate_density(tensor) -> float:
+    import numpy as np
+
+    v = np.asarray(getattr(tensor, "_value", tensor))
+    return float((v != 0).sum() / v.size)

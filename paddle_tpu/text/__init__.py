@@ -1,0 +1,137 @@
+"""``paddle.text`` — NLP datasets (reference: ``python/paddle/text/``).
+
+The reference ships downloadable corpora (Imdb, Imikolov, Movielens,
+UCIHousing, WMT14/16, Conll05). This offline image synthesises
+shape/dtype-faithful stand-ins with the same Dataset API so training
+pipelines (vocab, batching, padding) are exercisable end-to-end.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "viterbi_decode",
+           "ViterbiDecoder"]
+
+
+class Imdb(Dataset):
+    """Binary sentiment corpus: (token_ids[int64], label{0,1})."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 synthetic_size=None, vocab_size=5000, seq_len=64):
+        n = synthetic_size or (2000 if mode == "train" else 400)
+        rng = np.random.RandomState(11 if mode == "train" else 12)
+        self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+        self.labels = rng.randint(0, 2, n).astype("int64")
+        # class-conditional token distribution => learnable signal
+        self.docs = np.where(
+            rng.rand(n, seq_len) < 0.3,
+            (self.labels[:, None] * (vocab_size // 2)
+             + rng.randint(0, vocab_size // 2, (n, seq_len))),
+            rng.randint(0, vocab_size, (n, seq_len)),
+        ).astype("int64")
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram language-model corpus: n-1 context -> next word."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, synthetic_size=None,
+                 vocab_size=2000):
+        n = synthetic_size or (5000 if mode == "train" else 500)
+        rng = np.random.RandomState(13 if mode == "train" else 14)
+        seq = rng.randint(0, vocab_size, n + window_size).astype("int64")
+        self.window_size = window_size
+        self.grams = np.stack([seq[i:i + window_size] for i in range(n)])
+
+    def __getitem__(self, idx):
+        g = self.grams[idx]
+        return tuple(g[:-1]) + (g[-1:],)
+
+    def __len__(self):
+        return len(self.grams)
+
+
+class UCIHousing(Dataset):
+    """Boston-housing regression: (features[13] f32, price f32)."""
+
+    def __init__(self, data_file=None, mode="train", synthetic_size=None):
+        n = synthetic_size or (404 if mode == "train" else 102)
+        rng = np.random.RandomState(15 if mode == "train" else 16)
+        self.x = rng.randn(n, 13).astype("float32")
+        w = np.linspace(-1, 1, 13).astype("float32")
+        self.y = (self.x @ w + 0.1 * rng.randn(n)).astype("float32")[:, None]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True):
+    """CRF Viterbi decoding (reference ``paddle.text.viterbi_decode``).
+    potentials: [B, T, N] emission scores; transition: [N, N].
+    Returns (scores[B], paths[B, T])."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor, to_tensor
+
+    e = (potentials._value if isinstance(potentials, Tensor)
+         else jnp.asarray(potentials))
+    t = (transition_params._value if isinstance(transition_params, Tensor)
+         else jnp.asarray(transition_params))
+    B, T, N = e.shape
+    if lengths is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    else:
+        lens = (lengths._value if isinstance(lengths, Tensor)
+                else jnp.asarray(lengths)).astype(jnp.int32)
+
+    def decode_one(em, ln):  # em: [T, N]; ln: scalar true length
+        steps = jnp.arange(1, T)
+
+        def step(alpha, inp):
+            emt, idx = inp
+            valid = idx < ln
+            scores = alpha[:, None] + t  # [N, N]
+            best = jnp.max(scores, axis=0) + emt
+            back = jnp.argmax(scores, axis=0)
+            # padded steps: carry alpha through, backpointer = identity so
+            # backtracking walks unchanged to the last REAL step
+            best = jnp.where(valid, best, alpha)
+            back = jnp.where(valid, back, jnp.arange(t.shape[0]))
+            return best, back
+
+        alpha, backs = jax.lax.scan(step, em[0], (em[1:], steps))
+        last = jnp.argmax(alpha)
+
+        def backtrack(tag, back):
+            return back[tag], back[tag]
+
+        _, path_rev = jax.lax.scan(backtrack, last, backs[::-1])
+        path = jnp.concatenate([path_rev[::-1], last[None]])
+        return jnp.max(alpha), path
+
+    scores, paths = jax.vmap(decode_one)(e, lens)
+    return to_tensor(scores), to_tensor(paths.astype(jnp.int32))
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

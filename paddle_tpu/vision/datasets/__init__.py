@@ -18,7 +18,7 @@ import numpy as np
 
 from ...io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder", "ImageFolder"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers", "DatasetFolder", "ImageFolder"]
 
 
 class MNIST(Dataset):
@@ -112,6 +112,22 @@ class Cifar10(_CifarBase):
 
 class Cifar100(_CifarBase):
     NUM_CLASSES = 100
+
+
+class Flowers(_CifarBase):
+    """Flowers-102 (reference ``paddle.vision.datasets.Flowers``); synthetic
+    fallback in this offline image, same (3, 96, 96)/102-class geometry."""
+
+    NUM_CLASSES = 102
+    SHAPE = (3, 96, 96)
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend="cv2",
+                 synthetic_size=None):
+        n = synthetic_size or (1020 if mode.lower() == "train" else 102)
+        super().__init__(data_file=data_file, mode=mode, transform=transform,
+                         download=download, backend=backend,
+                         synthetic_size=n)
 
 
 class DatasetFolder(Dataset):

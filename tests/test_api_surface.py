@@ -1,0 +1,102 @@
+"""API-surface completeness tests for the audit additions: communication
+stream collectives, incubate.asp, VisualDL/ReduceLROnPlateau callbacks,
+Flowers dataset, paddle.text datasets + viterbi decode."""
+
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, text
+from paddle_tpu.distributed.communication import stream
+from paddle_tpu.incubate import asp
+from paddle_tpu.hapi.callbacks import ReduceLROnPlateau, VisualDL
+from paddle_tpu.vision.datasets import Flowers
+
+
+def test_stream_all_reduce_task():
+    t = paddle.to_tensor(np.ones(4, np.float32))
+    task = stream.all_reduce(t, sync_op=False)  # world=1: identity
+    assert task is not None and task.wait() is True
+    assert stream.all_reduce(t, sync_op=True) is None
+
+
+def test_asp_prune_and_decorate():
+    lin = nn.Linear(8, 8)
+    masks = asp.prune_model(lin)
+    assert "weight" in next(iter(masks)) or masks
+    assert asp.calculate_density(lin.weight) <= 0.51
+    opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.01,
+                                            parameters=lin.parameters()))
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype(
+        np.float32))
+    loss = paddle.mean(lin(x) ** 2)
+    loss.backward()
+    opt.step()
+    assert asp.calculate_density(lin.weight) <= 0.51
+
+
+def test_visualdl_callback(tmp_path):
+    cb = VisualDL(log_dir=str(tmp_path))
+
+    class FakeModel:
+        pass
+
+    cb.set_model(FakeModel())
+    cb.on_train_batch_end(0, {"loss": 1.5})
+    cb.on_train_batch_end(1, {"loss": np.float32(1.2)})
+    cb.on_eval_end({"acc": 0.9})
+    cb.on_train_end()
+    recs = [json.loads(l) for l in
+            open(os.path.join(tmp_path, "vdlrecords.jsonl"))]
+    assert len(recs) == 3
+    assert recs[0]["tag"] == "train/loss" and recs[0]["value"] == 1.5
+    assert recs[2]["tag"] == "eval/acc"
+
+
+def test_reduce_lr_on_plateau():
+    lin = nn.Linear(2, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+
+    class FakeModel:
+        _optimizer = opt
+
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2, verbose=0)
+    cb.set_model(FakeModel())
+    cb.on_train_begin()
+    for _ in range(4):
+        cb.on_eval_end({"loss": 1.0})  # flat -> plateau
+    assert abs(opt.get_lr() - 0.05) < 1e-9
+
+
+def test_flowers_dataset():
+    ds = Flowers(mode="test")
+    img, label = ds[0]
+    assert img.shape == (3, 96, 96)
+    assert 0 <= int(np.asarray(label).reshape(-1)[0]) < 102
+
+
+def test_text_datasets():
+    imdb = text.Imdb(mode="train", synthetic_size=100)
+    doc, lab = imdb[0]
+    assert doc.dtype == np.int64 and lab in (0, 1)
+    uci = text.UCIHousing(mode="test")
+    x, y = uci[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    ngram = text.Imikolov(window_size=5, synthetic_size=50)
+    item = ngram[0]
+    assert len(item) == 5
+
+
+def test_viterbi_decode():
+    # deterministic chain: transition strongly favors staying; emissions pick
+    # the start state
+    em = np.full((1, 4, 3), -10.0, np.float32)
+    em[0, 0, 1] = 10.0  # start in state 1
+    trans = np.full((3, 3), -5.0, np.float32)
+    np.fill_diagonal(trans, 5.0)
+    scores, paths = text.viterbi_decode(paddle.to_tensor(em),
+                                        paddle.to_tensor(trans))
+    assert paths.numpy().tolist() == [[1, 1, 1, 1]]
