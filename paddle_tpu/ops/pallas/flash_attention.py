@@ -63,14 +63,16 @@ def _xla_attention(q, k, v, mask=None, is_causal=False, scale=None):
 # ---------------------------------------------------------------------------
 
 def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
-                     causal_offset: int = 0):
+                     causal_offset: int = 0, with_lse: bool = False):
     """``causal_offset`` aligns the causal diagonal when sq != sk (KV-cache
     decode): query row i sits at absolute position i + offset, matching the
-    XLA fallback's ``tril(..., k=sk-sq)`` convention."""
+    XLA fallback's ``tril(..., k=sk-sq)`` convention. ``with_lse`` adds a
+    second output with each row's logsumexp (needed by the backward pass:
+    ``exp(s - lse)`` reconstitutes the softmax probabilities)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    def kernel(q_ref, k_ref, v_ref, o_ref):
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None):
         # q_ref: [1, block_q, d]; k_ref/v_ref: [1, S, d] (this head's K/V)
         qb = q_ref[0].astype(jnp.float32) * scale
         S = k_ref.shape[1]
@@ -116,15 +118,28 @@ def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
         acc, m, l = jax.lax.fori_loop(0, n_iter, body, (acc0, m0, l0))
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # exp(s*scale - lse) reconstitutes softmax probs in the bwd pass
+            # (shape [block_q, 1]: TPU block tiling needs the trailing unit dim)
+            m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+            lse_ref[0] = (m_safe + jnp.log(l))[:, None]
 
+    if not with_lse:
+        return lambda q_ref, k_ref, v_ref, o_ref: kernel(q_ref, k_ref,
+                                                         v_ref, o_ref)
     return kernel
 
 
 def _pallas_flash_attention(q, k, v, is_causal=False, scale=None,
-                            block_q: int = 256, block_k: int = 256):
-    """Forward flash attention via Pallas. [B, S, H, D] layout."""
+                            block_q: int = 256, block_k: int = 256,
+                            with_lse: bool = False):
+    """Forward flash attention via Pallas, [B, S, H, D] layout.
+
+    ``with_lse=False`` → out[B, S, H, D] (XLA fallback on untileable
+    shapes). ``with_lse=True`` → (out, lse[B*H, S, 1]) for the backward
+    pass (trailing unit dim is the TPU block-tiling requirement), or
+    ``None`` on untileable shapes (caller falls back)."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -132,6 +147,8 @@ def _pallas_flash_attention(q, k, v, is_causal=False, scale=None,
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     if sq % block_q or sk % block_k:
+        if with_lse:
+            return None
         return _xla_attention(q, k, v, is_causal=is_causal, scale=scale)
 
     # fold batch & heads into the grid's first axis: [B*H, S, D]
@@ -140,8 +157,15 @@ def _pallas_flash_attention(q, k, v, is_causal=False, scale=None,
     vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
 
     kernel = _make_pallas_fwd(block_q, block_k, is_causal, scale,
-                              causal_offset=sk - sq)
-    out = pl.pallas_call(
+                              causal_offset=sk - sq, with_lse=with_lse)
+    out_spec = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
+    out_shape = jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)
+    if with_lse:
+        out_spec = [out_spec,
+                    pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0))]
+        out_shape = [out_shape,
+                     jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32)]
+    result = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
         in_specs=[
@@ -149,42 +173,236 @@ def _pallas_flash_attention(q, k, v, is_causal=False, scale=None,
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
         ],
+        out_specs=out_spec,
+        out_shape=out_shape,
+    )(qr, kr, vr)
+    unfold = lambda x: x.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    if with_lse:
+        return unfold(result[0]), result[1]
+    return unfold(result)
+
+
+def _pallas_flash_fwd_lse(q, k, v, is_causal=False, scale=None,
+                          block_q: int = 256, block_k: int = 256):
+    """(out[B,S,H,D], lse[B*H,S,1]) or None when shapes don't tile."""
+    return _pallas_flash_attention(q, k, v, is_causal=is_causal, scale=scale,
+                                   block_q=block_q, block_k=block_k,
+                                   with_lse=True)
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward kernels (flash-attention backward): probs are
+# reconstituted blockwise from the saved logsumexp, so the [S, S] score
+# matrix is never materialised. dq and dk/dv are separate kernels so each
+# parallelises over its own output's blocks with no cross-block races.
+# ---------------------------------------------------------------------------
+
+def _make_pallas_bwd_dq(block_q, block_k, is_causal, scale, causal_offset=0):
+    from jax.experimental import pallas as pl
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
+        # q/do: [1, block_q, d]; k/v: [1, S, d]; lse/delta: [1, block_q]
+        qb = q_ref[0].astype(jnp.float32)
+        dob = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        S = k_ref.shape[1]
+        q_idx = pl.program_id(1)
+
+        def body(start, dq_acc):
+            kb = k_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
+            s = (qb @ kb.T) * scale
+            p = jnp.exp(s - lse[:, None])
+            if is_causal:
+                q_pos = causal_offset + q_idx * block_q + \
+                    jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                k_pos = start * block_k + \
+                    jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                p = jnp.where(q_pos >= k_pos, p, 0.0)
+            dp = dob @ vb.T
+            ds = p * (dp - delta[:, None]) * scale
+            return dq_acc + ds @ kb
+
+        n_k = S // block_k
+        if is_causal:
+            last = jax.lax.div(
+                causal_offset + (q_idx + 1) * block_q + block_k - 1,
+                jnp.int32(block_k))
+            n_iter = jnp.minimum(n_k, last)
+        else:
+            n_iter = n_k
+        dq0 = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
+        dq = jax.lax.fori_loop(0, n_iter, body, dq0)
+        dq_ref[0] = dq.astype(dq_ref.dtype)
+
+    return kernel
+
+
+def _make_pallas_bwd_dkv(block_q, block_k, is_causal, scale,
+                         causal_offset=0):
+    from jax.experimental import pallas as pl
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dk_ref, dv_ref):
+        # k/v: [1, block_k, d]; q/do: [1, S, d]; lse/delta: [1, S]
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        S = q_ref.shape[1]
+        k_idx = pl.program_id(1)
+
+        def body(start, carry):
+            dk_acc, dv_acc = carry
+            qb = q_ref[0, pl.ds(start * block_q, block_q), :].astype(jnp.float32)
+            dob = do_ref[0, pl.ds(start * block_q, block_q), :].astype(jnp.float32)
+            lse = lse_ref[0, pl.ds(start * block_q, block_q), 0]
+            delta = delta_ref[0, pl.ds(start * block_q, block_q), 0]
+            s = (qb @ kb.T) * scale  # [block_q, block_k]
+            p = jnp.exp(s - lse[:, None])
+            if is_causal:
+                q_pos = causal_offset + start * block_q + \
+                    jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                k_pos = k_idx * block_k + \
+                    jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                p = jnp.where(q_pos >= k_pos, p, 0.0)
+            dv_acc = dv_acc + p.T @ dob
+            dp = dob @ vb.T
+            ds = p * (dp - delta[:, None]) * scale
+            dk_acc = dk_acc + ds.T @ qb
+            return dk_acc, dv_acc
+
+        n_q = S // block_q
+        if is_causal:
+            # query blocks strictly before this kv block's diagonal see none
+            # of it: query row q_pos attends kv col k_pos iff q_pos >= k_pos
+            first = jax.lax.div(k_idx * block_k - causal_offset,
+                                jnp.int32(block_q))
+            start0 = jnp.clip(first, 0, n_q)
+        else:
+            start0 = 0
+        zeros = jnp.zeros((block_k, q_ref.shape[2]), jnp.float32)
+        dk, dv = jax.lax.fori_loop(start0, n_q, body, (zeros, zeros))
+        dk_ref[0] = dk.astype(dk_ref.dtype)
+        dv_ref[0] = dv.astype(dv_ref.dtype)
+
+    return kernel
+
+
+def _pallas_flash_bwd(q, k, v, do, out, lse, is_causal, scale=None,
+                      block_q: int = 256, block_k: int = 256):
+    """Flash backward: (dq, dk, dv) in the [B, S, H, D] layout."""
+    from jax.experimental import pallas as pl
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    dor = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    outr = out.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    # delta_i = rowsum(do_i * o_i) — the softmax-jacobian correction term
+    # ([BH, S, 1]: trailing unit dim for TPU block tiling, like lse)
+    delta = jnp.sum(dor.astype(jnp.float32) * outr.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    off = sk - sq
+    dq = pl.pallas_call(
+        _make_pallas_bwd_dq(block_q, block_k, is_causal, scale, off),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-    )(qr, kr, vr)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    )(qr, kr, vr, dor, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        _make_pallas_bwd_dkv(block_q, block_k, is_causal, scale, off),
+        grid=(b * h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+    )(qr, kr, vr, dor, lse, delta)
+
+    unfold = lambda x, s: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return unfold(dq, sq), unfold(dk, sk), unfold(dv, sk)
 
 
 def dot_product_attention(q, k, v, mask=None, is_causal=False):
-    """Public entry: picks Pallas on TPU (when enabled and mask-free),
-    XLA reference elsewhere. Differentiable (backward via XLA autodiff of the
-    reference path when pallas is active — see flash_attention custom VJP
-    TODO in M3 notes)."""
+    """Public entry: picks Pallas on TPU (when enabled, mask-free, and not
+    under a multi-device mesh), XLA reference elsewhere. Differentiable:
+    the pallas path uses the flash BACKWARD kernels (`_pallas_flash_bwd`,
+    O(S) memory via saved logsumexp); XLA-recompute backward remains only
+    as the untileable-shape fallback."""
     use_pallas = (
         _on_tpu()
         and flags.get_flags("use_pallas_kernels")["use_pallas_kernels"]
         and mask is None
+        and not _multi_device_mesh_active()
     )
     if use_pallas:
         return _flash_custom_vjp(q, k, v, is_causal)
     return _xla_attention(q, k, v, mask=mask, is_causal=is_causal)
 
 
-# custom VJP: pallas forward, XLA-recompute backward (flash-style backward
-# kernel lands with M3 perf work; recompute keeps memory at O(S) not O(S^2)
-# only in the forward — backward materialises scores per-head).
+def _multi_device_mesh_active() -> bool:
+    """GSPMD cannot auto-partition a pallas custom call across a >1-device
+    mesh — the XLA formulation (which it CAN shard) is the right lowering
+    there; pallas serves the single-chip hot path."""
+    try:
+        from ...parallel.mesh import get_mesh
+
+        mesh = get_mesh()
+        return mesh is not None and mesh.size > 1
+    except Exception:
+        return False
+
+
+# custom VJP: pallas forward AND pallas flash backward — the saved residuals
+# are (q, k, v, o, lse): O(S) memory, never the [S, S] score matrix. Falls
+# back to XLA-recompute backward when shapes don't tile.
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash_custom_vjp(q, k, v, is_causal):
     return _pallas_flash_attention(q, k, v, is_causal=is_causal)
 
 
 def _flash_fwd(q, k, v, is_causal):
-    return _pallas_flash_attention(q, k, v, is_causal=is_causal), (q, k, v)
+    fwd = _pallas_flash_fwd_lse(q, k, v, is_causal=is_causal)
+    if fwd is None:  # untileable shapes: XLA path, recompute backward
+        return (_pallas_flash_attention(q, k, v, is_causal=is_causal),
+                (q, k, v, None, None))
+    out, lse = fwd
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(is_causal, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(q_, k_, v_, is_causal=is_causal), q, k, v)
+    q, k, v, out, lse = res
+    if lse is not None:
+        return _pallas_flash_bwd(q, k, v, g, out, lse, is_causal)
+    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(
+        q_, k_, v_, is_causal=is_causal), q, k, v)
     return vjp(g)
 
 
