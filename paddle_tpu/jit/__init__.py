@@ -97,10 +97,17 @@ class TracedProgram:
 
     def __init__(self, function: Callable, input_spec=None, build_strategy=None,
                  full_graph=True):
+        self._orig_fn = function  # state discovery (closure/Layer walking)
+        if full_graph:
+            # dy2static: rewrite tensor-dependent if/while into lax.cond /
+            # lax.while_loop BEFORE tracing (reference ProgramTranslator)
+            from .dy2static import convert_to_static
+
+            function = convert_to_static(function)
         self._fn = function
         self._input_spec = input_spec
         self._cache: Dict[Any, Any] = {}  # structure key -> jitted pure fn
-        functools.update_wrapper(self, function,
+        functools.update_wrapper(self, self._orig_fn,
                                  assigned=("__name__", "__doc__", "__qualname__"),
                                  updated=())
 
@@ -140,7 +147,7 @@ class TracedProgram:
     def __call__(self, *args, **kwargs):
         from ..framework.random import next_key
 
-        params, buffers, layer = _collect_state(self._fn)
+        params, buffers, layer = _collect_state(self._orig_fn)
         tensor_args, arg_tree, rest_args, rest_kwargs = _split_args(args, kwargs)
         pure, out_store = self._make_pure(params, buffers, tensor_args,
                                           rest_args, rest_kwargs, arg_tree)
@@ -253,9 +260,21 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 
     def wrap(fn):
         if isinstance(fn, Layer):
-            traced = TracedProgram(fn.__call__, input_spec)
+            # dy2static the LAYER'S forward (not Layer.__call__, which is
+            # framework plumbing) and trace through the normal call path;
+            # TracedProgram gets full_graph=False so it won't re-transform
+            # Layer.__call__ itself
+            if full_graph:
+                from .dy2static import convert_to_static
+
+                fwd = type(fn).forward
+                conv = convert_to_static(fwd)
+                if conv is not fwd:
+                    object.__setattr__(fn, "forward",
+                                       conv.__get__(fn, type(fn)))
+            traced = TracedProgram(fn.__call__, input_spec, full_graph=False)
             return _TracedLayerProxy(fn, traced)
-        return TracedProgram(fn, input_spec)
+        return TracedProgram(fn, input_spec, full_graph=full_graph)
 
     if function is not None:
         return wrap(function)

@@ -1,0 +1,416 @@
+"""Dy2Static — AST rewriting of Python control flow for ``to_static``.
+
+Reference counterpart: ``python/paddle/jit/dy2static/`` (SURVEY.md §2.1
+"Dy2Static", §3.5): ``ProgramTranslator`` rewrites if/while on tensors into
+``cond``/``while_loop`` ops before building the static program.
+
+TPU-native design: the rewrite targets **XLA structured control flow** —
+``jax.lax.cond`` / ``jax.lax.while_loop`` — so a data-dependent Python
+branch becomes a single compiled program instead of a trace-time
+concretization error. The transform is conservative:
+
+* ``if``/``elif``/``else`` whose bodies contain no ``return``/``break``/
+  ``continue`` are rewritten; variables assigned in the branches are
+  captured iff they pre-exist or are assigned in BOTH branches (others stay
+  branch-local, mirroring the reference's UndefinedVar restriction).
+* ``while`` loops are rewritten over the set of loop-carried names.
+* Everything else (``for`` over static ranges, early returns) keeps Python
+  semantics — static-value control flow simply unrolls under the tracer.
+
+At runtime the rewritten calls dispatch on the condition's value: a traced
+tensor → ``lax`` op; a concrete Python/host value → ordinary Python branch,
+so the SAME transformed function serves eager and compiled execution.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable, List, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["convert_to_static", "cond", "while_loop", "to_bool"]
+
+
+# ---------------------------------------------------------------------------
+# Runtime helpers (the rewritten code calls these)
+# ---------------------------------------------------------------------------
+
+def _unwrap(x):
+    from ...core.tensor import Tensor
+
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_traced(x) -> bool:
+    return isinstance(_unwrap(x), jax.core.Tracer)
+
+
+def _flatten_state(state):
+    """state: tuple of captured vars (Tensors / arrays / python values).
+    Returns (leaves-for-jax, rebuild)."""
+    from ...core.tensor import Tensor
+
+    is_tensor = [isinstance(v, Tensor) for v in state]
+    leaves = [v._value if t else v for v, t in zip(state, is_tensor)]
+
+    def rebuild(new_leaves):
+        return tuple(
+            Tensor(nv, stop_gradient=True) if t else nv
+            for nv, t in zip(new_leaves, is_tensor)
+        )
+
+    return leaves, rebuild
+
+
+def _rewrap_state(orig_state, new_leaves):
+    """Rebuild the captured-var tuple after a lax op: positions that WERE
+    Tensors stay Tensors; positions that were host scalars but are now
+    data-dependent arrays become Tensors too (they can't stay python values
+    after a traced branch/loop) — nothing raw leaks back into user code."""
+    from ...core.tensor import Tensor
+
+    out = []
+    for ov, nv in zip(orig_state, new_leaves):
+        if isinstance(ov, Tensor) or isinstance(nv, jax.core.Tracer) or \
+                isinstance(nv, jax.Array):
+            out.append(nv if isinstance(nv, Tensor)
+                       else Tensor(nv, stop_gradient=True))
+        else:
+            out.append(nv)
+    return tuple(out)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, init: Tuple = ()):
+    """``if`` on a possibly-traced predicate. true_fn/false_fn take the
+    captured vars as POSITIONAL parameters (so branch-local rebinding
+    doesn't shadow reads) and return the updated tuple."""
+    pv = _unwrap(pred)
+    if not isinstance(pv, jax.core.Tracer):
+        taken = true_fn if bool(jnp.asarray(pv).reshape(())) else false_fn
+        return taken(*init)
+
+    # None placeholders (vars both branches CREATE — no pre-branch value)
+    # can't ride the lax.cond operand pytree; route live vars only and
+    # re-inject None positionally inside the branches
+    ph = {i for i, v in enumerate(init) if v is None}
+    live = tuple(v for i, v in enumerate(init) if i not in ph)
+    leaves, rebuild_live = _flatten_state(live)
+
+    def expand(live_vals):
+        it = iter(live_vals)
+        return tuple(None if i in ph else next(it)
+                     for i in range(len(init)))
+
+    def wrap(fn):
+        def run(leaves_):
+            out = fn(*expand(rebuild_live(leaves_)))
+            out_leaves, _ = _flatten_state(out)
+            return tuple(jnp.asarray(l) for l in out_leaves)
+
+        return run
+
+    out = jax.lax.cond(
+        pv.reshape(()).astype(bool) if hasattr(pv, "reshape") else pv,
+        wrap(true_fn), wrap(false_fn), tuple(jnp.asarray(l) for l in leaves))
+    return _rewrap_state(init, out)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, init: Tuple):
+    """``while`` with loop-carried vars. cond_fn/body_fn take the var tuple;
+    body_fn returns the updated tuple."""
+    probe = _unwrap(cond_fn(*init))
+    leaves, rebuild = _flatten_state(init)
+    traced = isinstance(probe, jax.core.Tracer) or any(
+        isinstance(l, jax.core.Tracer) for l in leaves)
+    if not traced:
+        state = init
+        while bool(jnp.asarray(_unwrap(cond_fn(*state))).reshape(())):
+            state = body_fn(*state)
+        return state
+
+    def c(leaves_):
+        out = _unwrap(cond_fn(*rebuild(leaves_)))
+        return out.reshape(()).astype(bool) if hasattr(out, "reshape") else out
+
+    def b(leaves_):
+        out = body_fn(*rebuild(leaves_))
+        new_leaves, _ = _flatten_state(out)
+        return tuple(jnp.asarray(l) for l in new_leaves)
+
+    # promote carried dtypes so the loop-carry aval is stable under updates
+    # that widen (int counter += 0.5 → f32): one eval_shape pass over the
+    # body gives the joint dtypes without running any compute
+    init_arrays = tuple(jnp.asarray(l) for l in leaves)
+    try:
+        out_avals = jax.eval_shape(b, init_arrays)
+        init_arrays = tuple(
+            a.astype(jnp.promote_types(a.dtype, oa.dtype))
+            for a, oa in zip(init_arrays, out_avals))
+    except Exception:
+        pass  # mismatches surface in lax.while_loop's own error
+
+    out = jax.lax.while_loop(c, b, init_arrays)
+    return _rewrap_state(init, out)
+
+
+def to_bool(x):
+    """Condition coercion used by the rewritten tests (tensor stays a
+    tensor; everything else through bool())."""
+    from ...core.tensor import Tensor
+
+    if isinstance(x, Tensor) or isinstance(x, jax.core.Tracer):
+        return x
+    return bool(x)
+
+
+# ---------------------------------------------------------------------------
+# Static analysis
+# ---------------------------------------------------------------------------
+
+def _assigned_names(nodes: List[ast.stmt]) -> Set[str]:
+    out: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, n):
+            if isinstance(n.ctx, (ast.Store,)):
+                out.add(n.id)
+
+        def visit_AugAssign(self, n):
+            if isinstance(n.target, ast.Name):
+                out.add(n.target.id)
+            self.generic_visit(n)
+
+        def visit_FunctionDef(self, n):  # don't descend into nested defs
+            out.add(n.name)
+
+        def visit_Lambda(self, n):
+            pass
+
+    v = V()
+    for s in nodes:
+        v.visit(s)
+    return out
+
+
+def _loaded_names(node) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(node if isinstance(node, ast.AST) else ast.Module(
+            body=list(node), type_ignores=[])):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            out.add(n.id)
+    return out
+
+
+def _has_escape(nodes: List[ast.stmt]) -> bool:
+    """True if the body contains return/break/continue in OUR scope
+    (recursive scan that skips nested function scopes but keeps walking
+    their siblings)."""
+
+    def scan(n) -> bool:
+        if isinstance(n, (ast.Return, ast.Break, ast.Continue)):
+            return True
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return False  # nested scope: its returns don't escape ours
+        return any(scan(c) for c in ast.iter_child_nodes(n))
+
+    return any(scan(s) for s in nodes)
+
+
+class _Transformer(ast.NodeTransformer):
+    """Rewrites If and While statements; tracks defined names in order."""
+
+    def __init__(self, initial_names: Set[str]):
+        self.defined = set(initial_names)
+        self.counter = 0
+
+    def _fresh(self, base):
+        self.counter += 1
+        return f"__jst_{base}_{self.counter}"
+
+    # -- helpers ------------------------------------------------------------
+    def _fn_def(self, name, args, body, returns: List[str]):
+        body = list(body)
+        body.append(ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=r, ctx=ast.Load()) for r in returns],
+            ctx=ast.Load())))
+        return ast.FunctionDef(
+            name=name,
+            args=ast.arguments(posonlyargs=[], args=[ast.arg(arg=a)
+                                                     for a in args],
+                               kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=body, decorator_list=[], returns=None, type_params=[])
+
+    def _visit_block(self, stmts):
+        out = []
+        for s in stmts:
+            r = self.visit(s)
+            out.extend(r if isinstance(r, list) else [r])
+            self.defined |= _assigned_names([s])
+        return out
+
+    # -- statements ---------------------------------------------------------
+    def visit_FunctionDef(self, node):
+        # only the top-level function body is transformed (nested defs keep
+        # python semantics)
+        return node
+
+    def visit_If(self, node: ast.If):
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            node.body = self._visit_block(node.body)
+            node.orelse = self._visit_block(node.orelse)
+            return node
+        # visit branches against a snapshot: names assigned INSIDE a branch
+        # must not count as pre-existing when computing captures/init
+        outer_defined = set(self.defined)
+        self.defined = set(outer_defined)
+        body = self._visit_block(node.body)
+        self.defined = set(outer_defined)
+        orelse = self._visit_block(node.orelse)
+        self.defined = outer_defined
+
+        a_body = _assigned_names(node.body)
+        a_else = _assigned_names(node.orelse)
+        # capture: pre-existing modified vars + vars both branches create.
+        # Captured vars are PARAMETERS of the branch functions — rebinding
+        # inside a branch must not shadow the pre-branch value for reads
+        # (the `y = y + 1` read-modify-write pattern).
+        captured = sorted(((a_body | a_else) & self.defined)
+                          | (a_body & a_else))
+        tname, fname, cname = (self._fresh("true"), self._fresh("false"),
+                               self._fresh("c"))
+        # params/init/returns all share `captured` order; vars created by
+        # both branches but not yet defined get a None placeholder input
+        true_def = self._fn_def(tname, captured, body, captured)
+        false_def = self._fn_def(fname, captured, orelse, captured)
+        init = ast.Tuple(
+            elts=[ast.Name(id=c, ctx=ast.Load()) if c in self.defined
+                  else ast.Constant(value=None) for c in captured],
+            ctx=ast.Load())
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=c, ctx=ast.Store()) for c in captured]
+                + [ast.Name(id=cname, ctx=ast.Store())],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(value=ast.Name(id="__jst", ctx=ast.Load()),
+                                   attr="_cond_stmt", ctx=ast.Load()),
+                args=[node.test,
+                      ast.Name(id=tname, ctx=ast.Load()),
+                      ast.Name(id=fname, ctx=ast.Load()),
+                      init],
+                keywords=[]))
+        self.defined |= set(captured)
+        return [true_def, false_def, call]
+
+    def visit_While(self, node: ast.While):
+        if _has_escape(node.body) or node.orelse:
+            node.body = self._visit_block(node.body)
+            return node
+        outer_defined = set(self.defined)
+        self.defined = set(outer_defined)
+        body = self._visit_block(node.body)
+        self.defined = outer_defined
+        a_body = _assigned_names(node.body)
+        carried = sorted(a_body & self.defined)
+        if not carried:  # nothing loop-carried we can reason about
+            node.body = body
+            return node
+        cname, bname = self._fresh("while_cond"), self._fresh("while_body")
+        cond_def = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(posonlyargs=[],
+                               args=[ast.arg(arg=a) for a in carried],
+                               kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], returns=None, type_params=[])
+        body_def = self._fn_def(bname, carried, body, carried)
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=c, ctx=ast.Store()) for c in carried],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(value=ast.Name(id="__jst", ctx=ast.Load()),
+                                   attr="while_loop", ctx=ast.Load()),
+                args=[ast.Name(id=cname, ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Name(id=c, ctx=ast.Load())
+                                      for c in carried], ctx=ast.Load())],
+                keywords=[]))
+        return [cond_def, body_def, call]
+
+
+def _cond_stmt(pred, true_fn, false_fn, init):
+    """Statement-form cond: appends a dummy element so the assignment target
+    tuple is never empty (zero captured vars)."""
+    out = cond(pred, true_fn, false_fn, init)
+    return tuple(out) + (None,)
+
+
+# module-level handle injected into transformed code's globals
+class _JstNamespace:
+    cond = staticmethod(cond)
+    _cond_stmt = staticmethod(_cond_stmt)
+    while_loop = staticmethod(while_loop)
+    to_bool = staticmethod(to_bool)
+
+
+_JST = _JstNamespace()
+
+
+# ---------------------------------------------------------------------------
+# Entry
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=256)
+def _transform_cached(code, name, filename):
+    tree = ast.parse(code)
+    fdef = tree.body[0]
+    fdef.decorator_list = []
+    params = {a.arg for a in fdef.args.args}
+    params |= {a.arg for a in fdef.args.kwonlyargs}
+    if fdef.args.vararg:
+        params.add(fdef.args.vararg.arg)
+    if fdef.args.kwarg:
+        params.add(fdef.args.kwarg.arg)
+    tr = _Transformer(params)
+    fdef.body = tr._visit_block(fdef.body)
+    ast.fix_missing_locations(tree)
+    return compile(tree, filename=f"<dy2static {filename}>", mode="exec")
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """AST-rewrite ``fn`` (plain function, bound or unbound method). Returns
+    the original when source is unavailable or parsing fails."""
+    if inspect.ismethod(fn):
+        conv = convert_to_static(fn.__func__)
+        return conv.__get__(fn.__self__, type(fn.__self__)) \
+            if conv is not fn.__func__ else fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        code = _transform_cached(src, fn.__name__,
+                                 getattr(fn, "__module__", "?"))
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+
+    glb = dict(fn.__globals__)
+    glb["__jst"] = _JST
+    # rebind closure freevars as globals (reference ProgramTranslator's
+    # closure handling; rebinding is read-only — documented subset)
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+    loc: dict = {}
+    exec(code, glb, loc)
+    out = loc[fn.__name__]
+    functools.wraps(fn)(out)
+    out.__wrapped_original__ = fn
+    return out

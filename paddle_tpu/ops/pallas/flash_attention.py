@@ -70,19 +70,23 @@ def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
     second output with each row's logsumexp (needed by the backward pass:
     ``exp(s - lse)`` reconstitutes the softmax probabilities)."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None):
-        # q_ref: [1, block_q, d]; k_ref/v_ref: [1, S, d] (this head's K/V)
-        qb = q_ref[0].astype(jnp.float32) * scale
+        # q_ref: [1, block_q, d]; k_ref/v_ref: [1, S, d] (this head's K/V).
+        # Matmuls keep the input dtype (bf16) with fp32 ACCUMULATION via
+        # preferred_element_type — full MXU rate; scale applies in fp32
+        # after the dot.
+        qb = q_ref[0]
         S = k_ref.shape[1]
         q_idx = pl.program_id(1)
 
         def body(start, carry):
             acc, m_prev, l_prev = carry
-            kb = k_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
-            vb = v_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
-            s = qb @ kb.T  # [block_q, block_k]
+            kb = k_ref[0, pl.ds(start * block_k, block_k), :]
+            vb = v_ref[0, pl.ds(start * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
             if is_causal:
                 q_pos = causal_offset + q_idx * block_q + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 0
@@ -99,7 +103,9 @@ def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
             p = jnp.where(jnp.isfinite(s), p, 0.0)
             alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
             l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-            acc = acc * alpha[:, None] + p @ vb
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
             return acc, m_new, l_new
 
         n_k = S // block_k
@@ -202,17 +208,19 @@ def _make_pallas_bwd_dq(block_q, block_k, is_causal, scale, causal_offset=0):
 
     def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
         # q/do: [1, block_q, d]; k/v: [1, S, d]; lse/delta: [1, block_q]
-        qb = q_ref[0].astype(jnp.float32)
-        dob = do_ref[0].astype(jnp.float32)
+        qb = q_ref[0]
+        dob = do_ref[0]
         lse = lse_ref[0, :, 0]
         delta = delta_ref[0, :, 0]
         S = k_ref.shape[1]
         q_idx = pl.program_id(1)
 
         def body(start, dq_acc):
-            kb = k_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
-            vb = v_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
-            s = (qb @ kb.T) * scale
+            kb = k_ref[0, pl.ds(start * block_k, block_k), :]
+            vb = v_ref[0, pl.ds(start * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
             p = jnp.exp(s - lse[:, None])
             if is_causal:
                 q_pos = causal_offset + q_idx * block_q + \
@@ -220,9 +228,13 @@ def _make_pallas_bwd_dq(block_q, block_k, is_causal, scale, causal_offset=0):
                 k_pos = start * block_k + \
                     jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
                 p = jnp.where(q_pos >= k_pos, p, 0.0)
-            dp = dob @ vb.T
+            dp = jax.lax.dot_general(
+                dob, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None]) * scale
-            return dq_acc + ds @ kb
+            return dq_acc + jax.lax.dot_general(
+                ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
 
         n_k = S // block_k
         if is_causal:
@@ -246,18 +258,20 @@ def _make_pallas_bwd_dkv(block_q, block_k, is_causal, scale,
     def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                dk_ref, dv_ref):
         # k/v: [1, block_k, d]; q/do: [1, S, d]; lse/delta: [1, S]
-        kb = k_ref[0].astype(jnp.float32)
-        vb = v_ref[0].astype(jnp.float32)
+        kb = k_ref[0]
+        vb = v_ref[0]
         S = q_ref.shape[1]
         k_idx = pl.program_id(1)
 
         def body(start, carry):
             dk_acc, dv_acc = carry
-            qb = q_ref[0, pl.ds(start * block_q, block_q), :].astype(jnp.float32)
-            dob = do_ref[0, pl.ds(start * block_q, block_q), :].astype(jnp.float32)
+            qb = q_ref[0, pl.ds(start * block_q, block_q), :]
+            dob = do_ref[0, pl.ds(start * block_q, block_q), :]
             lse = lse_ref[0, pl.ds(start * block_q, block_q), 0]
             delta = delta_ref[0, pl.ds(start * block_q, block_q), 0]
-            s = (qb @ kb.T) * scale  # [block_q, block_k]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
             p = jnp.exp(s - lse[:, None])
             if is_causal:
                 q_pos = causal_offset + start * block_q + \
@@ -265,10 +279,16 @@ def _make_pallas_bwd_dkv(block_q, block_k, is_causal, scale,
                 k_pos = k_idx * block_k + \
                     jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
                 p = jnp.where(q_pos >= k_pos, p, 0.0)
-            dv_acc = dv_acc + p.T @ dob
-            dp = dob @ vb.T
+            dv_acc = dv_acc + jax.lax.dot_general(
+                p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                dob, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None]) * scale
-            dk_acc = dk_acc + ds.T @ qb
+            dk_acc = dk_acc + jax.lax.dot_general(
+                ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
             return dk_acc, dv_acc
 
         n_q = S // block_q

@@ -1,0 +1,194 @@
+"""Dy2Static AST-transform tests (reference: ``test/dygraph_to_static/``
+per-syntax tests — run the function eagerly and compiled, compare)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit.dy2static import cond, convert_to_static, while_loop
+
+
+def test_tensor_if_else():
+    def f(x):
+        if paddle.sum(x) > 0:
+            y = x * 2
+        else:
+            y = x - 1
+        return y
+
+    static_f = paddle.jit.to_static(f)
+    pos = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    neg = paddle.to_tensor(np.array([-5.0, 1.0], np.float32))
+    np.testing.assert_allclose(static_f(pos).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(static_f(neg).numpy(), [-6.0, 0.0])
+
+
+def test_tensor_elif_chain():
+    def f(x):
+        s = paddle.sum(x)
+        if s > 10:
+            out = x * 10
+        elif s > 0:
+            out = x * 2
+        else:
+            out = x * 0
+        return out
+
+    static_f = paddle.jit.to_static(f)
+    np.testing.assert_allclose(
+        static_f(paddle.to_tensor(np.array([20.0], np.float32))).numpy(),
+        [200.0])
+    np.testing.assert_allclose(
+        static_f(paddle.to_tensor(np.array([3.0], np.float32))).numpy(),
+        [6.0])
+    np.testing.assert_allclose(
+        static_f(paddle.to_tensor(np.array([-3.0], np.float32))).numpy(),
+        [0.0])
+
+
+def test_tensor_while_loop():
+    def f(x):
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < 5:
+            x = x + 1
+            i = i + 1
+        return x
+
+    static_f = paddle.jit.to_static(f)
+    out = static_f(paddle.to_tensor(np.array([0.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [5.0])
+
+
+def test_while_data_dependent_trip_count():
+    """Collatz-ish: trip count depends on the DATA — impossible without
+    lax.while_loop (plain tracing would concretize)."""
+    def f(x):
+        steps = paddle.to_tensor(np.float32(0.0))
+        while paddle.sum(x) > 1:
+            x = x / 2
+            steps = steps + 1
+        return steps
+
+    static_f = paddle.jit.to_static(f)
+    out = static_f(paddle.to_tensor(np.array([8.0], np.float32)))
+    np.testing.assert_allclose(float(out), 3.0)
+    out = static_f(paddle.to_tensor(np.array([100.0], np.float32)))
+    np.testing.assert_allclose(float(out), 7.0)
+
+
+def test_python_condition_keeps_python_semantics():
+    def f(x, flag):
+        if flag:  # host value: stays a python branch
+            return x * 2
+        return x * 3
+
+    static_f = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(static_f(x, True).numpy(), [2.0])
+    np.testing.assert_allclose(static_f(x, False).numpy(), [3.0])
+
+
+def test_layer_forward_with_tensor_branch():
+    class Gate(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if paddle.mean(h) > 0:
+                out = h * 2
+            else:
+                out = -h
+            return out
+
+    layer = Gate()
+    static = paddle.jit.to_static(layer)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4).astype(
+        np.float32))
+    got = static(x).numpy()
+    ref = layer.forward(x).numpy()  # eager path of the SAME converted fn
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-5)
+
+
+def test_runtime_helpers_eager():
+    t = paddle.to_tensor(np.array(1.0, np.float32))
+    out = cond(t > 0, lambda: (t * 2,), lambda: (t * 3,))
+    np.testing.assert_allclose(float(out[0]), 2.0)
+
+    state = while_loop(lambda i: i < 3, lambda i: (i + 1,),
+                       (paddle.to_tensor(np.float32(0)),))
+    np.testing.assert_allclose(float(state[0]), 3.0)
+
+
+def test_grad_through_cond():
+    def f(x):
+        if paddle.sum(x) > 0:
+            y = x * x
+        else:
+            y = x * 3
+        return paddle.sum(y)
+
+    static_f = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([2.0, 1.0], np.float32),
+                         stop_gradient=False)
+    loss = static_f(x)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 2.0], rtol=1e-5)
+
+
+def test_read_modify_write_branch():
+    """`y = y + 1` inside a branch must read the pre-branch value
+    (captured vars are branch-fn parameters, not closure reads)."""
+    def f(x):
+        y = x * 1.0
+        if paddle.sum(x) > 0:
+            y = y + 1
+        return y
+
+    sf = paddle.jit.to_static(f)
+    np.testing.assert_allclose(
+        sf(paddle.to_tensor(np.array([1.0], np.float32))).numpy(), [2.0])
+    np.testing.assert_allclose(
+        sf(paddle.to_tensor(np.array([-1.0], np.float32))).numpy(), [-1.0])
+
+
+def test_while_carry_dtype_promotion():
+    """int-initialised carry updated with a float must promote, not
+    truncate (eval_shape pre-promotion pass)."""
+    def f(x):
+        n = 0
+        while paddle.sum(x) > 1:
+            x = x / 2
+            n = n + 0.5
+        return n
+
+    sf = paddle.jit.to_static(f)
+    np.testing.assert_allclose(
+        float(sf(paddle.to_tensor(np.array([8.0], np.float32)))), 1.5)
+
+
+def test_full_graph_false_skips_transform():
+    def f(x):
+        return x * 2
+
+    prog = paddle.jit.to_static(f, full_graph=False)
+    assert not hasattr(prog._fn, "__wrapped_original__")
+
+
+def test_escape_branch_keeps_python_semantics():
+    """Branches containing return (even past a nested def) must NOT be
+    rewritten — python semantics with host conditions."""
+    def f(x, flag):
+        if flag:
+            if flag:
+                def helper():
+                    return 1
+                return x * 2
+        return x * 3
+
+    cf = convert_to_static(f)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(cf(x, True).numpy(), [2.0])
+    np.testing.assert_allclose(cf(x, False).numpy(), [3.0])
