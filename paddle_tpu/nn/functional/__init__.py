@@ -46,6 +46,9 @@ __all__ = [
     "cosine_similarity", "ctc_loss", "sigmoid_focal_loss", "square_error_cost",
     # attention
     "scaled_dot_product_attention", "sequence_mask", "pad",
+    # extras
+    "pixel_unshuffle", "channel_shuffle", "fold", "pairwise_distance",
+    "huber_loss", "triplet_margin_loss", "cosine_embedding_loss", "rrelu",
 ]
 
 Axis = Union[int, Sequence[int]]
@@ -989,13 +992,6 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
     return run_op("sigmoid_focal_loss", f, logit, label)
 
 
-def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
-             reduction="mean", norm_by_times=False):
-    from ...enforce import raise_unimplemented
-
-    raise_unimplemented("ctc_loss")
-
-
 # ---------------------------------------------------------------------------
 # attention / misc
 # ---------------------------------------------------------------------------
@@ -1034,3 +1030,202 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
 
 
 from ...ops.manipulation import pad  # re-export: paddle.nn.functional.pad
+
+
+# --- extras batch: pixel ops, fold, distance/embedding losses, ctc, rrelu --
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    nhwc = data_format == "NHWC"
+
+    def f(a):
+        if nhwc:
+            a = a.transpose(0, 3, 1, 2)
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = a.transpose(0, 1, 3, 5, 2, 4)
+        a = a.reshape(n, c * r * r, h // r, w // r)
+        return a.transpose(0, 2, 3, 1) if nhwc else a
+
+    return run_op("pixel_unshuffle", f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    nhwc = data_format == "NHWC"
+
+    def f(a):
+        if nhwc:
+            a = a.transpose(0, 3, 1, 2)
+        n, c, h, w = a.shape
+        a = a.reshape(n, groups, c // groups, h, w)
+        a = a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        return a.transpose(0, 2, 3, 1) if nhwc else a
+
+    return run_op("channel_shuffle", f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im — inverse of ``unfold``: [N, C*kh*kw, L] -> [N, C, H, W]
+    with overlapping patches SUMMED (reference ``paddle.nn.functional.fold``)."""
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (kh * kw)
+        nh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        a = a.reshape(n, c, kh, kw, nh, nw)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        # scatter-add each kernel tap's grid of patches into the canvas
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh + sh * np.arange(nh)
+                wj = j * dw + sw * np.arange(nw)
+                out = out.at[:, :, hi[:, None], wj[None, :]].add(
+                    a[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+
+    return run_op("fold", f, x)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b) + epsilon
+        if np.isinf(p):
+            out = jnp.max(d, axis=-1, keepdims=keepdim)
+        else:
+            out = jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+        return out
+
+    return run_op("pairwise_distance", f, x, y)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    return smooth_l1_loss(input, label, reduction=reduction, delta=delta)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def f(a, pos, neg):
+        # epsilon inside |.|: keeps d/dx (sum d^p)^(1/p) finite at d == 0
+        dist = lambda u, v: jnp.sum((jnp.abs(u - v) + epsilon) ** p,
+                                    -1) ** (1.0 / p)
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return run_op("triplet_margin", f, input, positive, negative)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1.0 - cos,
+                         jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return run_op("cosine_embedding", f, input1, input2, label)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    if not training:
+        return run_op("rrelu", lambda a: jnp.where(
+            a >= 0, a, a * ((lower + upper) / 2.0)), x)
+    key = next_key()
+
+    def f(a):
+        slope = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+        return jnp.where(a >= 0, a, a * slope)
+
+    return run_op("rrelu", f, x)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC loss (reference ``paddle.nn.functional.ctc_loss`` / warpctc):
+    log-space alpha recursion compiled as a ``lax.scan`` over time — the
+    XLA-native form of the reference's warp-ctc CUDA kernel.
+
+    log_probs: [T, B, C] log-softmax outputs (time-major, paddle layout);
+    labels: [B, L] int; input_lengths/label_lengths: [B].
+    """
+
+    def f(lp, lab, ilen, llen):
+        T, B, C = lp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        NEG = jnp.float32(-1e30)
+
+        # extended label sequence: blank l1 blank l2 ... blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        pos = jnp.arange(S)[None, :]
+        valid_s = pos < (2 * llen[:, None] + 1)
+
+        # can skip from s-2 when ext[s] is a label differing from ext[s-2]
+        ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, jnp.int32),
+                                  ext[:, :-2]], axis=1)
+        can_skip = (ext != blank) & (ext != ext_m2)
+
+        def emit(t_lp, idx):
+            # t_lp: [B, C]; gather per-state emission log-probs [B, S]
+            return jnp.take_along_axis(t_lp, idx, axis=1)
+
+        alpha0 = jnp.full((B, S), NEG)
+        alpha0 = alpha0.at[:, 0].set(emit(lp[0], ext)[:, 0])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(
+            llen > 0, emit(lp[0], ext)[:, 1], NEG))
+
+        def lse(*xs):
+            stacked = jnp.stack(xs, 0)
+            m = jnp.max(stacked, 0)
+            m_safe = jnp.where(m <= NEG / 2, 0.0, m)
+            out = m_safe + jnp.log(jnp.sum(jnp.exp(stacked - m_safe), 0))
+            return jnp.where(m <= NEG / 2, NEG, out)
+
+        def step(alpha, inp):
+            t, t_lp = inp
+            prev1 = jnp.concatenate([jnp.full((B, 1), NEG),
+                                     alpha[:, :-1]], axis=1)
+            prev2 = jnp.concatenate([jnp.full((B, 2), NEG),
+                                     alpha[:, :-2]], axis=1)
+            prev2 = jnp.where(can_skip, prev2, NEG)
+            new = lse(alpha, prev1, prev2) + emit(t_lp, ext)
+            new = jnp.where(valid_s, new, NEG)
+            # freeze rows past their input length
+            new = jnp.where((t < ilen)[:, None], new, alpha)
+            return new, None
+
+        ts = jnp.arange(1, T)
+        alpha, _ = jax.lax.scan(step, alpha0, (ts, lp[1:]))
+
+        end = 2 * llen  # final blank state; end-1 = last label
+        a_end = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+        a_last = jnp.take_along_axis(
+            alpha, jnp.maximum(end - 1, 0)[:, None], axis=1)[:, 0]
+        a_last = jnp.where(llen > 0, a_last, NEG)
+        ll = lse(a_end, a_last)
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(ilen.astype(loss.dtype), 1)
+        if reduction == "mean":
+            # reference semantics: per-sample loss normalised by its label
+            # length BEFORE the batch mean
+            return jnp.mean(loss / jnp.maximum(llen.astype(loss.dtype), 1))
+        return _reduce(loss, reduction)
+
+    args = as_tensor_args(log_probs, labels, input_lengths, label_lengths)
+    return run_op("ctc_loss", f, *args)
+
+
+from ...ops.dispatch import as_tensor_args  # noqa: E402

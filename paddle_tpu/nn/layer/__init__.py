@@ -1,1 +1,1 @@
-from . import activation, common, container, conv, layers, loss, norm, pooling, rnn, transformer
+from . import activation, common, container, conv, extras, layers, loss, norm, pooling, rnn, transformer

@@ -1,0 +1,183 @@
+"""Remaining reference layer classes: pixel/channel ops, Fold, Unflatten,
+distance/embedding/CTC losses, RReLU, generic RNN wrapper, ZeroPad2D.
+
+Reference: the corresponding classes in ``python/paddle/nn/layer/``
+(``vision.py``, ``common.py``, ``loss.py``, ``rnn.py``; SURVEY.md §2.1).
+"""
+
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+from .common import Pad2D
+
+__all__ = ["PixelUnshuffle", "ChannelShuffle", "Fold", "Unflatten",
+           "ZeroPad2D", "HuberLoss", "TripletMarginLoss",
+           "PairwiseDistance", "CosineEmbeddingLoss", "CTCLoss", "RReLU",
+           "RNN"]
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._factor = downscale_factor
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self._factor)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._groups = groups
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self._groups)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._args = (output_sizes, kernel_sizes, strides, paddings,
+                      dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self._args)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self._axis = axis
+        self._shape = tuple(shape)
+
+    def forward(self, x):
+        from ...ops.manipulation import reshape
+
+        axis = self._axis % len(x.shape)
+        new = tuple(x.shape[:axis]) + self._shape + tuple(
+            x.shape[axis + 1:])
+        return reshape(x, new)
+
+
+class ZeroPad2D(Pad2D):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, mode="constant", value=0.0,
+                         data_format=data_format)
+
+
+class HuberLoss(Layer):
+    def __init__(self, reduction="mean", delta=1.0, name=None):
+        super().__init__()
+        self._reduction = reduction
+        self._delta = delta
+
+    def forward(self, input, label):
+        return F.huber_loss(input, label, self._delta, self._reduction)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._kw = dict(margin=margin, p=p, epsilon=epsilon, swap=swap,
+                        reduction=reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_loss(input, positive, negative, **self._kw)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._p, self._eps, self._keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self._p, self._eps, self._keepdim)
+
+
+class CosineEmbeddingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__()
+        self._margin, self._reduction = margin, reduction
+
+    def forward(self, input1, input2, label):
+        return F.cosine_embedding_loss(input1, input2, label, self._margin,
+                                       self._reduction)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self._blank, self._reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self._blank, self._reduction, norm_by_times)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self._lower, self._upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self._lower, self._upper, training=self.training)
+
+
+class RNN(Layer):
+    """Generic cell runner (reference ``paddle.nn.RNN``): steps any
+    ``RNNCellBase`` over the time axis."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self._reverse = is_reverse
+        self._time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+        from ...ops import manipulation as M
+
+        t_axis = 0 if self._time_major else 1
+        T = inputs.shape[t_axis]
+        steps = range(T - 1, -1, -1) if self._reverse else range(T)
+        states = initial_states
+        seq = (sequence_length._value if isinstance(sequence_length, Tensor)
+               else (jnp.asarray(sequence_length)
+                     if sequence_length is not None else None))
+        outs = []
+
+        def merge(new, old, mask):
+            # per-leaf: keep the new value only for rows still in-sequence
+            if old is None:
+                return new
+            if isinstance(new, (tuple, list)):
+                return type(new)(merge(n, o, mask)
+                                 for n, o in zip(new, old))
+            nv = new._value if isinstance(new, Tensor) else new
+            ov = old._value if isinstance(old, Tensor) else old
+            m = mask.reshape((-1,) + (1,) * (nv.ndim - 1))
+            out = jnp.where(m, nv, ov)
+            return Tensor(out, stop_gradient=True) if isinstance(
+                new, Tensor) else out
+
+        for t in steps:
+            xt = (inputs[t] if self._time_major else inputs[:, t])
+            out, new_states = self.cell(xt, states)
+            if seq is not None:
+                mask = t < seq
+                states = merge(new_states, states, mask)
+                mz = mask.reshape((-1,) + (1,) * (out.ndim - 1))
+                out = Tensor(jnp.where(mz, out._value, 0.0),
+                             stop_gradient=True)
+            else:
+                states = new_states
+            outs.append(out)
+        if self._reverse:
+            outs = outs[::-1]
+        return M.stack(outs, axis=t_axis), states

@@ -1,0 +1,160 @@
+"""Tests for the extras layer batch: pixel ops, Fold, Unflatten, distance/
+embedding/CTC losses, RReLU, generic RNN (reference: per-op tests in
+test/legacy_test)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def _t(a, **kw):
+    return paddle.to_tensor(np.asarray(a), **kw)
+
+
+def test_pixel_unshuffle_roundtrip():
+    x = _t(np.random.RandomState(0).rand(2, 4, 8, 8).astype(np.float32))
+    up = nn.PixelShuffle(2)(x)          # [2, 1, 16, 16]
+    down = nn.PixelUnshuffle(2)(up)
+    np.testing.assert_allclose(down.numpy(), x.numpy())
+
+
+def test_channel_shuffle():
+    x = np.arange(2 * 6 * 2 * 2, dtype=np.float32).reshape(2, 6, 2, 2)
+    out = nn.ChannelShuffle(3)(_t(x)).numpy()
+    want = x.reshape(2, 3, 2, 2, 2).transpose(0, 2, 1, 3, 4).reshape(
+        2, 6, 2, 2)
+    np.testing.assert_allclose(out, want)
+
+
+def test_fold_inverts_unfold_counting_overlaps():
+    x = np.random.RandomState(1).rand(1, 1, 4, 4).astype(np.float32)
+    cols = F.unfold(_t(x), kernel_sizes=2, strides=2)
+    out = nn.Fold((4, 4), 2, strides=2)(cols).numpy()
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+    # overlapping: each interior pixel counted per covering patch
+    cols2 = F.unfold(_t(np.ones((1, 1, 3, 3), np.float32)),
+                     kernel_sizes=2, strides=1)
+    out2 = nn.Fold((3, 3), 2, strides=1)(cols2).numpy()
+    np.testing.assert_allclose(out2[0, 0],
+                               [[1, 2, 1], [2, 4, 2], [1, 2, 1]])
+
+
+def test_unflatten_zeropad():
+    x = _t(np.arange(12, dtype=np.float32).reshape(2, 6))
+    out = nn.Unflatten(1, (2, 3))(x)
+    assert tuple(out.shape) == (2, 2, 3)
+    p = nn.ZeroPad2D([1, 1, 1, 1])(_t(np.ones((1, 1, 2, 2), np.float32)))
+    assert tuple(p.shape) == (1, 1, 4, 4)
+    assert float(paddle.sum(p)) == 4.0
+
+
+def test_distance_losses():
+    a = _t(np.array([[1.0, 0.0]], np.float32))
+    b = _t(np.array([[0.0, 0.0]], np.float32))
+    np.testing.assert_allclose(float(nn.PairwiseDistance()(a, b)), 1.0,
+                               rtol=1e-4)
+    h = nn.HuberLoss(delta=1.0)(_t([0.0, 3.0]), _t([0.0, 0.0]))
+    np.testing.assert_allclose(float(h), (0.0 + (3.0 - 0.5)) / 2, rtol=1e-6)
+    t = nn.TripletMarginLoss(margin=1.0)(
+        _t([[0.0, 0.0]]), _t([[0.0, 1.0]]), _t([[0.0, 5.0]]))
+    np.testing.assert_allclose(float(t), 0.0)  # neg far: loss clamps to 0
+    c = nn.CosineEmbeddingLoss()(_t([[1.0, 0.0]]), _t([[1.0, 0.0]]),
+                                 _t(np.array([1])))
+    np.testing.assert_allclose(float(c), 0.0, atol=1e-6)
+
+
+def test_ctc_loss_simple():
+    """Two timesteps, one label — brute-force checkable: paths are
+    (l, blank), (blank, l), (l, l) over T=2."""
+    T, B, C, L = 2, 1, 3, 1
+    logits = np.log(np.full((T, B, C), 1.0 / 3.0, np.float32))
+    labels = np.array([[1]], np.int64)
+    loss = F.ctc_loss(_t(logits), _t(labels), _t(np.array([2])),
+                      _t(np.array([1])), blank=0, reduction="none")
+    want = -np.log(3.0 / 9.0)  # 3 valid paths, each prob 1/9
+    np.testing.assert_allclose(float(loss), want, rtol=1e-4)
+
+
+def test_ctc_loss_decreases_training():
+    rng = np.random.RandomState(0)
+    lin = nn.Linear(4, 5)
+    x = _t(rng.randn(6, 2, 4).astype(np.float32))  # [T, B, F]
+    labels = _t(np.array([[1, 2], [3, 4]], np.int64))
+    il = _t(np.array([6, 6]))
+    ll = _t(np.array([2, 2]))
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=lin.parameters())
+    losses = []
+    for _ in range(15):
+        logp = F.log_softmax(lin(x), axis=-1)
+        loss = F.ctc_loss(logp, labels, il, ll)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_rrelu_modes():
+    layer = nn.RReLU(0.1, 0.3)
+    x = _t(np.array([-10.0, 10.0], np.float32))
+    layer.eval()
+    np.testing.assert_allclose(layer(x).numpy(), [-2.0, 10.0], rtol=1e-5)
+    layer.train()
+    out = layer(x).numpy()
+    assert -3.0 <= out[0] <= -1.0 and out[1] == 10.0
+
+
+def test_generic_rnn_wrapper():
+    cell = nn.SimpleRNNCell(3, 4)
+    rnn = nn.RNN(cell)
+    x = _t(np.random.RandomState(2).randn(2, 5, 3).astype(np.float32))
+    out, state = rnn(x)
+    assert tuple(out.shape) == (2, 5, 4)
+    assert tuple(state.shape) == (2, 4)
+
+
+def test_ctc_mean_normalizes_by_label_length():
+    T, B, C = 2, 1, 3
+    logits = np.log(np.full((T, B, C), 1.0 / 3.0, np.float32))
+    labels = _t(np.array([[1]], np.int64))
+    none_l = F.ctc_loss(_t(logits), labels, _t(np.array([2])),
+                        _t(np.array([1])), reduction="none")
+    mean_l = F.ctc_loss(_t(logits), labels, _t(np.array([2])),
+                        _t(np.array([1])), reduction="mean")
+    np.testing.assert_allclose(float(mean_l), float(none_l) / 1.0, rtol=1e-6)
+
+
+def test_triplet_no_nan_at_zero_distance():
+    a = _t(np.zeros((2, 3), np.float32), stop_gradient=False)
+    loss = F.triplet_margin_loss(a, _t(np.zeros((2, 3), np.float32)),
+                                 _t(np.ones((2, 3), np.float32)))
+    loss.backward()
+    assert np.all(np.isfinite(a.grad.numpy()))
+
+
+def test_rnn_sequence_length_masks_states():
+    cell = nn.SimpleRNNCell(2, 3)
+    rnn = nn.RNN(cell)
+    x = _t(np.random.RandomState(3).randn(2, 4, 2).astype(np.float32))
+    out_full, state_full = rnn(x)
+    out_m, state_m = rnn(x, sequence_length=np.array([2, 4]))
+    # sample 0's final state == its state after step 2 (pads ignored)
+    out_ref, state_ref = rnn(_t(x.numpy()[:1, :2]))
+    np.testing.assert_allclose(state_m.numpy()[0], state_ref.numpy()[0],
+                               rtol=1e-5)
+    # sample 1 ran the full length
+    np.testing.assert_allclose(state_m.numpy()[1], state_full.numpy()[1],
+                               rtol=1e-5)
+    # padded outputs are zeroed
+    np.testing.assert_allclose(out_m.numpy()[0, 2:], 0.0)
+
+
+def test_pixel_unshuffle_nhwc():
+    x = np.random.RandomState(4).rand(1, 4, 4, 2).astype(np.float32)  # NHWC
+    out = F.pixel_unshuffle(_t(x), 2, data_format="NHWC").numpy()
+    want = F.pixel_unshuffle(_t(x.transpose(0, 3, 1, 2)), 2).numpy()
+    np.testing.assert_allclose(out.transpose(0, 3, 1, 2), want)
