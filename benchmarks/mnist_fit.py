@@ -1,0 +1,45 @@
+"""BASELINE config 0: MNIST LeNet via the hapi ``Model.fit`` loop.
+
+Measures full-pipeline samples/sec (DataLoader -> train_batch -> metrics)
+on the synthetic MNIST dataset. Prints one JSON line.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.vision.datasets import MNIST
+    from paddle_tpu.vision.models import LeNet
+
+    train = MNIST(mode="train")
+    model = paddle.Model(LeNet())
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=1e-3,
+                              parameters=model.network.parameters()),
+        nn.CrossEntropyLoss(),
+        paddle.metric.Accuracy())
+
+    model.fit(train, epochs=1, batch_size=256, verbose=0)  # warmup/compile
+    t0 = time.perf_counter()
+    model.fit(train, epochs=1, batch_size=256, verbose=0)
+    dt = time.perf_counter() - t0
+    sps = len(train) / dt
+    log(f"{sps:,.0f} samples/s steady-state (epoch in {dt:.1f}s)")
+    print(json.dumps({
+        "metric": "mnist_lenet_fit_throughput", "value": round(sps, 1),
+        "unit": "samples/sec", "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

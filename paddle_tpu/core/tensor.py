@@ -292,6 +292,58 @@ class Tensor:
         o = other._value if isinstance(other, Tensor) else other
         return self._inplace_set(self._value * o)
 
+    def clip_(self, min=None, max=None) -> "Tensor":
+        return self._inplace_set(jnp.clip(self._value, min, max))
+
+    def exp_(self) -> "Tensor":
+        return self._inplace_set(jnp.exp(self._value))
+
+    def sqrt_(self) -> "Tensor":
+        return self._inplace_set(jnp.sqrt(self._value))
+
+    def floor_(self) -> "Tensor":
+        return self._inplace_set(jnp.floor(self._value))
+
+    def ceil_(self) -> "Tensor":
+        return self._inplace_set(jnp.ceil(self._value))
+
+    def round_(self) -> "Tensor":
+        return self._inplace_set(jnp.round(self._value))
+
+    def reciprocal_(self) -> "Tensor":
+        return self._inplace_set(1.0 / self._value)
+
+    def tanh_(self) -> "Tensor":
+        return self._inplace_set(jnp.tanh(self._value))
+
+    def scatter_(self, index, updates, overwrite=True) -> "Tensor":
+        iv = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+        iv = iv.reshape(-1)  # paddle accepts (N,) or (N,1) row indices
+        uv = (updates._value if isinstance(updates, Tensor)
+              else jnp.asarray(updates))
+        if overwrite:
+            return self._inplace_set(self._value.at[iv].set(uv))
+        return self._inplace_set(self._value.at[iv].add(uv))
+
+    def flatten_(self, start_axis=0, stop_axis=-1) -> "Tensor":
+        from ..ops.manipulation import flatten as _flatten
+
+        # reuse the ops kernel's axis normalization/validation (0-d, ranges)
+        flat = _flatten(Tensor(self._value, stop_gradient=True),
+                        start_axis, stop_axis)
+        return self._inplace_set(flat._value)
+
+    def squeeze_(self, axis=None) -> "Tensor":
+        return self._inplace_set(jnp.squeeze(
+            self._value, axis=tuple(axis) if isinstance(axis, (list, tuple))
+            else axis))
+
+    def unsqueeze_(self, axis) -> "Tensor":
+        return self._inplace_set(jnp.expand_dims(self._value, axis))
+
+    def reshape_(self, shape) -> "Tensor":
+        return self._inplace_set(self._value.reshape(tuple(shape)))
+
     # -- indexing -----------------------------------------------------------
     def __getitem__(self, idx):
         from ..ops.dispatch import run_op

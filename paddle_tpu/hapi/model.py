@@ -37,6 +37,8 @@ class Model:
         self._loss = None
         self._metrics: List[Metric] = []
         self.stop_training = False
+        self._fused_step = None
+        self._fused_failed = False
 
     # -- setup ---------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
@@ -64,6 +66,40 @@ class Model:
         self.network.train()
         inputs = _as_tensor_batch(inputs)
         labels = _as_tensor_batch(labels) if labels is not None else []
+        no_pending_grads = self._optimizer is None or all(
+            p.grad is None for p in self._optimizer._params())
+        if update and self._optimizer is not None and no_pending_grads:
+            # hot path: fwd+bwd+optimizer as ONE compiled XLA program per
+            # batch (paddle.jit.fused_train_step) — the reference's per-op
+            # C++ dispatch has ~ns overhead, ours is a device dispatch, so
+            # batching the whole step into one program is the TPU-native
+            # equivalent. Falls back to eager per-op if tracing fails.
+            if self._fused_step is None and not self._fused_failed:
+                net, n_in = self.network, len(inputs)
+
+                def _loss_and_outs(*args):
+                    outputs = net(*args[:n_in])
+                    loss = self._compute_loss(outputs, list(args[n_in:]))
+                    outs = (list(outputs) if isinstance(outputs,
+                                                        (list, tuple))
+                            else [outputs])
+                    return (loss, *outs)
+
+                from ..jit import fused_train_step
+
+                self._fused_step = fused_train_step(
+                    _loss_and_outs, self._optimizer, model=self.network,
+                    has_aux=True)
+            if self._fused_step is not None:
+                try:
+                    loss, *outs = self._fused_step(*inputs, *labels)
+                    outputs = outs if len(outs) > 1 else outs[0]
+                    metrics = self._update_metrics(outputs, labels)
+                    return (([float(loss.item())], metrics) if metrics
+                            else [float(loss.item())])
+                except Exception:
+                    self._fused_step = None
+                    self._fused_failed = True  # eager fallback from now on
         outputs = self.network(*inputs)
         loss = self._compute_loss(outputs, labels)
         loss.backward()

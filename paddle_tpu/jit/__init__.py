@@ -29,7 +29,7 @@ from ..nn.layer.layers import Layer
 from ..ops.dispatch import run_op
 from ..static import InputSpec
 
-__all__ = ["to_static", "TracedProgram", "save", "load", "ignore_module", "not_to_static", "is_tracing"]
+__all__ = ["to_static", "TracedProgram", "save", "load", "ignore_module", "not_to_static", "is_tracing", "fused_train_step", "FusedTrainStep"]
 
 _TRACING = [False]
 
@@ -37,9 +37,23 @@ _TRACING = [False]
 def is_tracing() -> bool:
     """True while a TracedProgram is being traced (layers use this to skip
     host-side buffer mutation that would leak tracers, e.g. BN running
-    stats — documented divergence: running stats don't update inside
-    to_static'd training steps)."""
+    stats). Under ``fused_train_step`` a buffer-write COLLECTOR is active
+    instead: ``record_buffer_write`` routes new buffer values out of the
+    compiled program so running stats keep updating (to_static'd inference
+    keeps the documented skip-divergence)."""
     return _TRACING[0]
+
+
+_BUFFER_COLLECTOR: List[Any] = []  # stack of active write-collectors
+
+
+def record_buffer_write(tensor, new_value) -> bool:
+    """Register a traced buffer update (BN running stats etc.). Returns
+    True when a collector consumed it; False → caller should skip."""
+    if not _BUFFER_COLLECTOR:
+        return False
+    _BUFFER_COLLECTOR[-1].append((tensor, new_value))
+    return True
 
 
 def _collect_state(obj) -> Tuple[List[Tensor], List[Tensor], Optional[Layer]]:
@@ -55,10 +69,25 @@ def _collect_state(obj) -> Tuple[List[Tensor], List[Tensor], Optional[Layer]]:
         layer = obj.__self__
         params = [p for p in obj.__self__.parameters() if not p.stop_gradient]
         buffers = obj.__self__.buffers()
-    elif hasattr(obj, "__closure__") and obj.__closure__:
+    else:
+        # free variables (nested fn) AND referenced globals (module-level fn
+        # using a module-level model) — both are how users close over Layers
+        candidates = []
+        if hasattr(obj, "__closure__") and obj.__closure__:
+            for cell in obj.__closure__:
+                try:
+                    candidates.append(cell.cell_contents)
+                except ValueError:
+                    pass
+        code = getattr(obj, "__code__", None)
+        glb = getattr(obj, "__globals__", None)
+        if code is not None and glb is not None:
+            for name in code.co_names:
+                v = glb.get(name)
+                if isinstance(v, Layer):
+                    candidates.append(v)
         seen = set()
-        for cell in obj.__closure__:
-            v = cell.cell_contents
+        for v in candidates:
             if isinstance(v, Layer):
                 for p in v.parameters():
                     if not p.stop_gradient and id(p) not in seen:
@@ -387,3 +416,157 @@ def ignore_module(modules):
 
 def not_to_static(fn=None):
     return fn
+
+
+class FusedTrainStep:
+    """ONE compiled XLA program per optimization step: forward + loss +
+    backward + optimizer update, with parameters/optimizer state in donated
+    buffers.
+
+    TPU-native rationale: the reference pays per-op launch costs and so
+    splits compute/optimizer into streams; under XLA the whole step as a
+    single program lets the compiler overlap everything AND costs exactly
+    one host->device dispatch — which dominates when dispatch latency is
+    non-trivial (remote/tunneled PJRT). This is the Layer/Optimizer-API
+    counterpart of ``models.llama.make_sharded_train_step``.
+
+    Usage::
+
+        step = paddle.jit.fused_train_step(loss_fn, optimizer)  # or (model=)
+        loss = step(x, y)          # params/opt state updated in place
+    """
+
+    def __init__(self, loss_fn: Callable, optimizer, model: Optional[Layer] = None,
+                 has_aux: bool = False):
+        self._loss_fn = loss_fn
+        self._opt = optimizer
+        self._has_aux = has_aux  # loss_fn returns (loss, aux...) — aux is
+        # returned to the caller (e.g. logits for metrics) from the SAME
+        # single compiled program
+        if model is None:
+            # discover the Layer through the closure like TracedProgram does
+            # (buffers must ride the program as inputs, not baked constants)
+            _, _, model = _collect_state(loss_fn)
+        self._model = model
+        self._cache: Dict[Any, Any] = {}
+
+    def _state_setup(self):
+        opt = self._opt
+        params = opt._params()
+        for p in params:
+            opt._ensure_state(p)
+        state_keys = opt._state_names()
+        svals = [{k: opt._accumulators[id(p)][k] for k in state_keys}
+                 for p in params]
+        evals = [opt._per_param_extras(p) for p in params]
+        buffers = self._model.buffers() if self._model is not None else []
+        return params, state_keys, svals, evals, buffers
+
+    def __call__(self, *inputs):
+        from ..framework import random as _random
+        from ..framework.random import next_key
+
+        opt = self._opt
+        params, state_keys, svals, evals, buffers = self._state_setup()
+        tensor_args, arg_tree, rest_args, rest_kwargs = _split_args(inputs, {})
+        ivals = [t._value for t in tensor_args]
+
+        key = (_tree_key(arg_tree),
+               tuple((tuple(v.shape), str(v.dtype)) for v in ivals),
+               tuple(id(p) for p in params),  # unfreezing params recompiles
+               getattr(self._model, "training", None))
+        jitted = self._cache.get(key)
+        if jitted is None:
+            loss_fn = self._loss_fn
+            rest_args = ()  # _rebuild_args rebuilds from arg_tree alone;
+            # capturing the caller's tensors would pin their device buffers
+            swap_targets = list(params) + list(buffers)
+            l2 = opt._l2_coeff
+            decay_in_grad = opt._apply_weight_decay_to_grad()
+            grad_clip = opt._grad_clip
+            update_one = opt._update_one
+
+            has_aux = self._has_aux
+
+            def pure(key_data, pvals, bvals, svals_, evals_, lr_, step_,
+                     *ivals_):
+                def functional_loss(pvals_):
+                    buf_writes: List[Any] = []
+                    with _SwapValues(swap_targets,
+                                     list(pvals_) + list(bvals)):
+                        args, kwargs = _rebuild_args(arg_tree, ivals_,
+                                                     rest_args, rest_kwargs)
+                        _TRACING[0] = True
+                        _BUFFER_COLLECTOR.append(buf_writes)
+                        _random.push_trace_key(
+                            jax.random.wrap_key_data(key_data))
+                        try:
+                            with autograd.no_grad():
+                                out = loss_fn(*args, **kwargs)
+                        finally:
+                            _random.pop_trace_key()
+                            _BUFFER_COLLECTOR.pop()
+                            _TRACING[0] = False
+                    # buffer updates (BN running stats) must flow OUT through
+                    # the differentiated function's aux — a side list would
+                    # leak linearize-trace tracers
+                    by_id = {id(t): v for t, v in buf_writes}
+                    new_b_local = tuple(
+                        jax.lax.stop_gradient(by_id[id(b)])
+                        if id(b) in by_id else bv
+                        for b, bv in zip(buffers, bvals))
+                    if has_aux:
+                        loss_t, *aux = out
+                        aux_vals = tuple(
+                            a._value if isinstance(a, Tensor) else a
+                            for a in aux)
+                    else:
+                        loss_t, aux_vals = out, ()
+                    return (loss_t._value.astype(jnp.float32),
+                            (aux_vals, new_b_local))
+
+                (loss, (aux, new_b)), grads = jax.value_and_grad(
+                    functional_loss, has_aux=True)(list(pvals))
+                if grad_clip is not None:
+                    clipped = grad_clip(list(zip(params, grads)))
+                    grads = [g for _, g in clipped]
+                new_p, new_s = [], []
+                for p, pv, g, s, e in zip(params, pvals, grads, svals_,
+                                          evals_):
+                    g = g.astype(pv.dtype) if g.dtype != pv.dtype else g
+                    if l2 and decay_in_grad:
+                        g = g + l2 * pv
+                    np_, ns_ = update_one(pv, g, s, lr_, step_, e)
+                    new_p.append(np_)
+                    new_s.append(ns_)
+                return loss, aux, new_p, new_s, new_b
+
+            jitted = jax.jit(pure, donate_argnums=(1, 3))
+            self._cache[key] = jitted
+
+        bvals = [b._value for b in buffers]
+        pvals = [p._value for p in params]
+        lr = jnp.float32(opt.get_lr())
+        # step count rides as data; committed only after a successful call so
+        # a failed trace doesn't skew bias correction for an eager fallback
+        loss, aux, new_p, new_s, new_b = jitted(
+            jax.random.key_data(next_key()), pvals, bvals, svals, evals,
+            lr, jnp.int32(opt._step_count + 1), *ivals)
+        opt._step_count += 1
+        for p, np_, ns_ in zip(params, new_p, new_s):
+            p._inplace_set(np_)
+            opt._accumulators[id(p)] = ns_
+        for b, nb in zip(buffers, new_b):
+            if nb is not b._value:
+                b._inplace_set(nb)
+        loss_t = Tensor(loss, stop_gradient=True)
+        if self._has_aux:
+            return (loss_t,) + tuple(Tensor(a, stop_gradient=True)
+                                     for a in aux)
+        return loss_t
+
+
+def fused_train_step(loss_fn=None, optimizer=None, model=None,
+                     has_aux=False):
+    """Build a one-dispatch-per-step compiled training function."""
+    return FusedTrainStep(loss_fn, optimizer, model, has_aux=has_aux)

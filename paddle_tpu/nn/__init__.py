@@ -22,10 +22,12 @@ from .layer.transformer import *  # noqa: F401,F403
 from .layer.transformer import __all__ as _tfm_all
 from .layer.extras import *  # noqa: F401,F403
 from .layer.extras import __all__ as _extras_all
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 from .utils import clip_grad_norm_, clip_grad_value_, parameters_to_vector, vector_to_parameters
 
 __all__ = (
-    ["Layer", "Parameter", "ParamAttr", "functional", "initializer"]
+    ["Layer", "Parameter", "ParamAttr", "functional", "initializer",
+     "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue"]
     + _act_all + _common_all + _container_all + _conv_all + _loss_all
     + _norm_all + _pool_all + _rnn_all + _tfm_all + _extras_all
 )

@@ -540,6 +540,20 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         with_var = jnp.var(x._value, axis=axes)
         running_mean._inplace_set(momentum * running_mean._value + (1 - momentum) * with_mean)
         running_var._inplace_set(momentum * running_var._value + (1 - momentum) * with_var)
+    elif use_batch_stats:
+        # traced (fused_train_step): route the new stats to the trace's
+        # buffer-write collector so the compiled program RETURNS them and
+        # the caller writes them back — running stats keep updating
+        from ...jit import record_buffer_write
+
+        record_buffer_write(
+            running_mean,
+            momentum * running_mean._value
+            + (1 - momentum) * jnp.mean(x._value, axis=axes))
+        record_buffer_write(
+            running_var,
+            momentum * running_var._value
+            + (1 - momentum) * jnp.var(x._value, axis=axes))
 
     shape = [1] * x.ndim
     shape[channel_axis] = x.shape[channel_axis]

@@ -21,18 +21,20 @@ class PixelUnshuffle(Layer):
     def __init__(self, downscale_factor, data_format="NCHW", name=None):
         super().__init__()
         self._factor = downscale_factor
+        self._data_format = data_format
 
     def forward(self, x):
-        return F.pixel_unshuffle(x, self._factor)
+        return F.pixel_unshuffle(x, self._factor, self._data_format)
 
 
 class ChannelShuffle(Layer):
     def __init__(self, groups, data_format="NCHW", name=None):
         super().__init__()
         self._groups = groups
+        self._data_format = data_format
 
     def forward(self, x):
-        return F.channel_shuffle(x, self._groups)
+        return F.channel_shuffle(x, self._groups, self._data_format)
 
 
 class Fold(Layer):
@@ -140,7 +142,7 @@ class RNN(Layer):
     def forward(self, inputs, initial_states=None, sequence_length=None):
         import jax.numpy as jnp
 
-        from ...core.tensor import Tensor
+        from ...core.tensor import Tensor, to_tensor
         from ...ops import manipulation as M
 
         t_axis = 0 if self._time_major else 1
@@ -152,29 +154,29 @@ class RNN(Layer):
                      if sequence_length is not None else None))
         outs = []
 
-        def merge(new, old, mask):
-            # per-leaf: keep the new value only for rows still in-sequence
+        def merge(new, old, mask_t):
+            # per-leaf masked select through REGISTERED ops so the result
+            # stays on the autograd tape (raw jnp.where would sever it)
             if old is None:
                 return new
             if isinstance(new, (tuple, list)):
-                return type(new)(merge(n, o, mask)
+                return type(new)(merge(n, o, mask_t)
                                  for n, o in zip(new, old))
-            nv = new._value if isinstance(new, Tensor) else new
-            ov = old._value if isinstance(old, Tensor) else old
-            m = mask.reshape((-1,) + (1,) * (nv.ndim - 1))
-            out = jnp.where(m, nv, ov)
-            return Tensor(out, stop_gradient=True) if isinstance(
-                new, Tensor) else out
+            m = mask_t
+            for _ in range(new.ndim - 1):
+                m = m.unsqueeze(-1)
+            return new * m + old * (1.0 - m)
 
         for t in steps:
             xt = (inputs[t] if self._time_major else inputs[:, t])
             out, new_states = self.cell(xt, states)
             if seq is not None:
-                mask = t < seq
-                states = merge(new_states, states, mask)
-                mz = mask.reshape((-1,) + (1,) * (out.ndim - 1))
-                out = Tensor(jnp.where(mz, out._value, 0.0),
-                             stop_gradient=True)
+                mask_t = to_tensor((t < seq).astype(jnp.float32))
+                states = merge(new_states, states, mask_t)
+                m = mask_t
+                for _ in range(out.ndim - 1):
+                    m = m.unsqueeze(-1)
+                out = out * m
             else:
                 states = new_states
             outs.append(out)

@@ -1,10 +1,11 @@
 """``paddle.optimizer`` surface."""
 
 from . import lr
-from .adam import Adam, AdamW, Lamb
+from .adam import Adam, AdamW, Adamax, Lamb, NAdam, RAdam
+from .lbfgs import LBFGS
 from .optimizer import SGD, Adadelta, Adagrad, Momentum, Optimizer, RMSProp
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Lamb", "Adagrad",
-    "Adadelta", "RMSProp", "lr",
+    "Adadelta", "RMSProp", "Adamax", "NAdam", "RAdam", "LBFGS", "lr",
 ]

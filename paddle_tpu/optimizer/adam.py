@@ -9,7 +9,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from .optimizer import Optimizer
 
-__all__ = ["Adam", "AdamW", "Lamb"]
+__all__ = ["Adam", "AdamW", "Lamb", "Adamax", "NAdam", "RAdam"]
 
 
 class Adam(Optimizer):
@@ -145,3 +145,108 @@ class Lamb(Optimizer):
         if self._multi_precision:
             new_state["master"] = new_pf
         return new_pf.astype(p.dtype), new_state
+
+
+class Adamax(Adam):
+    """Adam with infinity-norm second moment (reference
+    ``paddle.optimizer.Adamax``)."""
+
+    def __init__(self, *args, **kwargs):
+        if kwargs.pop("multi_precision", False):
+            from ..enforce import raise_unimplemented
+
+            raise_unimplemented("Adamax(multi_precision=True)")
+        super().__init__(*args, **kwargs)
+
+    def _state_names(self):
+        return ["moment", "inf_norm"]
+
+    def _init_state(self, p):
+        return {
+            "moment": jnp.zeros(p._value.shape, p._value.dtype),
+            "inf_norm": jnp.zeros(p._value.shape, p._value.dtype),
+        }
+
+    def _update_one(self, p, g, state, lr, step, extras=None):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(g))
+        stepf = step.astype(jnp.float32)
+        upd = lr / (1 - b1**stepf) * m / (u + eps)
+        return p - upd.astype(p.dtype), {"moment": m, "inf_norm": u}
+
+
+class NAdam(Adam):
+    """Nesterov-momentum Adam (reference ``paddle.optimizer.NAdam``)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, name=name)
+        self._psi = momentum_decay
+
+    def _state_names(self):
+        return ["moment1", "moment2", "mu_product"]
+
+    def _init_state(self, p):
+        st = {
+            "moment1": jnp.zeros(p._value.shape, p._value.dtype),
+            "moment2": jnp.zeros(p._value.shape, p._value.dtype),
+            "mu_product": jnp.ones((), jnp.float32),
+        }
+        return st
+
+    def _update_one(self, p, g, state, lr, step, extras=None):
+        b1, b2, eps, psi = self._beta1, self._beta2, self._epsilon, self._psi
+        stepf = step.astype(jnp.float32)
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (stepf * psi))
+        mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((stepf + 1) * psi))
+        mu_prod = state["mu_product"] * mu_t
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        mhat = (mu_t1 * m / (1 - mu_prod * mu_t1)
+                + (1 - mu_t) * g / (1 - mu_prod))
+        vhat = v / (1 - b2**stepf)
+        upd = lr * mhat / (jnp.sqrt(vhat) + eps)
+        return p - upd.astype(p.dtype), {
+            "moment1": m, "moment2": v, "mu_product": mu_prod}
+
+
+class RAdam(Adam):
+    """Rectified Adam (reference ``paddle.optimizer.RAdam``): variance
+    rectification term switches between SGD-with-momentum and Adam."""
+
+    def __init__(self, *args, **kwargs):
+        if kwargs.pop("multi_precision", False):
+            from ..enforce import raise_unimplemented
+
+            raise_unimplemented("RAdam(multi_precision=True)")
+        super().__init__(*args, **kwargs)
+
+    def _state_names(self):
+        return ["moment1", "moment2"]
+
+    def _init_state(self, p):
+        return {
+            "moment1": jnp.zeros(p._value.shape, p._value.dtype),
+            "moment2": jnp.zeros(p._value.shape, p._value.dtype),
+        }
+
+    def _update_one(self, p, g, state, lr, step, extras=None):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        stepf = step.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        mhat = m / (1 - b1**stepf)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2.0 * stepf * b2**stepf / (1 - b2**stepf)
+        r_num = (rho_t - 4) * (rho_t - 2) * rho_inf
+        r_den = (rho_inf - 4) * (rho_inf - 2) * rho_t
+        rect = jnp.sqrt(jnp.maximum(r_num, 1e-12)
+                        / jnp.maximum(r_den, 1e-12))
+        vhat = jnp.sqrt(v / (1 - b2**stepf)) + eps
+        adam_upd = lr * rect * mhat / vhat
+        sgd_upd = lr * mhat
+        upd = jnp.where(rho_t > 5.0, adam_upd, sgd_upd)
+        return p - upd.astype(p.dtype), {"moment1": m, "moment2": v}

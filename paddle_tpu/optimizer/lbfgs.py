@@ -1,0 +1,149 @@
+"""L-BFGS optimizer (reference: ``python/paddle/optimizer/lbfgs.py``).
+
+Full-batch quasi-Newton with two-loop recursion and backtracking (Armijo)
+line search. Unlike the first-order optimizers this one needs closure-style
+re-evaluation: ``step(closure)`` where ``closure()`` recomputes the loss
+with gradients, exactly the reference's API.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._max_iter = max_iter
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = history_size
+        self._line_search = line_search_fn  # None | "strong_wolfe"
+        self._s: List[jnp.ndarray] = []
+        self._y: List[jnp.ndarray] = []
+        self._prev_flat_g: Optional[jnp.ndarray] = None
+        self._prev_flat_p: Optional[jnp.ndarray] = None
+
+    # -- flat helpers -------------------------------------------------------
+    def _flat(self, vals):
+        return jnp.concatenate([jnp.ravel(v) for v in vals])
+
+    def _unflat(self, flat):
+        out, off = [], 0
+        for p in self._params():
+            n = int(np.prod(p._value.shape))
+            out.append(flat[off:off + n].reshape(p._value.shape))
+            off += n
+        return out
+
+    def _gather_grads(self):
+        pgs = [(p, p.grad._value if p.grad is not None else None)
+               for p in self._params()]
+        if self._grad_clip is not None:
+            pgs = self._grad_clip([(p, g) for p, g in pgs])
+        gs = []
+        for p, g in pgs:
+            if g is None:
+                g = jnp.zeros(p._value.shape, jnp.float32)
+            g = g.astype(jnp.float32)
+            if self._l2_coeff:
+                g = g + self._l2_coeff * p._value.astype(jnp.float32)
+            gs.append(g)
+        return self._flat(gs)
+
+    def _set_params(self, flat):
+        for p, v in zip(self._params(), self._unflat(flat)):
+            p._inplace_set(v.astype(p._value.dtype))
+
+    # -- the step -----------------------------------------------------------
+    def step(self, closure: Optional[Callable] = None):
+        """Runs up to ``max_iter`` L-BFGS iterations (reference semantics:
+        one ``step(closure)`` call is a full inner optimization loop)."""
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure computing the "
+                             "loss with backward()")
+        loss = closure()
+        for _ in range(self._max_iter):
+            loss, converged = self._iterate(loss, closure)
+            if converged:
+                break
+        return loss
+
+    def _iterate(self, loss, closure):
+        flat_g = self._gather_grads()
+        flat_p = self._flat([p._value.astype(jnp.float32)
+                             for p in self._params()])
+
+        if float(jnp.max(jnp.abs(flat_g))) <= self._tol_grad:
+            return loss, True
+
+        # curvature history update
+        if self._prev_flat_g is not None:
+            s = flat_p - self._prev_flat_p
+            y = flat_g - self._prev_flat_g
+            ys = float(s @ y)
+            if ys > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self._history:
+                    self._s.pop(0)
+                    self._y.pop(0)
+
+        # two-loop recursion
+        q = flat_g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / float(s @ y)
+            a = rho * float(s @ q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            q = q * (float(s @ y) / float(y @ y))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(y @ q)
+            q = q + (a - b) * s
+        direction = -q
+
+        lr = self.get_lr()
+        f0 = float(loss)
+        g_dot_d = float(flat_g @ direction)
+        t = lr
+        # backtracking Armijo line search (the reference's default path also
+        # caps function evaluations)
+        for _ in range(10 if self._line_search else 1):
+            self._set_params(flat_p + t * direction)
+            if not self._line_search:
+                break
+            self.clear_grad()
+            f_new = float(closure())
+            if f_new <= f0 + 1e-4 * t * g_dot_d:
+                break
+            t *= 0.5
+
+        self._prev_flat_g = flat_g
+        self._prev_flat_p = flat_p
+        self._step_count += 1
+        self.clear_grad()
+        new_loss = closure()
+        converged = (abs(float(new_loss) - f0) < self._tol_change
+                     or float(t) * float(jnp.max(jnp.abs(direction)))
+                     < self._tol_change)
+        return new_loss, converged
+
+    def clear_state(self):
+        self._s.clear()
+        self._y.clear()
+        self._prev_flat_g = self._prev_flat_p = None

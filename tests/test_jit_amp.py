@@ -152,3 +152,67 @@ def test_amp_decorate_after_step():
     opt.step()  # must not raise
     st = opt._accumulators[id(net.weight)]
     assert "master" in st and st["moment1"].dtype.name == "float32"
+
+
+class TestFusedTrainStep:
+    def test_parity_with_eager(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        def build():
+            paddle.seed(7)
+            lin = nn.Linear(4, 2)
+            opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                         parameters=lin.parameters())
+            return lin, opt
+
+        rng = np.random.RandomState(0)
+        X = paddle.to_tensor(rng.randn(16, 4).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(16, 2).astype(np.float32))
+
+        lin1, opt1 = build()
+        for _ in range(5):
+            loss = paddle.mean((lin1(X) - y) ** 2)
+            loss.backward()
+            opt1.step()
+            opt1.clear_grad()
+
+        lin2, opt2 = build()
+
+        def loss_fn(a, b):
+            return paddle.mean((lin2(a) - b) ** 2)
+
+        step = paddle.jit.fused_train_step(loss_fn, opt2)
+        for _ in range(5):
+            last = step(X, y)
+        np.testing.assert_allclose(lin2.weight.numpy(), lin1.weight.numpy(),
+                                   rtol=2e-4, atol=1e-6)
+        assert last.stop_gradient
+
+    def test_with_grad_clip_and_scheduler(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        lin = nn.Layer()
+        lin.fc = nn.Linear(3, 3)
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                              step_size=2, gamma=0.5)
+        opt = paddle.optimizer.SGD(
+            learning_rate=sched, parameters=lin.parameters(),
+            grad_clip=nn.ClipGradByGlobalNorm(0.1))
+        X = paddle.to_tensor(np.ones((4, 3), np.float32) * 100)
+
+        def loss_fn(a):
+            return paddle.mean(lin.fc(a) ** 2)
+
+        step = paddle.jit.fused_train_step(loss_fn, opt, model=lin)
+        w0 = lin.fc.weight.numpy().copy()
+        step(X)
+        delta = np.abs(lin.fc.weight.numpy() - w0)
+        # global-norm clip at 0.1 with lr 0.1 bounds the update norm
+        assert np.sqrt((delta ** 2).sum()) <= 0.1 * 0.1 + 1e-5
+        sched.step()
+        step(X)  # lr change recompiles nothing (lr is an input)
+        assert len(step._cache) == 1

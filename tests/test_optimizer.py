@@ -209,3 +209,69 @@ def test_lamb_multi_precision():
     opt.step()
     st = opt._accumulators[id(w)]
     assert "master" in st and st["master"].dtype.name == "float32"
+
+
+class TestNewOptimizers:
+    def _fit(self, opt_cls, steps=40, **kw):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        rng = np.random.RandomState(0)
+        lin = nn.Linear(4, 1)
+        w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+        X = paddle.to_tensor(rng.randn(32, 4).astype(np.float32))
+        y = paddle.to_tensor((X.numpy() @ w).astype(np.float32))
+        opt = opt_cls(parameters=lin.parameters(), **kw)
+        losses = []
+        for _ in range(steps):
+            loss = paddle.mean((lin(X) - y) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        return losses
+
+    def test_adamax(self):
+        import paddle_tpu as paddle
+
+        losses = self._fit(paddle.optimizer.Adamax, learning_rate=0.1)
+        assert losses[-1] < losses[0] * 0.1
+
+    def test_nadam(self):
+        import paddle_tpu as paddle
+
+        losses = self._fit(paddle.optimizer.NAdam, learning_rate=0.1)
+        assert losses[-1] < losses[0] * 0.1
+
+    def test_radam(self):
+        import paddle_tpu as paddle
+
+        losses = self._fit(paddle.optimizer.RAdam, learning_rate=0.1)
+        assert losses[-1] < losses[0] * 0.1
+
+    def test_lbfgs_quadratic(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        rng = np.random.RandomState(1)
+        lin = nn.Linear(4, 1)
+        X = paddle.to_tensor(rng.randn(64, 4).astype(np.float32))
+        w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+        y = paddle.to_tensor((X.numpy() @ w + 0.7).astype(np.float32))
+        opt = paddle.optimizer.LBFGS(learning_rate=0.5,
+                                     line_search_fn="strong_wolfe",
+                                     parameters=lin.parameters())
+
+        def closure():
+            opt.clear_grad()
+            loss = paddle.mean((lin(X) - y) ** 2)
+            loss.backward()
+            return loss
+
+        l0 = float(closure())
+        for _ in range(5):
+            opt.step(closure)
+        lN = float(closure())
+        assert lN < l0 * 0.01, (l0, lN)
