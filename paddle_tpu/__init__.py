@@ -64,6 +64,7 @@ from . import amp  # noqa: F401
 from . import fft  # noqa: F401
 from . import autograd  # noqa: F401
 from . import device  # noqa: F401
+from . import distribution  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401
@@ -72,6 +73,7 @@ from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import quantization  # noqa: F401
 from . import sparse  # noqa: F401
+from . import signal  # noqa: F401
 from . import static  # noqa: F401
 from . import text  # noqa: F401
 from . import utils  # noqa: F401

@@ -24,6 +24,10 @@ __all__ = [
     "enable_grad",
     "is_grad_enabled",
     "set_grad_enabled",
+    "jacobian",
+    "hessian",
+    "jvp",
+    "vjp",
 ]
 
 
@@ -154,3 +158,120 @@ class PyLayer:
             t._out_index = i
             wrapped.append(t)
         return wrapped[0] if single else tuple(wrapped)
+
+
+# ---------------------------------------------------------------------------
+# Functional autograd API (reference: paddle.incubate.autograd /
+# paddle.autograd.jacobian/hessian in 2.6+). Lowered directly onto jax's
+# transform stack: jacrev/jacfwd/jvp/vjp over Tensor-valued functions.
+# ---------------------------------------------------------------------------
+
+def _functionalize(func):
+    """Wrap a Tensor(s)->Tensor(s) function as a pure jax-array function.
+    Inputs wrap with stop_gradient=True — jax does the differentiation here;
+    building the eager tape during tracing would be wasted work."""
+    from ..core.tensor import Tensor
+
+    def unwrap(o):
+        if isinstance(o, Tensor):
+            return o._value
+        if isinstance(o, (list, tuple)):
+            return type(o)(unwrap(v) for v in o)
+        return o
+
+    def pure(*vals):
+        args = [Tensor(v, stop_gradient=True) for v in vals]
+        return unwrap(func(*args))
+
+    return pure
+
+
+def _vals(xs):
+    from ..core.tensor import Tensor
+
+    single = isinstance(xs, Tensor)
+    seq = [xs] if single else list(xs)
+    return [t._value for t in seq], single
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """J[i][j] = d out_i / d x_j (reverse mode). Returns a Tensor (single
+    input) or tuple of Tensors. ``allow_unused`` is accepted for API
+    compatibility; unused inputs always yield zero blocks (jax semantics —
+    the reference's allow_unused=True behavior)."""
+    from ..core.tensor import Tensor
+    from ..enforce import raise_unimplemented
+
+    if create_graph:
+        # results are plain Tensors, not tape nodes — silently detached
+        # higher-order grads would be worse than an explicit error; use
+        # nested jacobian()/hessian() for higher derivatives instead
+        raise_unimplemented("jacobian(create_graph=True)")
+    vals, single = _vals(xs)
+    pure = _functionalize(func)
+    wrap = lambda tree: jax.tree.map(
+        lambda a: Tensor(a, stop_gradient=True), tree)
+    if single:
+        # result mirrors the OUTPUT structure (array leaves -> Tensor)
+        return wrap(jax.jacrev(pure, argnums=0)(*vals))
+    # one jacobian per input (paddle layout: tuple over inputs, each
+    # mirroring the output structure)
+    return tuple(wrap(jax.jacrev(pure, argnums=i)(*vals))
+                 for i in range(len(vals)))
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """H = d^2 f / dx^2 for scalar-output ``func`` (fwd-over-rev).
+    ``allow_unused`` accepted for API compatibility (zero blocks)."""
+    from ..core.tensor import Tensor
+    from ..enforce import raise_unimplemented
+
+    if create_graph:
+        raise_unimplemented("hessian(create_graph=True)")
+    vals, single = _vals(xs)
+    pure = _functionalize(func)
+    if single:
+        return Tensor(jax.hessian(pure, argnums=0)(*vals),
+                      stop_gradient=True)
+    hes = jax.hessian(pure, argnums=tuple(range(len(vals))))(*vals)
+    return tuple(tuple(Tensor(h, stop_gradient=True)
+                       for h in row) for row in hes)
+
+
+def jvp(func, xs, v=None):
+    """(outputs, Jv) — forward-mode directional derivative."""
+    from ..core.tensor import Tensor
+
+    vals, single = _vals(xs)
+    if v is None:
+        tangents = [jax.numpy.ones_like(x) for x in vals]
+    else:
+        tv, _ = _vals(v)
+        tangents = tv
+    out, tangent_out = jax.jvp(_functionalize(func), tuple(vals),
+                               tuple(tangents))
+    return Tensor(out, stop_gradient=True), Tensor(tangent_out,
+                                                   stop_gradient=True)
+
+
+def vjp(func, xs, v=None):
+    """(outputs, vJ) — reverse-mode vector-Jacobian product."""
+    from ..core.tensor import Tensor
+
+    vals, single = _vals(xs)
+    out, vjp_fn = jax.vjp(_functionalize(func), *vals)
+    if v is None:
+        cot = jax.tree.map(jax.numpy.ones_like, out)
+    else:
+        from ..enforce import InvalidArgumentError
+
+        cv, _ = _vals(v)
+        n_out = len(out) if isinstance(out, (tuple, list)) else 1
+        if len(cv) != n_out:
+            raise InvalidArgumentError(
+                f"vjp: v has {len(cv)} cotangents but func returns "
+                f"{n_out} output(s) — v must match the OUTPUT structure")
+        cot = cv[0] if n_out == 1 else type(out)(cv)
+    grads = vjp_fn(cot)
+    outs = tuple(Tensor(g, stop_gradient=True) for g in grads)
+    return Tensor(out, stop_gradient=True), (outs[0] if single else outs)

@@ -1,0 +1,768 @@
+"""``paddle.distribution`` — probability distributions.
+
+Reference counterpart: ``python/paddle/distribution/`` (Distribution base,
+Normal/Uniform/Categorical/Beta/Dirichlet/..., ``kl_divergence`` registry,
+``TransformedDistribution``; SURVEY.md §2.1 Python user API).
+
+TPU-native: densities evaluate through jax (XLA-fused elementwise math);
+sampling uses the framework RNG key stream (``framework.random.next_key``),
+so samples inside ``to_static``/``fused_train_step`` programs draw fresh
+per-call randomness like every other random op.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..framework.random import next_key
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+    "Beta", "Dirichlet", "Gamma", "Exponential", "Laplace", "LogNormal",
+    "Gumbel", "Geometric", "Cauchy", "Multinomial", "Poisson",
+    "Independent", "TransformedDistribution", "kl_divergence",
+    "register_kl", "Transform", "AffineTransform", "ExpTransform",
+    "SigmoidTransform",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _t(x) -> Tensor:
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+class Distribution:
+    """Base class (reference ``paddle.distribution.Distribution``)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self) -> Tensor:
+        raise NotImplementedError
+
+    @property
+    def variance(self) -> Tensor:
+        raise NotImplementedError
+
+    def sample(self, shape=()) -> Tensor:
+        raise NotImplementedError
+
+    def rsample(self, shape=()) -> Tensor:
+        raise NotImplementedError
+
+    def log_prob(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def prob(self, value) -> Tensor:
+        from ..ops.dispatch import run_op
+
+        lp = self.log_prob(value)
+        return run_op("exp", jnp.exp, lp)
+
+    def entropy(self) -> Tensor:
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._value.shape,
+                                              self.scale._value.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        from ..ops.dispatch import run_op
+
+        return run_op("square", jnp.square, self.scale)
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def rsample(self, shape=()):
+        from ..ops.dispatch import run_op
+
+        shp = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(next_key(), shp, jnp.float32)
+        return run_op("normal_rsample",
+                      lambda l, s: l + s * eps, self.loc, self.scale)
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        def f(x, l, s):
+            z = (x - l) / s
+            return -0.5 * z * z - jnp.log(s) - 0.5 * math.log(2 * math.pi)
+
+        return run_op("normal_log_prob", f, _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        from ..ops.dispatch import run_op
+
+        return run_op("normal_entropy",
+                      lambda s: 0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(s), self.scale)
+
+    def kl_divergence(self, other: "Normal"):
+        return kl_divergence(self, other)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(jnp.broadcast_shapes(self.low._value.shape,
+                                              self.high._value.shape))
+
+    @property
+    def mean(self):
+        from ..ops.dispatch import run_op
+
+        return run_op("uniform_mean", lambda a, b: (a + b) / 2.0,
+                      self.low, self.high)
+
+    @property
+    def variance(self):
+        from ..ops.dispatch import run_op
+
+        return run_op("uniform_var", lambda a, b: (b - a) ** 2 / 12.0,
+                      self.low, self.high)
+
+    def rsample(self, shape=()):
+        from ..ops.dispatch import run_op
+
+        shp = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shp, jnp.float32)
+        return run_op("uniform_rsample", lambda a, b: a + (b - a) * u,
+                      self.low, self.high)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        def f(x, a, b):
+            inside = (x >= a) & (x < b)
+            return jnp.where(inside, -jnp.log(b - a), -jnp.inf)
+
+        return run_op("uniform_log_prob", f, _t(value), self.low, self.high)
+
+    def entropy(self):
+        from ..ops.dispatch import run_op
+
+        return run_op("uniform_entropy", lambda a, b: jnp.log(b - a),
+                      self.low, self.high)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(self.probs._value.shape)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        from ..ops.dispatch import run_op
+
+        return run_op("bern_var", lambda p: p * (1 - p), self.probs)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shp)
+        return to_tensor((u < self.probs._value).astype(jnp.float32))
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        def f(x, p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return x * jnp.log(p) + (1 - x) * jnp.log1p(-p)
+
+        return run_op("bern_log_prob", f, _t(value), self.probs)
+
+    def entropy(self):
+        from ..ops.dispatch import run_op
+
+        def f(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+        return run_op("bern_entropy", f, self.probs)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        super().__init__(self.logits._value.shape[:-1])
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        out = jax.random.categorical(next_key(), self.logits._value,
+                                     shape=shp)
+        return to_tensor(out)
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        def f(lg):
+            lp = jax.nn.log_softmax(lg, axis=-1)
+            idx = _v(value).astype(jnp.int32)
+            return jnp.take_along_axis(lp, idx[..., None], axis=-1)[..., 0]
+
+        return run_op("cat_log_prob", f, self.logits)
+
+    def probs(self, value=None):
+        from ..ops.dispatch import run_op
+
+        p = run_op("softmax", lambda lg: jax.nn.softmax(lg, -1), self.logits)
+        if value is None:
+            return p
+        from ..ops.dispatch import run_op as _r
+
+        return _r("gather_probs", lambda pv: jnp.take_along_axis(
+            pv, _v(value).astype(jnp.int32)[..., None], axis=-1)[..., 0], p)
+
+    def entropy(self):
+        from ..ops.dispatch import run_op
+
+        def f(lg):
+            lp = jax.nn.log_softmax(lg, axis=-1)
+            return -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+
+        return run_op("cat_entropy", f, self.logits)
+
+
+class _UnitIntervalDist(Distribution):
+    """Shared machinery for Beta/Dirichlet style simplex distributions."""
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha._value.shape,
+                                              self.beta._value.shape))
+
+    @property
+    def mean(self):
+        from ..ops.dispatch import run_op
+
+        return run_op("beta_mean", lambda a, b: a / (a + b),
+                      self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        from ..ops.dispatch import run_op
+
+        return run_op("beta_var",
+                      lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                      self.alpha, self.beta)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        out = jax.random.beta(next_key(), self.alpha._value,
+                              self.beta._value, shape=shp)
+        return to_tensor(out)
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        def f(x, a, b):
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (a - 1) * jnp.log(x) + (b - 1) * jnp.log1p(-x) - lbeta
+
+        return run_op("beta_log_prob", f, _t(value), self.alpha, self.beta)
+
+    def entropy(self):
+        from ..ops.dispatch import run_op
+
+        def f(a, b):
+            dg = jax.scipy.special.digamma
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+
+        return run_op("beta_entropy", f, self.alpha, self.beta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        shape = self.concentration._value.shape
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        from ..ops.dispatch import run_op
+
+        return run_op("dir_mean",
+                      lambda c: c / jnp.sum(c, -1, keepdims=True),
+                      self.concentration)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        out = jax.random.dirichlet(next_key(), self.concentration._value,
+                                   shape=shp)
+        return to_tensor(out)
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        def f(x, c):
+            gl = jax.scipy.special.gammaln
+            return (jnp.sum((c - 1) * jnp.log(x), -1)
+                    + gl(jnp.sum(c, -1)) - jnp.sum(gl(c), -1))
+
+        return run_op("dir_log_prob", f, _t(value), self.concentration)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(jnp.broadcast_shapes(
+            self.concentration._value.shape, self.rate._value.shape))
+
+    @property
+    def mean(self):
+        from ..ops.dispatch import run_op
+
+        return run_op("gamma_mean", lambda c, r: c / r,
+                      self.concentration, self.rate)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        g = jax.random.gamma(next_key(), self.concentration._value,
+                             shape=shp)
+        return to_tensor(g / self.rate._value)
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        def f(x, c, r):
+            return (c * jnp.log(r) + (c - 1) * jnp.log(x) - r * x
+                    - jax.scipy.special.gammaln(c))
+
+        return run_op("gamma_log_prob", f, _t(value), self.concentration,
+                      self.rate)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(self.rate._value.shape)
+
+    @property
+    def mean(self):
+        from ..ops.dispatch import run_op
+
+        return run_op("exp_mean", lambda r: 1.0 / r, self.rate)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        e = jax.random.exponential(next_key(), shp)
+        return to_tensor(e / self.rate._value)
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        return run_op("exp_log_prob",
+                      lambda x, r: jnp.log(r) - r * x, _t(value), self.rate)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._value.shape,
+                                              self.scale._value.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        l = jax.random.laplace(next_key(), shp)
+        return to_tensor(self.loc._value + self.scale._value * l)
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        return run_op(
+            "laplace_log_prob",
+            lambda x, m, b: -jnp.abs(x - m) / b - jnp.log(2 * b),
+            _t(value), self.loc, self.scale)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape)
+
+    def sample(self, shape=()):
+        from ..ops.dispatch import run_op
+
+        return run_op("exp", jnp.exp, self._base.sample(shape))
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        def f(x, l, s):
+            lx = jnp.log(x)
+            z = (lx - l) / s
+            return (-0.5 * z * z - jnp.log(s)
+                    - 0.5 * math.log(2 * math.pi) - lx)
+
+        return run_op("lognormal_log_prob", f, _t(value), self.loc,
+                      self.scale)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._value.shape,
+                                              self.scale._value.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        g = jax.random.gumbel(next_key(), shp)
+        return to_tensor(self.loc._value + self.scale._value * g)
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        def f(x, m, b):
+            z = (x - m) / b
+            return -(z + jnp.exp(-z)) - jnp.log(b)
+
+        return run_op("gumbel_log_prob", f, _t(value), self.loc, self.scale)
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(self.probs._value.shape)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        out = jax.random.geometric(next_key(), self.probs._value, shape=shp)
+        return to_tensor(out.astype(jnp.float32) - 1.0)  # failures count
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        return run_op(
+            "geom_log_prob",
+            lambda k, p: k * jnp.log1p(-p) + jnp.log(p),
+            _t(value), self.probs)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._value.shape,
+                                              self.scale._value.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        c = jax.random.cauchy(next_key(), shp)
+        return to_tensor(self.loc._value + self.scale._value * c)
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        def f(x, m, g):
+            return -jnp.log(math.pi * g * (1 + ((x - m) / g) ** 2))
+
+        return run_op("cauchy_log_prob", f, _t(value), self.loc, self.scale)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(self.rate._value.shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        out = jax.random.poisson(next_key(), self.rate._value, shape=shp)
+        return to_tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        def f(k, r):
+            return k * jnp.log(r) - r - jax.scipy.special.gammaln(k + 1)
+
+        return run_op("poisson_log_prob", f, _t(value), self.rate)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        shape = self.probs._value.shape
+        super().__init__(shape[:-1], shape[-1:])
+
+    def sample(self, shape=()):
+        n = self.total_count
+        cats = jax.random.categorical(
+            next_key(), jnp.log(jnp.clip(self.probs._value, 1e-30, None)),
+            shape=tuple(shape) + self.batch_shape + (n,))
+        k = self.probs._value.shape[-1]
+        counts = jax.nn.one_hot(cats, k).sum(axis=-2)
+        return to_tensor(counts)
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        def f(x, p):
+            gl = jax.scipy.special.gammaln
+            return (gl(jnp.sum(x, -1) + 1) - jnp.sum(gl(x + 1), -1)
+                    + jnp.sum(x * jnp.log(jnp.clip(p, 1e-30, None)), -1))
+
+        return run_op("multinomial_log_prob", f, _t(value), self.probs)
+
+
+class Independent(Distribution):
+    """Reinterprets batch dims as event dims (reference
+    ``paddle.distribution.Independent``)."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        lp = self.base.log_prob(value)
+        axes = tuple(range(-self.rank, 0))
+        return run_op("independent_sum",
+                      lambda a: jnp.sum(a, axis=axes), lp)
+
+
+# ---------------------------------------------------------------------------
+# Transforms
+# ---------------------------------------------------------------------------
+
+class Transform:
+    def forward(self, x):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        from ..ops.dispatch import run_op
+
+        return run_op("affine_fwd", lambda a, l, s: l + s * a, _t(x),
+                      self.loc, self.scale)
+
+    def inverse(self, y):
+        from ..ops.dispatch import run_op
+
+        return run_op("affine_inv", lambda a, l, s: (a - l) / s, _t(y),
+                      self.loc, self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        from ..ops.dispatch import run_op
+
+        return run_op("affine_ldj",
+                      lambda a, s: jnp.broadcast_to(jnp.log(jnp.abs(s)),
+                                                    a.shape),
+                      _t(x), self.scale)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        from ..ops.dispatch import run_op
+
+        return run_op("exp", jnp.exp, _t(x))
+
+    def inverse(self, y):
+        from ..ops.dispatch import run_op
+
+        return run_op("log", jnp.log, _t(y))
+
+    def forward_log_det_jacobian(self, x):
+        return _t(x)
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        from ..ops.dispatch import run_op
+
+        return run_op("sigmoid", jax.nn.sigmoid, _t(x))
+
+    def inverse(self, y):
+        from ..ops.dispatch import run_op
+
+        return run_op("logit",
+                      lambda a: jnp.log(a) - jnp.log1p(-a), _t(y))
+
+    def forward_log_det_jacobian(self, x):
+        from ..ops.dispatch import run_op
+
+        return run_op(
+            "sigmoid_ldj",
+            lambda a: -jax.nn.softplus(-a) - jax.nn.softplus(a), _t(x))
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base: Distribution, transforms: Sequence[Transform]):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        from ..ops.dispatch import run_op
+
+        y = _t(value)
+        ldj_total = None
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ldj = t.forward_log_det_jacobian(x)
+            ldj_total = ldj if ldj_total is None else run_op(
+                "add", jnp.add, ldj_total, ldj)
+            y = x
+        lp = self.base.log_prob(y)
+        return run_op("sub", jnp.subtract, lp, ldj_total) \
+            if ldj_total is not None else lp
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY: Dict[Tuple[Type, Type], object] = {}
+
+
+def register_kl(type_p: Type, type_q: Type):
+    """Decorator registering a KL(p||q) rule (reference ``register_kl``)."""
+
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    for (tp, tq), fn in _KL_REGISTRY.items():
+        if isinstance(p, tp) and isinstance(q, tq):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"kl_divergence not registered for ({type(p).__name__}, "
+        f"{type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p: Normal, q: Normal):
+    from ..ops.dispatch import run_op
+
+    def f(pl, ps, ql, qs):
+        var_ratio = (ps / qs) ** 2
+        t1 = ((pl - ql) / qs) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+    return run_op("kl_normal", f, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p: Categorical, q: Categorical):
+    from ..ops.dispatch import run_op
+
+    def f(pl, ql):
+        lp = jax.nn.log_softmax(pl, -1)
+        lq = jax.nn.log_softmax(ql, -1)
+        return jnp.sum(jnp.exp(lp) * (lp - lq), -1)
+
+    return run_op("kl_categorical", f, p.logits, q.logits)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p: Bernoulli, q: Bernoulli):
+    from ..ops.dispatch import run_op
+
+    def f(pp, qp):
+        pp = jnp.clip(pp, 1e-7, 1 - 1e-7)
+        qp = jnp.clip(qp, 1e-7, 1 - 1e-7)
+        return (pp * (jnp.log(pp) - jnp.log(qp))
+                + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+
+    return run_op("kl_bernoulli", f, p.probs, q.probs)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p: Uniform, q: Uniform):
+    from ..ops.dispatch import run_op
+
+    def f(pa, pb, qa, qb):
+        out = jnp.log((qb - qa) / (pb - pa))
+        ok = (qa <= pa) & (pb <= qb)
+        return jnp.where(ok, out, jnp.inf)
+
+    return run_op("kl_uniform", f, p.low, p.high, q.low, q.high)

@@ -1,0 +1,163 @@
+"""paddle.distribution, paddle.signal, and functional autograd tests
+(reference: test/distribution/, test/signal/, autograd api tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+from paddle_tpu import signal
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+class TestDistributions:
+    def test_normal(self):
+        d = D.Normal(_t(1.0), _t(2.0))
+        s = d.sample((5000,))
+        assert abs(float(paddle.mean(s)) - 1.0) < 0.15
+        lp = d.log_prob(_t(1.0))
+        from scipy.stats import norm
+        np.testing.assert_allclose(float(lp), norm.logpdf(1.0, 1.0, 2.0),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy()),
+                                   norm.entropy(1.0, 2.0), rtol=1e-5)
+
+    def test_normal_rsample_grad(self):
+        loc = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+        d = D.Normal(loc, _t(1.0))
+        s = d.rsample((16,))
+        paddle.mean(s).backward()
+        np.testing.assert_allclose(loc.grad.numpy(), 1.0, rtol=1e-5)
+
+    def test_kl_normal(self):
+        p = D.Normal(_t(0.0), _t(1.0))
+        q = D.Normal(_t(1.0), _t(2.0))
+        kl = float(D.kl_divergence(p, q))
+        want = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(kl, want, rtol=1e-5)
+
+    def test_categorical(self):
+        logits = _t([[0.0, np.log(3.0)]])
+        d = D.Categorical(logits)
+        lp = d.log_prob(paddle.to_tensor(np.array([1])))
+        np.testing.assert_allclose(float(lp), np.log(0.75), rtol=1e-5)
+        s = d.sample((2000,))
+        assert abs(float(paddle.mean(s.astype("float32"))) - 0.75) < 0.06
+
+    @pytest.mark.parametrize("dist,args,logpdf", [
+        ("Beta", (2.0, 3.0), lambda x: __import__("scipy.stats", fromlist=["beta"]).beta.logpdf(x, 2.0, 3.0)),
+        ("Gamma", (2.0, 1.5), lambda x: __import__("scipy.stats", fromlist=["gamma"]).gamma.logpdf(x, 2.0, scale=1/1.5)),
+        ("Laplace", (0.0, 1.0), lambda x: __import__("scipy.stats", fromlist=["laplace"]).laplace.logpdf(x)),
+        ("Gumbel", (0.0, 1.0), lambda x: __import__("scipy.stats", fromlist=["gumbel_r"]).gumbel_r.logpdf(x)),
+        ("Cauchy", (0.0, 1.0), lambda x: __import__("scipy.stats", fromlist=["cauchy"]).cauchy.logpdf(x)),
+    ])
+    def test_logpdf_vs_scipy(self, dist, args, logpdf):
+        d = getattr(D, dist)(*[_t(a) for a in args])
+        x = 0.3
+        np.testing.assert_allclose(float(d.log_prob(_t(x))), logpdf(x),
+                                   rtol=1e-4)
+
+    def test_dirichlet_multinomial(self):
+        d = D.Dirichlet(_t([2.0, 3.0, 5.0]))
+        s = d.sample((100,))
+        np.testing.assert_allclose(np.sum(s.numpy(), -1), 1.0, rtol=1e-5)
+        m = D.Multinomial(10, _t([0.2, 0.8]))
+        sm = m.sample((50,))
+        np.testing.assert_allclose(np.sum(sm.numpy(), -1), 10.0)
+
+    def test_transformed(self):
+        base = D.Normal(_t(0.0), _t(1.0))
+        ln = D.TransformedDistribution(base, [D.ExpTransform()])
+        ref = D.LogNormal(_t(0.0), _t(1.0))
+        x = _t(1.7)
+        np.testing.assert_allclose(float(ln.log_prob(x)),
+                                   float(ref.log_prob(x)), rtol=1e-5)
+
+    def test_independent(self):
+        d = D.Independent(D.Normal(_t([0.0, 1.0]), _t([1.0, 1.0])), 1)
+        lp = d.log_prob(_t([0.0, 1.0]))
+        assert lp.shape == []
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 512).astype(np.float32)
+        import scipy.signal
+
+        window = scipy.signal.get_window("hann", 128).astype(np.float32)
+        spec = signal.stft(_t(x), n_fft=128, hop_length=32,
+                           window=_t(window))
+        assert spec.shape == [2, 65, 17]
+        back = signal.istft(spec, n_fft=128, hop_length=32,
+                            window=_t(window), length=512)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-4)
+
+    def test_stft_matches_scipy(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(256).astype(np.float32)
+        spec = signal.stft(_t(x), n_fft=64, hop_length=16, center=False,
+                           window=_t(np.ones(64, np.float32)))
+        import scipy.signal as sps
+        _, _, Z = sps.stft(x, nperseg=64, noverlap=48, window=np.ones(64),
+                           boundary=None, padded=False)
+        np.testing.assert_allclose(spec.numpy(), Z * 64, atol=1e-3)
+
+
+class TestFunctionalAutograd:
+    def test_jacobian(self):
+        def f(x):
+            return x * x
+
+        x = _t([1.0, 2.0, 3.0])
+        J = paddle.autograd.jacobian(f, x)
+        np.testing.assert_allclose(J.numpy(), np.diag([2.0, 4.0, 6.0]),
+                                   rtol=1e-5)
+
+    def test_hessian(self):
+        def f(x):
+            return paddle.sum(x * x * x)
+
+        x = _t([1.0, 2.0])
+        H = paddle.autograd.hessian(f, x)
+        np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0]),
+                                   rtol=1e-5)
+
+    def test_jvp_vjp(self):
+        def f(x):
+            return paddle.sum(x * x)
+
+        x = _t([1.0, 2.0])
+        out, jv = paddle.autograd.jvp(f, x, v=_t([1.0, 0.0]))
+        np.testing.assert_allclose(float(jv), 2.0, rtol=1e-5)
+        out, vj = paddle.autograd.vjp(f, x)
+        np.testing.assert_allclose(vj.numpy(), [2.0, 4.0], rtol=1e-5)
+
+
+class TestFunctionalAutogradEdges:
+    def test_tuple_output_jacobian(self):
+        def f(x):
+            return (x * x, x + 1)
+
+        x = _t([1.0, 2.0])
+        J = paddle.autograd.jacobian(f, x)
+        # pytree matching the output structure, Tensor leaves
+        np.testing.assert_allclose(J[0].numpy(), np.diag([2.0, 4.0]),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(J[1].numpy(), np.eye(2), rtol=1e-5)
+
+    def test_create_graph_raises(self):
+        with pytest.raises(Exception):
+            paddle.autograd.jacobian(lambda a: a * a, _t([1.0]),
+                                     create_graph=True)
+
+    def test_vjp_cotangent_mismatch_raises(self):
+        def f(x):
+            return paddle.sum(x)
+
+        with pytest.raises(Exception):
+            paddle.autograd.vjp(f, _t([1.0, 2.0]),
+                                v=[_t(1.0), _t(2.0)])
