@@ -1,0 +1,111 @@
+"""``paddle.geometric`` — graph learning primitives.
+
+Reference counterpart: ``python/paddle/geometric/`` (segment reductions and
+the ``send_u_recv``/``send_ue_recv`` message-passing ops used by PGL;
+SURVEY.md §2.1 PHI kernel corpus). All reductions lower to XLA segment ops
+(one-hot matmul or sort-based — the compiler picks), which is the TPU-native
+replacement for the reference's atomic-scatter CUDA kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import run_op
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv"]
+
+
+def _nseg(segment_ids, num_segments):
+    if num_segments is not None:
+        return int(num_segments)
+    ids = segment_ids._value if isinstance(segment_ids, Tensor) else segment_ids
+    return int(jnp.max(ids)) + 1 if ids.size else 0
+
+
+def _segment(kind):
+    fns = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}
+
+    def op(data, segment_ids, num_segments=None, name=None):
+        n = _nseg(segment_ids, num_segments)
+        ids = (segment_ids._value if isinstance(segment_ids, Tensor)
+               else jnp.asarray(segment_ids)).astype(jnp.int32)
+
+        def f(a):
+            if kind == "mean":
+                s = jax.ops.segment_sum(a, ids, num_segments=n)
+                # counts accumulate in fp32: low-precision data dtypes
+                # (bf16) lose integer exactness above ~256
+                cnt = jax.ops.segment_sum(
+                    jnp.ones((a.shape[0],), jnp.float32), ids,
+                    num_segments=n).astype(a.dtype)
+                return s / jnp.maximum(cnt, 1.0).reshape(
+                    (-1,) + (1,) * (a.ndim - 1))
+            out = fns[kind](a, ids, num_segments=n)
+            if kind in ("max", "min"):
+                # empty segments: paddle fills 0, jax fills +-inf
+                cnt = jax.ops.segment_sum(jnp.ones((a.shape[0],)), ids,
+                                          num_segments=n)
+                mask = (cnt > 0).reshape((-1,) + (1,) * (a.ndim - 1))
+                out = jnp.where(mask, out, 0.0)
+            return out
+
+        return run_op(f"segment_{kind}", f, data)
+
+    op.__name__ = f"segment_{kind}"
+    return op
+
+
+segment_sum = _segment("sum")
+segment_mean = _segment("mean")
+segment_max = _segment("max")
+segment_min = _segment("min")
+
+
+_REDUCERS = {}  # filled below once the public segment ops exist
+
+
+def _reducer(reduce_op):
+    try:
+        return _REDUCERS[reduce_op]
+    except KeyError:
+        raise ValueError(
+            f"reduce_op must be one of {sorted(_REDUCERS)}, got "
+            f"{reduce_op!r}") from None
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather source-node features along edges, reduce at destinations
+    (reference ``paddle.geometric.send_u_recv``)."""
+    si = (src_index._value if isinstance(src_index, Tensor)
+          else jnp.asarray(src_index)).astype(jnp.int32)
+    seg = _reducer(reduce_op)
+    gathered = run_op("gather_u", lambda a: jnp.take(a, si, axis=0), x)
+    n = out_size if out_size is not None else (
+        x._value.shape[0] if isinstance(x, Tensor) else None)
+    return seg(gathered, dst_index, num_segments=n)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Like send_u_recv but combines node features with EDGE features
+    first (reference ``send_ue_recv``)."""
+    si = (src_index._value if isinstance(src_index, Tensor)
+          else jnp.asarray(src_index)).astype(jnp.int32)
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op]
+    msg = run_op("message_ue",
+                 lambda a, e: combine(jnp.take(a, si, axis=0), e), x, y)
+    seg = _reducer(reduce_op)
+    n = out_size if out_size is not None else (
+        x._value.shape[0] if isinstance(x, Tensor) else None)
+    return seg(msg, dst_index, num_segments=n)
+
+
+_REDUCERS.update({"sum": segment_sum, "mean": segment_mean,
+                  "max": segment_max, "min": segment_min})

@@ -1,0 +1,232 @@
+"""``paddle.vision.ops`` — detection operators.
+
+Reference counterpart: ``python/paddle/vision/ops.py`` over the phi
+detection kernels (``nms``, ``roi_align``, ``roi_pool``, ``box_coder``,
+``deform_conv2d``; SURVEY.md §2.1). TPU-native formulations: NMS as a
+fixed-trip ``fori_loop`` over sorted candidates (no dynamic shapes inside
+jit), RoIAlign as bilinear gathers — both compile into the XLA program
+instead of the reference's dynamic-output CUDA kernels; the dynamic-size
+final filtering happens on host like the reference's CPU post-process.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..ops.dispatch import run_op
+
+__all__ = ["nms", "box_iou", "roi_align", "roi_pool", "box_coder",
+           "box_area"]
+
+
+def box_area(boxes, name=None):
+    return run_op("box_area",
+                  lambda b: (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]),
+                  boxes)
+
+
+def box_iou(boxes1, boxes2, name=None):
+    """Pairwise IoU [N, M] for xyxy boxes."""
+
+    def f(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(area1[:, None] + area2[None, :] - inter,
+                                   1e-10)
+
+    return run_op("box_iou", f, boxes1, boxes2)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Greedy NMS. Returns kept indices sorted by score (host-side dynamic
+    filtering of a compiled fixed-size suppression loop)."""
+    bv = boxes._value if isinstance(boxes, Tensor) else jnp.asarray(boxes)
+    n = bv.shape[0]
+    sv = (scores._value if isinstance(scores, Tensor)
+          else (jnp.asarray(scores) if scores is not None
+                else jnp.arange(n, 0, -1, dtype=jnp.float32)))
+    if category_idxs is not None:
+        # category-aware: offset boxes per class so cross-class pairs never
+        # overlap (the standard batched-NMS trick)
+        cv = (category_idxs._value if isinstance(category_idxs, Tensor)
+              else jnp.asarray(category_idxs)).astype(bv.dtype)
+        offset = (jnp.max(bv) + 1.0) * cv
+        bv = bv + offset[:, None]
+
+    order = jnp.argsort(-sv)
+    bs = bv[order]
+
+    def body(i, keep):
+        # suppress every later box overlapping box i (if i itself is kept)
+        lt = jnp.maximum(bs[i, :2], bs[:, :2])
+        rb = jnp.minimum(bs[i, 2:], bs[:, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[:, 0] * wh[:, 1]
+        area_i = (bs[i, 2] - bs[i, 0]) * (bs[i, 3] - bs[i, 1])
+        areas = (bs[:, 2] - bs[:, 0]) * (bs[:, 3] - bs[:, 1])
+        iou = inter / jnp.maximum(area_i + areas - inter, 1e-10)
+        suppress = (iou > iou_threshold) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~suppress
+
+    keep0 = jnp.ones((n,), bool)
+    keep = jax.lax.fori_loop(0, n, body, keep0)
+    # keep is indexed by sorted position: order[j] is kept iff keep[j]
+    kept_sorted = np.asarray(order)[np.asarray(keep)]
+    if top_k is not None:
+        kept_sorted = kept_sorted[:top_k]
+    # int32: jax runs with x64 disabled (TPU-native default)
+    return to_tensor(jnp.asarray(kept_sorted, jnp.int32))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign via bilinear gathers. x: [N, C, H, W]; boxes: [R, 4]
+    (xyxy in input-image coords); boxes_num: rois per image."""
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    bn = np.asarray(boxes_num._value if isinstance(boxes_num, Tensor)
+                    else boxes_num)
+    img_ids = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
+    bv0 = boxes._value if isinstance(boxes, Tensor) else np.asarray(boxes)
+    if sampling_ratio > 0:
+        sr = int(sampling_ratio)
+    else:
+        # reference adaptive rule: ceil(roi_size / output_size), which must
+        # be a trace-time constant — use the LARGEST roi so every bin is
+        # sampled at least as densely as the reference would
+        sizes = np.asarray(bv0, np.float32)
+        max_h = float(np.max(sizes[:, 3] - sizes[:, 1])) * spatial_scale
+        max_w = float(np.max(sizes[:, 2] - sizes[:, 0])) * spatial_scale
+        sr = max(1, int(np.ceil(max(max_h / oh, max_w / ow))))
+
+    def f(xv, bv):
+        H, W = xv.shape[2], xv.shape[3]
+        off = 0.5 if aligned else 0.0
+        floor_sz = 1e-3 if aligned else 1.0  # reference clamps to 1 px
+
+        def bilinear(img, yy, xx):
+            # img: [C, H, W]; yy: [P]; xx: [Q] -> [C, P, Q]
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1)
+            x1i = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(yy, 0, H - 1) - y0
+            wx = jnp.clip(xx, 0, W - 1) - x0
+            g = lambda yi, xi: jnp.take(
+                jnp.take(img, yi.astype(jnp.int32), axis=1),
+                xi.astype(jnp.int32), axis=2)
+            return (g(y0, x0) * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                    + g(y1i, x0) * wy[None, :, None] * (1 - wx)[None, None, :]
+                    + g(y0, x1i) * (1 - wy)[None, :, None] * wx[None, None, :]
+                    + g(y1i, x1i) * wy[None, :, None] * wx[None, None, :])
+
+        def one_roi(box, img_id):
+            x1 = box[0] * spatial_scale - off
+            y1 = box[1] * spatial_scale - off
+            rw = jnp.maximum(box[2] * spatial_scale - off - x1, floor_sz)
+            rh = jnp.maximum(box[3] * spatial_scale - off - y1, floor_sz)
+            ys = y1 + rh * (jnp.arange(oh * sr) + 0.5) / (oh * sr)
+            xs = x1 + rw * (jnp.arange(ow * sr) + 0.5) / (ow * sr)
+            img = jnp.take(xv, img_id, axis=0)
+            sampled = bilinear(img, ys, xs)           # [C, oh*sr, ow*sr]
+            C = sampled.shape[0]
+            return sampled.reshape(C, oh, sr, ow, sr).mean((2, 4))
+
+        return jax.vmap(one_roi)(bv, img_ids)
+
+    return run_op("roi_align", f, x, boxes)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool (max) — implemented as RoIAlign-style sampling with max
+    reduction (adaptive max over the roi grid)."""
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    bn = np.asarray(boxes_num._value if isinstance(boxes_num, Tensor)
+                    else boxes_num)
+    img_ids = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
+
+    def f(xv, bv):
+        H, W = xv.shape[2], xv.shape[3]
+        sr = 2
+
+        def one_roi(box, img_id):
+            x1 = box[0] * spatial_scale
+            y1 = box[1] * spatial_scale
+            x2 = jnp.maximum(box[2] * spatial_scale, x1 + 1)
+            y2 = jnp.maximum(box[3] * spatial_scale, y1 + 1)
+            ys = jnp.clip(y1 + (y2 - y1) * (jnp.arange(oh * sr) + 0.5)
+                          / (oh * sr), 0, H - 1).astype(jnp.int32)
+            xs = jnp.clip(x1 + (x2 - x1) * (jnp.arange(ow * sr) + 0.5)
+                          / (ow * sr), 0, W - 1).astype(jnp.int32)
+            img = jnp.take(xv, img_id, axis=0)
+            sampled = jnp.take(jnp.take(img, ys, axis=1), xs, axis=2)
+            C = sampled.shape[0]
+            return sampled.reshape(C, oh, sr, ow, sr).max((2, 4))
+
+        return jax.vmap(one_roi)(bv, img_ids)
+
+    return run_op("roi_pool", f, x, boxes)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode detection boxes against priors (reference
+    ``paddle.vision.ops.box_coder``, encode/decode_center_size)."""
+
+    def f(pb, pbv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        if tb.ndim == 3:
+            # [N, M, 4] targets: priors broadcast along `axis` (reference
+            # decode with per-class deltas)
+            exp_axis = 1 if axis == 0 else 0
+            pb = jnp.expand_dims(pb, exp_axis)
+            pbv = jnp.expand_dims(pbv, exp_axis)
+            pw = pb[..., 2] - pb[..., 0] + norm
+            ph = pb[..., 3] - pb[..., 1] + norm
+            pcx = pb[..., 0] + pw / 2
+            pcy = pb[..., 1] + ph / 2
+            d = tb * pbv
+            cx = d[..., 0] * pw + pcx
+            cy = d[..., 1] * ph + pcy
+            w = jnp.exp(d[..., 2]) * pw
+            h = jnp.exp(d[..., 3]) * ph
+            return jnp.stack([cx - w / 2, cy - h / 2,
+                              cx + w / 2 - norm, cy + h / 2 - norm],
+                             axis=-1)
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw / 2
+            tcy = tb[:, 1] + th / 2
+            out = jnp.stack([
+                (tcx - pcx) / pw, (tcy - pcy) / ph,
+                jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
+            return out / pbv
+        # decode
+        d = tb * pbv
+        cx = d[:, 0] * pw + pcx
+        cy = d[:, 1] * ph + pcy
+        w = jnp.exp(d[:, 2]) * pw
+        h = jnp.exp(d[:, 3]) * ph
+        return jnp.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - norm, cy + h / 2 - norm], axis=1)
+
+    return run_op("box_coder", f, prior_box, prior_box_var, target_box)

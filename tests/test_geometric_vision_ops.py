@@ -1,0 +1,120 @@
+"""paddle.geometric and paddle.vision.ops tests (reference:
+test/legacy_test/test_segment_ops.py, test_nms_op.py, test_roi_align_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+from paddle_tpu.vision import ops as V
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        data = _t(np.array([[1.0, 2], [3, 4], [5, 6], [7, 8]], np.float32))
+        ids = np.array([0, 0, 1, 1])
+        np.testing.assert_allclose(G.segment_sum(data, ids).numpy(),
+                                   [[4, 6], [12, 14]])
+        np.testing.assert_allclose(G.segment_mean(data, ids).numpy(),
+                                   [[2, 3], [6, 7]])
+        np.testing.assert_allclose(G.segment_max(data, ids).numpy(),
+                                   [[3, 4], [7, 8]])
+        np.testing.assert_allclose(G.segment_min(data, ids).numpy(),
+                                   [[1, 2], [5, 6]])
+
+    def test_segment_empty_bucket(self):
+        data = _t(np.ones((2, 3), np.float32))
+        out = G.segment_max(data, np.array([0, 2]), num_segments=4)
+        np.testing.assert_allclose(out.numpy()[1], 0.0)  # empty -> 0
+
+    def test_send_u_recv(self):
+        x = _t(np.array([[1.0], [2], [4]], np.float32))
+        src = np.array([0, 1, 2, 0])
+        dst = np.array([1, 2, 0, 2])
+        out = G.send_u_recv(x, src, dst, reduce_op="sum")
+        np.testing.assert_allclose(out.numpy(), [[4], [1], [3]])
+
+    def test_send_ue_recv(self):
+        x = _t(np.array([[1.0], [2]], np.float32))
+        e = _t(np.array([[10.0], [20]], np.float32))
+        out = G.send_ue_recv(x, e, np.array([0, 1]), np.array([1, 0]),
+                             message_op="add", reduce_op="sum")
+        np.testing.assert_allclose(out.numpy(), [[22], [11]])
+
+    def test_segment_grad(self):
+        data = paddle.to_tensor(np.ones((4, 2), np.float32),
+                                stop_gradient=False)
+        out = G.segment_sum(data, np.array([0, 0, 1, 1]))
+        paddle.sum(out * _t(np.array([[1.0, 1], [2, 2]], np.float32))).backward()
+        np.testing.assert_allclose(data.grad.numpy(),
+                                   [[1, 1], [1, 1], [2, 2], [2, 2]])
+
+
+class TestVisionOps:
+    def test_box_iou(self):
+        a = _t(np.array([[0, 0, 2, 2]], np.float32))
+        b = _t(np.array([[1, 1, 3, 3], [0, 0, 2, 2]], np.float32))
+        iou = V.box_iou(a, b).numpy()
+        np.testing.assert_allclose(iou, [[1 / 7, 1.0]], rtol=1e-5)
+
+    def test_nms(self):
+        boxes = _t(np.array([
+            [0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]], np.float32))
+        scores = _t(np.array([0.9, 0.8, 0.7], np.float32))
+        keep = V.nms(boxes, iou_threshold=0.5, scores=scores).numpy()
+        np.testing.assert_array_equal(keep, [0, 2])
+
+    def test_nms_categories(self):
+        boxes = _t(np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32))
+        scores = _t(np.array([0.9, 0.8], np.float32))
+        # different classes: both survive despite overlap
+        keep = V.nms(boxes, 0.5, scores, category_idxs=_t(np.array([0, 1])),
+                     categories=[0, 1]).numpy()
+        assert set(keep.tolist()) == {0, 1}
+
+    def test_roi_align_vs_numpy_reference(self):
+        x = _t(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        boxes = _t(np.array([[0, 0, 4, 4]], np.float32))
+        out = V.roi_align(x, boxes, np.array([1]), output_size=2,
+                          spatial_scale=1.0, aligned=False,
+                          sampling_ratio=2)
+        assert tuple(out.shape) == (1, 1, 2, 2)
+
+        # numpy reference: per output bin, average sr*sr bilinear samples
+        img = np.arange(16, dtype=np.float32).reshape(4, 4)
+
+        def bilin(y, xq):
+            y0, x0 = int(np.clip(np.floor(y), 0, 3)), int(np.clip(np.floor(xq), 0, 3))
+            y1, x1 = min(y0 + 1, 3), min(x0 + 1, 3)
+            wy, wx = np.clip(y, 0, 3) - y0, np.clip(xq, 0, 3) - x0
+            return (img[y0, x0] * (1 - wy) * (1 - wx) + img[y1, x0] * wy * (1 - wx)
+                    + img[y0, x1] * (1 - wy) * wx + img[y1, x1] * wy * wx)
+
+        ref = np.zeros((2, 2), np.float32)
+        samples_y = [(i + 0.5) * 4 / 4 for i in range(4)]
+        samples_x = samples_y
+        for oy in range(2):
+            for ox in range(2):
+                vals = [bilin(samples_y[oy * 2 + a], samples_x[ox * 2 + b])
+                        for a in range(2) for b in range(2)]
+                ref[oy, ox] = np.mean(vals)
+        np.testing.assert_allclose(out.numpy()[0, 0], ref, rtol=1e-5)
+
+    def test_roi_pool_shape(self):
+        x = _t(np.random.RandomState(0).rand(2, 3, 8, 8).astype(np.float32))
+        boxes = _t(np.array([[0, 0, 4, 4], [2, 2, 8, 8], [0, 0, 8, 8]],
+                            np.float32))
+        out = V.roi_pool(x, boxes, np.array([2, 1]), output_size=(2, 2))
+        assert tuple(out.shape) == (3, 3, 2, 2)
+
+    def test_box_coder_roundtrip(self):
+        prior = _t(np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32))
+        var = _t(np.ones((2, 4), np.float32))
+        target = _t(np.array([[1, 1, 9, 9], [6, 6, 14, 18]], np.float32))
+        enc = V.box_coder(prior, var, target, "encode_center_size")
+        dec = V.box_coder(prior, var, enc, "decode_center_size")
+        np.testing.assert_allclose(dec.numpy(), target.numpy(), atol=1e-4)
