@@ -14,6 +14,12 @@ that is the design, not a gap. ``Config`` keeps the reference's switches as
 accepted-and-recorded no-ops where XLA subsumes them, so deployment scripts
 port unchanged; handle objects give the same copy_from_cpu/copy_to_cpu
 workflow.
+
+Int8 deployment (the reference's PaddleSlim/TRT-int8 flow): quantize at
+CONVERSION time — ``quantization.PTQ(...).quantize`` + calibrate +
+``convert`` rewrites Linear layers to real int8 MXU matmuls, and the
+converted model exports/serves through ``jit.save`` + ``Predictor``
+unchanged (see tests/test_ckpt_inference.py).
 """
 
 from __future__ import annotations

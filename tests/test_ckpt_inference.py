@@ -146,3 +146,49 @@ def test_predictor_output_handle_before_run(tmp_path):
     predictor.run()
     out = handle.copy_to_cpu()
     assert out.shape == (3, 2)
+
+
+def test_int8_ptq_model_through_predictor(tmp_path):
+    """PTQ-converted (real int8 matmul) model exports via jit.save and
+    serves through the Predictor — the reference's slim/int8 deploy flow."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, nn, quantization as Q
+    from paddle_tpu.static import InputSpec
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16)
+            self.act = nn.ReLU()
+            self.fc2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    net = Net()
+    net.eval()
+    cfg = Q.QuantConfig(activation=Q.quanter(Q.MovingAverageAbsmaxObserver))
+    ptq = Q.PTQ(cfg)
+    net = ptq.quantize(net)
+    rng = np.random.RandomState(0)
+    calib = paddle.to_tensor(rng.randn(32, 8).astype(np.float32))
+    ref = net(calib).numpy()  # observers collect
+    net = ptq.convert(net)
+    int8_out = net(calib).numpy()
+
+    prefix = str(tmp_path / "int8_model")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([32, 8], "float32")])
+
+    config = inference.Config(prefix)
+    config.precision = inference.PrecisionType.Int8
+    pred = inference.create_predictor(config)
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(calib.numpy())
+    assert pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, int8_out, rtol=1e-4, atol=1e-5)
+    # and the int8 path stays close to the fp32 reference
+    rel = np.abs(out - ref).mean() / (np.abs(ref).mean() + 1e-9)
+    assert rel < 0.05, rel
