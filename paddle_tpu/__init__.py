@@ -81,6 +81,7 @@ from . import utils  # noqa: F401
 from . import vision  # noqa: F401
 from . import inference  # noqa: F401
 from .hapi.model import Model  # noqa: F401
+from .hapi.model_summary import flops, summary  # noqa: F401
 from .nn.layer.layers import ParamAttr  # noqa: F401
 from .ops import linalg  # noqa: F401
 

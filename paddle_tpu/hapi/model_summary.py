@@ -1,0 +1,132 @@
+"""``paddle.summary`` / ``paddle.flops`` — model introspection.
+
+Reference counterpart: ``python/paddle/hapi/model_summary.py`` and
+``python/paddle/hapi/dynamic_flops.py``. Shapes come from a real traced
+forward (hooks on every sublayer), so any jit-traceable model summarises.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["summary", "flops"]
+
+
+def _param_count(layer) -> Tuple[int, int]:
+    total = trainable = 0
+    for p in layer.parameters(include_sublayers=False):
+        n = int(np.prod(p._value.shape))
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+    return total, trainable
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params', 'trainable_params'}
+    (reference ``paddle.summary``)."""
+    import paddle_tpu as paddle
+
+    rows: List[Dict] = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+            shape = list(getattr(out, "shape", []))
+            total, _ = _param_count(lyr)
+            rows.append({"name": f"{type(lyr).__name__}-{len(rows) + 1}",
+                         "shape": shape, "params": total})
+
+        return hook
+
+    for name, sub in net.named_sublayers():
+        hooks.append(sub.register_forward_post_hook(make_hook(name, sub)))
+
+    if input is not None:
+        args = input if isinstance(input, (list, tuple)) else [input]
+    else:
+        sizes = (input_size if isinstance(input_size, list)
+                 else [input_size])
+        dts = dtypes or ["float32"] * len(sizes)
+        args = [paddle.to_tensor(
+            np.zeros([d if d and d > 0 else 1 for d in size], dt))
+            for size, dt in zip(sizes, dts)]
+    was_training = net.training
+    net.eval()
+    try:
+        net(*args)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p._value.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p._value.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+
+    name_w = max([len(r["name"]) for r in rows] + [10]) + 2
+    line = "-" * (name_w + 40)
+    print(line)
+    print(f"{'Layer (type)':<{name_w}}{'Output Shape':<24}{'Param #':>12}")
+    print(line)
+    for r in rows:
+        print(f"{r['name']:<{name_w}}{str(r['shape']):<24}"
+              f"{r['params']:>12,}")
+    print(line)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False) -> int:
+    """Estimate forward FLOPs by tracing and counting matmul/conv work
+    (reference ``paddle.flops``). Counts multiply-accumulates × 2."""
+    import paddle_tpu as paddle
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import Conv2D
+
+    total = [0]
+    hooks = []
+
+    def conv_hook(lyr, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        oc, ic = lyr.weight.shape[0], lyr.weight.shape[1]
+        kh, kw = lyr.weight.shape[2], lyr.weight.shape[3]
+        oh, ow = out.shape[-2], out.shape[-1]
+        total[0] += 2 * oh * ow * oc * ic * kh * kw * out.shape[0]
+
+    def linear_hook(lyr, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        batch = int(np.prod(out.shape[:-1]))
+        total[0] += 2 * batch * lyr.weight.shape[0] * lyr.weight.shape[1]
+
+    for _, sub in net.named_sublayers():
+        if custom_ops and type(sub) in custom_ops:  # user rules win
+            fn = custom_ops[type(sub)]
+            hooks.append(sub.register_forward_post_hook(
+                lambda lyr, i, o, fn=fn: total.__setitem__(
+                    0, total[0] + fn(lyr, i, o))))
+        elif isinstance(sub, Conv2D):
+            hooks.append(sub.register_forward_post_hook(conv_hook))
+        elif isinstance(sub, Linear):
+            hooks.append(sub.register_forward_post_hook(linear_hook))
+
+    x = paddle.to_tensor(np.zeros(input_size, np.float32))
+    was_training = net.training
+    net.eval()
+    try:
+        net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]:,}")
+    return total[0]

@@ -4,7 +4,7 @@ Every op is a thin, registered lowering to jax/XLA primitives; fused/Pallas
 kernels live in ``paddle_tpu.ops.pallas``.
 """
 
-from . import creation, linalg, logic, manipulation, math, reduction, special
+from . import creation, linalg, logic, manipulation, math, reduction, special, tail
 from .creation import *  # noqa: F401,F403
 from .dispatch import run_op  # noqa: F401
 from .linalg import *  # noqa: F401,F403
@@ -13,6 +13,7 @@ from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .reduction import *  # noqa: F401,F403
 from .special import *  # noqa: F401,F403
+from .tail import *  # noqa: F401,F403
 from .registry import OPS, all_ops, get_op, register_op  # noqa: F401
 
 from . import _tensor_methods
@@ -28,5 +29,6 @@ __all__ = list(
         + logic.__all__
         + linalg.__all__
         + special.__all__
+        + tail.__all__
     )
 )

@@ -1,0 +1,315 @@
+"""Long-tail tensor ops completing the corpus.
+
+Reference counterparts: assorted ``paddle.*`` tensor functions backed by phi
+kernels (vander/frexp/heaviside/trapezoid/logcumsumexp/diag_embed/
+stack-family/complex-view ops; SURVEY.md §2.1). All thin jnp lowerings.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from .dispatch import run_op
+from .registry import register_op
+
+__all__ = [
+    "vander", "frexp", "ldexp", "copysign", "nextafter", "heaviside",
+    "trapezoid", "cumulative_trapezoid", "logcumsumexp", "index_fill",
+    "masked_scatter", "diag_embed", "take", "select_scatter",
+    "slice_scatter", "column_stack", "row_stack", "dstack", "hstack",
+    "vstack", "tensor_split", "as_strided", "nanquantile", "msort",
+    "aminmax", "positive", "negative", "signbit", "sinc", "fix", "sgn",
+    "conj", "real", "imag", "angle", "polar", "complex", "is_complex",
+    "is_integer", "isreal", "bitwise_left_shift", "bitwise_right_shift",
+]
+
+
+# reuse the math module's registered-op factories (single coercion path:
+# scalars/ndarrays accepted everywhere)
+from .math import _binary as _math_binary  # noqa: E402
+from .math import _unary as _u  # noqa: E402
+from .registry import OPS as _OPS  # noqa: E402
+
+
+def _b(name, fn, differentiable=True):
+    op = _math_binary(name, fn)
+    if not differentiable:
+        _OPS[name].differentiable = False
+    return op
+
+
+positive = _u("positive", lambda a: +a)
+negative = _u("negative", lambda a: -a)
+signbit = _u("signbit", jnp.signbit, differentiable=False)
+sinc = _u("sinc", jnp.sinc)
+fix = _u("fix", jnp.trunc)
+msort = _u("msort", lambda a: jnp.sort(a, axis=0))
+conj = _u("conj", jnp.conj)
+real = _u("real", jnp.real)
+imag = _u("imag", jnp.imag)
+angle = _u("angle", jnp.angle)
+
+copysign = _b("copysign", jnp.copysign)
+nextafter = _b("nextafter", jnp.nextafter, differentiable=False)
+heaviside = _b("heaviside", lambda a, b: jnp.where(
+    jnp.isnan(a), jnp.nan,
+    jnp.where(a > 0, 1.0, jnp.where(a < 0, 0.0, b))).astype(a.dtype))
+ldexp = _b("ldexp", lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)))
+bitwise_left_shift = _b("bitwise_left_shift", jnp.left_shift,
+                        differentiable=False)
+bitwise_right_shift = _b("bitwise_right_shift", jnp.right_shift,
+                         differentiable=False)
+polar = _b("polar", lambda r, t: jax.lax.complex(r * jnp.cos(t),
+                                                 r * jnp.sin(t)))
+complex = _b("complex",
+             lambda r, i: jax.lax.complex(*jnp.broadcast_arrays(r, i)))
+
+
+@register_op("sgn")
+def sgn(x, name=None):
+    """sign for real, unit phasor for complex (reference paddle.sgn)."""
+
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0.0 + 0.0j, a / jnp.maximum(mag, 1e-30))
+        return jnp.sign(a)
+
+    return run_op("sgn", f, x)
+
+
+@register_op(differentiable=False)
+def is_complex(x, name=None) -> bool:
+    return bool(jnp.issubdtype(x._value.dtype, jnp.complexfloating))
+
+
+@register_op(differentiable=False)
+def is_integer(x, name=None) -> bool:
+    return bool(jnp.issubdtype(x._value.dtype, jnp.integer))
+
+
+@register_op("isreal", differentiable=False)
+def isreal(x, name=None):
+    return run_op("isreal", lambda a: jnp.isreal(a), x)
+
+
+@register_op(differentiable=False)
+def frexp(x, name=None):
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(jnp.int32)
+
+    return run_op("frexp", f, x, n_diff_outputs=0)
+
+
+@register_op(differentiable=False)
+def vander(x, n=None, increasing=False, name=None):
+    return run_op("vander",
+                  lambda a: jnp.vander(a, N=n, increasing=increasing), x)
+
+
+@register_op()
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return run_op("trapezoid",
+                      lambda yy, xx: jnp.trapezoid(yy, xx, axis=axis), y, x)
+    spacing = 1.0 if dx is None else dx
+    return run_op("trapezoid",
+                  lambda yy: jnp.trapezoid(yy, dx=spacing, axis=axis), y)
+
+
+@register_op()
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def f(yy, *maybe_x):
+        yy_m = jnp.moveaxis(yy, axis, -1)
+        if maybe_x:
+            xx = jnp.moveaxis(maybe_x[0], axis, -1)
+            d = jnp.diff(xx, axis=-1)
+        else:
+            d = 1.0 if dx is None else dx
+        avg = (yy_m[..., 1:] + yy_m[..., :-1]) / 2.0
+        out = jnp.cumsum(avg * d, axis=-1)
+        return jnp.moveaxis(out, -1, axis)
+
+    args = (y,) if x is None else (y, x)
+    return run_op("cumulative_trapezoid", f, *args)
+
+
+@register_op()
+def logcumsumexp(x, axis=None, name=None):
+    ax = -1 if axis is None else axis
+
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+        return jax.lax.cumlogsumexp(a, axis=ax if axis is not None else 0)
+
+    return run_op("logcumsumexp", f, x)
+
+
+@register_op()
+def index_fill(x, index, axis, value, name=None):
+    iv = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[iv].set(value)
+        return jnp.moveaxis(moved, 0, axis)
+
+    return run_op("index_fill", f, x)
+
+
+@register_op()
+def masked_scatter(x, mask, value, name=None):
+    """Fill True positions of ``mask`` with consecutive elements of
+    ``value`` (reference paddle.masked_scatter)."""
+
+    mv = mask._value if isinstance(mask, Tensor) else jnp.asarray(mask)
+    vv = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+    if not isinstance(mv, jax.core.Tracer):
+        import numpy as _np
+
+        need = int(_np.asarray(mv).sum())
+        if vv.size < need:
+            from ...enforce import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"masked_scatter: value has {vv.size} elements but mask "
+                f"selects {need}")
+
+    def f(a, m, v):
+        flat_m = m.reshape(-1)
+        # position among True entries for each element
+        idx = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        picked = jnp.take(v.reshape(-1), jnp.clip(idx, 0, v.size - 1))
+        return jnp.where(flat_m, picked, a.reshape(-1)).reshape(a.shape)
+
+    return run_op("masked_scatter", f, x, mask, value)
+
+
+@register_op()
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = base.at[..., r, c].set(a)
+        # move the two new axes into requested positions
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+        return out
+
+    return run_op("diag_embed", f, x)
+
+
+@register_op()
+def take(x, index, mode="raise", name=None):
+    iv = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    n = x._value.size
+
+    if mode == "raise" and not isinstance(iv, jax.core.Tracer):
+        import numpy as _np
+
+        host = _np.asarray(iv)
+        if host.size and (host.min() < -n or host.max() >= n):
+            from ...enforce import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"take: index out of range for tensor of {n} elements")
+
+    def f(a):
+        idx = iv
+        if mode in ("raise", "clip"):
+            idx = jnp.where(idx < 0, idx + n, idx)  # python-style negatives
+        return jnp.take(a.reshape(-1), idx,
+                        mode="wrap" if mode == "wrap" else "clip")
+
+    return run_op("take", f, x)
+
+
+@register_op()
+def select_scatter(x, values, axis, index, name=None):
+    def f(a, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[index].set(v)
+        return jnp.moveaxis(moved, 0, axis)
+
+    return run_op("select_scatter", f, x, values)
+
+
+@register_op()
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def f(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, st, en, sr in zip(axes, starts, ends, strides):
+            idx[ax] = slice(st, en, sr)
+        return a.at[tuple(idx)].set(v)
+
+    return run_op("slice_scatter", f, x, value)
+
+
+def _stack_family(name, jfn):
+    def op(x, name_=None):
+        return run_op(name, lambda *vs: jfn(list(vs)),
+                      *[t if isinstance(t, Tensor) else to_tensor(t)
+                        for t in x])
+
+    op.__name__ = name
+    return register_op(name)(op)
+
+
+column_stack = _stack_family("column_stack", jnp.column_stack)
+row_stack = _stack_family("row_stack", jnp.vstack)
+dstack = _stack_family("dstack", jnp.dstack)
+hstack = _stack_family("hstack", jnp.hstack)
+vstack = _stack_family("vstack", jnp.vstack)
+
+
+@register_op()
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def f(a):
+        return tuple(jnp.array_split(a, num_or_indices, axis=axis)) \
+            if isinstance(num_or_indices, int) else tuple(
+                jnp.split(a, list(num_or_indices), axis=axis))
+
+    return run_op("tensor_split", f, x)
+
+
+@register_op()
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view materialised via gather (XLA has no aliasing views)."""
+
+    def f(a):
+        flat = a.reshape(-1)
+        idx = jnp.full(tuple(shape), offset)
+        for dim, (s, st) in enumerate(zip(shape, stride)):
+            r = jnp.arange(s) * st
+            r = r.reshape((1,) * dim + (s,) + (1,) * (len(shape) - dim - 1))
+            idx = idx + r
+        return jnp.take(flat, idx)
+
+    return run_op("as_strided", f, x)
+
+
+@register_op()
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return run_op("nanquantile",
+                  lambda a: jnp.nanquantile(a, q, axis=axis,
+                                            keepdims=keepdim), x)
+
+
+@register_op()
+def aminmax(x, axis=None, keepdim=False, name=None):
+    def f(a):
+        return (jnp.min(a, axis=axis, keepdims=keepdim),
+                jnp.max(a, axis=axis, keepdims=keepdim))
+
+    return run_op("aminmax", f, x)

@@ -1,0 +1,141 @@
+"""Long-tail op tests (OpTest pattern: numpy references)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+@pytest.mark.parametrize("name,args,ref", [
+    ("vander", (np.array([1.0, 2, 3], np.float32),),
+     lambda a: np.vander(a)),
+    ("sinc", (np.array([0.0, 0.5, 1.0], np.float32),), np.sinc),
+    ("copysign", (np.array([1.0, -2], np.float32),
+                  np.array([-1.0, 1], np.float32)), np.copysign),
+    ("logcumsumexp", (np.array([0.1, 0.2, 0.3], np.float32),),
+     lambda a: np.log(np.cumsum(np.exp(a)))),
+    ("msort", (np.array([[3.0, 1], [2, 4]], np.float32),),
+     lambda a: np.sort(a, axis=0)),
+])
+def test_vs_numpy(name, args, ref):
+    got = getattr(paddle, name)(*[_t(a) for a in args]).numpy()
+    np.testing.assert_allclose(got, ref(*args), rtol=1e-5, atol=1e-6)
+
+
+def test_heaviside():
+    x = np.array([-1.0, 0.0, 2.0], np.float32)
+    got = paddle.heaviside(_t(x), _t(np.float32(0.5))).numpy()
+    np.testing.assert_allclose(got, [0.0, 0.5, 1.0])
+
+
+def test_trapezoid_family():
+    y = np.array([1.0, 2, 3, 4], np.float32)
+    np.testing.assert_allclose(float(paddle.trapezoid(_t(y))),
+                               np.trapezoid(y))
+    ct = paddle.cumulative_trapezoid(_t(y)).numpy()
+    np.testing.assert_allclose(ct, [1.5, 4.0, 7.5])
+
+
+def test_diag_embed_take_index_fill():
+    d = paddle.diag_embed(_t(np.array([1.0, 2, 3], np.float32)))
+    np.testing.assert_allclose(d.numpy(), np.diag([1.0, 2, 3]))
+    t = paddle.take(_t(np.arange(6.0, dtype=np.float32).reshape(2, 3)),
+                    _t(np.array([0, 4])))
+    np.testing.assert_allclose(t.numpy(), [0.0, 4.0])
+    f = paddle.index_fill(_t(np.zeros((3, 2), np.float32)),
+                          np.array([0, 2]), 0, 9.0)
+    np.testing.assert_allclose(f.numpy()[:, 0], [9, 0, 9])
+
+
+def test_masked_scatter():
+    x = _t(np.zeros(5, np.float32))
+    mask = _t(np.array([True, False, True, False, True]))
+    out = paddle.masked_scatter(x, mask,
+                                _t(np.array([1.0, 2, 3], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [1, 0, 2, 0, 3])
+
+
+def test_scatter_variants():
+    s = paddle.select_scatter(_t(np.zeros((3, 2), np.float32)),
+                              _t(np.ones(2, np.float32)), 0, 1)
+    np.testing.assert_allclose(s.numpy()[1], [1, 1])
+    sl = paddle.slice_scatter(_t(np.zeros((4,), np.float32)),
+                              _t(np.ones(2, np.float32)), [0], [1], [3], [1])
+    np.testing.assert_allclose(sl.numpy(), [0, 1, 1, 0])
+
+
+def test_stack_family_and_split():
+    a, b = np.ones(3, np.float32), np.zeros(3, np.float32)
+    assert paddle.column_stack([_t(a), _t(b)]).shape == [3, 2]
+    assert paddle.hstack([_t(a), _t(b)]).shape == [6]
+    assert paddle.vstack([_t(a), _t(b)]).shape == [2, 3]
+    parts = paddle.tensor_split(_t(np.arange(7)), 3)
+    assert [len(p) for p in parts] == [3, 2, 2]
+
+
+def test_complex_views():
+    c = paddle.complex(_t(np.array([1.0], np.float32)),
+                       _t(np.array([2.0], np.float32)))
+    assert paddle.is_complex(c)
+    np.testing.assert_allclose(paddle.real(c).numpy(), [1.0])
+    np.testing.assert_allclose(paddle.imag(c).numpy(), [2.0])
+    np.testing.assert_allclose(paddle.angle(c).numpy(),
+                               [np.angle(1 + 2j)], rtol=1e-5)
+    p = paddle.polar(_t(np.array([1.0], np.float32)),
+                     _t(np.array([np.pi / 2], np.float32)))
+    np.testing.assert_allclose(paddle.imag(p).numpy(), [1.0], atol=1e-6)
+
+
+def test_as_strided_aminmax():
+    x = _t(np.arange(6, dtype=np.float32))
+    v = paddle.as_strided(x, [2, 2], [3, 1])
+    np.testing.assert_allclose(v.numpy(), [[0, 1], [3, 4]])
+    lo, hi = paddle.aminmax(x)
+    assert float(lo) == 0.0 and float(hi) == 5.0
+
+
+def test_summary_and_flops(capsys):
+    from paddle_tpu import nn
+
+    net = nn.Sequential(nn.Conv2D(1, 2, 3, padding=1), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(2 * 8 * 8, 4))
+    info = paddle.summary(net, input_size=(1, 1, 8, 8))
+    out = capsys.readouterr().out
+    assert "Total params" in out
+    assert info["total_params"] == (1 * 2 * 9 + 2) + (2 * 8 * 8 * 4 + 4)
+    fl = paddle.flops(net, [1, 1, 8, 8])
+    want = 2 * 8 * 8 * 2 * 1 * 9 + 2 * 1 * 128 * 4
+    assert fl == want, (fl, want)
+
+
+def test_review_fixes():
+    # take: negative index resolves python-style; OOB raises
+    x = _t(np.arange(5, dtype=np.float32))
+    np.testing.assert_allclose(paddle.take(x, _t(np.array([-1]))).numpy(),
+                               [4.0])
+    with pytest.raises(Exception):
+        paddle.take(x, _t(np.array([7])), mode="raise")
+    # complex broadcasts
+    c = paddle.complex(_t(np.ones((2, 3), np.float32)),
+                       _t(np.zeros(3, np.float32)))
+    assert c.shape == [2, 3]
+    # ldexp stays finite where naive 2**b overflows f32
+    out = paddle.ldexp(_t(np.float32(1e-30)), _t(np.int32(130)))
+    assert np.isfinite(out.numpy())
+    # heaviside propagates NaN
+    h = paddle.heaviside(_t(np.float32(np.nan)), _t(np.float32(0.5)))
+    assert np.isnan(h.numpy())
+    # trapezoid dx=0 integrates to 0
+    assert float(paddle.trapezoid(_t(np.array([1.0, 2], np.float32)),
+                                  dx=0.0)) == 0.0
+    # masked_scatter undersized value errors
+    with pytest.raises(Exception):
+        paddle.masked_scatter(_t(np.zeros(4, np.float32)),
+                              _t(np.array([True] * 4)),
+                              _t(np.ones(2, np.float32)))
+    # scalar coercion through the shared helpers
+    np.testing.assert_allclose(paddle.sinc(0.0).numpy(), 1.0)
