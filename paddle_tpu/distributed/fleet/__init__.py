@@ -5,6 +5,8 @@ global ``jax.sharding.Mesh`` the facade builds."""
 
 from . import elastic, meta_optimizers, meta_parallel, utils
 from .base.distributed_strategy import DistributedStrategy
+from .base.role_maker import (PaddleCloudRoleMaker, Role,
+                              UserDefinedRoleMaker)
 from .base.topology import (
     CommunicateTopology,
     HybridCommunicateGroup,
@@ -29,5 +31,6 @@ __all__ = [
     "worker_index", "worker_num", "is_first_worker", "barrier_worker",
     "DistributedStrategy", "CommunicateTopology", "HybridCommunicateGroup",
     "get_hybrid_communicate_group", "get_rng_state_tracker", "recompute",
-    "recompute_sequential", "meta_parallel", "meta_optimizers",
+    "recompute_sequential", "meta_parallel", "meta_optimizers", "utils",
+    "PaddleCloudRoleMaker", "UserDefinedRoleMaker", "Role",
 ]

@@ -35,6 +35,7 @@ class Fleet:
         self._strategy: Optional[DistributedStrategy] = None
         self._hcg: Optional[HybridCommunicateGroup] = None
         self._env: Optional[ParallelEnv] = None
+        self._role_maker = None
 
     # --- lifecycle ---
     def init(self, role_maker=None, is_collective: bool = True,
@@ -43,6 +44,7 @@ class Fleet:
 
         strategy = strategy or DistributedStrategy()
         self._strategy = strategy
+        self._role_maker = role_maker
         self._env = init_parallel_env()
 
         h = strategy.hybrid_configs
@@ -63,13 +65,29 @@ class Fleet:
         return self
 
     def is_first_worker(self) -> bool:
+        if self._role_maker is not None:
+            return self._role_maker.is_first_worker()
         return self.worker_index() == 0
 
     def worker_index(self) -> int:
+        if self._role_maker is not None:
+            return self._role_maker.worker_index()
         return ParallelEnv().rank
 
     def worker_num(self) -> int:
+        if self._role_maker is not None:
+            return self._role_maker.worker_num()
         return ParallelEnv().world_size
+
+    def is_worker(self) -> bool:
+        if self._role_maker is not None:
+            return self._role_maker.is_worker()
+        return True
+
+    def is_server(self) -> bool:
+        if self._role_maker is not None:
+            return self._role_maker.is_server()
+        return False
 
     def barrier_worker(self):
         from ..collective import barrier

@@ -341,3 +341,31 @@ class TestReviewRegressions:
         full = paddle.mean((pl.run_functions[0](x) - y) ** 2)
         np.testing.assert_allclose(float(loss.numpy()), float(full.numpy()),
                                    rtol=1e-5)
+
+
+class TestRoleMakers:
+    def test_cloud_role_maker_env(self, monkeypatch):
+        import paddle_tpu.distributed.fleet as fleet
+
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+        rm = fleet.PaddleCloudRoleMaker(is_collective=True)
+        assert rm.worker_index() == 2
+        assert rm.worker_num() == 4
+        assert rm.is_worker() and not rm.is_first_worker()
+        # collective: a stale PS TRAINING_ROLE must not demote workers
+        monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+        assert fleet.PaddleCloudRoleMaker(is_collective=True).is_worker()
+        assert fleet.PaddleCloudRoleMaker(is_collective=False).is_server()
+
+    def test_user_defined_role_maker_wired_into_fleet(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        rm = fleet.UserDefinedRoleMaker(
+            is_collective=True, current_id=3, worker_num=8,
+            worker_endpoints=[f"127.0.0.1:{9000 + i}" for i in range(8)])
+        f = fleet.Fleet().init(role_maker=rm)
+        assert f.worker_index() == 3
+        assert f.worker_num() == 8
+        assert not f.is_first_worker()
+        assert rm._get_trainer_endpoints()[3] == "127.0.0.1:9003"
