@@ -10,6 +10,7 @@ PJRT owns the host→HBM DMA) with a bounded prefetch queue — the
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 from typing import Any, Callable, Iterable, List, Optional, Sequence
@@ -268,21 +269,50 @@ def default_collate_fn(batch: List[Any]):
     return to_tensor(np.asarray(batch))
 
 
+def _mp_worker_loop(dataset, index_q, result_q, worker_id, num_workers,
+                    worker_init_fn):
+    """Subprocess worker body (module-level for spawn picklability):
+    pull index batches, build samples, ship raw python/numpy batches back —
+    collation into Tensors happens in the parent (jax must not be touched
+    in workers)."""
+    _worker_info.info = _WorkerInfo(worker_id, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        job = index_q.get()
+        if job is None:
+            return
+        seq, indices = job
+        try:
+            samples = [dataset[i] for i in indices]
+            result_q.put((seq, samples, None))
+        except Exception as e:  # surface dataset errors in the parent;
+            # KeyboardInterrupt/SystemExit must still kill the worker
+            result_q.put((seq, None, repr(e)))
+
+
 class DataLoader:
     """Batched, optionally prefetching loader.
 
-    ``num_workers>0`` uses a thread pool + bounded queue (BufferedReader
-    analog); ``prefetch_factor`` bounds in-flight batches.
+    ``num_workers>0`` uses a thread pool + bounded queue by default (numpy
+    preprocessing releases the GIL and feeds the native blob queue);
+    ``use_multiprocess=True`` switches to REAL subprocess workers (spawn
+    context, reference semantics) for GIL-bound python ``__getitem__``.
+    ``prefetch_factor`` bounds in-flight batches either way.
     """
 
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False,
                  drop_last=False, collate_fn=None, num_workers=0,
                  use_buffer_reader=True, prefetch_factor=2, use_shared_memory=False,
-                 timeout=0, worker_init_fn=None, persistent_workers=False):
+                 timeout=0, worker_init_fn=None, persistent_workers=False,
+                 use_multiprocess=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_multiprocess = use_multiprocess
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
         self.prefetch_factor = max(2, prefetch_factor)
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
@@ -314,6 +344,101 @@ class DataLoader:
     def _fetch(self, indices):
         return self.collate_fn([self.dataset[i] for i in indices])
 
+    def _iter_multiprocess(self):
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        index_q = ctx.Queue()
+        result_q = ctx.Queue()
+        workers = [ctx.Process(target=_mp_worker_loop,
+                               args=(self.dataset, index_q, result_q,
+                                     wid, self.num_workers,
+                                     self.worker_init_fn),
+                               daemon=True)
+                   for wid in range(self.num_workers)]
+        # data workers must NEVER claim the accelerator (the TPU is
+        # single-tenant; the parent owns it) — force any jax the child's
+        # imports may pull in onto CPU for the duration of the spawns
+        saved_env = {k: os.environ.get(k)
+                     for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")}
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for w in workers:
+                w.start()
+        finally:
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        try:
+            pending = {}
+            next_out = 0
+            submitted = 0
+            batches = iter(self.batch_sampler)
+            exhausted = False
+            max_inflight = self.num_workers * self.prefetch_factor
+
+            def submit():
+                nonlocal submitted, exhausted
+                if exhausted:
+                    return
+                try:
+                    idx = next(batches)
+                except StopIteration:
+                    exhausted = True
+                    return
+                index_q.put((submitted, list(idx)))
+                submitted += 1
+
+            for _ in range(max_inflight):
+                submit()
+            while next_out < submitted:
+                # poll with a short tick so a silently-dead worker (OOM
+                # kill, segfault, unpicklable dataset state) raises instead
+                # of hanging the training loop forever
+                import time as _time
+
+                deadline = (_time.monotonic() + self.timeout
+                            if self.timeout else None)
+                while True:
+                    try:
+                        seq, samples, err = result_q.get(timeout=1.0)
+                        break
+                    except queue.Empty:
+                        dead = [w for w in workers if not w.is_alive()]
+                        if dead:
+                            raise RuntimeError(
+                                f"DataLoader worker(s) died unexpectedly "
+                                f"(exitcodes "
+                                f"{[w.exitcode for w in dead]})") from None
+                        if deadline and _time.monotonic() > deadline:
+                            raise RuntimeError(
+                                f"DataLoader timed out after "
+                                f"{self.timeout}s waiting for a worker "
+                                f"batch") from None
+                if err is not None:
+                    raise RuntimeError(f"DataLoader worker failed: {err}")
+                pending[seq] = samples
+                while next_out in pending:  # preserve sampler order
+                    yield self.collate_fn(pending.pop(next_out))
+                    next_out += 1
+                    submit()
+        finally:
+            # drain unserved jobs so workers see their sentinel promptly
+            try:
+                while True:
+                    index_q.get_nowait()
+            except queue.Empty:
+                pass
+            for _ in workers:
+                index_q.put(None)
+            for w in workers:
+                w.join(timeout=5)
+                if w.is_alive():
+                    w.terminate()
+
     def __iter__(self):
         if self._iterable:
             yield from self._iter_iterable()
@@ -321,6 +446,9 @@ class DataLoader:
         if self.num_workers == 0:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
+            return
+        if self.use_multiprocess:
+            yield from self._iter_multiprocess()
             return
         # threaded prefetch: workers pull index-batches, push collated batches
         from concurrent.futures import ThreadPoolExecutor
