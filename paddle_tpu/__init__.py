@@ -63,6 +63,8 @@ from . import _C_ops  # noqa: F401
 from . import amp  # noqa: F401
 from . import fft  # noqa: F401
 from . import geometric  # noqa: F401
+from . import hub  # noqa: F401
+from . import onnx  # noqa: F401
 from . import autograd  # noqa: F401
 from . import device  # noqa: F401
 from . import distribution  # noqa: F401

@@ -1,0 +1,48 @@
+"""``paddle.hub`` — load models from local repos (reference:
+``python/paddle/hapi/hub.py``). Offline environment: only ``source='local'``
+is supported; a hubconf.py in the repo dir declares entrypoints."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+__all__ = ["list", "help", "load"]
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False
+         ) -> List[str]:
+    if source != "local":
+        raise ValueError("offline build: only source='local' is supported")
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False) -> str:
+    if source != "local":
+        raise ValueError("offline build: only source='local' is supported")
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__ or ""
+
+
+def load(repo_dir: str, model: str, *args, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    if source != "local":
+        raise ValueError("offline build: only source='local' is supported")
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model)(*args, **kwargs)

@@ -434,6 +434,13 @@ class DataLoader:
                 pass
             for _ in workers:
                 index_q.put(None)
+            # drain pending results too: a worker blocked flushing a large
+            # result into an unread pipe cannot exit
+            try:
+                while True:
+                    result_q.get_nowait()
+            except queue.Empty:
+                pass
             for w in workers:
                 w.join(timeout=5)
                 if w.is_alive():
@@ -441,6 +448,11 @@ class DataLoader:
 
     def __iter__(self):
         if self._iterable:
+            if self.use_multiprocess:
+                raise InvalidArgumentError(
+                    "use_multiprocess=True is not supported with "
+                    "IterableDataset (no index-based sharding); use the "
+                    "threaded workers or a map-style Dataset")
             yield from self._iter_iterable()
             return
         if self.num_workers == 0:

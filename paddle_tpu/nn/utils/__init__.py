@@ -8,7 +8,7 @@ import jax.numpy as jnp
 
 from ...core.tensor import Tensor, to_tensor
 
-__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector", "vector_to_parameters"]
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector", "vector_to_parameters", "weight_norm", "remove_weight_norm", "spectral_norm"]
 
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
@@ -52,3 +52,122 @@ def vector_to_parameters(vec: Tensor, parameters, name=None):
         n = p.size
         p._inplace_set(vec._value[offset : offset + n].reshape(p._value.shape))
         offset += n
+
+
+# ---------------------------------------------------------------------------
+# Parametrizations (reference: python/paddle/nn/utils/weight_norm_hook.py,
+# spectral_norm_hook.py): reparameterize a layer's weight via a
+# forward-pre-hook that recomputes it from auxiliary parameters each call.
+# ---------------------------------------------------------------------------
+
+def _norm_except_dim(v, dim):
+    dim = dim % v.ndim  # negative dims must select a real axis
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """w = g * v / ||v||  (reference ``paddle.nn.utils.weight_norm``)."""
+    from ...nn.layer.layers import Parameter
+
+    w = getattr(layer, name)
+    wv = w._value
+    dim = dim % wv.ndim
+    g0 = _norm_except_dim(wv, dim)
+    weight_g = Parameter(g0, name=f"{name}_g")
+    weight_v = Parameter(wv, name=f"{name}_v")
+    layer.add_parameter(f"{name}_g", weight_g)
+    layer.add_parameter(f"{name}_v", weight_v)
+    # the original weight becomes derived state, not a parameter
+    del layer._parameters[name]
+
+    def recompute(lyr, inputs):
+        from ...ops.dispatch import run_op
+
+        def f(g, v):
+            return g * v / jnp.maximum(_norm_except_dim(v, dim), 1e-12)
+
+        new_w = run_op("weight_norm", f, weight_g, weight_v)
+        object.__setattr__(lyr, name, new_w)
+
+    handle = layer.register_forward_pre_hook(recompute)
+    layer._weight_norm_hook = handle  # for remove_weight_norm
+    recompute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    from ...nn.layer.layers import Parameter
+
+    if hasattr(layer, "_weight_norm_hook"):
+        layer._weight_norm_hook.remove()
+        del layer._weight_norm_hook
+    w = getattr(layer, name)
+    # the recompute hook wrote a plain Tensor into __dict__; pop it or it
+    # would shadow the restored Parameter forever (forward would read the
+    # frozen derived weight while the optimizer updates the Parameter)
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, Parameter(w._value, name=name))
+    for aux in (f"{name}_g", f"{name}_v"):
+        if aux in layer._parameters:
+            del layer._parameters[aux]
+    return layer
+
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim: int = 0):
+    """w = w / sigma_max(w) via power iteration (reference
+    ``paddle.nn.utils.spectral_norm``)."""
+    import numpy as _np
+
+    from ...core.tensor import Tensor
+    from ...nn.layer.layers import Parameter
+
+    w = getattr(layer, name)
+    wv = w._value
+    dim = dim % wv.ndim
+    mat = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+    rng = _np.random.RandomState(0)
+    state = {
+        "u": jnp.asarray(rng.randn(mat.shape[0]), jnp.float32),
+        "v": jnp.asarray(rng.randn(mat.shape[1]), jnp.float32),
+    }
+    weight_orig = Parameter(wv, name=f"{name}_orig")
+    layer.add_parameter(f"{name}_orig", weight_orig)
+    del layer._parameters[name]
+
+    def recompute(lyr, inputs):
+        from ...ops.dispatch import run_op
+
+        u, v = state["u"], state["v"]
+
+        def f(wval):
+            m = jnp.moveaxis(wval, dim, 0).reshape(wval.shape[dim], -1)
+            uu, vv = u, v
+            for _ in range(n_power_iterations):
+                vv = m.T @ uu
+                vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+                uu = m @ vv
+                uu = uu / jnp.maximum(jnp.linalg.norm(uu), eps)
+            sigma = uu @ (m @ vv)
+            return wval / jnp.maximum(sigma, eps)
+
+        new_w = run_op("spectral_norm", f, weight_orig)
+        # refresh the persistent power-iteration state OUTSIDE the tape
+        # (eager values only — tracers must not leak into host state)
+        import jax as _jax
+
+        wval = weight_orig._value
+        if not isinstance(wval, _jax.core.Tracer):
+            m = jnp.moveaxis(wval, dim, 0).reshape(wval.shape[dim], -1)
+            vv = m.T @ state["u"]
+            vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+            uu = m @ vv
+            state["u"] = uu / jnp.maximum(jnp.linalg.norm(uu), eps)
+            state["v"] = vv
+        object.__setattr__(lyr, name, new_w)
+
+    handle = layer.register_forward_pre_hook(recompute)
+    layer._spectral_norm_hook = handle
+    recompute(layer, None)
+    return layer

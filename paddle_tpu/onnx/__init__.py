@@ -1,0 +1,26 @@
+"""``paddle.onnx`` — model export entry point.
+
+Reference counterpart: ``python/paddle/onnx/export.py`` (delegates to the
+paddle2onnx converter). TPU-native stance: the portable serialized program
+IS **StableHLO** (``paddle.jit.save``) — the MLIR-based interchange format
+the XLA ecosystem standardises on, playing ONNX's role for this framework.
+``paddle.onnx.export`` therefore emits the StableHLO artifact (and says so),
+keeping deployment scripts' call sites working; true ONNX emission would
+need the onnx package, which is not part of this environment.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=None, **configs):
+    """Export ``layer`` for deployment. Writes ``{path}.pdmodel`` (StableHLO)
+    + ``{path}.pdiparams`` via ``paddle.jit.save`` and returns the prefix."""
+    from .. import jit
+
+    prefix = path[:-5] if path.endswith(".onnx") else path
+    jit.save(layer, prefix, input_spec=input_spec)
+    return prefix

@@ -6,6 +6,7 @@ from __future__ import annotations
 from typing import Callable, List, Sequence
 
 import numpy as np
+from . import functional  # noqa: F401
 
 __all__ = [
     "Compose", "ToTensor", "Normalize", "Resize", "RandomCrop", "CenterCrop",

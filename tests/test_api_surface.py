@@ -100,3 +100,96 @@ def test_viterbi_decode():
     scores, paths = text.viterbi_decode(paddle.to_tensor(em),
                                         paddle.to_tensor(trans))
     assert paths.numpy().tolist() == [[1, 1, 1, 1]]
+
+
+def test_weight_and_spectral_norm():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    lin = nn.Linear(4, 3)
+    w0 = lin.weight.numpy().copy()
+    nn.utils.weight_norm(lin)
+    np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4).astype(
+        np.float32))
+    loss = paddle.sum(lin(x) ** 2)
+    loss.backward()
+    assert lin.weight_g.grad is not None and lin.weight_v.grad is not None
+    nn.utils.remove_weight_norm(lin)
+    assert "weight" in lin._parameters
+
+    sn = nn.Linear(4, 4)
+    nn.utils.spectral_norm(sn)
+    for _ in range(6):
+        sn(x)
+    s = np.linalg.svd(sn.weight.numpy(), compute_uv=False)
+    assert abs(s[0] - 1.0) < 0.05
+
+
+def test_transforms_functional():
+    import paddle_tpu.vision.transforms.functional as TF
+
+    img = np.random.RandomState(0).rand(3, 8, 8).astype(np.float32)
+    assert TF.resize(img, (4, 4)).shape == (3, 4, 4)
+    assert TF.center_crop(img, 4).shape == (3, 4, 4)
+    np.testing.assert_allclose(TF.hflip(TF.hflip(img)), img)
+    assert TF.rotate(img, 90).shape == (3, 8, 8)
+    np.testing.assert_allclose(TF.rotate(TF.rotate(img, 90), -90), img)
+    g = TF.to_grayscale(img, 3)
+    assert g.shape == (3, 8, 8) and np.allclose(g[0], g[1])
+
+
+def test_onnx_export_and_hub(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.static import InputSpec
+
+    net = nn.Linear(4, 2)
+    prefix = paddle.onnx.export(net, str(tmp_path / "m.onnx"),
+                                input_spec=[InputSpec([1, 4], "float32")])
+    assert os.path.exists(prefix + ".pdiparams")
+
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny(n=4):\n"
+        "    \"\"\"tiny linear\"\"\"\n"
+        "    from paddle_tpu import nn\n"
+        "    return nn.Linear(n, 1)\n")
+    assert paddle.hub.list(str(tmp_path)) == ["tiny"]
+    assert "tiny linear" in paddle.hub.help(str(tmp_path), "tiny")
+    m = paddle.hub.load(str(tmp_path), "tiny", n=6)
+    assert m.weight.shape == [6, 1]
+
+
+def test_remove_weight_norm_trains_again():
+    """Post-removal, optimizer updates must be visible to forward (the
+    derived-weight shadow must be cleared)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    lin = nn.Linear(2, 1)
+    nn.utils.weight_norm(lin)
+    nn.utils.remove_weight_norm(lin)
+    opt = paddle.optimizer.SGD(learning_rate=0.5,
+                               parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    before = lin(x).numpy().copy()
+    loss = paddle.sum(lin(x) ** 2)
+    loss.backward()
+    opt.step()
+    after = lin(x).numpy()
+    assert not np.allclose(before, after), "weight update invisible!"
+
+
+def test_transforms_dtype_and_hwc():
+    import paddle_tpu.vision.transforms.functional as TF
+
+    dark = np.zeros((4, 4, 3), np.uint8)
+    dark[0, 0, 0] = 1
+    out = TF.to_tensor(dark).numpy()
+    np.testing.assert_allclose(out.max(), 1 / 255.0, rtol=1e-5)
+    hdr = np.full((3, 4, 4), 2.0, np.float32)  # float >1 stays unscaled
+    np.testing.assert_allclose(TF.to_tensor(hdr).numpy(), hdr)
+    hwc = np.ones((4, 5, 3), np.float32)
+    out = TF.normalize(hwc, [1, 1, 1], [2, 2, 2], data_format="HWC")
+    assert out.shape == (4, 5, 3)
+    np.testing.assert_allclose(out, 0.0)
