@@ -298,11 +298,20 @@ def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
         # grad-of-conv formulation: dilate the input by `stride`, convolve with
         # the spatially-flipped kernel ("IO" spec swaps in/out channels)
         w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
-        out = jax.lax.conv_general_dilated(
-            a, w, window_strides=(1,) * nd, padding=pad, lhs_dilation=strides,
-            rhs_dilation=dils, dimension_numbers=dn,
-            feature_group_count=groups,
-        )
+        conv = lambda ag, wg: jax.lax.conv_general_dilated(
+            ag, wg, window_strides=(1,) * nd, padding=pad,
+            lhs_dilation=strides, rhs_dilation=dils, dimension_numbers=dn)
+        if groups > 1:
+            # grouped transpose conv: XLA's feature_group_count doesn't map
+            # onto the [in, out/g, k] "IO" layout — run per group (XLA fuses
+            # the slices; depthwise upsamplers are tiny convs anyway)
+            ca = a.ndim - 1 if channel_last else 1
+            outs = [conv(ag, wg) for ag, wg in
+                    zip(jnp.split(a, groups, axis=ca),
+                        jnp.split(w, groups, axis=0))]
+            out = jnp.concatenate(outs, axis=ca)
+        else:
+            out = conv(a, w)
         if rest:
             b = rest[0]
             shape = [1] * out.ndim

@@ -18,8 +18,7 @@ from ...framework.random import next_key
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-    "Assign", "Orthogonal", "Dirac", "calculate_gain",
-]
+    "Assign", "Orthogonal", "Dirac", "calculate_gain", "Bilinear", "set_global_initializer"]
 
 
 def _fans(shape: Sequence[int]):
@@ -175,3 +174,39 @@ class Dirac(Initializer):
                 idx = (g * (oc // self.groups) + i, i) + tuple(centers)
                 out[idx] = 1.0
         return jnp.asarray(out, dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference
+    ``paddle.nn.initializer.Bilinear``)."""
+
+    def __call__(self, shape, dtype=jnp.float32):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D conv weight")
+        kh, kw = shape[2], shape[3]
+        # reference/Caffe formula: f = ceil(k/2), c = (2f - 1 - f%2)/(2f)
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        cy = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cx = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        yy, xx = np.mgrid[0:kh, 0:kw]
+        filt = ((1 - np.abs(yy / fh - cy))
+                * (1 - np.abs(xx / fw - cx))).astype(np.float32)
+        # EVERY (in, out) channel slice gets the filter (the grouped
+        # transposed-conv weight is [C, 1, kh, kw] — diagonal-only fill
+        # would zero all channels but the first)
+        out = np.broadcast_to(filt, shape).copy()
+        return jnp.asarray(out, dtype)
+
+
+_GLOBAL_INITIALIZER = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Default initializers for subsequently created parameters (reference
+    ``paddle.nn.initializer.set_global_initializer``). Pass None to reset."""
+    _GLOBAL_INITIALIZER["weight"] = weight_init
+    _GLOBAL_INITIALIZER["bias"] = bias_init
+
+
+def _global_initializer(is_bias: bool):
+    return _GLOBAL_INITIALIZER["bias" if is_bias else "weight"]

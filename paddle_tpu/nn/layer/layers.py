@@ -107,7 +107,13 @@ class Layer:
         if attr is False:
             return None
         dtype = dtype or self._dtype
-        init = attr.initializer or default_initializer
+        # precedence (reference set_global_initializer semantics): explicit
+        # ParamAttr initializer > global default > layer default > built-in
+        init = attr.initializer
+        if init is None:
+            init = I._global_initializer(is_bias)
+        if init is None:
+            init = default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         value = init(shape, convert_dtype(dtype))

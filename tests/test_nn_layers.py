@@ -240,3 +240,59 @@ def test_lstm_against_manual_step():
         cc = sigmoid(f) * cc + sigmoid(i) * np.tanh(g)
         hh = sigmoid(o) * np.tanh(cc)
     np.testing.assert_allclose(y.numpy()[:, -1], hh, rtol=1e-4, atol=1e-5)
+
+
+def test_bilinear_and_global_initializer():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.nn import initializer as I
+
+    # reference/Caffe values: k=3 -> 1-D profile [0.25, 0.75, 0.75]
+    w3 = np.asarray(I.Bilinear()((1, 1, 3, 3)))
+    np.testing.assert_allclose(w3[0, 0, 1], [0.1875, 0.5625, 0.5625],
+                               rtol=1e-6)
+    # grouped upsampler layout [C, 1, kh, kw]: every channel gets the filter
+    wg = np.asarray(I.Bilinear()((3, 1, 4, 4)))
+    assert (wg.sum(axis=(2, 3)) > 0).all()
+    np.testing.assert_allclose(wg[0], wg[2])
+
+    I.set_global_initializer(I.Constant(3.0), I.Constant(-1.0))
+    try:
+        lin = nn.Linear(2, 2)
+        assert np.all(lin.weight.numpy() == 3.0)
+        assert np.all(lin.bias.numpy() == -1.0)
+        # explicit ParamAttr still wins over the global default
+        lin2 = nn.Linear(2, 2, weight_attr=paddle.ParamAttr(
+            initializer=I.Constant(7.0)))
+        assert np.all(lin2.weight.numpy() == 7.0)
+    finally:
+        I.set_global_initializer(None)
+    assert not np.all(nn.Linear(2, 2).weight.numpy() == 3.0)
+
+
+def test_grouped_conv_transpose():
+    """Grouped transposed conv (depthwise upsampler) — regression for the
+    feature_group_count/IO-layout mismatch."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    up = nn.Conv2DTranspose(4, 4, 3, stride=2, padding=1, groups=2)
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 4, 5, 5).astype(
+        np.float32))
+    y = up(x)
+    assert tuple(y.shape) == (2, 4, 9, 9)
+    # parity: groups=2 equals two independent halves
+    import paddle_tpu.nn.functional as F
+
+    w = up.weight
+    b = up.bias
+    y_ref_lo = F.conv2d_transpose(x[:, :2], w[:2], None, stride=2,
+                                  padding=1)
+    got_lo = F.conv2d_transpose(x, w, None, stride=2, padding=1,
+                                groups=2)[:, :2]
+    np.testing.assert_allclose(got_lo.numpy(), y_ref_lo.numpy(), rtol=1e-4,
+                               atol=1e-5)
