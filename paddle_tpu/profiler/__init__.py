@@ -18,7 +18,7 @@ from typing import Callable, Iterable, Optional, Tuple, Union
 import jax
 
 __all__ = ["ProfilerTarget", "ProfilerState", "Profiler", "RecordEvent",
-           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result", "SummaryView"]
 
 
 class ProfilerTarget(enum.Enum):
@@ -117,7 +117,9 @@ class Profiler:
         return False
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms"):
+                time_unit="ms", views=None):
+        # ``views`` (list of SummaryView) selects tables in the reference;
+        # this profiler prints its single step/op table for any selection
         n = len(self._step_times)
         if not n:
             print("No steps recorded.")
@@ -171,3 +173,17 @@ def load_profiler_result(filename: str):
     from ..enforce import raise_unimplemented
 
     raise_unimplemented("load_profiler_result (open the trace dir in TensorBoard)")
+
+
+class SummaryView(enum.Enum):
+    """Summary table selector (reference ``paddle.profiler.SummaryView``)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
