@@ -139,11 +139,14 @@ def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
 def _pick_block(seq_len: int, prefer: int = 512) -> int:
     """Largest MXU-friendly block that tiles ``seq_len`` (512 measured
     fastest at seq 512; 256/128 keep seq lens like 768 on the pallas path
-    instead of silently falling back to the O(S^2) XLA formulation)."""
+    instead of silently falling back to the O(S^2) XLA formulation).
+    Returns 0 when no aligned block tiles ``seq_len`` — callers' modulo
+    guard then routes to the XLA formulation (never hand Mosaic a block
+    that isn't sublane-aligned)."""
     for b in (512, 256, 128):
         if b <= prefer and seq_len % b == 0:
             return b
-    return min(prefer, seq_len)
+    return 0
 
 
 def _pallas_flash_attention(q, k, v, is_causal=False, scale=None,
@@ -162,7 +165,7 @@ def _pallas_flash_attention(q, k, v, is_causal=False, scale=None,
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     block_q = min(block_q, sq) if block_q else _pick_block(sq)
     block_k = min(block_k, sk) if block_k else _pick_block(sk)
-    if sq % block_q or sk % block_k:
+    if not block_q or not block_k or sq % block_q or sk % block_k:
         if with_lse:
             return None
         return _xla_attention(q, k, v, is_causal=is_causal, scale=scale)
@@ -328,6 +331,11 @@ def _pallas_flash_bwd(q, k, v, do, out, lse, is_causal, scale=None,
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     block_q = min(block_q, sq) if block_q else _pick_block(sq)
     block_k = min(block_k, sk) if block_k else _pick_block(sk)
+    if not block_q or not block_k or sq % block_q or sk % block_k:
+        raise ValueError(
+            f"flash backward needs tiling blocks for sq={sq}, sk={sk} — "
+            "the forward's tileability gate should have routed this shape "
+            "to the XLA path")
 
     qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
