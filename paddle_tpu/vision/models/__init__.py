@@ -9,7 +9,9 @@ from .mobilenetv2 import MobileNetV2, mobilenet_v2
 from .mobilenetv3 import (MobileNetV3Large, MobileNetV3Small,
                           mobilenet_v3_large, mobilenet_v3_small)
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
-                     resnet152, wide_resnet50_2)
+                     resnet152, resnext50_32x4d, resnext101_32x4d,
+                     resnext101_64x4d, resnext152_32x4d, wide_resnet50_2,
+                     wide_resnet101_2)
 from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_25,
                            shufflenet_v2_x0_5, shufflenet_v2_x1_0,
                            shufflenet_v2_x1_5, shufflenet_v2_x2_0)
@@ -19,7 +21,9 @@ from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 __all__ = [
     "LeNet", "AlexNet", "alexnet",
     "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-    "resnet152", "wide_resnet50_2",
+    "resnet152", "wide_resnet50_2", "wide_resnet101_2",
+    "resnext50_32x4d", "resnext101_32x4d", "resnext101_64x4d",
+    "resnext152_32x4d",
     "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
     "MobileNetV1", "mobilenet_v1", "MobileNetV2", "mobilenet_v2",
     "MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",

@@ -6,7 +6,7 @@ from __future__ import annotations
 from ... import nn
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-           "resnet152", "wide_resnet50_2"]
+           "resnet152", "wide_resnet50_2", "resnext50_32x4d", "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d", "wide_resnet101_2"]
 
 
 class BasicBlock(nn.Layer):
@@ -155,3 +155,28 @@ def resnet152(pretrained=False, **kwargs):
 def wide_resnet50_2(pretrained=False, **kwargs):
     kwargs["width"] = 128
     return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    """ResNeXt-50 32x4d (reference ``paddle.vision.models.resnext50_32x4d``)."""
+    return _resnet(BottleneckBlock, 50, pretrained, width=4, groups=32,
+                   **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 101, pretrained, width=4, groups=32,
+                   **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 101, pretrained, width=4, groups=64,
+                   **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 152, pretrained, width=4, groups=32,
+                   **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 101, pretrained, width=128, **kwargs)

@@ -51,3 +51,10 @@ def test_model_zoo_train_mode_batchnorm():
     loss.backward()
     grads = [p.grad for p in m.parameters() if p.grad is not None]
     assert len(grads) > 0
+
+
+def test_resnext_forward():
+    from paddle_tpu.vision import models
+
+    m = models.resnext50_32x4d(num_classes=10)
+    _run(m, size=64)
