@@ -37,7 +37,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..ops.pallas.flash_attention import dot_product_attention
+from ..ops.pallas.flash_attention import (
+    dot_product_attention,
+    flash_path_active as _flash_path_active,
+)
 from ..parallel.mesh import with_sharding_constraint as wsc
 
 
@@ -179,16 +182,18 @@ def _rms_norm(x, w, eps):
 
 
 def _rope(x, theta):
-    # x: [B, S, H, D]; rotate-half convention
+    # x: [B, S, H, D]; LLaMA rotate-half convention: the head dim splits
+    # into two contiguous halves (lane-aligned slices on TPU — the strided
+    # ::2 interleave costs extra vector shuffles every layer and again in
+    # every remat replay)
     b, s, h, d = x.shape
     pos = jnp.arange(s, dtype=jnp.float32)
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
     ang = pos[:, None] * freqs[None, :]              # [S, D/2]
     cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
     sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
-    x1, x2 = x[..., ::2], x[..., 1::2]
-    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
-    return out.reshape(b, s, h, d)
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
 
 
 def _act_spec(cfg: LlamaConfig) -> P:
@@ -197,8 +202,8 @@ def _act_spec(cfg: LlamaConfig) -> P:
     return P(("dp", "sharding"), seq, None)
 
 
-def _decoder_layer(cfg: LlamaConfig, x, lp):
-    """One transformer block. x: [B, S, H]; lp: this layer's weight slice."""
+def _layer_qkv(cfg: LlamaConfig, x, lp):
+    """Pre-attention half of a block: rms → qkv projections → rope → GQA."""
     B, S, H = x.shape
     dt = x.dtype
     h = _rms_norm(x, lp["ln_attn"], cfg.rms_eps)
@@ -212,7 +217,13 @@ def _decoder_layer(cfg: LlamaConfig, x, lp):
         v = jnp.repeat(v, rep, axis=2)
     # heads are mp-sharded (follows from wq's output sharding)
     q = wsc(q, P(("dp", "sharding"), None, "mp", None))
-    attn = dot_product_attention(q, k, v, is_causal=True)
+    return q, k, v
+
+
+def _layer_post(cfg: LlamaConfig, x, attn, lp):
+    """Post-attention half: output projection, residual, mlp."""
+    B, S, H = x.shape
+    dt = x.dtype
     attn = attn.reshape(B, S, H)
     x = x + wsc(attn @ lp["wo"].astype(dt), _act_spec(cfg))
     h = _rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
@@ -220,6 +231,13 @@ def _decoder_layer(cfg: LlamaConfig, x, lp):
     up = h @ lp["w_up"].astype(dt)
     x = x + wsc((gate * up) @ lp["w_down"].astype(dt), _act_spec(cfg))
     return x
+
+
+def _decoder_layer(cfg: LlamaConfig, x, lp):
+    """One transformer block. x: [B, S, H]; lp: this layer's weight slice."""
+    q, k, v = _layer_qkv(cfg, x, lp)
+    attn = dot_product_attention(q, k, v, is_causal=True)
+    return _layer_post(cfg, x, attn, lp)
 
 
 def forward(params: Dict[str, jax.Array], tokens: jax.Array,
@@ -233,11 +251,27 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array,
                      ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
                       "ln_attn", "ln_mlp")}
 
-    def body(x, lp):
-        return _decoder_layer(cfg, x, lp), None
+    if cfg.remat and _flash_path_active():
+        # Flash-path remat structure: checkpoint the two matmul halves but
+        # keep attention OUTSIDE the remat region, so the flash custom-VJP's
+        # O(S) residuals (q/k/v/out/logsumexp) are saved rather than the
+        # forward kernel re-running inside the backward scan. The halves
+        # still fully remat: saving their matmul outputs measures neutral
+        # (the save/reload HBM traffic ≈ the recompute cost at this scale)
+        # while costing ~2.4 GB — recompute is the better trade.
+        qkv_part = jax.checkpoint(functools.partial(_layer_qkv, cfg))
+        post_part = jax.checkpoint(functools.partial(_layer_post, cfg))
 
-    if cfg.remat:
-        body = jax.checkpoint(body)  # fleet.recompute analog: per-layer remat
+        def body(x, lp):
+            q, k, v = qkv_part(x, lp)
+            attn = dot_product_attention(q, k, v, is_causal=True)
+            return post_part(x, attn, lp), None
+    else:
+        def body(x, lp):
+            return _decoder_layer(cfg, x, lp), None
+
+        if cfg.remat:
+            body = jax.checkpoint(body)  # fleet.recompute analog
     x, _ = jax.lax.scan(body, x, layer_weights)
 
     x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
@@ -246,16 +280,26 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array,
 
 
 def loss_fn(params, tokens, labels, cfg: LlamaConfig) -> jax.Array:
-    """Next-token cross entropy in fp32 (the reference's
-    ``ParallelCrossEntropy`` / ``c_softmax_with_cross_entropy`` — here the
-    vocab-sharded logsumexp reduction is a GSPMD-inserted collective).
+    """Next-token cross entropy (the reference's ``ParallelCrossEntropy`` /
+    ``c_softmax_with_cross_entropy`` — here the vocab-sharded logsumexp
+    reduction is a GSPMD-inserted collective).
+
+    The reduction upcasts to fp32 INSIDE the fused pass over the bf16
+    logits: casting the whole [B, S, V] tensor first would materialise
+    ~2.6 GB of fp32 holding bf16-precision values — pure HBM traffic for
+    zero accuracy (the matmul already rounded to bf16).
 
     ``labels`` is the same [B, S] token stream; the shift happens HERE:
     position i's logits are scored against labels[i+1]."""
-    logits = forward(params, tokens, cfg).astype(jnp.float32)[:, :-1]
+    logits = forward(params, tokens, cfg)[:, :-1]
     targets = labels[:, 1:]
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    m = jnp.max(logits, axis=-1)
+    # one fused pass: (bf16 - bf16) -> f32 exp -> f32 row sum
+    sumexp = jnp.sum(
+        jnp.exp((logits - m[..., None]).astype(jnp.float32)), axis=-1)
+    gold = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0].astype(jnp.float32)
+    logz = m.astype(jnp.float32) + jnp.log(sumexp)
     return jnp.mean(logz - gold)
 
 

@@ -63,13 +63,24 @@ def _xla_attention(q, k, v, mask=None, is_causal=False, scale=None):
 # ---------------------------------------------------------------------------
 
 def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
-                     causal_offset: int = 0, with_lse: bool = False):
+                     causal_offset: int = 0, with_lse: bool = False,
+                     seq_k: int = 0):
     """``causal_offset`` aligns the causal diagonal when sq != sk (KV-cache
     decode): query row i sits at absolute position i + offset, matching the
     XLA fallback's ``tril(..., k=sk-sq)`` convention. ``with_lse`` adds a
     second output with each row's logsumexp (needed by the backward pass:
-    ``exp(s - lse)`` reconstitutes the softmax probabilities)."""
+    ``exp(s - lse)`` reconstitutes the softmax probabilities).
+
+    Every row sees at least one unmasked key in k-block 0 (causal:
+    q_pos >= 0 always; non-causal: trivially), so the running max is finite
+    from the first visited block and IEEE semantics make the -inf paths
+    self-correcting: ``exp(-inf - finite) = 0`` — no isfinite guards needed.
+    ``seq_k == block_k`` (the whole K/V fits one block — the common
+    seq<=512 training shape) drops the online-softmax loop entirely for a
+    straight-line softmax in VMEM."""
     from jax.experimental import pallas as pl
+
+    single_block = seq_k == block_k
 
     def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None):
         # q_ref: [1, block_q, d]; k_ref/v_ref: [1, S, d] (this head's K/V).
@@ -79,6 +90,29 @@ def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
         qb = q_ref[0]
         S = k_ref.shape[1]
         q_idx = pl.program_id(1)
+
+        if single_block:
+            kb = k_ref[0]
+            vb = v_ref[0]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if is_causal:
+                q_pos = causal_offset + q_idx * block_q + \
+                    jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                k_pos = jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+            m = jnp.max(s, axis=-1)
+            p = jnp.exp(s - m[:, None])
+            l = jnp.sum(p, axis=-1)
+            acc = jax.lax.dot_general(
+                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+            if lse_ref is not None:
+                lse_ref[0] = (m + jnp.log(l))[:, None]
+            return
 
         def body(start, carry):
             acc, m_prev, l_prev = carry
@@ -97,11 +131,8 @@ def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
                 s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
             m_cur = jnp.max(s, axis=-1)
             m_new = jnp.maximum(m_prev, m_cur)
-            # guard fully-masked rows (m == -inf)
-            m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-            p = jnp.exp(s - m_safe[:, None])
-            p = jnp.where(jnp.isfinite(s), p, 0.0)
-            alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m_prev - m_new)  # iter 0: exp(-inf - m) = 0
             l_new = l_prev * alpha + jnp.sum(p, axis=-1)
             acc = acc * alpha[:, None] + jax.lax.dot_general(
                 p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
@@ -122,13 +153,11 @@ def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
         m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
         l0 = jnp.zeros((block_q,), jnp.float32)
         acc, m, l = jax.lax.fori_loop(0, n_iter, body, (acc0, m0, l0))
-        l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
         if lse_ref is not None:
             # exp(s*scale - lse) reconstitutes softmax probs in the bwd pass
             # (shape [block_q, 1]: TPU block tiling needs the trailing unit dim)
-            m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
-            lse_ref[0] = (m_safe + jnp.log(l))[:, None]
+            lse_ref[0] = (m + jnp.log(l))[:, None]
 
     if not with_lse:
         return lambda q_ref, k_ref, v_ref, o_ref: kernel(q_ref, k_ref,
@@ -176,7 +205,8 @@ def _pallas_flash_attention(q, k, v, is_causal=False, scale=None,
     vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
 
     kernel = _make_pallas_fwd(block_q, block_k, is_causal, scale,
-                              causal_offset=sk - sq, with_lse=with_lse)
+                              causal_offset=sk - sq, with_lse=with_lse,
+                              seq_k=sk)
     out_spec = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
     out_shape = jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)
     if with_lse:
@@ -216,8 +246,11 @@ def _pallas_flash_fwd_lse(q, k, v, is_causal=False, scale=None,
 # parallelises over its own output's blocks with no cross-block races.
 # ---------------------------------------------------------------------------
 
-def _make_pallas_bwd_dq(block_q, block_k, is_causal, scale, causal_offset=0):
+def _make_pallas_bwd_dq(block_q, block_k, is_causal, scale, causal_offset=0,
+                        seq_k: int = 0):
     from jax.experimental import pallas as pl
+
+    single_block = seq_k == block_k
 
     def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
         # q/do: [1, block_q, d]; k/v: [1, S, d]; lse/delta: [1, block_q]
@@ -228,9 +261,7 @@ def _make_pallas_bwd_dq(block_q, block_k, is_causal, scale, causal_offset=0):
         S = k_ref.shape[1]
         q_idx = pl.program_id(1)
 
-        def body(start, dq_acc):
-            kb = k_ref[0, pl.ds(start * block_k, block_k), :]
-            vb = v_ref[0, pl.ds(start * block_k, block_k), :]
+        def block_dq(start, kb, vb):
             s = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
@@ -245,9 +276,18 @@ def _make_pallas_bwd_dq(block_q, block_k, is_causal, scale, causal_offset=0):
                 dob, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None]) * scale
-            return dq_acc + jax.lax.dot_general(
+            return jax.lax.dot_general(
                 ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
+
+        if single_block:
+            dq_ref[0] = block_dq(0, k_ref[0], v_ref[0]).astype(dq_ref.dtype)
+            return
+
+        def body(start, dq_acc):
+            kb = k_ref[0, pl.ds(start * block_k, block_k), :]
+            vb = v_ref[0, pl.ds(start * block_k, block_k), :]
+            return dq_acc + block_dq(start, kb, vb)
 
         n_k = S // block_k
         if is_causal:
@@ -265,8 +305,10 @@ def _make_pallas_bwd_dq(block_q, block_k, is_causal, scale, causal_offset=0):
 
 
 def _make_pallas_bwd_dkv(block_q, block_k, is_causal, scale,
-                         causal_offset=0):
+                         causal_offset=0, seq_q: int = 0):
     from jax.experimental import pallas as pl
+
+    single_block = seq_q == block_q
 
     def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                dk_ref, dv_ref):
@@ -276,12 +318,7 @@ def _make_pallas_bwd_dkv(block_q, block_k, is_causal, scale,
         S = q_ref.shape[1]
         k_idx = pl.program_id(1)
 
-        def body(start, carry):
-            dk_acc, dv_acc = carry
-            qb = q_ref[0, pl.ds(start * block_q, block_q), :]
-            dob = do_ref[0, pl.ds(start * block_q, block_q), :]
-            lse = lse_ref[0, pl.ds(start * block_q, block_q), 0]
-            delta = delta_ref[0, pl.ds(start * block_q, block_q), 0]
+        def block_dkv(start, qb, dob, lse, delta):
             s = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
@@ -292,17 +329,33 @@ def _make_pallas_bwd_dkv(block_q, block_k, is_causal, scale,
                 k_pos = k_idx * block_k + \
                     jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
                 p = jnp.where(q_pos >= k_pos, p, 0.0)
-            dv_acc = dv_acc + jax.lax.dot_general(
+            dv_c = jax.lax.dot_general(
                 p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             dp = jax.lax.dot_general(
                 dob, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None]) * scale
-            dk_acc = dk_acc + jax.lax.dot_general(
+            dk_c = jax.lax.dot_general(
                 ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            return dk_acc, dv_acc
+            return dk_c, dv_c
+
+        if single_block:
+            dk, dv = block_dkv(0, q_ref[0], do_ref[0], lse_ref[0, :, 0],
+                               delta_ref[0, :, 0])
+            dk_ref[0] = dk.astype(dk_ref.dtype)
+            dv_ref[0] = dv.astype(dv_ref.dtype)
+            return
+
+        def body(start, carry):
+            dk_acc, dv_acc = carry
+            qb = q_ref[0, pl.ds(start * block_q, block_q), :]
+            dob = do_ref[0, pl.ds(start * block_q, block_q), :]
+            lse = lse_ref[0, pl.ds(start * block_q, block_q), 0]
+            delta = delta_ref[0, pl.ds(start * block_q, block_q), 0]
+            dk_c, dv_c = block_dkv(start, qb, dob, lse, delta)
+            return dk_acc + dk_c, dv_acc + dv_c
 
         n_q = S // block_q
         if is_causal:
@@ -349,7 +402,8 @@ def _pallas_flash_bwd(q, k, v, do, out, lse, is_causal, scale=None,
 
     off = sk - sq
     dq = pl.pallas_call(
-        _make_pallas_bwd_dq(block_q, block_k, is_causal, scale, off),
+        _make_pallas_bwd_dq(block_q, block_k, is_causal, scale, off,
+                            seq_k=sk),
         grid=(b * h, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
@@ -364,7 +418,8 @@ def _pallas_flash_bwd(q, k, v, do, out, lse, is_causal, scale=None,
     )(qr, kr, vr, dor, lse, delta)
 
     dk, dv = pl.pallas_call(
-        _make_pallas_bwd_dkv(block_q, block_k, is_causal, scale, off),
+        _make_pallas_bwd_dkv(block_q, block_k, is_causal, scale, off,
+                             seq_q=sq),
         grid=(b * h, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
@@ -388,18 +443,27 @@ def _pallas_flash_bwd(q, k, v, do, out, lse, is_causal, scale=None,
     return unfold(dq, sq), unfold(dk, sk), unfold(dv, sk)
 
 
+def flash_path_active(mask=None) -> bool:
+    """True when `dot_product_attention` would take the Pallas flash path
+    (TPU, kernels enabled, no additive mask, single-device mesh). Models use
+    this to pick a remat structure: on the flash path the custom-VJP's O(S)
+    residuals (out + logsumexp) are worth SAVING across `jax.checkpoint`
+    boundaries instead of re-running the forward kernel in the backward."""
+    return (
+        _on_tpu()
+        and flags.get_flags("use_pallas_kernels")["use_pallas_kernels"]
+        and mask is None
+        and not _multi_device_mesh_active()
+    )
+
+
 def dot_product_attention(q, k, v, mask=None, is_causal=False):
     """Public entry: picks Pallas on TPU (when enabled, mask-free, and not
     under a multi-device mesh), XLA reference elsewhere. Differentiable:
     the pallas path uses the flash BACKWARD kernels (`_pallas_flash_bwd`,
     O(S) memory via saved logsumexp); XLA-recompute backward remains only
     as the untileable-shape fallback."""
-    use_pallas = (
-        _on_tpu()
-        and flags.get_flags("use_pallas_kernels")["use_pallas_kernels"]
-        and mask is None
-        and not _multi_device_mesh_active()
-    )
+    use_pallas = flash_path_active(mask)
     if use_pallas:
         return _flash_custom_vjp(q, k, v, is_causal)
     return _xla_attention(q, k, v, mask=mask, is_causal=is_causal)

@@ -19,9 +19,13 @@ pytestmark = pytest.mark.skipif(
 
 
 @pytest.mark.parametrize("causal", [True, False])
-def test_flash_fwd_bwd_parity(causal):
+# S=512 takes the single-block straight-line kernels (seq == block); S=1024
+# exercises the multi-block online-softmax loop and its causal block-skip
+# bounds — keep BOTH paths covered.
+@pytest.mark.parametrize("S", [512, 1024])
+def test_flash_fwd_bwd_parity(causal, S):
     rng = np.random.RandomState(0)
-    B, S, H, D = 2, 512, 4, 64
+    B, H, D = 2, 4, 64
     q = jnp.array(rng.randn(B, S, H, D), jnp.bfloat16)
     k = jnp.array(rng.randn(B, S, H, D), jnp.bfloat16)
     v = jnp.array(rng.randn(B, S, H, D), jnp.bfloat16)
