@@ -60,6 +60,13 @@ class LlamaConfig:
     # 1 = shard opt states, 2 = (+grads, implicit in jit), 3 = shard params too
     sharding_stage: int = 1
     remat: bool = True
+    # scan_layers=True: decoder as lax.scan over stacked weights — O(1)
+    # compile depth, the right shape for deep models and the pp axis.
+    # scan_layers=False: python-unrolled layers — XLA saves residuals as
+    # plain buffers with NO scan dynamic-update-slice stacking machinery;
+    # measured ~20% faster on the bert-base-budget single-chip workload
+    # (usually paired with remat=False when activations fit HBM).
+    scan_layers: bool = True
     # sequence parallel: shard activations' seq dim over 'sep' outside matmuls
     sequence_parallel: bool = False
 
@@ -78,9 +85,14 @@ class LlamaConfig:
 
     @classmethod
     def bert_base_equiv(cls, **kw):
-        """~110M decoder matching BERT/ERNIE-base budget (BASELINE config 2)."""
+        """~110M decoder matching BERT/ERNIE-base budget (BASELINE config 2).
+
+        Unrolled + no remat: at this depth/width the activations fit HBM
+        alongside the optimizer, and skipping both the recompute FLOPs and
+        the scan residual-stacking copies is worth ~25% step time."""
         d = dict(vocab_size=32000, hidden_size=768, intermediate_size=3072,
-                 num_layers=12, num_heads=12, num_kv_heads=12, max_seq_len=512)
+                 num_layers=12, num_heads=12, num_kv_heads=12, max_seq_len=512,
+                 remat=False, scan_layers=False)
         d.update(kw)
         return cls(**d)
 
@@ -272,7 +284,13 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array,
 
         if cfg.remat:
             body = jax.checkpoint(body)  # fleet.recompute analog
-    x, _ = jax.lax.scan(body, x, layer_weights)
+
+    if cfg.scan_layers:
+        x, _ = jax.lax.scan(body, x, layer_weights)
+    else:
+        # python-unrolled: static per-layer slices, no scan stacking copies
+        for i in range(cfg.num_layers):
+            x, _ = body(x, {k: w[i] for k, w in layer_weights.items()})
 
     x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
     logits = x @ params["lm_head"].astype(dt)
@@ -293,14 +311,15 @@ def loss_fn(params, tokens, labels, cfg: LlamaConfig) -> jax.Array:
     position i's logits are scored against labels[i+1]."""
     logits = forward(params, tokens, cfg)[:, :-1]
     targets = labels[:, 1:]
-    m = jnp.max(logits, axis=-1)
-    # one fused pass: (bf16 - bf16) -> f32 exp -> f32 row sum
+    m = jnp.max(logits, axis=-1).astype(jnp.float32)
+    # one fused pass: f32(bf16) - f32 max -> exp -> row sum (the convert
+    # fuses into the reduction; subtracting in bf16 would re-round the
+    # differences to 8 mantissa bits)
     sumexp = jnp.sum(
-        jnp.exp((logits - m[..., None]).astype(jnp.float32)), axis=-1)
+        jnp.exp(logits.astype(jnp.float32) - m[..., None]), axis=-1)
     gold = jnp.take_along_axis(
         logits, targets[..., None], axis=-1)[..., 0].astype(jnp.float32)
-    logz = m.astype(jnp.float32) + jnp.log(sumexp)
-    return jnp.mean(logz - gold)
+    return jnp.mean(m + jnp.log(sumexp) - gold)
 
 
 # ---------------------------------------------------------------------------

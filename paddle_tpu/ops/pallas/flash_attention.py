@@ -91,18 +91,22 @@ def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
         S = k_ref.shape[1]
         q_idx = pl.program_id(1)
 
-        if single_block:
-            kb = k_ref[0]
-            vb = v_ref[0]
+        def block_scores(start, kb):
+            """Causal-masked scaled scores of this q block vs k block."""
             s = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
             if is_causal:
                 q_pos = causal_offset + q_idx * block_q + \
                     jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-                k_pos = jax.lax.broadcasted_iota(
+                k_pos = start * block_k + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
                 s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+            return s
+
+        if single_block:
+            vb = v_ref[0]
+            s = block_scores(0, k_ref[0])
             m = jnp.max(s, axis=-1)
             p = jnp.exp(s - m[:, None])
             l = jnp.sum(p, axis=-1)
@@ -118,17 +122,7 @@ def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
             acc, m_prev, l_prev = carry
             kb = k_ref[0, pl.ds(start * block_k, block_k), :]
             vb = v_ref[0, pl.ds(start * block_k, block_k), :]
-            s = jax.lax.dot_general(
-                qb, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
-            if is_causal:
-                q_pos = causal_offset + q_idx * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0
-                )
-                k_pos = start * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1
-                )
-                s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+            s = block_scores(start, kb)
             m_cur = jnp.max(s, axis=-1)
             m_new = jnp.maximum(m_prev, m_cur)
             p = jnp.exp(s - m_new[:, None])
@@ -194,7 +188,11 @@ def _pallas_flash_attention(q, k, v, is_causal=False, scale=None,
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     block_q = min(block_q, sq) if block_q else _pick_block(sq)
     block_k = min(block_k, sk) if block_k else _pick_block(sk)
-    if not block_q or not block_k or sq % block_q or sk % block_k:
+    # sq > sk under causal would put query rows before any visible key
+    # (fully-masked rows -> 0/0 in the guard-free kernels); route to the
+    # XLA formulation, whose -inf softmax defines that edge
+    if (not block_q or not block_k or sq % block_q or sk % block_k
+            or (is_causal and sq > sk)):
         if with_lse:
             return None
         return _xla_attention(q, k, v, is_causal=is_causal, scale=scale)
