@@ -11,7 +11,11 @@ and reports compile+step wall time. Run with:
 """
 
 import json
+import os
 import sys
+
+# runnable standalone: the repo root (one level up) holds paddle_tpu
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import time
 
 

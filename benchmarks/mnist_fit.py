@@ -5,7 +5,11 @@ on the synthetic MNIST dataset. Prints one JSON line.
 """
 
 import json
+import os
 import sys
+
+# runnable standalone: the repo root (one level up) holds paddle_tpu
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import time
 
 import numpy as np
