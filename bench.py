@@ -75,14 +75,24 @@ def run(batch: int, seq: int):
 
 def main():
     best = 0.0
-    # 16 and 32 bracket the sweet spot on v5e; 8/4 are OOM-only fallbacks
-    for batch in (16, 32, 8, 4):
-        if best and batch <= 8:
+    # 32 is the measured sweet spot on v5e (b64 is worse, b16 ~4% behind);
+    # 16 is the fallback bracket, 8/4 are OOM-only fallbacks
+    for batch in (32, 16, 8, 4):
+        if best and batch <= 16:
             break
-        try:
-            best = max(best, run(batch, 512))
-        except Exception as e:
-            log(f"batch {batch} failed: {type(e).__name__}: {e}")
+        # the tunneled compile service occasionally drops a request
+        # (INTERNAL: remote_compile ... response body closed) — retry each
+        # batch once on that signature; anything else (e.g. OOM) falls
+        # through to the next batch immediately
+        for attempt in (1, 2):
+            try:
+                best = max(best, run(batch, 512))
+                break
+            except Exception as e:
+                log(f"batch {batch} attempt {attempt} failed: "
+                    f"{type(e).__name__}: {e}")
+                if "remote_compile" not in str(e):
+                    break
     tokens_per_s = best
     if not best:
         print(json.dumps({

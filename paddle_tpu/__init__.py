@@ -90,6 +90,20 @@ from .ops import linalg  # noqa: F401
 # paddle.DataParallel / distributed entry points live in paddle_tpu.distributed
 # (imported lazily to keep single-process import light)
 
+_LAZY_SUBMODULES = ("distributed", "incubate")
+
+
+def __getattr__(name):
+    # PEP 562: `import paddle_tpu as paddle; paddle.distributed.…` must work
+    # (the reference's documented entry pattern) without paying the
+    # distributed-stack import at plain-`import paddle_tpu` time. The import
+    # system sets the attribute on this package, so the hook fires once.
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 def DataParallel(layers, **kwargs):
     from .distributed.parallel import DataParallel as _DP

@@ -1,5 +1,8 @@
 from . import hybrid_parallel_util, sequence_parallel_utils
 from .hybrid_parallel_util import fused_allreduce_gradients
+# reference parity: upstream re-exports recompute at
+# python/paddle/distributed/fleet/utils/__init__.py as well as fleet.*
+from ..recompute import recompute, recompute_sequential
 from .sequence_parallel_utils import (
     AllGatherOp,
     ColumnSequenceParallelLinear,
@@ -11,7 +14,8 @@ from .sequence_parallel_utils import (
     register_sequence_parallel_allreduce_hooks,
 )
 
-__all__ = ["fused_allreduce_gradients", "ScatterOp", "GatherOp",
+__all__ = ["fused_allreduce_gradients", "recompute", "recompute_sequential",
+           "ScatterOp", "GatherOp",
            "AllGatherOp", "ReduceScatterOp",
            "mark_as_sequence_parallel_parameter",
            "register_sequence_parallel_allreduce_hooks",

@@ -193,3 +193,25 @@ def test_transforms_dtype_and_hwc():
     out = TF.normalize(hwc, [1, 1, 1], [2, 2, 2], data_format="HWC")
     assert out.shape == (4, 5, 3)
     np.testing.assert_allclose(out, 0.0)
+
+
+def test_top_level_lazy_submodules():
+    """`import paddle_tpu as paddle; paddle.distributed...` (the reference's
+    documented entry pattern) must resolve without a prior explicit
+    submodule import — PEP 562 lazy hook in paddle_tpu/__init__.py."""
+    import subprocess
+    import sys
+
+    code = (
+        "import paddle_tpu as paddle\n"
+        "assert paddle.distributed.fleet.DistributedStrategy() is not None\n"
+        "assert paddle.distributed.fleet.utils.recompute is not None\n"
+        "assert paddle.distributed.Shard is not None\n"
+        "assert paddle.incubate.asp is not None\n"
+        "assert paddle.hapi.Model is not None\n"
+        "print('lazy-ok')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=240,
+                         env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert "lazy-ok" in out.stdout, out.stderr[-2000:]
