@@ -1027,8 +1027,36 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     On TPU uses the Pallas flash-attention kernel
     (``paddle_tpu/ops/pallas/flash_attention.py``); elsewhere an XLA softmax
     attention that XLA fuses well.
+
+    ``dropout_p > 0`` (training) follows the reference's semantics —
+    dropout applies to the ATTENTION PROBABILITIES, severing random q-k
+    links — which requires the explicit [b, h, s, s] probs formulation
+    (the flash kernel has no in-kernel RNG); attention dropout therefore
+    trades the O(S) memory of the flash path for reference-exact
+    regularisation. Inference (or p=0) keeps the flash path.
     """
     from ...ops.pallas import flash_attention as fa
+
+    if dropout_p > 0.0 and training:
+        import math
+
+        from ...ops.manipulation import einsum, where
+
+        d = int(query.shape[-1])
+        logits = einsum("bqhd,bkhd->bhqk", query, key) * (1.0 / math.sqrt(d))
+        neg = to_tensor(np.asarray(-1e9, np.float32)).astype(logits.dtype)
+        if is_causal:
+            sq, sk = int(logits.shape[-2]), int(logits.shape[-1])
+            causal = np.tril(np.ones((sq, sk), bool), k=sk - sq)
+            logits = where(to_tensor(causal), logits, neg)
+        if attn_mask is not None:
+            if convert_dtype(attn_mask.dtype) == "bool":
+                logits = where(attn_mask, logits, neg)
+            else:
+                logits = logits + attn_mask.astype(logits.dtype)
+        probs = softmax(logits, axis=-1)
+        probs = dropout(probs, dropout_p, training=training)
+        return einsum("bhqk,bkhd->bqhd", probs, value)
 
     args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
 
@@ -1036,10 +1064,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         mask = rest[0] if rest else None
         return fa.dot_product_attention(q, k, v, mask=mask, is_causal=is_causal)
 
-    out = run_op("scaled_dot_product_attention", f, *args)
-    if dropout_p > 0.0 and training:
-        out = dropout(out, dropout_p, training=training)
-    return out
+    return run_op("scaled_dot_product_attention", f, *args)
 
 
 def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
@@ -1252,3 +1277,7 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
 
 from ...ops.dispatch import as_tensor_args  # noqa: E402
+
+# the flash-attention functional module (paddle.nn.functional.flash_attention
+# in the reference) — imported last so its lazy back-references resolve
+from . import flash_attention  # noqa: E402,F401

@@ -1,0 +1,110 @@
+"""``paddle.nn.functional.flash_attention`` — the reference's flash-attn
+functional module (``python/paddle/nn/functional/flash_attention.py``,
+wrapping the ``flash_attn``/``flash_attn_unpadded`` fused kernels of
+``paddle/phi/kernels/fusion``; SURVEY.md §2.1).
+
+TPU-native lowering: the dense path dispatches to the Pallas flash
+kernels (``paddle_tpu/ops/pallas/flash_attention.py``); the varlen
+(unpadded) path runs per-sequence segments through the same attention —
+segment boundaries come from ``cu_seqlens``, the reference's packed-batch
+convention.
+
+Layout: [batch, seq, num_heads, head_dim] (paddle flash_attn layout).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import run_op
+from ...ops.pallas import flash_attention as _fa
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, *, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None
+                    ) -> Tuple[Tensor, Optional[Tensor]]:
+    """Returns ``(out, softmax)``; ``softmax`` is only materialised when
+    ``return_softmax=True`` (the reference computes it for debugging only —
+    it defeats the O(S)-memory point of flash attention; the returned
+    probabilities are PRE-dropout). Dispatch (Pallas vs XLA, probs-level
+    attention dropout) is shared with ``scaled_dot_product_attention``."""
+    from . import scaled_dot_product_attention as _sdpa
+
+    out = _sdpa(query, key, value, dropout_p=dropout, is_causal=causal,
+                training=training)
+    softmax = None
+    if return_softmax:
+        def probs(q, k, v):
+            import jax
+            import jax.numpy as jnp
+
+            d = q.shape[-1]
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                                preferred_element_type=jnp.float32)
+            logits = logits / np.sqrt(d)
+            if causal:
+                sq, sk = logits.shape[-2], logits.shape[-1]
+                mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+                logits = jnp.where(mask, logits, -jnp.inf)
+            return jax.nn.softmax(logits, axis=-1)
+
+        softmax = run_op("flash_attention_softmax", probs, query, key, value)
+    return out, softmax
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False, *,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None) -> Tuple[Tensor, Optional[Tensor]]:
+    """Varlen (packed) flash attention. ``query``/``key``/``value`` are
+    [total_tokens, num_heads, head_dim]; ``cu_seqlens_*`` are the int32
+    [batch+1] cumulative boundaries of the packed sequences; ``scale`` is
+    the explicit softmax scale (the reference takes it rather than deriving
+    1/sqrt(d)).
+
+    Segments run independently through the dense attention path (each is
+    its own batch of 1) — the packed-batch equivalent of the reference's
+    varlen kernel. Boundaries must be host-known (they define shapes)."""
+    if return_softmax:
+        raise NotImplementedError(
+            "flash_attn_unpadded(return_softmax=True): the per-segment "
+            "softmax matrices are ragged; use the dense flash_attention "
+            "on one sequence at a time if you need them")
+    cq = np.asarray(cu_seqlens_q.numpy() if isinstance(cu_seqlens_q, Tensor)
+                    else cu_seqlens_q).astype(np.int64)
+    ck = np.asarray(cu_seqlens_k.numpy() if isinstance(cu_seqlens_k, Tensor)
+                    else cu_seqlens_k).astype(np.int64)
+    if len(cq) != len(ck):
+        raise ValueError("cu_seqlens_q and cu_seqlens_k disagree on batch")
+    if int(cq[-1]) != int(query.shape[0]) or int(ck[-1]) != int(key.shape[0]):
+        raise ValueError(
+            f"cu_seqlens must cover the packed tokens: cu_seqlens_q ends at "
+            f"{int(cq[-1])} but query has {int(query.shape[0])} tokens "
+            f"(key: {int(ck[-1])} vs {int(key.shape[0])})")
+
+    d = int(query.shape[-1])
+    # the shared dispatch applies 1/sqrt(d); pre-scaling q by scale*sqrt(d)
+    # yields the requested net scale
+    q_factor = float(scale) * float(np.sqrt(d))
+
+    from . import scaled_dot_product_attention as _sdpa
+    from ...ops import manipulation as _m
+
+    outs = []
+    for i in range(len(cq) - 1):
+        qs, qe = int(cq[i]), int(cq[i + 1])
+        ks, ke = int(ck[i]), int(ck[i + 1])
+        q_i = (query[qs:qe] * q_factor).unsqueeze(0)
+        k_i = key[ks:ke].unsqueeze(0)
+        v_i = value[ks:ke].unsqueeze(0)
+        outs.append(_sdpa(q_i, k_i, v_i, dropout_p=dropout,
+                          is_causal=causal, training=training).squeeze(0))
+    return _m.concat(outs, axis=0), None
+
+
+__all__ = ["flash_attention", "flash_attn_unpadded"]

@@ -19,7 +19,7 @@ __all__ = [
     "empty_like", "arange", "linspace", "logspace", "eye", "diag", "diagflat",
     "tril", "triu", "meshgrid", "rand", "randn", "randint", "randperm",
     "uniform", "normal", "standard_normal", "bernoulli", "multinomial",
-    "one_hot", "assign", "clone_",
+    "one_hot", "assign", "clone", "clone_",
 ]
 
 
@@ -224,6 +224,12 @@ def assign(x, output=None, name=None) -> Tensor:
     if output is not None:
         return output._inplace_set(val)
     return to_tensor(val)
+
+
+def clone(x: Tensor, name=None) -> Tensor:
+    """Differentiable copy (reference: ``paddle.clone`` /
+    ``python/paddle/tensor/creation.py``)."""
+    return x.clone()
 
 
 def clone_(x: Tensor) -> Tensor:

@@ -18,7 +18,8 @@ from .dispatch import run_op
 from .registry import register_op
 
 __all__ = [
-    "reshape", "reshape_", "flatten", "transpose", "moveaxis", "swapaxes",
+    "reshape", "reshape_", "flatten", "unflatten", "transpose", "moveaxis",
+    "swapaxes",
     "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "concat", "stack",
     "split", "chunk", "unbind", "tile", "expand", "expand_as", "broadcast_to",
     "broadcast_tensors", "flip", "rot90", "roll", "gather", "gather_nd",
@@ -72,6 +73,21 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
         return jnp.reshape(a, new_shape)
 
     return run_op("flatten", f, x)
+
+
+@register_op()
+def unflatten(x, axis, shape, name=None):
+    """Expand ``axis`` into ``shape`` (reference: ``paddle.unflatten``,
+    ``python/paddle/tensor/manipulation.py``). One entry of ``shape`` may
+    be -1 (inferred)."""
+    shape = tuple(int(s._value) if isinstance(s, Tensor) else int(s)
+                  for s in shape)
+
+    def f(a):
+        ax = axis if axis >= 0 else axis + a.ndim
+        return jnp.reshape(a, a.shape[:ax] + shape + a.shape[ax + 1:])
+
+    return run_op("unflatten", f, x)
 
 
 @register_op()

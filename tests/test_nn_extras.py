@@ -158,3 +158,45 @@ def test_pixel_unshuffle_nhwc():
     out = F.pixel_unshuffle(_t(x), 2, data_format="NHWC").numpy()
     want = F.pixel_unshuffle(_t(x.transpose(0, 3, 1, 2)), 2).numpy()
     np.testing.assert_allclose(out.transpose(0, 3, 1, 2), want)
+
+
+def test_clone_unflatten():
+    import numpy as np
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 12))
+    x.stop_gradient = False
+    y = paddle.clone(x)
+    u = paddle.unflatten(x, 1, [3, 4])
+    assert y.shape == [2, 12] and u.shape == [2, 3, 4]
+    (paddle.sum(u * 2.0) + paddle.sum(y)).backward()
+    assert np.allclose(x.grad.numpy(), 3.0)
+
+
+def test_functional_flash_attention_module():
+    """paddle.nn.functional.flash_attention mirrors the reference module:
+    (out, softmax) tuple, causal flag, varlen via cu_seqlens."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.functional import flash_attention as FA
+    from paddle_tpu.ops.pallas.flash_attention import _xla_attention
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    q = paddle.to_tensor(rng.randn(2, 16, 4, 8).astype(np.float32))
+    out, sm = FA.flash_attention(q, q, q, causal=True, return_softmax=True)
+    assert out.shape == [2, 16, 4, 8] and sm.shape == [2, 4, 16, 16]
+    ref = _xla_attention(jnp.asarray(q.numpy()), jnp.asarray(q.numpy()),
+                         jnp.asarray(q.numpy()), is_causal=True)
+    assert np.allclose(out.numpy(), np.asarray(ref), atol=1e-5)
+
+    total = paddle.to_tensor(rng.randn(10, 4, 8).astype(np.float32))
+    cu = np.array([0, 4, 10], np.int32)
+    out2, _ = FA.flash_attn_unpadded(total, total, total, cu, cu, 6, 6,
+                                     scale=1 / np.sqrt(8), causal=True)
+    seg0 = _xla_attention(jnp.asarray(total.numpy()[None, :4]),
+                          jnp.asarray(total.numpy()[None, :4]),
+                          jnp.asarray(total.numpy()[None, :4]),
+                          is_causal=True)
+    assert out2.shape == [10, 4, 8]
+    assert np.allclose(out2.numpy()[:4], np.asarray(seg0)[0], atol=1e-5)
