@@ -40,9 +40,11 @@ from .core.dtype import (
     complex64,
     complex128,
     dtype,
+    finfo,
     float16,
     float32,
     float64,
+    iinfo,
     int8,
     int16,
     int32,

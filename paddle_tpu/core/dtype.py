@@ -13,6 +13,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = [
+    "iinfo",
+    "finfo",
     "dtype",
     "float16",
     "bfloat16",
@@ -104,3 +106,37 @@ def is_differentiable_dtype(dt: Any) -> bool:
 
 def is_integer_dtype(dt: Any) -> bool:
     return jnp.issubdtype(jnp.dtype(dt), jnp.integer)
+
+
+class iinfo:
+    """Integer dtype info (reference: ``paddle.iinfo``)."""
+
+    def __init__(self, dtype):
+        info = np.iinfo(np.dtype(convert_dtype(dtype)))
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = str(info.dtype)
+
+
+class finfo:
+    """Floating dtype info incl. bfloat16 (reference: ``paddle.finfo``)."""
+
+    def __init__(self, dtype):
+        dt = convert_dtype(dtype)
+        npdt = np.dtype(dt)
+        try:
+            info = np.finfo(npdt)
+        except (TypeError, ValueError):  # bfloat16 etc.: numpy can't
+            import ml_dtypes
+
+            info = ml_dtypes.finfo(npdt)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(getattr(info, "smallest_normal", getattr(info, "tiny", 0.0)))
+        self.smallest_normal = self.tiny
+        self.resolution = float(getattr(info, "resolution", self.eps))
+        self.bits = int(info.bits)
+        self.dtype = str(npdt)
+

@@ -192,6 +192,21 @@ class Tensor:
         t = Tensor(self._value, stop_gradient=True, name=self.name + ".detach")
         return t
 
+    def element_size(self) -> int:
+        """Bytes per element (reference: ``Tensor.element_size``)."""
+        return int(jnp.dtype(self._value.dtype).itemsize)
+
+    def pin_memory(self) -> "Tensor":
+        """API parity: XLA manages host staging buffers itself."""
+        return self
+
+    def contiguous(self) -> "Tensor":
+        """API parity: jax.Arrays are always dense/contiguous."""
+        return self
+
+    def is_contiguous(self) -> bool:
+        return True
+
     def clone(self) -> "Tensor":
         from ..ops.dispatch import run_op
 

@@ -27,6 +27,7 @@ __all__ = [
     "set_device", "get_device", "get_all_devices", "device_count",
     "synchronize", "max_memory_allocated", "max_memory_reserved",
     "memory_allocated", "memory_reserved", "empty_cache", "tpu", "cuda",
+    "Stream", "Event", "current_stream", "stream_guard",
 ]
 
 
@@ -114,6 +115,79 @@ class _DeviceNamespace:
 
     def empty_cache(self) -> None:
         empty_cache()
+
+
+class Stream:
+    """API-parity stream object (reference: ``paddle.device.Stream`` over
+    CUDA streams). XLA/PJRT schedules asynchronously on internal streams
+    the user cannot target, so ordering is already program order:
+    ``wait_event``/``wait_stream`` are no-ops, ``synchronize`` drains the
+    device, and ``query`` reports completion by draining first."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def wait_event(self, event) -> None:
+        pass
+
+    def wait_stream(self, stream) -> None:
+        pass
+
+    def synchronize(self) -> None:
+        synchronize(self.device)
+
+    def query(self) -> bool:
+        synchronize(self.device)
+        return True
+
+
+class Event:
+    """API-parity event (reference: ``paddle.device.Event``). Recording is
+    an async no-op under XLA's in-order dispatch; ``synchronize`` drains."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self.device = device
+        self._recorded = False
+
+    def record(self, stream=None) -> None:
+        self._recorded = True
+        self._stream = stream
+
+    def query(self) -> bool:
+        if self._recorded:
+            synchronize(self.device)
+        return True
+
+    def synchronize(self) -> None:
+        if self._recorded:
+            synchronize(self.device)
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    return _current_stream
+
+
+class stream_guard:
+    """Context manager for API parity with ``paddle.device.stream_guard``;
+    under XLA there is one implicit in-order stream."""
+
+    def __init__(self, stream: Stream):
+        self._stream = stream
+
+    def __enter__(self):
+        return self._stream
+
+    def __exit__(self, *exc):
+        return False
 
 
 tpu = _DeviceNamespace("tpu")

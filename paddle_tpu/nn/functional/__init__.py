@@ -1038,25 +1038,36 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     from ...ops.pallas import flash_attention as fa
 
     if dropout_p > 0.0 and training:
-        import math
+        # mirrors _xla_attention's numerics (fp32-accumulated matmuls,
+        # on-device causal mask) with the dropout slotted between the
+        # softmax and the value matmul
+        def probs_f(q, k, *rest):
+            d = q.shape[-1]
+            logits = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k,
+                preferred_element_type=jnp.float32) * (1.0 / np.sqrt(d))
+            if is_causal:
+                sq, sk = logits.shape[-2], logits.shape[-1]
+                causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+                logits = jnp.where(causal, logits, -jnp.inf)
+            if rest:
+                m = rest[0]
+                if m.dtype == jnp.bool_:
+                    logits = jnp.where(m, logits, -jnp.inf)
+                else:
+                    logits = logits + m.astype(logits.dtype)
+            return jax.nn.softmax(logits, axis=-1)
 
-        from ...ops.manipulation import einsum, where
-
-        d = int(query.shape[-1])
-        logits = einsum("bqhd,bkhd->bhqk", query, key) * (1.0 / math.sqrt(d))
-        neg = to_tensor(np.asarray(-1e9, np.float32)).astype(logits.dtype)
-        if is_causal:
-            sq, sk = int(logits.shape[-2]), int(logits.shape[-1])
-            causal = np.tril(np.ones((sq, sk), bool), k=sk - sq)
-            logits = where(to_tensor(causal), logits, neg)
-        if attn_mask is not None:
-            if convert_dtype(attn_mask.dtype) == "bool":
-                logits = where(attn_mask, logits, neg)
-            else:
-                logits = logits + attn_mask.astype(logits.dtype)
-        probs = softmax(logits, axis=-1)
+        mask_args = [attn_mask] if attn_mask is not None else []
+        probs = run_op("sdpa_probs", probs_f, query, key, *mask_args)
         probs = dropout(probs, dropout_p, training=training)
-        return einsum("bhqk,bkhd->bqhd", probs, value)
+
+        def out_f(p, v):
+            return jnp.einsum(
+                "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                preferred_element_type=jnp.float32).astype(v.dtype)
+
+        return run_op("sdpa_out", out_f, probs, value)
 
     args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
 

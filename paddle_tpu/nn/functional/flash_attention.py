@@ -20,7 +20,6 @@ import numpy as np
 
 from ...core.tensor import Tensor
 from ...ops.dispatch import run_op
-from ...ops.pallas import flash_attention as _fa
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
@@ -86,6 +85,11 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
             f"cu_seqlens must cover the packed tokens: cu_seqlens_q ends at "
             f"{int(cq[-1])} but query has {int(query.shape[0])} tokens "
             f"(key: {int(ck[-1])} vs {int(key.shape[0])})")
+    for name_, arr in (("cu_seqlens_q", cq), ("cu_seqlens_k", ck)):
+        if int(arr[0]) != 0 or np.any(np.diff(arr) < 0):
+            raise ValueError(
+                f"{name_} must start at 0 and be non-decreasing, got "
+                f"{arr.tolist()}")
 
     d = int(query.shape[-1])
     # the shared dispatch applies 1/sqrt(d); pre-scaling q by scale*sqrt(d)

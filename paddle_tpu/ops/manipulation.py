@@ -11,6 +11,7 @@ from typing import List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor, to_tensor
 from ..enforce import InvalidArgumentError
@@ -19,7 +20,7 @@ from .registry import register_op
 
 __all__ = [
     "reshape", "reshape_", "flatten", "unflatten", "transpose", "moveaxis",
-    "swapaxes",
+    "swapaxes", "numel", "rank",
     "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "concat", "stack",
     "split", "chunk", "unbind", "tile", "expand", "expand_as", "broadcast_to",
     "broadcast_tensors", "flip", "rot90", "roll", "gather", "gather_nd",
@@ -73,6 +74,21 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
         return jnp.reshape(a, new_shape)
 
     return run_op("flatten", f, x)
+
+
+def numel(x, name=None):
+    """0-D integer tensor holding the element count (reference:
+    ``paddle.numel``; int64 there — here the widest enabled int, since
+    x64 is off by default under jax)."""
+    n = x.size if isinstance(x, Tensor) else jnp.asarray(x).size
+    return to_tensor(np.asarray(n, np.int64))
+
+
+def rank(x, name=None):
+    """0-D int32 tensor holding the number of dimensions (reference:
+    ``paddle.rank``)."""
+    nd = x.ndim if isinstance(x, Tensor) else jnp.asarray(x).ndim
+    return to_tensor(jnp.asarray(int(nd), jnp.int32))
 
 
 @register_op()

@@ -1,11 +1,11 @@
 """``paddle.optimizer`` surface."""
 
 from . import lr
-from .adam import Adam, AdamW, Adamax, Lamb, NAdam, RAdam
+from .adam import Adam, AdamW, Adamax, Lamb, Lion, NAdam, RAdam
 from .lbfgs import LBFGS
 from .optimizer import SGD, Adadelta, Adagrad, Momentum, Optimizer, RMSProp
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Lamb", "Adagrad",
-    "Adadelta", "RMSProp", "Adamax", "NAdam", "RAdam", "LBFGS", "lr",
+    "Adadelta", "RMSProp", "Adamax", "NAdam", "RAdam", "Lion", "LBFGS", "lr",
 ]

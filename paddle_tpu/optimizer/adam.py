@@ -9,7 +9,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from .optimizer import Optimizer
 
-__all__ = ["Adam", "AdamW", "Lamb", "Adamax", "NAdam", "RAdam"]
+__all__ = ["Adam", "AdamW", "Lamb", "Adamax", "NAdam", "RAdam", "Lion"]
 
 
 class Adam(Optimizer):
@@ -142,6 +142,46 @@ class Lamb(Optimizer):
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         new_pf = pf - lr * trust * r
         new_state = {"moment1": m, "moment2": v}
+        if self._multi_precision:
+            new_state["master"] = new_pf
+        return new_pf.astype(p.dtype), new_state
+
+
+class Lion(Optimizer):
+    """Sign-momentum optimizer (EvoLved Sign Momentum; used across the
+    reference ecosystem for memory-lean pretraining — one moment instead of
+    Adam's two). Decoupled weight decay, AdamW-style."""
+
+    def __init__(self, learning_rate=1e-4, beta1=0.9, beta2=0.99,
+                 parameters=None, weight_decay=0.0, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._wd = float(weight_decay or 0.0)
+        self._multi_precision = multi_precision
+
+    def _state_names(self):
+        if self._multi_precision:
+            return ["moment", "master"]
+        return ["moment"]
+
+    def _init_state(self, p):
+        dt = jnp.float32 if self._multi_precision else p._value.dtype
+        st = {"moment": jnp.zeros(p._value.shape, dt)}
+        if self._multi_precision:
+            st["master"] = p._value.astype(jnp.float32)
+        return st
+
+    def _update_one(self, p, g, state, lr, step, extras=None):
+        b1, b2 = self._beta1, self._beta2
+        m = state["moment"]
+        gf = g.astype(m.dtype)
+        update = jnp.sign(b1 * m + (1 - b1) * gf)
+        m_new = b2 * m + (1 - b2) * gf
+        pf = state["master"] if self._multi_precision else p
+        new_pf = pf - lr * (update.astype(pf.dtype) + self._wd * pf)
+        new_state = {"moment": m_new}
         if self._multi_precision:
             new_state["master"] = new_pf
         return new_pf.astype(p.dtype), new_state

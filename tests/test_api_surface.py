@@ -215,3 +215,43 @@ def test_top_level_lazy_submodules():
                          text=True, timeout=240,
                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert "lazy-ok" in out.stdout, out.stderr[-2000:]
+
+
+def test_small_api_gaps():
+    """Lion, device Stream/Event, numel/rank, iinfo/finfo, tensor
+    pin_memory/element_size/contiguous — reference parity fillers."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    fi = paddle.finfo(paddle.bfloat16)
+    assert fi.bits == 16 and abs(fi.eps - 0.0078125) < 1e-9
+    ii = paddle.iinfo("int32")
+    assert ii.min == -(2**31) and ii.max == 2**31 - 1
+
+    t = paddle.to_tensor(np.ones((2, 3), np.float32))
+    assert int(paddle.numel(t)) == 6 and int(paddle.rank(t)) == 2
+    assert t.element_size() == 4
+    assert t.pin_memory() is t and t.contiguous() is t and t.is_contiguous()
+
+    s = paddle.device.Stream()
+    ev = s.record_event()
+    ev.synchronize()
+    assert s.query() and ev.query()
+    with paddle.device.stream_guard(s):
+        pass
+    assert paddle.device.current_stream() is not None
+
+    w = paddle.to_tensor(np.random.RandomState(0).randn(4, 4).astype(np.float32))
+    w.stop_gradient = False
+    opt = paddle.optimizer.Lion(learning_rate=0.01, parameters=[w],
+                                weight_decay=0.01)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(8, 4).astype(np.float32))
+    prev = None
+    for _ in range(5):
+        loss = paddle.mean((paddle.matmul(x, w) - 1.0) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        cur = float(loss)
+        assert prev is None or cur < prev + 1e-3
+        prev = cur
