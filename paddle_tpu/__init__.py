@@ -150,3 +150,53 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
 
     return _grad(outputs, inputs, grad_outputs, retain_graph, create_graph,
                  only_inputs, allow_unused)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor print formatting (reference: ``paddle.set_printoptions``) —
+    tensors render through numpy, so this maps onto numpy printoptions."""
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+    _np.set_printoptions(**kw)
+
+
+class LazyGuard:
+    """API parity with the reference's deferred-parameter-init guard.
+    Initialisation here is eager numpy on host (cheap) and device buffers
+    only materialise on first use, so the guard has nothing to defer; it
+    exists so reference scripts run unchanged."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Old-style reader decorator (reference: ``paddle.batch``): wraps an
+    item-yielding reader into a batch-yielding one."""
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched

@@ -206,3 +206,19 @@ class GradScaler:
         self._scale = state.get("scale", self._scale)
         self._good_steps = state.get("good_steps", 0)
         self._bad_steps = state.get("bad_steps", 0)
+
+
+def is_bfloat16_supported(place=None) -> bool:
+    """bf16 is the TPU-native compute type; XLA's CPU backend emulates it
+    for the test mesh (reference: ``paddle.amp.is_bfloat16_supported``)."""
+    return True
+
+
+def is_float16_supported(place=None) -> bool:
+    """fp16 compute lowers through XLA on every backend here; bf16 is still
+    the recommended mixed-precision dtype on TPU (wider exponent — no loss
+    scaling needed)."""
+    return True
+
+
+__all__ += ["is_bfloat16_supported", "is_float16_supported"]

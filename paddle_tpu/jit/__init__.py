@@ -176,6 +176,8 @@ class TracedProgram:
     def __call__(self, *args, **kwargs):
         from ..framework.random import next_key
 
+        if not _to_static_enabled:  # jit.enable_to_static(False): run eager
+            return self._orig_fn(*args, **kwargs)
         params, buffers, layer = _collect_state(self._orig_fn)
         tensor_args, arg_tree, rest_args, rest_kwargs = _split_args(args, kwargs)
         pure, out_store = self._make_pure(params, buffers, tensor_args,
@@ -351,7 +353,27 @@ def save(layer, path, input_spec=None, **configs):
                 outs = out if isinstance(out, (list, tuple)) else [out]
                 return tuple(o._value for o in outs)
 
-            specs = [jax.ShapeDtypeStruct(tuple(s.shape), s.dtype) for s in input_spec]
+            # InputSpec dims of None/-1 (dynamic batch etc.) become
+            # jax.export symbolic dimensions in ONE shared scope. A None at
+            # axis j is named dyn{j} — the same name across specs, so the
+            # batch dims of multiple inputs unify (a+b etc. stays
+            # broadcastable). For independently-varying extents, put a
+            # STRING in the InputSpec shape (e.g. ["qlen", 16] vs
+            # ["klen", 16]) and equal strings unify, distinct ones don't.
+            scope = None
+            specs = []
+            for s in input_spec:
+                dims = tuple(s.shape)
+                if any(not isinstance(d, int) or d == -1 for d in dims):
+                    if scope is None:
+                        scope = jexport.SymbolicScope()
+                    shape_str = ", ".join(
+                        d if isinstance(d, str)
+                        else (str(d) if d is not None and d != -1
+                              else f"dyn{j}")
+                        for j, d in enumerate(dims))
+                    dims = jexport.symbolic_shape(shape_str, scope=scope)
+                specs.append(jax.ShapeDtypeStruct(dims, s.dtype))
             pv = [p._value for p in params]
             bv = [b._value for b in buffers]
             exported = jexport.export(jax.jit(pure))(
@@ -570,3 +592,14 @@ def fused_train_step(loss_fn=None, optimizer=None, model=None,
                      has_aux=False):
     """Build a one-dispatch-per-step compiled training function."""
     return FusedTrainStep(loss_fn, optimizer, model, has_aux=has_aux)
+
+
+_to_static_enabled = True
+
+
+def enable_to_static(enable: bool = True) -> None:
+    """Globally toggle ``@to_static`` compilation (reference:
+    ``paddle.jit.enable_to_static``) — with it off, decorated functions run
+    eagerly (debugging aid)."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)

@@ -1038,36 +1038,17 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     from ...ops.pallas import flash_attention as fa
 
     if dropout_p > 0.0 and training:
-        # mirrors _xla_attention's numerics (fp32-accumulated matmuls,
-        # on-device causal mask) with the dropout slotted between the
-        # softmax and the value matmul
+        # same numerics as _xla_attention (shared attention_probs/apply
+        # helpers) with the dropout slotted between softmax and the value
+        # matmul — the reference's probs-level attention dropout
         def probs_f(q, k, *rest):
-            d = q.shape[-1]
-            logits = jnp.einsum(
-                "bqhd,bkhd->bhqk", q, k,
-                preferred_element_type=jnp.float32) * (1.0 / np.sqrt(d))
-            if is_causal:
-                sq, sk = logits.shape[-2], logits.shape[-1]
-                causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-                logits = jnp.where(causal, logits, -jnp.inf)
-            if rest:
-                m = rest[0]
-                if m.dtype == jnp.bool_:
-                    logits = jnp.where(m, logits, -jnp.inf)
-                else:
-                    logits = logits + m.astype(logits.dtype)
-            return jax.nn.softmax(logits, axis=-1)
+            return fa.attention_probs(q, k, mask=rest[0] if rest else None,
+                                      is_causal=is_causal)
 
         mask_args = [attn_mask] if attn_mask is not None else []
         probs = run_op("sdpa_probs", probs_f, query, key, *mask_args)
         probs = dropout(probs, dropout_p, training=training)
-
-        def out_f(p, v):
-            return jnp.einsum(
-                "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
-                preferred_element_type=jnp.float32).astype(v.dtype)
-
-        return run_op("sdpa_out", out_f, probs, value)
+        return run_op("sdpa_out", fa.attention_apply, probs, value)
 
     args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
 

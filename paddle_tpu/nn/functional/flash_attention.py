@@ -37,21 +37,12 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
                 training=training)
     softmax = None
     if return_softmax:
-        def probs(q, k, v):
-            import jax
-            import jax.numpy as jnp
+        from ...ops.pallas.flash_attention import attention_probs
 
-            d = q.shape[-1]
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                                preferred_element_type=jnp.float32)
-            logits = logits / np.sqrt(d)
-            if causal:
-                sq, sk = logits.shape[-2], logits.shape[-1]
-                mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-                logits = jnp.where(mask, logits, -jnp.inf)
-            return jax.nn.softmax(logits, axis=-1)
-
-        softmax = run_op("flash_attention_softmax", probs, query, key, value)
+        softmax = run_op(
+            "flash_attention_softmax",
+            lambda q, k: attention_probs(q, k, is_causal=causal),
+            query, key)
     return out, softmax
 
 

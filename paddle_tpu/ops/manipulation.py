@@ -80,7 +80,7 @@ def numel(x, name=None):
     """0-D integer tensor holding the element count (reference:
     ``paddle.numel``; int64 there — here the widest enabled int, since
     x64 is off by default under jax)."""
-    n = x.size if isinstance(x, Tensor) else jnp.asarray(x).size
+    n = x.size if isinstance(x, Tensor) else np.asarray(x).size
     return to_tensor(np.asarray(n, np.int64))
 
 

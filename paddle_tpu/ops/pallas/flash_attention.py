@@ -32,12 +32,11 @@ def _on_tpu() -> bool:
         return False
 
 
-def _xla_attention(q, k, v, mask=None, is_causal=False, scale=None):
-    # q,k,v: [B, S, H, D] -> scores over S. Matmuls keep the input dtype
-    # (bf16 on TPU) with fp32 ACCUMULATION via preferred_element_type — the
-    # MXU's native mode; casting inputs to fp32 first would run the matmul
-    # at 1/8 MXU rate (this path is also the flash-VJP's recompute, so it
-    # sets the backward-pass speed).
+def attention_probs(q, k, mask=None, is_causal=False, scale=None):
+    """Masked softmax attention probabilities [B, H, Sq, Sk] — the ONE
+    implementation of the fp32-accumulated logits + causal/additive-mask +
+    softmax block (shared by `_xla_attention`, the probs-level-dropout SDPA
+    path, and `flash_attention(return_softmax=True)`). q/k: [B, S, H, D]."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -51,10 +50,25 @@ def _xla_attention(q, k, v, mask=None, is_causal=False, scale=None):
             logits = jnp.where(mask, logits, -jnp.inf)
         else:
             logits = logits + mask.astype(logits.dtype)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v,
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def attention_apply(probs, v):
+    """probs [B, H, Sq, Sk] @ v [B, Sk, H, D] -> [B, Sq, H, D], fp32
+    accumulation, output in v's dtype."""
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
+    return out.astype(v.dtype)
+
+
+def _xla_attention(q, k, v, mask=None, is_causal=False, scale=None):
+    # q,k,v: [B, S, H, D] -> scores over S. Matmuls keep the input dtype
+    # (bf16 on TPU) with fp32 ACCUMULATION via preferred_element_type — the
+    # MXU's native mode; casting inputs to fp32 first would run the matmul
+    # at 1/8 MXU rate (this path is also the flash-VJP's recompute, so it
+    # sets the backward-pass speed).
+    probs = attention_probs(q, k, mask=mask, is_causal=is_causal, scale=scale)
+    return attention_apply(probs, v).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -192,3 +192,75 @@ def test_int8_ptq_model_through_predictor(tmp_path):
     # and the int8 path stays close to the fp32 reference
     rel = np.abs(out - ref).mean() / (np.abs(ref).mean() + 1e-9)
     assert rel < 0.05, rel
+
+
+def test_jit_save_dynamic_batch_predictor(tmp_path):
+    """InputSpec dims of None export as jax.export symbolic dimensions —
+    one saved program serves every batch size (the reference's dynamic
+    first-dim .pdmodel convention)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, nn
+    from paddle_tpu.static import InputSpec
+
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    net.eval()
+    prefix = str(tmp_path / "dyn")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([None, 16], "float32")])
+    cfg = inference.Config(prefix + ".pdmodel", prefix + ".pdiparams")
+    pred = inference.create_predictor(cfg)
+    for bs in (8, 3):
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        x = np.random.RandomState(bs).rand(bs, 16).astype(np.float32)
+        h.copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        ref = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_jit_save_multi_input_dynamic_and_string_dims(tmp_path):
+    """None dims at the same axis position unify across input specs
+    (a+b broadcasting survives export); string dims name independent
+    symbolic extents; jit.enable_to_static(False) runs eagerly."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.static import InputSpec
+
+    class Add(nn.Layer):
+        def forward(self, a, b):
+            return a + b
+
+    prefix = str(tmp_path / "add")
+    paddle.jit.save(Add(), prefix,
+                    input_spec=[InputSpec([None, 16]), InputSpec([None, 16])])
+    m = paddle.jit.load(prefix)
+    x = np.random.rand(5, 16).astype(np.float32)
+    np.testing.assert_allclose(
+        m(paddle.to_tensor(x), paddle.to_tensor(x)).numpy(), 2 * x)
+
+    class Cat(nn.Layer):
+        def forward(self, a, b):
+            return paddle.concat([a, b], axis=0)
+
+    prefix2 = str(tmp_path / "cat")
+    paddle.jit.save(Cat(), prefix2,
+                    input_spec=[InputSpec(["qlen", 8]), InputSpec(["klen", 8])])
+    m2 = paddle.jit.load(prefix2)
+    out = m2(paddle.to_tensor(np.ones((3, 8), np.float32)),
+             paddle.to_tensor(np.ones((5, 8), np.float32)))
+    assert out.shape == [8, 8]
+
+    @paddle.jit.to_static
+    def f(x):
+        return x * 2
+
+    t = paddle.to_tensor(np.ones(3, np.float32))
+    f(t)
+    paddle.jit.enable_to_static(False)
+    try:
+        np.testing.assert_allclose(f(t).numpy(), 2.0)
+    finally:
+        paddle.jit.enable_to_static(True)
