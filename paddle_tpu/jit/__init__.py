@@ -29,7 +29,9 @@ from ..nn.layer.layers import Layer
 from ..ops.dispatch import run_op
 from ..static import InputSpec
 
-__all__ = ["to_static", "TracedProgram", "save", "load", "ignore_module", "not_to_static", "is_tracing", "fused_train_step", "FusedTrainStep"]
+__all__ = ["to_static", "enable_to_static", "TracedProgram", "save", "load",
+           "ignore_module", "not_to_static", "is_tracing",
+           "fused_train_step", "FusedTrainStep"]
 
 _TRACING = [False]
 
@@ -295,16 +297,16 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             # framework plumbing) and trace through the normal call path;
             # TracedProgram gets full_graph=False so it won't re-transform
             # Layer.__call__ itself
+            orig_fwd = type(fn).forward
             if full_graph:
                 from .dy2static import convert_to_static
 
-                fwd = type(fn).forward
-                conv = convert_to_static(fwd)
-                if conv is not fwd:
+                conv = convert_to_static(orig_fwd)
+                if conv is not orig_fwd:
                     object.__setattr__(fn, "forward",
                                        conv.__get__(fn, type(fn)))
             traced = TracedProgram(fn.__call__, input_spec, full_graph=False)
-            return _TracedLayerProxy(fn, traced)
+            return _TracedLayerProxy(fn, traced, orig_forward=orig_fwd)
         return TracedProgram(fn, input_spec, full_graph=full_graph)
 
     if function is not None:
@@ -315,11 +317,24 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 class _TracedLayerProxy:
     """Layer-like proxy whose __call__ runs the compiled program."""
 
-    def __init__(self, layer: Layer, traced: TracedProgram):
+    def __init__(self, layer: Layer, traced: TracedProgram,
+                 orig_forward=None):
         self._layer = layer
         self._traced = traced
+        self._orig_forward = orig_forward
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled and self._orig_forward is not None:
+            # enable_to_static(False): run the ORIGINAL dygraph forward
+            # (to_static replaced it with the dy2static-converted one)
+            cur = self._layer.forward
+            object.__setattr__(
+                self._layer, "forward",
+                self._orig_forward.__get__(self._layer, type(self._layer)))
+            try:
+                return self._layer(*args, **kwargs)
+            finally:
+                object.__setattr__(self._layer, "forward", cur)
         return self._traced(*args, **kwargs)
 
     def __getattr__(self, name):

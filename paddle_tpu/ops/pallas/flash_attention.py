@@ -53,12 +53,15 @@ def attention_probs(q, k, mask=None, is_causal=False, scale=None):
     return jax.nn.softmax(logits, axis=-1)
 
 
-def attention_apply(probs, v):
+def attention_apply(probs, v, dtype=None):
     """probs [B, H, Sq, Sk] @ v [B, Sk, H, D] -> [B, Sq, H, D], fp32
-    accumulation, output in v's dtype."""
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+    accumulation. ``dtype`` is the compute/output dtype — pass q's dtype
+    when it differs from v's (the probs round to it before the matmul, as
+    the pre-refactor `_xla_attention` did)."""
+    dtype = dtype or v.dtype
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(dtype), v,
                      preferred_element_type=jnp.float32)
-    return out.astype(v.dtype)
+    return out.astype(dtype)
 
 
 def _xla_attention(q, k, v, mask=None, is_causal=False, scale=None):
@@ -68,7 +71,7 @@ def _xla_attention(q, k, v, mask=None, is_causal=False, scale=None):
     # at 1/8 MXU rate (this path is also the flash-VJP's recompute, so it
     # sets the backward-pass speed).
     probs = attention_probs(q, k, mask=mask, is_causal=is_causal, scale=scale)
-    return attention_apply(probs, v).astype(q.dtype)
+    return attention_apply(probs, v, dtype=q.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -35,10 +35,13 @@ def _chunk_attention(q, k, v, scale, q_offset, k_offset, is_causal):
     Returns (acc, m, l): fp32 weighted values, running max, running sum —
     the online-softmax partial state. Offsets are *global* sequence
     positions of element 0 of q / k, used for causal masking across chunks.
+
+    Matmuls keep the input dtype (bf16 on TPU) with fp32 ACCUMULATION via
+    ``preferred_element_type`` — full MXU rate; casting inputs to fp32
+    first would run them at 1/8 rate (same rule as the flash kernels).
     """
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if is_causal:
         sq, sk = q.shape[1], k.shape[1]
         q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
@@ -49,8 +52,9 @@ def _chunk_attention(q, k, v, scale, q_offset, k_offset, is_causal):
     p = jnp.exp(s - m_safe[..., None])
     p = jnp.where(jnp.isfinite(s), p, 0.0)
     l = jnp.sum(p, axis=-1)  # [B, H, Sq]
-    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
-    return acc, m, l
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return acc.astype(jnp.float32), m, l
 
 
 def _merge(acc, m, l, acc2, m2, l2):
@@ -83,8 +87,24 @@ def ring_attention(q, k, v, axis_name: str = "sep", is_causal: bool = False,
         acc, m, l, k_cur, v_cur = carry
         # chunk i currently held came from rank (idx - i) mod n
         src = jax.lax.rem(idx - i + n, n)
-        acc2, m2, l2 = _chunk_attention(
-            q, k_cur, v_cur, scale, q_offset, src * s_local, is_causal)
+
+        def do_chunk(_):
+            return _chunk_attention(
+                q, k_cur, v_cur, scale, q_offset, src * s_local, is_causal)
+
+        if is_causal:
+            # causal load shape: chunks strictly after this rank's rows are
+            # FULLY masked — skip their matmuls (the reference's causal
+            # ring skips them the same way); the -inf partial merges as a
+            # no-op
+            def skip(_):
+                return (jnp.zeros((b, h, s_local, d), jnp.float32),
+                        jnp.full((b, h, s_local), -jnp.inf, jnp.float32),
+                        jnp.zeros((b, h, s_local), jnp.float32))
+
+            acc2, m2, l2 = jax.lax.cond(src <= idx, do_chunk, skip, None)
+        else:
+            acc2, m2, l2 = do_chunk(None)
         acc, m, l = _merge(acc, m, l, acc2, m2, l2)
         # pass K/V along the ring (skippable on the last step, but keeping
         # it unconditional lets XLA pipeline the permute under the compute)
