@@ -193,19 +193,22 @@ def _rms_norm(x, w, eps):
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w.astype(x.dtype)
 
 
-def _rope(x, theta):
-    # x: [B, S, H, D]; LLaMA rotate-half convention: the head dim splits
-    # into two contiguous halves (lane-aligned slices on TPU — the strided
-    # ::2 interleave costs extra vector shuffles every layer and again in
-    # every remat replay)
+def _rope_at(x, theta, positions):
+    # x: [B, S, H, D] at absolute ``positions`` [S]; LLaMA rotate-half
+    # convention: the head dim splits into two contiguous halves
+    # (lane-aligned slices on TPU — the strided ::2 interleave costs extra
+    # vector shuffles every layer and again in every remat replay)
     b, s, h, d = x.shape
-    pos = jnp.arange(s, dtype=jnp.float32)
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    ang = pos[:, None] * freqs[None, :]              # [S, D/2]
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [S, D/2]
     cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
     sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
     x1, x2 = x[..., : d // 2], x[..., d // 2:]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+
+
+def _rope(x, theta):
+    return _rope_at(x, theta, jnp.arange(x.shape[1]))
 
 
 def _act_spec(cfg: LlamaConfig) -> P:
@@ -214,15 +217,27 @@ def _act_spec(cfg: LlamaConfig) -> P:
     return P(("dp", "sharding"), seq, None)
 
 
-def _layer_qkv(cfg: LlamaConfig, x, lp):
-    """Pre-attention half of a block: rms → qkv projections → rope → GQA."""
+def _qkv_proj(cfg: LlamaConfig, x, lp, positions=None):
+    """rms → q/k/v projections → rope at ``positions`` (default 0..S-1).
+    Returns q [B,S,nH,D] and UNREPEATED k/v [B,S,Hkv,D] — the single
+    source of the attention input convention for both training and the
+    KV-cache decode path."""
     B, S, H = x.shape
     dt = x.dtype
+    if positions is None:
+        positions = jnp.arange(S)
     h = _rms_norm(x, lp["ln_attn"], cfg.rms_eps)
     q = (h @ lp["wq"].astype(dt)).reshape(B, S, cfg.num_heads, cfg.head_dim)
     k = (h @ lp["wk"].astype(dt)).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
     v = (h @ lp["wv"].astype(dt)).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-    q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
+    q = _rope_at(q, cfg.rope_theta, positions)
+    k = _rope_at(k, cfg.rope_theta, positions)
+    return q, k, v
+
+
+def _layer_qkv(cfg: LlamaConfig, x, lp):
+    """Pre-attention half of a block: rms → qkv projections → rope → GQA."""
+    q, k, v = _qkv_proj(cfg, x, lp)
     if cfg.num_kv_heads != cfg.num_heads:  # GQA: repeat kv heads
         rep = cfg.num_heads // cfg.num_kv_heads
         k = jnp.repeat(k, rep, axis=2)
@@ -417,3 +432,151 @@ def make_sharded_train_step(cfg: LlamaConfig, mesh, lr=3e-4):
         out_shardings=(ps, opt_sh, NamedSharding(mesh, P())),
         donate_argnums=(0, 1),
     )
+
+
+# ---------------------------------------------------------------------------
+# KV-cache autoregressive decoding (inference). Reference: PaddleNLP's
+# generation loop over the fused decode-attention kernels (SURVEY.md §2.4);
+# here prefill and per-token decode are each ONE jitted program with the
+# cache donated between steps, and the decode attention masks the padded
+# cache tail instead of re-running the whole prefix.
+# ---------------------------------------------------------------------------
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,
+                  dtype=None) -> Dict[str, jax.Array]:
+    """Per-layer stacked K/V cache: [L, B, max_len, Hkv, D]."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _cache_attention(cfg: LlamaConfig, q, kc, vc, positions):
+    """q [B,T,nH,D] against the UNREPEATED cache kc/vc [B,Smax,Hkv,D].
+    GQA contracts via a grouped einsum (q reshaped [B,T,Hkv,rep,D]) —
+    the repeated cache is never materialised. Keys j > token position are
+    masked (covers both causality and the unwritten cache tail)."""
+    B, T, nH, D = q.shape
+    Smax = kc.shape[1]
+    rep = cfg.num_heads // cfg.num_kv_heads
+    dt = q.dtype
+    scale = 1.0 / np.sqrt(cfg.head_dim)
+    qg = q.reshape(B, T, cfg.num_kv_heads, rep, D)
+    s = jnp.einsum("bthrd,bshd->bhrts", qg, kc,
+                   preferred_element_type=jnp.float32) * scale
+    visible = jnp.arange(Smax)[None, :] <= positions[:, None]  # [T, Smax]
+    s = jnp.where(visible[None, None, None], s, -jnp.inf)
+    probs = jax.nn.softmax(s, axis=-1)
+    attn = jnp.einsum("bhrts,bshd->bthrd", probs.astype(dt), vc,
+                      preferred_element_type=jnp.float32).astype(dt)
+    return attn.reshape(B, T, nH, D)
+
+
+def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos):
+    """Run ``tokens`` [B, T] at absolute positions pos..pos+T-1 against the
+    cache. Returns (last-position logits [B, V], updated cache). T is the
+    prompt length for prefill and 1 for decode; ``pos`` may be a traced
+    scalar (the decode step compiles once). Layers run under lax.scan over
+    the stacked [L, ...] weights and cache — O(1) compile depth, matching
+    the training path's scan_layers design."""
+    dt = cfg.dtype
+    B, T = tokens.shape
+    x = params["embed"].astype(dt)[tokens]
+    positions = pos + jnp.arange(T)
+    keys = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+            "ln_attn", "ln_mlp")
+    layer_weights = {kk: params[kk] for kk in keys}
+
+    def body(x, per_layer):
+        lp, kc, vc = per_layer
+        q, k_new, v_new = _qkv_proj(cfg, x, lp, positions)
+        kc = jax.lax.dynamic_update_slice(
+            kc, k_new.astype(kc.dtype), (0, pos, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            vc, v_new.astype(vc.dtype), (0, pos, 0, 0))
+        attn = _cache_attention(cfg, q, kc, vc, positions)
+        return _layer_post(cfg, x, attn, lp), (kc, vc)
+
+    x, (kcs, vcs) = jax.lax.scan(body, x,
+                                 (layer_weights, cache["k"], cache["v"]))
+    x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
+    logits = x[:, -1] @ params["lm_head"].astype(dt)  # [B, V]
+    return logits.astype(jnp.float32), {"k": kcs, "v": vcs}
+
+
+def _sample(logits, temperature, top_k, key):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k:
+        k = min(int(top_k), logits.shape[-1])
+        kth = jax.lax.top_k(logits, k)[0][:, -1][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int = 32,
+             max_len: Optional[int] = None, temperature: float = 0.0,
+             top_k: int = 0, seed: int = 0) -> jax.Array:
+    """Autoregressive generation: greedy at temperature 0, otherwise
+    temperature/top-k sampling. Returns [B, max_new_tokens] int32.
+
+    Prefill is one jitted program; every decode token is one jitted step
+    with the cache DONATED (in-place on device). Sampling and the position
+    counter live INSIDE the step, so the host loop only threads device
+    references — no per-token host->device transfers or syncs.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, S = prompt.shape
+    max_len = max_len or min(cfg.max_seq_len, S + max_new_tokens)
+    if S + max_new_tokens > max_len:
+        raise ValueError(f"prompt ({S}) + max_new_tokens ({max_new_tokens}) "
+                         f"exceeds max_len ({max_len})")
+    prefill, decode_all = _generate_programs(cfg, S, max_len, max_new_tokens,
+                                             float(temperature), int(top_k))
+    cache, nxt, pos, key = prefill(params, prompt, jax.random.PRNGKey(seed))
+    if max_new_tokens == 1:
+        return nxt[:, None]
+    toks, _ = decode_all(params, cache, nxt, pos, key)
+    return jnp.concatenate([nxt[:, None], toks.T], axis=1)
+
+
+@functools.lru_cache(maxsize=32)
+def _generate_programs(cfg: LlamaConfig, prompt_len: int, max_len: int,
+                       max_new_tokens: int, temperature: float, top_k: int):
+    """Compiled (prefill, decode_all) pair — cached so repeated generate()
+    calls with the same config/shapes reuse the XLA programs instead of
+    recompiling (the jits close over static sampling params). The cache is
+    allocated INSIDE prefill (on device from the start; decode_all then
+    donates it cleanly)."""
+
+    @jax.jit
+    def prefill(params, prompt, key):
+        cache = init_kv_cache(cfg, prompt.shape[0], max_len)
+        logits, cache = forward_with_cache(params, prompt, cfg, cache,
+                                           jnp.int32(0))
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits, temperature, top_k, sub)
+        return cache, nxt, jnp.int32(prompt_len), key
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def decode_all(params, cache, nxt, pos, key):
+        # the whole decode loop is ONE compiled program (lax.scan): zero
+        # host round-trips per token — the TPU-native replacement for the
+        # reference's per-token python generation loop
+        def body(carry, _):
+            cache, nxt, pos, key = carry
+            logits, cache = forward_with_cache(params, nxt[:, None], cfg,
+                                               cache, pos)
+            key, sub = jax.random.split(key)
+            nxt = _sample(logits, temperature, top_k, sub)
+            return (cache, nxt, pos + 1, key), nxt
+
+        (cache, *_), toks = jax.lax.scan(
+            body, (cache, nxt, pos, key), None, length=max_new_tokens - 1)
+        # returning the final cache gives the donated input an aliasing
+        # target (in-place update, no copy, no donation warning); callers
+        # discard it
+        return toks, cache  # toks: [T-1, B]
+
+    return prefill, decode_all
