@@ -274,9 +274,7 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array,
     x = params["embed"].astype(dt)[tokens]
     x = wsc(x, _act_spec(cfg))
 
-    layer_weights = {k: params[k] for k in
-                     ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-                      "ln_attn", "ln_mlp")}
+    layer_weights = {k: params[k] for k in _LAYER_KEYS}
 
     if cfg.remat and _flash_path_active():
         # Flash-path remat structure: checkpoint the two matmul halves but
@@ -351,6 +349,11 @@ def init_opt_state(params):
 
 
 NO_DECAY_KEYS = ("ln_attn", "ln_mlp", "ln_f", "embed")
+
+# per-layer stacked weights (leading [L] axis) — the one list both the
+# training forward and the KV-cache decode path slice from
+_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+               "ln_attn", "ln_mlp")
 
 
 def adamw_update(params, grads, opt_state, lr=3e-4, beta1=0.9, beta2=0.95,
@@ -483,9 +486,7 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos):
     B, T = tokens.shape
     x = params["embed"].astype(dt)[tokens]
     positions = pos + jnp.arange(T)
-    keys = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-            "ln_attn", "ln_mlp")
-    layer_weights = {kk: params[kk] for kk in keys}
+    layer_weights = {kk: params[kk] for kk in _LAYER_KEYS}
 
     def body(x, per_layer):
         lp, kc, vc = per_layer
@@ -528,28 +529,34 @@ def generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int = 32,
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     B, S = prompt.shape
-    max_len = max_len or min(cfg.max_seq_len, S + max_new_tokens)
-    if S + max_new_tokens > max_len:
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    # the last sampled token is returned but never written back to the
+    # cache, so S + max_new_tokens - 1 slots suffice
+    max_len = max_len or min(cfg.max_seq_len, S + max_new_tokens - 1)
+    if S + max_new_tokens - 1 > max_len:
         raise ValueError(f"prompt ({S}) + max_new_tokens ({max_new_tokens}) "
-                         f"exceeds max_len ({max_len})")
-    prefill, decode_all = _generate_programs(cfg, S, max_len, max_new_tokens,
-                                             float(temperature), int(top_k))
+                         f"needs {S + max_new_tokens - 1} cache slots but "
+                         f"max_len is {max_len}")
+    prefill = _prefill_program(cfg, max_len, float(temperature), int(top_k))
     cache, nxt, pos, key = prefill(params, prompt, jax.random.PRNGKey(seed))
     if max_new_tokens == 1:
         return nxt[:, None]
+    decode_all = _decode_program(cfg, max_new_tokens, float(temperature),
+                                 int(top_k))
     toks, _ = decode_all(params, cache, nxt, pos, key)
     return jnp.concatenate([nxt[:, None], toks.T], axis=1)
 
 
-@functools.lru_cache(maxsize=32)
-def _generate_programs(cfg: LlamaConfig, prompt_len: int, max_len: int,
-                       max_new_tokens: int, temperature: float, top_k: int):
-    """Compiled (prefill, decode_all) pair — cached so repeated generate()
-    calls with the same config/shapes reuse the XLA programs instead of
-    recompiling (the jits close over static sampling params). The cache is
-    allocated INSIDE prefill (on device from the start; decode_all then
-    donates it cleanly)."""
+# Compiled-program factories, cached SEPARATELY: varying prompt lengths
+# re-specialise only prefill (through jit's own shape cache) while ONE
+# decode program serves them all, and varying max_new_tokens leaves
+# prefill untouched. The KV cache is allocated INSIDE prefill (on device
+# from the start; decode then donates it cleanly).
 
+@functools.lru_cache(maxsize=32)
+def _prefill_program(cfg: LlamaConfig, max_len: int, temperature: float,
+                     top_k: int):
     @jax.jit
     def prefill(params, prompt, key):
         cache = init_kv_cache(cfg, prompt.shape[0], max_len)
@@ -557,8 +564,14 @@ def _generate_programs(cfg: LlamaConfig, prompt_len: int, max_len: int,
                                            jnp.int32(0))
         key, sub = jax.random.split(key)
         nxt = _sample(logits, temperature, top_k, sub)
-        return cache, nxt, jnp.int32(prompt_len), key
+        return cache, nxt, jnp.int32(prompt.shape[1]), key
 
+    return prefill
+
+
+@functools.lru_cache(maxsize=32)
+def _decode_program(cfg: LlamaConfig, max_new_tokens: int,
+                    temperature: float, top_k: int):
     @functools.partial(jax.jit, donate_argnums=(1,))
     def decode_all(params, cache, nxt, pos, key):
         # the whole decode loop is ONE compiled program (lax.scan): zero
@@ -579,4 +592,4 @@ def _generate_programs(cfg: LlamaConfig, prompt_len: int, max_len: int,
         # discard it
         return toks, cache  # toks: [T-1, B]
 
-    return prefill, decode_all
+    return decode_all
