@@ -1,0 +1,7 @@
+"""``paddle.audio`` — audio feature extraction (reference:
+``python/paddle/audio/``): mel/log-mel spectrograms and MFCC over the
+signal-processing stack."""
+
+from . import features, functional
+
+__all__ = ["features", "functional"]

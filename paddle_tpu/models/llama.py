@@ -550,9 +550,12 @@ def generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int = 32,
 
 # Compiled-program factories, cached SEPARATELY: varying prompt lengths
 # re-specialise only prefill (through jit's own shape cache) while ONE
-# decode program serves them all, and varying max_new_tokens leaves
-# prefill untouched. The KV cache is allocated INSIDE prefill (on device
-# from the start; decode then donates it cleanly).
+# decode program serves them all. NOTE: on the default path max_len is
+# derived from S + max_new_tokens - 1, which couples BOTH programs to the
+# request sizes — serving loops should pass a fixed max_len so the cache
+# shape (and with it every compiled program) stays stable. The KV cache
+# is allocated INSIDE prefill (on device from the start; decode then
+# donates it cleanly).
 
 @functools.lru_cache(maxsize=32)
 def _prefill_program(cfg: LlamaConfig, max_len: int, temperature: float,

@@ -1,0 +1,53 @@
+"""paddle.audio feature-extraction tests: filterbank math invariants and
+feature layer shapes/frequency localisation."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.audio import features, functional as AF
+
+
+def test_mel_scale_roundtrip():
+    for htk in (False, True):
+        for hz in (55.0, 440.0, 4000.0, 7999.0):
+            back = AF.mel_to_hz(AF.hz_to_mel(hz, htk), htk)
+            assert abs(back - hz) < 1e-2 * max(1.0, hz / 100)
+
+
+def test_fbank_matrix_properties():
+    fb = AF.compute_fbank_matrix(sr=16000, n_fft=512, n_mels=40)
+    assert fb.shape == (40, 257)
+    assert fb.min() >= 0
+    # each filter is a contiguous triangle: one maximum, nonzero support
+    assert (fb.max(axis=1) > 0).all()
+
+
+def test_spectrogram_peak_bin():
+    sr, n_fft, f0 = 16000, 512, 440.0
+    t = np.arange(sr) / sr
+    sig = paddle.to_tensor(np.sin(2 * np.pi * f0 * t).astype(np.float32)[None])
+    spec = features.Spectrogram(n_fft=n_fft)(sig)
+    peak = int(np.argmax(spec.numpy()[0].mean(-1)))
+    assert abs(peak - round(f0 / (sr / n_fft))) <= 1
+
+
+def test_feature_layer_shapes_finite():
+    sig = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 8000).astype(np.float32))
+    mel = features.MelSpectrogram(sr=16000, n_fft=512, n_mels=64)(sig)
+    logmel = features.LogMelSpectrogram(sr=16000, n_fft=512, n_mels=64,
+                                        top_db=80.0)(sig)
+    mfcc = features.MFCC(sr=16000, n_fft=512, n_mfcc=13)(sig)
+    assert mel.shape[:2] == [2, 64] and logmel.shape == mel.shape
+    assert mfcc.shape[:2] == [2, 13]
+    for x in (mel, logmel, mfcc):
+        assert np.isfinite(x.numpy()).all()
+    # top_db floors the dynamic range
+    lm = logmel.numpy()
+    assert lm.max() - lm.min() <= 80.0 + 1e-3
+
+
+def test_dct_orthonormal():
+    d = AF.create_dct(13, 64, norm="ortho")
+    gram = d.T @ d  # [13, 13]
+    np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
