@@ -57,7 +57,15 @@ def main(batch=8, prompt_len=64, new_tokens=128):
     # isolate pure decode: subtract the prefill-only (max_new_tokens=1) time
     t_full = timed(new_tokens)
     t_prefill = timed(1)
-    decode_time = max(t_full - t_prefill, 1e-9)
+    if t_full - t_prefill <= 0:
+        log(f"timing too noisy to isolate decode "
+            f"(full {t_full:.3f}s <= prefill {t_prefill:.3f}s); aborting")
+        print(json.dumps({
+            "metric": "llama110m_decode_throughput", "value": 0.0,
+            "unit": "tokens/sec", "vs_baseline": 0.0,
+            "error": "prefill/full timing inversion"}))
+        return
+    decode_time = t_full - t_prefill
     tps = batch * (new_tokens - 1) / decode_time
     log(f"decode: {tps:,.0f} tokens/s ({decode_time/(new_tokens-1)*1e3:.2f} "
         f"ms/token, batch {batch}; prefill {t_prefill*1e3:.0f} ms)")

@@ -2,17 +2,23 @@
 LogMelSpectrogram / MFCC layers (reference:
 ``python/paddle/audio/features/layers.py``), built on
 ``paddle_tpu.signal.stft`` and the functional filterbanks.
+
+Windows/filterbanks/DCT bases are STATIC HOST MATH and stay numpy: they
+embed as constants in the ops' closures, which follow the input tensor's
+committed device. (On the TPU env ``signal.stft`` is host-resident —
+complex dtypes don't cross the transport — so the whole feature chain
+runs on host; a device-committed filterbank tensor would clash with it.)
 """
 
 from __future__ import annotations
 
 from typing import Optional, Union
 
-import numpy as np
+import jax.numpy as jnp
 
 from .. import signal
-from ..core.tensor import Tensor, to_tensor
 from ..nn.layer.layers import Layer
+from ..ops.dispatch import run_op
 from . import functional as F
 
 __all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
@@ -33,18 +39,21 @@ class Spectrogram(Layer):
         self.center = center
         self.pad_mode = pad_mode
         self._dtype = dtype
-        self.window = to_tensor(F.get_window(window, self.win_length))
+        self._window = F.get_window(window, self.win_length)  # numpy
 
     def forward(self, x):
-        import paddle_tpu as paddle
-
         spec = signal.stft(x, self.n_fft, self.hop_length, self.win_length,
-                           window=self.window, center=self.center,
+                           window=self._window, center=self.center,
                            pad_mode=self.pad_mode)
-        mag = paddle.abs(spec)
-        if self.power != 1.0:
-            mag = mag ** self.power
-        return mag.astype(self._dtype)
+        power, dtype = self.power, self._dtype
+
+        def mag_f(s):
+            m = jnp.abs(s)
+            if power != 1.0:
+                m = m ** power
+            return m.astype(dtype)
+
+        return run_op("spectrogram_mag", mag_f, spec)
 
 
 class MelSpectrogram(Layer):
@@ -60,14 +69,13 @@ class MelSpectrogram(Layer):
         self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
                                         window, power, center, pad_mode,
                                         dtype=dtype)
-        self.fbank = to_tensor(F.compute_fbank_matrix(
-            sr, n_fft, n_mels, f_min, f_max, htk, norm)).astype(dtype)
+        self._fbank = F.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm).astype(dtype)
 
     def forward(self, x):
-        import paddle_tpu as paddle
-
         spec = self._spectrogram(x)          # [..., freq, frames]
-        return paddle.matmul(self.fbank, spec)
+        fb = self._fbank
+        return run_op("mel_fbank", lambda s: jnp.matmul(fb, s), spec)
 
 
 class LogMelSpectrogram(Layer):
@@ -87,10 +95,9 @@ class MFCC(Layer):
                  **mel_kwargs):
         super().__init__()
         self._log_mel = LogMelSpectrogram(sr=sr, n_mels=n_mels, **mel_kwargs)
-        self.dct = to_tensor(F.create_dct(n_mfcc, n_mels))
+        self._dct_t = F.create_dct(n_mfcc, n_mels).T  # [n_mfcc, n_mels]
 
     def forward(self, x):
-        import paddle_tpu as paddle
-
         log_mel = self._log_mel(x)           # [..., n_mels, frames]
-        return paddle.matmul(self.dct.t(), log_mel)
+        dct_t = self._dct_t
+        return run_op("mfcc_dct", lambda m: jnp.matmul(dct_t, m), log_mel)

@@ -92,18 +92,24 @@ def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
 
 def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
                 top_db: Optional[float] = 80.0):
-    """10·log10(spect/ref) with an optional dynamic-range floor."""
-    from .. import ops  # noqa: F401  (tensor op namespace)
-    import paddle_tpu as paddle
+    """10·log10(spect/ref) with an optional dynamic-range floor. Runs as
+    one op whose scalar constants live in the closure, so it follows the
+    input's committed device (host-resident on the TPU env, where the
+    upstream stft chain is host math)."""
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import run_op
 
     x = spect if isinstance(spect, Tensor) else to_tensor(np.asarray(spect))
-    log_spec = 10.0 * paddle.log10(paddle.maximum(
-        x, to_tensor(np.float32(amin))))
-    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
-    if top_db is not None:
-        floor = paddle.max(log_spec) - top_db
-        log_spec = paddle.maximum(log_spec, floor)
-    return log_spec
+    offset = 10.0 * math.log10(max(amin, ref_value))
+
+    def f(a):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(a, amin)) - offset
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+
+    return run_op("power_to_db", f, x)
 
 
 def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho"):

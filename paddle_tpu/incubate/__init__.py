@@ -4,3 +4,36 @@ experimental APIs — MoE expert parallelism and fused-op entry points."""
 from . import asp, distributed, nn
 
 __all__ = ["asp", "distributed", "nn"]
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one compiled region (reference:
+    ``incubate.softmax_mask_fuse`` fused kernel — XLA fuses this chain)."""
+    from ..nn import functional as F
+
+    return F.softmax(x + mask.astype(x.dtype), axis=-1)
+
+
+def segment_sum(data, segment_ids, name=None):
+    from .. import geometric
+
+    return geometric.segment_sum(data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    from .. import geometric
+
+    return geometric.segment_mean(data, segment_ids)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Legacy name of ``geometric.send_u_recv`` (message passing)."""
+    from .. import geometric
+
+    return geometric.send_u_recv(x, src_index, dst_index,
+                                 reduce_op=pool_type, out_size=out_size)
+
+
+__all__ += ["softmax_mask_fuse", "segment_sum", "segment_mean",
+            "graph_send_recv"]

@@ -1,3 +1,24 @@
 from . import functional
+from .layers import (
+    FusedFeedForward,
+    FusedLinear,
+    FusedMultiHeadAttention,
+    FusedTransformerEncoderLayer,
+)
 
-__all__ = ["functional"]
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    """Reference ``paddle.incubate.nn.memory_efficient_attention`` — here
+    the flash path IS the memory-efficient implementation ([B, S, H, D])."""
+    from ...nn.functional import scaled_dot_product_attention
+
+    q = query if scale is None else query * (
+        float(scale) * float(query.shape[-1]) ** 0.5)
+    return scaled_dot_product_attention(q, key, value, attn_mask=attn_bias,
+                                        dropout_p=p, training=training)
+
+
+__all__ = ["functional", "FusedLinear", "FusedFeedForward",
+           "FusedMultiHeadAttention", "FusedTransformerEncoderLayer",
+           "memory_efficient_attention"]

@@ -202,3 +202,20 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
         out = F.layer_norm(out, out.shape[-1:], weight=ln_scale, bias=ln_bias,
                            epsilon=ln_epsilon)
     return out
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU activation (reference ``incubate.nn.functional.swiglu``):
+    silu(x) * y; with ``y=None``, x splits into two halves on the last
+    axis (the llama MLP convention)."""
+    if y is None:
+        if x.shape[-1] % 2:
+            raise ValueError(
+                f"swiglu with y=None needs an even last dim to split, got "
+                f"{x.shape[-1]}")
+        d = x.shape[-1] // 2
+        x, y = x[..., :d], x[..., d:]
+    return F.silu(x) * y
+
+
+__all__ += ["swiglu"]

@@ -200,3 +200,55 @@ def test_functional_flash_attention_module():
                           is_causal=True)
     assert out2.shape == [10, 4, 8]
     assert np.allclose(out2.numpy()[:4], np.asarray(seg0)[0], atol=1e-5)
+
+
+def test_incubate_fused_layers():
+    """Fused layer classes own reference-layout params and match a manual
+    composition of the same math; gradients flow to the packed weights."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate import nn as inn
+    from paddle_tpu.nn import functional as F
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 6, 16).astype(np.float32))
+
+    attn = inn.FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                       attn_dropout_rate=0.0)
+    attn.eval()
+    out = attn(x)
+    assert out.shape == [2, 6, 16]
+    # manual recompute from the packed weights (post-LN path)
+    qkvw = attn.qkv_weight.numpy().reshape(3, 16, 16)  # [3, nH*hd, H]
+    qkvb = attn.qkv_bias.numpy().reshape(3, 16)
+    h = x.numpy()
+    q = (h @ qkvw[0].T + qkvb[0]).reshape(2, 6, 4, 4)
+    k = (h @ qkvw[1].T + qkvb[1]).reshape(2, 6, 4, 4)
+    v = (h @ qkvw[2].T + qkvb[2]).reshape(2, 6, 4, 4)
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / 2.0  # 1/sqrt(4)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    o = np.einsum("bhqk,bkhd->bqhd", p, v).reshape(2, 6, 16)
+    o = o @ attn.linear_weight.numpy() + attn.linear_bias.numpy()
+    ref = h + o  # residual; post-LN
+    ln = F.layer_norm(paddle.to_tensor(ref.astype(np.float32)), [16],
+                      weight=attn.ln_scale, bias=attn.ln_bias)
+    np.testing.assert_allclose(out.numpy(), ln.numpy(), atol=2e-4)
+
+    loss = paddle.sum(out ** 2)
+    loss.backward()
+    assert attn.qkv_weight.grad is not None
+
+    enc = inn.FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+    enc.eval()
+    assert enc(x).shape == [2, 6, 16]
+
+    from paddle_tpu.incubate.nn import functional as IF
+    half = paddle.to_tensor(rng.randn(3, 8).astype(np.float32))
+    sw = IF.swiglu(half)
+    ref_sw = (half.numpy()[:, :4] / (1 + np.exp(-half.numpy()[:, :4]))
+              ) * half.numpy()[:, 4:]
+    np.testing.assert_allclose(sw.numpy(), ref_sw, rtol=1e-5)
+
+    assert paddle.incubate.softmax_mask_fuse(
+        x, paddle.zeros_like(x)).shape == x.shape
