@@ -4,6 +4,7 @@ axes, collectives are XLA ops, hybrid parallel lives in ``fleet``."""
 
 from .collective import (
     Group,
+    P2POp,
     ReduceOp,
     all_gather,
     all_gather_object,
@@ -19,9 +20,15 @@ from .collective import (
     new_group,
     recv,
     reduce,
+    batch_isend_irecv,
+    destroy_process_group,
+    get_backend,
     reduce_scatter,
     scatter,
+    scatter_object_list,
     send,
+    split,
+    wait,
 )
 from .env import (
     ParallelEnv,
@@ -31,6 +38,7 @@ from .env import (
     is_initialized,
 )
 from .parallel import DataParallel
+from . import utils  # noqa: F401
 from . import (auto_parallel, checkpoint, communication, fleet, launch, ps,
                rpc, sharding)
 from .communication import stream  # noqa: F401
@@ -53,6 +61,8 @@ __all__ = [
     "all_reduce", "all_gather", "all_gather_object", "reduce",
     "reduce_scatter", "broadcast", "scatter", "alltoall", "all_to_all",
     "send", "recv", "isend", "irecv", "barrier", "ParallelEnv", "get_rank",
+    "P2POp", "batch_isend_irecv", "wait", "destroy_process_group",
+    "get_backend", "scatter_object_list", "split", "utils",
     "get_world_size", "init_parallel_env", "is_initialized", "DataParallel",
     "spawn", "launch", "fleet", "sharding", "group_sharded_parallel",
     "save_group_sharded_model", "auto_parallel", "ProcessMesh", "Placement",

@@ -74,13 +74,14 @@ class Group:
         return f"Group(id={self.id}, ranks={self.ranks}, axis={self.axis_name!r})"
 
 
-_groups: List[Group] = []
+_groups: dict = {}  # id -> Group (dict: destroy() must not shift ids)
+_next_gid = [1]
 
 
 def get_default_group() -> Group:
-    if not _groups:
+    if 0 not in _groups:
         world = get_world_size()
-        _groups.append(Group(list(range(world)), axis_name="dp", id=0))
+        _groups[0] = Group(list(range(world)), axis_name="dp", id=0)
     return _groups[0]
 
 
@@ -88,13 +89,19 @@ def new_group(ranks: Optional[Sequence[int]] = None, backend=None,
               axis_name: Optional[str] = None) -> Group:
     if ranks is None:
         ranks = list(range(get_world_size()))
-    g = Group(list(ranks), axis_name=axis_name or f"group{len(_groups)}",
-              id=len(_groups))
-    _groups.append(g)
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    g = Group(list(ranks), axis_name=axis_name or f"group{gid}", id=gid)
+    _groups[gid] = g
     return g
 
 
 def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return get_default_group()
+    if gid not in _groups:
+        raise InvalidArgumentError(f"no group with id {gid} "
+                                   f"(destroyed or never created)")
     return _groups[gid]
 
 
@@ -314,3 +321,120 @@ def barrier(group=None):
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Scatter a python-object list from ``src`` (reference:
+    ``paddle.distributed.scatter_object_list``). Single-process world (and
+    the SPMD single-controller model) : rank 0 keeps its slice."""
+    g = group or get_default_group()
+    if g.nranks == 1:
+        out_object_list.clear()
+        out_object_list.append(in_object_list[0] if in_object_list else None)
+        return
+    # cross-PROCESS object exchange needs the launch runtime's store (the
+    # SPMD single controller has no per-rank eager processes) — same
+    # contract as eager send/recv
+    raise InvalidArgumentError(
+        "scatter_object_list across ranks requires the launch runtime "
+        "(python -m paddle_tpu.distributed.launch); in SPMD programs pass "
+        "arrays, not python objects")
+
+
+class P2POp:
+    """One pending point-to-point op for ``batch_isend_irecv`` (reference:
+    ``paddle.distributed.P2POp`` — the pipeline-parallel P2P batching
+    API). ``op`` is ``isend`` or ``irecv``."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv):
+            raise ValueError("P2POp op must be paddle.distributed.isend "
+                             "or paddle.distributed.irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Launch a batch of P2POps; returns one task per op (reference
+    semantics; under SPMD the ppermute pairs compile into one
+    collective-permute)."""
+    tasks = []
+    for p in p2p_op_list:
+        if p.op is isend:
+            tasks.append(isend(p.tensor, p.peer, p.group))
+        else:
+            tasks.append(irecv(p.tensor, p.peer, p.group))
+    return tasks
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Block until ``tensor``'s producing work completes (reference:
+    ``paddle.distributed.wait`` — stream sync). XLA dispatch is async;
+    block_until_ready is the stream-wait analog."""
+    val = _unwrap(tensor)
+    if hasattr(val, "block_until_ready"):
+        val.block_until_ready()
+    return tensor
+
+
+def destroy_process_group(group=None):
+    """Tear down a group (or every group) — reference
+    ``paddle.distributed.destroy_process_group``."""
+    from . import env as _env
+
+    if group is None:
+        _groups.clear()
+        _env._initialized[0] = False
+    else:
+        _groups.pop(group.id, None)
+
+
+def get_backend(group=None) -> str:
+    """Communication backend name. The reference answers 'NCCL'/'GLOO';
+    here every collective lowers to XLA (ICI/DCN)."""
+    return "XLA"
+
+
+_split_layer_cache = {}
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=None,
+          gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style distributed fc/embedding (reference:
+    ``paddle.distributed.split`` — builds a row/column-parallel weight and
+    applies it). Dygraph-first here: the parallel layer is created once
+    per ``name`` and cached (pass ``name`` to reuse weights across steps;
+    the reference's static mode gets the same effect from the program).
+    Prefer the explicit ``fleet.meta_parallel`` layers for new code."""
+    from .fleet import meta_parallel as mp
+
+    if name is None:
+        raise InvalidArgumentError(
+            "paddle.distributed.split needs a unique `name` per logical "
+            "layer: the weight it creates is cached and reused across "
+            "calls, and an implicit key would silently weight-tie "
+            "same-shaped projections")
+    key = (name, operation, tuple(size), axis, bool(gather_out))
+    layer = _split_layer_cache.get(key)
+    if layer is None:
+        in_f, out_f = size
+        if operation == "embedding":
+            layer = mp.VocabParallelEmbedding(in_f, out_f,
+                                              weight_attr=weight_attr)
+        elif operation == "linear" and axis == 0:
+            layer = mp.RowParallelLinear(in_f, out_f,
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         input_is_parallel=False)
+        elif operation == "linear":
+            layer = mp.ColumnParallelLinear(in_f, out_f,
+                                            weight_attr=weight_attr,
+                                            has_bias=bias_attr is not False,
+                                            gather_output=gather_out)
+        else:
+            raise ValueError(f"unsupported split operation {operation!r}")
+        _split_layer_cache[key] = layer
+    return layer(x)

@@ -16,7 +16,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ...core.tensor import Tensor
+from ...core.tensor import Tensor, to_tensor
 from ...nn import functional as F
 from ...ops.dispatch import run_op
 from ...ops.pallas.flash_attention import dot_product_attention
@@ -182,19 +182,24 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
         while mask_val.ndim < 4:  # broadcast to [B, n_heads, S, S]
             mask_val = mask_val[None]
 
-    def mha(xa, wa, *rest):
+    def qkv_proj(xa, wa, *rest):
         bias = rest[0] if len(rest) else None
         w = wa.reshape(3 * n_heads * head_dim, H).T  # [H, 3*Hd]
         qkv = xa @ w
         if bias is not None:
             qkv = qkv + bias.reshape(-1)
         qkv = qkv.reshape(B, S, 3, n_heads, head_dim)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        o = dot_product_attention(q, k, v, mask=mask_val, is_causal=False)
-        return o.reshape(B, S, n_heads * head_dim)
+        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
     args = [x, qkv_weight] + ([qkv_bias] if qkv_bias is not None else [])
-    o = run_op("fused_attention_qkv", mha, *args)
+    q, k, v = run_op("fused_attention_qkv", qkv_proj, *args)
+    # attention through the shared SDPA dispatch so attn_dropout_rate gets
+    # the reference's PROBS-level dropout semantics
+    mask_t = to_tensor(mask_val) if mask_val is not None else None
+    o = F.scaled_dot_product_attention(q, k, v, attn_mask=mask_t,
+                                       dropout_p=attn_dropout_rate,
+                                       training=training)
+    o = o.reshape([B, S, n_heads * head_dim])
     o = F.linear(o, linear_weight, linear_bias)
     o = F.dropout(o, p=dropout_rate, training=training, mode=mode)
     out = o + residual if add_residual else o

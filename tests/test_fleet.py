@@ -369,3 +369,45 @@ class TestRoleMakers:
         assert f.worker_num() == 8
         assert not f.is_first_worker()
         assert rm._get_trainer_endpoints()[3] == "127.0.0.1:9003"
+
+
+def test_p2p_and_object_collectives_api():
+    """P2POp/batch_isend_irecv, scatter_object_list, wait, get_backend,
+    destroy_process_group, split, distributed.utils — reference API
+    surface (world-of-one semantics here; SPMD paths covered by the
+    hybrid-parallel tests)."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    d = paddle.distributed
+    t = paddle.to_tensor(np.ones(4, np.float32))
+    g1 = d.new_group([0])  # world-of-one group: eager P2P is identity
+    tasks = d.batch_isend_irecv([d.P2POp(d.isend, t, 0, group=g1),
+                                 d.P2POp(d.irecv, t, 0, group=g1)])
+    assert len(tasks) == 2
+    d.wait(t)
+    assert d.get_backend() == "XLA"
+
+    out = []
+    d.scatter_object_list(out, [{"a": 1}])
+    assert out == [{"a": 1}]
+
+    y1 = d.split(paddle.to_tensor(np.ones((2, 8), np.float32)), (8, 4),
+                 operation="linear", axis=1, name="t_split")
+    y2 = d.split(paddle.to_tensor(np.ones((2, 8), np.float32)), (8, 4),
+                 operation="linear", axis=1, name="t_split")
+    assert y1.shape == [2, 4]
+    np.testing.assert_allclose(y1.numpy(), y2.numpy())  # cached weights
+
+    from paddle_tpu.distributed import utils as dutils
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+    np.testing.assert_allclose(
+        dutils.global_scatter(x, np.array([6]), np.array([6]),
+                              group=g1).numpy(),
+        x.numpy())
+    try:
+        import pytest
+        with pytest.raises(ValueError):
+            d.P2POp("bogus", t, 0)
+    except ImportError:
+        pass
