@@ -80,6 +80,9 @@ from . import quantization  # noqa: F401
 from . import sparse  # noqa: F401
 from . import signal  # noqa: F401
 from . import audio  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import version  # noqa: F401
+from .hapi import callbacks  # noqa: F401  (paddle.callbacks alias)
 from . import static  # noqa: F401
 from . import text  # noqa: F401
 from . import utils  # noqa: F401
@@ -201,3 +204,8 @@ def batch(reader, batch_size, drop_last=False):
             yield buf
 
     return batched
+
+
+def disable_signal_handler():
+    """API parity: the reference uninstalls its C++ crash handlers; this
+    runtime installs none, so there is nothing to disable."""

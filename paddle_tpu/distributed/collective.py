@@ -288,22 +288,40 @@ all_to_all = alltoall
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send. Inside shard_map (SPMD single controller) every rank runs
+    the SAME program, so ``dst`` expresses a UNIFORM SHIFT relative to the
+    caller (the reference's pipeline pattern — send to the next stage):
+    rank r's buffer goes to rank (r + (dst - rank)) mod n, compiled as one
+    collective-permute over the whole ring."""
     g = group or get_default_group()
     if g.nranks == 1:
         return tensor
     ax = g.axis_name
     val = _unwrap(tensor)
     if isinstance(val, jax.core.Tracer):
-        # point-to-point inside SPMD: ppermute ring step
-        perm = [(g.get_group_rank(get_rank()), g.get_group_rank(dst))]
+        n = g.nranks
+        shift = (g.get_group_rank(dst) - g.get_group_rank(get_rank())) % n
+        perm = [(i, (i + shift) % n) for i in range(n)]
         return Tensor(jax.lax.ppermute(val, ax, perm))
     raise InvalidArgumentError("eager send/recv requires a shard_map context or launch runtime")
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    """P2P receive. Inside shard_map the matched isend/irecv pair is ONE
+    collective-permute; like ``send``, ``src`` expresses a uniform shift
+    (receive from the previous stage etc.): rank r receives the buffer of
+    rank (r - (rank - src)) mod n — ``tensor`` holds each rank's outgoing
+    payload, per the reference's p2p_communication convention."""
     g = group or get_default_group()
     if g.nranks == 1:
         return tensor
+    ax = g.axis_name
+    val = _unwrap(tensor)
+    if isinstance(val, jax.core.Tracer):
+        n = g.nranks
+        shift = (g.get_group_rank(get_rank()) - g.get_group_rank(src)) % n
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return Tensor(jax.lax.ppermute(val, ax, perm))
     raise InvalidArgumentError("eager send/recv requires a shard_map context or launch runtime")
 
 
@@ -387,6 +405,7 @@ def destroy_process_group(group=None):
 
     if group is None:
         _groups.clear()
+        _split_layer_cache.clear()  # release split()'s cached weights too
         _env._initialized[0] = False
     else:
         _groups.pop(group.id, None)
@@ -417,8 +436,19 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
             "layer: the weight it creates is cached and reused across "
             "calls, and an implicit key would silently weight-tie "
             "same-shaped projections")
-    key = (name, operation, tuple(size), axis, bool(gather_out))
-    layer = _split_layer_cache.get(key)
+    if operation == "linear" and axis not in (0, 1):
+        raise InvalidArgumentError(
+            f"split(operation='linear') partitions a 2-D weight: axis must "
+            f"be 0 (row-parallel) or 1 (column-parallel), got {axis}")
+    config = (operation, tuple(size), axis, bool(gather_out),
+              bias_attr is not False)
+    cached = _split_layer_cache.get(name)
+    if cached is not None and cached[0] != config:
+        raise InvalidArgumentError(
+            f"split(name={name!r}) called with a different configuration "
+            f"than the cached layer ({cached[0]} vs {config}) — use a "
+            f"distinct name per logical layer")
+    layer = cached[1] if cached else None
     if layer is None:
         in_f, out_f = size
         if operation == "embedding":
@@ -436,5 +466,5 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
                                             gather_output=gather_out)
         else:
             raise ValueError(f"unsupported split operation {operation!r}")
-        _split_layer_cache[key] = layer
+        _split_layer_cache[name] = (config, layer)
     return layer(x)

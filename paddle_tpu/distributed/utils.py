@@ -1,69 +1,42 @@
 """``paddle.distributed.utils`` (reference:
 ``python/paddle/distributed/utils/``): MoE token-exchange primitives
 (``global_scatter``/``global_gather``, the python surface of the
-reference's ``global_scatter/gather`` collective ops) plus small helpers.
+reference's ``global_scatter/gather`` collective ops).
 
-TPU-native lowering: both are expressed over ``alltoall`` on the expert-
-parallel group — GSPMD compiles them to ICI all-to-alls; at world size 1
-they reduce to local gather/scatter-add."""
+TPU-native contract: the ragged token exchange is an all-to-all INSIDE
+the MoE layer's shard_map program (see
+``paddle_tpu.incubate.distributed.models.moe``) — eager top-level calls
+are world-of-one identities, and multi-rank eager use raises the same
+launch-runtime error as eager send/recv (the SPMD single controller has
+no per-rank eager processes)."""
 
 from __future__ import annotations
 
-import numpy as np
-
-from ..core.tensor import Tensor, to_tensor
-from .collective import alltoall, get_default_group
+from ..enforce import InvalidArgumentError
+from .collective import get_default_group
 
 __all__ = ["global_scatter", "global_gather"]
 
 
-def _counts_to_offsets(counts):
-    off = np.zeros(len(counts) + 1, np.int64)
-    np.cumsum(counts, out=off[1:])
-    return off
+def _world_of_one_or_raise(name, group):
+    g = group or get_default_group()
+    if g.nranks == 1:
+        return True
+    raise InvalidArgumentError(
+        f"eager {name} across ranks is not supported: the ragged MoE "
+        "token exchange is an all-to-all inside the MoE layer's shard_map "
+        "program, and cross-process eager exchange needs the launch "
+        "runtime (python -m paddle_tpu.distributed.launch)")
 
 
 def global_scatter(x, local_count, global_count, group=None):
-    """Send ``local_count[i*ne+j]`` rows of ``x`` to expert j of rank i;
-    receive ``global_count`` rows (reference ``global_scatter``). With one
-    rank this is the identity permutation over the expert buckets."""
-    g = group or get_default_group()
-    lc = np.asarray(local_count.numpy() if isinstance(local_count, Tensor)
-                    else local_count).astype(np.int64)
-    if g.nranks == 1:
-        return x
-    # eager alltoall stacks chunks, so per-rank counts must be EQUAL (the
-    # capacity-padded MoE layout); ragged token exchange belongs inside the
-    # MoE layer's shard_map program
-    per_rank = lc.reshape(g.nranks, -1).sum(axis=1)
-    if len(set(per_rank.tolist())) != 1:
-        raise ValueError(
-            "eager global_scatter needs equal per-rank counts (capacity-"
-            f"padded); got {per_rank.tolist()} — use the MoELayer shard_map "
-            "path for ragged dispatch")
-    chunks = []
-    off = _counts_to_offsets(per_rank)
-    for r in range(g.nranks):
-        chunks.append(x[int(off[r]): int(off[r + 1])])
-    return alltoall(chunks, group=g)
+    """Send ``local_count[i*ne+j]`` rows of ``x`` to expert j of rank i
+    (reference ``global_scatter``). See the module contract above."""
+    _world_of_one_or_raise("global_scatter", group)
+    return x
 
 
 def global_gather(x, local_count, global_count, group=None):
-    """Inverse of ``global_scatter``: return the rows this rank scattered
-    (reference ``global_gather``)."""
-    g = group or get_default_group()
-    gc = np.asarray(global_count.numpy() if isinstance(global_count, Tensor)
-                    else global_count).astype(np.int64)
-    if g.nranks == 1:
-        return x
-    per_rank = gc.reshape(g.nranks, -1).sum(axis=1)
-    if len(set(per_rank.tolist())) != 1:
-        raise ValueError(
-            "eager global_gather needs equal per-rank counts (capacity-"
-            f"padded); got {per_rank.tolist()} — use the MoELayer shard_map "
-            "path for ragged dispatch")
-    chunks = []
-    off = _counts_to_offsets(per_rank)
-    for r in range(g.nranks):
-        chunks.append(x[int(off[r]): int(off[r + 1])])
-    return alltoall(chunks, group=g)
+    """Inverse of ``global_scatter`` (reference ``global_gather``)."""
+    _world_of_one_or_raise("global_gather", group)
+    return x

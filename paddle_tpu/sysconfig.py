@@ -1,0 +1,15 @@
+"""Build/config introspection (reference: ``paddle.sysconfig``)."""
+
+import os
+
+
+def get_include():
+    """Headers directory for custom C++ ops (the C-ABI surface lives with
+    utils.cpp_extension)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "utils", "cpp_extension")
+
+
+def get_lib():
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native")

@@ -3,3 +3,62 @@
 from . import cpp_extension  # noqa: F401
 
 __all__ = ["cpp_extension"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference:
+    ``paddle.utils.deprecated``) — warns once per call site."""
+    import functools
+    import warnings
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            msg = f"API {fn.__name__!r} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap
+
+
+def try_import(module_name, err_msg=None):
+    """Import a soft dependency or raise a friendly error (reference:
+    ``paddle.utils.try_import``)."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or
+                          f"Optional dependency {module_name!r} is not "
+                          f"installed; this environment is offline — gate "
+                          f"the feature or vendor the package")
+
+
+def run_check():
+    """Smoke-check the installation end to end on the current device
+    (reference: ``paddle.utils.run_check`` — prints a verdict)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = paddle.mean(lin(x) ** 2)
+    loss.backward()
+    dev = paddle.get_device()
+    n = len(paddle.device.get_all_devices())
+    print(f"paddle_tpu is installed successfully! {n} device(s) "
+          f"visible, compute verified on {dev}.")
+
+
+__all__ += ["deprecated", "try_import", "run_check"]

@@ -1,0 +1,24 @@
+"""Version metadata (reference: generated ``paddle/version.py``)."""
+
+from . import __version__ as full_version
+
+major, minor, patch = (full_version.split(".") + ["0", "0"])[:3]
+rc = 0
+istaged = True
+commit = "tpu-native"
+with_pip = False
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+
+
+def show():
+    print(f"paddle_tpu {full_version} (commit {commit}); backend: XLA/TPU")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

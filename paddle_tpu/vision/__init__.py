@@ -1,1 +1,35 @@
 from . import datasets, models, ops, transforms
+
+
+_image_backend = ["pil"]
+
+
+def set_image_backend(backend: str) -> None:
+    """Reference: ``paddle.vision.set_image_backend``. Offline image: only
+    numpy ('cv2'-shaped arrays) is actually used by the datasets; the
+    setting is recorded for API parity."""
+    if backend not in ("pil", "cv2", "numpy"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    _image_backend[0] = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend[0]
+
+
+def image_load(path, backend=None):
+    """Load an image file to an array (reference ``paddle.vision.image_load``).
+    PIL when available; always returns HWC uint8 numpy otherwise."""
+    import numpy as np
+
+    try:
+        from PIL import Image
+
+        img = Image.open(path)
+        if (backend or _image_backend[0]) == "pil":
+            return img
+        return np.asarray(img)
+    except ImportError:
+        raise ImportError("image_load needs Pillow, which is not in this "
+                          "offline image; datasets here use synthetic "
+                          "arrays instead")

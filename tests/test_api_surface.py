@@ -255,3 +255,40 @@ def test_small_api_gaps():
         cur = float(loss)
         assert prev is None or cur < prev + 1e-3
         prev = cur
+
+
+def test_misc_parity_apis():
+    """paddle.callbacks alias, version/sysconfig, utils.deprecated/
+    try_import/run_check, vision image-backend setters,
+    disable_signal_handler."""
+    import warnings
+
+    import paddle_tpu as paddle
+
+    assert paddle.callbacks.EarlyStopping is not None
+    assert paddle.version.full_version == paddle.__version__
+    assert paddle.sysconfig.get_include()
+    paddle.disable_signal_handler()
+
+    prev = paddle.vision.get_image_backend()
+    paddle.vision.set_image_backend("numpy")
+    assert paddle.vision.get_image_backend() == "numpy"
+    paddle.vision.set_image_backend(prev)
+    try:
+        import pytest
+        with pytest.raises(ValueError):
+            paddle.vision.set_image_backend("bogus")
+    except ImportError:
+        pass
+
+    @paddle.utils.deprecated(update_to="paddle.new_api", since="2.0")
+    def old():
+        return 7
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old() == 7
+        assert len(w) == 1 and "deprecated" in str(w[0].message)
+
+    import types
+    assert isinstance(paddle.utils.try_import("math"), types.ModuleType)

@@ -411,3 +411,36 @@ def test_p2p_and_object_collectives_api():
             d.P2POp("bogus", t, 0)
     except ImportError:
         pass
+
+
+def test_spmd_p2p_ring_shift():
+    """send/recv inside shard_map compile to a full-ring collective-permute
+    with uniform-shift semantics (the PP send-to-next/recv-from-prev
+    pattern); the matched pair moves every stage's buffer one hop."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed import collective as C
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("pp",))
+    g = C.new_group([0, 1, 2, 3], axis_name="pp")
+    xs = jnp.arange(4, dtype=jnp.float32).reshape(4, 1)
+
+    def recv_prev(x):
+        return C.recv(Tensor(x), src=3, group=g)._value  # shift 1
+
+    out = jax.shard_map(recv_prev, mesh=mesh, in_specs=P("pp", None),
+                        out_specs=P("pp", None), check_vma=False)(xs)
+    assert np.asarray(out).ravel().tolist() == [3.0, 0.0, 1.0, 2.0]
+
+    def send_next(x):
+        return C.send(Tensor(x), dst=1, group=g)._value
+
+    out = jax.shard_map(send_next, mesh=mesh, in_specs=P("pp", None),
+                        out_specs=P("pp", None), check_vma=False)(xs)
+    assert np.asarray(out).ravel().tolist() == [3.0, 0.0, 1.0, 2.0]
