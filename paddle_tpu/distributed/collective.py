@@ -300,7 +300,10 @@ def send(tensor, dst=0, group=None, sync_op=True):
     val = _unwrap(tensor)
     if isinstance(val, jax.core.Tracer):
         n = g.nranks
-        shift = (g.get_group_rank(dst) - g.get_group_rank(get_rank())) % n
+        # single controller: the caller's process rank may not belong to a
+        # subgroup — the shift is then relative to the group's rank 0
+        me = max(g.get_group_rank(get_rank()), 0)
+        shift = (g.get_group_rank(dst) - me) % n
         perm = [(i, (i + shift) % n) for i in range(n)]
         return Tensor(jax.lax.ppermute(val, ax, perm))
     raise InvalidArgumentError("eager send/recv requires a shard_map context or launch runtime")
@@ -319,7 +322,8 @@ def recv(tensor, src=0, group=None, sync_op=True):
     val = _unwrap(tensor)
     if isinstance(val, jax.core.Tracer):
         n = g.nranks
-        shift = (g.get_group_rank(get_rank()) - g.get_group_rank(src)) % n
+        me = max(g.get_group_rank(get_rank()), 0)
+        shift = (me - g.get_group_rank(src)) % n
         perm = [(i, (i + shift) % n) for i in range(n)]
         return Tensor(jax.lax.ppermute(val, ax, perm))
     raise InvalidArgumentError("eager send/recv requires a shard_map context or launch runtime")
@@ -376,9 +380,11 @@ class P2POp:
 
 
 def batch_isend_irecv(p2p_op_list):
-    """Launch a batch of P2POps; returns one task per op (reference
-    semantics; under SPMD the ppermute pairs compile into one
-    collective-permute)."""
+    """Launch a batch of P2POps; returns one task per op. NOTE the SPMD
+    convention (see send/recv): peers express UNIFORM SHIFTS and each op
+    RETURNS its result — recv returns a NEW tensor holding the peer's
+    payload rather than filling the passed buffer in place, so read the
+    returned tasks' values, not the original buffers."""
     tasks = []
     for p in p2p_op_list:
         if p.op is isend:
@@ -441,7 +447,7 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
             f"split(operation='linear') partitions a 2-D weight: axis must "
             f"be 0 (row-parallel) or 1 (column-parallel), got {axis}")
     config = (operation, tuple(size), axis, bool(gather_out),
-              bias_attr is not False)
+              bias_attr is not False, repr(weight_attr), num_partitions)
     cached = _split_layer_cache.get(name)
     if cached is not None and cached[0] != config:
         raise InvalidArgumentError(
