@@ -80,6 +80,7 @@ from . import quantization  # noqa: F401
 from . import sparse  # noqa: F401
 from . import signal  # noqa: F401
 from . import audio  # noqa: F401
+from . import multiprocessing  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import version  # noqa: F401
 from .hapi import callbacks  # noqa: F401  (paddle.callbacks alias)

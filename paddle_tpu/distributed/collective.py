@@ -300,10 +300,14 @@ def send(tensor, dst=0, group=None, sync_op=True):
     val = _unwrap(tensor)
     if isinstance(val, jax.core.Tracer):
         n = g.nranks
+        peer = g.get_group_rank(dst)
+        if peer < 0:
+            raise InvalidArgumentError(
+                f"send dst={dst} is not a member of group {g.ranks}")
         # single controller: the caller's process rank may not belong to a
         # subgroup — the shift is then relative to the group's rank 0
         me = max(g.get_group_rank(get_rank()), 0)
-        shift = (g.get_group_rank(dst) - me) % n
+        shift = (peer - me) % n
         perm = [(i, (i + shift) % n) for i in range(n)]
         return Tensor(jax.lax.ppermute(val, ax, perm))
     raise InvalidArgumentError("eager send/recv requires a shard_map context or launch runtime")
@@ -322,8 +326,12 @@ def recv(tensor, src=0, group=None, sync_op=True):
     val = _unwrap(tensor)
     if isinstance(val, jax.core.Tracer):
         n = g.nranks
+        peer = g.get_group_rank(src)
+        if peer < 0:
+            raise InvalidArgumentError(
+                f"recv src={src} is not a member of group {g.ranks}")
         me = max(g.get_group_rank(get_rank()), 0)
-        shift = (me - g.get_group_rank(src)) % n
+        shift = (me - peer) % n
         perm = [(i, (i + shift) % n) for i in range(n)]
         return Tensor(jax.lax.ppermute(val, ax, perm))
     raise InvalidArgumentError("eager send/recv requires a shard_map context or launch runtime")
@@ -426,6 +434,17 @@ def get_backend(group=None) -> str:
 _split_layer_cache = {}
 
 
+def _attr_key(attr):
+    """Stable value-based key for a ParamAttr-ish object (repr would embed
+    the memory address, making equal attrs look different)."""
+    if attr is None:
+        return None
+    fields = {k: v for k, v in vars(attr).items()
+              if isinstance(v, (str, int, float, bool, type(None)))} \
+        if hasattr(attr, "__dict__") else {}
+    return (type(attr).__name__, tuple(sorted(fields.items())))
+
+
 def split(x, size, operation="linear", axis=0, num_partitions=None,
           gather_out=True, weight_attr=None, bias_attr=None, name=None):
     """Megatron-style distributed fc/embedding (reference:
@@ -447,7 +466,7 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
             f"split(operation='linear') partitions a 2-D weight: axis must "
             f"be 0 (row-parallel) or 1 (column-parallel), got {axis}")
     config = (operation, tuple(size), axis, bool(gather_out),
-              bias_attr is not False, repr(weight_attr), num_partitions)
+              bias_attr is not False, _attr_key(weight_attr), num_partitions)
     cached = _split_layer_cache.get(name)
     if cached is not None and cached[0] != config:
         raise InvalidArgumentError(

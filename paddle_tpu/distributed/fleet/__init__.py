@@ -34,3 +34,38 @@ __all__ = [
     "recompute_sequential", "meta_parallel", "meta_optimizers", "utils",
     "PaddleCloudRoleMaker", "UserDefinedRoleMaker", "Role",
 ]
+
+
+class UtilBase:
+    """Cross-rank helper utilities (reference ``fleet.UtilBase`` /
+    ``fleet.util``): tiny wrappers over the eager collectives."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from ... import distributed as dist
+        from ...core.tensor import to_tensor
+
+        t = to_tensor(np.asarray(input))
+        op = {"sum": dist.ReduceOp.SUM, "max": dist.ReduceOp.MAX,
+              "min": dist.ReduceOp.MIN}[mode]
+        return dist.all_reduce(t, op=op).numpy()
+
+    def barrier(self, comm_world="worker"):
+        from ... import distributed as dist
+
+        dist.barrier()
+
+    def get_file_shard(self, files):
+        """Contiguous blocks, remainder to the lowest ranks (the
+        reference's split so pre-sorted file lists stay ordered)."""
+        from ... import distributed as dist
+
+        rank, world = dist.get_rank(), dist.get_world_size()
+        base, rem = divmod(len(files), world)
+        start = rank * base + min(rank, rem)
+        return files[start: start + base + (1 if rank < rem else 0)]
+
+
+util = UtilBase()
+__all__ += ["UtilBase", "util"]

@@ -1,0 +1,12 @@
+"""``paddle.multiprocessing`` (reference: ``python/paddle/multiprocessing``
+— torch-style shared-tensor multiprocessing). jax arrays are immutable and
+transfer by value, so this is the stdlib module plus the paddle entry
+points; DataLoader workers already use spawn contexts internally."""
+
+from multiprocessing import *  # noqa: F401,F403
+from multiprocessing import get_context as _get_context
+
+
+def get_context(method="spawn"):
+    """Spawn is the only fork-safe method once a TPU backend is live."""
+    return _get_context(method)

@@ -46,6 +46,7 @@ __all__ = [
     "cosine_similarity", "ctc_loss", "sigmoid_focal_loss", "square_error_cost",
     # attention
     "scaled_dot_product_attention", "sequence_mask", "pad",
+    "affine_grid", "grid_sample",
     # extras
     "pixel_unshuffle", "channel_shuffle", "fold", "pairwise_distance",
     "huber_loss", "triplet_margin_loss", "cosine_embedding_loss", "rrelu",
@@ -475,6 +476,78 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
         return out.reshape(n, c * ks[0] * ks[1], oh * ow)
 
     return run_op("unfold", f, x)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Sampling grid from affine matrices (reference:
+    ``paddle.nn.functional.affine_grid``). theta: [N, 2, 3];
+    out_shape: [N, C, H, W] -> grid [N, H, W, 2] in xy order."""
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def f(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, w)
+            ys = jnp.linspace(-1.0, 1.0, h)
+        else:
+            xs = (2.0 * jnp.arange(w) + 1.0) / w - 1.0
+            ys = (2.0 * jnp.arange(h) + 1.0) / h - 1.0
+        gx, gy = jnp.meshgrid(xs, ys)                  # [H, W]
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+        # grid[n,h,w,:] = theta[n] @ [x, y, 1]
+        return jnp.einsum("nij,hwj->nhwi", th.astype(jnp.float32),
+                          base).astype(th.dtype)
+
+    return run_op("affine_grid", f, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample ``x`` [N, C, H, W] at ``grid`` [N, Hg, Wg, 2] (xy in
+    [-1, 1]) — reference ``paddle.nn.functional.grid_sample``. Supports
+    bilinear/nearest with zeros/border padding."""
+    if mode not in ("bilinear", "nearest"):
+        raise InvalidArgumentError(f"grid_sample mode {mode!r} unsupported")
+    if padding_mode not in ("zeros", "border"):
+        raise InvalidArgumentError(
+            f"grid_sample padding_mode {padding_mode!r} unsupported")
+
+    def f(xa, ga):
+        n, c, h, w = xa.shape
+        gx = ga[..., 0].astype(jnp.float32)
+        gy = ga[..., 1].astype(jnp.float32)
+        if align_corners:
+            ix = (gx + 1.0) * (w - 1) / 2.0
+            iy = (gy + 1.0) * (h - 1) / 2.0
+        else:
+            ix = ((gx + 1.0) * w - 1.0) / 2.0
+            iy = ((gy + 1.0) * h - 1.0) / 2.0
+
+        def gather(yy, xx):
+            # [N, Hg, Wg] integer coords -> values [N, C, Hg, Wg] with
+            # validity masking (zeros) or clamping (border)
+            valid = ((xx >= 0) & (xx <= w - 1) & (yy >= 0) & (yy <= h - 1))
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            vals = xa[jnp.arange(n)[:, None, None], :, yc, xc]  # [N,Hg,Wg,C]
+            vals = jnp.moveaxis(vals, -1, 1)                    # [N,C,Hg,Wg]
+            if padding_mode == "zeros":
+                vals = vals * valid[:, None].astype(vals.dtype)
+            return vals
+
+        if mode == "nearest":
+            return gather(jnp.round(iy), jnp.round(ix)).astype(xa.dtype)
+
+        x0, y0 = jnp.floor(ix), jnp.floor(iy)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (ix - x0)[:, None]
+        wy = (iy - y0)[:, None]
+        out = (gather(y0, x0) * (1 - wx) * (1 - wy)
+               + gather(y0, x1) * wx * (1 - wy)
+               + gather(y1, x0) * (1 - wx) * wy
+               + gather(y1, x1) * wx * wy)
+        return out.astype(xa.dtype)
+
+    return run_op("grid_sample", f, x, grid)
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest",

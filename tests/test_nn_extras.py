@@ -252,3 +252,30 @@ def test_incubate_fused_layers():
 
     assert paddle.incubate.softmax_mask_fuse(
         x, paddle.zeros_like(x)).shape == x.shape
+
+
+def test_affine_grid_and_grid_sample():
+    """Identity/flip affine warps reproduce the image; nearest/border
+    modes run; gradients flow (reference: F.affine_grid/F.grid_sample)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import functional as F
+
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(2, 3, 5, 7).astype(np.float32))
+    ident = np.tile(np.array([[1, 0, 0], [0, 1, 0]], np.float32), (2, 1, 1))
+    grid = F.affine_grid(paddle.to_tensor(ident), [2, 3, 5, 7],
+                         align_corners=True)
+    out = F.grid_sample(x, grid, align_corners=True)
+    np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-5)
+
+    flip = np.tile(np.array([[-1, 0, 0], [0, 1, 0]], np.float32), (2, 1, 1))
+    gridf = F.affine_grid(paddle.to_tensor(flip), [2, 3, 5, 7],
+                          align_corners=True)
+    outf = F.grid_sample(x, gridf, align_corners=True)
+    np.testing.assert_allclose(outf.numpy(), x.numpy()[..., ::-1], atol=1e-5)
+
+    F.grid_sample(x, grid, mode="nearest", padding_mode="border")
+    x.stop_gradient = False
+    paddle.sum(F.grid_sample(x, grid) ** 2).backward()
+    assert x.grad is not None
