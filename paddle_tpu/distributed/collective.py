@@ -439,9 +439,15 @@ def _attr_key(attr):
     the memory address, making equal attrs look different)."""
     if attr is None:
         return None
-    fields = {k: v for k, v in vars(attr).items()
-              if isinstance(v, (str, int, float, bool, type(None)))} \
-        if hasattr(attr, "__dict__") else {}
+    fields = {}
+    if hasattr(attr, "__dict__"):
+        for k, v in vars(attr).items():
+            if isinstance(v, (str, int, float, bool, type(None))):
+                fields[k] = v
+            else:  # initializer/regularizer objects: type + scalar config
+                sub = {sk: sv for sk, sv in getattr(v, "__dict__", {}).items()
+                       if isinstance(sv, (str, int, float, bool, type(None)))}
+                fields[k] = (type(v).__name__, tuple(sorted(sub.items())))
     return (type(attr).__name__, tuple(sorted(fields.items())))
 
 

@@ -47,9 +47,15 @@ class UtilBase:
         from ...core.tensor import to_tensor
 
         t = to_tensor(np.asarray(input))
-        op = {"sum": dist.ReduceOp.SUM, "max": dist.ReduceOp.MAX,
-              "min": dist.ReduceOp.MIN}[mode]
-        return dist.all_reduce(t, op=op).numpy()
+        ops = {"sum": dist.ReduceOp.SUM, "max": dist.ReduceOp.MAX,
+               "min": dist.ReduceOp.MIN}
+        if mode not in ops:
+            from ...enforce import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"UtilBase.all_reduce mode must be one of {sorted(ops)}, "
+                f"got {mode!r}")
+        return dist.all_reduce(t, op=ops[mode]).numpy()
 
     def barrier(self, comm_world="worker"):
         from ... import distributed as dist

@@ -1,10 +1,34 @@
 """``paddle.multiprocessing`` (reference: ``python/paddle/multiprocessing``
 — torch-style shared-tensor multiprocessing). jax arrays are immutable and
-transfer by value, so this is the stdlib module plus the paddle entry
-points; DataLoader workers already use spawn contexts internally."""
+transfer by value, so the paddle-specific shared-memory machinery is
+unnecessary; what matters is FORK SAFETY: once a TPU/JAX backend is live,
+forked children inherit broken backend state. Everything here is therefore
+bound to the SPAWN context (Process, Pool, Queue, ...), unlike the stdlib
+default."""
 
-from multiprocessing import *  # noqa: F401,F403
 from multiprocessing import get_context as _get_context
+
+_spawn = _get_context("spawn")
+
+Process = _spawn.Process
+Pool = _spawn.Pool
+Queue = _spawn.Queue
+SimpleQueue = _spawn.SimpleQueue
+JoinableQueue = _spawn.JoinableQueue
+Event = _spawn.Event
+Lock = _spawn.Lock
+RLock = _spawn.RLock
+Semaphore = _spawn.Semaphore
+BoundedSemaphore = _spawn.BoundedSemaphore
+Condition = _spawn.Condition
+Barrier = _spawn.Barrier
+Manager = _spawn.Manager
+Pipe = _spawn.Pipe
+Value = _spawn.Value
+Array = _spawn.Array
+active_children = _spawn.active_children
+cpu_count = _spawn.cpu_count
+current_process = _spawn.current_process
 
 
 def get_context(method="spawn"):

@@ -483,6 +483,10 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
     ``paddle.nn.functional.affine_grid``). theta: [N, 2, 3];
     out_shape: [N, C, H, W] -> grid [N, H, W, 2] in xy order."""
     n, _, h, w = [int(s) for s in out_shape]
+    if tuple(theta.shape) != (n, 2, 3):
+        raise InvalidArgumentError(
+            f"affine_grid: theta must be [{n}, 2, 3] to match "
+            f"out_shape {list(out_shape)}, got {list(theta.shape)}")
 
     def f(th):
         if align_corners:
@@ -493,9 +497,12 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
             ys = (2.0 * jnp.arange(h) + 1.0) / h - 1.0
         gx, gy = jnp.meshgrid(xs, ys)                  # [H, W]
         base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
-        # grid[n,h,w,:] = theta[n] @ [x, y, 1]
-        return jnp.einsum("nij,hwj->nhwi", th.astype(jnp.float32),
-                          base).astype(th.dtype)
+        # grid[n,h,w,:] = theta[n] @ [x, y, 1]. HIGHEST precision: on TPU
+        # the default einsum runs the MXU's bf16 passes, which quantises
+        # the sampling COORDINATES (identity warps came back 4e-3 off)
+        return jnp.einsum("nij,hwj->nhwi", th.astype(jnp.float32), base,
+                          precision=jax.lax.Precision.HIGHEST
+                          ).astype(th.dtype)
 
     return run_op("affine_grid", f, theta)
 
