@@ -164,3 +164,54 @@ def test_kv_cache_generate_matches_full_recompute():
         toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
     gen2 = llama.generate(gparams, prompt, gcfg, max_new_tokens=4)
     assert bool(jnp.all(gen2 == jnp.stack(ref2, axis=1)))
+
+
+def test_beam_search_generate():
+    """Beam search: num_beams=1 is exactly greedy; wider beams find
+    sequences with >= total log-likelihood; eos freezing runs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(7))
+    prompt = jnp.array(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 9)),
+        jnp.int32)
+
+    greedy = llama.generate(params, prompt, cfg, max_new_tokens=6)
+    beam1 = llama.beam_search_generate(params, prompt, cfg,
+                                       max_new_tokens=6, num_beams=1)
+    assert bool(jnp.all(beam1 == greedy))
+
+    def seq_logprob(toks):
+        full = jnp.concatenate([prompt, toks], axis=1)
+        lp = jax.nn.log_softmax(
+            llama.forward(params, full, cfg).astype(jnp.float32), axis=-1)
+        total = jnp.zeros((2,))
+        for i in range(toks.shape[1]):
+            pos = prompt.shape[1] - 1 + i
+            total = total + lp[jnp.arange(2), pos, toks[:, i]]
+        return total
+
+    beam4 = llama.beam_search_generate(params, prompt, cfg,
+                                       max_new_tokens=6, num_beams=4)
+    assert bool(jnp.all(seq_logprob(beam4) >= seq_logprob(greedy) - 1e-4))
+
+    eosed = llama.beam_search_generate(params, prompt, cfg,
+                                       max_new_tokens=6, num_beams=3,
+                                       eos_token_id=5)
+    assert eosed.shape == (2, 6)
+
+    # length penalty normalises per-beam (by each hypothesis's OWN length):
+    # with an EOS on the beam path, p=0 favours the early-finished beam's
+    # raw score while a large p favours the full-length hypothesis
+    a = llama.beam_search_generate(params, prompt, cfg, max_new_tokens=6,
+                                   num_beams=3, eos_token_id=50,
+                                   length_penalty=0.0)
+    b = llama.beam_search_generate(params, prompt, cfg, max_new_tokens=6,
+                                   num_beams=3, eos_token_id=50,
+                                   length_penalty=4.0)
+    assert not bool(jnp.all(a == b))
