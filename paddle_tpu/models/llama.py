@@ -505,7 +505,7 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos):
     return logits.astype(jnp.float32), {"k": kcs, "v": vcs}
 
 
-def _sample(logits, temperature, top_k, key):
+def _sample(logits, temperature, top_k, key, top_p=1.0):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
@@ -513,14 +513,25 @@ def _sample(logits, temperature, top_k, key):
         k = min(int(top_k), logits.shape[-1])
         kth = jax.lax.top_k(logits, k)[0][:, -1][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        # nucleus sampling: keep the smallest prefix of the sorted probs
+        # whose mass reaches top_p (the first token always survives)
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p              # mass BEFORE this token
+        keep = keep.at[:, 0].set(True)          # the top token always survives
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1)
+        logits = jnp.where(logits < cutoff[:, None], -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
 def generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int = 32,
              max_len: Optional[int] = None, temperature: float = 0.0,
-             top_k: int = 0, seed: int = 0) -> jax.Array:
+             top_k: int = 0, top_p: float = 1.0, seed: int = 0) -> jax.Array:
     """Autoregressive generation: greedy at temperature 0, otherwise
-    temperature/top-k sampling. Returns [B, max_new_tokens] int32.
+    temperature sampling with optional top-k and/or nucleus (top-p)
+    filtering. Returns [B, max_new_tokens] int32.
 
     Prefill is one jitted program; every decode token is one jitted step
     with the cache DONATED (in-place on device). Sampling and the position
@@ -538,12 +549,13 @@ def generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int = 32,
         raise ValueError(f"prompt ({S}) + max_new_tokens ({max_new_tokens}) "
                          f"needs {S + max_new_tokens - 1} cache slots but "
                          f"max_len is {max_len}")
-    prefill = _prefill_program(cfg, max_len, float(temperature), int(top_k))
+    prefill = _prefill_program(cfg, max_len, float(temperature), int(top_k),
+                               float(top_p))
     cache, nxt, pos, key = prefill(params, prompt, jax.random.PRNGKey(seed))
     if max_new_tokens == 1:
         return nxt[:, None]
     decode_all = _decode_program(cfg, max_new_tokens, float(temperature),
-                                 int(top_k))
+                                 int(top_k), float(top_p))
     toks, _ = decode_all(params, cache, nxt, pos, key)
     return jnp.concatenate([nxt[:, None], toks.T], axis=1)
 
@@ -559,14 +571,14 @@ def generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int = 32,
 
 @functools.lru_cache(maxsize=32)
 def _prefill_program(cfg: LlamaConfig, max_len: int, temperature: float,
-                     top_k: int):
+                     top_k: int, top_p: float = 1.0):
     @jax.jit
     def prefill(params, prompt, key):
         cache = init_kv_cache(cfg, prompt.shape[0], max_len)
         logits, cache = forward_with_cache(params, prompt, cfg, cache,
                                            jnp.int32(0))
         key, sub = jax.random.split(key)
-        nxt = _sample(logits, temperature, top_k, sub)
+        nxt = _sample(logits, temperature, top_k, sub, top_p)
         return cache, nxt, jnp.int32(prompt.shape[1]), key
 
     return prefill
@@ -574,7 +586,7 @@ def _prefill_program(cfg: LlamaConfig, max_len: int, temperature: float,
 
 @functools.lru_cache(maxsize=32)
 def _decode_program(cfg: LlamaConfig, max_new_tokens: int,
-                    temperature: float, top_k: int):
+                    temperature: float, top_k: int, top_p: float = 1.0):
     @functools.partial(jax.jit, donate_argnums=(1,))
     def decode_all(params, cache, nxt, pos, key):
         # the whole decode loop is ONE compiled program (lax.scan): zero
@@ -585,7 +597,7 @@ def _decode_program(cfg: LlamaConfig, max_new_tokens: int,
             logits, cache = forward_with_cache(params, nxt[:, None], cfg,
                                                cache, pos)
             key, sub = jax.random.split(key)
-            nxt = _sample(logits, temperature, top_k, sub)
+            nxt = _sample(logits, temperature, top_k, sub, top_p)
             return (cache, nxt, pos + 1, key), nxt
 
         (cache, *_), toks = jax.lax.scan(
@@ -700,11 +712,11 @@ def _beam_program(cfg: LlamaConfig, max_new_tokens: int, num_beams: int,
             carry, _ = jax.lax.scan(body, carry,
                                     jnp.arange(1, max_new_tokens))
         _, _, _, scores, _, hist, lengths = carry
-        if length_penalty != 1.0:
-            # reference BeamSearchScorer: each hypothesis normalised by its
-            # OWN length (EOS position), so the penalty can reorder early-
-            # finished vs full-length beams
-            scores = scores / (lengths ** length_penalty)
+        # reference BeamSearchScorer: score = sum_logprobs / len**penalty,
+        # each hypothesis normalised by its OWN length (EOS position) — at
+        # the default penalty of 1.0 this is plain per-length averaging;
+        # penalty 0.0 disables normalisation
+        scores = scores / (lengths ** length_penalty)
         best = jnp.argmax(scores, axis=-1)                # [B]
         return jnp.take_along_axis(
             hist, best[:, None, None], axis=1)[:, 0]      # [B, T]

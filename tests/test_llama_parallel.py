@@ -152,6 +152,12 @@ def test_kv_cache_generate_matches_full_recompute():
     assert sampled.shape == (2, 5)
     assert bool(jnp.all((sampled >= 0) & (sampled < cfg.vocab_size)))
 
+    # nucleus sampling: top_p -> 0 keeps only the argmax token, so the
+    # sampled output degenerates to greedy at any temperature
+    nucleus = llama.generate(params, prompt, cfg, max_new_tokens=6,
+                             temperature=1.0, top_p=1e-6, seed=9)
+    assert bool(jnp.all(nucleus == gen))
+
     # GQA: grouped-einsum cache attention (unrepeated KV cache)
     gcfg = llama.LlamaConfig.tiny(num_heads=4, num_kv_heads=2)
     gparams = llama.init_params(gcfg, jax.random.PRNGKey(3))
