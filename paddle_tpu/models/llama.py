@@ -242,8 +242,11 @@ def _layer_qkv(cfg: LlamaConfig, x, lp):
         rep = cfg.num_heads // cfg.num_kv_heads
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    # heads are mp-sharded (follows from wq's output sharding)
-    q = wsc(q, P(("dp", "sharding"), None, "mp", None))
+    # heads are mp-sharded (follows from wq's output sharding); under SP
+    # the seq dim STAYS sep-sharded — pinning it replicated here would
+    # all-gather the sequence right before the ring attention
+    seq_ax = "sep" if cfg.sequence_parallel else None
+    q = wsc(q, P(("dp", "sharding"), seq_ax, "mp", None))
     return q, k, v
 
 
@@ -260,10 +263,27 @@ def _layer_post(cfg: LlamaConfig, x, attn, lp):
     return x
 
 
+def _attention(cfg: LlamaConfig, q, k, v):
+    """Training attention dispatch: under sequence parallelism with a >1
+    'sep' axis the seq dim is SHARDED, so attention must be the RING
+    (context-parallel) formulation — K/V blocks ppermute around the sep
+    ring with online-softmax merging — instead of letting GSPMD all-gather
+    the whole sequence onto every device. The axis/divisibility fallback
+    lives in context_parallel_attention itself (one guard, not two)."""
+    if cfg.sequence_parallel:
+        from ..ops.pallas.ring_attention import context_parallel_attention
+
+        return context_parallel_attention(
+            q, k, v, axis_name="sep", is_causal=True,
+            batch_axes=("dp", "sharding"), head_axes="mp",
+            fallback=lambda: dot_product_attention(q, k, v, is_causal=True))
+    return dot_product_attention(q, k, v, is_causal=True)
+
+
 def _decoder_layer(cfg: LlamaConfig, x, lp):
     """One transformer block. x: [B, S, H]; lp: this layer's weight slice."""
     q, k, v = _layer_qkv(cfg, x, lp)
-    attn = dot_product_attention(q, k, v, is_causal=True)
+    attn = _attention(cfg, q, k, v)
     return _layer_post(cfg, x, attn, lp)
 
 
@@ -289,7 +309,7 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array,
 
         def body(x, lp):
             q, k, v = qkv_part(x, lp)
-            attn = dot_product_attention(q, k, v, is_causal=True)
+            attn = _attention(cfg, q, k, v)
             return post_part(x, attn, lp), None
     else:
         def body(x, lp):

@@ -128,24 +128,50 @@ RingFlashAttention = ring_attention
 
 
 def context_parallel_attention(q, k, v, mesh=None, axis_name: str = "sep",
-                               is_causal: bool = False):
+                               is_causal: bool = False, batch_axes=None,
+                               head_axes=None, fallback=None):
     """GSPMD-level entry: q/k/v are *global* arrays; shard the seq dim over
-    ``axis_name`` and run ring attention under shard_map. Falls back to
-    plain attention when the axis has size 1 / no mesh."""
+    ``axis_name`` and run ring attention under shard_map. Falls back
+    (``fallback()`` if given, else the XLA formulation) when the axis has
+    size 1 / no mesh, or when any sharded dim doesn't divide its axes.
+
+    ``batch_axes``/``head_axes`` name the mesh axes the batch and head
+    dims are already sharded over (e.g. ('dp', 'sharding') and 'mp' in the
+    hybrid llama layout) so the shard_map specs match the surrounding
+    GSPMD sharding — those axes stay pure data parallelism inside the
+    ring."""
     from jax.sharding import PartitionSpec as P
 
     from ...parallel.mesh import get_mesh
     from .flash_attention import _xla_attention
 
+    def fall_back():
+        if fallback is not None:
+            return fallback()
+        return _xla_attention(q, k, v, is_causal=is_causal)
+
     mesh = mesh or get_mesh()
     if mesh is None or axis_name not in mesh.axis_names or \
             mesh.shape[axis_name] <= 1:
-        return _xla_attention(q, k, v, is_causal=is_causal)
-    n = mesh.shape[axis_name]
-    if q.shape[1] % n:
-        return _xla_attention(q, k, v, is_causal=is_causal)
+        return fall_back()
 
-    spec = P(None, axis_name, None, None)
+    def _present(axes):
+        if axes is None:
+            return None
+        axes = tuple(a for a in (axes if isinstance(axes, (tuple, list))
+                                 else (axes,)) if a in mesh.axis_names)
+        return axes or None
+
+    baxes, haxes = _present(batch_axes), _present(head_axes)
+    b_size = int(np.prod([mesh.shape[a] for a in (baxes or ())]))
+    h_size = int(np.prod([mesh.shape[a] for a in ((haxes,) if
+                          isinstance(haxes, str) else (haxes or ()))]))
+    if (q.shape[1] % mesh.shape[axis_name]
+            or q.shape[0] % max(b_size, 1)
+            or q.shape[2] % max(h_size, 1)):
+        return fall_back()
+
+    spec = P(baxes, axis_name, haxes, None)
     fn = jax.shard_map(
         functools.partial(ring_attention, axis_name=axis_name,
                           is_causal=is_causal),
