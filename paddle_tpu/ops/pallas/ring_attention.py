@@ -164,11 +164,10 @@ def context_parallel_attention(q, k, v, mesh=None, axis_name: str = "sep",
 
     baxes, haxes = _present(batch_axes), _present(head_axes)
     b_size = int(np.prod([mesh.shape[a] for a in (baxes or ())]))
-    h_size = int(np.prod([mesh.shape[a] for a in ((haxes,) if
-                          isinstance(haxes, str) else (haxes or ()))]))
+    h_size = int(np.prod([mesh.shape[a] for a in (haxes or ())]))
     if (q.shape[1] % mesh.shape[axis_name]
-            or q.shape[0] % max(b_size, 1)
-            or q.shape[2] % max(h_size, 1)):
+            or q.shape[0] % b_size
+            or q.shape[2] % h_size):
         return fall_back()
 
     spec = P(baxes, axis_name, haxes, None)

@@ -163,3 +163,53 @@ class TestLauncher:
             cwd="/root/repo", env=env, timeout=60)
         assert rc.returncode == 0
         assert marker.read_text() == "2"
+
+    def test_two_process_rendezvous_through_store(self, tmp_path):
+        """A REAL 2-process pod: the launcher spawns both ranks, each
+        connects to the master's C++ TCPStore from the env contract,
+        crosses a barrier, publishes its rank key, and rank 0 verifies
+        both arrived — the reference's loopback fake-multi-node recipe
+        (SURVEY §4) end to end."""
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent("""
+            import os
+            from paddle_tpu.distributed.store import TCPStore
+
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+            world = int(os.environ["PADDLE_TRAINERS_NUM"])
+            master = os.environ["PADDLE_MASTER"]
+            host, port = master.rsplit(":", 1)
+            store = TCPStore(host=host, port=int(port),
+                             is_master=(rank == 0), world_size=world)
+            store.set(f"hello_{rank}", str(rank).encode())
+            store.barrier("rdv", timeout_ms=30000)
+            if rank == 0:
+                got = sorted(int(store.get(f"hello_{r}", timeout_ms=10000))
+                             for r in range(world))
+                assert got == list(range(world)), got
+                # the master must shut down LAST: wait for every other
+                # rank's done-mark before closing the store server
+                for r in range(1, world):
+                    store.get(f"done_{r}", timeout_ms=10000)
+                print("RENDEZVOUS-OK", got)
+            else:
+                store.set(f"done_{rank}", b"1")
+            store.close()
+        """))
+        import socket
+
+        with socket.socket() as s:  # unique master port: no cross-test
+            s.bind(("127.0.0.1", 0))  # TIME_WAIT collisions on the default
+            free_port = s.getsockname()[1]
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        rc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2",
+             "--master", f"127.0.0.1:{free_port}",
+             "--log_dir", str(tmp_path / "log"), str(script)],
+            cwd="/root/repo", env=env, timeout=120)
+        assert rc.returncode == 0
+        log = (tmp_path / "log" / "workerlog.0").read_text()
+        assert "RENDEZVOUS-OK [0, 1]" in log
