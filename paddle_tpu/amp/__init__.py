@@ -222,3 +222,4 @@ def is_float16_supported(place=None) -> bool:
 
 
 __all__ += ["is_bfloat16_supported", "is_float16_supported"]
+from . import debugging  # noqa: E402,F401

@@ -1,0 +1,64 @@
+"""``paddle.amp.debugging`` (reference:
+``python/paddle/amp/debugging.py``): numeric-anomaly tooling for mixed
+precision. TPU-native: the per-op NaN/Inf scan rides the dispatcher's
+``check_nan_inf`` flag (the reference's ``FLAGS_check_nan_inf``)."""
+
+from __future__ import annotations
+
+from .. import flags as _flags
+from ..core.tensor import Tensor
+
+__all__ = ["enable_operator_stats_collection",
+           "disable_operator_stats_collection", "check_numerics",
+           "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker"]
+
+
+class TensorCheckerConfig:
+    """Configuration for the tensor checker (reference signature)."""
+
+    def __init__(self, enable=True, debug_mode=None, output_dir=None,
+                 checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list or []
+        self.skipped_op_list = skipped_op_list or []
+
+
+def enable_tensor_checker(config: TensorCheckerConfig) -> None:
+    """Turn on the dispatcher's per-op NaN/Inf scan."""
+    _flags.set_flags({"check_nan_inf": bool(config.enable)})
+
+
+def disable_tensor_checker() -> None:
+    _flags.set_flags({"check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Raise on NaN/Inf in ``tensor`` (reference ``check_numerics``)."""
+    import numpy as np
+
+    v = tensor.numpy() if isinstance(tensor, Tensor) else np.asarray(tensor)
+    if not np.isfinite(v).all():
+        n_nan = int(np.isnan(v).sum())
+        n_inf = int(np.isinf(v).sum())
+        raise FloatingPointError(
+            f"check_numerics: {op_type or 'tensor'} {var_name or ''} has "
+            f"{n_nan} NaN and {n_inf} Inf values")
+    return tensor
+
+
+_op_stats = [False]
+
+
+def enable_operator_stats_collection() -> None:
+    """The reference counts per-dtype op calls during autocast; here the
+    dispatcher's op registry serves introspection, so this toggles the
+    flag for API parity."""
+    _op_stats[0] = True
+
+
+def disable_operator_stats_collection() -> None:
+    _op_stats[0] = False

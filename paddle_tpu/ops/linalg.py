@@ -16,7 +16,8 @@ from .registry import register_op
 
 __all__ = [
     "cholesky", "inv", "det", "slogdet", "svd", "qr", "eigh", "eigvalsh",
-    "eig", "eigvals", "matrix_power", "matrix_rank", "pinv", "solve",
+    "eig", "eigvals", "matrix_exp", "matrix_power", "matrix_rank", "pinv",
+    "solve",
     "triangular_solve", "cholesky_solve", "lstsq", "lu", "cond", "cov",
     "corrcoef", "householder_product", "multi_dot", "norm",
 ]
@@ -186,3 +187,12 @@ def multi_dot(tensors, name=None):
 
 
 from .reduction import norm  # re-export under paddle.linalg.norm
+
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential (reference ``paddle.linalg.matrix_exp``) via the
+    scaling-and-squaring Padé implementation in jax.scipy."""
+    from jax.scipy.linalg import expm
+
+    return run_op("matrix_exp", lambda a: expm(a), x)

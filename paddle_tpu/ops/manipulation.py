@@ -20,7 +20,8 @@ from .registry import register_op
 
 __all__ = [
     "reshape", "reshape_", "flatten", "unflatten", "transpose", "moveaxis",
-    "swapaxes", "numel", "rank",
+    "swapaxes", "numel", "rank", "block_diag", "combinations",
+    "cartesian_prod",
     "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "concat", "stack",
     "split", "chunk", "unbind", "tile", "expand", "expand_as", "broadcast_to",
     "broadcast_tensors", "flip", "rot90", "roll", "gather", "gather_nd",
@@ -602,3 +603,54 @@ def atleast_3d(*xs, name=None):
 
 def tolist(x):
     return x.tolist()
+
+
+
+def block_diag(inputs, name=None):
+    """Block-diagonal matrix from blocks of rank <= 2 (reference
+    ``paddle.block_diag``; higher ranks are rejected there too).
+    Differentiable — inputs go through run_op untouched."""
+    from jax.scipy.linalg import block_diag as _bd
+
+    tensors = [x if isinstance(x, Tensor) else to_tensor(jnp.asarray(x))
+               for x in inputs]
+    for i, t in enumerate(tensors):
+        if t.ndim > 2:
+            raise InvalidArgumentError(
+                f"block_diag inputs must have ndim <= 2; input {i} has "
+                f"shape {list(t.shape)}")
+
+    def f(*vs):
+        return _bd(*[v if v.ndim == 2 else v.reshape(1, -1) for v in vs])
+
+    return run_op("block_diag", f, *tensors)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """r-length combinations of a 1-D tensor's elements (reference
+    ``paddle.combinations``). Index sets are host math (shapes must be
+    static); the gather is traced."""
+    import itertools
+
+    if x.ndim != 1:
+        raise InvalidArgumentError(
+            f"combinations expects a 1-D tensor, got shape {list(x.shape)}")
+    n = int(x.shape[0])
+    it = (itertools.combinations_with_replacement if with_replacement
+          else itertools.combinations)
+    idx = np.asarray(list(it(range(n), r)), np.int32).reshape(-1, r)
+
+    def f(a):
+        return a[idx]
+
+    return run_op("combinations", f, x)
+
+
+def cartesian_prod(xs, name=None):
+    """Cartesian product of 1-D tensors (reference
+    ``paddle.cartesian_prod``): [prod(n_i), len(xs)]."""
+    def f(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.ravel() for g in grids], axis=-1)
+
+    return run_op("cartesian_prod", f, *xs)

@@ -27,7 +27,8 @@ __all__ = [
     "minimum", "fmax", "fmin", "erf", "erfinv", "lerp", "lgamma", "digamma",
     "logit", "logaddexp", "hypot", "nan_to_num", "deg2rad", "rad2deg",
     "cumsum", "cumprod", "cummax", "cummin", "diff", "trace", "kron",
-    "isnan", "isinf", "isfinite", "scale", "stanh", "rsqrt_",
+    "isnan", "isinf", "isposinf", "isneginf", "isfinite", "scale", "stanh",
+    "rsqrt_",
     "increment", "multiplex", "gcd", "lcm",
 ]
 
@@ -116,6 +117,10 @@ deg2rad = _unary("deg2rad", lambda a: jnp.deg2rad(a))
 rad2deg = _unary("rad2deg", lambda a: jnp.rad2deg(a))
 isnan = _unary("isnan", lambda a: jnp.isnan(a), differentiable=False)
 isinf = _unary("isinf", lambda a: jnp.isinf(a), differentiable=False)
+isposinf = _unary("isposinf", lambda a: jnp.isposinf(a),
+                  differentiable=False)
+isneginf = _unary("isneginf", lambda a: jnp.isneginf(a),
+                  differentiable=False)
 isfinite = _unary("isfinite", lambda a: jnp.isfinite(a), differentiable=False)
 stanh = _unary("stanh", lambda a: 1.7159 * jnp.tanh(a * 2.0 / 3.0))
 

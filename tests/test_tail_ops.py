@@ -139,3 +139,48 @@ def test_review_fixes():
                               _t(np.ones(2, np.float32)))
     # scalar coercion through the shared helpers
     np.testing.assert_allclose(paddle.sinc(0.0).numpy(), 1.0)
+
+
+def test_long_tail_additions_round1b():
+    """matrix_exp, isposinf/isneginf, block_diag, combinations,
+    cartesian_prod, amp.debugging — late parity additions."""
+    import numpy as np
+    import scipy.linalg as sl
+
+    import paddle_tpu as paddle
+    from paddle_tpu.amp import debugging as D
+
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.]], np.float32))
+    np.testing.assert_allclose(paddle.linalg.matrix_exp(x).numpy(),
+                               sl.expm(x.numpy()), rtol=2e-4)
+
+    t = paddle.to_tensor(np.array([1.0, -np.inf, np.inf, np.nan], np.float32))
+    assert paddle.isposinf(t).numpy().tolist() == [False, False, True, False]
+    assert paddle.isneginf(t).numpy().tolist() == [False, True, False, False]
+
+    bd = paddle.block_diag([paddle.to_tensor(np.ones((2, 2), np.float32)),
+                            paddle.to_tensor(np.full((1, 3), 2., np.float32))])
+    assert bd.shape == [3, 5]
+    assert float(bd.numpy()[0, 3]) == 0.0 and float(bd.numpy()[2, 2]) == 2.0
+
+    comb = paddle.combinations(paddle.to_tensor(np.arange(4, dtype=np.int32)))
+    assert comb.shape == [6, 2]
+    combr = paddle.combinations(
+        paddle.to_tensor(np.arange(3, dtype=np.int32)), 2,
+        with_replacement=True)
+    assert combr.shape == [6, 2]
+
+    cp = paddle.cartesian_prod(
+        [paddle.to_tensor(np.array([1, 2], np.int32)),
+         paddle.to_tensor(np.array([3, 4, 5], np.int32))])
+    assert cp.shape == [6, 2] and cp.numpy().tolist()[0] == [1, 3]
+
+    try:
+        D.check_numerics(t)
+        raise AssertionError("check_numerics should have raised")
+    except FloatingPointError:
+        pass
+    D.enable_tensor_checker(D.TensorCheckerConfig(enable=True))
+    assert paddle.get_flags("check_nan_inf")["check_nan_inf"]
+    D.disable_tensor_checker()
+    assert not paddle.get_flags("check_nan_inf")["check_nan_inf"]
