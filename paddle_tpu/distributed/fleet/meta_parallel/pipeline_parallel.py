@@ -49,6 +49,7 @@ class PipelineParallel(Layer):
         self.micro_batch_size = getattr(pcfg, "micro_batch_size", 1)
         self.accumulate_steps = getattr(pcfg, "accumulate_steps", 1)
         self.total_loss = None
+        self._1f1b_engine = None
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
@@ -92,9 +93,28 @@ class PipelineParallel(Layer):
             return None
         return data if isinstance(data, Tensor) else None
 
-    def train_batch(self, data, optimizer=None, lr_scheduler=None, scaler=None):
+    def train_batch(self, data, optimizer=None, lr_scheduler=None, scaler=None,
+                    schedule: Optional[str] = None):
         """One global batch: micro-batch loop with grad accumulation, then a
-        single optimizer step — loss-equivalent to the reference's 1F1B."""
+        single optimizer step — loss-equivalent to the reference's 1F1B.
+
+        ``schedule='1f1b'`` selects the compiled SPMD 1F1B program
+        (``pp_1f1b.OneFOneBEngine``): shard_map over the ``pp`` mesh axis,
+        ``lax.ppermute`` activation/grad rings, stage-local rotating
+        activation buffers, interleaved virtual stages. Restrictions (and
+        why) are documented on that module; the default ``None`` keeps the
+        loss-equivalent eager grad-accumulation loop.
+        """
+        if schedule is not None:
+            s = schedule.strip().lower()
+            if s in ("1f1b", "1f1b-compiled"):
+                return self._train_batch_1f1b(data, optimizer, lr_scheduler,
+                                              scaler)
+            if s not in ("fthenb", "grad_accum"):
+                raise ValueError(
+                    f"unknown pipeline schedule {schedule!r}; accepted: "
+                    "'1f1b' (compiled SPMD program), 'FThenB'/'grad_accum' "
+                    "(eager micro-batch loop), or None")
         micros = self._split_micro(data, self._num_micro(data))
         # weight each micro-loss by its share of the global batch so the
         # accumulated gradient equals the full-batch mean even when the
@@ -129,6 +149,30 @@ class PipelineParallel(Layer):
             if lr_scheduler is not None:
                 lr_scheduler.step()
         return total
+
+    def _train_batch_1f1b(self, data, optimizer=None, lr_scheduler=None,
+                          scaler=None):
+        if scaler is not None:
+            raise NotImplementedError(
+                "GradScaler is not supported with the compiled 1F1B "
+                "schedule; on TPU train in bf16 (no loss scaling needed) "
+                "or use the grad-accumulation schedule")
+        if not (isinstance(data, (tuple, list)) and len(data) == 2):
+            raise ValueError("1F1B schedule expects data=(inputs, labels)")
+        x, y = data
+        if self._1f1b_engine is None:
+            from ....parallel.mesh import get_mesh
+            from .pp_1f1b import OneFOneBEngine
+
+            self._1f1b_engine = OneFOneBEngine(self._layers, get_mesh())
+        loss = self._1f1b_engine.train_batch(x, y, self._num_micro(data))
+        self.total_loss = loss
+        if optimizer is not None:
+            optimizer.step()
+            optimizer.clear_grad()
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+        return loss
 
     def eval_batch(self, data, compute_loss: bool = True):
         micros = self._split_micro(data, self._num_micro(data))

@@ -1,0 +1,334 @@
+"""Compiled SPMD 1F1B pipeline schedule.
+
+Reference counterpart: ``python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py`` + ``pp_utils/p2p_communication.py`` (SURVEY.md §2.2 PP
+row, §3.4): the reference runs a host-driven 1F1B scheduler per stage rank —
+warmup forwards, steady-state one-forward-one-backward, cooldown backwards —
+with P2P activation/grad tensors flowing between neighbouring stage ranks and
+interleaved virtual stages when ``virtual_pp_degree > 1``.
+
+TPU-native redesign — ONE compiled SPMD program instead of a host scheduler:
+
+* ``jax.shard_map`` over the ``pp`` mesh axis gives each device its stage's
+  slice of the schedule; ``lax.axis_index('pp')`` selects the stage's layer
+  chunk via ``lax.switch`` (every device runs the same program — SPMD).
+* The 1F1B tick loop is a ``lax.scan`` over ``T = M + 2C - 2`` global ticks
+  (M micro-batches, ``C = pp * virtual_pp_degree`` chunks). At tick ``t``,
+  chunk ``c`` forwards micro-batch ``t - c`` and backwards micro-batch
+  ``t - (2C - 2 - c)`` — the classic 1F1B timetable: the last stage starts
+  its first backward immediately after its first forward, bounding in-flight
+  activations per stage at ``2(C-1-c)+1`` instead of M (GPipe/F-then-B).
+* Activation transfer is a ``lax.ppermute`` ring shift (+1 for forwards,
+  -1 for activation-grads) — exactly the P2P send/recv of the reference's
+  ``p2p_communication.py``, but compiled onto ICI. With virtual stages the
+  V chunk streams ride one stacked ppermute; the ring wrap (device pp-1 →
+  device 0) rolls the stack by one slot, which is what "interleaved"
+  means on a ring: chunk v*pp + (pp-1) feeds chunk (v+1)*pp + 0.
+* Stage-local activations: each device keeps a rotating buffer of its own
+  chunk inputs (slot = micro-batch mod S, S = min(M, 2C-1) — the 1F1B
+  liveness bound). Backward recomputes the chunk forward from the stored
+  input under ``jax.vjp`` (activation recompute, the reference's
+  ``recompute_interval`` pairing), so nothing but chunk inputs is buffered.
+* Bubble ticks run masked compute on zero buffers (SPMD programs are
+  uniform); their outputs and gradient contributions are ``where``-masked
+  out, so numerics equal the grad-accumulation path exactly.
+
+Restrictions vs the eager grad-accumulation path (documented, enforced):
+inter-chunk activations must share one shape/dtype (the reference's P2P
+meta handshake makes the same assumption per segment boundary), buffers
+(e.g. BN running stats) are read-only inside the compiled program, and the
+global batch must divide evenly into micro-batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ....core import autograd
+from ....core.tensor import Tensor
+
+__all__ = ["OneFOneBEngine"]
+
+
+def _unique_params(layer) -> Tuple[List[Any], List[Any]]:
+    """Trainable params and buffers reachable from the PipelineLayer,
+    deduplicated by identity (SharedLayerDesc ties appear once — their
+    gradient contributions from every stage accumulate into one slot, the
+    reference's tied-embedding allreduce falling out of the math)."""
+    params, buffers, seen = [], [], set()
+    for p in layer.parameters():
+        if not p.stop_gradient and id(p) not in seen:
+            seen.add(id(p))
+            params.append(p)
+    for b in layer.buffers():
+        if id(b) not in seen:
+            seen.add(id(b))
+            buffers.append(b)
+    return params, buffers
+
+
+class OneFOneBEngine:
+    """Builds and caches the compiled 1F1B train step for a PipelineLayer."""
+
+    def __init__(self, pipeline_layer, mesh):
+        if mesh is None or "pp" not in mesh.axis_names:
+            raise ValueError("1F1B schedule needs a mesh with a 'pp' axis")
+        self._layer = pipeline_layer
+        self._mesh = mesh
+        self._pp = int(mesh.shape["pp"])
+        self._vpp = max(int(pipeline_layer._virtual_pp_degree), 1)
+        self._chunks = [pipeline_layer.stage_layers(i)
+                        for i in range(len(pipeline_layer.segment_parts) - 1)]
+        if len(self._chunks) != self._pp * self._vpp:
+            raise ValueError(
+                f"PipelineLayer has {len(self._chunks)} segments but mesh "
+                f"pp={self._pp} x virtual={self._vpp} needs "
+                f"{self._pp * self._vpp}")
+        if pipeline_layer._loss_fn is None:
+            raise ValueError(
+                "1F1B schedule needs PipelineLayer(loss_fn=...): the last "
+                "chunk must emit a scalar loss to seed the backward ring")
+        self._params, self._buffers = _unique_params(pipeline_layer)
+        self._cache: Dict[Any, Callable] = {}
+
+    # -- eager-under-trace chunk application (TracedProgram's technique) --
+
+    def _run_chunk(self, c: int, x: Tensor) -> Tensor:
+        for fn in self._chunks[c]:
+            x = fn(*x) if isinstance(x, tuple) else fn(x)
+        return x
+
+    def _make_branch(self, c: int, hidden_aval):
+        """Branch for chunk ``c``: uniform signature so lax.switch can select
+        by stage index. Returns (hidden_out, micro_loss)."""
+        from ....framework import random as _random
+        from ....jit import _SwapValues, _TRACING
+
+        layer = self._layer
+        last = c == len(self._chunks) - 1
+
+        def branch(pvals, bvals, x_hidden, mb_idx, x_micro, y_micro, key):
+            with _SwapValues(self._params + self._buffers,
+                             list(pvals) + list(bvals)):
+                prev = _TRACING[0]
+                _TRACING[0] = True
+                # keyed by (chunk, micro-batch) so dropout masks agree
+                # between the forward pass and its backward recompute
+                _random.push_trace_key(
+                    jax.random.fold_in(jax.random.wrap_key_data(key),
+                                       c * 1000003 + mb_idx))
+                try:
+                    with autograd.no_grad():
+                        if c == 0:
+                            inp = Tensor(lax.dynamic_index_in_dim(
+                                x_micro, mb_idx, axis=0, keepdims=False))
+                        else:
+                            inp = Tensor(x_hidden)
+                        out = self._run_chunk(c, inp)
+                        if last and layer._loss_fn is not None:
+                            y = Tensor(lax.dynamic_index_in_dim(
+                                y_micro, mb_idx, axis=0, keepdims=False))
+                            loss = layer._loss_fn(out, y)
+                            return (jnp.zeros(hidden_aval.shape,
+                                              hidden_aval.dtype),
+                                    loss._value.astype(jnp.float32))
+                        return out._value, jnp.float32(0.0)
+                finally:
+                    _random.pop_trace_key()
+                    _TRACING[0] = prev
+        return branch
+
+    def _infer_hidden(self, pvals, bvals, x_mb_aval, key_aval):
+        """Shape/dtype of the inter-chunk activation stream; also validates
+        that every chunk boundary carries the same aval (the reference's
+        p2p shape-meta handshake assumption). Only chunks 0..C-2 are traced
+        here — the last chunk emits the loss, not a hidden stream."""
+        C = len(self._chunks)
+        if C < 2:
+            raise ValueError("1F1B schedule needs at least 2 pipeline chunks")
+
+        def fwd_c(c, pv, bv, x, k):
+            # branch c with hidden_aval=None: safe for non-last chunks
+            br = self._make_branch(c, None)
+            x_micro = x[None] if c == 0 else jnp.zeros((1, 1), jnp.float32)
+            x_hidden = jnp.zeros((), jnp.float32) if c == 0 else x
+            return br(pv, bv, x_hidden, jnp.int32(0), x_micro,
+                      jnp.zeros((), jnp.float32), k)[0]
+
+        hidden = jax.eval_shape(
+            lambda pv, bv, x, k: fwd_c(0, pv, bv, x, k),
+            pvals, bvals, x_mb_aval, key_aval)
+        aval = hidden
+        for c in range(1, C - 1):
+            nxt = jax.eval_shape(
+                lambda pv, bv, x, k, _c=c: fwd_c(_c, pv, bv, x, k),
+                pvals, bvals, aval, key_aval)
+            if (nxt.shape, nxt.dtype) != (hidden.shape, hidden.dtype):
+                raise ValueError(
+                    "1F1B needs a uniform inter-stage activation: chunk "
+                    f"{c} emits {nxt.shape}/{nxt.dtype}, expected "
+                    f"{hidden.shape}/{hidden.dtype}")
+            aval = nxt
+        return hidden
+
+    # -- the compiled program --
+
+    def _build(self, M: int, x_shape, x_dtype):
+        mesh, pp, V = self._mesh, self._pp, self._vpp
+        C = pp * V
+        S = min(M, 2 * C - 1)  # 1F1B in-flight bound per chunk
+        T = M + 2 * C - 2
+        dp = "dp" if ("dp" in mesh.axis_names and mesh.shape["dp"] > 1) else None
+
+        pvals0 = [p._value for p in self._params]
+        bvals0 = [b._value for b in self._buffers]
+        mb_rows = x_shape[0] // M
+        if dp:
+            if mb_rows % mesh.shape["dp"] != 0:
+                raise ValueError(
+                    f"1F1B schedule needs batch {x_shape[0]} divisible by "
+                    f"micro-batch count {M} x dp degree {mesh.shape['dp']}")
+            mb_rows //= mesh.shape["dp"]
+        x_mb_aval = jax.ShapeDtypeStruct((mb_rows,) + tuple(x_shape[1:]),
+                                         x_dtype)
+        key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        hidden = self._infer_hidden(
+            [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in pvals0],
+            [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in bvals0],
+            x_mb_aval, key_aval)
+
+        branches = [[self._make_branch(v * pp + r, hidden)
+                     for r in range(pp)] for v in range(V)]
+
+        def program(pvals, bvals, x_micro, y_micro, key):
+            s = lax.axis_index("pp")
+
+            def apply_v(v, pv, xh, mb):
+                return lax.switch(s, branches[v], pv, bvals, xh, mb,
+                                  x_micro, y_micro, key)
+
+            def tick(carry, t):
+                fwd_in, bwd_in, store, gacc, lacc = carry
+                # ---- forward half-tick: chunk c forwards micro t - c ----
+                fwd_out = []
+                for v in range(V):
+                    c = v * pp + s
+                    mf = t - c
+                    ok = (mf >= 0) & (mf < M)
+                    mfc = jnp.clip(mf, 0, M - 1)
+                    xh = fwd_in[v]
+                    y, loss = apply_v(v, pvals, xh, mfc)
+                    slot = mfc % S
+                    store = store.at[v, slot].set(
+                        jnp.where(ok, xh, store[v, slot]))
+                    lacc = lacc + jnp.where(ok, loss, 0.0)
+                    fwd_out.append(jnp.where(ok, y, jnp.zeros_like(y)))
+                # ---- backward half-tick: chunk c backwards micro
+                #      t - (2C - 2 - c); recompute-vjp from the stored input
+                bwd_out = []
+                for v in range(V):
+                    c = v * pp + s
+                    mb = t - (2 * C - 2 - c)
+                    ok = (mb >= 0) & (mb < M)
+                    mbc = jnp.clip(mb, 0, M - 1)
+                    x_saved = store[v, mbc % S]
+                    _, vjp = jax.vjp(
+                        lambda pv, xh, _v=v, _mb=mbc: apply_v(_v, pv, xh, _mb),
+                        pvals, x_saved)
+                    is_last = c == C - 1
+                    dy = jnp.where(is_last, jnp.zeros_like(bwd_in[v]),
+                                   bwd_in[v])
+                    dl = jnp.where(is_last, jnp.float32(1.0 / M),
+                                   jnp.float32(0.0))
+                    dpv, dx = vjp((dy, dl))
+                    gacc = [g + jnp.where(ok, d, jnp.zeros_like(d))
+                            for g, d in zip(gacc, dpv)]
+                    bwd_out.append(jnp.where(ok, dx, jnp.zeros_like(dx)))
+                # ---- ring transfers (the P2P of p2p_communication.py) ----
+                fstk = jnp.stack(fwd_out)
+                frecv = lax.ppermute(fstk, "pp",
+                                     [(i, (i + 1) % pp) for i in range(pp)])
+                # ring wrap carries chunk v*pp+pp-1 -> chunk (v+1)*pp+0:
+                # on device 0 the stack shifts down one virtual slot
+                frecv = jnp.where(s == 0, jnp.roll(frecv, 1, axis=0), frecv)
+                bstk = jnp.stack(bwd_out)
+                brecv = lax.ppermute(bstk, "pp",
+                                     [(i, (i - 1) % pp) for i in range(pp)])
+                brecv = jnp.where(s == pp - 1, jnp.roll(brecv, -1, axis=0),
+                                  brecv)
+                return (list(frecv), list(brecv), store, gacc, lacc), None
+
+            zeros_h = jnp.zeros(hidden.shape, hidden.dtype)
+            carry0 = (
+                [zeros_h] * V,
+                [zeros_h] * V,
+                jnp.zeros((V, S) + tuple(hidden.shape), hidden.dtype),
+                [jnp.zeros(v.shape, v.dtype) for v in pvals0],
+                jnp.float32(0.0),
+            )
+            (fi, bi, st, gacc, lacc), _ = lax.scan(
+                tick, carry0, jnp.arange(T, dtype=jnp.int32))
+            grads = [lax.psum(g, "pp") for g in gacc]
+            loss = lax.psum(lacc, "pp") / M
+            if dp:
+                grads = [lax.pmean(g, dp) for g in grads]
+                loss = lax.pmean(loss, dp)
+            return loss, grads
+
+        # data enters as (M, rows, ...): micro-batch index leading, rows
+        # (the per-micro batch dim) sharded over dp when present
+        data_spec = P(None, dp)
+        mapped = jax.shard_map(
+            program, mesh=mesh,
+            in_specs=(P(), P(), data_spec, data_spec, P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+
+        def run(pvals, bvals, x, y, key):
+            xm = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+            ym = y.reshape((M, y.shape[0] // M) + y.shape[1:])
+            return mapped(pvals, bvals, xm, ym, key)
+
+        return jax.jit(run)
+
+    # -- public: one train step --
+
+    def train_batch(self, x: Tensor, y: Tensor, num_micro: int):
+        """Run the compiled 1F1B schedule; returns (loss Tensor, sets
+        .grad on every trainable parameter — caller steps the optimizer)."""
+        from ....framework.random import next_key
+
+        M = int(num_micro)
+        if x.shape[0] % M != 0:
+            raise ValueError(
+                f"1F1B schedule needs batch {x.shape[0]} divisible by "
+                f"micro-batch count {M}")
+        key = (tuple(x.shape), str(x.dtype), tuple(y.shape), str(y.dtype), M)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build(M, tuple(x.shape), x._value.dtype)
+            self._cache[key] = fn
+        pvals = [p._value for p in self._params]
+        bvals = [b._value for b in self._buffers]
+        # commit inputs to the mesh (params already live there; jit rejects
+        # mixed device assignments)
+        from jax.sharding import NamedSharding
+
+        rep = NamedSharding(self._mesh, P())
+        xv = jax.device_put(x._value, rep)
+        yv = jax.device_put(y._value, rep)
+        kd = jax.device_put(jax.random.key_data(next_key()), rep)
+        loss, grads = fn(pvals, bvals, xv, yv, kd)
+        for p, g in zip(self._params, grads):
+            g = g.astype(p._value.dtype) if g.dtype != p._value.dtype else g
+            if p.grad is None:
+                p.grad = Tensor(g, stop_gradient=True)
+            else:
+                p.grad = Tensor(p.grad._value + g, stop_gradient=True)
+        return Tensor(loss, stop_gradient=True)

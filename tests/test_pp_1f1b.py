@@ -1,0 +1,144 @@
+"""Compiled SPMD 1F1B pipeline schedule (meta_parallel/pp_1f1b.py).
+
+Reference test pattern (SURVEY.md §4 hybrid-parallel correctness): the
+pipeline schedule must match the non-pipelined execution numerically — 1F1B
+reorders micro-batch work, it does not change the math. We assert loss AND
+per-parameter gradient parity against the eager grad-accumulation path, and
+pin the dispatch: the compiled program must move activations between stages
+with collective-permute (the ICI analog of the reference's P2P send/recv).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+    SharedLayerDesc,
+)
+from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
+
+
+def _mse(out, y):
+    return paddle.mean((out - y) ** 2)
+
+
+def _build_pp(num_stages, n_layers, virtual=1, width=8, seed=7):
+    paddle.seed(seed)
+    descs = []
+    for _ in range(n_layers):
+        descs.append(LayerDesc(paddle.nn.Linear, width, width))
+        descs.append(paddle.nn.functional.tanh)
+    pl = PipelineLayer(layers=descs, num_stages=num_stages, loss_fn=_mse,
+                       num_virtual_pipeline_stages=virtual)
+    strategy = DistributedStrategy()
+    strategy.pipeline_configs = {"accumulate_steps": 4}
+    return PipelineParallel(pl, None, strategy), pl
+
+
+def _grads(pl):
+    return [None if p.grad is None else np.asarray(p.grad.numpy()).copy()
+            for p in pl.parameters() if not p.stop_gradient]
+
+
+@pytest.fixture
+def pp4_mesh():
+    mesh = create_hybrid_mesh(dp=2, pp=4)
+    yield mesh
+    set_mesh(None)
+
+
+@pytest.fixture
+def pp2v2_mesh():
+    mesh = create_hybrid_mesh(dp=2, pp=2, devices=jax.devices()[:4])
+    yield mesh
+    set_mesh(None)
+
+
+class Test1F1BParity:
+    def test_loss_and_grad_parity_vs_grad_accum(self, pp4_mesh):
+        pp, pl = _build_pp(num_stages=4, n_layers=8)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(8, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randn(8, 8).astype("float32"))
+
+        loss_ref = pp.train_batch((x, y))
+        g_ref = _grads(pl)
+        for p in pl.parameters():
+            p.clear_grad()
+
+        loss_1f1b = pp.train_batch((x, y), schedule="1f1b")
+        g_new = _grads(pl)
+
+        np.testing.assert_allclose(loss_1f1b.numpy(), loss_ref.numpy(),
+                                   rtol=2e-5, atol=1e-7)
+        assert len(g_ref) == len(g_new) and len(g_ref) > 0
+        for a, b in zip(g_ref, g_new):
+            assert (a is None) == (b is None)
+            if a is not None:
+                np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-6)
+
+    def test_interleaved_virtual_stages_parity(self, pp2v2_mesh):
+        # virtual_pp_degree=2 on pp=2: 4 chunks ride 2 devices — the
+        # reference's interleaved 1F1B (virtual_pp_degree) on a ring
+        pp, pl = _build_pp(num_stages=2, n_layers=8, virtual=2)
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(8, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randn(8, 8).astype("float32"))
+
+        loss_ref = pp.train_batch((x, y))
+        g_ref = _grads(pl)
+        for p in pl.parameters():
+            p.clear_grad()
+
+        loss_1f1b = pp.train_batch((x, y), schedule="1f1b")
+        g_new = _grads(pl)
+
+        np.testing.assert_allclose(loss_1f1b.numpy(), loss_ref.numpy(),
+                                   rtol=2e-5, atol=1e-7)
+        for a, b in zip(g_ref, g_new):
+            if a is not None:
+                np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-6)
+
+    def test_optimizer_step_applies(self, pp4_mesh):
+        pp, pl = _build_pp(num_stages=4, n_layers=8, seed=9)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=pl.parameters())
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randn(8, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randn(8, 8).astype("float32"))
+        w0 = pl.run_functions[0].weight.numpy().copy()
+        loss = pp.train_batch((x, y), optimizer=opt, schedule="1f1b")
+        assert np.isfinite(float(loss.numpy()))
+        assert not np.allclose(pl.run_functions[0].weight.numpy(), w0)
+
+    def test_hlo_pins_collective_permute(self, pp4_mesh):
+        pp, pl = _build_pp(num_stages=4, n_layers=8, seed=5)
+        rng = np.random.RandomState(3)
+        x = paddle.to_tensor(rng.randn(8, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randn(8, 8).astype("float32"))
+        pp.train_batch((x, y), schedule="1f1b")
+        eng = pp._1f1b_engine
+        (key, fn), = eng._cache.items()
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(eng._mesh, PartitionSpec())
+        pvals = [p._value for p in eng._params]
+        bvals = [b._value for b in eng._buffers]
+        kd = jax.device_put(
+            jax.random.key_data(jax.random.PRNGKey(0)), rep)
+        hlo = fn.lower(pvals, bvals, jax.device_put(x._value, rep),
+                       jax.device_put(y._value, rep), kd).compile().as_text()
+        assert "collective-permute" in hlo, (
+            "1F1B activation transfer must compile to collective-permute")
+
+    def test_uneven_batch_rejected(self, pp4_mesh):
+        pp, pl = _build_pp(num_stages=4, n_layers=8, seed=4)
+        x = paddle.to_tensor(np.random.randn(6, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.randn(6, 8).astype("float32"))
+        with pytest.raises(ValueError, match="divisible"):
+            pp.train_batch((x, y), schedule="1f1b")
