@@ -93,7 +93,10 @@ def _devices_for_type(device_type: str):
     platforms = _PLATFORM_ALIASES.get(device_type, (device_type,))
     for platform in platforms:
         try:
-            devs = jax.devices(platform)
+            # local_devices, not devices: under the multi-controller launch
+            # runtime each trainer may only place data on its own process's
+            # devices (the reference's trainer->CUDA_VISIBLE_DEVICES pinning)
+            devs = jax.local_devices(backend=platform)
             if devs:
                 return tuple(devs)
         except RuntimeError:

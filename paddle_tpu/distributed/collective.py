@@ -125,19 +125,88 @@ def _rewrap(tensor, value):
     return to_tensor(value)
 
 
-def _apply(name, tensor, fn_traced, fn_single):
-    """Run a collective: traced (shard_map) path, or eager top-level path."""
+def _apply(name, tensor, fn_traced, fn_single, fn_multi=None, group=None):
+    """Run a collective: traced (shard_map) path, multi-process eager path
+    (launcher runtime: tiny jitted program over the group's processes), or
+    single-process eager path (identity per reference semantics)."""
     val = _unwrap(tensor)
     if isinstance(val, jax.core.Tracer):
         out = fn_traced(val)
         if isinstance(tensor, Tensor):
             return Tensor(out, stop_gradient=tensor.stop_gradient)
         return out
-    # top-level eager: single-process world → the group spans devices only
-    # through SPMD programs; outside shard_map it degenerates per reference
+    if jax.process_count() > 1 and group is not None and group.nranks > 1:
+        if fn_multi is None:
+            raise InvalidArgumentError(
+                f"{name} has no eager multi-process path; run it inside a "
+                "shard_map program (mesh-axis group) instead")
+        out = fn_multi(val)
+        if tuple(getattr(out, "shape", ())) != tuple(getattr(val, "shape", ())):
+            # shape-changing collectives (all_gather, reduce_scatter,
+            # alltoall) must NOT overwrite the caller's input buffer
+            return to_tensor(out) if isinstance(tensor, Tensor) else out
+        return _rewrap(tensor, out)
+    # top-level eager, single process: the group spans devices only through
+    # SPMD programs; outside shard_map it degenerates per reference
     # semantics to identity when world_size == 1.
     out = fn_single(val)
     return _rewrap(tensor, out)
+
+
+# --- multi-process eager execution (launcher runtime) ----------------------
+# init_parallel_env → jax.distributed.initialize makes this a
+# multi-controller SPMD world: every trainer process holds a slice of the
+# global device set. An eager collective is then ONE cached jitted program
+# over a ('world', 'local') mesh of the group's processes — the "eager
+# collectives = cached one-op jitted programs per group" design (SURVEY
+# §5.8/§7.1); the reference's ProcessGroupNCCL issue-to-comm-stream becomes
+# XLA dispatching the compiled collective.
+
+_MP_JIT_CACHE: dict = {}
+_MP_MESH_CACHE: dict = {}
+
+
+def _process_mesh(g: Group):
+    """('world', 'local') mesh whose rows are the group's processes."""
+    key = (g.id, tuple(g.ranks))
+    mesh = _MP_MESH_CACHE.get(key)
+    if mesh is None:
+        from jax.sharding import Mesh
+
+        procs: dict = {}
+        for d in jax.devices():
+            procs.setdefault(d.process_index, []).append(d)
+        try:
+            rows = [procs[r] for r in g.ranks]
+        except KeyError as e:
+            raise InvalidArgumentError(
+                f"group ranks {g.ranks} exceed the {len(procs)}-process "
+                "runtime — trainer ranks map 1:1 to processes") from e
+        n_local = min(len(r) for r in rows)
+        mesh = Mesh(np.array([r[:n_local] for r in rows]),
+                    ("world", "local"))
+        _MP_MESH_CACHE[key] = mesh
+    return mesh
+
+
+def _mp_program(name, g, v, body):
+    """Stack rank w's value at index w of a (W, *shape) global array over
+    the group's process mesh, run ``body`` on it, return the replicated
+    result as a process-local array."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = _process_mesh(g)
+    local = np.asarray(v)[None]
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, PartitionSpec("world")), local)
+    key = (name, g.id, tuple(local.shape), str(local.dtype))
+    fn = _MP_JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(body,
+                     out_shardings=NamedSharding(mesh, PartitionSpec()))
+        _MP_JIT_CACHE[key] = fn
+    out = fn(arr)
+    return jnp.asarray(np.asarray(out.addressable_data(0)))
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
@@ -160,7 +229,19 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     def single(v):
         return v  # world of one: reduction is identity
 
-    return _apply("all_reduce", tensor, traced, single)
+    def multi(v):
+        red = {
+            ReduceOp.SUM: lambda a: jnp.sum(a, 0),
+            ReduceOp.AVG: lambda a: jnp.mean(a, 0),
+            ReduceOp.MAX: lambda a: jnp.max(a, 0),
+            ReduceOp.MIN: lambda a: jnp.min(a, 0),
+            ReduceOp.PROD: lambda a: jnp.prod(a, 0),
+        }
+        if op not in red:
+            raise InvalidArgumentError(f"Unknown reduce op {op}")
+        return _mp_program(f"all_reduce_{op}", g, v, red[op])
+
+    return _apply("all_reduce", tensor, traced, single, multi, g)
 
 
 def all_gather(tensor_list, tensor=None, group: Optional[Group] = None,
@@ -179,7 +260,16 @@ def all_gather(tensor_list, tensor=None, group: Optional[Group] = None,
     def single(v):
         return v
 
-    out = _apply("all_gather", tensor, traced, single)
+    def multi(v):
+        # stacked (W, *s) -> concatenated along ``axis`` like the traced
+        # path (axis 0: the list split below recovers per-rank tensors)
+        stacked = _mp_program("all_gather", g, v, lambda a: a)
+        if axis == 0:
+            return stacked.reshape((-1,) + tuple(v.shape[1:]))
+        return jnp.concatenate(
+            [stacked[i] for i in range(g.nranks)], axis=axis)
+
+    out = _apply("all_gather", tensor, traced, single, multi, g)
     if isinstance(tensor_list, list):
         val = _unwrap(out)
         if not isinstance(val, jax.core.Tracer):
@@ -193,6 +283,23 @@ def all_gather(tensor_list, tensor=None, group: Optional[Group] = None,
 
 
 def all_gather_object(object_list, obj, group=None):
+    g = group or get_default_group()
+    if jax.process_count() > 1 and g.nranks > 1:
+        # two-phase gather: lengths first, then the padded pickle blobs
+        import pickle
+
+        data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        lens = _mp_program("gather_obj_len", g,
+                           np.array([data.size], np.int32),
+                           lambda a: a.reshape(-1))
+        mx = int(np.max(np.asarray(lens)))
+        padded = np.zeros((mx,), np.uint8)
+        padded[:data.size] = data
+        blob = _mp_program("gather_obj", g, padded, lambda a: a)
+        for r in range(g.nranks):
+            raw = bytes(np.asarray(blob[r][:int(lens[r])]))
+            object_list.append(pickle.loads(raw))
+        return object_list
     object_list.append(obj)  # world of one
     return object_list
 
@@ -214,11 +321,28 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
     def single(v):
         return v
 
+    def multi(v):
+        # identical program on every process (the cross-process reduction);
+        # the per-rank slice is local, after
+        red = {
+            ReduceOp.SUM: lambda a: jnp.sum(a, 0),
+            ReduceOp.AVG: lambda a: jnp.mean(a, 0),
+            ReduceOp.MAX: lambda a: jnp.max(a, 0),
+            ReduceOp.MIN: lambda a: jnp.min(a, 0),
+            ReduceOp.PROD: lambda a: jnp.prod(a, 0),
+        }
+        if op not in red:
+            raise InvalidArgumentError(f"Unknown reduce op {op}")
+        full = _mp_program(f"reduce_scatter_{op}", g, v, red[op])
+        chunk = full.shape[0] // g.nranks
+        me = max(g.get_group_rank(get_rank()), 0)
+        return full[me * chunk:(me + 1) * chunk]
+
     if isinstance(src, (list, tuple)):
         from ..ops.manipulation import concat
 
         src = concat(list(src), axis=0)
-    return _apply("reduce_scatter", src, traced, single)
+    return _apply("reduce_scatter", src, traced, single, multi, g)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
@@ -232,7 +356,12 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     def single(v):
         return v
 
-    return _apply("broadcast", tensor, traced, single)
+    def multi(v):
+        r = g.get_group_rank(src)
+        r = r if r >= 0 else src
+        return _mp_program(f"broadcast_{r}", g, v, lambda a: a[r])
+
+    return _apply("broadcast", tensor, traced, single, multi, g)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
@@ -251,12 +380,27 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     def single(v):
         return v
 
+    def multi(v):
+        r = g.get_group_rank(src)
+        r = r if r >= 0 else src
+        me = max(g.get_group_rank(get_rank()), 0)
+        # reference semantics: only src passes tensor_list (the full
+        # source); every other rank passes just its chunk-shaped output
+        # buffer — pad those to full size so the per-process local shapes
+        # agree inside _mp_program (src's row is the one selected anyway)
+        if tensor_list is None and me != r:
+            v = jnp.zeros((v.shape[0] * g.nranks,) + tuple(v.shape[1:]),
+                          v.dtype)
+        full = _mp_program(f"scatter_{r}", g, v, lambda a: a[r])
+        chunk = full.shape[0] // g.nranks
+        return full[me * chunk:(me + 1) * chunk]
+
     src_val = tensor_list if tensor_list is not None else tensor
     if isinstance(src_val, (list, tuple)):
         from ..ops.manipulation import concat
 
         src_val = concat(list(src_val), axis=0)
-    return _apply("scatter", src_val, traced, single)
+    return _apply("scatter", src_val, traced, single, multi, g)
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
@@ -275,7 +419,16 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     def single(v):
         return v
 
-    out = _apply("alltoall", src, traced, single)
+    def multi(v):
+        # stacked (W, n*c, *s): out on rank me concatenates every rank's
+        # chunk me — gather everything, slice own column locally
+        full = _mp_program("alltoall", g, v, lambda a: a)
+        c = v.shape[0] // g.nranks
+        me = max(g.get_group_rank(get_rank()), 0)
+        return full[:, me * c:(me + 1) * c].reshape(
+            (-1,) + tuple(v.shape[1:]))
+
+    out = _apply("alltoall", src, traced, single, multi, g)
     if isinstance(out_tensor_list, list):
         val = _unwrap(out)
         if not isinstance(val, jax.core.Tracer):

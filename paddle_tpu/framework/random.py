@@ -18,7 +18,16 @@ import jax
 __all__ = ["seed", "get_rng_state", "set_rng_state", "next_key", "fold_in"]
 
 _lock = threading.Lock()
-_state = {"key": jax.random.key(0), "seed": 0}
+# key is created LAZILY: materialising it at import would initialise the
+# XLA backend, which must not happen before jax.distributed.initialize
+# (init_parallel_env) in multi-controller launches
+_state = {"key": None, "seed": 0}
+
+
+def _global_key():
+    if _state["key"] is None:
+        _state["key"] = jax.random.key(_state["seed"])
+    return _state["key"]
 
 
 def seed(s: int):
@@ -31,7 +40,7 @@ def seed(s: int):
 
 def get_rng_state() -> Any:
     with _lock:
-        return _state["key"]
+        return _global_key()
 
 
 def set_rng_state(key: Any) -> None:
@@ -66,7 +75,7 @@ def next_key():
         stack[-1], sub = jax.random.split(stack[-1])
         return sub
     with _lock:
-        _state["key"], sub = jax.random.split(_state["key"])
+        _state["key"], sub = jax.random.split(_global_key())
         return sub
 
 
@@ -74,4 +83,4 @@ def fold_in(data: int):
     """Derive (without consuming) a key folded with ``data`` — used for
     deterministic per-rank / per-layer streams."""
     with _lock:
-        return jax.random.fold_in(_state["key"], data)
+        return jax.random.fold_in(_global_key(), data)

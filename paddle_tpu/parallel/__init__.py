@@ -14,6 +14,7 @@ from .mesh import (
     create_hybrid_mesh,
     get_mesh,
     mesh_axis_size,
+    host_to_global,
     named_sharding,
     set_mesh,
     with_sharding_constraint,
@@ -26,5 +27,6 @@ __all__ = [
     "set_mesh",
     "mesh_axis_size",
     "named_sharding",
+    "host_to_global",
     "with_sharding_constraint",
 ]

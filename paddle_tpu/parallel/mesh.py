@@ -83,6 +83,26 @@ def named_sharding(spec: PartitionSpec, mesh: Optional[Mesh] = None
     return NamedSharding(mesh, spec)
 
 
+def host_to_global(x, spec: PartitionSpec, mesh: Optional[Mesh] = None):
+    """Turn a host value (identical on every process) into a global
+    ``jax.Array`` sharded by ``spec`` over the mesh.
+
+    Needed by the multi-controller runtime (``init_parallel_env`` with
+    ``PADDLE_TRAINERS_NUM>1``): jit rejects host numpy inputs with
+    process-spanning shardings, so sharded train steps convert their inputs
+    through here — each process materialises only its addressable shards
+    (``jax.make_array_from_callback``). Single-process: a plain device_put.
+    """
+    mesh = mesh or _GLOBAL_MESH
+    if mesh is None:
+        return jax.device_put(np.asarray(x))
+    sh = NamedSharding(mesh, spec)
+    x = np.asarray(x)
+    if jax.process_count() == 1:
+        return jax.device_put(x, sh)
+    return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
+
+
 def with_sharding_constraint(x, spec: PartitionSpec, mesh: Optional[Mesh] = None):
     """Sharding hint for XLA GSPMD; no-op without a mesh (single chip/tests).
 

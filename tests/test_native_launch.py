@@ -213,3 +213,170 @@ class TestLauncher:
         assert rc.returncode == 0
         log = (tmp_path / "log" / "workerlog.0").read_text()
         assert "RENDEZVOUS-OK [0, 1]" in log
+
+
+_SPMD_WORKER = """
+import os
+import numpy as np
+import jax
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+env = dist.init_parallel_env()   # -> jax.distributed.initialize
+rank = env.rank
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+assert jax.local_device_count() == 4
+
+# --- eager cross-process collectives (multi-controller runtime) ---
+t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+dist.all_reduce(t)
+np.testing.assert_allclose(t.numpy(), 3.0)
+
+lst = []
+dist.all_gather(lst, paddle.to_tensor(np.full((2,), float(rank), np.float32)))
+assert len(lst) == 2, len(lst)
+np.testing.assert_allclose(lst[0].numpy(), 0.0)
+np.testing.assert_allclose(lst[1].numpy(), 1.0)
+
+b = paddle.to_tensor(np.full((3,), float(rank * 7 + 1), np.float32))
+dist.broadcast(b, src=1)
+np.testing.assert_allclose(b.numpy(), 8.0)
+
+objs = []
+dist.all_gather_object(objs, {"rank": rank, "tag": "x" * (rank + 1)})
+assert objs == [{"rank": 0, "tag": "x"}, {"rank": 1, "tag": "xx"}], objs
+
+# all_gather must NOT overwrite its input buffer
+src_buf = paddle.to_tensor(np.full((2,), float(rank), np.float32))
+dist.all_gather([], src_buf)
+assert tuple(src_buf.shape) == (2,), src_buf.shape
+
+# scatter: reference convention — only src passes tensor_list
+out_buf = paddle.to_tensor(np.zeros((2,), np.float32))
+if rank == 0:
+    got = dist.scatter(out_buf, tensor_list=[
+        paddle.to_tensor(np.array([1., 2.], np.float32)),
+        paddle.to_tensor(np.array([3., 4.], np.float32))], src=0)
+else:
+    got = dist.scatter(out_buf, src=0)
+np.testing.assert_allclose(got.numpy(), [1., 2.] if rank == 0 else [3., 4.])
+
+# reduce_scatter honors the reduce op
+rs_in = paddle.to_tensor(np.arange(1, 5, dtype=np.float32) + rank)
+got = dist.reduce_scatter(rs_in, op=dist.ReduceOp.MAX)
+np.testing.assert_allclose(got.numpy(), [2., 3.] if rank == 0 else [4., 5.])
+
+# --- one sharded llama train step over the global 2-process mesh ---
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.models import llama
+from paddle_tpu.parallel import create_hybrid_mesh, host_to_global
+
+mesh = create_hybrid_mesh(dp=2, mp=4)  # dp axis spans the two processes
+cfg = llama.LlamaConfig.tiny()
+params = llama.init_params(cfg)
+opt = llama.init_opt_state(params)
+ps = llama.param_specs(cfg)
+os_ = llama.opt_state_specs(cfg)
+gparams = {k: host_to_global(np.asarray(v), ps[k], mesh)
+           for k, v in params.items()}
+gopt = {
+    "step": host_to_global(np.asarray(opt["step"]), P(), mesh),
+    "m": {k: host_to_global(np.asarray(v), os_[k], mesh)
+          for k, v in opt["m"].items()},
+    "v": {k: host_to_global(np.asarray(v), os_[k], mesh)
+          for k, v in opt["v"].items()},
+}
+tokens = np.random.RandomState(0).randint(
+    0, cfg.vocab_size, (4, 64)).astype(np.int32)
+gtok = host_to_global(tokens, P(("dp", "sharding"), None), mesh)
+step = llama.make_sharded_train_step(cfg, mesh, lr=1e-3)
+_, _, loss = step(gparams, gopt, gtok, gtok)
+loss = float(np.asarray(loss.addressable_data(0)))
+if rank == 0:
+    print("SPMD-LLAMA-LOSS", repr(loss))
+print("SPMD-WORKER-OK", rank)
+"""
+
+
+class TestMultiProcessSPMD:
+    def test_launch_two_process_collectives_and_train_step(self, tmp_path):
+        """The launcher->runtime->collective chain end to end (VERDICT r1
+        item 3): the launcher spawns 2 workers; each joins the
+        jax.distributed coordinator via init_parallel_env (4 virtual CPU
+        devices per process -> 8 global), runs eager cross-process
+        all_reduce/all_gather/broadcast/all_gather_object, then ONE sharded
+        llama train step over a global dp=2 x mp=4 mesh. Rank 0's loss must
+        match the same step computed single-process on this pytest
+        process's own 8 local devices."""
+        script = tmp_path / "spmd_worker.py"
+        script.write_text(_SPMD_WORKER)
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            free_port = s.getsockname()[1]
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        rc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2",
+             "--master", f"127.0.0.1:{free_port}",
+             "--log_dir", str(tmp_path / "log"), str(script)],
+            cwd="/root/repo", env=env, timeout=600,
+            capture_output=True, text=True)
+        log0 = (tmp_path / "log" / "workerlog.0")
+        log1 = (tmp_path / "log" / "workerlog.1")
+        detail = "\n".join(
+            p.read_text()[-3000:] for p in (log0, log1) if p.exists())
+        assert rc.returncode == 0, f"launch failed:\n{detail}"
+        text0 = log0.read_text()
+        assert "SPMD-WORKER-OK 0" in text0, text0[-3000:]
+        assert "SPMD-WORKER-OK 1" in log1.read_text()
+
+        # single-process reference on this process's 8 local devices
+        import re
+
+        m = re.search(r"SPMD-LLAMA-LOSS (\S+)", text0)
+        assert m, text0[-3000:]
+        loss_mp = float(m.group(1))
+
+        import numpy as np
+
+        from jax.sharding import PartitionSpec as P
+
+        from paddle_tpu.models import llama
+        from paddle_tpu.parallel import (
+            create_hybrid_mesh,
+            host_to_global,
+            set_mesh,
+        )
+
+        mesh = create_hybrid_mesh(dp=2, mp=4)
+        try:
+            cfg = llama.LlamaConfig.tiny()
+            params = llama.init_params(cfg)
+            opt = llama.init_opt_state(params)
+            ps = llama.param_specs(cfg)
+            os_ = llama.opt_state_specs(cfg)
+            gp = {k: host_to_global(np.asarray(v), ps[k], mesh)
+                  for k, v in params.items()}
+            go = {
+                "step": host_to_global(np.asarray(opt["step"]), P(), mesh),
+                "m": {k: host_to_global(np.asarray(v), os_[k], mesh)
+                      for k, v in opt["m"].items()},
+                "v": {k: host_to_global(np.asarray(v), os_[k], mesh)
+                      for k, v in opt["v"].items()},
+            }
+            tokens = np.random.RandomState(0).randint(
+                0, cfg.vocab_size, (4, 64)).astype(np.int32)
+            gtok = host_to_global(tokens, P(("dp", "sharding"), None), mesh)
+            step = llama.make_sharded_train_step(cfg, mesh, lr=1e-3)
+            _, _, loss = step(gp, go, gtok, gtok)
+            loss_sp = float(np.asarray(loss))
+        finally:
+            set_mesh(None)
+        np.testing.assert_allclose(loss_mp, loss_sp, rtol=2e-5)
