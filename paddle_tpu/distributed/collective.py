@@ -125,10 +125,13 @@ def _rewrap(tensor, value):
     return to_tensor(value)
 
 
-def _apply(name, tensor, fn_traced, fn_single, fn_multi=None, group=None):
+def _apply(name, tensor, fn_traced, fn_single, fn_multi=None, group=None,
+           inplace=True):
     """Run a collective: traced (shard_map) path, multi-process eager path
     (launcher runtime: tiny jitted program over the group's processes), or
-    single-process eager path (identity per reference semantics)."""
+    single-process eager path (identity per reference semantics).
+    ``inplace=False``: the eager result never overwrites the input tensor
+    (ops whose input is NOT their output buffer, e.g. alltoall)."""
     val = _unwrap(tensor)
     if isinstance(val, jax.core.Tracer):
         out = fn_traced(val)
@@ -141,9 +144,11 @@ def _apply(name, tensor, fn_traced, fn_single, fn_multi=None, group=None):
                 f"{name} has no eager multi-process path; run it inside a "
                 "shard_map program (mesh-axis group) instead")
         out = fn_multi(val)
-        if tuple(getattr(out, "shape", ())) != tuple(getattr(val, "shape", ())):
-            # shape-changing collectives (all_gather, reduce_scatter,
-            # alltoall) must NOT overwrite the caller's input buffer
+        if not inplace or tuple(getattr(out, "shape", ())) != \
+                tuple(getattr(val, "shape", ())):
+            # shape-changing collectives (all_gather, reduce_scatter) and
+            # input-preserving ones (alltoall) must NOT overwrite the
+            # caller's input buffer
             return to_tensor(out) if isinstance(tensor, Tensor) else out
         return _rewrap(tensor, out)
     # top-level eager, single process: the group spans devices only through
@@ -277,7 +282,9 @@ def all_gather(tensor_list, tensor=None, group: Optional[Group] = None,
             if n == 1:
                 tensor_list.append(out)
             else:
-                for chunk in jnp.split(val, n, axis=0):
+                # the gathered value concatenates along ``axis`` — split it
+                # back along the same axis to recover per-rank tensors
+                for chunk in jnp.split(val, n, axis=axis):
                     tensor_list.append(to_tensor(chunk))
     return out
 
@@ -400,7 +407,15 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         from ..ops.manipulation import concat
 
         src_val = concat(list(src_val), axis=0)
-    return _apply("scatter", src_val, traced, single, multi, g)
+    out = _apply("scatter", src_val, traced, single, multi, g)
+    # reference convention: the chunk lands in the caller's ``tensor`` out
+    # buffer on EVERY rank (on src, _apply only saw the concat temp)
+    out_val = _unwrap(out)
+    if isinstance(tensor, Tensor) and not isinstance(out_val, jax.core.Tracer) \
+            and tuple(out_val.shape) == tuple(tensor.shape):
+        tensor._inplace_set(out_val)
+        return tensor
+    return out
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
@@ -428,7 +443,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
         return full[:, me * c:(me + 1) * c].reshape(
             (-1,) + tuple(v.shape[1:]))
 
-    out = _apply("alltoall", src, traced, single, multi, g)
+    out = _apply("alltoall", src, traced, single, multi, g, inplace=False)
     if isinstance(out_tensor_list, list):
         val = _unwrap(out)
         if not isinstance(val, jax.core.Tracer):

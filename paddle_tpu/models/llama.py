@@ -69,6 +69,9 @@ class LlamaConfig:
     scan_layers: bool = True
     # sequence parallel: shard activations' seq dim over 'sep' outside matmuls
     sequence_parallel: bool = False
+    # single-chip chunked cross-entropy: head+CE recomputed per batch-chunk
+    # so [B,S,V] logits never materialise (0 = off; see loss_fn)
+    ce_chunks: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -287,9 +290,9 @@ def _decoder_layer(cfg: LlamaConfig, x, lp):
     return _layer_post(cfg, x, attn, lp)
 
 
-def forward(params: Dict[str, jax.Array], tokens: jax.Array,
-            cfg: LlamaConfig) -> jax.Array:
-    """Logits for next-token prediction. tokens: [B, S] int32 → [B, S, V]."""
+def forward_hidden(params: Dict[str, jax.Array], tokens: jax.Array,
+                   cfg: LlamaConfig) -> jax.Array:
+    """Final hidden states (post ln_f). tokens: [B, S] int32 → [B, S, H]."""
     dt = cfg.dtype
     x = params["embed"].astype(dt)[tokens]
     x = wsc(x, _act_spec(cfg))
@@ -325,9 +328,30 @@ def forward(params: Dict[str, jax.Array], tokens: jax.Array,
         for i in range(cfg.num_layers):
             x, _ = body(x, {k: w[i] for k, w in layer_weights.items()})
 
-    x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
-    logits = x @ params["lm_head"].astype(dt)
+    return _rms_norm(x, params["ln_f"], cfg.rms_eps)
+
+
+def forward(params: Dict[str, jax.Array], tokens: jax.Array,
+            cfg: LlamaConfig) -> jax.Array:
+    """Logits for next-token prediction. tokens: [B, S] int32 → [B, S, V]."""
+    x = forward_hidden(params, tokens, cfg)
+    logits = x @ params["lm_head"].astype(cfg.dtype)
     return wsc(logits, P(("dp", "sharding"), None, "mp"))
+
+
+def _nll_sum(logits, targets, weights) -> jax.Array:
+    """Weighted token-nll sum over one logits block.
+
+    The reduction upcasts to fp32 INSIDE the fused pass over the bf16
+    logits: casting the whole [.., V] tensor first would materialise fp32
+    holding bf16-precision values — pure HBM traffic for zero accuracy
+    (the matmul already rounded to bf16)."""
+    m = jnp.max(logits, axis=-1).astype(jnp.float32)
+    sumexp = jnp.sum(
+        jnp.exp(logits.astype(jnp.float32) - m[..., None]), axis=-1)
+    gold = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0].astype(jnp.float32)
+    return jnp.sum((m + jnp.log(sumexp) - gold) * weights)
 
 
 def loss_fn(params, tokens, labels, cfg: LlamaConfig) -> jax.Array:
@@ -335,24 +359,44 @@ def loss_fn(params, tokens, labels, cfg: LlamaConfig) -> jax.Array:
     ``c_softmax_with_cross_entropy`` — here the vocab-sharded logsumexp
     reduction is a GSPMD-inserted collective).
 
-    The reduction upcasts to fp32 INSIDE the fused pass over the bf16
-    logits: casting the whole [B, S, V] tensor first would materialise
-    ~2.6 GB of fp32 holding bf16-precision values — pure HBM traffic for
-    zero accuracy (the matmul already rounded to bf16).
+    Single-chip, the head+CE is chunked over the batch dim with the chunk
+    body ``jax.checkpoint``-ed: the [B,S,V] logits tensor (1.5 GB at the
+    bench shape) is never materialised and never saved for the backward —
+    each chunk's logits are recomputed from the (small) hidden states in
+    the bwd, trading ~1.2 TF of recompute for ~5 passes of HBM traffic
+    (measured worth ~4 ms/step at bert-base batch 48). Multi-device meshes
+    keep the unchunked form: GSPMD owns the vocab-parallel layout there.
 
     ``labels`` is the same [B, S] token stream; the shift happens HERE:
     position i's logits are scored against labels[i+1]."""
-    logits = forward(params, tokens, cfg)[:, :-1]
+    h = forward_hidden(params, tokens, cfg)
+    dt = cfg.dtype
+    B, S, _ = h.shape
+    nc = cfg.ce_chunks
+    from ..parallel.mesh import get_mesh
+
+    mesh = get_mesh()
+    multi = mesh is not None and mesh.size > 1
+    if nc and not multi and B % nc == 0:
+        W = params["lm_head"].astype(dt)
+        # pad the shifted targets so every position has a label; the pad
+        # column carries weight 0 (exactly the reference's shift+mean)
+        targets = jnp.concatenate(
+            [labels[:, 1:], jnp.zeros((B, 1), labels.dtype)], axis=1)
+        wgt = jnp.concatenate(
+            [jnp.ones((S - 1,), jnp.float32), jnp.zeros((1,), jnp.float32)])
+        hc = h.reshape(nc, B // nc, S, h.shape[-1])
+        tc = targets.reshape(nc, B // nc, S)
+        body = jax.checkpoint(
+            lambda hcb, tcb: _nll_sum(hcb @ W, tcb, wgt[None, :]))
+        total = jnp.float32(0.0)
+        for i in range(nc):
+            total = total + body(hc[i], tc[i])
+        return total / (B * (S - 1))
+    logits = wsc(h @ params["lm_head"].astype(dt),
+                 P(("dp", "sharding"), None, "mp"))[:, :-1]
     targets = labels[:, 1:]
-    m = jnp.max(logits, axis=-1).astype(jnp.float32)
-    # one fused pass: f32(bf16) - f32 max -> exp -> row sum (the convert
-    # fuses into the reduction; subtracting in bf16 would re-round the
-    # differences to 8 mantissa bits)
-    sumexp = jnp.sum(
-        jnp.exp(logits.astype(jnp.float32) - m[..., None]), axis=-1)
-    gold = jnp.take_along_axis(
-        logits, targets[..., None], axis=-1)[..., 0].astype(jnp.float32)
-    return jnp.mean(m + jnp.log(sumexp) - gold)
+    return _nll_sum(logits, targets, jnp.float32(1.0)) / (B * (S - 1))
 
 
 # ---------------------------------------------------------------------------

@@ -210,6 +210,10 @@ def _pallas_flash_attention(q, k, v, is_causal=False, scale=None,
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    if (not with_lse and not block_q and not block_k
+            and _packed_eligible(q, k)):
+        # transpose-free packed layout (see the packed section below)
+        return _pallas_flash_fwd_packed(q, k, v, is_causal, scale=scale)[0]
     block_q = min(block_q, sq) if block_q else _pick_block(sq)
     block_k = min(block_k, sk) if block_k else _pick_block(sk)
     # sq > sk under causal would put query rows before any visible key
@@ -477,6 +481,159 @@ def _pallas_flash_bwd(q, k, v, do, out, lse, is_causal, scale=None,
     return unfold(dq, sq), unfold(dk, sk), unfold(dv, sk)
 
 
+# ---------------------------------------------------------------------------
+# Packed flat-layout kernels: [B, S, H*D] with 128//D heads per grid cell.
+#
+# Why: D=64 leaves single-head blocks at half the 128-lane width, and the
+# [B,S,H,D] -> [B*H,S,D] fold costs SIX materialised transposes per layer
+# (fwd q/k/v + refolds in the backward). Packing 2 heads per cell makes the
+# minor block dim a full 128 lanes ON THE MODEL'S NATIVE [B,S,H*D] layout —
+# zero transposes anywhere — and the single-block structure lets ONE
+# backward kernel produce dq, dk AND dv from one shared probability
+# recompute (the two-kernel path recomputes p twice). Single-block only
+# (the [S,S] score block lives in VMEM): longer sequences keep the blocked
+# [B*H,S,D] path above; ring attention owns the sharded-seq regime.
+# ---------------------------------------------------------------------------
+
+
+def _packed_group(h: int, d: int) -> int:
+    """Heads per grid cell for the packed layout (0 = ineligible)."""
+    if d > 128 or 128 % d or d % 8:
+        return 0
+    hp = 128 // d
+    return hp if h % hp == 0 else 0
+
+
+def _packed_eligible(q, k) -> int:
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    hp = _packed_group(h, d)
+    # <=512 keeps the fused backward's [S,S] fp32 intermediates well inside
+    # VMEM and leaves S>=1024 on the blocked multi-block kernels (whose
+    # causal block-skip bounds need their own live coverage)
+    if hp and hk == h and sq == sk and sq % 128 == 0 and sq <= 512:
+        return hp
+    return 0
+
+
+def _make_packed_fwd(S, d, hp, is_causal):
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
+        for i in range(hp):
+            sl = slice(i * d, (i + 1) * d)
+            q = q_ref[0, :, sl]  # PRE-SCALED, [S, d]
+            k = k_ref[0, :, sl]
+            v = v_ref[0, :, sl]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if is_causal:
+                qp = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+                kp = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+                s = jnp.where(qp >= kp, s, -jnp.inf)
+            m = jnp.max(s, axis=1)
+            p = jnp.exp(s - m[:, None])
+            l = jnp.sum(p, axis=1)
+            o = jax.lax.dot_general(p.astype(v.dtype), v,
+                                    (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            o_ref[0, :, sl] = (o / l[:, None]).astype(o_ref.dtype)
+            lse_ref[0, 0, i, :] = m + jnp.log(l)
+    return kernel
+
+
+def _make_packed_bwd(S, d, hp, is_causal, scale):
+    """Fused dq/dk/dv: one probability recompute serves all three grads
+    (the blocked path pays it twice across its dq and dkv kernels)."""
+    def kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+               dq_ref, dk_ref, dv_ref):
+        for i in range(hp):
+            sl = slice(i * d, (i + 1) * d)
+            q = q_ref[0, :, sl]  # PRE-SCALED (dk then carries the scale)
+            k = k_ref[0, :, sl]
+            v = v_ref[0, :, sl]
+            do = do_ref[0, :, sl]
+            o = o_ref[0, :, sl]
+            lse = lse_ref[0, 0, i, :]
+            delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                            axis=1)
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            p = jnp.exp(s - lse[:, None])
+            if is_causal:
+                qp = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+                kp = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+                p = jnp.where(qp >= kp, p, 0.0)
+            pb = p.astype(do.dtype)
+            dv = jax.lax.dot_general(pb, do, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None])).astype(q.dtype)
+            dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            dk = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            dq_ref[0, :, sl] = (dq * scale).astype(dq_ref.dtype)
+            dk_ref[0, :, sl] = dk.astype(dk_ref.dtype)
+            dv_ref[0, :, sl] = dv.astype(dv_ref.dtype)
+    return kernel
+
+
+def _pallas_flash_fwd_packed(q, k, v, is_causal, scale=None):
+    """(out[B,S,H,D], lse[B,G,hp,S]) via the packed flat layout."""
+    from jax.experimental import pallas as pl
+
+    b, S, h, d = q.shape
+    hp = _packed_eligible(q, k)
+    assert hp, "caller must gate on _packed_eligible"
+    G = h // hp
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    hd = h * d
+    qf = (q * scale).astype(q.dtype).reshape(b, S, hd)
+    kf = k.reshape(b, S, hd)
+    vf = v.reshape(b, S, hd)
+    blk = pl.BlockSpec((1, S, hp * d), lambda bb, g: (bb, 0, g))
+    out, lse = pl.pallas_call(
+        _make_packed_fwd(S, d, hp, is_causal),
+        grid=(b, G),
+        in_specs=[blk, blk, blk],
+        out_specs=[blk, pl.BlockSpec((1, 1, hp, S),
+                                     lambda bb, g: (bb, g, 0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b, S, hd), q.dtype),
+                   jax.ShapeDtypeStruct((b, G, hp, S), jnp.float32)],
+    )(qf, kf, vf)
+    return out.reshape(b, S, h, d), lse
+
+
+def _pallas_flash_bwd_packed(q, k, v, do, out, lse, is_causal, scale=None):
+    """(dq, dk, dv) in [B,S,H,D] via the fused packed backward."""
+    from jax.experimental import pallas as pl
+
+    b, S, h, d = q.shape
+    hp = _packed_eligible(q, k)
+    assert hp, "caller must gate on _packed_eligible"
+    G = h // hp
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    hd = h * d
+    qf = (q * scale).astype(q.dtype).reshape(b, S, hd)
+    kf = k.reshape(b, S, hd)
+    vf = v.reshape(b, S, hd)
+    dof = do.reshape(b, S, hd)
+    of = out.reshape(b, S, hd)
+    blk = pl.BlockSpec((1, S, hp * d), lambda bb, g: (bb, 0, g))
+    lse_blk = pl.BlockSpec((1, 1, hp, S), lambda bb, g: (bb, g, 0, 0))
+    dq, dk, dv = pl.pallas_call(
+        _make_packed_bwd(S, d, hp, is_causal, scale),
+        grid=(b, G),
+        in_specs=[blk, blk, blk, blk, blk, lse_blk],
+        out_specs=[blk, blk, blk],
+        out_shape=[jax.ShapeDtypeStruct((b, S, hd), q.dtype),
+                   jax.ShapeDtypeStruct((b, S, hd), k.dtype),
+                   jax.ShapeDtypeStruct((b, S, hd), v.dtype)],
+    )(qf, kf, vf, dof, of, lse)
+    r4 = lambda x: x.reshape(b, S, h, d)
+    return r4(dq), r4(dk), r4(dv)
+
+
 def flash_path_active(mask=None) -> bool:
     """True when `dot_product_attention` would take the Pallas flash path
     (TPU, kernels enabled, no additive mask, single-device mesh). Models use
@@ -525,6 +682,9 @@ def _flash_custom_vjp(q, k, v, is_causal):
 
 
 def _flash_fwd(q, k, v, is_causal):
+    if _packed_eligible(q, k):
+        out, lse = _pallas_flash_fwd_packed(q, k, v, is_causal)
+        return out, (q, k, v, out, lse)  # packed lse is 4-D (the marker)
     fwd = _pallas_flash_fwd_lse(q, k, v, is_causal=is_causal)
     if fwd is None:  # untileable shapes: XLA path, recompute backward
         return (_pallas_flash_attention(q, k, v, is_causal=is_causal),
@@ -535,6 +695,8 @@ def _flash_fwd(q, k, v, is_causal):
 
 def _flash_bwd(is_causal, res, g):
     q, k, v, out, lse = res
+    if lse is not None and lse.ndim == 4:  # packed path residuals
+        return _pallas_flash_bwd_packed(q, k, v, g, out, lse, is_causal)
     if lse is not None:
         return _pallas_flash_bwd(q, k, v, g, out, lse, is_causal)
     _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(

@@ -1,0 +1,12 @@
+"""Packed-layout eligibility logic (pure shape math — runs on any backend;
+the kernel parity tests live in test_flash_attention_tpu.py)."""
+
+from paddle_tpu.ops.pallas.flash_attention import _packed_group
+
+
+def test_packed_group_head_packing():
+    assert _packed_group(12, 64) == 2   # two 64-wide heads fill 128 lanes
+    assert _packed_group(4, 128) == 1   # 128-wide head native
+    assert _packed_group(7, 64) == 0    # odd head count can't pair
+    assert _packed_group(8, 80) == 0    # 80 doesn't divide 128
+    assert _packed_group(8, 256) == 0   # wider than the lane tile

@@ -221,3 +221,24 @@ def test_beam_search_generate():
                                    num_beams=3, eos_token_id=50,
                                    length_penalty=4.0)
     assert not bool(jnp.all(a == b))
+
+
+def test_chunked_ce_matches_unchunked():
+    """ce_chunks>0 recomputes the head+CE per batch-chunk (logits never
+    materialised); loss AND grads must equal the unchunked form."""
+    import jax
+
+    from paddle_tpu.parallel import set_mesh
+
+    set_mesh(None)  # single-chip gate for the chunked path
+    cfg0 = llama.LlamaConfig.tiny()
+    cfg1 = llama.LlamaConfig.tiny(ce_chunks=2)
+    params = llama.init_params(cfg0)
+    tok = jnp.array(np.random.RandomState(0).randint(
+        0, cfg0.vocab_size, (4, 32)), jnp.int32)
+    l0, g0 = jax.value_and_grad(lambda p: llama.loss_fn(p, tok, tok, cfg0))(params)
+    l1, g1 = jax.value_and_grad(lambda p: llama.loss_fn(p, tok, tok, cfg1))(params)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-5)
+    for k in g0:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g0[k]),
+                                   rtol=1e-4, atol=1e-6)
