@@ -192,6 +192,7 @@ def run_backward(
         seed(t, g)
 
     if not roots:
+        _fire_backward_end(capture, accumulate_leaves)
         return captured if capture is not None else None
 
     for node in _topo_order(roots):
@@ -238,7 +239,34 @@ def run_backward(
             else:
                 tslots = cots.setdefault(id(target), [None] * target.n_outputs)
                 tslots[idx] = g if tslots[idx] is None else tslots[idx] + g
+    _fire_backward_end(capture, accumulate_leaves)
     return captured if capture is not None else None
+
+
+# --- backward-completion callbacks ----------------------------------------
+# The reference's C++ Reducer hooks the END of the autograd pass (its
+# finalize step flushes grad buckets). Eager consumers (DataParallel
+# bucketing) register here; callbacks fire only for the leaf-accumulating
+# ``.backward()`` walk, never for ``paddle.grad`` capture passes.
+
+_backward_end_callbacks: List[Any] = []
+
+
+def register_backward_end_callback(fn) -> None:
+    _backward_end_callbacks.append(fn)
+
+
+def unregister_backward_end_callback(fn) -> None:
+    try:
+        _backward_end_callbacks.remove(fn)
+    except ValueError:
+        pass
+
+
+def _fire_backward_end(capture, accumulate_leaves) -> None:
+    if capture is None and accumulate_leaves:
+        for fn in list(_backward_end_callbacks):
+            fn()
 
 
 def backward(

@@ -142,7 +142,11 @@ class Stream:
         synchronize(self.device)
 
     def query(self) -> bool:
-        synchronize(self.device)
+        """Non-blocking completion poll (reference ``Stream.query``). XLA
+        dispatch is in-order and this framework's streams are the no-op
+        stream model, so there is no pending-work handle to poll — return
+        True WITHOUT draining the device (a synchronizing query would turn
+        reference-style polling loops into full device barriers)."""
         return True
 
 
@@ -160,8 +164,8 @@ class Event:
         self._stream = stream
 
     def query(self) -> bool:
-        if self._recorded:
-            synchronize(self.device)
+        # non-blocking, like Stream.query (see there); the reference's
+        # cudaEventQuery never drains the device either
         return True
 
     def synchronize(self) -> None:

@@ -30,7 +30,8 @@ from .env import ParallelEnv, get_rank, get_world_size
 __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "all_reduce", "all_gather",
     "all_gather_object", "reduce", "reduce_scatter", "broadcast", "scatter",
-    "alltoall", "all_to_all", "send", "recv", "isend", "irecv", "barrier",
+    "alltoall", "all_to_all", "send", "recv", "send_next", "recv_prev",
+    "isend", "irecv", "barrier",
     "get_default_group",
 ]
 
@@ -461,6 +462,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     caller (the reference's pipeline pattern — send to the next stage):
     rank r's buffer goes to rank (r + (dst - rank)) mod n, compiled as one
     collective-permute over the whole ring."""
+    _warn_absolute_rank_p2p("send", dst, group)
     g = group or get_default_group()
     if g.nranks == 1:
         return tensor
@@ -486,7 +488,13 @@ def recv(tensor, src=0, group=None, sync_op=True):
     collective-permute; like ``send``, ``src`` expresses a uniform shift
     (receive from the previous stage etc.): rank r receives the buffer of
     rank (r - (rank - src)) mod n — ``tensor`` holds each rank's outgoing
-    payload, per the reference's p2p_communication convention."""
+    payload, per the reference's p2p_communication convention.
+
+    The received payload is ALSO bound back onto ``tensor`` (when it is a
+    framework Tensor), so reference-style code that reads the original
+    recv buffer after ``wait()`` sees the peer's data, not its own
+    outgoing payload."""
+    _warn_absolute_rank_p2p("recv", src, group)
     g = group or get_default_group()
     if g.nranks == 1:
         return tensor
@@ -501,8 +509,63 @@ def recv(tensor, src=0, group=None, sync_op=True):
         me = max(g.get_group_rank(get_rank()), 0)
         shift = (me - peer) % n
         perm = [(i, (i + shift) % n) for i in range(n)]
-        return Tensor(jax.lax.ppermute(val, ax, perm))
+        out = jax.lax.ppermute(val, ax, perm)
+        if isinstance(tensor, Tensor):
+            tensor._value = out  # fill the passed buffer (traced rebind)
+            return tensor
+        return Tensor(out)
     raise InvalidArgumentError("eager send/recv requires a shard_map context or launch runtime")
+
+
+_P2P_WARNED = set()
+_P2P_SUPPRESS = [0]  # >0 while inside a shift-explicit API
+
+
+def _warn_absolute_rank_p2p(op: str, peer, group) -> None:
+    """One-time heads-up that SPMD send/recv reinterpret absolute ranks as
+    a UNIFORM ring shift (ADVICE r1): patterns that aren't a rotation
+    (e.g. every rank sending to rank 0) silently become one. The
+    shift-explicit ``send_next``/``recv_prev`` APIs say what they mean."""
+    if _P2P_SUPPRESS[0]:
+        return
+    g = group or get_default_group()
+    if g.nranks > 2 and (op, g.id) not in _P2P_WARNED:
+        _P2P_WARNED.add((op, g.id))
+        import warnings
+
+        warnings.warn(
+            f"paddle.distributed.{op}(peer={peer}) under SPMD compiles to a "
+            "UNIFORM ring shift of (peer - rank) positions: every rank "
+            "shifts by the same amount, as in pipeline next/prev-stage "
+            "exchange. Non-uniform P2P patterns (e.g. all ranks -> rank 0) "
+            "are NOT expressible this way — use gather/scatter collectives, "
+            "or the explicit send_next/recv_prev APIs.",
+            stacklevel=3)
+
+
+def send_next(tensor, group=None):
+    """Shift-explicit P2P: every rank sends ``tensor`` to the next rank on
+    the ring (pipeline send_forward). Equivalent to ``send(dst=rank+1)``
+    but says the uniform-shift semantics out loud."""
+    g = group or get_default_group()
+    me = max(g.get_group_rank(get_rank()), 0)
+    _P2P_SUPPRESS[0] += 1  # shift is explicit here — no warning
+    try:
+        return send(tensor, dst=g.ranks[(me + 1) % g.nranks], group=g)
+    finally:
+        _P2P_SUPPRESS[0] -= 1
+
+
+def recv_prev(tensor, group=None):
+    """Shift-explicit P2P: every rank receives the previous rank's buffer
+    (pipeline recv_forward); ``tensor`` holds this rank's outgoing payload."""
+    g = group or get_default_group()
+    me = max(g.get_group_rank(get_rank()), 0)
+    _P2P_SUPPRESS[0] += 1  # shift is explicit here — no warning
+    try:
+        return recv(tensor, src=g.ranks[(me - 1) % g.nranks], group=g)
+    finally:
+        _P2P_SUPPRESS[0] -= 1
 
 
 def isend(tensor, dst=0, group=None):
@@ -557,10 +620,8 @@ class P2POp:
 
 def batch_isend_irecv(p2p_op_list):
     """Launch a batch of P2POps; returns one task per op. NOTE the SPMD
-    convention (see send/recv): peers express UNIFORM SHIFTS and each op
-    RETURNS its result — recv returns a NEW tensor holding the peer's
-    payload rather than filling the passed buffer in place, so read the
-    returned tasks' values, not the original buffers."""
+    convention (see send/recv): peers express UNIFORM SHIFTS. irecv fills
+    the passed buffer in place (reference semantics) AND returns it."""
     tasks = []
     for p in p2p_op_list:
         if p.op is isend:
@@ -630,11 +691,21 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
     from .fleet import meta_parallel as mp
 
     if name is None:
-        raise InvalidArgumentError(
-            "paddle.distributed.split needs a unique `name` per logical "
-            "layer: the weight it creates is cached and reused across "
-            "calls, and an implicit key would silently weight-tie "
-            "same-shaped projections")
+        # reference signature makes name optional: derive a stable key from
+        # the WHOLE call stack, so the weight a given split() call path
+        # creates is reused across steps (same stack every step) while a
+        # helper function invoked from two places builds two distinct
+        # layers (ADVICE r1 + review: file:line of the immediate caller
+        # would weight-tie factory helpers). One line building several
+        # layers in a loop still needs an explicit name.
+        import sys
+
+        frames = []
+        f = sys._getframe(1)
+        while f is not None:
+            frames.append((id(f.f_code), f.f_lineno))
+            f = f.f_back
+        name = f"_split_auto:{hash(tuple(frames)) & 0xFFFFFFFFFFFF:x}"
     if operation == "linear" and axis not in (0, 1):
         raise InvalidArgumentError(
             f"split(operation='linear') partitions a 2-D weight: axis must "

@@ -119,8 +119,17 @@ class Pod:
         for c in self.containers:
             c.start()
 
-    def watch(self) -> int:
-        """Block until any worker exits; returns its code (0 = all done)."""
+    # sentinel: cluster membership changed (elastic scale event) — the pod
+    # itself is healthy but must re-rendezvous
+    MEMBERSHIP_CHANGED = -99
+
+    def watch(self, monitor=None) -> int:
+        """Block until any worker exits; returns its code (0 = all done).
+
+        ``monitor`` (optional callable) is polled each cycle — the elastic
+        membership hook: returning True reports a scale event and watch
+        returns ``MEMBERSHIP_CHANGED`` so the controller can tear the pod
+        down and re-rendezvous (SURVEY §5.3 mechanism)."""
         while True:
             alive = 0
             for c in self.containers:
@@ -131,6 +140,8 @@ class Pod:
                     return rc
             if alive == 0:
                 return 0
+            if monitor is not None and monitor():
+                return self.MEMBERSHIP_CHANGED
             time.sleep(0.5)
 
     def stop(self):
@@ -146,6 +157,8 @@ class CollectiveController:
         self.ctx = ctx
 
     def _node_rank(self) -> int:
+        if getattr(self, "_rank_override", None) is not None:
+            return self._rank_override
         if self.ctx.rank >= 0:
             return self.ctx.rank
         return int(os.environ.get("PADDLE_NODE_RANK", "0"))
@@ -184,18 +197,72 @@ class CollectiveController:
             pod.add(Container(cmd, env, log))
         return pod
 
+    def _make_elastic_monitor(self):
+        """Multi-node elastic membership: register this node with an
+        ElasticManager on the master store plane (master port + 1) and
+        return a pod-watch hook that reports peer-node death. Single-node
+        pods need no membership plane — local child death is already what
+        ``pod.watch`` sees — so this returns None there."""
+        ctx = self.ctx
+        if ctx.elastic_level < 1 or len(ctx.ips) <= 1:
+            return None
+        from ..fleet.elastic import ElasticManager, ElasticStatus
+
+        master = ctx.master or f"{ctx.ips[0]}:49170"
+        host, port = master.rsplit(":", 1)
+        node_rank = self._node_rank()
+        self._elastic = ElasticManager(
+            node_id=f"node{node_rank}", host=host, port=int(port) + 1,
+            is_master=(node_rank == 0))
+        self._elastic.start()
+
+        def monitor() -> bool:
+            ev = self._elastic.watch()
+            if ev.status == ElasticStatus.SCALE_IN:
+                print(f"[launch] elastic: nodes {ev.dead} died; "
+                      f"re-rendezvous with {ev.alive}", file=sys.stderr)
+                self._pending_alive = list(ev.alive)
+                return True
+            return False
+
+        return monitor
+
+    def _shrink_to_survivors(self):
+        """Re-form the job at reduced size after a SCALE_IN: keep only the
+        surviving nodes' ips and renumber this node's rank by its position
+        among survivors, so build_pod emits the smaller world. (If node 0 —
+        the master — died, the rendezvous plane itself is gone; survivors
+        will fail to re-form, which is the reference's behaviour too.)"""
+        alive = getattr(self, "_pending_alive", None)
+        self._pending_alive = None
+        if not alive:
+            return
+        keep = sorted(int(n[4:]) for n in alive
+                      if n.startswith("node") and n[4:].isdigit())
+        me = self._node_rank()
+        if me not in keep or not keep:
+            return
+        self.ctx.ips = [self.ctx.ips[i] for i in keep
+                        if i < len(self.ctx.ips)]
+        self._rank_override = keep.index(me)
+
     def run(self) -> int:
         restarts = 0
+        monitor = self._make_elastic_monitor()
         while True:
             pod = self.build_pod()
             pod.start()
-            rc = pod.watch()
+            rc = pod.watch(monitor=monitor)
             pod.stop()
             if rc == 0:
                 return 0
             if self.ctx.elastic_level >= 1 and restarts < self.ctx.max_restart:
                 restarts += 1
-                print(f"[launch] worker failed (exit {rc}); elastic restart "
+                why = ("membership changed" if rc == Pod.MEMBERSHIP_CHANGED
+                       else f"worker failed (exit {rc})")
+                if rc == Pod.MEMBERSHIP_CHANGED:
+                    self._shrink_to_survivors()
+                print(f"[launch] {why}; elastic restart "
                       f"{restarts}/{self.ctx.max_restart}", file=sys.stderr)
                 time.sleep(1.0)
                 continue

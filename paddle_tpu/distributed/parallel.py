@@ -1,26 +1,47 @@
-"""DataParallel — dygraph data parallelism.
+"""DataParallel — dygraph data parallelism with bucketed grad sync.
 
 Reference: ``paddle.DataParallel`` over the C++ ``Reducer``
 (``paddle/fluid/distributed/collective/reducer.cc``; SURVEY.md §2.2 DP row):
-bucketed grad allreduce overlapping backward. TPU-native: gradient hooks
-(per-parameter, firing as the tape accumulates) lower to ``lax.psum`` when
-running under a shard_map/SPMD program; in single-controller SPMD mode the
-preferred path is data sharding + jit (XLA inserts the grad psums), which
-``paddle_tpu.distributed.fleet.distributed_model`` sets up — this class keeps
-the dygraph API shape and the ``no_sync`` contract.
+parameters are grouped (reverse construction order) into ~``comm_buffer_size``
+MB buckets; as backward produces grads, complete buckets launch ONE fused
+allreduce each, and the Reducer's finalize step flushes stragglers.
+
+TPU-native mapping: the bucket flush runs from an autograd
+backward-completion callback (the Reducer finalize analog) and issues one
+``all_reduce`` per bucket on the flattened concat — coalescing many small
+collectives into few large ones, which is the Reducer's first-order win.
+Issue-order overlap with backward compute is implicit: XLA dispatch is
+async, so earlier buckets' collectives execute while later host work
+proceeds. ``find_unused_parameters`` mirrors the reference contract: with
+it False, a parameter that received no gradient raises (pointing at the
+flag); with it True, missing grads contribute zeros to the bucket so every
+rank issues identical collectives, and the local ``.grad`` stays None.
+
+In single-controller SPMD mode the preferred path remains data sharding +
+jit (XLA inserts the grad psums) via ``fleet.distributed_model``; this
+class serves the launcher's multi-process runtime and keeps the dygraph
+API shape (``no_sync``, ``comm_buffer_size``, ``find_unused_parameters``).
 """
 
 from __future__ import annotations
 
 import contextlib
-from typing import Optional
+from typing import List, Optional
 
+import numpy as np
+
+from ..core import autograd
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 from .collective import ReduceOp, all_reduce, get_default_group
 from .env import get_world_size
 
 __all__ = ["DataParallel"]
+
+
+class _Bucket:
+    def __init__(self, params):
+        self.params = params  # reverse-order slice of trainable params
 
 
 class DataParallel(Layer):
@@ -31,25 +52,100 @@ class DataParallel(Layer):
         self._layers = layers
         self._group = group or get_default_group()
         self._grad_sync = True
+        self._find_unused = bool(find_unused_parameters)
         self.add_sublayer("_layers", layers)
+        self._buckets: List[_Bucket] = []
+        self._flush_cb = None
+        self._dirty = False  # set by param hooks during THIS model's backward
         if get_world_size(self._group) > 1:
-            self._register_grad_hooks()
+            self._build_buckets(float(comm_buffer_size))
+            import weakref
 
-    def _register_grad_hooks(self):
-        scale = 1.0 / get_world_size(self._group)
-        for p in self._layers.parameters():
-            if p.stop_gradient:
-                continue
+            wself = weakref.ref(self)
+            for b in self._buckets:
+                for p in b.params:
+                    def _mark(grad, _w=wself):
+                        s = _w()
+                        if s is not None:
+                            s._dirty = True
+                        return grad
+                    p.register_hook(_mark)
 
-            def hook(grad, _p=p, _scale=scale, _self=self):
-                if not _self._grad_sync:
-                    return grad
-                synced = all_reduce(grad, op=ReduceOp.SUM, group=_self._group)
-                from ..ops.math import scale as scale_op
+            # weakref callback: the global registry must not keep the
+            # model (and all its parameters) alive forever; a dead ref
+            # unregisters itself on the next backward
+            def _cb(_w=wself):
+                s = _w()
+                if s is None:
+                    autograd.unregister_backward_end_callback(_cb)
+                    return
+                s._flush_buckets()
 
-                return scale_op(synced, _scale)
+            self._flush_cb = _cb
+            autograd.register_backward_end_callback(_cb)
 
-            p.register_hook(hook)
+    def __del__(self):
+        if self._flush_cb is not None:
+            autograd.unregister_backward_end_callback(self._flush_cb)
+
+    def _build_buckets(self, mb: float):
+        """Reverse construction order (grads arrive roughly back-to-front,
+        like the reference), split at ~comm_buffer_size MB boundaries."""
+        limit = max(mb, 1e-6) * (1 << 20)
+        cur, cur_bytes = [], 0.0
+        for p in reversed([p for p in self._layers.parameters()
+                           if not p.stop_gradient]):
+            nbytes = float(np.prod(p.shape)) * 4.0
+            if cur and cur_bytes + nbytes > limit:
+                self._buckets.append(_Bucket(cur))
+                cur, cur_bytes = [], 0.0
+            cur.append(p)
+            cur_bytes += nbytes
+        if cur:
+            self._buckets.append(_Bucket(cur))
+
+    def _flush_buckets(self):
+        # fire only for backwards that produced grads for THIS model (the
+        # dirty flag set by the param hooks) — a process can host several
+        # models and unrelated backwards must not re-sync stale grads
+        if not self._dirty:
+            return
+        self._dirty = False
+        if not self._grad_sync or not self._buckets:
+            return
+        import jax.numpy as jnp
+
+        inv = 1.0 / get_world_size(self._group)
+        for b in self._buckets:
+            flats, had_grad = [], []
+            for p in b.params:
+                if p.grad is None:
+                    if not self._find_unused:
+                        raise RuntimeError(
+                            f"DataParallel: parameter {p.name!r} received no "
+                            "gradient this backward; pass "
+                            "find_unused_parameters=True if parts of the "
+                            "model are conditionally unused")
+                    flats.append(jnp.zeros(int(np.prod(p.shape)),
+                                           jnp.float32))
+                    had_grad.append(False)
+                else:
+                    autograd.densify_grad_(p)
+                    flats.append(
+                        p.grad._value.astype(jnp.float32).reshape(-1))
+                    had_grad.append(True)
+            fused = Tensor(jnp.concatenate(flats) if len(flats) > 1
+                           else flats[0], stop_gradient=True)
+            all_reduce(fused, op=ReduceOp.SUM, group=self._group)
+            synced = fused._value * inv
+            off = 0
+            for p, had in zip(b.params, had_grad):
+                n = int(np.prod(p.shape))
+                if had:
+                    p.grad = Tensor(
+                        synced[off:off + n].reshape(p.shape).astype(
+                            p.grad._value.dtype), stop_gradient=True)
+                off += n
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)

@@ -67,15 +67,44 @@ class GroupShardedScaler:
 
 class _GroupShardedOptimizer(HybridParallelOptimizer):
     """Optimizer wrapper for stages 2/3: state + grad placement over the
-    zero axes; stage 3 re-pins params sharded after each update."""
+    zero axes; stage 3 re-pins params sharded after each update.
 
-    def __init__(self, optimizer, model, stage: int):
+    ``offload=True`` is the reference's CPU-offload: between steps the
+    sharded optimizer states live in HOST memory (``pinned_host`` memory
+    kind), freeing HBM for activations/params; ``step()`` stages them onto
+    the device, updates, and spills them back. Synchronous H2D/D2H per
+    step — the reference's async prefetch is a further optimisation, not a
+    semantic difference."""
+
+    def __init__(self, optimizer, model, stage: int, offload: bool = False):
         super().__init__(optimizer, hcg=None, strategy=None)
         self._sharding_stage = stage
         self._model = model
+        self._offload = bool(offload)
+
+    def _move_states(self, to_host: bool):
+        from jax.sharding import NamedSharding
+
+        mesh = get_mesh()
+        if mesh is None:
+            return
+        opt = self._inner_opt
+        for state in opt._accumulators.values():
+            for k, v in list(state.items()):
+                if not hasattr(v, "ndim") or v.ndim == 0:
+                    continue
+                spec = zero_shard_spec(v.shape, mesh) or P(*([None] * v.ndim))
+                sh = NamedSharding(mesh, spec,
+                                   memory_kind="pinned_host" if to_host
+                                   else "device")
+                state[k] = jax.device_put(v, sh)
 
     def step(self):
+        if self._offload:
+            self._move_states(to_host=False)
         super().step()
+        if self._offload:
+            self._move_states(to_host=True)
         if self._sharding_stage >= 3:
             _shard_model_params(self._model)
 
@@ -88,14 +117,10 @@ def group_sharded_parallel(model, optimizer, level: str = "os_g",
     """Wrap (model, optimizer[, scaler]) for ZeRO training at ``level``."""
     if level not in _LEVELS:
         raise ValueError(f"level must be one of {sorted(_LEVELS)}, got {level!r}")
-    if offload:
-        # CPU offload of sharded states: orthogonal to layout; jax supports
-        # host memory via device_put to CPU — kept for a later milestone.
-        raise NotImplementedError("offload is not supported yet on the TPU backend")
     stage = _LEVELS[level]
     if stage >= 3:
         _shard_model_params(model)
-    opt = _GroupShardedOptimizer(optimizer, model, stage)
+    opt = _GroupShardedOptimizer(optimizer, model, stage, offload=offload)
     if scaler is not None:
         scaler = GroupShardedScaler(scaler)
         return model, opt, scaler

@@ -370,22 +370,29 @@ def save(layer, path, input_spec=None, **configs):
 
             # InputSpec dims of None/-1 (dynamic batch etc.) become
             # jax.export symbolic dimensions in ONE shared scope. A None at
-            # axis j is named dyn{j} — the same name across specs, so the
-            # batch dims of multiple inputs unify (a+b etc. stays
-            # broadcastable). For independently-varying extents, put a
-            # STRING in the InputSpec shape (e.g. ["qlen", 16] vs
-            # ["klen", 16]) and equal strings unify, distinct ones don't.
+            # axis j is named dyn{j} for specs sharing an (ndim, dtype)
+            # signature — the common co-varying case ((x, labels) float
+            # pairs, a+b operands) unifies so export succeeds. Specs with
+            # distinct signatures get per-spec names dyn{i}_{j} so e.g. an
+            # int token stream and a float feature batch are NOT silently
+            # equated (ADVICE r1). For explicit control, put a STRING in
+            # the InputSpec shape (e.g. ["qlen", 16] vs ["klen", 16]):
+            # equal strings unify, distinct ones don't.
+            sigs = [(len(s.shape), str(s.dtype)) for s in input_spec]
             scope = None
             specs = []
-            for s in input_spec:
+            for i, s in enumerate(input_spec):
                 dims = tuple(s.shape)
                 if any(not isinstance(d, int) or d == -1 for d in dims):
                     if scope is None:
                         scope = jexport.SymbolicScope()
+                    shared = sigs.count(sigs[i]) > 1
+                    auto = (lambda j: f"dyn{j}") if shared else \
+                        (lambda j, _i=i: f"dyn{_i}_{j}")
                     shape_str = ", ".join(
                         d if isinstance(d, str)
                         else (str(d) if d is not None and d != -1
-                              else f"dyn{j}")
+                              else auto(j))
                         for j, d in enumerate(dims))
                     dims = jexport.symbolic_shape(shape_str, scope=scope)
                 specs.append(jax.ShapeDtypeStruct(dims, s.dtype))

@@ -1,0 +1,30 @@
+"""Host-event collection plumbing shared by the dispatcher and profiler.
+
+Reference counterpart: the C++ host tracer's RAII ``RecordEvent`` calls
+sprinkled through the eager layer and executor (SURVEY.md §5.1) — op
+dispatch reports per-op host spans here; ``paddle.profiler.Profiler``
+registers itself as a collector while recording. Kept dependency-free so
+``ops.dispatch`` (hot path) imports nothing but this module; the fast-path
+cost when no profiler is active is one falsy check on ``COLLECTORS``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+# active Profiler instances (a stack: nested profilers each get events)
+COLLECTORS: List[object] = []
+
+
+def active() -> bool:
+    return bool(COLLECTORS)
+
+
+def now_ns() -> int:
+    return time.perf_counter_ns()
+
+
+def emit(name: str, start_ns: int, end_ns: int, kind: str = "op") -> None:
+    for c in COLLECTORS:
+        c._host_event(name, start_ns, end_ns, kind)

@@ -1,0 +1,122 @@
+"""xplane → summary tables / chrome trace (the device half of §5.1).
+
+Reference counterpart: the CUPTI device tracer + chrome-trace serializer
+(``paddle/fluid/platform/profiler/``): kernel/memcpy timelines and the
+op/kernel summary tables. On TPU the device timeline already exists — XLA
+emits xplane protos into the trace dir — so this module PARSES it
+(``jax.profiler.ProfileData``) instead of re-collecting it:
+
+* ``device_tables``: per-plane aggregation of the "XLA Modules" line
+  (program-level spans — the op-level view) and the "XLA Ops" line
+  (HLO-instruction spans — the kernel-level view), plus device occupancy
+  (busy module time / observed wall).
+* ``chrome_events``: the same spans as chrome-trace "X" events, merged with
+  the profiler's host spans into one loadable ``chrome_trace.json``.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+
+def latest_xplane(log_dir: str) -> Optional[str]:
+    files = glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    return max(files, key=os.path.getmtime) if files else None
+
+
+_HLO_RE = re.compile(r"=\s*\S+\s+([a-zA-Z][\w-]*)\(")
+
+
+def _kernel_key(event_name: str) -> str:
+    """%fusion.3 = f32[..] fusion(...) -> 'fusion' (HLO opcode)."""
+    m = _HLO_RE.search(event_name)
+    if m:
+        return m.group(1)
+    return event_name.split(" ", 1)[0].lstrip("%")
+
+
+def _module_key(name: str) -> str:
+    """jit_matmul(12345...) -> jit_matmul."""
+    return name.split("(", 1)[0]
+
+
+def parse(log_dir: str):
+    """Returns (tables, chrome_events) or (None, []) when no xplane exists.
+
+    tables = {
+      'modules': {name: [calls, total_ns]},
+      'kernels': {opcode: [calls, total_ns]},
+      'occupancy': float | None,   # busy/wall over the device plane
+      'device': plane name,
+    }"""
+    path = latest_xplane(log_dir)
+    if path is None:
+        return None, []
+    from jax.profiler import ProfileData
+
+    pd = ProfileData.from_file(path)
+    tables = None
+    chrome: List[dict] = []
+    for plane in pd.planes:
+        is_device = plane.name.startswith("/device:")
+        for line in plane.lines:
+            if line.name not in ("XLA Modules", "XLA Ops"):
+                continue
+            agg: Dict[str, List[float]] = {}
+            lo, hi, busy = None, None, 0.0
+            for ev in line.events:
+                key = (_module_key(ev.name) if line.name == "XLA Modules"
+                       else _kernel_key(ev.name))
+                a = agg.setdefault(key, [0, 0.0])
+                a[0] += 1
+                a[1] += ev.duration_ns
+                if line.name == "XLA Modules":
+                    lo = ev.start_ns if lo is None else min(lo, ev.start_ns)
+                    hi = (ev.start_ns + ev.duration_ns if hi is None
+                          else max(hi, ev.start_ns + ev.duration_ns))
+                    busy += ev.duration_ns
+                chrome.append({
+                    "ph": "X", "name": key, "cat": line.name,
+                    "pid": plane.name, "tid": line.name,
+                    "ts": ev.start_ns / 1e3, "dur": ev.duration_ns / 1e3,
+                })
+            if not agg:
+                continue
+            if tables is None:
+                tables = {"modules": {}, "kernels": {}, "occupancy": None,
+                          "device": plane.name if is_device else ""}
+            # accumulate across planes (multi-chip: every device plane runs
+            # the same modules — counts and times must SUM, not overwrite)
+            dst = tables["modules"] if line.name == "XLA Modules" \
+                else tables["kernels"]
+            for k, (c, ns) in agg.items():
+                cur = dst.setdefault(k, [0, 0.0])
+                cur[0] += c
+                cur[1] += ns
+            if line.name == "XLA Modules" and is_device:
+                if lo is not None and hi > lo:
+                    occ = busy / (hi - lo)
+                    prev = tables["occupancy"]
+                    tables["occupancy"] = occ if prev is None \
+                        else (prev + occ) / 2  # mean over device planes
+                tables["device"] = plane.name
+    return tables, chrome
+
+
+def format_table(title: str, rows: Dict[str, List[float]],
+                 total_ns: Optional[float] = None, limit: int = 20) -> str:
+    """name / calls / total / avg / share — the reference's summary shape."""
+    if not rows:
+        return ""
+    total = total_ns or sum(v[1] for v in rows.values()) or 1.0
+    out = [f"\n--- {title} " + "-" * max(1, 58 - len(title)),
+           f"{'name':<34} {'calls':>6} {'total(ms)':>10} {'avg(us)':>9} "
+           f"{'share':>6}"]
+    for name, (calls, ns) in sorted(rows.items(), key=lambda kv: -kv[1][1])[:limit]:
+        out.append(f"{name[:34]:<34} {calls:>6} {ns / 1e6:>10.3f} "
+                   f"{ns / calls / 1e3:>9.1f} {ns / total:>6.1%}")
+    return "\n".join(out)

@@ -399,6 +399,21 @@ def test_p2p_and_object_collectives_api():
     assert y1.shape == [2, 4]
     np.testing.assert_allclose(y1.numpy(), y2.numpy())  # cached weights
 
+    # name=None derives a stable per-call-site key (reference's optional
+    # name): the same line reuses its weight across steps, a different
+    # call site never weight-ties
+    def site_a():
+        return d.split(paddle.to_tensor(np.ones((2, 8), np.float32)), (8, 4),
+                       operation="linear", axis=1)
+
+    def site_b():
+        return d.split(paddle.to_tensor(np.ones((2, 8), np.float32)), (8, 4),
+                       operation="linear", axis=1)
+
+    a1, a2, b1 = site_a(), site_a(), site_b()
+    np.testing.assert_allclose(a1.numpy(), a2.numpy())  # same site: cached
+    assert not np.allclose(a1.numpy(), b1.numpy())  # distinct sites: new init
+
     from paddle_tpu.distributed import utils as dutils
     x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
     np.testing.assert_allclose(

@@ -267,6 +267,21 @@ rs_in = paddle.to_tensor(np.arange(1, 5, dtype=np.float32) + rank)
 got = dist.reduce_scatter(rs_in, op=dist.ReduceOp.MAX)
 np.testing.assert_allclose(got.numpy(), [2., 3.] if rank == 0 else [4., 5.])
 
+# DataParallel bucketed grad sync across the two processes: each rank
+# backwards its batch shard; the synced grad must equal the full-batch
+# gradient (reference Reducer semantics)
+paddle.seed(5)
+net = paddle.nn.Linear(8, 8)
+dpm = paddle.DataParallel(net)
+xfull = np.random.RandomState(7).randn(4, 8).astype(np.float32)
+shard = paddle.to_tensor(xfull[rank * 2:(rank + 1) * 2])
+paddle.mean(dpm(shard) ** 2).backward()
+paddle.seed(5)
+ref = paddle.nn.Linear(8, 8)
+paddle.mean(ref(paddle.to_tensor(xfull)) ** 2).backward()
+np.testing.assert_allclose(net.weight.grad.numpy(),
+                           ref.weight.grad.numpy(), rtol=1e-5, atol=1e-6)
+
 # --- one sharded llama train step over the global 2-process mesh ---
 from jax.sharding import PartitionSpec as P
 

@@ -77,6 +77,32 @@ class TestGroupSharded:
         # weight [16, 8]: dim0 divisible by 8 → sharded over ('dp','sharding')
         assert not sh.is_fully_replicated
 
+    def test_offload_states_live_in_host_memory(self, zero_mesh):
+        """offload=True: between steps the sharded optimizer states sit in
+        pinned_host memory (the reference's CPU offload), and training
+        still matches the non-offloaded run numerically."""
+        paddle.seed(101)
+        ref_model = paddle.nn.Linear(16, 4)
+        ref_opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=ref_model.parameters())
+        set_mesh(None)
+        ref_losses = _train_steps(ref_model, ref_opt)
+
+        create_hybrid_mesh(dp=2, sharding=4)
+        paddle.seed(101)
+        model = paddle.nn.Linear(16, 4)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+        model, opt = group_sharded_parallel(model, opt, level="os_g",
+                                            offload=True)
+        losses = _train_steps(model, opt)
+        np.testing.assert_allclose(losses, ref_losses, rtol=2e-5, atol=1e-6)
+        states = opt._inner_opt._accumulators[id(model.weight)]
+        host_kinds = [v.sharding.memory_kind for v in states.values()
+                      if hasattr(v, "sharding") and v.ndim > 0]
+        assert host_kinds and all(k == "pinned_host" for k in host_kinds), \
+            host_kinds
+
     def test_scaler_wrap(self, zero_mesh):
         model = paddle.nn.Linear(16, 4)
         opt = paddle.optimizer.AdamW(learning_rate=1e-2,
