@@ -1,3 +1,4 @@
+from .engine import Engine
 from .api import (
     Partial,
     Placement,
@@ -13,6 +14,6 @@ from .api import (
     to_placements,
 )
 
-__all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+__all__ = ["Engine", "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
            "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
            "to_placements", "get_mesh", "set_mesh"]

@@ -1,0 +1,141 @@
+"""Auto-parallel ``Engine`` — strategy search + prepared training.
+
+Reference counterpart: ``python/paddle/distributed/auto_parallel/engine.py``
+(SURVEY.md §2.2 auto-parallel row): the static half of auto-parallel —
+``Engine(model, loss, optimizer).prepare(...).fit(...)`` — whose
+completion/partitioner/planner pipeline decides how every tensor is
+distributed, guided by a cost model.
+
+TPU-native redesign — GSPMD subsumes the per-op half, measurement replaces
+the analytic cost model:
+
+* **Completion/partitioner → GSPMD.** Per-op SPMD rules and resharding are
+  exactly what XLA's GSPMD pass computes from the parameter/data shardings
+  the mesh implies — there is nothing left to re-derive in Python (the
+  stance ARCHITECTURE.md documents). What GSPMD does NOT choose is the
+  MESH SHAPE: how many devices to give data parallelism vs tensor
+  parallelism. That choice measurably matters (the candidates differ in
+  collective volume vs activation-memory balance) and is this Engine's job.
+* **Cost model → empirical trials.** The reference predicts; on TPU the
+  compiled step can simply be RUN. ``prepare()`` times one warm step per
+  candidate hybrid layout over the available devices and keeps the
+  fastest — an autotuner, which is how XLA-world tooling picks configs.
+
+The searched model must express its parallelism through the mesh (e.g.
+``fleet.meta_parallel`` layers or sharding-rule functional models like
+``models.llama``); a model with no mesh-aware layers measures dp-only
+layouts as equal, and the search degenerates gracefully.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ...parallel.mesh import create_hybrid_mesh, get_mesh, set_mesh
+
+__all__ = ["Engine"]
+
+
+def _candidate_layouts(n: int) -> List[Dict[str, int]]:
+    """Hybrid degree assignments over ``n`` devices: every (dp, mp) split
+    with both degrees dividing n (the ladder configs' axes; pp/sep join
+    the search the same way when models use them)."""
+    return [{"dp": d, "mp": n // d} for d in range(1, n + 1) if n % d == 0]
+
+
+class Engine:
+    """``paddle.distributed.auto_parallel.Engine`` analog.
+
+    ``model_fn(mesh) -> (step_fn, example_args)`` builds the compiled train
+    step under a mesh (rebuilt per candidate so parameter shardings follow
+    the layout). ``fit`` then runs the chosen layout.
+    """
+
+    def __init__(self, model_fn: Callable, strategy=None,
+                 candidates: Optional[Sequence[Dict[str, int]]] = None,
+                 warmup_steps: int = 1, measure_steps: int = 3):
+        self._model_fn = model_fn
+        self._strategy = strategy
+        self._candidates = list(candidates) if candidates is not None else None
+        self._warm = max(0, int(warmup_steps))
+        self._meas = max(1, int(measure_steps))
+        self.best_layout: Optional[Dict[str, int]] = None
+        self.measurements: Dict[Tuple[Tuple[str, int], ...], float] = {}
+        self._prepared = None
+
+    # -- the search --------------------------------------------------------
+    def prepare(self, devices: Optional[Sequence] = None) -> "Engine":
+        devices = list(devices if devices is not None else jax.devices())
+        cands = (self._candidates if self._candidates is not None
+                 else _candidate_layouts(len(devices)))
+        prev_mesh = get_mesh()
+        best, best_dt = None, None
+        try:
+            for layout in cands:
+                mesh = create_hybrid_mesh(devices=devices, **layout)
+                step_fn, args = self._model_fn(mesh)
+                state = list(args)
+
+                def run_once():
+                    # thread new state through (steps donate their buffers)
+                    out = step_fn(*state)
+                    n = len(out) - 1
+                    state[:n] = out[:n]
+                    return out[-1]
+
+                loss = run_once()
+                loss.block_until_ready()  # compile + first warm step
+                for _ in range(self._warm):
+                    loss = run_once()
+                loss.block_until_ready()
+                t0 = time.perf_counter()
+                for _ in range(self._meas):
+                    loss = run_once()
+                loss.block_until_ready()
+                dt = (time.perf_counter() - t0) / self._meas
+                self.measurements[tuple(sorted(layout.items()))] = dt
+                if best_dt is None or dt < best_dt:
+                    best, best_dt = layout, dt
+        finally:
+            set_mesh(prev_mesh)
+        self.best_layout = best
+        return self
+
+    # -- prepared execution ------------------------------------------------
+    def fit(self, data_iter, steps: int, devices: Optional[Sequence] = None,
+            log_every: int = 0) -> List[float]:
+        """Train ``steps`` batches under the chosen (or default) layout.
+
+        ``data_iter`` yields per-step batch tuples; the step contract is
+        ``step_fn(*state, *batch) -> (*new_state, loss)`` where ``state``
+        is the leading portion of ``model_fn``'s example args (params, opt
+        state, ...) and ``batch`` replaces the trailing portion."""
+        if self.best_layout is None:
+            self.prepare(devices)
+        devices = list(devices if devices is not None else jax.devices())
+        prev_mesh = get_mesh()
+        try:
+            mesh = create_hybrid_mesh(devices=devices, **self.best_layout)
+            step_fn, args = self._model_fn(mesh)
+            losses: List[float] = []
+            first = next(data_iter)
+            batch = first if isinstance(first, tuple) else (first,)
+            state = list(args[:len(args) - len(batch)])
+            for i in range(steps):
+                if i > 0:
+                    nxt = next(data_iter)
+                    batch = nxt if isinstance(nxt, tuple) else (nxt,)
+                out = step_fn(*state, *batch)
+                *state, loss = out
+                state = list(state)
+                losses.append(float(np.asarray(loss)))
+                if log_every and (i + 1) % log_every == 0:
+                    print(f"[auto_parallel.Engine] step {i + 1}: "
+                          f"loss {losses[-1]:.4f}")
+            return losses
+        finally:
+            set_mesh(prev_mesh)  # never clobber the caller's global mesh

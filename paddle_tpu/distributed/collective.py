@@ -692,20 +692,25 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
 
     if name is None:
         # reference signature makes name optional: derive a stable key from
-        # the WHOLE call stack, so the weight a given split() call path
-        # creates is reused across steps (same stack every step) while a
-        # helper function invoked from two places builds two distinct
-        # layers (ADVICE r1 + review: file:line of the immediate caller
-        # would weight-tie factory helpers). One line building several
-        # layers in a loop still needs an explicit name.
+        # the IMMEDIATE call site (file:line), so the split() line inside a
+        # model's forward resolves to the same weight no matter which outer
+        # code path (train loop, eval loop) reaches it. The known limit —
+        # one line building several logical layers (loops, factory
+        # helpers) weight-ties them — gets a one-time warning pointing at
+        # the explicit-name escape hatch.
         import sys
 
-        frames = []
         f = sys._getframe(1)
-        while f is not None:
-            frames.append((id(f.f_code), f.f_lineno))
-            f = f.f_back
-        name = f"_split_auto:{hash(tuple(frames)) & 0xFFFFFFFFFFFF:x}"
+        name = f"_split_auto:{f.f_code.co_filename}:{f.f_lineno}"
+        if name not in _split_layer_cache:
+            import warnings
+
+            warnings.warn(
+                "paddle.distributed.split called without `name`: the "
+                f"created weight is cached per call site ({name}); if this "
+                "line builds several logical layers (loop/factory), pass "
+                "an explicit unique name per layer or they will share one "
+                "weight", stacklevel=2)
     if operation == "linear" and axis not in (0, 1):
         raise InvalidArgumentError(
             f"split(operation='linear') partitions a 2-D weight: axis must "

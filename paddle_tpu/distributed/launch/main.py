@@ -211,6 +211,10 @@ class CollectiveController:
         master = ctx.master or f"{ctx.ips[0]}:49170"
         host, port = master.rsplit(":", 1)
         node_rank = self._node_rank()
+        # original topology: node ids are permanent; shrink math indexes
+        # these, never the already-shrunk ctx.ips
+        self._orig_ips = list(ctx.ips)
+        self._my_node_id = node_rank
         self._elastic = ElasticManager(
             node_id=f"node{node_rank}", host=host, port=int(port) + 1,
             is_master=(node_rank == 0))
@@ -230,21 +234,24 @@ class CollectiveController:
     def _shrink_to_survivors(self):
         """Re-form the job at reduced size after a SCALE_IN: keep only the
         surviving nodes' ips and renumber this node's rank by its position
-        among survivors, so build_pod emits the smaller world. (If node 0 —
-        the master — died, the rendezvous plane itself is gone; survivors
-        will fail to re-form, which is the reference's behaviour too.)"""
+        among survivors, so build_pod emits the smaller world. All math is
+        against the ORIGINAL node ids/ips (node ids never renumber in the
+        membership plane), so repeated SCALE_INs stay consistent. (If
+        node 0 — the master — died, the rendezvous plane itself is gone;
+        survivors will fail to re-form, the reference's behaviour too.)"""
         alive = getattr(self, "_pending_alive", None)
         self._pending_alive = None
         if not alive:
             return
+        if not hasattr(self, "_orig_ips"):
+            return  # monitor never initialised original topology
         keep = sorted(int(n[4:]) for n in alive
                       if n.startswith("node") and n[4:].isdigit())
-        me = self._node_rank()
-        if me not in keep or not keep:
+        keep = [i for i in keep if i < len(self._orig_ips)]
+        if not keep or self._my_node_id not in keep:
             return
-        self.ctx.ips = [self.ctx.ips[i] for i in keep
-                        if i < len(self.ctx.ips)]
-        self._rank_override = keep.index(me)
+        self.ctx.ips = [self._orig_ips[i] for i in keep]
+        self._rank_override = keep.index(self._my_node_id)
 
     def run(self) -> int:
         restarts = 0

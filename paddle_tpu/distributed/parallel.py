@@ -15,7 +15,9 @@ async, so earlier buckets' collectives execute while later host work
 proceeds. ``find_unused_parameters`` mirrors the reference contract: with
 it False, a parameter that received no gradient raises (pointing at the
 flag); with it True, missing grads contribute zeros to the bucket so every
-rank issues identical collectives, and the local ``.grad`` stays None.
+rank issues identical collectives, and the reduced slice is written back
+on every rank — a rank whose branch skipped a parameter still applies
+the cross-rank mean, keeping replicas bit-identical.
 
 In single-controller SPMD mode the preferred path remains data sharding +
 jit (XLA inserts the grad psums) via ``fleet.distributed_model``; this
@@ -95,6 +97,9 @@ class DataParallel(Layer):
         cur, cur_bytes = [], 0.0
         for p in reversed([p for p in self._layers.parameters()
                            if not p.stop_gradient]):
+            # the fused allreduce payload is fp32 regardless of the param
+            # dtype (see _flush_buckets), so the comm byte cap must count
+            # 4 bytes/element, not the storage itemsize
             nbytes = float(np.prod(p.shape)) * 4.0
             if cur and cur_bytes + nbytes > limit:
                 self._buckets.append(_Bucket(cur))
@@ -117,7 +122,7 @@ class DataParallel(Layer):
 
         inv = 1.0 / get_world_size(self._group)
         for b in self._buckets:
-            flats, had_grad = [], []
+            flats = []
             for p in b.params:
                 if p.grad is None:
                     if not self._find_unused:
@@ -128,23 +133,24 @@ class DataParallel(Layer):
                             "model are conditionally unused")
                     flats.append(jnp.zeros(int(np.prod(p.shape)),
                                            jnp.float32))
-                    had_grad.append(False)
                 else:
                     autograd.densify_grad_(p)
                     flats.append(
                         p.grad._value.astype(jnp.float32).reshape(-1))
-                    had_grad.append(True)
             fused = Tensor(jnp.concatenate(flats) if len(flats) > 1
                            else flats[0], stop_gradient=True)
             all_reduce(fused, op=ReduceOp.SUM, group=self._group)
             synced = fused._value * inv
             off = 0
-            for p, had in zip(b.params, had_grad):
+            for p in b.params:
                 n = int(np.prod(p.shape))
-                if had:
-                    p.grad = Tensor(
-                        synced[off:off + n].reshape(p.shape).astype(
-                            p.grad._value.dtype), stop_gradient=True)
+                # write the reduced slice back on EVERY rank (reference
+                # Reducer semantics): a rank whose branch skipped this
+                # param still applies the cross-rank mean, so replicas
+                # never diverge
+                p.grad = Tensor(
+                    synced[off:off + n].reshape(p.shape).astype(
+                        p._value.dtype), stop_gradient=True)
                 off += n
 
     def forward(self, *inputs, **kwargs):

@@ -1,0 +1,275 @@
+"""Continuous-batching generation engine (serving-shaped decode).
+
+Reference counterpart: Paddle Inference / PaddleNLP's serving stack
+(SURVEY.md §2.1 inference row: dynamic batching over the KV cache). The
+reference's GPU serving engines (and vLLM-style systems) keep a fixed pool
+of decode slots and swap finished requests out for queued ones so the
+batch stays full — that scheduling idea, TPU-native:
+
+* **Fixed-shape compiled programs.** The decode step is ONE jitted
+  ``lax.scan`` chunk over all slots with per-slot positions (ragged
+  attention: every slot attends and writes at its own ``pos`` — see
+  ``llama.forward_with_cache``'s ragged path) and per-slot REMAINING
+  counts: a slot freezes in-program the step its request completes, so
+  chunks never overshoot and the host needs no per-step validity fetch.
+  Shapes never depend on request sizes — nothing recompiles as requests
+  come and go.
+* **Wave-batched bucketed admission.** Free slots are refilled in WAVES:
+  queued prompts pad to a small set of length buckets and a sub-batch
+  (power-of-two count) prefills in ONE program call, then ONE insert
+  program scatters all the new KV rows/positions into their slots. On a
+  high-latency dispatch path (the dev tunnel) per-request admission is
+  the dominant serving cost; waves amortise it by ~the wave width.
+* **Slot-contiguous (ragged) cache, not paged.** Each slot owns rows
+  [0, max_len) of the shared [L, slots, max_len, H, D] cache. Paging adds
+  an indirection XLA can't fuse well; at serving's typical length spread
+  the ragged layout wins on TPU (documented trade-off vs the reference's
+  paged pools).
+
+Greedy decoding (temperature 0) — matching ``llama.generate``'s default —
+so engine output is bit-comparable to the dense path request-by-request.
+``eos_token_id`` freezes a slot in-program the step EOS is emitted.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import llama
+
+__all__ = ["Request", "ServingEngine"]
+
+_WAVE_WIDTHS = (8, 4, 2, 1)  # compiled prefill sub-batch sizes
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray            # [S] int32
+    max_new_tokens: int
+    tokens: List[int] = field(default_factory=list)
+
+    @property
+    def done(self) -> bool:
+        return len(self.tokens) >= self.max_new_tokens
+
+
+class ServingEngine:
+    def __init__(self, cfg: llama.LlamaConfig, params, slots: int = 8,
+                 max_len: Optional[int] = None, chunk: int = 32,
+                 prompt_buckets: Sequence[int] = (32, 64, 128, 256),
+                 eos_token_id: Optional[int] = None):
+        self.cfg = cfg
+        self.params = params
+        self.slots = int(slots)
+        self.max_len = int(max_len or cfg.max_seq_len)
+        self.chunk = int(chunk)
+        self.buckets = tuple(sorted(int(b) for b in prompt_buckets
+                                    if b <= self.max_len))
+        if not self.buckets:
+            raise ValueError("no prompt bucket fits max_len")
+        self.eos = eos_token_id
+        self._progs: Dict[tuple, object] = {}  # (bucket, nb) -> admit fn
+        self._queue: List[Request] = []
+        self._active: List[Optional[Request]] = [None] * self.slots
+        self._rem_host = [0] * self.slots  # host mirror of remaining counts
+        self._finished: List[Request] = []
+        self._next_rid = 0
+        self._cache = llama.init_kv_cache(cfg, self.slots, self.max_len)
+        self._pos = jnp.zeros((self.slots,), jnp.int32)
+        self._nxt = jnp.zeros((self.slots,), jnp.int32)
+        self._rem = jnp.zeros((self.slots,), jnp.int32)
+
+    # --- request intake ---------------------------------------------------
+    def add_request(self, prompt, max_new_tokens: int) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) > max(self.buckets):
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the largest bucket "
+                f"{max(self.buckets)}")
+        if len(prompt) + max_new_tokens - 1 > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
+                f"exceeds cache max_len {self.max_len}")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(Request(rid, prompt, int(max_new_tokens)))
+        return rid
+
+    # --- compiled programs ------------------------------------------------
+    def _admit_prog(self, bucket: int, nb: int):
+        """Fused prefill + slot insert: ONE program call per admission
+        sub-wave (dispatch latency is the dominant admission cost).
+        Memoised per instance (a class-level lru_cache would pin the
+        engine — params and KV cache included — forever)."""
+        cached = self._progs.get((bucket, nb))
+        if cached is not None:
+            return cached
+        cfg, max_len = self.cfg, self.max_len
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def admit(params, cache, prompts, true_lens, slot_ids,
+                  pos, nxt, rem, rems_new):
+            # [nb, bucket] padded prompts; logits at each row's true last
+            # token; pad rows beyond true_len are dead weight that decode
+            # overwrites as generation proceeds
+            c = llama.init_kv_cache(cfg, nb, max_len)
+            logits, c = llama.forward_with_cache(
+                params, prompts, cfg, c, jnp.int32(0),
+                logit_pos=true_lens - 1)
+            tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            k = cache["k"].at[:, slot_ids].set(c["k"])
+            v = cache["v"].at[:, slot_ids].set(c["v"])
+            pos = pos.at[slot_ids].set(true_lens)
+            nxt = nxt.at[slot_ids].set(tok0)
+            rem = rem.at[slot_ids].set(rems_new)
+            return {"k": k, "v": v}, pos, nxt, rem, tok0
+
+        self._progs[(bucket, nb)] = admit
+        return admit
+
+    @functools.cached_property
+    def _decode_prog(self):
+        cfg, K, eos = self.cfg, self.chunk, self.eos
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def decode_chunk(params, cache, pos, nxt, rem):
+            def body(carry, _):
+                cache, pos, nxt, rem = carry
+                live = rem > 0
+                logits, cache = llama.forward_with_cache(
+                    params, nxt[:, None], cfg, cache, pos)
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                tok = jnp.where(live, tok, nxt)  # frozen slots idle
+                pos = pos + live.astype(jnp.int32)
+                rem = rem - live.astype(jnp.int32)
+                if eos is not None:
+                    rem = jnp.where(live & (tok == eos), 0, rem)
+                return (cache, pos, tok, rem), tok
+
+            (cache, pos, nxt, rem), toks = jax.lax.scan(
+                body, (cache, pos, nxt, rem), None, length=K)
+            return cache, pos, nxt, rem, toks  # toks: [K, slots]
+
+        return decode_chunk
+
+    # --- scheduling -------------------------------------------------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"no bucket for prompt length {n}")
+
+    def _fill_slots(self) -> None:
+        """Admission wave: take as many queued requests as there are free
+        slots (longest-remaining-first), group them by prompt bucket, and
+        run ONE fused prefill+insert program per sub-group. Hysteresis:
+        between chunks, refill only once a few slots are free (the
+        threshold shrinks with the queue so the tail always drains) —
+        wide waves amortise per-program dispatch latency."""
+        free = [s for s in range(self.slots) if self._active[s] is None]
+        if not free or not self._queue:
+            return
+        threshold = min(4, self.slots, len(self._queue))
+        if len(free) < threshold and len(free) < self.slots:
+            return
+        self._queue.sort(key=lambda r: -r.max_new_tokens)
+        picked = self._queue[:len(free)]
+        del self._queue[:len(free)]
+        by_bucket: Dict[int, List[Request]] = {}
+        for r in picked:
+            by_bucket.setdefault(self._bucket_for(len(r.prompt)), []).append(r)
+        it = iter(free)
+        for bucket, group in sorted(by_bucket.items()):
+            i = 0
+            while i < len(group):
+                nb = next(w for w in _WAVE_WIDTHS if w <= len(group) - i)
+                sub = group[i:i + nb]
+                i += nb
+                slots = [next(it) for _ in sub]
+                prompts = np.zeros((nb, bucket), np.int32)
+                lens = np.zeros((nb,), np.int32)
+                for j, r in enumerate(sub):
+                    prompts[j, :len(r.prompt)] = r.prompt
+                    lens[j] = len(r.prompt)
+                rems = np.array([r.max_new_tokens - 1 for r in sub],
+                                np.int32)
+                self._cache, self._pos, self._nxt, self._rem, tok0 = \
+                    self._admit_prog(bucket, nb)(
+                        self.params, self._cache, jnp.asarray(prompts),
+                        jnp.asarray(lens), jnp.asarray(slots, jnp.int32),
+                        self._pos, self._nxt, self._rem, jnp.asarray(rems))
+                tok0 = np.asarray(tok0)
+                for j, (r, s) in enumerate(zip(sub, slots)):
+                    r.tokens.append(int(tok0[j]))
+                    hit_eos = self.eos is not None and \
+                        r.tokens[-1] == self.eos
+                    if r.done or hit_eos:
+                        self._finished.append(r)
+                        self._rem_host[s] = 0
+                        # slot was inserted live; freeze it again
+                        self._rem = self._rem.at[s].set(0)
+                        self._active[s] = None
+                    else:
+                        self._active[s] = r
+                        self._rem_host[s] = r.max_new_tokens - 1
+        # recurse: retiring at-prefill frees slots for remaining queue
+        if self._queue and any(a is None for a in self._active):
+            self._fill_slots()
+
+    def warmup(self) -> None:
+        """Compile every program shape (fused admit per bucket x wave
+        width, the decode chunk) so serving excludes compiles."""
+        for b in self.buckets:
+            for nb in _WAVE_WIDTHS:
+                if nb > self.slots:
+                    continue
+                out = self._admit_prog(b, nb)(
+                    self.params, self._cache, jnp.zeros((nb, b), jnp.int32),
+                    jnp.ones((nb,), jnp.int32),
+                    jnp.arange(nb, dtype=jnp.int32),
+                    self._pos, self._nxt, self._rem,
+                    jnp.zeros((nb,), jnp.int32))
+                self._cache = out[0]
+        out = self._decode_prog(self.params, self._cache, self._pos,
+                                self._nxt, self._rem)
+        self._cache = out[0]
+        self._pos = jnp.zeros((self.slots,), jnp.int32)
+        self._nxt = jnp.zeros((self.slots,), jnp.int32)
+        self._rem = jnp.zeros((self.slots,), jnp.int32)
+
+    # --- the engine loop --------------------------------------------------
+    def run(self) -> Dict[int, List[int]]:
+        """Drain the queue: continuous batching until every request is
+        served. Returns rid -> generated tokens (greedy, incl. the first
+        token sampled at prefill)."""
+        self._fill_slots()
+        while any(r is not None for r in self._active):
+            out = self._decode_prog(self.params, self._cache, self._pos,
+                                    self._nxt, self._rem)
+            self._cache, self._pos, self._nxt, self._rem, toks = out
+            toks = np.asarray(toks)  # the one device->host fetch per chunk
+            for slot, req in enumerate(self._active):
+                if req is None:
+                    continue
+                take = min(self.chunk, self._rem_host[slot])
+                for k in range(take):
+                    t = int(toks[k, slot])
+                    req.tokens.append(t)
+                    self._rem_host[slot] -= 1
+                    if self.eos is not None and t == self.eos:
+                        self._rem_host[slot] = 0
+                        break
+                if self._rem_host[slot] == 0:
+                    self._finished.append(req)
+                    self._active[slot] = None
+            self._fill_slots()
+        done = {r.rid: r.tokens[:r.max_new_tokens] for r in self._finished}
+        self._finished = []
+        return done

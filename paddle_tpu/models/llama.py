@@ -197,15 +197,18 @@ def _rms_norm(x, w, eps):
 
 
 def _rope_at(x, theta, positions):
-    # x: [B, S, H, D] at absolute ``positions`` [S]; LLaMA rotate-half
-    # convention: the head dim splits into two contiguous halves
-    # (lane-aligned slices on TPU — the strided ::2 interleave costs extra
-    # vector shuffles every layer and again in every remat replay)
+    # x: [B, S, H, D] at absolute ``positions`` — [S] (shared across the
+    # batch) or [B, S] (ragged decode: every slot at its own position).
+    # LLaMA rotate-half convention: the head dim splits into two contiguous
+    # halves (lane-aligned slices on TPU — the strided ::2 interleave costs
+    # extra vector shuffles every layer and again in every remat replay)
     b, s, h, d = x.shape
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [S, D/2]
-    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    ang = positions.astype(jnp.float32)[..., None] * freqs  # [..., S, D/2]
+    if ang.ndim == 2:  # shared positions -> add the batch dim
+        ang = ang[None]
+    cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., : d // 2], x[..., d // 2:]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
 
@@ -522,7 +525,8 @@ def _cache_attention(cfg: LlamaConfig, q, kc, vc, positions):
     """q [B,T,nH,D] against the UNREPEATED cache kc/vc [B,Smax,Hkv,D].
     GQA contracts via a grouped einsum (q reshaped [B,T,Hkv,rep,D]) —
     the repeated cache is never materialised. Keys j > token position are
-    masked (covers both causality and the unwritten cache tail)."""
+    masked (covers both causality and the unwritten cache tail).
+    ``positions``: [T] shared, or [B, T] ragged (per-slot decode)."""
     B, T, nH, D = q.shape
     Smax = kc.shape[1]
     rep = cfg.num_heads // cfg.num_kv_heads
@@ -531,41 +535,63 @@ def _cache_attention(cfg: LlamaConfig, q, kc, vc, positions):
     qg = q.reshape(B, T, cfg.num_kv_heads, rep, D)
     s = jnp.einsum("bthrd,bshd->bhrts", qg, kc,
                    preferred_element_type=jnp.float32) * scale
-    visible = jnp.arange(Smax)[None, :] <= positions[:, None]  # [T, Smax]
-    s = jnp.where(visible[None, None, None], s, -jnp.inf)
+    visible = jnp.arange(Smax) <= positions[..., None]  # [(B,) T, Smax]
+    if visible.ndim == 2:
+        visible = visible[None]
+    s = jnp.where(visible[:, None, None], s, -jnp.inf)
     probs = jax.nn.softmax(s, axis=-1)
     attn = jnp.einsum("bhrts,bshd->bthrd", probs.astype(dt), vc,
                       preferred_element_type=jnp.float32).astype(dt)
     return attn.reshape(B, T, nH, D)
 
 
-def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos):
+def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos,
+                       logit_pos=None):
     """Run ``tokens`` [B, T] at absolute positions pos..pos+T-1 against the
-    cache. Returns (last-position logits [B, V], updated cache). T is the
-    prompt length for prefill and 1 for decode; ``pos`` may be a traced
-    scalar (the decode step compiles once). Layers run under lax.scan over
-    the stacked [L, ...] weights and cache — O(1) compile depth, matching
-    the training path's scan_layers design."""
+    cache. Returns (logits [B, V], updated cache). T is the prompt length
+    for prefill and 1 for decode; ``pos`` may be a traced scalar, or a
+    traced [B] vector (ragged decode, T==1: every slot writes and attends
+    at its OWN position — the continuous-batching engine's path). Logits
+    come from the last position, or from ``logit_pos`` (traced scalar —
+    bucket-padded prompts read the true last token). Layers run under
+    lax.scan over the stacked [L, ...] weights and cache — O(1) compile
+    depth, matching the training path's scan_layers design."""
     dt = cfg.dtype
     B, T = tokens.shape
     x = params["embed"].astype(dt)[tokens]
-    positions = pos + jnp.arange(T)
+    ragged = getattr(pos, "ndim", 0) == 1
+    if ragged and T != 1:
+        raise ValueError("per-slot pos requires single-token decode (T=1)")
+    positions = pos[:, None] if ragged else pos + jnp.arange(T)
     layer_weights = {kk: params[kk] for kk in _LAYER_KEYS}
 
     def body(x, per_layer):
         lp, kc, vc = per_layer
         q, k_new, v_new = _qkv_proj(cfg, x, lp, positions)
-        kc = jax.lax.dynamic_update_slice(
-            kc, k_new.astype(kc.dtype), (0, pos, 0, 0))
-        vc = jax.lax.dynamic_update_slice(
-            vc, v_new.astype(vc.dtype), (0, pos, 0, 0))
+        if ragged:
+            # scatter each slot's new row at its own position
+            rows = jnp.arange(B)
+            kc = kc.at[rows, pos].set(k_new[:, 0].astype(kc.dtype))
+            vc = vc.at[rows, pos].set(v_new[:, 0].astype(vc.dtype))
+        else:
+            kc = jax.lax.dynamic_update_slice(
+                kc, k_new.astype(kc.dtype), (0, pos, 0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v_new.astype(vc.dtype), (0, pos, 0, 0))
         attn = _cache_attention(cfg, q, kc, vc, positions)
         return _layer_post(cfg, x, attn, lp), (kc, vc)
 
     x, (kcs, vcs) = jax.lax.scan(body, x,
                                  (layer_weights, cache["k"], cache["v"]))
     x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
-    logits = x[:, -1] @ params["lm_head"].astype(dt)  # [B, V]
+    if logit_pos is None:
+        last = x[:, -1]
+    elif getattr(logit_pos, "ndim", 0) == 1:
+        last = x[jnp.arange(B), logit_pos]  # per-row (batched prefill)
+    else:
+        last = jax.lax.dynamic_index_in_dim(x, logit_pos, axis=1,
+                                            keepdims=False)
+    logits = last @ params["lm_head"].astype(dt)  # [B, V]
     return logits.astype(jnp.float32), {"k": kcs, "v": vcs}
 
 

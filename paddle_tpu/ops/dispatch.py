@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from .. import flags
 from ..core import autograd
+from ..profiler import _hooks as _phooks
 from ..core.autograd import GradNode
 from ..core.dtype import is_differentiable_dtype, is_floating_dtype
 from ..core.tensor import Tensor
@@ -100,7 +101,32 @@ def run_op(
     Static-graph hook: under ``paddle.enable_static()``, an op touching a
     symbolic Variable is *recorded* into the default main program instead of
     executed (the reference's OpDesc-appending; see static/graph.py).
+
+    Profiler hook: while a ``paddle.profiler.Profiler`` is recording, each
+    dispatch reports a host span keyed by op name (the reference's
+    RecordEvent-in-the-eager-layer; SURVEY §5.1) — one falsy check when
+    no profiler is active.
     """
+    if _phooks.COLLECTORS:
+        t0 = _phooks.now_ns()
+        try:
+            return _run_op_impl(name, pure_fn, *tensors,
+                                n_diff_outputs=n_diff_outputs,
+                                static_attrs=static_attrs)
+        finally:
+            _phooks.emit(name, t0, _phooks.now_ns())
+    return _run_op_impl(name, pure_fn, *tensors,
+                        n_diff_outputs=n_diff_outputs,
+                        static_attrs=static_attrs)
+
+
+def _run_op_impl(
+    name: str,
+    pure_fn: Callable,
+    *tensors: Tensor,
+    n_diff_outputs: Optional[int] = None,
+    static_attrs: Optional[dict] = None,
+) -> Union[Tensor, Tuple[Tensor, ...]]:
     from ..static import graph as _sgraph
 
     if _sgraph.recording_active(tensors):

@@ -76,17 +76,40 @@ class Profiler:
         self._timer_only = timer_only
         self._step_times = []
         self._last = None
+        # host spans: op dispatch + RecordEvent ranges, collected via
+        # profiler._hooks while this profiler is recording
+        self._host_ops = {}     # name -> [calls, total_ns]
+        self._host_spans = []   # (name, kind, start_ns, dur_ns)
+
+    def _host_event(self, name, start_ns, end_ns, kind):
+        a = self._host_ops.setdefault(name, [0, 0.0])
+        a[0] += 1
+        a[1] += end_ns - start_ns
+        if len(self._host_spans) < 200_000:  # bound trace memory
+            self._host_spans.append((name, kind, start_ns, end_ns - start_ns))
 
     def start(self):
+        from . import _hooks
+
         self._state = self._scheduler(self._step)
-        if self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
-                and not self._timer_only:
+        recording = self._state in (ProfilerState.RECORD,
+                                    ProfilerState.RECORD_AND_RETURN)
+        if recording and not self._timer_only:
             jax.profiler.start_trace(self._log_dir)
             self._running = True
+        # host spans track the RECORD windows only, matching the device
+        # trace (timer_only profilers have no device trace — collect
+        # whenever the scheduler says record)
+        if recording and self not in _hooks.COLLECTORS:
+            _hooks.COLLECTORS.append(self)
         self._last = time.perf_counter()
         return self
 
     def stop(self):
+        from . import _hooks
+
+        if self in _hooks.COLLECTORS:
+            _hooks.COLLECTORS.remove(self)
         if self._running:
             jax.profiler.stop_trace()
             self._running = False
@@ -100,12 +123,21 @@ class Profiler:
         self._last = now
         self._step += 1
         new_state = self._scheduler(self._step)
+        from . import _hooks
+
+        # host-span collection follows the scheduler's record windows for
+        # every profiler kind (timer_only included)
+        recording = new_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN)
+        if recording and self not in _hooks.COLLECTORS:
+            _hooks.COLLECTORS.append(self)
+        elif not recording and self in _hooks.COLLECTORS:
+            _hooks.COLLECTORS.remove(self)
         if self._timer_only:
             return
         if self._running and new_state == ProfilerState.CLOSED:
             self.stop()
-        elif not self._running and new_state in (ProfilerState.RECORD,
-                                                 ProfilerState.RECORD_AND_RETURN):
+        elif not self._running and recording:
             jax.profiler.start_trace(self._log_dir)
             self._running = True
 
@@ -118,40 +150,110 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms", views=None):
-        # ``views`` (list of SummaryView) selects tables in the reference;
-        # this profiler prints its single step/op table for any selection
-        n = len(self._step_times)
-        if not n:
-            print("No steps recorded.")
-            return
+        """Reference-shaped summary tables (SURVEY §5.1): step overview,
+        host operator view (dispatch spans + RecordEvent ranges), and —
+        when an xplane trace was captured — the device op-level (XLA
+        modules) and kernel-level (HLO instructions) views with device
+        occupancy. ``views`` selects a subset (SummaryView values)."""
+        from . import _xplane
+
         import numpy as np
 
-        ts = np.asarray(self._step_times) * 1000
-        print(f"steps: {n}  avg: {ts.mean():.3f}ms  p50: {np.percentile(ts, 50):.3f}ms "
-              f"p99: {np.percentile(ts, 99):.3f}ms  trace dir: {self._log_dir}")
+        n = len(self._step_times)
+        if n:
+            ts = np.asarray(self._step_times) * 1000
+            print(f"steps: {n}  avg: {ts.mean():.3f}ms  "
+                  f"p50: {np.percentile(ts, 50):.3f}ms "
+                  f"p99: {np.percentile(ts, 99):.3f}ms  "
+                  f"trace dir: {self._log_dir}")
+        else:
+            print("No steps recorded.")
+
+        want = None if views is None else {v for v in views}
+
+        def wanted(v):
+            return want is None or v in want
+
+        if op_detail and self._host_ops and wanted(SummaryView.OperatorView):
+            print(_xplane.format_table("Host operator view (eager dispatch)",
+                                       self._host_ops))
+        if self._running or self._timer_only:
+            return
+        tables, _ = _xplane.parse(self._log_dir)
+        if tables is None:
+            return
+        if tables["modules"] and wanted(SummaryView.ModelView):
+            occ = tables["occupancy"]
+            dev = tables["device"] or "device"
+            head = f"Device op view ({dev}"
+            head += f", occupancy {occ:.1%})" if occ is not None else ")"
+            print(_xplane.format_table(head, tables["modules"]))
+        if tables["kernels"] and wanted(SummaryView.KernelView):
+            print(_xplane.format_table("Device kernel view (HLO)",
+                                       tables["kernels"]))
 
     def export_chrome_tracing(self, dir_name: Optional[str] = None,
-                              worker_name: Optional[str] = None):
-        """The xplane protos under log_dir are TensorBoard/Perfetto loadable —
-        that directory is the chrome-trace artifact."""
-        return self._log_dir
+                              worker_name: Optional[str] = None) -> str:
+        """Write a loadable chrome-trace JSON (device xplane spans merged
+        with the host dispatch/RecordEvent spans) and return its path —
+        the reference's ``export_chrome_tracing`` artifact. The raw xplane
+        protos stay under log_dir for TensorBoard's trace viewer."""
+        import json
+
+        from . import _xplane
+
+        out_dir = dir_name or self._log_dir
+        os.makedirs(out_dir, exist_ok=True)
+        _, events = _xplane.parse(self._log_dir)
+        # host spans (perf_counter epoch) and xplane spans (capture
+        # timebase) live on unrelated clocks: zero-base each source so the
+        # viewer shows both tracks from a common origin (alignment is
+        # approximate — the common origin is each source's first event)
+        if events:
+            base = min(e["ts"] for e in events)
+            for e in events:
+                e["ts"] -= base
+        if self._host_spans:
+            hbase = min(s[2] for s in self._host_spans)
+            for name, kind, start_ns, dur_ns in self._host_spans:
+                events.append({
+                    "ph": "X", "name": name, "cat": kind,
+                    "pid": "host", "tid": f"host {kind}",
+                    "ts": (start_ns - hbase) / 1e3, "dur": dur_ns / 1e3,
+                })
+        path = os.path.join(
+            out_dir, f"{worker_name or 'worker'}.chrome_trace.json")
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        return path
 
     export = export_chrome_tracing
 
 
 class RecordEvent:
     """Named range in the device/host timeline (reference RAII RecordEvent →
-    ``jax.profiler.TraceAnnotation``)."""
+    ``jax.profiler.TraceAnnotation`` for the xplane timeline, plus a host
+    span reported to any recording Profiler for its tables/chrome trace)."""
 
     def __init__(self, name: str, event_type=None):
         self.name = name
         self._ann = jax.profiler.TraceAnnotation(name)
+        self._t0 = None
 
     def begin(self):
+        from . import _hooks
+
+        self._t0 = _hooks.now_ns()
         self._ann.__enter__()
 
     def end(self):
+        from . import _hooks
+
         self._ann.__exit__(None, None, None)
+        if self._t0 is not None:
+            _hooks.emit(self.name, self._t0, _hooks.now_ns(), kind="range")
+            self._t0 = None
 
     def __enter__(self):
         self.begin()

@@ -61,6 +61,7 @@ def parse(log_dir: str):
     pd = ProfileData.from_file(path)
     tables = None
     chrome: List[dict] = []
+    occs: List[float] = []
     for plane in pd.planes:
         is_device = plane.name.startswith("/device:")
         for line in plane.lines:
@@ -99,11 +100,10 @@ def parse(log_dir: str):
                 cur[1] += ns
             if line.name == "XLA Modules" and is_device:
                 if lo is not None and hi > lo:
-                    occ = busy / (hi - lo)
-                    prev = tables["occupancy"]
-                    tables["occupancy"] = occ if prev is None \
-                        else (prev + occ) / 2  # mean over device planes
+                    occs.append(busy / (hi - lo))
                 tables["device"] = plane.name
+    if tables is not None and occs:
+        tables["occupancy"] = sum(occs) / len(occs)  # mean over planes
     return tables, chrome
 
 

@@ -71,6 +71,7 @@ if os.path.exists(ckpt + ".pdparams"):
 
 rng = np.random.RandomState(11)
 xs = [rng.randn(4, 8).astype("float32") for _ in range(TOTAL)]
+import time
 for step in range(start, TOTAL):
     loss = paddle.mean(model(paddle.to_tensor(xs[step])) ** 2)
     loss.backward()
@@ -80,10 +81,14 @@ for step in range(start, TOTAL):
         state = model.state_dict()
         state["__step__"] = step + 1
         paddle.save(state, ckpt + ".pdparams")
-    if rank == 1 and step == 2 and not os.path.exists(marker):
-        open(marker, "w").write("killed")
-        import signal
-        os.kill(os.getpid(), signal.SIGKILL)  # die mid-training, hard
+    time.sleep(0.15)  # pace steps so the ranks' incarnations overlap
+    if rank == 1 and step >= 2 and not os.path.exists(marker):
+        # kill only once a checkpoint exists, so the restart provably
+        # RESUMES (not restarts from scratch) even on a loaded machine
+        if os.path.exists(ckpt + ".pdparams"):
+            open(marker, "w").write("killed")
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)  # die mid-training, hard
 print(f"FINAL-STEP {TOTAL} rank {rank}", flush=True)
 """
 

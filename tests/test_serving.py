@@ -1,0 +1,71 @@
+"""Continuous-batching serving engine (VERDICT r1 item 8): greedy engine
+output must equal the dense generate() path request-by-request, across
+mixed prompt/generation lengths and slot turnover."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models import llama
+from paddle_tpu.parallel import set_mesh
+
+
+@pytest.fixture
+def tiny():
+    set_mesh(None)
+    cfg = llama.LlamaConfig.tiny(max_seq_len=96)
+    params = llama.init_params(cfg)
+    return cfg, params
+
+
+def _dense_reference(cfg, params, prompt, n):
+    out = llama.generate(params, np.asarray(prompt, np.int32)[None], cfg,
+                         max_new_tokens=n, max_len=96)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+class TestServingEngine:
+    def test_matches_dense_generate_mixed_lengths(self, tiny):
+        cfg, params = tiny
+        rng = np.random.RandomState(0)
+        reqs = [
+            (rng.randint(0, cfg.vocab_size, (l,)).astype(np.int32), n)
+            for l, n in [(5, 7), (12, 3), (30, 9), (3, 12), (17, 5),
+                         (8, 8), (25, 4)]
+        ]
+        eng = ServingEngine(cfg, params, slots=3, max_len=96, chunk=4,
+                            prompt_buckets=(8, 16, 32))
+        rids = [eng.add_request(p, n) for p, n in reqs]
+        results = eng.run()
+        assert sorted(results) == sorted(rids)
+        for rid, (p, n) in zip(rids, reqs):
+            ref = _dense_reference(cfg, params, p, n)
+            assert results[rid] == ref, (rid, results[rid], ref)
+
+    def test_more_requests_than_slots_all_served(self, tiny):
+        cfg, params = tiny
+        rng = np.random.RandomState(1)
+        eng = ServingEngine(cfg, params, slots=2, max_len=96, chunk=8,
+                            prompt_buckets=(16,))
+        rids = [eng.add_request(
+            rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32), 5)
+            for _ in range(7)]
+        results = eng.run()
+        assert sorted(results) == sorted(rids)
+        assert all(len(v) == 5 for v in results.values())
+
+    def test_single_token_request(self, tiny):
+        cfg, params = tiny
+        eng = ServingEngine(cfg, params, slots=2, max_len=96,
+                            prompt_buckets=(8,))
+        rid = eng.add_request(np.arange(4, dtype=np.int32), 1)
+        results = eng.run()
+        ref = _dense_reference(cfg, params, np.arange(4, dtype=np.int32), 1)
+        assert results[rid] == ref
+
+    def test_oversized_request_rejected(self, tiny):
+        cfg, params = tiny
+        eng = ServingEngine(cfg, params, slots=2, max_len=96,
+                            prompt_buckets=(64,))
+        with pytest.raises(ValueError, match="max_len"):
+            eng.add_request(np.zeros((60,), np.int32), 64)  # 60+63 > 96
