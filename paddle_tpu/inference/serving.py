@@ -80,6 +80,7 @@ class ServingEngine:
         self._active: List[Optional[Request]] = [None] * self.slots
         self._rem_host = [0] * self.slots  # host mirror of remaining counts
         self._finished: List[Request] = []
+        self.last_run_chunks = 0  # decode chunks issued by the last run()
         self._next_rid = 0
         self._cache = llama.init_kv_cache(cfg, self.slots, self.max_len)
         self._pos = jnp.zeros((self.slots,), jnp.int32)
@@ -249,10 +250,12 @@ class ServingEngine:
         """Drain the queue: continuous batching until every request is
         served. Returns rid -> generated tokens (greedy, incl. the first
         token sampled at prefill)."""
+        self.last_run_chunks = 0
         self._fill_slots()
         while any(r is not None for r in self._active):
             out = self._decode_prog(self.params, self._cache, self._pos,
                                     self._nxt, self._rem)
+            self.last_run_chunks += 1
             self._cache, self._pos, self._nxt, self._rem, toks = out
             toks = np.asarray(toks)  # the one device->host fetch per chunk
             for slot, req in enumerate(self._active):

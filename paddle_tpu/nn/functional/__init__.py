@@ -406,19 +406,21 @@ def adaptive_avg_pool1d(x, output_size, name=None):
 
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
-    return _adaptive_pool(x, output_size, 2, "avg")
+    return _adaptive_pool(x, output_size, 2, "avg", data_format=data_format)
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, output_size, 2, "max")
 
 
-def _adaptive_pool(x, output_size, nd, kind):
+def _adaptive_pool(x, output_size, nd, kind, data_format="NCHW"):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     out_sz = _pair(output_size, nd)
-    in_sz = tuple(x.shape[-nd:])
+    in_sz = tuple(x.shape[-nd - 1:-1]) if channel_last else tuple(x.shape[-nd:])
     if all(i % o == 0 for i, o in zip(in_sz, out_sz)):
         ks = tuple(i // o for i, o in zip(in_sz, out_sz))
-        return _pool_nd(x, ks, ks, 0, nd, kind, False, True, "NCHW", f"adaptive_{kind}_pool")
+        return _pool_nd(x, ks, ks, 0, nd, kind, False, True, data_format,
+                        f"adaptive_{kind}_pool")
     # General case (any in/out ratio, incl. upsampling): output cell i pools
     # over [floor(i*I/O), ceil((i+1)*I/O)). One axis at a time: gather the
     # max-width window per output index and reduce with a validity mask.
@@ -446,7 +448,9 @@ def _adaptive_pool(x, output_size, nd, kind):
 
     def f(a):
         for d in range(nd):
-            axis = a.ndim - nd + d
+            # spatial axes precede the channel axis when channel-last
+            axis = (a.ndim - 1 - nd + d) if channel_last \
+                else (a.ndim - nd + d)
             a = pool_axis(a, axis, in_sz[d], out_sz[d])(a)
         return a
 

@@ -12,12 +12,17 @@ __all__ = [
 
 
 class _PoolND(Layer):
-    def __init__(self, kernel_size, stride=None, padding=0, **kwargs):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, **kwargs):
         super().__init__()
         self.kernel_size = kernel_size
         self.stride = stride if stride is not None else kernel_size
         self.padding = padding
+        self.data_format = data_format
         self.kwargs = kwargs
+
+    def _df(self, default):
+        return self.data_format or default
 
     def extra_repr(self):
         return f"kernel_size={self.kernel_size}, stride={self.stride}, padding={self.padding}"
@@ -25,32 +30,38 @@ class _PoolND(Layer):
 
 class MaxPool1D(_PoolND):
     def forward(self, x):
-        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            data_format=self._df("NCL"))
 
 
 class MaxPool2D(_PoolND):
     def forward(self, x):
-        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            data_format=self._df("NCHW"))
 
 
 class MaxPool3D(_PoolND):
     def forward(self, x):
-        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            data_format=self._df("NCDHW"))
 
 
 class AvgPool1D(_PoolND):
     def forward(self, x):
-        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding)
+        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            data_format=self._df("NCL"))
 
 
 class AvgPool2D(_PoolND):
     def forward(self, x):
-        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding)
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            data_format=self._df("NCHW"))
 
 
 class AvgPool3D(_PoolND):
     def forward(self, x):
-        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding)
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            data_format=self._df("NCDHW"))
 
 
 class AdaptiveAvgPool1D(Layer):
@@ -66,9 +77,11 @@ class AdaptiveAvgPool2D(Layer):
     def __init__(self, output_size, data_format="NCHW", name=None):
         super().__init__()
         self.output_size = output_size
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.adaptive_avg_pool2d(x, self.output_size)
+        return F.adaptive_avg_pool2d(x, self.output_size,
+                                     data_format=self.data_format)
 
 
 class AdaptiveMaxPool2D(Layer):

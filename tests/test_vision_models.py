@@ -58,3 +58,23 @@ def test_resnext_forward():
 
     m = models.resnext50_32x4d(num_classes=10)
     _run(m, size=64)
+
+
+def test_resnet_nhwc_matches_nchw():
+    """data_format="NHWC" (reference PaddleClas option): channel-last
+    network must match the channel-first one numerically."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision import models
+
+    paddle.seed(0)
+    m1 = models.resnet18(num_classes=10)
+    paddle.seed(0)
+    m2 = models.resnet18(num_classes=10, data_format="NHWC")
+    m1.eval()
+    m2.eval()
+    x = np.random.RandomState(0).rand(2, 3, 32, 32).astype("float32")
+    o1 = m1(paddle.to_tensor(x)).numpy()
+    o2 = m2(paddle.to_tensor(x.transpose(0, 2, 3, 1))).numpy()
+    np.testing.assert_allclose(o2, o1, rtol=1e-4, atol=2e-4)
