@@ -17,7 +17,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-__all__ = ["Context", "Container", "Pod", "CollectiveController", "launch",
+__all__ = ["Context", "Container", "Pod", "CollectiveController",
+           "PSController", "launch",
            "main"]
 
 
@@ -36,6 +37,8 @@ class Context:
     elastic_level: int = 0
     max_restart: int = 3
     run_mode: str = "collective"
+    server_num: int = 0
+    trainer_num: int = 0
 
     @classmethod
     def parse(cls, argv: Optional[List[str]] = None) -> "Context":
@@ -59,6 +62,10 @@ class Context:
         p.add_argument("--max_restart", type=int, default=3)
         p.add_argument("--run_mode", type=str, default="collective",
                        choices=["collective", "ps"])
+        p.add_argument("--server_num", type=int, default=0,
+                       help="ps mode: parameter-server processes")
+        p.add_argument("--trainer_num", type=int, default=0,
+                       help="ps mode: trainer processes")
         p.add_argument("script", type=str)
         p.add_argument("script_args", nargs=argparse.REMAINDER)
         a = p.parse_args(argv)
@@ -69,7 +76,16 @@ class Context:
             master=a.master, rank=a.rank, log_dir=a.log_dir,
             devices=a.devices, elastic_level=a.elastic_level,
             max_restart=a.max_restart, run_mode=a.run_mode,
+            server_num=a.server_num, trainer_num=a.trainer_num,
         )
+
+
+def _worker_pythonpath() -> str:
+    """Workers get python's sys.path[0] = the *script's* dir, not the
+    launcher's cwd — propagate cwd so source-tree imports resolve (shared
+    by the collective and ps controllers)."""
+    return os.pathsep.join(
+        p for p in (os.getcwd(), os.environ.get("PYTHONPATH", "")) if p)
 
 
 class Container:
@@ -187,11 +203,7 @@ class CollectiveController:
             if ctx.devices:
                 env["TPU_VISIBLE_DEVICES"] = ctx.devices
                 env["CUDA_VISIBLE_DEVICES"] = ctx.devices
-            # workers get python's sys.path[0] = the *script's* dir, not the
-            # launcher's cwd — propagate cwd so source-tree imports resolve
-            env["PYTHONPATH"] = os.pathsep.join(
-                p for p in (os.getcwd(), os.environ.get("PYTHONPATH", ""))
-                if p)
+            env["PYTHONPATH"] = _worker_pythonpath()
             cmd = [sys.executable, "-u", ctx.script] + ctx.script_args
             log = os.path.join(ctx.log_dir, f"workerlog.{local}")
             pod.add(Container(cmd, env, log))
@@ -276,9 +288,78 @@ class CollectiveController:
             return rc
 
 
+class PSController:
+    """Parameter-server job controller (reference:
+    ``launch/controllers/ps.py``): spawns PSERVER containers on assigned
+    ports and TRAINER containers with the PS env contract
+    (``TRAINING_ROLE``, ``PADDLE_PSERVERS_IP_PORT_LIST``,
+    ``PADDLE_TRAINER_ID``); servers run until every trainer exits, then
+    the controller tears them down — upstream's run_mode=ps lifecycle."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+
+    def run(self) -> int:
+        import socket as _socket
+
+        ctx = self.ctx
+        ns = max(ctx.server_num, 1)
+        nt = ctx.trainer_num or ctx.nproc_per_node
+        ports = []
+        for _ in range(ns):
+            with _socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                ports.append(s.getsockname()[1])
+        ep_list = ",".join(f"127.0.0.1:{p}" for p in ports)
+        base = {
+            "PADDLE_PSERVERS_IP_PORT_LIST": ep_list,
+            "PADDLE_TRAINERS_NUM": str(nt),
+            "PYTHONPATH": _worker_pythonpath(),
+        }
+        cmd = [sys.executable, "-u", ctx.script] + ctx.script_args
+        servers, trainers = Pod(), Pod()
+        for i in range(ns):
+            env = dict(base, TRAINING_ROLE="PSERVER", POD_IP="127.0.0.1",
+                       PADDLE_PORT=str(ports[i]))
+            servers.add(Container(
+                cmd, env, os.path.join(ctx.log_dir, f"serverlog.{i}")))
+        for i in range(nt):
+            env = dict(base, TRAINING_ROLE="TRAINER",
+                       PADDLE_TRAINER_ID=str(i))
+            trainers.add(Container(
+                cmd, env, os.path.join(ctx.log_dir, f"workerlog.{i}")))
+        servers.start()
+        trainers.start()
+        try:
+            # watch BOTH pods: a crashed pserver must fail the job fast
+            # (trainers would otherwise stall in connect-retry and die
+            # with a misleading trainer-side error)
+            while True:
+                for c in servers.containers:
+                    src = c.poll()
+                    if src is not None and src != 0:
+                        print(f"[launch] pserver exited {src}; see its "
+                              "serverlog", file=sys.stderr)
+                        return src
+                alive = 0
+                for c in trainers.containers:
+                    rc = c.poll()
+                    if rc is None:
+                        alive += 1
+                    elif rc != 0:
+                        return rc
+                if alive == 0:
+                    return 0
+                time.sleep(0.5)
+        finally:
+            trainers.stop()
+            servers.stop()  # servers live exactly as long as the trainers
+
+
 def launch(argv: Optional[List[str]] = None) -> int:
     ctx = Context.parse(argv)
-    controller = CollectiveController(ctx)
+    controller = (PSController(ctx) if ctx.run_mode == "ps"
+                  else CollectiveController(ctx))
 
     def on_signal(sig, frame):
         sys.exit(128 + sig)

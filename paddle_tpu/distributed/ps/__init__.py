@@ -182,6 +182,10 @@ class PsServer:
             self._bar[key] = self._bar.get(key, 0) + 1
             return self._bar[key]
 
+    def _op_barrier_stat(self, key):
+        with self._bar_lock:
+            return self._bar.get(key, 0)
+
     def stop(self):
         self._srv.shutdown()
         self._srv.server_close()
@@ -193,7 +197,21 @@ class PsClient:
     reference deployment)."""
 
     def __init__(self, host, port, timeout=60.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+        # retry until the server is up: under the launcher, trainers and
+        # pservers start simultaneously and the server's interpreter may
+        # still be importing when the first trainer connects
+        import time as _time
+
+        deadline = _time.time() + timeout
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=timeout)
+                break
+            except OSError:
+                if _time.time() > deadline:
+                    raise
+                _time.sleep(0.2)
         self._lock = threading.Lock()
 
     def _call(self, op, *args):
@@ -228,6 +246,23 @@ class PsClient:
 
     def table_stats(self):
         return self._call("table_stats")
+
+    def barrier(self, key, world, timeout=60.0):
+        """Block until ``world`` clients entered ``key`` (reference
+        BrpcPsClient barrier). REUSABLE: the server counter is monotonic,
+        so arrival n belongs to generation (n-1)//world and waits until
+        the whole generation arrived — per-epoch barriers on one key work.
+        (A TimeoutError leaves a stale arrival behind; re-create the
+        server-side key rather than retrying the same generation.)"""
+        import time as _time
+
+        n = self._call("barrier", key, world)
+        target = ((n - 1) // world + 1) * world
+        deadline = _time.time() + timeout
+        while self._call("barrier_stat", key) < target:
+            if _time.time() > deadline:
+                raise TimeoutError(f"ps barrier {key!r} timed out")
+            _time.sleep(0.02)
 
     def close(self):
         self._sock.close()

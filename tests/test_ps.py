@@ -79,3 +79,136 @@ def test_trainer_local_train_converges(ps):
         grad = 2 * X.T @ (X @ w - y) / len(X)
         client.push_dense_grad(3, grad)
     np.testing.assert_allclose(client.pull_dense(3), w_true, atol=1e-2)
+
+
+def test_multi_client_concurrent_push_consistency(ps):
+    """Two clients hammering the same tables concurrently: SGD updates are
+    additive, so the final state must equal the serial sum regardless of
+    interleaving (the dense/sparse table locks make pushes atomic)."""
+    server, _ = ps
+    c0 = PsClient(server.host, server.port)
+    c1 = PsClient(server.host, server.port)
+    c0.create_dense_table(40, (4,), lr=1.0, init=np.zeros(4))
+    c0.create_sparse_table(41, dim=3, lr=1.0)
+    N = 50
+
+    def worker(c, val):
+        for _ in range(N):
+            c.push_dense_grad(40, np.full((4,), val, np.float32))
+            c.push_sparse_grad(41, [7], np.full((1, 3), val, np.float32))
+
+    ts = [threading.Thread(target=worker, args=(c, v))
+          for c, v in ((c0, 1.0), (c1, 2.0))]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    # w = -lr * sum(grads) = -(50*1 + 50*2) = -150 per element
+    np.testing.assert_allclose(c0.pull_dense(40), -150.0)
+    np.testing.assert_allclose(c1.pull_sparse(41, [7])[0],
+                               c0.pull_sparse(41, [7])[0])
+    base = c0.pull_sparse(41, [8])[0]  # untouched row: only init
+    assert np.all(np.abs(base) <= 0.05)
+    c0.close(); c1.close()
+
+
+def test_client_barrier_waits_for_world(ps):
+    import time
+
+    server, _ = ps
+    order = []
+
+    def late():
+        c = PsClient(server.host, server.port)
+        time.sleep(0.3)
+        order.append("enter-late")
+        c.barrier("b1", 2)
+        order.append("exit-late")
+        c.close()
+
+    t = threading.Thread(target=late)
+    t.start()
+    c = PsClient(server.host, server.port)
+    order.append("enter-early")
+    c.barrier("b1", 2)
+    order.append("exit-early")
+    t.join(timeout=10)
+    c.close()
+    assert order[0] == "enter-early"
+    assert set(order[2:]) == {"exit-early", "exit-late"}
+
+
+_PS_WORKER = """
+import os
+import time
+import numpy as np
+
+role = os.environ["TRAINING_ROLE"]
+eps = os.environ["PADDLE_PSERVERS_IP_PORT_LIST"].split(",")
+
+if role == "PSERVER":
+    from paddle_tpu.distributed.ps import PsServer
+
+    port = int(os.environ["PADDLE_PORT"])
+    s = PsServer(port=port)
+    print("PSERVER-UP", port, flush=True)
+    while True:  # the launcher tears servers down after trainers finish
+        time.sleep(0.5)
+
+from paddle_tpu.distributed.ps import PsClient
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+host, port = eps[0].rsplit(":", 1)
+c = PsClient(host, int(port))
+if rank == 0:
+    c.create_dense_table(0, (2,), lr=0.1, init=np.zeros(2))
+    c.create_sparse_table(1, dim=2, lr=0.1)
+c.barrier("init", world)
+
+# distributed linear fit: w -> [3, -1]; each trainer pushes grads from its
+# own data shard (the GeoSGD-style local-compute / central-apply loop)
+rng = np.random.RandomState(100 + rank)
+target = np.array([3.0, -1.0], np.float32)
+for step in range(60):
+    w = c.pull_dense(0)
+    x = rng.randn(8, 2).astype(np.float32)
+    y = x @ target
+    grad = 2 * x.T @ (x @ w - y) / len(x)
+    c.push_dense_grad(0, grad)
+    c.push_sparse_grad(1, [rank], np.ones((1, 2), np.float32) * 0.01)
+c.barrier("done", world)
+if rank == 0:
+    w = c.pull_dense(0)
+    err = float(np.abs(w - target).max())
+    stats = c.table_stats()
+    assert err < 0.15, (w, err)
+    assert stats["sparse"][1] == world, stats
+    print("PS-TRAIN-OK err", round(err, 4), "rows", stats["sparse"][1],
+          flush=True)
+c.close()
+"""
+
+
+def test_launcher_run_mode_ps_end_to_end(tmp_path):
+    """python -m paddle_tpu.distributed.launch --run_mode ps: 1 server +
+    2 trainers jointly fit a dense table (and touch per-rank sparse rows);
+    the launcher must tear the server down once trainers finish."""
+    import os as _os
+    import subprocess
+    import sys as _sys
+
+    script = tmp_path / "ps_worker.py"
+    script.write_text(_PS_WORKER)
+    env = dict(_os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    rc = subprocess.run(
+        [_sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--run_mode", "ps", "--server_num", "1", "--trainer_num", "2",
+         "--log_dir", str(tmp_path / "log"), str(script)],
+        cwd="/root/repo", env=env, timeout=180,
+        capture_output=True, text=True)
+    log0 = (tmp_path / "log" / "workerlog.0").read_text()
+    slog = (tmp_path / "log" / "serverlog.0").read_text()
+    assert rc.returncode == 0, (rc.stderr[-1500:], log0[-1500:])
+    assert "PSERVER-UP" in slog
+    assert "PS-TRAIN-OK" in log0
