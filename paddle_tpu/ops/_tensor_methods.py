@@ -9,7 +9,8 @@ here: the op corpus is the single source, and this module wires it onto the
 from __future__ import annotations
 
 from ..core.tensor import Tensor
-from . import creation, linalg, logic, manipulation, math, reduction
+from . import (creation, linalg, logic, manipulation, math, reduction,
+               special, tail)
 
 
 def attach():
@@ -46,7 +47,9 @@ def attach():
     T.__hash__ = object.__hash__  # identity hash despite __eq__, like paddle
 
     # method surface (paddle.Tensor methods)
-    for mod in (math, reduction, manipulation, logic, creation, linalg):
+    # tail/special last: the earlier modules' names win collisions
+    for mod in (math, reduction, manipulation, logic, creation, linalg,
+                special, tail):
         for name in getattr(mod, "__all__", []):
             fn = getattr(mod, name)
             if not callable(fn) or hasattr(T, name):

@@ -272,9 +272,24 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
 
 
 def load_profiler_result(filename: str):
-    from ..enforce import raise_unimplemented
+    """Load an exported chrome trace (or a trace dir containing one) back
+    as its event list (reference: ``load_profiler_result`` re-loads a
+    saved profile for inspection)."""
+    import glob as _glob
+    import json as _json
 
-    raise_unimplemented("load_profiler_result (open the trace dir in TensorBoard)")
+    path = filename
+    if os.path.isdir(path):
+        hits = sorted(_glob.glob(os.path.join(path, "*.chrome_trace.json")),
+                      key=os.path.getmtime)  # newest, not alphabetical
+        if not hits:
+            raise FileNotFoundError(
+                f"no *.chrome_trace.json under {filename!r}; call "
+                "Profiler.export_chrome_tracing() first (raw xplane "
+                "protos are viewable in TensorBoard)")
+        path = hits[-1]
+    with open(path) as f:
+        return _json.load(f)["traceEvents"]
 
 
 class SummaryView(enum.Enum):

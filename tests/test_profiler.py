@@ -81,3 +81,17 @@ class TestProfilerTables:
             return
         assert tables["modules"] or tables["kernels"]
         assert events
+
+
+def test_load_profiler_result_roundtrip(tmp_path):
+    p = profiler.Profiler(timer_only=True, log_dir=str(tmp_path))
+    p.start()
+    with profiler.RecordEvent("roundtrip"):
+        _train_some(1)
+    p.stop()
+    path = p.export_chrome_tracing()
+    events = profiler.load_profiler_result(path)
+    assert any(e["name"] == "roundtrip" for e in events)
+    # directory form resolves to the newest exported trace
+    events2 = profiler.load_profiler_result(str(tmp_path))
+    assert len(events2) == len(events)
