@@ -25,9 +25,30 @@ __all__ = ["Model"]
 
 
 def _as_tensor_batch(data):
-    if isinstance(data, (list, tuple)):
-        return [d if isinstance(d, Tensor) else to_tensor(np.asarray(d)) for d in data]
-    return [data if isinstance(data, Tensor) else to_tensor(np.asarray(data))]
+    """Host batch -> device Tensors. All host arrays ride ONE device_put
+    (a transfer round trip per batch element adds up fast on
+    dispatch-latency-bound transports)."""
+    import jax
+
+    items = list(data) if isinstance(data, (list, tuple)) else [data]
+    host_idx, host_arrs = [], []
+    for i, d in enumerate(items):
+        if isinstance(d, Tensor):
+            continue
+        a = np.asarray(d)
+        if np.issubdtype(a.dtype, np.complexfloating):
+            items[i] = to_tensor(a)  # complex is host-resident (see fft)
+        else:
+            host_idx.append(i)
+            host_arrs.append(a)
+    if host_idx:
+        from ..core.place import device_for_place, expected_place
+
+        # honour paddle.set_device like to_tensor does
+        put = jax.device_put(host_arrs, device_for_place(expected_place()))
+        for i, v in zip(host_idx, put):
+            items[i] = Tensor(v, stop_gradient=True)
+    return items
 
 
 class Model:
@@ -50,6 +71,14 @@ class Model:
             self._metrics = [metrics]
         else:
             self._metrics = list(metrics)
+        # the compiled step bakes in the loss AND the fused metric set —
+        # re-preparing must rebuild it (a stale program would feed one
+        # metric's fused result into another)
+        self._fused_step = None
+        self._fused_failed = False
+        self._fused_metric_flags = [
+            getattr(m, "compute_traced", None) is not None
+            for m in self._metrics]
         return self
 
     # -- single-batch ops ----------------------------------------------------
@@ -76,6 +105,13 @@ class Model:
             # equivalent. Falls back to eager per-op if tracing fails.
             if self._fused_step is None and not self._fused_failed:
                 net, n_in = self.network, len(inputs)
+                # metrics providing compute_traced fuse INTO the step: only
+                # their (small) pre-computed results cross to the host per
+                # batch, not the full output logits (the transfer dominates
+                # on dispatch-latency-bound transports)
+                self._fused_metric_flags = [
+                    getattr(m, "compute_traced", None) is not None
+                    for m in self._metrics]
 
                 def _loss_and_outs(*args):
                     outputs = net(*args[:n_in])
@@ -83,7 +119,10 @@ class Model:
                     outs = (list(outputs) if isinstance(outputs,
                                                         (list, tuple))
                             else [outputs])
-                    return (loss, *outs)
+                    pres = [m.compute_traced(*outs, *args[n_in:])
+                            for m, f in zip(self._metrics,
+                                            self._fused_metric_flags) if f]
+                    return (loss, *outs, *pres)
 
                 from ..jit import fused_train_step
 
@@ -91,15 +130,35 @@ class Model:
                     _loss_and_outs, self._optimizer, model=self.network,
                     has_aux=True)
             if self._fused_step is not None:
+                import jax
+
+                stepped = None
                 try:
-                    loss, *outs = self._fused_step(*inputs, *labels)
-                    outputs = outs if len(outs) > 1 else outs[0]
-                    metrics = self._update_metrics(outputs, labels)
-                    return (([float(loss.item())], metrics) if metrics
-                            else [float(loss.item())])
+                    stepped = self._fused_step(*inputs, *labels)
                 except Exception:
                     self._fused_step = None
                     self._fused_failed = True  # eager fallback from now on
+                if stepped is not None:
+                    # post-step work stays OUTSIDE the fallback window: the
+                    # optimizer update already committed, so a failure here
+                    # must propagate rather than re-run the batch eagerly
+                    # (which would apply the gradient twice)
+                    loss, *rest = stepped
+                    flags = getattr(self, "_fused_metric_flags",
+                                    [False] * len(self._metrics))
+                    n_pre = sum(flags)
+                    outs = rest[:len(rest) - n_pre] if n_pre else rest
+                    pres = rest[len(rest) - n_pre:] if n_pre else []
+                    outputs = outs if len(outs) > 1 else outs[0]
+                    # ONE device->host round trip for the loss scalar and
+                    # every fused metric result together
+                    host = jax.device_get(
+                        [loss._value] + [p._value for p in pres])
+                    metrics = self._update_metrics(outputs, labels,
+                                                   fused_pre=host[1:],
+                                                   fused_flags=flags)
+                    return (([float(host[0])], metrics) if metrics
+                            else [float(host[0])])
         outputs = self.network(*inputs)
         loss = self._compute_loss(outputs, labels)
         loss.backward()
@@ -131,13 +190,17 @@ class Model:
         outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
         return [o.numpy() for o in outs]
 
-    def _update_metrics(self, outputs, labels):
+    def _update_metrics(self, outputs, labels, fused_pre=(), fused_flags=()):
         results = []
         outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
-        for m in self._metrics:
-            pre = m.compute(*outs, *labels)
-            if not isinstance(pre, (list, tuple)):
-                pre = [pre]
+        pre_it = iter(fused_pre)
+        for i, m in enumerate(self._metrics):
+            if i < len(fused_flags) and fused_flags[i]:
+                pre = [next(pre_it)]  # computed inside the fused step
+            else:
+                pre = m.compute(*outs, *labels)
+                if not isinstance(pre, (list, tuple)):
+                    pre = [pre]
             m.update(*pre)
             results.append(m.accumulate())
         return results

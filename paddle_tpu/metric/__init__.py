@@ -53,6 +53,20 @@ class Accuracy(Metric):
         correct = topk_idx == label_np[..., None]
         return correct
 
+    def compute_traced(self, pred, label, *args):
+        """Traceable form of ``compute`` (paddle ops on device tensors):
+        hapi fuses this INTO the compiled train step, so per batch only
+        the tiny [N, maxk] correctness matrix crosses to the host instead
+        of the whole logits tensor (SURVEY §3.2's hot loop; the transfer
+        dominates on dispatch-latency-bound transports)."""
+        from ..ops import logic, manipulation
+
+        if label.ndim == pred.ndim and label.shape[-1] == 1:
+            label = manipulation.squeeze(label, -1)
+        idx = manipulation.argsort(pred, axis=-1, descending=True)
+        idx = idx[..., : self.maxk]
+        return logic.equal(idx, manipulation.unsqueeze(label, -1))
+
     def update(self, correct, *args):
         correct = _np(correct)
         flat = correct.reshape(-1, correct.shape[-1])
