@@ -71,17 +71,59 @@ class Model:
             self._metrics = [metrics]
         else:
             self._metrics = list(metrics)
-        # the compiled step bakes in the loss AND the fused metric set —
-        # re-preparing must rebuild it (a stale program would feed one
+        # the compiled steps bake in the loss AND the fused metric set —
+        # re-preparing must rebuild them (a stale program would feed one
         # metric's fused result into another)
         self._fused_step = None
         self._fused_failed = False
-        self._fused_metric_flags = [
-            getattr(m, "compute_traced", None) is not None
-            for m in self._metrics]
+        self._fused_eval = None
+        self._fused_eval_failed = False
+        self._fused_pre_counts = [0] * len(self._metrics)
+        self._fused_eval_counts = [0] * len(self._metrics)
         return self
 
     # -- single-batch ops ----------------------------------------------------
+    def _traced_metric_flags(self):
+        return [getattr(m, "compute_traced", None) is not None
+                for m in self._metrics]
+
+    def _collect_traced_pres(self, outs, largs, counts_attr):
+        """Run each fused metric's compute_traced during tracing; results
+        flatten into the program outputs and the per-metric counts are
+        recorded (trace-time side effect, set before the first call
+        returns) so the consumer can regroup them."""
+        pres, counts = [], []
+        for m, f in zip(self._metrics, self._traced_metric_flags()):
+            if not f:
+                counts.append(0)
+                continue
+            pre = m.compute_traced(*outs, *largs)
+            pre = list(pre) if isinstance(pre, (list, tuple)) else [pre]
+            counts.append(len(pre))
+            pres.extend(pre)
+        setattr(self, counts_attr, counts)
+        return pres
+
+    def _finish_fused(self, stepped, labels, counts):
+        """Unpack a fused program's (loss, *outs, *pres) result: ONE
+        device->host round trip for the loss scalar and every fused metric
+        result together. Runs OUTSIDE any eager-fallback window — by the
+        time this is called the program's effects are committed, so a
+        failure here must propagate, never re-run the batch."""
+        import jax
+
+        loss, *rest = stepped
+        n_pre = sum(counts)
+        outs = rest[:len(rest) - n_pre] if n_pre else rest
+        pres = rest[len(rest) - n_pre:] if n_pre else []
+        outputs = outs if len(outs) > 1 else outs[0]
+        host = jax.device_get([loss._value] + [p._value for p in pres])
+        metrics = self._update_metrics(outputs, labels,
+                                       fused_pre=host[1:],
+                                       fused_counts=counts)
+        return (([float(host[0])], metrics) if metrics
+                else [float(host[0])])
+
     def _compute_loss(self, outputs, labels):
         if self._loss is None:
             raise InvalidArgumentError("Model.prepare(loss=...) was not called")
@@ -105,23 +147,19 @@ class Model:
             # equivalent. Falls back to eager per-op if tracing fails.
             if self._fused_step is None and not self._fused_failed:
                 net, n_in = self.network, len(inputs)
+
                 # metrics providing compute_traced fuse INTO the step: only
                 # their (small) pre-computed results cross to the host per
                 # batch, not the full output logits (the transfer dominates
                 # on dispatch-latency-bound transports)
-                self._fused_metric_flags = [
-                    getattr(m, "compute_traced", None) is not None
-                    for m in self._metrics]
-
                 def _loss_and_outs(*args):
                     outputs = net(*args[:n_in])
                     loss = self._compute_loss(outputs, list(args[n_in:]))
                     outs = (list(outputs) if isinstance(outputs,
                                                         (list, tuple))
                             else [outputs])
-                    pres = [m.compute_traced(*outs, *args[n_in:])
-                            for m, f in zip(self._metrics,
-                                            self._fused_metric_flags) if f]
+                    pres = self._collect_traced_pres(
+                        outs, list(args[n_in:]), "_fused_pre_counts")
                     return (loss, *outs, *pres)
 
                 from ..jit import fused_train_step
@@ -130,8 +168,6 @@ class Model:
                     _loss_and_outs, self._optimizer, model=self.network,
                     has_aux=True)
             if self._fused_step is not None:
-                import jax
-
                 stepped = None
                 try:
                     stepped = self._fused_step(*inputs, *labels)
@@ -143,22 +179,10 @@ class Model:
                     # optimizer update already committed, so a failure here
                     # must propagate rather than re-run the batch eagerly
                     # (which would apply the gradient twice)
-                    loss, *rest = stepped
-                    flags = getattr(self, "_fused_metric_flags",
-                                    [False] * len(self._metrics))
-                    n_pre = sum(flags)
-                    outs = rest[:len(rest) - n_pre] if n_pre else rest
-                    pres = rest[len(rest) - n_pre:] if n_pre else []
-                    outputs = outs if len(outs) > 1 else outs[0]
-                    # ONE device->host round trip for the loss scalar and
-                    # every fused metric result together
-                    host = jax.device_get(
-                        [loss._value] + [p._value for p in pres])
-                    metrics = self._update_metrics(outputs, labels,
-                                                   fused_pre=host[1:],
-                                                   fused_flags=flags)
-                    return (([float(host[0])], metrics) if metrics
-                            else [float(host[0])])
+                    return self._finish_fused(
+                        stepped, labels,
+                        getattr(self, "_fused_pre_counts",
+                                [0] * len(self._metrics)))
         outputs = self.network(*inputs)
         loss = self._compute_loss(outputs, labels)
         loss.backward()
@@ -174,6 +198,38 @@ class Model:
         self.network.eval()
         inputs = _as_tensor_batch(inputs)
         labels = _as_tensor_batch(labels) if labels is not None else []
+        # same fusion as train_batch: forward+loss+traced metrics as ONE
+        # compiled program, loss + metric results on ONE device_get; only
+        # the program CALL may fall back (metric updates must never run
+        # twice for one batch, so unpack/update stay outside the window)
+        if not getattr(self, "_fused_eval_failed", False):
+            stepped = None
+            try:
+                if getattr(self, "_fused_eval", None) is None:
+                    from ..jit import to_static
+
+                    net, n_in = self.network, len(inputs)
+
+                    def _eval_fn(*args):
+                        outputs = net(*args[:n_in])
+                        loss = self._compute_loss(outputs, list(args[n_in:]))
+                        outs = (list(outputs) if isinstance(outputs,
+                                                            (list, tuple))
+                                else [outputs])
+                        pres = self._collect_traced_pres(
+                            outs, list(args[n_in:]), "_fused_eval_counts")
+                        return (loss, *outs, *pres)
+
+                    self._fused_eval = to_static(_eval_fn, full_graph=False)
+                stepped = self._fused_eval(*inputs, *labels)
+            except Exception:
+                self._fused_eval = None
+                self._fused_eval_failed = True
+            if stepped is not None:
+                return self._finish_fused(
+                    stepped, labels,
+                    getattr(self, "_fused_eval_counts",
+                            [0] * len(self._metrics)))
         with no_grad():
             outputs = self.network(*inputs)
             loss = self._compute_loss(outputs, labels)
@@ -190,13 +246,14 @@ class Model:
         outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
         return [o.numpy() for o in outs]
 
-    def _update_metrics(self, outputs, labels, fused_pre=(), fused_flags=()):
+    def _update_metrics(self, outputs, labels, fused_pre=(), fused_counts=()):
         results = []
         outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
-        pre_it = iter(fused_pre)
+        pre_list = list(fused_pre)
         for i, m in enumerate(self._metrics):
-            if i < len(fused_flags) and fused_flags[i]:
-                pre = [next(pre_it)]  # computed inside the fused step
+            c = fused_counts[i] if i < len(fused_counts) else 0
+            if c:
+                pre = [pre_list.pop(0) for _ in range(c)]
             else:
                 pre = m.compute(*outs, *labels)
                 if not isinstance(pre, (list, tuple)):
