@@ -19,6 +19,7 @@ from .collective import (
     isend,
     new_group,
     recv,
+    recv_prev,
     reduce,
     batch_isend_irecv,
     destroy_process_group,
@@ -27,6 +28,7 @@ from .collective import (
     scatter,
     scatter_object_list,
     send,
+    send_next,
     split,
     wait,
 )
@@ -60,7 +62,7 @@ __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "get_default_group",
     "all_reduce", "all_gather", "all_gather_object", "reduce",
     "reduce_scatter", "broadcast", "scatter", "alltoall", "all_to_all",
-    "send", "recv", "isend", "irecv", "barrier", "ParallelEnv", "get_rank",
+    "send", "recv", "send_next", "recv_prev", "isend", "irecv", "barrier", "ParallelEnv", "get_rank",
     "P2POp", "batch_isend_irecv", "wait", "destroy_process_group",
     "get_backend", "scatter_object_list", "split", "utils",
     "get_world_size", "init_parallel_env", "is_initialized", "DataParallel",
