@@ -241,6 +241,23 @@ class Model:
 
         self.network.eval()
         inputs = _as_tensor_batch(inputs)
+        # compiled forward (one program per batch, like train/eval); the
+        # outputs are fetched anyway, so only the dispatch count changes
+        if not getattr(self, "_fused_pred_failed", False):
+            try:
+                if getattr(self, "_fused_pred", None) is None:
+                    from ..jit import to_static
+
+                    self._fused_pred = to_static(self.network,
+                                                 full_graph=False)
+                with no_grad():  # inference: skip the program-level vjp
+                    outputs = self._fused_pred(*inputs)
+                outs = (outputs if isinstance(outputs, (list, tuple))
+                        else [outputs])
+                return [o.numpy() for o in outs]
+            except Exception:
+                self._fused_pred = None
+                self._fused_pred_failed = True
         with no_grad():
             outputs = self.network(*inputs)
         outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]

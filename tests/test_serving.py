@@ -69,3 +69,23 @@ class TestServingEngine:
                             prompt_buckets=(64,))
         with pytest.raises(ValueError, match="max_len"):
             eng.add_request(np.zeros((60,), np.int32), 64)  # 60+63 > 96
+
+
+class TestServingEos:
+    def test_eos_freezes_slot_early(self, tiny):
+        """eos_token_id must stop a request the step EOS is emitted (slot
+        frozen in-program) and the tokens must still match the dense path
+        truncated at its first EOS."""
+        cfg, params = tiny
+        p = np.random.RandomState(5).randint(
+            0, cfg.vocab_size, (10,)).astype(np.int32)
+        # find the greedy continuation and pick its 3rd token as "EOS" so
+        # the engine must stop at position 3 of a 10-token budget
+        ref = _dense_reference(cfg, params, p, 10)
+        eos = ref[2]
+        eng = ServingEngine(cfg, params, slots=2, max_len=96, chunk=4,
+                            prompt_buckets=(16,), eos_token_id=eos)
+        rid = eng.add_request(p, 10)
+        results = eng.run()
+        want = ref[:ref.index(eos) + 1]
+        assert results[rid] == want, (results[rid], want)
