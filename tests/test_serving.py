@@ -89,3 +89,26 @@ class TestServingEos:
         results = eng.run()
         want = ref[:ref.index(eos) + 1]
         assert results[rid] == want, (results[rid], want)
+
+    def test_mixed_eos_and_full_requests_share_slots(self, tiny):
+        """Requests that hit EOS early retire and hand their slot to queued
+        requests while non-EOS requests keep decoding — the continuous
+        part of continuous batching under early termination."""
+        cfg, params = tiny
+        rng = np.random.RandomState(9)
+        prompts = [rng.randint(0, cfg.vocab_size, (6 + i,)).astype(np.int32)
+                   for i in range(5)]
+        refs = [_dense_reference(cfg, params, p, 8) for p in prompts]
+        # an EOS token that appears early for request 0 only
+        eos = refs[0][1]
+        eng = ServingEngine(cfg, params, slots=2, max_len=96, chunk=4,
+                            prompt_buckets=(16,), eos_token_id=eos)
+        rids = [eng.add_request(p, 8) for p in prompts]
+        results = eng.run()
+        assert sorted(results) == sorted(rids)
+        for rid, ref in zip(rids, refs):
+            if eos in ref:
+                want = ref[:ref.index(eos) + 1]
+            else:
+                want = ref
+            assert results[rid] == want, (rid, results[rid], want)
