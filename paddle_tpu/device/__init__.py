@@ -117,12 +117,28 @@ class _DeviceNamespace:
         empty_cache()
 
 
+def _last_dispatched():
+    from ..ops.dispatch import _LAST_DISPATCHED
+
+    return _LAST_DISPATCHED[0]
+
+
+def _array_ready(arr) -> bool:
+    if arr is None:
+        return True
+    try:
+        return bool(arr.is_ready())
+    except Exception:  # deleted/donated buffers count as "done"
+        return True
+
+
 class Stream:
     """API-parity stream object (reference: ``paddle.device.Stream`` over
     CUDA streams). XLA/PJRT schedules asynchronously on internal streams
     the user cannot target, so ordering is already program order:
     ``wait_event``/``wait_stream`` are no-ops, ``synchronize`` drains the
-    device, and ``query`` reports completion by draining first."""
+    device, and ``query`` polls the readiness of the most recently
+    dispatched value without ever draining (see ``query``)."""
 
     def __init__(self, device=None, priority=2):
         self.device = device
@@ -144,10 +160,12 @@ class Stream:
     def query(self) -> bool:
         """Non-blocking completion poll (reference ``Stream.query``). XLA
         dispatch is in-order and this framework's streams are the no-op
-        stream model, so there is no pending-work handle to poll — return
-        True WITHOUT draining the device (a synchronizing query would turn
-        reference-style polling loops into full device barriers)."""
-        return True
+        stream model; the honest non-blocking answer is whether the MOST
+        RECENTLY dispatched eager op's output is ready (``.is_ready()`` on
+        the tracked array) — in-order dispatch means everything before it
+        is then done too. Never drains the device (a synchronizing query
+        would turn reference-style polling loops into full barriers)."""
+        return _array_ready(_last_dispatched())
 
 
 class Event:
@@ -162,11 +180,17 @@ class Event:
     def record(self, stream=None) -> None:
         self._recorded = True
         self._stream = stream
+        # snapshot the last dispatch at record time: query() then answers
+        # "has the work recorded by this event completed", matching
+        # cudaEventRecord/cudaEventQuery semantics under in-order dispatch
+        self._marker = _last_dispatched()
 
     def query(self) -> bool:
         # non-blocking, like Stream.query (see there); the reference's
         # cudaEventQuery never drains the device either
-        return True
+        if not self._recorded:
+            return True
+        return _array_ready(getattr(self, "_marker", None))
 
     def synchronize(self) -> None:
         if self._recorded:

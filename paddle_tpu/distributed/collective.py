@@ -510,10 +510,11 @@ def recv(tensor, src=0, group=None, sync_op=True):
         shift = (me - peer) % n
         perm = [(i, (i + shift) % n) for i in range(n)]
         out = jax.lax.ppermute(val, ax, perm)
-        if isinstance(tensor, Tensor):
-            tensor._value = out  # fill the passed buffer (traced rebind)
-            return tensor
-        return Tensor(out)
+        # fill the passed buffer through _inplace_set so the grad-node and
+        # symbolic-write guards apply (ADVICE r2); this branch only runs
+        # when the buffer already holds a tracer of the current trace, so
+        # no tracer is introduced into an eager Tensor here
+        return _rewrap(tensor, out)
     raise InvalidArgumentError("eager send/recv requires a shard_map context or launch runtime")
 
 
@@ -690,34 +691,48 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
     Prefer the explicit ``fleet.meta_parallel`` layers for new code."""
     from .fleet import meta_parallel as mp
 
+    cache = _split_layer_cache
     if name is None:
         # reference signature makes name optional: derive a stable key from
-        # the IMMEDIATE call site (file:line), so the split() line inside a
-        # model's forward resolves to the same weight no matter which outer
-        # code path (train loop, eval loop) reaches it. The known limit —
-        # one line building several logical layers (loops, factory
-        # helpers) weight-ties them — gets a one-time warning pointing at
-        # the explicit-name escape hatch.
+        # the IMMEDIATE call site (file:line), scoped to the calling
+        # INSTANCE when there is one — the cache dict is stored on the
+        # caller's `self`, so the split() line inside a model's forward
+        # resolves to the same weight across steps, two model objects built
+        # from the same source line never weight-tie, and a dead model's
+        # weights are released with it instead of pinned in a module global
+        # (ADVICE r2 + review). Module-level / __slots__ callers fall back
+        # to the per-site global cache. The remaining limit — one line
+        # building several logical layers for the SAME instance (loops,
+        # factory helpers) weight-ties them — gets a one-time warning per
+        # (site, cache) pointing at the explicit-name escape hatch.
         import sys
 
         f = sys._getframe(1)
         name = f"_split_auto:{f.f_code.co_filename}:{f.f_lineno}"
-        if name not in _split_layer_cache:
+        owner = f.f_locals.get("self")
+        if owner is not None and hasattr(owner, "__dict__"):
+            try:
+                cache = owner.__dict__.setdefault(
+                    "_paddle_split_site_cache", {})
+            except (AttributeError, TypeError):  # mappingproxy etc.
+                pass
+        if name not in cache:
             import warnings
 
             warnings.warn(
                 "paddle.distributed.split called without `name`: the "
-                f"created weight is cached per call site ({name}); if this "
-                "line builds several logical layers (loop/factory), pass "
-                "an explicit unique name per layer or they will share one "
-                "weight", stacklevel=2)
+                f"created weight is cached per call site ({name}"
+                f"{'' if cache is _split_layer_cache else ', per instance'}"
+                "); if this line builds several logical layers "
+                "(loop/factory), pass an explicit unique name per layer or "
+                "they will share one weight", stacklevel=2)
     if operation == "linear" and axis not in (0, 1):
         raise InvalidArgumentError(
             f"split(operation='linear') partitions a 2-D weight: axis must "
             f"be 0 (row-parallel) or 1 (column-parallel), got {axis}")
     config = (operation, tuple(size), axis, bool(gather_out),
               bias_attr is not False, _attr_key(weight_attr), num_partitions)
-    cached = _split_layer_cache.get(name)
+    cached = cache.get(name)
     if cached is not None and cached[0] != config:
         raise InvalidArgumentError(
             f"split(name={name!r}) called with a different configuration "
@@ -741,5 +756,5 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
                                             gather_output=gather_out)
         else:
             raise ValueError(f"unsupported split operation {operation!r}")
-        _split_layer_cache[name] = (config, layer)
+        cache[name] = (config, layer)
     return layer(x)

@@ -76,6 +76,9 @@ class Model:
         # metric's fused result into another)
         self._fused_step = None
         self._fused_failed = False
+        self._fused_train_sigs = set()  # compile-window bookkeeping follows
+        # the step program it belongs to (stale sigs would skip the
+        # fallback-eligible compile window for a rebuilt step)
         self._fused_eval = None
         self._fused_eval_failed = False
         self._fused_pre_counts = [0] * len(self._metrics)
@@ -168,13 +171,35 @@ class Model:
                     _loss_and_outs, self._optimizer, model=self.network,
                     has_aux=True)
             if self._fused_step is not None:
-                stepped = None
-                try:
+                # fallback window covers ONLY trace/compile: compile() does
+                # not execute, donate buffers, or advance optimizer state,
+                # so falling back to eager after it fails re-runs nothing.
+                # Genuine runtime errors from the compiled call propagate —
+                # after donation the eager re-run would read invalidated
+                # arrays and apply the gradient twice (ADVICE r2). The
+                # compile window runs once per input signature (the
+                # signature check is a tuple build + set lookup, keeping the
+                # per-batch hot path at ONE _prepare, not two).
+                sig = (tuple((tuple(t.shape), str(t.dtype))
+                             for t in (*inputs, *labels)),
+                       tuple(id(p) for p in self._optimizer._params()))
+                seen = self.__dict__.setdefault("_fused_train_sigs", set())
+                compiled = sig in seen
+                if not compiled:
+                    try:
+                        self._fused_step.compile(*inputs, *labels)
+                        seen.add(sig)
+                        compiled = True
+                    except Exception as e:
+                        self._fused_step = None
+                        self._fused_failed = True  # eager from now on
+                        import logging
+
+                        logging.getLogger("paddle_tpu.hapi").warning(
+                            "fused train step failed to trace/compile; "
+                            "falling back to eager per-op execution: %r", e)
+                if compiled:
                     stepped = self._fused_step(*inputs, *labels)
-                except Exception:
-                    self._fused_step = None
-                    self._fused_failed = True  # eager fallback from now on
-                if stepped is not None:
                     # post-step work stays OUTSIDE the fallback window: the
                     # optimizer update already committed, so a failure here
                     # must propagate rather than re-run the batch eagerly
@@ -222,9 +247,14 @@ class Model:
 
                     self._fused_eval = to_static(_eval_fn, full_graph=False)
                 stepped = self._fused_eval(*inputs, *labels)
-            except Exception:
+            except Exception as e:
                 self._fused_eval = None
                 self._fused_eval_failed = True
+                import logging
+
+                logging.getLogger("paddle_tpu.hapi").warning(
+                    "fused eval step failed; falling back to eager "
+                    "per-op execution: %r", e)
             if stepped is not None:
                 return self._finish_fused(
                     stepped, labels,
@@ -255,9 +285,14 @@ class Model:
                 outs = (outputs if isinstance(outputs, (list, tuple))
                         else [outputs])
                 return [o.numpy() for o in outs]
-            except Exception:
+            except Exception as e:
                 self._fused_pred = None
                 self._fused_pred_failed = True
+                import logging
+
+                logging.getLogger("paddle_tpu.hapi").warning(
+                    "fused predict failed; falling back to eager "
+                    "per-op execution: %r", e)
         with no_grad():
             outputs = self.network(*inputs)
         outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]

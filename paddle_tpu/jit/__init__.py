@@ -462,6 +462,28 @@ def not_to_static(fn=None):
     return fn
 
 
+class _AOTCachedJit:
+    """A jax.jit function plus an optional AOT-compiled executable.
+
+    ``ensure_compiled(args)`` lowers+compiles without executing; once that
+    happened, calls go through the stored executable so the compile work is
+    paid exactly once whether or not the caller pre-compiled."""
+
+    def __init__(self, jitted):
+        self._jitted = jitted
+        self._compiled = None
+
+    def ensure_compiled(self, *args):
+        if self._compiled is None:
+            self._compiled = self._jitted.lower(*args).compile()
+        return self._compiled
+
+    def __call__(self, *args):
+        if self._compiled is not None:
+            return self._compiled(*args)
+        return self._jitted(*args)
+
+
 class FusedTrainStep:
     """ONE compiled XLA program per optimization step: forward + loss +
     backward + optimizer update, with parameters/optimizer state in donated
@@ -506,9 +528,21 @@ class FusedTrainStep:
         buffers = self._model.buffers() if self._model is not None else []
         return params, state_keys, svals, evals, buffers
 
-    def __call__(self, *inputs):
+    def compile(self, *inputs):
+        """Trace + lower + compile the step for these input shapes WITHOUT
+        executing it (no buffers donated, no RNG consumed, no optimizer
+        state touched). Callers that want an eager fallback on *tracing*
+        failures only — not on genuine runtime errors — compile() inside
+        their try block and then __call__ outside it (hapi does this).
+        The compiled executable is cached, so the following __call__ pays
+        no second compilation."""
+        entry, _, call_tail = self._prepare(inputs)
+        dummy_key = jax.random.key_data(jax.random.key(0))
+        entry.ensure_compiled(dummy_key, *call_tail)
+        return self
+
+    def _prepare(self, inputs):
         from ..framework import random as _random
-        from ..framework.random import next_key
 
         opt = self._opt
         params, state_keys, svals, evals, buffers = self._state_setup()
@@ -585,17 +619,28 @@ class FusedTrainStep:
                     new_s.append(ns_)
                 return loss, aux, new_p, new_s, new_b
 
-            jitted = jax.jit(pure, donate_argnums=(1, 3))
+            jitted = _AOTCachedJit(jax.jit(pure, donate_argnums=(1, 3)))
             self._cache[key] = jitted
 
         bvals = [b._value for b in buffers]
         pvals = [p._value for p in params]
         lr = jnp.float32(opt.get_lr())
+        call_tail = (pvals, bvals, svals, evals, lr,
+                     jnp.int32(opt._step_count + 1)) + tuple(ivals)
+        return jitted, (params, buffers), call_tail
+
+    def __call__(self, *inputs):
+        from ..framework.random import next_key
+
+        opt = self._opt
+        jitted, (params, buffers), call_tail = self._prepare(inputs)
         # step count rides as data; committed only after a successful call so
         # a failed trace doesn't skew bias correction for an eager fallback
         loss, aux, new_p, new_s, new_b = jitted(
-            jax.random.key_data(next_key()), pvals, bvals, svals, evals,
-            lr, jnp.int32(opt._step_count + 1), *ivals)
+            jax.random.key_data(next_key()), *call_tail)
+        from ..ops.dispatch import note_dispatch
+
+        note_dispatch(loss)  # Stream/Event.query honesty for the fused path
         opt._step_count += 1
         for p, np_, ns_ in zip(params, new_p, new_s):
             p._inplace_set(np_)

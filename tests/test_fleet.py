@@ -414,6 +414,19 @@ def test_p2p_and_object_collectives_api():
     np.testing.assert_allclose(a1.numpy(), a2.numpy())  # same site: cached
     assert not np.allclose(a1.numpy(), b1.numpy())  # distinct sites: new init
 
+    # ADVICE r2: two INSTANCES whose forward shares one source line must
+    # not weight-tie — the auto key includes a per-instance token taken
+    # from the caller's `self`
+    class _SplitNet:
+        def forward(self):
+            return d.split(paddle.to_tensor(np.ones((2, 8), np.float32)),
+                           (8, 4), operation="linear", axis=1)
+
+    m1, m2 = _SplitNet(), _SplitNet()
+    o1a, o1b, o2 = m1.forward(), m1.forward(), m2.forward()
+    np.testing.assert_allclose(o1a.numpy(), o1b.numpy())  # same instance
+    assert not np.allclose(o1a.numpy(), o2.numpy())  # new instance: new init
+
     from paddle_tpu.distributed import utils as dutils
     x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
     np.testing.assert_allclose(
