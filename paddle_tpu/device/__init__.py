@@ -118,13 +118,17 @@ class _DeviceNamespace:
 
 
 def _last_dispatched():
+    """The weakref slot dispatch.py maintains (or None)."""
     from ..ops.dispatch import _LAST_DISPATCHED
 
     return _LAST_DISPATCHED[0]
 
 
-def _array_ready(arr) -> bool:
-    if arr is None:
+def _array_ready(ref) -> bool:
+    if ref is None:
+        return True
+    arr = ref() if callable(ref) else ref
+    if arr is None:  # buffer already collected: the work is long done
         return True
     try:
         return bool(arr.is_ready())

@@ -510,10 +510,15 @@ def recv(tensor, src=0, group=None, sync_op=True):
         shift = (me - peer) % n
         perm = [(i, (i + shift) % n) for i in range(n)]
         out = jax.lax.ppermute(val, ax, perm)
-        # fill the passed buffer through _inplace_set so the grad-node and
-        # symbolic-write guards apply (ADVICE r2); this branch only runs
-        # when the buffer already holds a tracer of the current trace, so
-        # no tracer is introduced into an eager Tensor here
+        # fill the passed buffer through _inplace_set so the symbolic-write
+        # guard applies (ADVICE r2); this branch only runs when the buffer
+        # already holds a tracer of the current trace, so no tracer is
+        # introduced into an eager Tensor here. A NON-LEAF buffer (an
+        # activation with a grad node) cannot be overwritten without
+        # corrupting its tape — those get a fresh Tensor instead of an
+        # in-place fill; callers use the return value either way.
+        if isinstance(tensor, Tensor) and tensor._grad_node is not None:
+            return Tensor(out)
         return _rewrap(tensor, out)
     raise InvalidArgumentError("eager send/recv requires a shard_map context or launch runtime")
 
@@ -650,6 +655,7 @@ def destroy_process_group(group=None):
     if group is None:
         _groups.clear()
         _split_layer_cache.clear()  # release split()'s cached weights too
+        _split_cache_gen[0] += 1  # invalidate per-instance caches as well
         _env._initialized[0] = False
     else:
         _groups.pop(group.id, None)
@@ -662,6 +668,10 @@ def get_backend(group=None) -> str:
 
 
 _split_layer_cache = {}
+# bumped by destroy_process_group(): per-instance split caches carry the
+# generation they were built under and are discarded on mismatch (a layer
+# built for the old world size has stale shard shapes)
+_split_cache_gen = [0]
 
 
 def _attr_key(attr):
@@ -714,6 +724,9 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
             try:
                 cache = owner.__dict__.setdefault(
                     "_paddle_split_site_cache", {})
+                if cache.get("__gen__") != _split_cache_gen[0]:
+                    cache.clear()  # world torn down since these were built
+                    cache["__gen__"] = _split_cache_gen[0]
             except (AttributeError, TypeError):  # mappingproxy etc.
                 pass
         if name not in cache:

@@ -58,6 +58,85 @@ def _mp_degree() -> int:
     return mesh_axis_size("mp")
 
 
+# ---------------------------------------------------------------------------
+# Manual tensor-parallel mode (Megatron f/g inside manual shard_map)
+# ---------------------------------------------------------------------------
+# The GSPMD forwards above/below express TP as layout constraints — correct
+# under jit, but NOT inside an all-manual shard_map program whose stage
+# dispatch is a lax.switch (the compiled 1F1B pipeline): GSPMD-auto
+# collectives inside a switch branch deadlock, because only the matching
+# stage's devices execute them. While ``manual_mp(axis)`` is active (the
+# 1F1B engine sets it around its trace), the layers therefore run the
+# reference's OWN formulation — local-shard matmuls plus the Megatron
+# ``f``/``g`` collectives, here with the gradient-correct custom VJPs:
+#   _copy_to_mp:     identity fwd, psum bwd   (reference c_identity)
+#   _reduce_from_mp: psum fwd, identity bwd   (reference mp_allreduce_sum)
+#   _gather_from_mp: all-gather fwd, local-slice bwd (reference c_allgather)
+# Raw lax.psum would double-count under replicated downstream compute; the
+# custom VJPs encode the single logical consumption.
+
+_MANUAL_MP = [None]  # the manual 'mp' axis name, or None
+
+
+class manual_mp:
+    """Context manager activating manual-TP forwards for traces within."""
+
+    def __init__(self, axis: Optional[str]):
+        self._axis = axis
+
+    def __enter__(self):
+        self._prev = _MANUAL_MP[0]
+        _MANUAL_MP[0] = self._axis
+        return self
+
+    def __exit__(self, *exc):
+        _MANUAL_MP[0] = self._prev
+        return False
+
+
+def _manual_fns(ax: str):
+    @jax.custom_vjp
+    def copy_to(x):
+        return x
+
+    copy_to.defvjp(lambda x: (x, None),
+                   lambda _, g: (jax.lax.psum(g, ax),))
+
+    @jax.custom_vjp
+    def reduce_from(x):
+        return jax.lax.psum(x, ax)
+
+    reduce_from.defvjp(lambda x: (jax.lax.psum(x, ax), None),
+                       lambda _, g: (g,))
+
+    @jax.custom_vjp
+    def gather_from(x):
+        return jax.lax.all_gather(x, ax, axis=x.ndim - 1, tiled=True)
+
+    def _gather_fwd(x):
+        return gather_from(x), x.shape[-1]
+
+    def _gather_bwd(local_n, g):
+        i = jax.lax.axis_index(ax)
+        return (jax.lax.dynamic_slice_in_dim(
+            g, i * local_n, local_n, axis=g.ndim - 1),)
+
+    gather_from.defvjp(_gather_fwd, _gather_bwd)
+    return copy_to, reduce_from, gather_from
+
+
+_MANUAL_FNS: dict = {}
+
+
+def manual_tp_fns(ax: Optional[str] = None):
+    """(copy_to, reduce_from, gather_from) for the active manual axis."""
+    ax = ax or _MANUAL_MP[0]
+    fns = _MANUAL_FNS.get(ax)
+    if fns is None:
+        fns = _MANUAL_FNS[ax] = _manual_fns(ax)
+    return fns
+
+
 def _constrain(t, spec: P):
     """Differentiable, Tensor-aware sharding constraint (tape-recorded op).
 
@@ -139,6 +218,24 @@ class VocabParallelEmbedding(Layer):
         _place_param(self.weight, P("mp", None))
 
     def forward(self, x):
+        ax = _MANUAL_MP[0]
+        if ax is not None:
+            # manual mode: the weight IS the local vocab slice; mask
+            # out-of-range ids, look up locally, all-reduce — literally the
+            # reference's VocabParallelEmbedding.forward
+            copy_to, reduce_from, _ = manual_tp_fns(ax)
+
+            def f(ids, w_local):
+                vloc = w_local.shape[0]
+                lo = jax.lax.axis_index(ax) * vloc
+                idl = ids - lo
+                ok = (idl >= 0) & (idl < vloc)
+                safe = jnp.clip(idl, 0, vloc - 1)
+                out = jnp.take(w_local, safe, axis=0)
+                out = jnp.where(ok[..., None], out, 0)
+                return reduce_from(out)
+
+            return run_op("vocab_parallel_embedding_manual", f, x, self.weight)
         x = _on_mesh(x)
         out = F.embedding(x, self.weight)
         return _constrain(out, P(*([None] * out.ndim)))
@@ -179,6 +276,22 @@ class ColumnParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        ax = _MANUAL_MP[0]
+        if ax is not None:
+            # manual mode: weight/bias are the local output-dim shards;
+            # copy_to makes the replicated input's backward psum over mp
+            # (the reference's c_identity before the matmul)
+            copy_to, _, gather_from = manual_tp_fns(ax)
+            args = [x, self.weight] + ([self.bias] if self.bias is not None
+                                       else [])
+
+            def f(xv, wv, *rest):
+                y = copy_to(xv) @ wv
+                if rest:
+                    y = y + rest[0]
+                return gather_from(y) if self.gather_output else y
+
+            return run_op("column_parallel_linear_manual", f, *args)
         x = _on_mesh(x)
         y = F.linear(x, self.weight, self.bias)
         spec = [None] * y.ndim
@@ -229,6 +342,31 @@ class RowParallelLinear(Layer):
         return P(*([None] * ndim))
 
     def forward(self, x):
+        ax = _MANUAL_MP[0]
+        if ax is not None:
+            # manual mode: local input-shard matmul produces partial sums;
+            # reduce_from is the reference's mp_allreduce_sum, bias added
+            # after the reduce (replicated)
+            copy_to, reduce_from, _ = manual_tp_fns(ax)
+            args = [x, self.weight] + ([self.bias] if self.bias is not None
+                                       else [])
+
+            def f(xv, wv, *rest):
+                if not self.input_is_parallel:
+                    # replicated input: each shard consumes its slice of
+                    # the input feature dim (the reference scatters first);
+                    # copy_to makes the backward psum the per-shard
+                    # zero-padded cotangents back into the full dx
+                    k = wv.shape[0]
+                    xv = jax.lax.dynamic_slice_in_dim(
+                        copy_to(xv), jax.lax.axis_index(ax) * k, k,
+                        axis=xv.ndim - 1)
+                y = reduce_from(xv @ wv)
+                if rest:
+                    y = y + rest[0]
+                return y
+
+            return run_op("row_parallel_linear_manual", f, *args)
         if self.input_is_parallel:
             spec = [None] * x.ndim
             spec[-1] = "mp"

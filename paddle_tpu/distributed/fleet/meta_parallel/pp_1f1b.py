@@ -38,6 +38,18 @@ inter-chunk activations must share one shape/dtype (the reference's P2P
 meta handshake makes the same assumption per segment boundary), buffers
 (e.g. BN running stats) are read-only inside the compiled program, and the
 global batch must divide evenly into micro-batches.
+
+Tensor-parallel composition (BASELINE config 4, TP+PP+DP in one step): when
+the mesh carries an ``mp`` axis, the whole program stays manual and the
+parallel layers switch to their Megatron manual-TP forwards
+(``mp_layers.manual_mp``): local-shard matmuls plus explicit f/g
+collectives over ``mp``, with mp-sharded params entering/leaving the
+program in their TP layout (``_manual_param_spec``). GSPMD-auto collectives
+cannot ride inside the ``lax.switch`` stage dispatch — only the selected
+stage's devices would execute them (deadlock) — which is why TP here is
+manual, exactly like the reference's own mp_layers. Proven on the flagship:
+``models.llama_pipe`` parity-tests LLaMA (tied embeddings, TP decoder
+blocks, causal-LM loss) on a pp x mp x dp mesh (tests/test_pp_1f1b.py).
 """
 
 from __future__ import annotations
@@ -94,7 +106,31 @@ class OneFOneBEngine:
                 "1F1B schedule needs PipelineLayer(loss_fn=...): the last "
                 "chunk must emit a scalar loss to seed the backward ring")
         self._params, self._buffers = _unique_params(pipeline_layer)
+        # manual tensor-parallel mode: active when the mesh carries a
+        # non-trivial 'mp' axis — the parallel layers then run their
+        # local-shard forwards inside the compiled schedule
+        self._mp_axis = ("mp" if "mp" in mesh.axis_names
+                         and int(mesh.shape["mp"]) > 1 else None)
         self._cache: Dict[Any, Callable] = {}
+
+    def _manual_param_spec(self, v) -> P:
+        """The in/out spec a parameter keeps inside the manual program:
+        its 'mp' (TP) placement survives — devices hold only their TP
+        shard — while pp/dp/sharding placements are dropped to replicated
+        (the schedule needs every stage's weights resident; ZeRO-style
+        resharding stays outside this program)."""
+        from jax.sharding import NamedSharding
+
+        if self._mp_axis is None:
+            return P()
+        sh = getattr(v, "sharding", None)
+        if not isinstance(sh, NamedSharding):
+            return P()
+        spec = tuple(
+            self._mp_axis if e == self._mp_axis or
+            (isinstance(e, tuple) and self._mp_axis in e) else None
+            for e in tuple(sh.spec) + (None,) * (v.ndim - len(tuple(sh.spec))))
+        return P(*spec)
 
     # -- eager-under-trace chunk application (TracedProgram's technique) --
 
@@ -268,7 +304,9 @@ class OneFOneBEngine:
                 [zeros_h] * V,
                 [zeros_h] * V,
                 jnp.zeros((V, S) + tuple(hidden.shape), hidden.dtype),
-                [jnp.zeros(v.shape, v.dtype) for v in pvals0],
+                # zeros_like the TRACED pvals: under manual TP these are
+                # the device-local shards, not the global arrays
+                [jnp.zeros_like(v) for v in pvals],
                 jnp.float32(0.0),
             )
             (fi, bi, st, gacc, lacc), _ = lax.scan(
@@ -281,12 +319,23 @@ class OneFOneBEngine:
             return loss, grads
 
         # data enters as (M, rows, ...): micro-batch index leading, rows
-        # (the per-micro batch dim) sharded over dp when present
+        # (the per-micro batch dim) sharded over dp when present.
+        #
+        # TP composition (BASELINE config 4's TP+PP in ONE program): the
+        # shard_map is manual over EVERY mesh axis — GSPMD-auto collectives
+        # cannot live inside the lax.switch stage dispatch (only the
+        # matching stage's devices would execute them: rendezvous deadlock).
+        # Instead the parallel layers switch to Megatron-style manual-TP
+        # forwards (mp_layers.manual_mp): local-shard matmuls plus explicit
+        # f/g collectives over 'mp'. Each mp-sharded parameter enters with
+        # its 'mp' spec (kept from its NamedSharding) so devices hold only
+        # their TP shard; grads leave with the same layout.
         data_spec = P(None, dp)
+        pspecs = [self._manual_param_spec(v) for v in pvals0]
         mapped = jax.shard_map(
             program, mesh=mesh,
-            in_specs=(P(), P(), data_spec, data_spec, P()),
-            out_specs=(P(), P()),
+            in_specs=(pspecs, P(), data_spec, data_spec, P()),
+            out_specs=(P(), pspecs),
             check_vma=False,
         )
 
@@ -320,11 +369,19 @@ class OneFOneBEngine:
         # mixed device assignments)
         from jax.sharding import NamedSharding
 
+        from .parallel_layers import mp_layers as _mpl
+
         rep = NamedSharding(self._mesh, P())
         xv = jax.device_put(x._value, rep)
         yv = jax.device_put(y._value, rep)
         kd = jax.device_put(jax.random.key_data(next_key()), rep)
-        loss, grads = fn(pvals, bvals, xv, yv, kd)
+        # manual-TP trace context: the first call traces the program; the
+        # parallel layers must take their local-shard forwards there
+        with _mpl.manual_mp(self._mp_axis):
+            loss, grads = fn(pvals, bvals, xv, yv, kd)
+        from ....ops.dispatch import note_dispatch
+
+        note_dispatch(loss)  # Stream/Event.query honesty (see dispatch.py)
         for p, g in zip(self._params, grads):
             g = g.astype(p._value.dtype) if g.dtype != p._value.dtype else g
             if p.grad is None:

@@ -1,0 +1,218 @@
+"""LLaMA as a ``PipelineLayer`` — the flagship decoder on the 1F1B path.
+
+Reference counterpart: PaddleNLP's ``LlamaForCausalLMPipe`` (the reference
+declares the decoder as a LayerDesc list — ``LlamaEmbeddingPipe``,
+``LlamaDecoderLayerPipe`` per layer, ``LlamaRMSNormPipe`` + LM head — and
+hands it to ``PipelineLayer`` for stage segmentation; SURVEY.md §2.2 PP row,
+§3.4 config 4). This module is the same declaration built from this
+framework's tensor-parallel layers, so ONE model rides TP (GSPMD over the
+``mp`` axis, via Vocab/Column/RowParallelLinear) and PP (compiled SPMD 1F1B
+over the ``pp`` axis, ``fleet.meta_parallel.pp_1f1b``) in one mesh.
+
+Design notes:
+
+* The inter-stage stream is uniform ``[B, S, H]`` hidden states — tokens
+  enter only at chunk 0 (the 1F1B engine feeds micro-batches from the data
+  input, not the ring), logits/loss leave only at the last chunk.
+* Tied embeddings are a ``SharedLayerDesc``: the head occurrence reuses the
+  embedding weight as ``x @ W^T`` (forward_func); both gradient
+  contributions accumulate into the one shared parameter — no explicit
+  tied-grad allreduce (pp_layers.py docstring).
+* TP composes through GSPMD: the parallel layers only constrain layouts, so
+  the same descs run dense (mp=1) or tensor-parallel (mp>1) — including
+  inside the 1F1B program, whose shard_map is manual over ``pp`` (+``dp``)
+  only and leaves ``mp`` to GSPMD (``axis_names`` partial-manual).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from .. import nn
+from ..distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    LayerDesc,
+    PipelineLayer,
+    RowParallelLinear,
+    SharedLayerDesc,
+    VocabParallelEmbedding,
+)
+from .llama import LlamaConfig
+
+__all__ = ["LlamaEmbeddingPipe", "LlamaDecoderLayerPipe", "LlamaHeadPipe",
+           "llama_pipe_descs", "build_llama_pipe", "causal_lm_loss"]
+
+
+class LlamaEmbeddingPipe(Layer):
+    """Token embedding stage: [B, S] int tokens -> [B, S, H] hidden."""
+
+    def __init__(self, vocab_size: int, hidden_size: int):
+        super().__init__()
+        self.embed = VocabParallelEmbedding(vocab_size, hidden_size)
+
+    def forward(self, tokens):
+        return self.embed(tokens)
+
+
+def _rope(x, theta: float):
+    """Rotary embedding over [B, S, N, D] with paddle ops (tape-traceable
+    for the eager grad-accumulation parity path)."""
+    import numpy as np
+
+    b, s, n, d = x.shape
+    half = d // 2
+    # host-computed angle table: positions/frequencies are static per shape
+    inv = np.power(float(theta), -np.arange(0, half, dtype=np.float32) / half)
+    ang = np.outer(np.arange(s, dtype=np.float32), inv)  # [S, half]
+    import paddle_tpu as paddle
+
+    cos = paddle.to_tensor(np.cos(ang)[None, :, None, :])  # [1,S,1,half]
+    sin = paddle.to_tensor(np.sin(ang)[None, :, None, :])
+    x1 = x[:, :, :, :half]
+    x2 = x[:, :, :, half:]
+    return paddle.concat([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+class LlamaDecoderLayerPipe(Layer):
+    """One decoder block, uniform [B, S, H] -> [B, S, H].
+
+    Attention + SwiGLU MLP built from Column/RowParallelLinear so the block
+    is Megatron-TP under a mesh with ``mp`` and plain dense without one.
+    """
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.cfg = cfg
+        self.input_norm = nn.RMSNorm(h, epsilon=cfg.rms_eps)
+        # separate q/k/v and gate/up projections: a packed [3H] (or [2I])
+        # output dim would interleave q/k/v inside one contiguous mp shard
+        # under manual TP — separate weights keep every shard a clean
+        # heads-subset (the reference's mp_layers partition the same way)
+        self.wq = ColumnParallelLinear(h, h, has_bias=False,
+                                       gather_output=False)
+        self.wk = ColumnParallelLinear(h, h, has_bias=False,
+                                       gather_output=False)
+        self.wv = ColumnParallelLinear(h, h, has_bias=False,
+                                       gather_output=False)
+        self.o_proj = RowParallelLinear(h, h, has_bias=False,
+                                        input_is_parallel=True)
+        self.post_norm = nn.RMSNorm(h, epsilon=cfg.rms_eps)
+        i = cfg.intermediate_size
+        self.gate = ColumnParallelLinear(h, i, has_bias=False,
+                                         gather_output=False)
+        self.up = ColumnParallelLinear(h, i, has_bias=False,
+                                       gather_output=False)
+        self.down = RowParallelLinear(i, h, has_bias=False,
+                                      input_is_parallel=True)
+
+    def forward(self, x):
+        cfg = self.cfg
+        b, s, h = x.shape
+        d = cfg.head_dim
+        res = x
+        y = self.input_norm(x)
+        # [-1] head count: global heads under GSPMD, the local heads-subset
+        # under manual TP (shards carry out_dim/mp columns)
+        q = _rope(self.wq(y).reshape([b, s, -1, d]), cfg.rope_theta)
+        k = _rope(self.wk(y).reshape([b, s, -1, d]), cfg.rope_theta)
+        v = self.wv(y).reshape([b, s, -1, d])
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        x = res + self.o_proj(attn.reshape([b, s, -1]))
+        res = x
+        y = self.post_norm(x)
+        x = res + self.down(F.silu(self.gate(y)) * self.up(y))
+        return x
+
+
+class LlamaHeadPipe(Layer):
+    """Final RMSNorm + (untied) LM head: [B, S, H] -> [B, S, V] logits."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_eps)
+        self.head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size,
+                                         has_bias=False, gather_output=True)
+
+    def forward(self, x):
+        return self.head(self.norm(x))
+
+
+class _NormOnly(Layer):
+    """Final RMSNorm stage used when the head is the tied embedding."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_eps)
+
+    def forward(self, x):
+        return self.norm(x)
+
+
+def _tied_head_forward(embed_pipe: LlamaEmbeddingPipe, x):
+    """SharedLayerDesc forward_func: reuse the embedding table as the LM
+    head (logits = x @ W^T). W is [V, H] sharded P('mp', None): under GSPMD
+    the logits' vocab dim comes out mp-sharded like a column-parallel head;
+    under manual TP (inside the 1F1B program) the local vocab-slice logits
+    are all-gathered for the loss."""
+    import paddle_tpu as paddle
+    from ..distributed.fleet.meta_parallel.parallel_layers import (
+        mp_layers as _mpl,
+    )
+
+    ax = _mpl._MANUAL_MP[0]
+    if ax is not None:
+        from ..ops.dispatch import run_op
+
+        copy_to, _, gather_from = _mpl.manual_tp_fns(ax)
+
+        def f(xv, wv):
+            return gather_from(copy_to(xv) @ wv.T)
+
+        return run_op("tied_lm_head_manual", f, x, embed_pipe.embed.weight)
+    return paddle.matmul(x, embed_pipe.embed.weight, transpose_y=True)
+
+
+def causal_lm_loss(logits, labels):
+    """Next-token cross entropy (labels are already the shifted targets)."""
+    v = logits.shape[-1]
+    return F.cross_entropy(logits.reshape([-1, v]),
+                           labels.reshape([-1, 1]))
+
+
+def llama_pipe_descs(cfg: LlamaConfig, tie_embeddings: bool = True):
+    """The LayerDesc list (the reference's ``LlamaForCausalLMPipe``
+    declaration) — feed to ``PipelineLayer`` with
+    ``seg_method='layer:LlamaDecoderLayerPipe'``."""
+    descs = []
+    if tie_embeddings:
+        descs.append(SharedLayerDesc(
+            "embed", LlamaEmbeddingPipe, None, "weight",
+            cfg.vocab_size, cfg.hidden_size))
+    else:
+        descs.append(LayerDesc(LlamaEmbeddingPipe, cfg.vocab_size,
+                               cfg.hidden_size))
+    for _ in range(cfg.num_layers):
+        descs.append(LayerDesc(LlamaDecoderLayerPipe, cfg))
+    if tie_embeddings:
+        descs.append(LayerDesc(_NormOnly, cfg))
+        descs.append(SharedLayerDesc(
+            "embed", LlamaEmbeddingPipe, _tied_head_forward, "weight",
+            cfg.vocab_size, cfg.hidden_size))
+    else:
+        descs.append(LayerDesc(LlamaHeadPipe, cfg))
+    return descs
+
+
+def build_llama_pipe(cfg: LlamaConfig, num_stages: Optional[int] = None,
+                     tie_embeddings: bool = True,
+                     num_virtual_pipeline_stages: int = 1) -> PipelineLayer:
+    """LLaMA as a PipelineLayer with loss_fn attached (1F1B-ready)."""
+    return PipelineLayer(
+        layers=llama_pipe_descs(cfg, tie_embeddings),
+        num_stages=num_stages,
+        loss_fn=causal_lm_loss,
+        seg_method="layer:LlamaDecoderLayerPipe",
+        num_virtual_pipeline_stages=num_virtual_pipeline_stages)

@@ -925,18 +925,21 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
     """Softmax cross-entropy (reference: ``c_softmax_with_cross_entropy`` CPU/GPU
     kernels + ``python/paddle/nn/functional/loss.py``)."""
 
-    def f(logits, *rest):
+    # label rides run_op as a real operand (not a closure capture) so the
+    # dispatcher's device-set harmonization lifts it onto the logits' mesh
+    # when they disagree (single-device labels vs mesh-sharded logits)
+    def f(logits, lab0, *rest):
         logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
             jnp.clip(logits, 1e-30, None)
         )
         if soft_label:
-            lab = label._value
+            lab = lab0
             if label_smoothing > 0:
                 k = logits.shape[axis]
                 lab = (1 - label_smoothing) * lab + label_smoothing / k
             loss = -jnp.sum(lab * logp, axis=axis)
         else:
-            lab = label._value
+            lab = lab0
             if lab.ndim == logp.ndim:
                 lab = jnp.squeeze(lab, axis)
             if label_smoothing > 0:
@@ -955,13 +958,16 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                     return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
         if weight is not None:
             w = rest[0]
-            lab_idx = label._value
+            lab_idx = lab0
             if lab_idx.ndim == logp.ndim:
                 lab_idx = jnp.squeeze(lab_idx, axis)
             loss = loss * jnp.take(w, lab_idx)
         return _reduce(loss, reduction)
 
-    args = [input] + ([weight] if weight is not None else [])
+    from ...ops.dispatch import as_tensor_args
+
+    args = [input, *as_tensor_args(label)] + (
+        [weight] if weight is not None else [])
     return run_op("cross_entropy", f, *args)
 
 

@@ -30,20 +30,28 @@ __all__ = ["run_op", "as_tensor_args"]
 
 # last dispatched output array — lets Stream/Event.query() answer
 # completion polls honestly (ADVICE r2) by testing .is_ready() on the most
-# recent async dispatch instead of returning a constant True. One slot, one
-# strong ref; replaced on every eager op AND on every compiled-program
-# dispatch (TracedProgram/to_static executes through run_op and records
-# here inline; FusedTrainStep bypasses run_op and calls note_dispatch — all
-# outputs of one XLA execution complete together, so any one output stands
-# for the program). Never set while tracing.
-_LAST_DISPATCHED = [None]
+# recent async dispatch instead of returning a constant True. One slot
+# holding a WEAK reference (a strong ref would pin a possibly-huge output
+# buffer in device memory until the next dispatch; a collected/donated
+# buffer counts as "done"); replaced on every eager op AND on every
+# compiled-program dispatch (TracedProgram/to_static executes through
+# run_op and records here inline; FusedTrainStep and the 1F1B engine
+# bypass run_op and call note_dispatch — all outputs of one XLA execution
+# complete together, so any one output stands for the program). Never set
+# while tracing.
+_LAST_DISPATCHED = [None]  # weakref.ref | None
 
 
 def note_dispatch(arr) -> None:
     """Record ``arr`` as the most recently dispatched device value (called
     by the jitted-program paths; the eager path records inline)."""
     if arr is not None and not _is_tracer(arr):
-        _LAST_DISPATCHED[0] = arr
+        import weakref
+
+        try:
+            _LAST_DISPATCHED[0] = weakref.ref(arr)
+        except TypeError:  # non-weakref-able value: skip rather than pin
+            _LAST_DISPATCHED[0] = None
 
 
 def _is_tracer(x) -> bool:
@@ -259,8 +267,8 @@ def _wrap(name, out, record, n_diff_outputs):
             out_avals=[(o.shape, o.dtype) for o in outs[:n_diff]],
         )
 
-    if outs and not _is_tracer(outs[0]):
-        _LAST_DISPATCHED[0] = outs[0]
+    if outs:
+        note_dispatch(outs[0])
     for i, o in enumerate(outs):
         differentiable = record is not None and i < n_diff and is_differentiable_dtype(o.dtype)
         t = Tensor(o, stop_gradient=not differentiable, name=f"{name}.out")

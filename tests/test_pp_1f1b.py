@@ -136,6 +136,96 @@ class Test1F1BParity:
         assert "collective-permute" in hlo, (
             "1F1B activation transfer must compile to collective-permute")
 
+    def test_llama_pipe_parity_pp_mp_dp(self):
+        """Flagship-shaped 1F1B (VERDICT r2 item 3): LLaMA as a
+        PipelineLayer with tied embeddings, TP decoder blocks, and the
+        causal-LM loss — pp=2 x mp=2 x dp=2 in ONE mesh. The compiled
+        schedule runs manual Megatron TP (local-shard matmuls + f/g
+        collectives) inside the pp ring; parity vs the eager
+        grad-accumulation path covers loss AND every parameter gradient,
+        including the shared embedding (grad contributions from both the
+        embed and the LM-head use)."""
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.models.llama_pipe import build_llama_pipe
+
+        mesh = create_hybrid_mesh(pp=2, mp=2, dp=2)
+        try:
+            paddle.seed(0)
+            cfg = LlamaConfig.tiny(num_layers=4)
+            pl = build_llama_pipe(cfg, num_stages=2)
+            strategy = DistributedStrategy()
+            strategy.pipeline_configs = {"accumulate_steps": 4}
+            pp = PipelineParallel(pl, None, strategy)
+
+            rng = np.random.RandomState(0)
+            x = paddle.to_tensor(
+                rng.randint(0, cfg.vocab_size, (8, 16)).astype("int64"))
+            y = paddle.to_tensor(
+                rng.randint(0, cfg.vocab_size, (8, 16)).astype("int64"))
+
+            loss_ref = pp.train_batch((x, y))
+            g_ref = _grads(pl)
+            for p in pl.parameters():
+                p.clear_grad()
+
+            loss_1f1b = pp.train_batch((x, y), schedule="1f1b")
+            g_new = _grads(pl)
+
+            np.testing.assert_allclose(loss_1f1b.numpy(), loss_ref.numpy(),
+                                       rtol=2e-5, atol=1e-6)
+            assert len(g_ref) == len(g_new) and len(g_ref) > 10
+            for a, b in zip(g_ref, g_new):
+                assert (a is None) == (b is None)
+                if a is not None:
+                    np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
+
+            # the mp-sharded weights keep their TP layout on the grads
+            from jax.sharding import NamedSharding
+
+            qw = pl.run_functions[1].wq.weight
+            assert isinstance(qw.grad._value.sharding, NamedSharding)
+            assert "mp" in str(qw.grad._value.sharding.spec)
+        finally:
+            set_mesh(None)
+
+    def test_llama_pipe_parity_virtual_stages(self):
+        """Interleaved virtual stages on the transformer: 4 chunks over
+        pp=2 (virtual_pp_degree=2), tied embeddings crossing the ring
+        wrap."""
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.models.llama_pipe import build_llama_pipe
+
+        mesh = create_hybrid_mesh(pp=2, mp=2, dp=2)
+        try:
+            paddle.seed(3)
+            cfg = LlamaConfig.tiny(num_layers=4)
+            pl = build_llama_pipe(cfg, num_stages=2,
+                                  num_virtual_pipeline_stages=2)
+            strategy = DistributedStrategy()
+            strategy.pipeline_configs = {"accumulate_steps": 4}
+            pp = PipelineParallel(pl, None, strategy)
+
+            rng = np.random.RandomState(5)
+            x = paddle.to_tensor(
+                rng.randint(0, cfg.vocab_size, (8, 16)).astype("int64"))
+            y = paddle.to_tensor(
+                rng.randint(0, cfg.vocab_size, (8, 16)).astype("int64"))
+
+            loss_ref = pp.train_batch((x, y))
+            g_ref = _grads(pl)
+            for p in pl.parameters():
+                p.clear_grad()
+            loss_1f1b = pp.train_batch((x, y), schedule="1f1b")
+            g_new = _grads(pl)
+
+            np.testing.assert_allclose(loss_1f1b.numpy(), loss_ref.numpy(),
+                                       rtol=2e-5, atol=1e-6)
+            for a, b in zip(g_ref, g_new):
+                if a is not None:
+                    np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
+        finally:
+            set_mesh(None)
+
     def test_uneven_batch_rejected(self, pp4_mesh):
         pp, pl = _build_pp(num_stages=4, n_layers=8, seed=4)
         x = paddle.to_tensor(np.random.randn(6, 8).astype("float32"))
