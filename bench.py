@@ -75,10 +75,11 @@ def run(batch: int, seq: int):
 
 def main():
     best = 0.0
-    # 48 is the measured sweet spot on v5e (b64 fails to compile, b32 ~2%
-    # behind, b16 ~4% behind); 32/16 are fallback brackets, 8/4 OOM-only
-    for batch in (48, 32, 16, 8, 4):
-        if best and batch <= 32:
+    # 44 is the measured sweet spot on v5e after the r3 CE/logits-slice
+    # work (b48 -0.7%, b42/b46 -0.3/-1.2%, b64 compiles but -4%); 48/32/16
+    # are fallback brackets, 8/4 OOM-only
+    for batch in (44, 48, 32, 16, 8, 4):
+        if best and batch <= 48:
             break
         # the tunneled compile service occasionally drops a request
         # (INTERNAL: remote_compile ... response body closed) — retry each

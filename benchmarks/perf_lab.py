@@ -1,0 +1,57 @@
+"""Perf lab: measure train-step variants on the real chip (bench.py's
+methodology — best of 3x20 chained iterations, scalar-only fetches).
+
+Usage: python benchmarks/perf_lab.py key=value ...  (cfg overrides)
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def measure(cfg_overrides, batch=48, seq=512, tag=""):
+    from paddle_tpu.models import llama
+    from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
+
+    cfg = llama.LlamaConfig.bert_base_equiv(max_seq_len=seq, **cfg_overrides)
+    mesh = create_hybrid_mesh(devices=jax.devices()[:1])
+    params = llama.init_params(cfg)
+    opt_state = llama.init_opt_state(params)
+    rng = np.random.RandomState(0)
+    tokens = jnp.array(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    step = llama.make_sharded_train_step(cfg, mesh, lr=1e-4)
+    params, opt_state, loss = step(params, opt_state, tokens, tokens)
+    l0 = float(loss)
+    params, opt_state, loss = step(params, opt_state, tokens, tokens)
+    float(loss)
+    iters = 20
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, loss = step(params, opt_state, tokens, tokens)
+        float(loss)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    set_mesh(None)
+    tps = iters * batch * seq / best
+    print(f"[{tag or cfg_overrides}] {tps:,.0f} tok/s, "
+          f"step {best/iters*1e3:.1f} ms, warm loss {l0:.4f}", flush=True)
+    return tps
+
+
+if __name__ == "__main__":
+    ov = {}
+    for a in sys.argv[1:]:
+        k, v = a.split("=", 1)
+        try:
+            v = int(v)
+        except ValueError:
+            v = {"True": True, "False": False}.get(v, v)
+        ov[k] = v
+    measure(ov)

@@ -128,7 +128,11 @@ def _array_ready(ref) -> bool:
     if ref is None:
         return True
     arr = ref() if callable(ref) else ref
-    if arr is None:  # buffer already collected: the work is long done
+    if arr is None:
+        # buffer object was garbage-collected: completion is UNKNOWABLE
+        # (the dispatched computation may still be running) — report done
+        # because no handle remains to poll; holding a strong ref instead
+        # would pin arbitrarily large buffers in device memory
         return True
     try:
         return bool(arr.is_ready())

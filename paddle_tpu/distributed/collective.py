@@ -491,9 +491,13 @@ def recv(tensor, src=0, group=None, sync_op=True):
     payload, per the reference's p2p_communication convention.
 
     The received payload is ALSO bound back onto ``tensor`` (when it is a
-    framework Tensor), so reference-style code that reads the original
-    recv buffer after ``wait()`` sees the peer's data, not its own
-    outgoing payload."""
+    framework Tensor that is a LEAF — a dedicated recv buffer), so
+    reference-style code that reads the original recv buffer after
+    ``wait()`` sees the peer's data, not its own outgoing payload.
+    Exception: a NON-LEAF tensor (an activation with a live autograd node)
+    cannot be overwritten without corrupting its tape — for those the
+    received payload is ONLY in the returned Tensor; use the return
+    value."""
     _warn_absolute_rank_p2p("recv", src, group)
     g = group or get_default_group()
     if g.nranks == 1:

@@ -349,7 +349,15 @@ def _nll_sum(logits, targets, weights) -> jax.Array:
     logits: casting the whole [.., V] tensor first would materialise fp32
     holding bf16-precision values — pure HBM traffic for zero accuracy
     (the matmul already rounded to bf16)."""
-    m = jnp.max(logits, axis=-1).astype(jnp.float32)
+    # stop_gradient on the max: lse's gradient (softmax) is exact for any
+    # constant shift, and differentiating through jnp.max would cost an
+    # extra [.., V] equality-mask pass plus an add_any combine in the bwd
+    # (measured ~4.5 ms/step at the bench shape). Hand-written VJPs LOSE
+    # here: an iota-onehot custom backward is +1.6 ms (the mask pass
+    # outweighs the saved cotangent combine), scatter-based backwards are
+    # +21..+50 ms (TPU scatters serialize). Autodiff of this exact form is
+    # the measured optimum.
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1).astype(jnp.float32))
     sumexp = jnp.sum(
         jnp.exp(logits.astype(jnp.float32) - m[..., None]), axis=-1)
     gold = jnp.take_along_axis(
@@ -396,8 +404,11 @@ def loss_fn(params, tokens, labels, cfg: LlamaConfig) -> jax.Array:
         for i in range(nc):
             total = total + body(hc[i], tc[i])
         return total / (B * (S - 1))
-    logits = wsc(h @ params["lm_head"].astype(dt),
-                 P(("dp", "sharding"), None, "mp"))[:, :-1]
+    # slice h BEFORE the head matmul: slicing the [B,S,V] product instead
+    # would materialise a second ~1.5 GB logits copy (the last position
+    # has no next-token label and needn't be scored at all)
+    logits = wsc(h[:, :-1] @ params["lm_head"].astype(dt),
+                 P(("dp", "sharding"), None, "mp"))
     targets = labels[:, 1:]
     return _nll_sum(logits, targets, jnp.float32(1.0)) / (B * (S - 1))
 

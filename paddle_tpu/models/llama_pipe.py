@@ -18,10 +18,14 @@ Design notes:
   embedding weight as ``x @ W^T`` (forward_func); both gradient
   contributions accumulate into the one shared parameter — no explicit
   tied-grad allreduce (pp_layers.py docstring).
-* TP composes through GSPMD: the parallel layers only constrain layouts, so
-  the same descs run dense (mp=1) or tensor-parallel (mp>1) — including
-  inside the 1F1B program, whose shard_map is manual over ``pp`` (+``dp``)
-  only and leaves ``mp`` to GSPMD (``axis_names`` partial-manual).
+* TP composition is DUAL-MODE: in eager/GSPMD execution the parallel
+  layers only constrain layouts, so the same descs run dense (mp=1) or
+  tensor-parallel (mp>1). Inside the compiled 1F1B program the shard_map
+  is manual over EVERY axis (GSPMD collectives deadlock inside the
+  lax.switch stage dispatch — see pp_1f1b.py), so the layers switch to
+  their Megatron manual-TP forwards (``mp_layers.manual_mp``: local-shard
+  matmuls + explicit f/g collectives over ``mp``). Any NEW layer used in a
+  pipeline chunk must either be mp-free or implement the manual mode.
 """
 
 from __future__ import annotations
@@ -56,20 +60,37 @@ class LlamaEmbeddingPipe(Layer):
         return self.embed(tokens)
 
 
+_ROPE_TABLES: dict = {}
+
+
+def _rope_tables(s: int, half: int, theta: float):
+    """cos/sin angle tables, cached per (seq, half, theta): the eager
+    parity path calls every layer's forward per micro-batch — rebuilding
+    the host table and re-transferring it each time is pure waste."""
+    import numpy as np
+
+    key = (s, half, float(theta))
+    hit = _ROPE_TABLES.get(key)
+    if hit is None:
+        import paddle_tpu as paddle
+
+        inv = np.power(float(theta),
+                       -np.arange(0, half, dtype=np.float32) / half)
+        ang = np.outer(np.arange(s, dtype=np.float32), inv)  # [S, half]
+        hit = (paddle.to_tensor(np.cos(ang)[None, :, None, :]),
+               paddle.to_tensor(np.sin(ang)[None, :, None, :]))
+        _ROPE_TABLES[key] = hit
+    return hit
+
+
 def _rope(x, theta: float):
     """Rotary embedding over [B, S, N, D] with paddle ops (tape-traceable
     for the eager grad-accumulation parity path)."""
-    import numpy as np
+    import paddle_tpu as paddle
 
     b, s, n, d = x.shape
     half = d // 2
-    # host-computed angle table: positions/frequencies are static per shape
-    inv = np.power(float(theta), -np.arange(0, half, dtype=np.float32) / half)
-    ang = np.outer(np.arange(s, dtype=np.float32), inv)  # [S, half]
-    import paddle_tpu as paddle
-
-    cos = paddle.to_tensor(np.cos(ang)[None, :, None, :])  # [1,S,1,half]
-    sin = paddle.to_tensor(np.sin(ang)[None, :, None, :])
+    cos, sin = _rope_tables(s, half, theta)  # [1,S,1,half] each
     x1 = x[:, :, :, :half]
     x2 = x[:, :, :, half:]
     return paddle.concat([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)

@@ -951,11 +951,14 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                 loss = -jnp.take_along_axis(
                     logp, jnp.expand_dims(lab, axis), axis=axis
                 ).squeeze(axis)
-            if ignore_index >= 0:
-                mask = lab != ignore_index
-                loss = jnp.where(mask, loss, 0.0)
-                if reduction == "mean":
-                    return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
+            # the reference masks label == ignore_index regardless of sign
+            # (the default -100 is the common padding sentinel); guarding
+            # on ignore_index >= 0 silently scored padding rows via
+            # negative-index wraparound
+            mask = lab != ignore_index
+            loss = jnp.where(mask, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
         if weight is not None:
             w = rest[0]
             lab_idx = lab0

@@ -592,6 +592,8 @@ def _pallas_flash_fwd_packed(q, k, v, is_causal, scale=None):
     kf = k.reshape(b, S, hd)
     vf = v.reshape(b, S, hd)
     blk = pl.BlockSpec((1, S, hp * d), lambda bb, g: (bb, 0, g))
+    from jax.experimental.pallas import tpu as pltpu
+
     out, lse = pl.pallas_call(
         _make_packed_fwd(S, d, hp, is_causal),
         grid=(b, G),
@@ -600,6 +602,8 @@ def _pallas_flash_fwd_packed(q, k, v, is_causal, scale=None):
                                      lambda bb, g: (bb, g, 0, 0))],
         out_shape=[jax.ShapeDtypeStruct((b, S, hd), q.dtype),
                    jax.ShapeDtypeStruct((b, G, hp, S), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
     )(qf, kf, vf)
     return out.reshape(b, S, h, d), lse
 
@@ -621,6 +625,8 @@ def _pallas_flash_bwd_packed(q, k, v, do, out, lse, is_causal, scale=None):
     of = out.reshape(b, S, hd)
     blk = pl.BlockSpec((1, S, hp * d), lambda bb, g: (bb, 0, g))
     lse_blk = pl.BlockSpec((1, 1, hp, S), lambda bb, g: (bb, g, 0, 0))
+    from jax.experimental.pallas import tpu as pltpu
+
     dq, dk, dv = pl.pallas_call(
         _make_packed_bwd(S, d, hp, is_causal, scale),
         grid=(b, G),
@@ -629,6 +635,8 @@ def _pallas_flash_bwd_packed(q, k, v, do, out, lse, is_causal, scale=None):
         out_shape=[jax.ShapeDtypeStruct((b, S, hd), q.dtype),
                    jax.ShapeDtypeStruct((b, S, hd), k.dtype),
                    jax.ShapeDtypeStruct((b, S, hd), v.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
     )(qf, kf, vf, dof, of, lse)
     r4 = lambda x: x.reshape(b, S, h, d)
     return r4(dq), r4(dk), r4(dv)

@@ -7,26 +7,35 @@ our XLA host-platform multi-device trick. Must run before jax initializes.
 
 import os
 
-# The dev machine pins JAX_PLATFORMS=axon (TPU via the axon PJRT plugin) and
-# /root/.axon_site/sitecustomize.py imports jax at interpreter startup — so
-# env vars alone are too late. jax is imported but its backends are not yet
-# initialized when conftest loads, so runtime config updates still work.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# PADDLE_TPU_TEST_LANE=1 (set by benchmarks/tpu_test_lane.py) keeps the
+# REAL TPU backend so the pallas-kernel tests run on the chip and their
+# results can be recorded as a per-round artifact (TPU_TESTS_r<N>.json).
+_TPU_LANE = os.environ.get("PADDLE_TPU_TEST_LANE") == "1"
+
+if not _TPU_LANE:
+    # The dev machine pins JAX_PLATFORMS=axon (TPU via the axon PJRT
+    # plugin) and /root/.axon_site/sitecustomize.py imports jax at
+    # interpreter startup — so env vars alone are too late. jax is imported
+    # but its backends are not yet initialized when conftest loads, so
+    # runtime config updates still work.
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-assert jax.devices()[0].platform == "cpu", (
-    "tests must run on the virtual CPU platform; jax was initialized on "
-    f"{jax.devices()[0].platform} before conftest could redirect it"
-)
-assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for distributed tests"
+if not _TPU_LANE:
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.devices()[0].platform == "cpu", (
+        "tests must run on the virtual CPU platform; jax was initialized on "
+        f"{jax.devices()[0].platform} before conftest could redirect it"
+    )
+    assert len(jax.devices()) == 8, \
+        "expected 8 virtual CPU devices for distributed tests"
 
 import numpy as np
 import pytest

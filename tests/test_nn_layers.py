@@ -210,6 +210,13 @@ def test_cross_entropy_ignore_index_and_smoothing():
     full = float(F.cross_entropy(logits, labels3).item())
     assert np.isfinite(full)
 
+    # the DEFAULT ignore_index=-100 (negative padding sentinel) must mask:
+    # the mean over [a, b, PAD, c] equals the mean over [a, b, c]
+    pad = paddle.to_tensor([0, 1, -100, 2])
+    got = float(F.cross_entropy(logits, pad).item())
+    want = float(np.mean([l_ref[0], l_ref[1], l_ref[3]]))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
 
 def test_rnn_gru_shapes_and_grads():
     gru = nn.GRU(4, 8)
