@@ -18,7 +18,8 @@ __all__ = [
     "cholesky", "inv", "det", "slogdet", "svd", "qr", "eigh", "eigvalsh",
     "eig", "eigvals", "matrix_exp", "matrix_power", "matrix_rank", "pinv",
     "solve",
-    "triangular_solve", "cholesky_solve", "lstsq", "lu", "cond", "cov",
+    "triangular_solve", "cholesky_solve", "lstsq", "lu", "lu_unpack",
+    "cond", "cov",
     "corrcoef", "householder_product", "multi_dot", "norm",
 ]
 
@@ -143,6 +144,52 @@ def lu(x, pivot=True, get_infos=False, name=None):
     if get_infos:
         return to_tensor(lu_), to_tensor(piv.astype(jnp.int32)), to_tensor(jnp.zeros((), jnp.int32))
     return to_tensor(lu_), to_tensor(piv.astype(jnp.int32))
+
+
+@register_op(differentiable=False)
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack the packed LU factorization from ``paddle.lu`` into
+    (P, L, U) with A = P @ L @ U (reference: ``paddle.linalg.lu_unpack``).
+
+    The sequential-swap pivot vector (LAPACK getrf convention: row i was
+    interchanged with row piv[i]) is replayed with a ``lax.fori_loop`` over
+    an identity permutation — pivot VALUES are runtime data, so the replay
+    uses dynamic `.at[]` updates rather than Python control flow, keeping
+    the op jittable for static shapes."""
+
+    def unpack_one(lu_, piv):
+        m, n = lu_.shape
+        k = min(m, n)
+        l_mat = jnp.tril(lu_[:, :k], -1)
+        diag = jnp.arange(k)
+        l_mat = l_mat.at[diag, diag].set(jnp.ones((k,), lu_.dtype))
+        u_mat = jnp.triu(lu_[:k, :])
+
+        def swap(i, perm):
+            j = piv[i].astype(jnp.int32)
+            pi, pj = perm[i], perm[j]
+            return perm.at[i].set(pj).at[j].set(pi)
+
+        perm = jax.lax.fori_loop(0, piv.shape[0], swap,
+                                 jnp.arange(m, dtype=jnp.int32))
+        # rows perm of A equal L@U, so A = P @ (L U) with P = eye[perm]^T
+        p_mat = jnp.eye(m, dtype=lu_.dtype)[perm].T
+        return p_mat, l_mat, u_mat
+
+    lu_v, piv_v = x._value, y._value
+    if lu_v.ndim == 2:
+        p_mat, l_mat, u_mat = unpack_one(lu_v, piv_v)
+    else:
+        batch = lu_v.shape[:-2]
+        flat_lu = lu_v.reshape((-1,) + lu_v.shape[-2:])
+        flat_piv = piv_v.reshape((-1,) + piv_v.shape[-1:])
+        p_mat, l_mat, u_mat = jax.vmap(unpack_one)(flat_lu, flat_piv)
+        p_mat = p_mat.reshape(batch + p_mat.shape[-2:])
+        l_mat = l_mat.reshape(batch + l_mat.shape[-2:])
+        u_mat = u_mat.reshape(batch + u_mat.shape[-2:])
+    return (to_tensor(p_mat) if unpack_pivots else None,
+            to_tensor(l_mat) if unpack_ludata else None,
+            to_tensor(u_mat) if unpack_ludata else None)
 
 
 @register_op(differentiable=False)

@@ -19,7 +19,7 @@ from .registry import register_op
 
 __all__ = [
     "bincount", "histogram", "histogramdd", "cross", "cdist", "dist",
-    "renorm", "i0", "i0e", "i1", "i1e", "polygamma", "poisson",
+    "pdist", "renorm", "i0", "i0e", "i1", "i1e", "polygamma", "poisson",
 ]
 
 
@@ -91,6 +91,30 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
             return jnp.max(diff, -1)
         return jnp.sum(diff ** p, -1) ** (1.0 / p)
     return run_op("cdist", f, x, y)
+
+
+@register_op()
+def pdist(x, p=2.0, name=None) -> Tensor:
+    """Condensed pairwise distance of the rows of a [N, M] matrix: the
+    N*(N-1)/2 upper-triangle entries of ``cdist(x, x, p)`` in row-major
+    (i < j) order (reference: ``paddle.pdist``). Pair indices are static
+    (N is a trace-time shape), so the gather lowers to one XLA take."""
+    import numpy as np
+
+    def f(a):
+        n = a.shape[0]
+        iu, ju = np.triu_indices(n, k=1)
+        diff = jnp.abs(a[iu] - a[ju])           # [n(n-1)/2, M]
+        pf = float(p)
+        if pf == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, -1))
+        if pf == 0:
+            return jnp.sum(diff != 0, -1).astype(a.dtype)
+        if np.isinf(pf):
+            return jnp.max(diff, -1)
+        return jnp.sum(diff ** pf, -1) ** (1.0 / pf)
+
+    return run_op("pdist", f, x)
 
 
 @register_op()

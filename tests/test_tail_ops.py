@@ -184,3 +184,37 @@ def test_long_tail_additions_round1b():
     assert paddle.get_flags("check_nan_inf")["check_nan_inf"]
     D.disable_tensor_checker()
     assert not paddle.get_flags("check_nan_inf")["check_nan_inf"]
+
+
+def test_pdist_and_lu_unpack():
+    # pdist == condensed upper triangle of cdist(x, x)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((6, 4)).astype(np.float32)
+    got = paddle.pdist(_t(x)).numpy()
+    full = np.sqrt(((x[:, None, :] - x[None, :, :]) ** 2).sum(-1))
+    iu, ju = np.triu_indices(6, k=1)
+    np.testing.assert_allclose(got, full[iu, ju], rtol=1e-5, atol=1e-5)
+    # p=inf and p=1 variants
+    got1 = paddle.pdist(_t(x), p=1.0).numpy()
+    np.testing.assert_allclose(
+        got1, np.abs(x[iu] - x[ju]).sum(-1), rtol=1e-5, atol=1e-5)
+
+    # lu_unpack reconstructs A = P @ L @ U from paddle.lu's packed output
+    a = rng.standard_normal((5, 5)).astype(np.float32)
+    lu_, piv = paddle.linalg.lu(_t(a))
+    p, l, u = paddle.linalg.lu_unpack(lu_, piv)
+    recon = p.numpy() @ l.numpy() @ u.numpy()
+    np.testing.assert_allclose(recon, a, rtol=1e-4, atol=1e-4)
+    # unit lower-diagonal and upper-triangularity
+    assert np.allclose(np.diag(l.numpy()), 1.0)
+    assert np.allclose(np.tril(u.numpy(), -1), 0.0)
+    # batched path
+    ab = rng.standard_normal((3, 4, 4)).astype(np.float32)
+    lub, pivb = paddle.linalg.lu(_t(ab))
+    pb, lb, ub = paddle.linalg.lu_unpack(lub, pivb)
+    np.testing.assert_allclose(pb.numpy() @ lb.numpy() @ ub.numpy(), ab,
+                               rtol=1e-4, atol=1e-4)
+    # unpack flags
+    p_only, l_none, u_none = paddle.linalg.lu_unpack(
+        lu_, piv, unpack_ludata=False)
+    assert l_none is None and u_none is None and p_only is not None
