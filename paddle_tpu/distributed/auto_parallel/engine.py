@@ -40,11 +40,41 @@ from ...parallel.mesh import create_hybrid_mesh, get_mesh, set_mesh
 __all__ = ["Engine"]
 
 
-def _candidate_layouts(n: int) -> List[Dict[str, int]]:
-    """Hybrid degree assignments over ``n`` devices: every (dp, mp) split
-    with both degrees dividing n (the ladder configs' axes; pp/sep join
-    the search the same way when models use them)."""
-    return [{"dp": d, "mp": n // d} for d in range(1, n + 1) if n % d == 0]
+def _candidate_layouts(n: int, axes: Sequence[str] = ("dp", "mp"),
+                       max_trials: int = 16) -> List[Dict[str, int]]:
+    """Hybrid degree assignments over ``n`` devices: every ordered
+    factorization of ``n`` across ``axes`` (each degree ≥ 1, product = n).
+
+    ``axes`` is the set the model honors — any of dp/mp/sharding/pp/sep;
+    axes not listed stay at degree 1. Candidates are ordered simple-first
+    (fewer non-trivial axes, then larger dp) and capped at ``max_trials``:
+    each trial compiles and times a real step, so an unbounded enumeration
+    at high device counts would make the search itself the bottleneck."""
+    axes = list(axes)
+
+    def compositions(rem: int, k: int):
+        if k == 1:
+            yield (rem,)
+            return
+        for d in range(1, rem + 1):
+            if rem % d == 0:
+                for rest in compositions(rem // d, k - 1):
+                    yield (d,) + rest
+
+    cands = [dict(zip(axes, degs)) for degs in compositions(n, len(axes))]
+    cands.sort(key=lambda c: (sum(1 for v in c.values() if v > 1),
+                              -c.get("dp", 1)))
+    if len(cands) > max_trials:
+        import warnings
+
+        warnings.warn(
+            f"auto_parallel.Engine: {len(cands)} candidate layouts over "
+            f"axes {axes}; measuring only the first {max_trials} "
+            f"(simple-first order) — pass explicit `candidates` or raise "
+            f"`max_trials` to widen the search", stacklevel=2)
+        cands = cands[:max_trials]
+    return [{a: d for a, d in c.items() if d > 1} or {"dp": 1}
+            for c in cands]
 
 
 class Engine:
@@ -53,55 +83,80 @@ class Engine:
     ``model_fn(mesh) -> (step_fn, example_args)`` builds the compiled train
     step under a mesh (rebuilt per candidate so parameter shardings follow
     the layout). ``fit`` then runs the chosen layout.
+
+    ``axes`` declares which hybrid axes the model honors (any of
+    dp/mp/sharding/pp/sep — e.g. a PipelineLayer model passes
+    ``axes=("dp", "pp")``); the search enumerates every factorization of
+    the device count across exactly those axes, capped at ``max_trials``.
     """
 
     def __init__(self, model_fn: Callable, strategy=None,
                  candidates: Optional[Sequence[Dict[str, int]]] = None,
-                 warmup_steps: int = 1, measure_steps: int = 3):
+                 warmup_steps: int = 1, measure_steps: int = 3,
+                 axes: Sequence[str] = ("dp", "mp"), max_trials: int = 16):
         self._model_fn = model_fn
         self._strategy = strategy
         self._candidates = list(candidates) if candidates is not None else None
+        self._axes = tuple(axes)
+        self._max_trials = int(max_trials)
         self._warm = max(0, int(warmup_steps))
         self._meas = max(1, int(measure_steps))
         self.best_layout: Optional[Dict[str, int]] = None
         self.measurements: Dict[Tuple[Tuple[str, int], ...], float] = {}
+        self.skipped: Dict[Tuple[Tuple[str, int], ...], str] = {}
         self._prepared = None
 
     # -- the search --------------------------------------------------------
     def prepare(self, devices: Optional[Sequence] = None) -> "Engine":
         devices = list(devices if devices is not None else jax.devices())
         cands = (self._candidates if self._candidates is not None
-                 else _candidate_layouts(len(devices)))
+                 else _candidate_layouts(len(devices), self._axes,
+                                         self._max_trials))
         prev_mesh = get_mesh()
         best, best_dt = None, None
+        errors: Dict[Tuple[Tuple[str, int], ...], str] = {}
         try:
             for layout in cands:
-                mesh = create_hybrid_mesh(devices=devices, **layout)
-                step_fn, args = self._model_fn(mesh)
-                state = list(args)
+                try:
+                    mesh = create_hybrid_mesh(devices=devices, **layout)
+                    step_fn, args = self._model_fn(mesh)
+                    state = list(args)
 
-                def run_once():
-                    # thread new state through (steps donate their buffers)
-                    out = step_fn(*state)
-                    n = len(out) - 1
-                    state[:n] = out[:n]
-                    return out[-1]
+                    def run_once():
+                        # thread new state through (steps donate buffers)
+                        out = step_fn(*state)
+                        n = len(out) - 1
+                        state[:n] = out[:n]
+                        return out[-1]
 
-                loss = run_once()
-                loss.block_until_ready()  # compile + first warm step
-                for _ in range(self._warm):
                     loss = run_once()
-                loss.block_until_ready()
-                t0 = time.perf_counter()
-                for _ in range(self._meas):
-                    loss = run_once()
-                loss.block_until_ready()
-                dt = (time.perf_counter() - t0) / self._meas
+                    loss.block_until_ready()  # compile + first warm step
+                    for _ in range(self._warm):
+                        loss = run_once()
+                    loss.block_until_ready()
+                    t0 = time.perf_counter()
+                    for _ in range(self._meas):
+                        loss = run_once()
+                    loss.block_until_ready()
+                    dt = (time.perf_counter() - t0) / self._meas
+                except Exception as e:  # noqa: BLE001 — an INFEASIBLE
+                    # layout (batch not divisible by dp x micro-batches,
+                    # too few layers for pp stages, OOM at this degree…)
+                    # is a legitimate search outcome, not a search failure:
+                    # record it and keep measuring the others.
+                    errors[tuple(sorted(layout.items()))] = (
+                        f"{type(e).__name__}: {e}")
+                    continue
                 self.measurements[tuple(sorted(layout.items()))] = dt
                 if best_dt is None or dt < best_dt:
                     best, best_dt = layout, dt
         finally:
             set_mesh(prev_mesh)
+        self.skipped = errors
+        if best is None:
+            raise RuntimeError(
+                "auto_parallel.Engine: every candidate layout failed — "
+                + "; ".join(f"{dict(k)}: {v}" for k, v in errors.items()))
         self.best_layout = best
         return self
 
