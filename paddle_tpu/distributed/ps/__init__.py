@@ -24,7 +24,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
-__all__ = ["PsServer", "PsClient", "DenseTable", "SparseTable"]
+__all__ = ["PsServer", "PsClient", "ShardedPsClient", "DenseTable",
+           "SparseTable"]
 
 
 def _send(sock, obj):
@@ -175,7 +176,8 @@ class PsServer:
 
     def _op_table_stats(self):
         return {"dense": sorted(self.dense),
-                "sparse": {k: v.size() for k, v in self.sparse.items()}}
+                "sparse": {k: v.size() for k, v in self.sparse.items()},
+                "sparse_dims": {k: v.dim for k, v in self.sparse.items()}}
 
     def _op_barrier(self, key, world):
         with self._bar_lock:
@@ -266,3 +268,171 @@ class PsClient:
 
     def close(self):
         self._sock.close()
+
+
+class ShardedPsClient:
+    """Trainer-side stub over a *sharded* server fleet (reference: the
+    multi-server half of BrpcPsClient — ``paddle/fluid/distributed/ps/``
+    shards every table across all pserver ranks).
+
+    Partitioning, matching the reference's scheme:
+
+    * **Sparse tables** live on every server; each id is HASH-partitioned
+      (``id % num_servers``) so the embedding corpus splits across server
+      memory. pull/push group ids per server, issue one request per
+      server, and reassemble rows in the caller's id order.
+    * **Dense tables** are ROW-RANGE-partitioned: ``np.array_split`` row
+      blocks, block ``i`` on server ``i`` (servers beyond ``shape[0]``
+      hold an empty block). pull concatenates; push splits the gradient
+      with the same deterministic boundaries, so no shape metadata needs
+      to travel.
+    * ``barrier`` is coordinated by server 0 alone (one counter, as the
+      reference keeps barriers on the fleet's rank-0 brpc channel).
+
+    The method surface mirrors ``PsClient``, so single-server code moves
+    to a sharded fleet by swapping the constructor (or using
+    ``from_env()`` under the launcher's ``--run_mode ps`` contract).
+    """
+
+    def __init__(self, endpoints, timeout=60.0):
+        if isinstance(endpoints, str):
+            endpoints = endpoints.split(",")
+        parsed = []
+        for ep in endpoints:
+            if isinstance(ep, str):
+                host, port = ep.rsplit(":", 1)
+                parsed.append((host, int(port)))
+            else:
+                parsed.append((ep[0], int(ep[1])))
+        if not parsed:
+            raise ValueError("ShardedPsClient needs at least one endpoint")
+        self._clients = [PsClient(h, p, timeout) for h, p in parsed]
+        self._n = len(self._clients)
+        self._sparse_dims: Dict[int, int] = {}
+        # per-shard requests go out CONCURRENTLY (the reference BrpcPsClient
+        # fans out async RPCs): a sequential loop would make every op pay
+        # num_servers x RTT, erasing the point of sharding
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(max_workers=max(2, self._n))
+
+    def _fan_out(self, calls):
+        """Run ``calls`` (zero-arg closures) concurrently; return results
+        in order, re-raising the first failure."""
+        return [f.result() for f in
+                [self._pool.submit(c) for c in calls]]
+
+    @classmethod
+    def from_env(cls, timeout=60.0):
+        """Connect to the fleet the launcher advertised
+        (``PADDLE_PSERVERS_IP_PORT_LIST``, the reference env contract)."""
+        import os
+
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        if not eps:
+            raise RuntimeError(
+                "PADDLE_PSERVERS_IP_PORT_LIST is not set — launch with "
+                "--run_mode ps or pass endpoints explicitly")
+        return cls(eps, timeout=timeout)
+
+    @property
+    def num_servers(self):
+        return self._n
+
+    # --- dense: row-range partition ------------------------------------
+    def _dense_blocks(self, arr):
+        return np.array_split(np.asarray(arr, np.float32), self._n, axis=0)
+
+    def create_dense_table(self, table_id, shape, lr=0.01, init=None):
+        shape = tuple(shape)
+        blocks = (self._dense_blocks(np.asarray(init, np.float32))
+                  if init is not None else
+                  self._dense_blocks(np.zeros(shape, np.float32)))
+        self._fan_out([
+            (lambda c=c, blk=blk: c.create_dense_table(
+                table_id, blk.shape, lr, blk))
+            for c, blk in zip(self._clients, blocks)])
+
+    def pull_dense(self, table_id):
+        return np.concatenate(
+            self._fan_out([(lambda c=c: c.pull_dense(table_id))
+                           for c in self._clients]), axis=0)
+
+    def push_dense_grad(self, table_id, grad):
+        self._fan_out([
+            (lambda c=c, blk=blk: c.push_dense_grad(table_id, blk))
+            for c, blk in zip(self._clients, self._dense_blocks(grad))
+            if blk.shape[0]])
+
+    def set_dense(self, table_id, value):
+        self._fan_out([
+            (lambda c=c, blk=blk: c.set_dense(table_id, blk))
+            for c, blk in zip(self._clients, self._dense_blocks(value))])
+
+    # --- sparse: hash partition ----------------------------------------
+    def create_sparse_table(self, table_id, dim, lr=0.01):
+        self._sparse_dims[table_id] = int(dim)
+        self._fan_out([(lambda c=c: c.create_sparse_table(table_id, dim, lr))
+                       for c in self._clients])
+
+    def _shard_ids(self, ids):
+        ids = np.asarray(ids, np.int64)
+        owner = ids % self._n
+        per_server = [np.flatnonzero(owner == s) for s in range(self._n)]
+        return ids, per_server
+
+    def _sparse_dim(self, table_id) -> int:
+        """Embedding width of ``table_id`` — known locally when this client
+        created the table, else fetched once from the fleet (a trainer that
+        didn't create the table still needs correctly-shaped empty pulls)."""
+        dim = self._sparse_dims.get(table_id)
+        if dim is None:
+            stats = self._clients[0].table_stats()
+            dim = int(stats.get("sparse_dims", {}).get(table_id, 0))
+            if dim:
+                self._sparse_dims[table_id] = dim
+        return dim or 0
+
+    def pull_sparse(self, table_id, ids):
+        ids, per_server = self._shard_ids(ids)
+        live = [(s, idx) for s, idx in enumerate(per_server) if idx.size]
+        if not live:
+            return np.empty((0, self._sparse_dim(table_id)), np.float32)
+        results = self._fan_out([
+            (lambda s=s, idx=idx:
+             self._clients[s].pull_sparse(table_id, ids[idx]))
+            for s, idx in live])
+        out = np.empty((len(ids), results[0].shape[1]), np.float32)
+        for (s, idx), rows in zip(live, results):
+            out[idx] = rows
+        return out
+
+    def push_sparse_grad(self, table_id, ids, grads):
+        ids, per_server = self._shard_ids(ids)
+        grads = np.asarray(grads, np.float32)
+        self._fan_out([
+            (lambda s=s, idx=idx:
+             self._clients[s].push_sparse_grad(table_id, ids[idx],
+                                               grads[idx]))
+            for s, idx in enumerate(per_server) if idx.size])
+
+    # --- fleet-wide ops -------------------------------------------------
+    def table_stats(self):
+        """Aggregated view: dense table ids from server 0 (every server
+        holds a block of each), sparse row counts summed across shards."""
+        per = self._fan_out([(lambda c=c: c.table_stats())
+                             for c in self._clients])
+        sparse: Dict[int, int] = {}
+        for st in per:
+            for tid, n in st["sparse"].items():
+                sparse[tid] = sparse.get(tid, 0) + n
+        return {"dense": per[0]["dense"], "sparse": sparse,
+                "per_server": per}
+
+    def barrier(self, key, world, timeout=60.0):
+        self._clients[0].barrier(key, world, timeout)
+
+    def close(self):
+        for c in self._clients:
+            c.close()
+        self._pool.shutdown(wait=False)

@@ -212,3 +212,167 @@ def test_launcher_run_mode_ps_end_to_end(tmp_path):
     assert rc.returncode == 0, (rc.stderr[-1500:], log0[-1500:])
     assert "PSERVER-UP" in slog
     assert "PS-TRAIN-OK" in log0
+
+
+@pytest.fixture()
+def sharded_ps():
+    from paddle_tpu.distributed.ps import ShardedPsClient
+
+    servers = [PsServer(), PsServer()]
+    client = ShardedPsClient([(s.host, s.port) for s in servers])
+    yield servers, client
+    client.close()
+    for s in servers:
+        s.stop()
+
+
+class TestShardedPs:
+    def test_dense_parity_vs_single_server(self, sharded_ps):
+        """VERDICT r2 item 7: the 2-server row-partitioned dense table must
+        train to EXACTLY the same weights as one server (SGD is row-local,
+        so partitioning cannot change the math)."""
+        servers, sc = sharded_ps
+        single_srv = PsServer()
+        single = PsClient(single_srv.host, single_srv.port)
+        try:
+            rng = np.random.RandomState(0)
+            init = rng.randn(5, 3).astype(np.float32)
+            sc.create_dense_table(0, init.shape, lr=0.1, init=init)
+            single.create_dense_table(0, init.shape, lr=0.1, init=init)
+            np.testing.assert_allclose(sc.pull_dense(0), init)
+            for _ in range(20):
+                g = rng.randn(5, 3).astype(np.float32)
+                sc.push_dense_grad(0, g)
+                single.push_dense_grad(0, g)
+            np.testing.assert_allclose(sc.pull_dense(0),
+                                       single.pull_dense(0), rtol=1e-6)
+            # the rows really are split: each server holds only a block
+            blocks = [c.pull_dense(0) for c in sc._clients]
+            assert [b.shape[0] for b in blocks] == [3, 2]
+        finally:
+            single.close()
+            single_srv.stop()
+
+    def test_sparse_hash_partition_and_update_math(self, sharded_ps):
+        servers, sc = sharded_ps
+        sc.create_sparse_table(1, dim=4, lr=0.5)
+        ids = np.array([0, 1, 2, 3, 4, 5, 1, 4], np.int64)
+        rows = sc.pull_sparse(1, ids)
+        assert rows.shape == (8, 4)
+        # same id pulls the same row regardless of request grouping
+        np.testing.assert_allclose(rows[1], rows[6])
+        np.testing.assert_allclose(rows[4], rows[7])
+        # ids land on their hash owner ONLY: server s holds ids with
+        # id % 2 == s
+        stats = [s.sparse[1].rows.keys() for s in servers]
+        assert all(i % 2 == 0 for i in stats[0])
+        assert all(i % 2 == 1 for i in stats[1])
+        assert sc.table_stats()["sparse"][1] == 6  # distinct ids
+        # push applies per-row SGD across the shard boundary
+        g = np.ones((8, 4), np.float32)
+        sc.push_sparse_grad(1, ids, g)
+        rows2 = sc.pull_sparse(1, ids)
+        # ids 1 and 4 appear twice -> two accumulated updates
+        np.testing.assert_allclose(rows2[0], rows[0] - 0.5, rtol=1e-5)
+        np.testing.assert_allclose(rows2[1], rows[1] - 1.0, rtol=1e-5)
+        np.testing.assert_allclose(rows2[4], rows[4] - 1.0, rtol=1e-5)
+
+    def test_dense_fewer_rows_than_servers(self):
+        from paddle_tpu.distributed.ps import ShardedPsClient
+
+        servers = [PsServer() for _ in range(3)]
+        sc = ShardedPsClient(",".join(f"{s.host}:{s.port}" for s in servers))
+        try:
+            sc.create_dense_table(0, (2, 2), lr=1.0,
+                                  init=np.eye(2, dtype=np.float32))
+            np.testing.assert_allclose(sc.pull_dense(0), np.eye(2))
+            sc.push_dense_grad(0, np.ones((2, 2), np.float32))
+            np.testing.assert_allclose(sc.pull_dense(0),
+                                       np.eye(2) - 1.0)
+        finally:
+            sc.close()
+            for s in servers:
+                s.stop()
+
+
+    def test_sparse_empty_pull_keeps_dim(self, sharded_ps):
+        servers, sc = sharded_ps
+        sc.create_sparse_table(5, dim=7, lr=0.1)
+        out = sc.pull_sparse(5, np.empty((0,), np.int64))
+        assert out.shape == (0, 7)
+
+
+_SHARDED_PS_WORKER = """
+import os
+import time
+import numpy as np
+
+role = os.environ["TRAINING_ROLE"]
+
+if role == "PSERVER":
+    from paddle_tpu.distributed.ps import PsServer
+
+    port = int(os.environ["PADDLE_PORT"])
+    s = PsServer(port=port)
+    print("PSERVER-UP", port, flush=True)
+    while True:
+        time.sleep(0.5)
+
+from paddle_tpu.distributed.ps import ShardedPsClient
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+c = ShardedPsClient.from_env()
+assert c.num_servers == 2, c.num_servers
+if rank == 0:
+    c.create_dense_table(0, (4, 2), lr=0.1, init=np.zeros((4, 2)))
+    c.create_sparse_table(1, dim=2, lr=0.1)
+c.barrier("init", world)
+
+rng = np.random.RandomState(100 + rank)
+target = np.tile(np.array([3.0, -1.0], np.float32), (4, 1))
+for step in range(60):
+    w = c.pull_dense(0)
+    grad = 2 * (w - target) / 4
+    c.push_dense_grad(0, grad)
+    c.push_sparse_grad(1, [rank, rank + 2], np.ones((2, 2), np.float32) * 0.01)
+c.barrier("done", world)
+if rank == 0:
+    w = c.pull_dense(0)
+    err = float(np.abs(w - target).max())
+    stats = c.table_stats()
+    assert err < 0.15, (w, err)
+    assert stats["sparse"][1] == 2 * world, stats
+    # the corpus is really split: both servers own some rows
+    per = [st["sparse"].get(1, 0) for st in stats["per_server"]]
+    assert all(n > 0 for n in per), per
+    print("SHARDED-PS-OK err", round(err, 4), "split", per, flush=True)
+c.close()
+"""
+
+
+def test_launcher_two_sharded_servers_two_trainers(tmp_path):
+    """VERDICT r2 item 7 end-to-end: --run_mode ps with server_num 2 —
+    trainers reach the fleet via ShardedPsClient.from_env(), dense rows
+    range-partition and sparse ids hash-partition across both servers."""
+    import os as _os
+    import subprocess
+    import sys as _sys
+
+    script = tmp_path / "sharded_ps_worker.py"
+    script.write_text(_SHARDED_PS_WORKER)
+    env = dict(_os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    rc = subprocess.run(
+        [_sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--run_mode", "ps", "--server_num", "2", "--trainer_num", "2",
+         "--log_dir", str(tmp_path / "log"), str(script)],
+        cwd="/root/repo", env=env, timeout=180,
+        capture_output=True, text=True)
+    log0 = (tmp_path / "log" / "workerlog.0").read_text()
+    slog0 = (tmp_path / "log" / "serverlog.0").read_text()
+    slog1 = (tmp_path / "log" / "serverlog.1").read_text()
+    assert rc.returncode == 0, (rc.stderr[-1500:], log0[-1500:])
+    assert "PSERVER-UP" in slog0 and "PSERVER-UP" in slog1
+    assert "SHARDED-PS-OK" in log0
