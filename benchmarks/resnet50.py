@@ -1,9 +1,22 @@
 """BASELINE config 1: ResNet-50 ImageNet-geometry training throughput,
 single chip (reference: PaddleClas ResNet50 default config).
 
-Whole train step through the compiled path: ``to_static`` forward+loss (one
-XLA program + its compiled vjp) and the optimizer's donated fused update.
-Prints one JSON line: images/sec.
+Whole train step through the compiled path: ``fused_train_step`` (forward +
+loss + backward + momentum update as ONE donated XLA program). The
+benchmarked layout is NHWC end-to-end — channels-last is the layout TPU
+convolutions tile natively, so no transpose pass precedes the MXU convs.
+
+``host_input=True`` feeds a FRESH host batch through ``jax.device_put``
+issued one step ahead (double buffering): the async transfer overlaps the
+previous step's device compute. On a real TPU host that pipeline keeps up
+(PCIe feeds GB/s); through THIS environment's remote-tunnel PJRT the bulk
+host->device path moves ~35 MB/s (measured: a 77 MB batch costs ~2.2 s),
+so the default measurement uses device-resident batches and the overlap
+path is exercised at reduced size by ``tests/test_scaling_evidence.py``'s
+sibling (`test_io_hapi`) rather than timed here.
+
+Prints one JSON line: images/sec + MFU (3x-forward FLOP convention,
+12.27 GFLOP/img at 224x224) against the v5e bf16 peak.
 """
 
 import json
@@ -16,17 +29,22 @@ import time
 
 import numpy as np
 
+TRAIN_GFLOP_PER_IMG = 12.27  # 3 x 4.09 GFLOP fwd (fvcore count, 224x224)
+V5E_PEAK_TFLOPS = 197.0
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def run(batch=128, size=224, iters=10):
+def run(batch=128, size=224, iters=20, host_input=False):
+    import jax
+
     import paddle_tpu as paddle
     from paddle_tpu import nn
     from paddle_tpu.vision import models
 
-    model = models.resnet50(num_classes=1000)
+    model = models.resnet50(num_classes=1000, data_format="NHWC")
     model.train()
     opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                     parameters=model.parameters(),
@@ -45,28 +63,43 @@ def run(batch=128, size=224, iters=10):
     step_fn = paddle.jit.fused_train_step(loss_fn, opt, model=model)
 
     rng = np.random.RandomState(0)
-    x = paddle.to_tensor(rng.rand(batch, 3, size, size).astype(np.float32))
-    y = paddle.to_tensor(rng.randint(0, 1000, (batch,)))
+    # a small rotation of prepared host batches: each step feeds a DIFFERENT
+    # buffer so the host->device DMA really happens every step (one fixed
+    # device array would hide the input pipeline entirely)
+    host_x = [np.ascontiguousarray(
+        rng.rand(batch, size, size, 3).astype(np.float32)) for _ in range(3)]
+    host_y = [rng.randint(0, 1000, (batch,)) for _ in range(3)]
+    dev = jax.devices()[0]
 
-    def one_step():
-        return step_fn(x, y)
+    def put(i):
+        return (paddle.to_tensor(jax.device_put(host_x[i % 3], dev)),
+                paddle.to_tensor(jax.device_put(host_y[i % 3], dev)))
 
-    loss = one_step()
+    x, y = put(0)
+    loss = step_fn(x, y)
     log(f"warmup loss {float(loss):.3f}")
-    loss = one_step()
+    loss = step_fn(x, y)
     float(loss)
 
     best = None
     for _ in range(3):
+        nxt = (x, y)
         t0 = time.perf_counter()
-        for _ in range(iters):
-            loss = one_step()
+        for i in range(iters):
+            cur = nxt
+            if host_input:
+                # issue next batch's transfer BEFORE dispatching this step:
+                # device_put is async, so the DMA rides under the compute
+                nxt = put(i + 1)
+            loss = step_fn(*cur)
         float(loss)  # forces completion (block_until_ready unreliable here)
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
     ips = iters * batch / best
-    log(f"b{batch}: {ips:,.0f} img/s, step {best/iters*1e3:.1f} ms")
-    return ips
+    mfu = ips * TRAIN_GFLOP_PER_IMG / (V5E_PEAK_TFLOPS * 1e3)
+    log(f"b{batch} NHWC host-input={host_input}: {ips:,.0f} img/s, "
+        f"step {best/iters*1e3:.1f} ms, MFU~{mfu*100:.1f}% (v5e)")
+    return ips, mfu
 
 
 def main():
@@ -75,17 +108,19 @@ def main():
     import subprocess
 
     if len(sys.argv) > 1:
-        print(json.dumps({"ips": run(int(sys.argv[1]))}))
+        ips, mfu = run(int(sys.argv[1]))
+        print(json.dumps({"ips": ips, "mfu": mfu}))
         return
 
-    best = 0.0
+    best, mfu = 0.0, 0.0
     for batch in (128, 64, 32):
         proc = subprocess.run([sys.executable, __file__, str(batch)],
                               capture_output=True, text=True)
         log(proc.stderr[-500:])
         for line in proc.stdout.splitlines():
             try:
-                best = json.loads(line)["ips"]
+                rec = json.loads(line)
+                best, mfu = rec["ips"], rec["mfu"]
                 break
             except (ValueError, KeyError):
                 continue
@@ -93,7 +128,7 @@ def main():
             break
     print(json.dumps({
         "metric": "resnet50_train_throughput", "value": round(best, 1),
-        "unit": "images/sec",
+        "unit": "images/sec", "mfu": round(mfu, 4),
         "vs_baseline": round(best / 2850.0, 4),  # A100 fp16 public ballpark
     }))
 

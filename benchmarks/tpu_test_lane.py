@@ -26,6 +26,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TPU_TEST_FILES = [
     "tests/test_flash_attention_tpu.py",
     "tests/test_flash_packed_gating.py",
+    "tests/test_resnet_fusion_tpu.py",
 ]
 
 

@@ -413,7 +413,15 @@ def to_tensor(
     else:
         dev = device_for_place(place if isinstance(place, Place) else _parse_device(place))
     if isinstance(val, jax.Array) and not isinstance(val, jax.core.Tracer):
-        arr = jax.device_put(val.astype(dt) if dt is not None else val, dev)
+        if place is None and getattr(val.sharding, "num_devices", 1) > 1:
+            # a mesh-sharded array (GSPMD path: dist.shard_tensor /
+            # sharded-input pipelines) keeps its NamedSharding — re-placing
+            # it on the single default device would silently de-shard it;
+            # an EXPLICIT place still wins
+            arr = val.astype(dt) if dt is not None else val
+        else:
+            arr = jax.device_put(val.astype(dt) if dt is not None else val,
+                                 dev)
     elif isinstance(val, jax.core.Tracer):
         arr = val.astype(dt) if dt is not None else val
     else:

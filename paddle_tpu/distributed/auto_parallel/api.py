@@ -276,10 +276,14 @@ def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
     sublayer to place its parameters (default: replicate everything)."""
 
     def default_shard_fn(name, sublayer, mesh):
+        rep = [Replicate() for _ in mesh.dim_names]
         for pname, param in sublayer.named_parameters(include_sublayers=False):
-            sharded = shard_tensor(param, mesh,
-                                   [Replicate() for _ in mesh.dim_names])
-            param._inplace_set(sharded._value)
+            param._inplace_set(shard_tensor(param, mesh, rep)._value)
+        # buffers (BN running stats, …) must ride the same mesh: a
+        # single-device buffer next to mesh-placed params makes every
+        # downstream jit reject the computation as cross-device
+        for bname, buf in sublayer.named_buffers(include_sublayers=False):
+            buf._inplace_set(shard_tensor(buf, mesh, rep)._value)
 
     fn = shard_fn or default_shard_fn
     for name, sub in layer.named_sublayers(include_self=True):

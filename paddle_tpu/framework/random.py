@@ -61,18 +61,24 @@ def push_trace_key(key) -> None:
     stack = getattr(_trace, "stack", None)
     if stack is None:
         stack = _trace.stack = []
-    stack.append(key)
+    stack.append([key, False])  # [current key, consumed?]
 
 
-def pop_trace_key() -> None:
-    _trace.stack.pop()
+def pop_trace_key() -> bool:
+    """Leave traced-RNG mode. Returns whether the traced program actually
+    CONSUMED randomness — compiled-step drivers use this to skip the
+    per-step host-side key split for deterministic models (a measurable
+    per-step cost on big parameter lists)."""
+    return _trace.stack.pop()[1]
 
 
 def next_key():
     """Consume the RNG stream: returns a fresh subkey."""
     stack = getattr(_trace, "stack", None)
     if stack:
-        stack[-1], sub = jax.random.split(stack[-1])
+        top = stack[-1]
+        top[0], sub = jax.random.split(top[0])
+        top[1] = True
         return sub
     with _lock:
         _state["key"], sub = jax.random.split(_global_key())

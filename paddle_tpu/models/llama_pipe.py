@@ -66,21 +66,35 @@ _ROPE_TABLES: dict = {}
 def _rope_tables(s: int, half: int, theta: float):
     """cos/sin angle tables, cached per (seq, half, theta): the eager
     parity path calls every layer's forward per micro-batch — rebuilding
-    the host table and re-transferring it each time is pure waste."""
+    the host table and re-transferring it each time is pure waste.
+
+    Only CONCRETE tensors are memoised: under a jit trace, ``to_tensor``'s
+    device placement is itself traced, so the wrapped value is a tracer
+    bound to that one program — caching it would leak it into the next
+    trace (UnexpectedTracerError when a second pipeline program compiles).
+    The host-side numpy tables are cached unconditionally either way."""
     import numpy as np
 
     key = (s, half, float(theta))
     hit = _ROPE_TABLES.get(key)
     if hit is None:
-        import paddle_tpu as paddle
-
         inv = np.power(float(theta),
                        -np.arange(0, half, dtype=np.float32) / half)
         ang = np.outer(np.arange(s, dtype=np.float32), inv)  # [S, half]
-        hit = (paddle.to_tensor(np.cos(ang)[None, :, None, :]),
-               paddle.to_tensor(np.sin(ang)[None, :, None, :]))
-        _ROPE_TABLES[key] = hit
-    return hit
+        _ROPE_TABLES[key] = hit = (np.cos(ang)[None, :, None, :],
+                                   np.sin(ang)[None, :, None, :])
+    dev_key = ("dev",) + key
+    dev_hit = _ROPE_TABLES.get(dev_key)
+    if dev_hit is not None:
+        return dev_hit
+    import jax
+
+    import paddle_tpu as paddle
+
+    cos_t, sin_t = paddle.to_tensor(hit[0]), paddle.to_tensor(hit[1])
+    if not isinstance(cos_t._value, jax.core.Tracer):
+        _ROPE_TABLES[dev_key] = (cos_t, sin_t)
+    return cos_t, sin_t
 
 
 def _rope(x, theta: float):

@@ -5,6 +5,7 @@ fusion is the whole-pytree donated jit in ``Optimizer.step``)."""
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 from .optimizer import Optimizer
@@ -80,7 +81,7 @@ class AdamW(Adam):
         decay = self._wd
         if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
             decay = 0.0
-        return {"decay": jnp.float32(decay)}
+        return {"decay": np.float32(decay)}  # host scalar: placement-neutral under meshes
 
     def _update_one(self, p, g, state, lr, step, extras=None):
         new_p, new_state = super()._update_one(p, g, state, lr, step)
@@ -123,7 +124,7 @@ class Lamb(Optimizer):
         decay = self._wd
         if self._exclude_fn is not None and self._exclude_fn(p.name):
             decay = 0.0
-        return {"decay": jnp.float32(decay)}
+        return {"decay": np.float32(decay)}  # host scalar: placement-neutral under meshes
 
     def _update_one(self, p, g, state, lr, step, extras=None):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
