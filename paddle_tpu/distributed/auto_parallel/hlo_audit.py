@@ -1,0 +1,194 @@
+"""Collective inventory of a compiled SPMD program — scaling evidence.
+
+The driver's north star (SURVEY.md §6; BASELINE.md row 3) is ≥90% scaling
+efficiency from 8 to 256 chips. Real pods aren't reachable from this
+environment, so the claim is made auditable instead of aspirational: this
+module walks a compiled program's optimized HLO, lists every cross-device
+collective with its payload bytes, and attributes each to the mesh axes it
+rides by matching its replica groups against the groups every axis subset
+induces. Tests pin the inventory (op kinds + bytes per axis per step) for
+the baseline-ladder configs, and SCALING.md turns the bytes into an ICI
+roofline projection.
+
+Reference counterpart: the reference ships no such tool — its scaling
+numbers come from pod runs. The audit is the compile-time substitute this
+environment allows (the collective schedule IS the program; XLA will run
+exactly these ops at scale).
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["collective_inventory", "summarize_by_axis", "format_inventory"]
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "s4": 1, "u4": 1, "pred": 1,
+    "c64": 8, "c128": 16,
+}
+
+# one result shape: `f32[8,128,256]` or scalar `f32[]`
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+_COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                   "all-to-all", "collective-permute", "collective-broadcast")
+
+
+def _shape_bytes(shape_text: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_text):
+        if dtype not in _DTYPE_BYTES:
+            continue  # token[] etc. carry no payload
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def _parse_groups(line: str) -> Optional[List[Tuple[int, ...]]]:
+    """Parse ``replica_groups`` in either HLO syntax: explicit
+    ``{{0,1},{2,3}}`` or iota ``[2,2]<=[4]`` / ``[4,2]<=[2,4]T(1,0)``."""
+    m = re.search(r"replica_groups=\{(\{[^=]*?\})\}", line)
+    if m:
+        return [tuple(int(v) for v in g.split(",") if v.strip())
+                for g in re.findall(r"\{([\d,\s]*)\}", m.group(1))]
+    m = re.search(
+        r"replica_groups=\[([\d,]+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?", line)
+    if m:
+        group_shape = [int(v) for v in m.group(1).split(",")]
+        iota_shape = [int(v) for v in m.group(2).split(",")]
+        ids = np.arange(int(np.prod(iota_shape))).reshape(iota_shape)
+        if m.group(3):
+            ids = ids.transpose([int(v) for v in m.group(3).split(",")])
+        ids = ids.reshape(group_shape)
+        return [tuple(int(v) for v in row) for row in ids]
+    return None
+
+
+def _parse_pairs(line: str) -> Optional[List[Tuple[int, int]]]:
+    m = re.search(r"source_target_pairs=\{([^}]*(?:\},\{[^}]*)*)\}\}", line)
+    if m is None:
+        m = re.search(r"source_target_pairs=\{(.*?)\}\}", line)
+    if m is None:
+        return None
+    return [tuple(int(v) for v in p.split(","))
+            for p in re.findall(r"\{(\d+,\d+)\}", line)]
+
+
+def _axis_groups(mesh_shape: Dict[str, int],
+                 axes: Sequence[str]) -> frozenset:
+    """The replica groups a collective over ``axes`` induces: device
+    positions (row-major over the mesh shape) varying along ``axes`` with
+    every other coordinate fixed."""
+    names = list(mesh_shape)
+    sizes = [mesh_shape[a] for a in names]
+    ids = np.arange(int(np.prod(sizes))).reshape(sizes)
+    keep = [i for i, a in enumerate(names) if a not in axes]
+    move = [i for i, a in enumerate(names) if a in axes]
+    ids = ids.transpose(keep + move).reshape(
+        int(np.prod([sizes[i] for i in keep]) or 1), -1)
+    return frozenset(frozenset(int(v) for v in row) for row in ids)
+
+
+def _attribute_axes(groups, mesh_shape: Dict[str, int]) -> Optional[Tuple[str, ...]]:
+    """Which mesh-axis subset induces exactly these groups?"""
+    got = frozenset(frozenset(g) for g in groups)
+    nontrivial = [a for a, s in mesh_shape.items() if s > 1]
+    for r in range(1, len(nontrivial) + 1):
+        for combo in itertools.combinations(nontrivial, r):
+            if _axis_groups(mesh_shape, combo) == got:
+                return combo
+    return None
+
+
+def _attribute_pairs(pairs, mesh_shape: Dict[str, int]) -> Optional[Tuple[str, ...]]:
+    """collective-permute: match source→target pairs against a ±1 ring
+    shift on each mesh axis (the pipeline/ring-attention pattern)."""
+    got = frozenset(pairs)
+    names = list(mesh_shape)
+    sizes = [mesh_shape[a] for a in names]
+    ids = np.arange(int(np.prod(sizes))).reshape(sizes)
+    for i, a in enumerate(names):
+        if sizes[i] == 1:
+            continue
+        for shift in (1, -1):
+            rolled = np.roll(ids, -shift, axis=i)
+            expect = frozenset(
+                (int(s), int(t)) for s, t in
+                zip(ids.reshape(-1), rolled.reshape(-1)))
+            if got <= expect:  # a partial ring (subset of edges) still rides this axis
+                return (a,)
+    return None
+
+
+def collective_inventory(hlo_text: str, mesh=None) -> List[Dict]:
+    """Every cross-device collective in optimized HLO ``hlo_text``.
+
+    Returns one entry per op: ``{"op", "shape", "bytes", "groups",
+    "axes"}`` — ``bytes`` is the op's RESULT payload (full per-device
+    output buffer), ``axes`` the mesh-axis subset whose induced replica
+    groups match (None when ``mesh`` is not given or no subset matches).
+    Async ``-start``/``-done`` pairs are counted once (at the start).
+    """
+    mesh_shape = dict(mesh.shape) if mesh is not None else None
+    out: List[Dict] = []
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        m = re.match(
+            r"(?:ROOT\s+)?%?[\w.\-]+\s*=\s*((?:\([^)]*\))|(?:\w+\[[^\]]*\]))"
+            r"\s+([\w\-]+)\(", stripped)
+        if m is None:
+            continue
+        shape_text, opname = m.group(1), m.group(2)
+        base = opname[:-6] if opname.endswith("-start") else opname
+        if base not in _COLLECTIVE_OPS or opname.endswith("-done"):
+            continue
+        entry = {"op": base, "shape": shape_text,
+                 "bytes": _shape_bytes(shape_text),
+                 "groups": None, "axes": None}
+        pairs = _parse_pairs(stripped) if base == "collective-permute" else None
+        groups = _parse_groups(stripped)
+        if pairs is not None:
+            entry["groups"] = pairs
+            if mesh_shape:
+                entry["axes"] = _attribute_pairs(pairs, mesh_shape)
+        elif groups is not None:
+            entry["groups"] = groups
+            if mesh_shape:
+                entry["axes"] = _attribute_axes(groups, mesh_shape)
+        out.append(entry)
+    return out
+
+
+def summarize_by_axis(inventory: List[Dict]) -> Dict[Tuple[str, ...], Dict]:
+    """Aggregate an inventory: axis subset → {count, bytes, ops}."""
+    summary: Dict[Tuple[str, ...], Dict] = {}
+    for e in inventory:
+        key = e["axes"] if e["axes"] is not None else ("<unattributed>",)
+        s = summary.setdefault(key, {"count": 0, "bytes": 0, "ops": {}})
+        s["count"] += 1
+        s["bytes"] += e["bytes"]
+        s["ops"][e["op"]] = s["ops"].get(e["op"], 0) + 1
+    return summary
+
+
+def format_inventory(inventory: List[Dict]) -> str:
+    lines = [f"{'axis':<22} {'op':<20} {'count':>5} {'MiB':>10}"]
+    agg: Dict[Tuple, Dict] = {}
+    for e in inventory:
+        key = (e["axes"] or ("<unattributed>",), e["op"])
+        a = agg.setdefault(key, {"count": 0, "bytes": 0})
+        a["count"] += 1
+        a["bytes"] += e["bytes"]
+    for (axes, op), a in sorted(agg.items(), key=lambda kv: -kv[1]["bytes"]):
+        lines.append(f"{'x'.join(axes):<22} {op:<20} {a['count']:>5} "
+                     f"{a['bytes'] / 2**20:>10.2f}")
+    return "\n".join(lines)

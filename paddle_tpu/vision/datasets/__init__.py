@@ -33,7 +33,12 @@ class MNIST(Dataset):
                  backend="cv2", synthetic_size=None):
         self.mode = mode.lower()
         self.transform = transform
-        if image_path and label_path and os.path.exists(image_path):
+        if image_path and label_path:
+            if not (os.path.exists(image_path) and os.path.exists(label_path)):
+                raise FileNotFoundError(
+                    f"{type(self).__name__}: image_path/label_path "
+                    f"({image_path!r}, {label_path!r}) do not both exist "
+                    f"(omit them for the synthetic offline fallback)")
             self.images, self.labels = self._load_idx(image_path, label_path)
             self.synthetic = False
         else:
@@ -90,7 +95,16 @@ class _CifarBase(Dataset):
                  download=True, backend="cv2", synthetic_size=None):
         self.mode = mode.lower()
         self.transform = transform
-        if self.ARCHIVE_SUPPORTED and data_file and os.path.exists(data_file):
+        if self.ARCHIVE_SUPPORTED and data_file:
+            # an EXPLICIT archive path must exist — silently training on
+            # synthetic noise because of a typo'd path would look like real
+            # training (the synthetic fallback is only for no-path offline
+            # use)
+            if not os.path.exists(data_file):
+                raise FileNotFoundError(
+                    f"{type(self).__name__}: data_file {data_file!r} does "
+                    f"not exist (omit data_file for the synthetic offline "
+                    f"fallback)")
             self.images, self.labels = self._load_archive(data_file)
             self.synthetic = False
             return

@@ -1,0 +1,165 @@
+"""Scaling evidence (VERDICT r2 item 5; SURVEY.md §6, BASELINE.md row 3).
+
+Real pods aren't reachable, so the ≥90%-scaling claim is made auditable:
+these tests compile the baseline-ladder steps, walk the optimized HLO, and
+pin the COLLECTIVE INVENTORY — which op kinds ride which mesh axis, and how
+many bytes per step. SCALING.md turns the pinned bytes into the ICI
+roofline projection; these tests keep those numbers honest across changes.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.auto_parallel.hlo_audit import (
+    collective_inventory,
+    format_inventory,
+    summarize_by_axis,
+)
+from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
+
+
+class TestHloAuditParser:
+    def test_explicit_groups_and_bytes(self):
+        mesh = create_hybrid_mesh(dp=4, mp=2)
+        try:
+            hlo = (
+                "  %ar = f32[128,256] all-reduce(f32[128,256] %p), "
+                "replica_groups={{0,2},{1,3},{4,6},{5,7}}, to_apply=%sum\n"
+                "  %ag = bf16[64] all-gather(bf16[32] %q), "
+                "replica_groups={{0,1},{2,3},{4,5},{6,7}}, dimensions={0}\n"
+            )
+            inv = collective_inventory(hlo, mesh)
+            assert [e["op"] for e in inv] == ["all-reduce", "all-gather"]
+            assert inv[0]["bytes"] == 128 * 256 * 4
+            assert inv[1]["bytes"] == 64 * 2
+            # {{0,2},{1,3},...}: pairs varying along the second-from-inner
+            # axis of (dp=4, mp=2) row-major layout — NOT dp, NOT mp alone
+            assert inv[1]["axes"] == ("mp",)
+        finally:
+            set_mesh(None)
+
+    def test_iota_groups(self):
+        mesh = create_hybrid_mesh(dp=2, mp=4)
+        try:
+            hlo = ("  %ar = f32[8] all-reduce-start(f32[8] %p), "
+                   "replica_groups=[2,4]<=[8], to_apply=%sum\n"
+                   "  %d = f32[8] all-reduce-done(f32[8] %ar)\n")
+            inv = collective_inventory(hlo, mesh)
+            assert len(inv) == 1  # -start counted once, -done skipped
+            assert inv[0]["axes"] == ("mp",)  # contiguous quads = inner axis
+        finally:
+            set_mesh(None)
+
+    def test_permute_pairs_ride_an_axis(self):
+        mesh = create_hybrid_mesh(dp=2, pp=4)
+        try:
+            # pp ring on each dp replica: +1 shift along the pp axis
+            pairs = ",".join("{%d,%d}" % (d * 4 + s, d * 4 + (s + 1) % 4)
+                             for d in range(2) for s in range(4))
+            hlo = (f"  %cp = f32[4,8] collective-permute(f32[4,8] %x), "
+                   f"source_target_pairs={{{pairs}}}\n")
+            inv = collective_inventory(hlo, mesh)
+            assert inv[0]["axes"] == ("pp",)
+        finally:
+            set_mesh(None)
+
+    def test_tuple_shape_bytes(self):
+        hlo = ("  %ar = (f32[16], bf16[32], u8[]) all-reduce("
+               "f32[16] %a, bf16[32] %b, u8[] %c), "
+               "replica_groups={{0,1}}, to_apply=%sum\n")
+        inv = collective_inventory(hlo)
+        assert inv[0]["bytes"] == 16 * 4 + 32 * 2 + 1
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+class TestLadderCollectiveInventory:
+    def test_dp8_resnet_grad_sync_bytes_equal_param_bytes(self):
+        """BASELINE config 4 (fleet DP ResNet): the compiled DP step's ONLY
+        collectives are dp-axis all-reduces, and their payload is the
+        trainable gradient bytes (+ BN batch-stat sync + the loss scalar).
+        This is the whole scaling story for DP: bytes/step is constant in
+        device count, so efficiency follows the ring-allreduce roofline."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from paddle_tpu.distributed.auto_parallel.api import (
+            ProcessMesh, shard_layer)
+        from paddle_tpu.vision.models import resnet18
+
+        pm = ProcessMesh(np.arange(8), ["dp"])
+        try:
+            model = resnet18(num_classes=10)
+            model.train()
+            shard_layer(model, pm)  # replicate params+buffers on the mesh
+            opt = paddle.optimizer.Momentum(
+                learning_rate=0.1, momentum=0.9,
+                parameters=model.parameters())
+            ce = nn.CrossEntropyLoss()
+            step = paddle.jit.fused_train_step(
+                lambda x, y: ce(model(x), y), opt, model=model)
+            rng = np.random.RandomState(0)
+            x = paddle.to_tensor(jax.device_put(
+                rng.rand(16, 3, 32, 32).astype(np.float32),
+                NamedSharding(pm.mesh, P("dp"))))
+            y = paddle.to_tensor(jax.device_put(
+                rng.randint(0, 10, (16,)), NamedSharding(pm.mesh, P("dp"))))
+            step.compile(x, y)
+            entry = next(iter(step._cache.values()))
+            inv = collective_inventory(entry._compiled.as_text(), pm.mesh)
+
+            assert inv, "DP step must contain collectives"
+            kinds = {e["op"] for e in inv}
+            assert kinds == {"all-reduce"}, format_inventory(inv)
+            assert all(e["axes"] == ("dp",) for e in inv), \
+                format_inventory(inv)
+            grad_bytes = sum(
+                4 * int(np.prod(p.shape)) for p in model.parameters()
+                if not p.stop_gradient)
+            total = sum(e["bytes"] for e in inv)
+            # payload ≥ the gradients; ≤ +2% slack for BN stats + scalars
+            assert grad_bytes <= total <= int(grad_bytes * 1.02), (
+                f"all-reduce bytes {total} vs grad bytes {grad_bytes}\n"
+                + format_inventory(inv))
+
+            # the sharded step also EXECUTES (placement fix regression net)
+            loss = step(x, y)
+            assert np.isfinite(float(loss))
+        finally:
+            set_mesh(None)
+
+    def test_llama_hybrid_inventory_by_axis(self):
+        """BASELINE config 5 (LLaMA TP + ZeRO over dp×sharding×mp): every
+        collective in the compiled step is attributable to a mesh axis —
+        TP activation reductions on mp, gradient/param traffic on the
+        dp×sharding data axes — and nothing rides an unknown group."""
+        from paddle_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny(sharding_stage=3)
+        mesh = create_hybrid_mesh(dp=2, sharding=2, mp=2,
+                                  devices=jax.devices()[:8])
+        try:
+            import jax.numpy as jnp
+
+            step = llama.make_sharded_train_step(cfg, mesh, lr=1e-3)
+            params = llama.init_params(cfg)
+            opt = llama.init_opt_state(params)
+            toks = jnp.array(np.random.RandomState(0).randint(
+                0, cfg.vocab_size, (8, 32)), jnp.int32)
+            txt = step.lower(params, opt, toks, toks).compile().as_text()
+            inv = collective_inventory(txt, mesh)
+            by_axis = summarize_by_axis(inv)
+
+            assert inv, "hybrid step must contain collectives"
+            assert ("<unattributed>",) not in by_axis, format_inventory(inv)
+            # TP: activation all-reduces on the mp axis
+            assert ("mp",) in by_axis and \
+                by_axis[("mp",)]["ops"].get("all-reduce", 0) > 0
+            # data half: grad sync across the dp×sharding axes together
+            data_keys = [k for k in by_axis
+                         if set(k) <= {"dp", "sharding"}]
+            assert data_keys, format_inventory(inv)
+            assert sum(by_axis[k]["bytes"] for k in data_keys) > 0
+        finally:
+            set_mesh(None)
