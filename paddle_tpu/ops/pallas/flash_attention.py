@@ -516,38 +516,79 @@ def _packed_eligible(q, k) -> int:
     return 0
 
 
+_LOG2_E = float(np.log2(np.e))
+
+
 def _make_packed_fwd(S, d, hp, is_causal):
+    """Packed forward in the BASE-2 domain: the caller folds
+    ``scale * log2(e)`` into q, so the score matrix arrives pre-multiplied
+    and the softmax runs on ``exp2`` directly — one fewer VPU multiply per
+    [S, S] element than ``exp`` (which lowers to mul-by-log2e + pow2).
+    Probabilities are identical: ``2^(c*s - c*m) == e^(s - m)``. The saved
+    lse is ALSO base-2 (``m2 + log2(l)``); the packed backward consumes it
+    in the same domain."""
+    return _make_packed_fwd_general(S, S, 0, d, hp, is_causal)
+
+
+def _make_packed_fwd_general(Sq, Sk, q_off, d, hp, is_causal):
+    """Packed forward over a [Sq, Sk] score tile: q rows sit at absolute
+    positions ``q_off + i``, k columns at ``j`` (k is always a prefix of
+    the sequence in the split-causal decomposition)."""
     def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
+        if is_causal:
+            qp = q_off + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+            kp = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+            causal = qp >= kp  # hoisted: shared by all heads in the cell
         for i in range(hp):
             sl = slice(i * d, (i + 1) * d)
-            q = q_ref[0, :, sl]  # PRE-SCALED, [S, d]
+            q = q_ref[0, :, sl]  # PRE-SCALED by scale*log2(e), [Sq, d]
             k = k_ref[0, :, sl]
             v = v_ref[0, :, sl]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             if is_causal:
-                qp = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
-                kp = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
-                s = jnp.where(qp >= kp, s, -jnp.inf)
+                s = jnp.where(causal, s, -jnp.inf)
             m = jnp.max(s, axis=1)
-            p = jnp.exp(s - m[:, None])
+            p = jnp.exp2(s - m[:, None])
             l = jnp.sum(p, axis=1)
             o = jax.lax.dot_general(p.astype(v.dtype), v,
                                     (((1,), (0,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             o_ref[0, :, sl] = (o / l[:, None]).astype(o_ref.dtype)
-            lse_ref[0, 0, i, :] = m + jnp.log(l)
+            lse_ref[0, 0, i, :] = m + jnp.log2(l)
     return kernel
 
 
 def _make_packed_bwd(S, d, hp, is_causal, scale):
     """Fused dq/dk/dv: one probability recompute serves all three grads
-    (the blocked path pays it twice across its dq and dkv kernels)."""
+    (the blocked path pays it twice across its dq and dkv kernels).
+
+    Base-2 domain like the packed forward: q arrives pre-scaled by
+    ``scale * log2(e)`` and lse is base-2, so the recompute is one
+    ``exp2`` with no extra multiply. ``ds`` (natural-domain softmax vjp,
+    p*(dp-delta)) is unaffected — p's VALUES are domain-independent. The
+    chain rule per input: dq = (ds @ k) * scale (w.r.t. UNSCALED q),
+    dk = ds^T @ q_scaled / log2(e) (the pre-fold over-scales q by log2(e),
+    divided back out on the narrow [S, d] result)."""
+    return _make_packed_bwd_general(S, S, 0, d, hp, is_causal, scale)
+
+
+def _make_packed_bwd_general(Sq, Sk, q_off, d, hp, is_causal, scale):
+    """Fused dq + dk/dv over a [Sq, Sk] score tile (q rows at absolute
+    positions ``q_off + i``; k a sequence prefix). In the split-causal
+    decomposition a call's dk/dv are PARTIAL (only its q rows' share);
+    the wrapper sums overlapping k regions."""
+    inv_log2e = 1.0 / _LOG2_E
+
     def kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                dq_ref, dk_ref, dv_ref):
+        if is_causal:
+            qp = q_off + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+            kp = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+            causal = qp >= kp  # hoisted: shared by all heads in the cell
         for i in range(hp):
             sl = slice(i * d, (i + 1) * d)
-            q = q_ref[0, :, sl]  # PRE-SCALED (dk then carries the scale)
+            q = q_ref[0, :, sl]  # PRE-SCALED by scale*log2(e)
             k = k_ref[0, :, sl]
             v = v_ref[0, :, sl]
             do = do_ref[0, :, sl]
@@ -557,11 +598,9 @@ def _make_packed_bwd(S, d, hp, is_causal, scale):
                             axis=1)
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
-            p = jnp.exp(s - lse[:, None])
+            p = jnp.exp2(s - lse[:, None])
             if is_causal:
-                qp = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
-                kp = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
-                p = jnp.where(qp >= kp, p, 0.0)
+                p = jnp.where(causal, p, 0.0)
             pb = p.astype(do.dtype)
             dv = jax.lax.dot_general(pb, do, (((0,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
@@ -573,7 +612,7 @@ def _make_packed_bwd(S, d, hp, is_causal, scale):
             dk = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
             dq_ref[0, :, sl] = (dq * scale).astype(dq_ref.dtype)
-            dk_ref[0, :, sl] = dk.astype(dk_ref.dtype)
+            dk_ref[0, :, sl] = (dk * inv_log2e).astype(dk_ref.dtype)
             dv_ref[0, :, sl] = dv.astype(dv_ref.dtype)
     return kernel
 
@@ -588,7 +627,8 @@ def _pallas_flash_fwd_packed(q, k, v, is_causal, scale=None):
     G = h // hp
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     hd = h * d
-    qf = (q * scale).astype(q.dtype).reshape(b, S, hd)
+    # base-2 domain: scale*log2(e) folded into q (see _make_packed_fwd)
+    qf = (q * (scale * _LOG2_E)).astype(q.dtype).reshape(b, S, hd)
     kf = k.reshape(b, S, hd)
     vf = v.reshape(b, S, hd)
     blk = pl.BlockSpec((1, S, hp * d), lambda bb, g: (bb, 0, g))
@@ -618,7 +658,8 @@ def _pallas_flash_bwd_packed(q, k, v, do, out, lse, is_causal, scale=None):
     G = h // hp
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     hd = h * d
-    qf = (q * scale).astype(q.dtype).reshape(b, S, hd)
+    # base-2 domain, matching the packed forward (lse is base-2)
+    qf = (q * (scale * _LOG2_E)).astype(q.dtype).reshape(b, S, hd)
     kf = k.reshape(b, S, hd)
     vf = v.reshape(b, S, hd)
     dof = do.reshape(b, S, hd)
