@@ -1,9 +1,9 @@
 """Print the per-axis collective inventory of the baseline-ladder steps.
 
-Runs on the 8-device virtual CPU mesh (no TPU needed): compiles the DP
-ResNet step and the LLaMA hybrid (dp×sharding×mp) step, audits their
-optimized HLO with ``hlo_audit``, and prints the tables SCALING.md embeds.
-Usage::
+Runs on the 8-device virtual CPU mesh (no TPU needed): compiles the SAME
+programs ``tests/test_scaling_evidence.py`` pins (shared builders in
+``hlo_audit``), audits their optimized HLO, and prints the tables
+SCALING.md embeds. Usage::
 
     env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
         XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -19,34 +19,17 @@ import jax
 import numpy as np
 
 
-def audit_dp_resnet():
-    import paddle_tpu as paddle
-    from paddle_tpu import nn
-    from paddle_tpu.distributed.auto_parallel.api import (
-        ProcessMesh, shard_layer)
+def main():
     from paddle_tpu.distributed.auto_parallel.hlo_audit import (
-        collective_inventory, format_inventory)
-    from paddle_tpu.vision.models import resnet18
-    from jax.sharding import NamedSharding, PartitionSpec as P
+        build_dp_resnet_compiled,
+        build_llama_hybrid_compiled,
+        collective_inventory,
+        format_inventory,
+    )
+    from paddle_tpu.parallel import set_mesh
 
-    pm = ProcessMesh(np.arange(8), ["dp"])
-    model = resnet18(num_classes=10)
-    model.train()
-    shard_layer(model, pm)
-    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
-                                    parameters=model.parameters())
-    ce = nn.CrossEntropyLoss()
-    step = paddle.jit.fused_train_step(lambda x, y: ce(model(x), y), opt,
-                                       model=model)
-    rng = np.random.RandomState(0)
-    x = paddle.to_tensor(jax.device_put(
-        rng.rand(16, 3, 32, 32).astype(np.float32),
-        NamedSharding(pm.mesh, P("dp"))))
-    y = paddle.to_tensor(jax.device_put(
-        rng.randint(0, 10, (16,)), NamedSharding(pm.mesh, P("dp"))))
-    step.compile(x, y)
-    entry = next(iter(step._cache.values()))
-    inv = collective_inventory(entry._compiled.as_text(), pm.mesh)
+    hlo, mesh, model, _, _ = build_dp_resnet_compiled()
+    inv = collective_inventory(hlo, mesh)
     grad_b = sum(4 * int(np.prod(p.shape)) for p in model.parameters()
                  if not p.stop_gradient)
     print("== DP-8 ResNet18 train step (b16, fp32 grads) ==")
@@ -56,29 +39,12 @@ def audit_dp_resnet():
           f"{sum(e['bytes'] for e in inv) / 2**20:.2f} MiB")
     print()
 
-
-def audit_llama_hybrid():
-    from paddle_tpu.distributed.auto_parallel.hlo_audit import (
-        collective_inventory, format_inventory)
-    from paddle_tpu.models import llama
-    from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
-    import jax.numpy as jnp
-
-    cfg = llama.LlamaConfig.tiny(sharding_stage=3)
-    mesh = create_hybrid_mesh(dp=2, sharding=2, mp=2,
-                              devices=jax.devices()[:8])
     try:
-        step = llama.make_sharded_train_step(cfg, mesh, lr=1e-3)
-        params = llama.init_params(cfg)
-        opt = llama.init_opt_state(params)
-        toks = jnp.array(np.random.RandomState(0).randint(
-            0, cfg.vocab_size, (8, 32)), jnp.int32)
-        txt = step.lower(params, opt, toks, toks).compile().as_text()
-        inv = collective_inventory(txt, mesh)
+        txt, mesh2 = build_llama_hybrid_compiled()
+        inv2 = collective_inventory(txt, mesh2)
         print("== LLaMA-tiny hybrid step (dp=2 x sharding=2 x mp=2, "
               "ZeRO-3 + TP) ==")
-        print(format_inventory(inv))
-        print()
+        print(format_inventory(inv2))
     finally:
         set_mesh(None)
 
@@ -87,5 +53,4 @@ if __name__ == "__main__":
     if len(jax.devices()) < 8:
         raise SystemExit("run with the 8-device virtual CPU mesh (see "
                          "module docstring)")
-    audit_dp_resnet()
-    audit_llama_hybrid()
+    main()

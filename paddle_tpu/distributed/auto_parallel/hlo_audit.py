@@ -40,8 +40,11 @@ _COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
                    "all-to-all", "collective-permute", "collective-broadcast")
 
 
-def _shape_bytes(shape_text: str) -> int:
-    total = 0
+def _shape_member_bytes(shape_text: str) -> List[int]:
+    """Byte size of each array member in a result-shape string. Layout
+    suffixes (``{1,0:T(8,128)(2,1)S(1)}``) contain no brackets, so the
+    dtype[dims] matches are exactly the array members."""
+    out = []
     for dtype, dims in _SHAPE_RE.findall(shape_text):
         if dtype not in _DTYPE_BYTES:
             continue  # token[] etc. carry no payload
@@ -49,8 +52,18 @@ def _shape_bytes(shape_text: str) -> int:
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        total += n * _DTYPE_BYTES[dtype]
-    return total
+        out.append(n * _DTYPE_BYTES[dtype])
+    return out
+
+
+def _shape_bytes(shape_text: str, async_start: bool = False) -> int:
+    members = _shape_member_bytes(shape_text)
+    if async_start and len(members) >= 2:
+        # async `-start` results are (aliased inputs..., outputs...) —
+        # counting every member would double the payload. Outputs are the
+        # trailing half (heuristic; exact aliasing isn't in the text).
+        members = members[len(members) // 2:]
+    return sum(members)
 
 
 def _parse_groups(line: str) -> Optional[List[Tuple[int, ...]]]:
@@ -74,13 +87,13 @@ def _parse_groups(line: str) -> Optional[List[Tuple[int, ...]]]:
 
 
 def _parse_pairs(line: str) -> Optional[List[Tuple[int, int]]]:
-    m = re.search(r"source_target_pairs=\{([^}]*(?:\},\{[^}]*)*)\}\}", line)
-    if m is None:
-        m = re.search(r"source_target_pairs=\{(.*?)\}\}", line)
+    m = re.search(r"source_target_pairs=\{((?:\{\d+,\d+\},?)*)\}", line)
     if m is None:
         return None
+    # findall over the MATCHED group only — the rest of the line contains
+    # `{1,0}`-shaped layout suffixes that are not pairs
     return [tuple(int(v) for v in p.split(","))
-            for p in re.findall(r"\{(\d+,\d+)\}", line)]
+            for p in re.findall(r"\{(\d+,\d+)\}", m.group(1))]
 
 
 def _axis_groups(mesh_shape: Dict[str, int],
@@ -139,20 +152,26 @@ def collective_inventory(hlo_text: str, mesh=None) -> List[Dict]:
     Async ``-start``/``-done`` pairs are counted once (at the start).
     """
     mesh_shape = dict(mesh.shape) if mesh is not None else None
+    # anchor on the opcode token itself: result shapes carry layout
+    # suffixes with nested parens (`{2,1,0:T(8,128)(2,1)S(1)}`), so a
+    # shape-first regex silently drops ops (found the hard way: 35 of the
+    # DP-ResNet step's 96 all-reduces)
+    op_re = re.compile(
+        r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.*?)\s"
+        r"((?:" + "|".join(_COLLECTIVE_OPS) + r")(?:-start|-done)?)\(")
     out: List[Dict] = []
     for line in hlo_text.splitlines():
         stripped = line.strip()
-        m = re.match(
-            r"(?:ROOT\s+)?%?[\w.\-]+\s*=\s*((?:\([^)]*\))|(?:\w+\[[^\]]*\]))"
-            r"\s+([\w\-]+)\(", stripped)
+        m = op_re.match(stripped)
         if m is None:
             continue
         shape_text, opname = m.group(1), m.group(2)
-        base = opname[:-6] if opname.endswith("-start") else opname
-        if base not in _COLLECTIVE_OPS or opname.endswith("-done"):
-            continue
+        if opname.endswith("-done"):
+            continue  # counted once, at the -start
+        is_start = opname.endswith("-start")
+        base = opname[:-6] if is_start else opname
         entry = {"op": base, "shape": shape_text,
-                 "bytes": _shape_bytes(shape_text),
+                 "bytes": _shape_bytes(shape_text, async_start=is_start),
                  "groups": None, "axes": None}
         pairs = _parse_pairs(stripped) if base == "collective-permute" else None
         groups = _parse_groups(stripped)
@@ -178,6 +197,70 @@ def summarize_by_axis(inventory: List[Dict]) -> Dict[Tuple[str, ...], Dict]:
         s["bytes"] += e["bytes"]
         s["ops"][e["op"]] = s["ops"].get(e["op"], 0) + 1
     return summary
+
+
+# ---------------------------------------------------------------------------
+# Canonical audited programs: ONE definition of the ladder steps whose
+# collective schedules the tests pin and SCALING.md reports — the test
+# suite and benchmarks/collective_audit.py both import these, so the
+# pinned inventory and the printed tables always describe the same program.
+# ---------------------------------------------------------------------------
+
+
+def build_dp_resnet_compiled(n_devices: int = 8, batch: int = 16):
+    """Compile the DP ResNet18 fused train step over an n-device dp mesh.
+    Returns (hlo_text, mesh, model, step, (x, y)) — the step is compiled
+    but NOT executed."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.vision.models import resnet18
+
+    from .api import ProcessMesh, shard_layer
+
+    pm = ProcessMesh(np.arange(n_devices), ["dp"])
+    model = resnet18(num_classes=10)
+    model.train()
+    shard_layer(model, pm)  # replicate params+buffers on the mesh
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    ce = nn.CrossEntropyLoss()
+    step = paddle.jit.fused_train_step(lambda x, y: ce(model(x), y), opt,
+                                       model=model)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(jax.device_put(
+        rng.rand(batch, 3, 32, 32).astype(np.float32),
+        NamedSharding(pm.mesh, PartitionSpec("dp"))))
+    y = paddle.to_tensor(jax.device_put(
+        rng.randint(0, 10, (batch,)),
+        NamedSharding(pm.mesh, PartitionSpec("dp"))))
+    step.compile(x, y)
+    entry = next(iter(step._cache.values()))
+    return entry._compiled.as_text(), pm.mesh, model, step, (x, y)
+
+
+def build_llama_hybrid_compiled(n_devices: int = 8):
+    """Compile the LLaMA-tiny ZeRO-3 + TP step over dp=2 x sharding=2 x
+    mp=(n/4). Returns (hlo_text, mesh). Caller must reset the global mesh
+    (``parallel.set_mesh(None)``) when done."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.parallel import create_hybrid_mesh
+
+    cfg = llama.LlamaConfig.tiny(sharding_stage=3)
+    mesh = create_hybrid_mesh(dp=2, sharding=2, mp=n_devices // 4,
+                              devices=jax.devices()[:n_devices])
+    step = llama.make_sharded_train_step(cfg, mesh, lr=1e-3)
+    params = llama.init_params(cfg)
+    opt = llama.init_opt_state(params)
+    toks = jnp.array(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (8, 32)), jnp.int32)
+    txt = step.lower(params, opt, toks, toks).compile().as_text()
+    return txt, mesh
 
 
 def format_inventory(inventory: List[Dict]) -> str:

@@ -33,7 +33,16 @@ class MNIST(Dataset):
                  backend="cv2", synthetic_size=None):
         self.mode = mode.lower()
         self.transform = transform
-        if image_path and label_path:
+        if image_path or label_path:
+            # both-or-neither: one supplied path with the other omitted is
+            # the same typo class as a missing file — silently training on
+            # synthetic blobs would mask it
+            if not (image_path and label_path):
+                raise ValueError(
+                    f"{type(self).__name__}: image_path and label_path "
+                    f"must be given together (got {image_path!r}, "
+                    f"{label_path!r}); omit both for the synthetic "
+                    f"offline fallback)")
             if not (os.path.exists(image_path) and os.path.exists(label_path)):
                 raise FileNotFoundError(
                     f"{type(self).__name__}: image_path/label_path "
