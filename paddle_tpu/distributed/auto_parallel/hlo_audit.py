@@ -40,10 +40,10 @@ _COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
                    "all-to-all", "collective-permute", "collective-broadcast")
 
 
-def _shape_member_bytes(shape_text: str) -> List[int]:
-    """Byte size of each array member in a result-shape string. Layout
-    suffixes (``{1,0:T(8,128)(2,1)S(1)}``) contain no brackets, so the
-    dtype[dims] matches are exactly the array members."""
+def _shape_member_bytes(shape_text: str) -> List[Tuple[int, bool]]:
+    """(bytes, is_scalar) of each array member in a result-shape string.
+    Layout suffixes (``{1,0:T(8,128)(2,1)S(1)}``) contain no brackets, so
+    the dtype[dims] matches are exactly the array members."""
     out = []
     for dtype, dims in _SHAPE_RE.findall(shape_text):
         if dtype not in _DTYPE_BYTES:
@@ -52,18 +52,23 @@ def _shape_member_bytes(shape_text: str) -> List[int]:
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        out.append(n * _DTYPE_BYTES[dtype])
+        out.append((n * _DTYPE_BYTES[dtype], not dims))
     return out
 
 
 def _shape_bytes(shape_text: str, async_start: bool = False) -> int:
     members = _shape_member_bytes(shape_text)
     if async_start and len(members) >= 2:
-        # async `-start` results are (aliased inputs..., outputs...) —
-        # counting every member would double the payload. Outputs are the
-        # trailing half (heuristic; exact aliasing isn't in the text).
-        members = members[len(members) // 2:]
-    return sum(members)
+        # async `-start` results are (aliased inputs..., outputs...),
+        # possibly followed by scalar context members (collective-permute
+        # -start carries two u32[] sync flags). Drop the scalar contexts
+        # FIRST, then count the trailing (output) half — counting every
+        # member would double the payload, and counting the contexts as
+        # "the outputs" once undercounted a permute's payload ~500x.
+        arrays = [b for b, scalar in members if not scalar]
+        if arrays:
+            return sum(arrays[len(arrays) // 2:])
+    return sum(b for b, _ in members)
 
 
 def _parse_groups(line: str) -> Optional[List[Tuple[int, ...]]]:

@@ -106,15 +106,6 @@ def get_group(gid: int = 0) -> Group:
     return _groups[gid]
 
 
-def _axis_in_scope(name: str) -> bool:
-    """True when called under shard_map with this axis name bound."""
-    try:
-        jax.lax.axis_index(name)
-        return True
-    except (NameError, KeyError, Exception):
-        return False
-
-
 def _unwrap(t):
     return t._value if isinstance(t, Tensor) else t
 

@@ -188,6 +188,21 @@ class PsServer:
         with self._bar_lock:
             return self._bar.get(key, 0)
 
+    def _op_barrier_abort(self, key, world):
+        """Retract one arrival (a client timing out takes its arrival back
+        so the NEXT generation on this key isn't off by one — the r2
+        footgun of a stale arrival poisoning the counter). GENERATION-
+        AWARE, atomically under the lock: if the counter shows the
+        aborter's generation actually COMPLETED (a late peer arrived
+        between the client's last poll and this abort), the arrival was
+        consumed by a successful barrier and must NOT be retracted —
+        decrementing a completed generation would skew every later one."""
+        with self._bar_lock:
+            n = self._bar.get(key, 0)
+            if n > 0 and n % world != 0:  # current generation incomplete
+                self._bar[key] = n - 1
+            return self._bar.get(key, 0)
+
     def stop(self):
         self._srv.shutdown()
         self._srv.server_close()
@@ -254,8 +269,9 @@ class PsClient:
         BrpcPsClient barrier). REUSABLE: the server counter is monotonic,
         so arrival n belongs to generation (n-1)//world and waits until
         the whole generation arrived — per-epoch barriers on one key work.
-        (A TimeoutError leaves a stale arrival behind; re-create the
-        server-side key rather than retrying the same generation.)"""
+        On timeout the arrival is RETRACTED (barrier_abort) before the
+        TimeoutError propagates, so a later generation on the same key
+        isn't off by one."""
         import time as _time
 
         n = self._call("barrier", key, world)
@@ -263,6 +279,9 @@ class PsClient:
         deadline = _time.time() + timeout
         while self._call("barrier_stat", key) < target:
             if _time.time() > deadline:
+                # take the arrival back (no-op server-side if a late peer
+                # completed the generation in the meantime)
+                self._call("barrier_abort", key, world)
                 raise TimeoutError(f"ps barrier {key!r} timed out")
             _time.sleep(0.02)
 

@@ -42,7 +42,7 @@ class MNIST(Dataset):
                     f"{type(self).__name__}: image_path and label_path "
                     f"must be given together (got {image_path!r}, "
                     f"{label_path!r}); omit both for the synthetic "
-                    f"offline fallback)")
+                    f"offline fallback")
             if not (os.path.exists(image_path) and os.path.exists(label_path)):
                 raise FileNotFoundError(
                     f"{type(self).__name__}: image_path/label_path "

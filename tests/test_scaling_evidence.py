@@ -179,3 +179,20 @@ class TestLadderCollectiveInventory:
             assert sum(by_axis[k]["bytes"] for k in data_keys) > 0
         finally:
             set_mesh(None)
+
+
+class TestHloAuditAsyncContexts:
+    def test_permute_start_context_scalars_excluded(self):
+        """collective-permute-start's result is (in, out, u32[], u32[]) —
+        the scalar sync contexts must not be mistaken for the output half
+        (that once reported 8 bytes for a 4 KiB permute)."""
+        from paddle_tpu.distributed.auto_parallel.hlo_audit import (
+            collective_inventory)
+
+        hlo = ("  %cps = (f32[1024]{0}, f32[1024]{0}, u32[], u32[]) "
+               "collective-permute-start(f32[1024]{0} %x), "
+               "source_target_pairs={{0,1},{1,0}}\n"
+               "  %cpd = f32[1024]{0} collective-permute-done(%cps)\n")
+        inv = collective_inventory(hlo)
+        assert len(inv) == 1
+        assert inv[0]["bytes"] == 1024 * 4
