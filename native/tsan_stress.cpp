@@ -1,0 +1,147 @@
+// ThreadSanitizer stress harness for the native runtime (tcp_store +
+// data_loader queue).
+//
+// Reference counterpart: the reference's CI runs its C++ distributed store
+// under sanitizers (SURVEY.md §5.2 "race detection"); this binary is the
+// equivalent evidence for the TPU-native runtime: N client threads hammer
+// one store daemon with concurrent SET/GET/ADD/WAIT/DELETE plus a
+// barrier-like ADD/WAIT pattern while producer/consumer threads cycle the
+// prefetch queue. Built with -fsanitize=thread (`make -C native tsan`);
+// tests/test_native_launch.py runs it and fails on any TSAN report.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* tcp_store_server_start(int port);
+int tcp_store_server_port(void* h);
+void tcp_store_server_stop(void* h);
+void* tcp_store_client_connect(const char* host, int port, int timeout_ms);
+void tcp_store_client_close(void* h);
+int tcp_store_set(void* h, const char* key, const uint8_t* data, int len);
+int tcp_store_get(void* h, const char* key, int timeout_ms, uint8_t* buf,
+                  int buflen);
+long long tcp_store_add(void* h, const char* key, long long delta);
+int tcp_store_wait(void* h, const char* key, int timeout_ms);
+int tcp_store_delete(void* h, const char* key);
+long long tcp_store_num_keys(void* h);
+
+void* dl_queue_create(int capacity);
+int dl_queue_push(void* q, const uint8_t* data, int len, int timeout_ms);
+int dl_queue_pop(void* q, uint8_t* buf, int buflen, int timeout_ms);
+void dl_queue_close(void* q);
+void dl_queue_destroy(void* q);
+}
+
+namespace {
+
+std::atomic<int> failures{0};
+
+void check(bool ok, const char* what) {
+  if (!ok) {
+    std::fprintf(stderr, "FAIL: %s\n", what);
+    failures.fetch_add(1);
+  }
+}
+
+void store_worker(int port, int rank, int n_ranks, int iters) {
+  void* c = tcp_store_client_connect("127.0.0.1", port, 5000);
+  check(c != nullptr, "client connect");
+  if (!c) return;
+  char key[64];
+  uint8_t buf[256];
+  for (int i = 0; i < iters; ++i) {
+    // private key churn: set / get / delete
+    std::snprintf(key, sizeof key, "k-%d-%d", rank, i % 8);
+    std::string val = "v" + std::to_string(rank * 1000 + i);
+    check(tcp_store_set(c, key, (const uint8_t*)val.data(),
+                        (int)val.size()) == 0, "set");
+    int n = tcp_store_get(c, key, 2000, buf, sizeof buf);
+    check(n >= 0, "get");
+    // shared counter: every rank increments the same key
+    tcp_store_add(c, "shared-counter", 1);
+    if (i % 16 == 0) tcp_store_delete(c, key);
+    // barrier-ish generation sync every 32 iterations
+    if (i % 32 == 31) {
+      long long gen = i / 32;
+      std::string bkey = "bar-" + std::to_string(gen);
+      long long arrived = tcp_store_add(c, bkey.c_str(), 1);
+      if (arrived == n_ranks) {
+        std::string done = "done-" + std::to_string(gen);
+        uint8_t one = 1;
+        tcp_store_set(c, done.c_str(), &one, 1);
+      } else {
+        std::string done = "done-" + std::to_string(gen);
+        check(tcp_store_wait(c, done.c_str(), 5000) == 0, "barrier wait");
+      }
+    }
+  }
+  tcp_store_client_close(c);
+}
+
+void queue_producer(void* q, int iters) {
+  uint8_t blob[512];
+  std::memset(blob, 7, sizeof blob);
+  for (int i = 0; i < iters; ++i)
+    check(dl_queue_push(q, blob, sizeof blob, 5000) == 0, "queue push");
+}
+
+void queue_consumer(void* q, int iters) {
+  uint8_t buf[1024];
+  for (int i = 0; i < iters; ++i)
+    check(dl_queue_pop(q, buf, sizeof buf, 5000) >= 0, "queue pop");
+}
+
+}  // namespace
+
+int main() {
+  void* srv = tcp_store_server_start(0);
+  if (!srv) {
+    std::fprintf(stderr, "FAIL: server start\n");
+    return 1;
+  }
+  int port = tcp_store_server_port(srv);
+
+  const int n_ranks = 8, iters = 256;
+  std::vector<std::thread> ts;
+  for (int r = 0; r < n_ranks; ++r)
+    ts.emplace_back(store_worker, port, r, n_ranks, iters);
+
+  void* q = dl_queue_create(4);
+  const int qiters = 2000;
+  std::thread prod1(queue_producer, q, qiters);
+  std::thread prod2(queue_producer, q, qiters);
+  std::thread cons1(queue_consumer, q, qiters);
+  std::thread cons2(queue_consumer, q, qiters);
+
+  for (auto& t : ts) t.join();
+  prod1.join();
+  prod2.join();
+  cons1.join();
+  cons2.join();
+  dl_queue_close(q);
+  dl_queue_destroy(q);
+
+  // the shared counter must equal exactly ranks x iters (atomic ADDs)
+  void* c = tcp_store_client_connect("127.0.0.1", port, 5000);
+  uint8_t buf[64];
+  int n = tcp_store_get(c, "shared-counter", 2000, buf, sizeof buf);
+  long long counter = 0;
+  if (n == 8) std::memcpy(&counter, buf, 8);  // ADD stores LE int64
+  check(counter == (long long)n_ranks * iters, "shared counter total");
+  tcp_store_client_close(c);
+  tcp_store_server_stop(srv);
+
+  if (failures.load()) {
+    std::fprintf(stderr, "tsan_stress: %d failures\n", failures.load());
+    return 1;
+  }
+  std::printf("tsan_stress OK: %d ranks x %d iters, counter=%lld\n",
+              n_ranks, iters, counter);
+  return 0;
+}
