@@ -129,12 +129,15 @@ int main() {
 
   // the shared counter must equal exactly ranks x iters (atomic ADDs)
   void* c = tcp_store_client_connect("127.0.0.1", port, 5000);
-  uint8_t buf[64];
-  int n = tcp_store_get(c, "shared-counter", 2000, buf, sizeof buf);
+  check(c != nullptr, "final verify connect");
   long long counter = 0;
-  if (n == 8) std::memcpy(&counter, buf, 8);  // ADD stores LE int64
+  if (c) {
+    uint8_t buf[64];
+    int n = tcp_store_get(c, "shared-counter", 2000, buf, sizeof buf);
+    if (n == 8) std::memcpy(&counter, buf, 8);  // ADD stores LE int64
+    tcp_store_client_close(c);
+  }
   check(counter == (long long)n_ranks * iters, "shared counter total");
-  tcp_store_client_close(c);
   tcp_store_server_stop(srv);
 
   if (failures.load()) {

@@ -403,7 +403,6 @@ def test_native_tsan_stress():
     with -fsanitize=thread and hammers them from 12 threads. Any data
     race makes TSAN print a report and exit non-zero."""
     import shutil
-    import subprocess
 
     if shutil.which("g++") is None:
         pytest.skip("no g++ in this environment")
@@ -411,6 +410,10 @@ def test_native_tsan_stress():
     proc = subprocess.run(["make", "-C", "native", "tsan"], cwd=root,
                           capture_output=True, text=True, timeout=300)
     out = proc.stdout + proc.stderr
+    if proc.returncode != 0 and ("libtsan" in out or "cannot find -ltsan"
+                                 in out or "fsanitize=thread" in out
+                                 and "unrecognized" in out):
+        pytest.skip("toolchain lacks ThreadSanitizer support")
     assert proc.returncode == 0, out[-2000:]
     assert "ThreadSanitizer" not in out, out[-2000:]
     assert "tsan_stress OK" in out
