@@ -1,0 +1,74 @@
+"""Capture an xplane profile of the headline train step and print the top
+HLO instructions by device time (finer than the profiler's opcode table:
+raw per-instruction totals, so dW vs dx vs flash kernels are separable).
+
+Usage: python benchmarks/step_profile.py [batch] [top_n]
+"""
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 44
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    seq = 512
+    from paddle_tpu.models import llama
+    from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
+
+    cfg = llama.LlamaConfig.bert_base_equiv(max_seq_len=seq)
+    mesh = create_hybrid_mesh(devices=jax.devices()[:1])
+    params = llama.init_params(cfg)
+    opt_state = llama.init_opt_state(params)
+    rng = np.random.RandomState(0)
+    tokens = jnp.array(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    step = llama.make_sharded_train_step(cfg, mesh, lr=1e-4)
+    params, opt_state, loss = step(params, opt_state, tokens, tokens)
+    float(loss)
+    params, opt_state, loss = step(params, opt_state, tokens, tokens)
+    float(loss)
+
+    tmp = tempfile.mkdtemp(prefix="xplane_")
+    n_steps = 6
+    with jax.profiler.trace(tmp):
+        for _ in range(n_steps):
+            params, opt_state, loss = step(params, opt_state, tokens, tokens)
+        float(loss)
+    set_mesh(None)
+
+    from paddle_tpu.profiler import _xplane
+    path = _xplane.latest_xplane(tmp)
+    assert path, f"no xplane in {tmp}"
+    from jax.profiler import ProfileData
+    pd = ProfileData.from_file(path)
+    agg = {}
+    total = 0.0
+    for plane in pd.planes:
+        if not plane.name.startswith("/device:"):
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                name = ev.name.split(" ", 1)[0]
+                a = agg.setdefault(name, [0, 0.0])
+                a[0] += 1
+                a[1] += ev.duration_ns
+                total += ev.duration_ns
+    print(f"batch {batch}: {len(agg)} distinct HLO instrs, "
+          f"{total/1e6/n_steps:.1f} ms device time/step")
+    print(f"{'instr':<58} {'calls':>6} {'ms/step':>8} {'share':>6}")
+    for name, (c, ns) in sorted(agg.items(), key=lambda kv: -kv[1][1])[:top_n]:
+        print(f"{name[:58]:<58} {c:>6} {ns/1e6/n_steps:>8.3f} "
+              f"{ns/total:>6.1%}")
+
+
+if __name__ == "__main__":
+    main()

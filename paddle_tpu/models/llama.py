@@ -72,6 +72,24 @@ class LlamaConfig:
     # single-chip chunked cross-entropy: head+CE recomputed per batch-chunk
     # so [B,S,V] logits never materialise (0 = off; see loss_fn)
     ce_chunks: int = 0
+    # perf experiment knob: comma-joined set of backward-cotangent barrier
+    # sites ('mlp', 'qkv', 'logits') — forces the named cotangents to
+    # MATERIALISE once instead of letting XLA re-fuse their elementwise
+    # chains into both consumer dots (dW and dx). See _barrier_grad.
+    bwd_barriers: str = ""
+    # store wq/wk/wv as ONE stacked [H, H+2*Hkv] matrix and w_gate/w_up
+    # as [H, 2F]: one projection dot with a wider N instead of three/two
+    # (fewer MXU ramp-ups, one dW instead of three in the bwd). The split
+    # into q/k/v (gate/up) is a free minor-dim slice of the dot output.
+    # r3's measured LOSS on this idea concatenated the weights PER STEP;
+    # storing them fused removes that cost from the step entirely.
+    fused_weights: bool = False
+    # AMP-O2 gradient dtype: differentiate w.r.t. the bf16 param VIEW so
+    # grads stay bf16 end-to-end (half the HBM traffic in the dW writes,
+    # global-norm pass, and AdamW reads); the fp32 master weights are only
+    # touched by the optimizer. Matches the reference's O2 GradScaler
+    # contract (fp16/bf16 grads + fp32 master params).
+    bf16_grads: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -119,7 +137,7 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, P]:
     ZeRO stage 3 additionally shards the non-mp dim over ('dp','sharding').
     """
     zdim = ("dp", "sharding") if cfg.sharding_stage >= 3 else None
-    return {
+    specs = {
         "embed": P("mp", zdim),                    # [V, H]
         "wq": P(None, zdim, "mp"),                 # [L, H, H]
         "wk": P(None, zdim, "mp"),                 # [L, H, Hkv]
@@ -133,6 +151,20 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, P]:
         "ln_f": P(None),                           # [H]
         "lm_head": P(zdim, "mp"),                  # [H, V]
     }
+    return _fuse_keys(cfg, specs)
+
+
+def _fuse_keys(cfg: "LlamaConfig", d: Dict[str, Any]) -> Dict[str, Any]:
+    """Rewrite a per-key dict to the fused_weights param tree: wq/wk/wv →
+    wqkv, w_gate/w_up → w_gate_up (the fused matrices share wq's spec —
+    the stacked minor dim stays the 'column' TP dim)."""
+    if not cfg.fused_weights:
+        return d
+    out = {k: v for k, v in d.items()
+           if k not in ("wq", "wk", "wv", "w_gate", "w_up")}
+    out["wqkv"] = d["wq"]
+    out["w_gate_up"] = d["w_gate"]
+    return out
 
 
 def opt_state_specs(cfg: LlamaConfig) -> Dict[str, P]:
@@ -142,7 +174,7 @@ def opt_state_specs(cfg: LlamaConfig) -> Dict[str, P]:
     if cfg.sharding_stage < 1:
         return param_specs(cfg)
     z = ("dp", "sharding")
-    return {
+    return _fuse_keys(cfg, {
         "embed": P("mp", z),
         "wq": P(None, z, "mp"),
         "wk": P(None, z, "mp"),
@@ -155,7 +187,7 @@ def opt_state_specs(cfg: LlamaConfig) -> Dict[str, P]:
         "ln_mlp": P(None, z),
         "ln_f": P(z),
         "lm_head": P(z, "mp"),
-    }
+    })
 
 
 def init_params(cfg: LlamaConfig, key: Optional[jax.Array] = None,
@@ -170,7 +202,7 @@ def init_params(cfg: LlamaConfig, key: Optional[jax.Array] = None,
     ks = jax.random.split(key, 12)
     s = lambda fan_in: 1.0 / np.sqrt(fan_in)
     n = jax.random.normal
-    return {
+    out = {
         "embed": (n(ks[0], (V, H)) * 0.02).astype(dtype),
         "wq": (n(ks[1], (L, H, H)) * s(H)).astype(dtype),
         "wk": (n(ks[2], (L, H, Hkv)) * s(H)).astype(dtype),
@@ -184,6 +216,12 @@ def init_params(cfg: LlamaConfig, key: Optional[jax.Array] = None,
         "ln_f": jnp.ones((H,), dtype),
         "lm_head": (n(ks[8], (H, V)) * s(H)).astype(dtype),
     }
+    if cfg.fused_weights:
+        out["wqkv"] = jnp.concatenate(
+            [out.pop("wq"), out.pop("wk"), out.pop("wv")], axis=-1)
+        out["w_gate_up"] = jnp.concatenate(
+            [out.pop("w_gate"), out.pop("w_up")], axis=-1)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -194,6 +232,22 @@ def _rms_norm(x, w, eps):
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w.astype(x.dtype)
+
+
+@jax.custom_vjp
+def _barrier_grad(x):
+    """Identity whose COTANGENT is fenced with an optimization_barrier.
+
+    XLA fuses an elementwise backward chain (silu', rope shuffles, softmax
+    recompute) into EVERY consumer dot's operand window, re-running it per
+    dot; fencing the cotangent forces one materialisation that both the dW
+    and dx dots then read. Whether that trade wins is shape-dependent —
+    gate it with LlamaConfig.bwd_barriers and measure (benchmarks/perf_lab)."""
+    return x
+
+
+_barrier_grad.defvjp(lambda x: (x, None),
+                     lambda _, g: (jax.lax.optimization_barrier(g),))
 
 
 def _rope_at(x, theta, positions):
@@ -233,9 +287,20 @@ def _qkv_proj(cfg: LlamaConfig, x, lp, positions=None):
     if positions is None:
         positions = jnp.arange(S)
     h = _rms_norm(x, lp["ln_attn"], cfg.rms_eps)
-    q = (h @ lp["wq"].astype(dt)).reshape(B, S, cfg.num_heads, cfg.head_dim)
-    k = (h @ lp["wk"].astype(dt)).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-    v = (h @ lp["wv"].astype(dt)).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    Hq = cfg.num_heads * cfg.head_dim
+    Hkv = cfg.num_kv_heads * cfg.head_dim
+    if cfg.fused_weights:
+        z = h @ lp["wqkv"].astype(dt)
+        zq, zk, zv = (z[..., :Hq], z[..., Hq:Hq + Hkv], z[..., Hq + Hkv:])
+    else:
+        zq = h @ lp["wq"].astype(dt)
+        zk = h @ lp["wk"].astype(dt)
+        zv = h @ lp["wv"].astype(dt)
+    if "qkv" in cfg.bwd_barriers:
+        zq, zk, zv = map(_barrier_grad, (zq, zk, zv))
+    q = zq.reshape(B, S, cfg.num_heads, cfg.head_dim)
+    k = zk.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    v = zv.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
     q = _rope_at(q, cfg.rope_theta, positions)
     k = _rope_at(k, cfg.rope_theta, positions)
     return q, k, v
@@ -263,8 +328,17 @@ def _layer_post(cfg: LlamaConfig, x, attn, lp):
     attn = attn.reshape(B, S, H)
     x = x + wsc(attn @ lp["wo"].astype(dt), _act_spec(cfg))
     h = _rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
-    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
-    up = h @ lp["w_up"].astype(dt)
+    if cfg.fused_weights:
+        F_ = cfg.intermediate_size
+        zz = h @ lp["w_gate_up"].astype(dt)
+        zg, up = zz[..., :F_], zz[..., F_:]
+    else:
+        zg = h @ lp["w_gate"].astype(dt)
+        up = h @ lp["w_up"].astype(dt)
+    if "mlp" in cfg.bwd_barriers:
+        zg = _barrier_grad(zg)
+        up = _barrier_grad(up)
+    gate = jax.nn.silu(zg)
     x = x + wsc((gate * up) @ lp["w_down"].astype(dt), _act_spec(cfg))
     return x
 
@@ -300,7 +374,7 @@ def forward_hidden(params: Dict[str, jax.Array], tokens: jax.Array,
     x = params["embed"].astype(dt)[tokens]
     x = wsc(x, _act_spec(cfg))
 
-    layer_weights = {k: params[k] for k in _LAYER_KEYS}
+    layer_weights = {k: params[k] for k in layer_keys(cfg)}
 
     if cfg.remat and _flash_path_active():
         # Flash-path remat structure: checkpoint the two matmul halves but
@@ -398,8 +472,11 @@ def loss_fn(params, tokens, labels, cfg: LlamaConfig) -> jax.Array:
             [jnp.ones((S - 1,), jnp.float32), jnp.zeros((1,), jnp.float32)])
         hc = h.reshape(nc, B // nc, S, h.shape[-1])
         tc = targets.reshape(nc, B // nc, S)
+        logit_bar = ("logits" in cfg.bwd_barriers)
         body = jax.checkpoint(
-            lambda hcb, tcb: _nll_sum(hcb @ W, tcb, wgt[None, :]))
+            lambda hcb, tcb: _nll_sum(
+                _barrier_grad(hcb @ W) if logit_bar else hcb @ W,
+                tcb, wgt[None, :]))
         total = jnp.float32(0.0)
         for i in range(nc):
             total = total + body(hc[i], tc[i])
@@ -409,6 +486,8 @@ def loss_fn(params, tokens, labels, cfg: LlamaConfig) -> jax.Array:
     # has no next-token label and needn't be scored at all)
     logits = wsc(h[:, :-1] @ params["lm_head"].astype(dt),
                  P(("dp", "sharding"), None, "mp"))
+    if "logits" in cfg.bwd_barriers:
+        logits = _barrier_grad(logits)
     targets = labels[:, 1:]
     return _nll_sum(logits, targets, jnp.float32(1.0)) / (B * (S - 1))
 
@@ -432,6 +511,12 @@ NO_DECAY_KEYS = ("ln_attn", "ln_mlp", "ln_f", "embed")
 # training forward and the KV-cache decode path slice from
 _LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
                "ln_attn", "ln_mlp")
+_LAYER_KEYS_FUSED = ("wqkv", "wo", "w_gate_up", "w_down",
+                     "ln_attn", "ln_mlp")
+
+
+def layer_keys(cfg: LlamaConfig):
+    return _LAYER_KEYS_FUSED if cfg.fused_weights else _LAYER_KEYS
 
 
 def adamw_update(params, grads, opt_state, lr=3e-4, beta1=0.9, beta2=0.95,
@@ -466,13 +551,23 @@ def adamw_update(params, grads, opt_state, lr=3e-4, beta1=0.9, beta2=0.95,
 def train_step(params, opt_state, tokens, labels, cfg: LlamaConfig,
                lr=3e-4):
     """One full step: fwd, bwd, global-norm clip, AdamW. Pure → jit it."""
-    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels, cfg)
+    if cfg.bf16_grads:
+        # differentiate w.r.t. the bf16 view: the fwd is numerically
+        # IDENTICAL (every use site casts to cfg.dtype anyway) but the
+        # cotangents stay bf16 — no [params]-sized fp32 convert pass
+        diff = jax.tree.map(lambda p: p.astype(cfg.dtype)
+                            if p.dtype == jnp.float32 else p, params)
+        loss, grads = jax.value_and_grad(loss_fn)(diff, tokens, labels, cfg)
+    else:
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels, cfg)
     # HybridParallelClipGrad analog: global norm across ALL parallel axes
     # (GSPMD reduces over every mesh axis for free)
     gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                          for g in jax.tree.leaves(grads)))
     clip = jnp.minimum(1.0, 1.0 / (gnorm + 1e-6))
-    grads = jax.tree.map(lambda g: g * clip, grads)
+    # keep each leaf's dtype: a strong fp32 scalar would PROMOTE bf16
+    # grads to fp32 (defeating bf16_grads' traffic contract)
+    grads = jax.tree.map(lambda g: g * clip.astype(g.dtype), grads)
     params, opt_state = adamw_update(params, grads, opt_state, lr=lr)
     return params, opt_state, loss
 
@@ -574,7 +669,7 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos,
     if ragged and T != 1:
         raise ValueError("per-slot pos requires single-token decode (T=1)")
     positions = pos[:, None] if ragged else pos + jnp.arange(T)
-    layer_weights = {kk: params[kk] for kk in _LAYER_KEYS}
+    layer_weights = {kk: params[kk] for kk in layer_keys(cfg)}
 
     def body(x, per_layer):
         lp, kc, vc = per_layer

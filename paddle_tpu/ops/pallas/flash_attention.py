@@ -519,7 +519,7 @@ def _packed_eligible(q, k) -> int:
 _LOG2_E = float(np.log2(np.e))
 
 
-def _make_packed_fwd(S, d, hp, is_causal):
+def _make_packed_fwd(S, d, hp, is_causal, q_cst=1.0):
     """Packed forward in the BASE-2 domain: the caller folds
     ``scale * log2(e)`` into q, so the score matrix arrives pre-multiplied
     and the softmax runs on ``exp2`` directly — one fewer VPU multiply per
@@ -527,13 +527,15 @@ def _make_packed_fwd(S, d, hp, is_causal):
     Probabilities are identical: ``2^(c*s - c*m) == e^(s - m)``. The saved
     lse is ALSO base-2 (``m2 + log2(l)``); the packed backward consumes it
     in the same domain."""
-    return _make_packed_fwd_general(S, S, 0, d, hp, is_causal)
+    return _make_packed_fwd_general(S, S, 0, d, hp, is_causal, q_cst=q_cst)
 
 
-def _make_packed_fwd_general(Sq, Sk, q_off, d, hp, is_causal):
+def _make_packed_fwd_general(Sq, Sk, q_off, d, hp, is_causal, q_cst=1.0):
     """Packed forward over a [Sq, Sk] score tile: q rows sit at absolute
     positions ``q_off + i``, k columns at ``j`` (k is always a prefix of
-    the sequence in the split-causal decomposition)."""
+    the sequence in the split-causal decomposition). ``q_cst`` is the
+    scale*log2(e) fold applied IN-KERNEL on the narrow [Sq, d] q tile —
+    an XLA-level prescale pass would touch the full [B, S, H*D] array."""
     def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
         if is_causal:
             qp = q_off + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
@@ -541,7 +543,9 @@ def _make_packed_fwd_general(Sq, Sk, q_off, d, hp, is_causal):
             causal = qp >= kp  # hoisted: shared by all heads in the cell
         for i in range(hp):
             sl = slice(i * d, (i + 1) * d)
-            q = q_ref[0, :, sl]  # PRE-SCALED by scale*log2(e), [Sq, d]
+            q = q_ref[0, :, sl]  # [Sq, d]
+            if q_cst != 1.0:
+                q = (q * q_cst).astype(q_ref.dtype)
             k = k_ref[0, :, sl]
             v = v_ref[0, :, sl]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -559,7 +563,7 @@ def _make_packed_fwd_general(Sq, Sk, q_off, d, hp, is_causal):
     return kernel
 
 
-def _make_packed_bwd(S, d, hp, is_causal, scale):
+def _make_packed_bwd(S, d, hp, is_causal, scale, q_cst=1.0):
     """Fused dq/dk/dv: one probability recompute serves all three grads
     (the blocked path pays it twice across its dq and dkv kernels).
 
@@ -570,10 +574,12 @@ def _make_packed_bwd(S, d, hp, is_causal, scale):
     chain rule per input: dq = (ds @ k) * scale (w.r.t. UNSCALED q),
     dk = ds^T @ q_scaled / log2(e) (the pre-fold over-scales q by log2(e),
     divided back out on the narrow [S, d] result)."""
-    return _make_packed_bwd_general(S, S, 0, d, hp, is_causal, scale)
+    return _make_packed_bwd_general(S, S, 0, d, hp, is_causal, scale,
+                                    q_cst=q_cst)
 
 
-def _make_packed_bwd_general(Sq, Sk, q_off, d, hp, is_causal, scale):
+def _make_packed_bwd_general(Sq, Sk, q_off, d, hp, is_causal, scale,
+                             q_cst=1.0):
     """Fused dq + dk/dv over a [Sq, Sk] score tile (q rows at absolute
     positions ``q_off + i``; k a sequence prefix). In the split-causal
     decomposition a call's dk/dv are PARTIAL (only its q rows' share);
@@ -588,7 +594,10 @@ def _make_packed_bwd_general(Sq, Sk, q_off, d, hp, is_causal, scale):
             causal = qp >= kp  # hoisted: shared by all heads in the cell
         for i in range(hp):
             sl = slice(i * d, (i + 1) * d)
-            q = q_ref[0, :, sl]  # PRE-SCALED by scale*log2(e)
+            q = q_ref[0, :, sl]
+            if q_cst != 1.0:
+                # scale*log2(e) fold, in-kernel on the narrow [Sq, d] tile
+                q = (q * q_cst).astype(q_ref.dtype)
             k = k_ref[0, :, sl]
             v = v_ref[0, :, sl]
             do = do_ref[0, :, sl]
@@ -627,15 +636,16 @@ def _pallas_flash_fwd_packed(q, k, v, is_causal, scale=None):
     G = h // hp
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     hd = h * d
-    # base-2 domain: scale*log2(e) folded into q (see _make_packed_fwd)
-    qf = (q * (scale * _LOG2_E)).astype(q.dtype).reshape(b, S, hd)
+    # base-2 domain: scale*log2(e) folded into q INSIDE the kernel (an
+    # XLA-level prescale would be a full [B, S, H*D] elementwise pass)
+    qf = q.reshape(b, S, hd)
     kf = k.reshape(b, S, hd)
     vf = v.reshape(b, S, hd)
     blk = pl.BlockSpec((1, S, hp * d), lambda bb, g: (bb, 0, g))
     from jax.experimental.pallas import tpu as pltpu
 
     out, lse = pl.pallas_call(
-        _make_packed_fwd(S, d, hp, is_causal),
+        _make_packed_fwd(S, d, hp, is_causal, q_cst=scale * _LOG2_E),
         grid=(b, G),
         in_specs=[blk, blk, blk],
         out_specs=[blk, pl.BlockSpec((1, 1, hp, S),
@@ -658,8 +668,9 @@ def _pallas_flash_bwd_packed(q, k, v, do, out, lse, is_causal, scale=None):
     G = h // hp
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     hd = h * d
-    # base-2 domain, matching the packed forward (lse is base-2)
-    qf = (q * (scale * _LOG2_E)).astype(q.dtype).reshape(b, S, hd)
+    # base-2 domain, matching the packed forward (lse is base-2); the
+    # scale*log2(e) fold happens in-kernel like the forward
+    qf = q.reshape(b, S, hd)
     kf = k.reshape(b, S, hd)
     vf = v.reshape(b, S, hd)
     dof = do.reshape(b, S, hd)
@@ -669,7 +680,8 @@ def _pallas_flash_bwd_packed(q, k, v, do, out, lse, is_causal, scale=None):
     from jax.experimental.pallas import tpu as pltpu
 
     dq, dk, dv = pl.pallas_call(
-        _make_packed_bwd(S, d, hp, is_causal, scale),
+        _make_packed_bwd(S, d, hp, is_causal, scale,
+                         q_cst=scale * _LOG2_E),
         grid=(b, G),
         in_specs=[blk, blk, blk, blk, blk, lse_blk],
         out_specs=[blk, blk, blk],
