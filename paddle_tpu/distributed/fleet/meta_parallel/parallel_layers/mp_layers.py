@@ -75,22 +75,69 @@ def _mp_degree() -> int:
 # Raw lax.psum would double-count under replicated downstream compute; the
 # custom VJPs encode the single logical consumption.
 
-_MANUAL_MP = [None]  # the manual 'mp' axis name, or None
+# Context-LOCAL manual-TP state (contextvars, not a module global): two
+# engines building programs concurrently — or a build racing an eager
+# forward on another thread/async task — each see their own value.
+import contextvars as _contextvars
+
+_MANUAL_MP_VAR: "_contextvars.ContextVar[Optional[str]]" = \
+    _contextvars.ContextVar("manual_mp_axis", default=None)
+# True while TRACING a fully-manual shard_map program (the 1F1B schedule):
+# set even when the mesh has no mp axis, so GSPMD staging is detectable
+_MANUAL_PROGRAM_VAR: "_contextvars.ContextVar[bool]" = \
+    _contextvars.ContextVar("manual_program", default=False)
+# the pipeline layer currently running inside the manual trace — names the
+# offender when a GSPMD op is staged where only manual collectives may live
+_CURRENT_PIPE_LAYER_VAR: "_contextvars.ContextVar[Optional[str]]" = \
+    _contextvars.ContextVar("current_pipe_layer", default=None)
+
+
+def manual_axis() -> Optional[str]:
+    """The active manual 'mp' axis name, or None."""
+    return _MANUAL_MP_VAR.get()
+
+
+def in_manual_program() -> bool:
+    """True while a fully-manual shard_map program is being traced."""
+    return _MANUAL_PROGRAM_VAR.get()
 
 
 class manual_mp:
-    """Context manager activating manual-TP forwards for traces within."""
+    """Context manager activating manual-TP forwards for traces within.
 
-    def __init__(self, axis: Optional[str]):
+    ``program=True`` additionally marks the trace as a fully-manual
+    shard_map program (every axis manual — the 1F1B schedule), arming the
+    GSPMD-staging guard in ``_constrain`` even when ``axis`` is None."""
+
+    def __init__(self, axis: Optional[str], program: bool = False):
         self._axis = axis
+        self._program = program
 
     def __enter__(self):
-        self._prev = _MANUAL_MP[0]
-        _MANUAL_MP[0] = self._axis
+        self._tok_ax = _MANUAL_MP_VAR.set(self._axis)
+        self._tok_pg = (_MANUAL_PROGRAM_VAR.set(True) if self._program
+                        else None)
         return self
 
     def __exit__(self, *exc):
-        _MANUAL_MP[0] = self._prev
+        _MANUAL_MP_VAR.reset(self._tok_ax)
+        if self._tok_pg is not None:
+            _MANUAL_PROGRAM_VAR.reset(self._tok_pg)
+        return False
+
+
+class current_pipe_layer:
+    """Records which pipeline sublayer is running (for guard messages)."""
+
+    def __init__(self, name: Optional[str]):
+        self._name = name
+
+    def __enter__(self):
+        self._tok = _CURRENT_PIPE_LAYER_VAR.set(self._name)
+        return self
+
+    def __exit__(self, *exc):
+        _CURRENT_PIPE_LAYER_VAR.reset(self._tok)
         return False
 
 
@@ -130,7 +177,7 @@ _MANUAL_FNS: dict = {}
 
 def manual_tp_fns(ax: Optional[str] = None):
     """(copy_to, reduce_from, gather_from) for the active manual axis."""
-    ax = ax or _MANUAL_MP[0]
+    ax = ax or manual_axis()
     fns = _MANUAL_FNS.get(ax)
     if fns is None:
         fns = _MANUAL_FNS[ax] = _manual_fns(ax)
@@ -143,7 +190,18 @@ def _constrain(t, spec: P):
     Eagerly this is a ``device_put`` reshard; under a trace it is GSPMD's
     ``with_sharding_constraint``. Both have identity VJPs with the same
     layout, so gradients flow with matching shardings.
+
+    Inside a fully-manual shard_map program (the compiled 1F1B schedule)
+    staging a GSPMD constraint is a trace-time ERROR, not a runtime
+    deadlock: the stage dispatch is a ``lax.switch``, so a GSPMD-auto
+    collective would only be executed by the selected stage's devices —
+    the other ranks never reach the rendezvous. The offending layer is
+    named so the fix (implement the manual mode, or make the layer
+    mp-free) is actionable.
     """
+    from .....parallel.mesh import _guard_manual_program
+
+    _guard_manual_program(spec)
     sh = named_sharding(spec)
     if sh is None:
         return t
@@ -218,7 +276,7 @@ class VocabParallelEmbedding(Layer):
         _place_param(self.weight, P("mp", None))
 
     def forward(self, x):
-        ax = _MANUAL_MP[0]
+        ax = manual_axis()
         if ax is not None:
             # manual mode: the weight IS the local vocab slice; mask
             # out-of-range ids, look up locally, all-reduce — literally the
@@ -276,7 +334,7 @@ class ColumnParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
-        ax = _MANUAL_MP[0]
+        ax = manual_axis()
         if ax is not None:
             # manual mode: weight/bias are the local output-dim shards;
             # copy_to makes the replicated input's backward psum over mp
@@ -342,7 +400,7 @@ class RowParallelLinear(Layer):
         return P(*([None] * ndim))
 
     def forward(self, x):
-        ax = _MANUAL_MP[0]
+        ax = manual_axis()
         if ax is not None:
             # manual mode: local input-shard matmul produces partial sums;
             # reduce_from is the reference's mp_allreduce_sum, bias added

@@ -50,6 +50,18 @@ stage's devices would execute them (deadlock) — which is why TP here is
 manual, exactly like the reference's own mp_layers. Proven on the flagship:
 ``models.llama_pipe`` parity-tests LLaMA (tied embeddings, TP decoder
 blocks, causal-LM loss) on a pp x mp x dp mesh (tests/test_pp_1f1b.py).
+
+ZeRO composition (SURVEY §3.4 config 4, TP+PP+**sharding** in one step):
+when the mesh also carries a ``sharding`` axis, parameters cross the
+shard_map boundary SHARDED over it (``_param_layout`` picks each param's
+shard dim), are all-gathered once at program entry, and gradients leave
+``psum_scatter``-ed back to the same shard layout — the reference
+DygraphShardingOptimizer's broadcast-params / reduce-scatter-grads pair,
+compiled into the one 1F1B program. The sharding ranks double as extra
+data parallelism (batch rows split over dp x sharding), matching the
+reference's hybrid topology. Runtime memory is ZeRO-1/2 (full weights live
+during the schedule); the at-rest layout between steps is sharded, so a
+sharded optimizer updates shard-locally with zero extra collectives.
 """
 
 from __future__ import annotations
@@ -111,14 +123,35 @@ class OneFOneBEngine:
         # local-shard forwards inside the compiled schedule
         self._mp_axis = ("mp" if "mp" in mesh.axis_names
                          and int(mesh.shape["mp"]) > 1 else None)
+        # ZeRO composition (SURVEY §3.4 config 4 — TP+PP+sharding in ONE
+        # step): params enter the program sharded over 'sharding', are
+        # all-gathered ONCE at program start (manual collective — GSPMD
+        # cannot ride inside the lax.switch stage dispatch), and gradients
+        # leave reduce-scattered back to the shard layout. Runtime memory
+        # inside the step is ZeRO-1/2 (full params live during the
+        # schedule); the at-rest layout between steps is sharded, and a
+        # sharded optimizer updates shard-locally.
+        self._zero_axis = ("sharding" if "sharding" in mesh.axis_names
+                           and int(mesh.shape["sharding"]) > 1 else None)
         self._cache: Dict[Any, Callable] = {}
 
+    def _zero_dim(self, v, mp_dim: Optional[int]) -> Optional[int]:
+        """Dim index a parameter shards over the 'sharding' axis: the first
+        dim that is not the TP dim and divides evenly; None = replicated
+        (its grad is pmean'd over the axis instead of reduce-scattered)."""
+        if self._zero_axis is None:
+            return None
+        zsize = int(self._mesh.shape[self._zero_axis])
+        for j in range(v.ndim):
+            if j != mp_dim and v.shape[j] % zsize == 0 and v.shape[j] >= zsize:
+                return j
+        return None
+
     def _manual_param_spec(self, v) -> P:
-        """The in/out spec a parameter keeps inside the manual program:
-        its 'mp' (TP) placement survives — devices hold only their TP
-        shard — while pp/dp/sharding placements are dropped to replicated
-        (the schedule needs every stage's weights resident; ZeRO-style
-        resharding stays outside this program)."""
+        """The TP part of a parameter's in/out spec inside the manual
+        program: its 'mp' placement survives — devices hold only their TP
+        shard — while pp/dp placements are dropped to replicated (the
+        schedule needs every stage's weights resident)."""
         from jax.sharding import NamedSharding
 
         if self._mp_axis is None:
@@ -132,11 +165,32 @@ class OneFOneBEngine:
             for e in tuple(sh.spec) + (None,) * (v.ndim - len(tuple(sh.spec))))
         return P(*spec)
 
+    def _param_layout(self, v) -> Tuple[P, Optional[int]]:
+        """(boundary spec, ZeRO dim) for one parameter: the TP 'mp'
+        placement plus — when the mesh carries a 'sharding' axis — the
+        ZeRO shard dim. The spec is BOTH the shard_map in_spec (params
+        arrive as shards) and the grad out_spec (grads leave
+        reduce-scattered to the same layout)."""
+        mp_spec = tuple(self._manual_param_spec(v)) + (None,) * v.ndim
+        mp_dim = next((j for j in range(v.ndim)
+                       if mp_spec[j] is not None), None)
+        zdim = self._zero_dim(v, mp_dim)
+        if zdim is None:
+            return P(*mp_spec[:v.ndim]), None
+        spec = list(mp_spec[:v.ndim])
+        spec[zdim] = self._zero_axis
+        return P(*spec), zdim
+
     # -- eager-under-trace chunk application (TracedProgram's technique) --
 
     def _run_chunk(self, c: int, x: Tensor) -> Tensor:
+        from .parallel_layers import mp_layers as _mpl
+
         for fn in self._chunks[c]:
-            x = fn(*x) if isinstance(x, tuple) else fn(x)
+            # name the running sublayer so the GSPMD-staging guard
+            # (mesh._guard_manual_program) can point at the offender
+            with _mpl.current_pipe_layer(type(fn).__name__):
+                x = fn(*x) if isinstance(x, tuple) else fn(x)
         return x
 
     def _make_branch(self, c: int, hidden_aval):
@@ -220,16 +274,23 @@ class OneFOneBEngine:
         S = min(M, 2 * C - 1)  # 1F1B in-flight bound per chunk
         T = M + 2 * C - 2
         dp = "dp" if ("dp" in mesh.axis_names and mesh.shape["dp"] > 1) else None
+        zax = self._zero_axis
+        zsize = int(mesh.shape[zax]) if zax else 1
+        # the ZeRO axis is ALSO a data axis: its ranks each process their
+        # own batch rows (grads then reduce-scatter instead of all-reduce)
+        batch_axes = tuple(a for a in (dp, zax) if a)
 
         pvals0 = [p._value for p in self._params]
         bvals0 = [b._value for b in self._buffers]
         mb_rows = x_shape[0] // M
-        if dp:
-            if mb_rows % mesh.shape["dp"] != 0:
+        bdeg = (mesh.shape["dp"] if dp else 1) * zsize
+        if bdeg > 1:
+            if mb_rows % bdeg != 0:
                 raise ValueError(
                     f"1F1B schedule needs batch {x_shape[0]} divisible by "
-                    f"micro-batch count {M} x dp degree {mesh.shape['dp']}")
-            mb_rows //= mesh.shape["dp"]
+                    f"micro-batch count {M} x data degree {bdeg} "
+                    f"(dp x sharding)")
+            mb_rows //= bdeg
         x_mb_aval = jax.ShapeDtypeStruct((mb_rows,) + tuple(x_shape[1:]),
                                          x_dtype)
         key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
@@ -243,6 +304,13 @@ class OneFOneBEngine:
 
         def program(pvals, bvals, x_micro, y_micro, key):
             s = lax.axis_index("pp")
+            if zax:
+                # ZeRO entry gather: shards -> full (mp-local) weights,
+                # ONCE per step (the reference's sharding-stage broadcast /
+                # all-gather before the micro-batch loop)
+                pvals = [v if zd is None
+                         else lax.all_gather(v, zax, axis=zd, tiled=True)
+                         for v, zd in zip(pvals, zero_dims)]
 
             def apply_v(v, pv, xh, mb):
                 return lax.switch(s, branches[v], pv, bvals, xh, mb,
@@ -313,6 +381,18 @@ class OneFOneBEngine:
                 tick, carry0, jnp.arange(T, dtype=jnp.int32))
             grads = [lax.psum(g, "pp") for g in gacc]
             loss = lax.psum(lacc, "pp") / M
+            if zax:
+                # ZeRO exit FIRST: reduce-scatter each shardable grad back
+                # to the entry layout (mean — the axis is data-parallel);
+                # params that could not shard fall back to a plain mean.
+                # Ordering matters: scattering before the dp all-reduce
+                # means dp pays 1/zsize the traffic on the big tensors.
+                grads = [
+                    lax.pmean(g, zax) if zd is None
+                    else lax.psum_scatter(g, zax, scatter_dimension=zd,
+                                          tiled=True) / zsize
+                    for g, zd in zip(grads, zero_dims)]
+                loss = lax.pmean(loss, zax)
             if dp:
                 grads = [lax.pmean(g, dp) for g in grads]
                 loss = lax.pmean(loss, dp)
@@ -330,8 +410,10 @@ class OneFOneBEngine:
         # f/g collectives over 'mp'. Each mp-sharded parameter enters with
         # its 'mp' spec (kept from its NamedSharding) so devices hold only
         # their TP shard; grads leave with the same layout.
-        data_spec = P(None, dp)
-        pspecs = [self._manual_param_spec(v) for v in pvals0]
+        data_spec = P(None, batch_axes if batch_axes else None)
+        layouts = [self._param_layout(v) for v in pvals0]
+        pspecs = [sp for sp, _ in layouts]
+        zero_dims = [zd for _, zd in layouts]
         mapped = jax.shard_map(
             program, mesh=mesh,
             in_specs=(pspecs, P(), data_spec, data_spec, P()),
@@ -377,7 +459,7 @@ class OneFOneBEngine:
         kd = jax.device_put(jax.random.key_data(next_key()), rep)
         # manual-TP trace context: the first call traces the program; the
         # parallel layers must take their local-shard forwards there
-        with _mpl.manual_mp(self._mp_axis):
+        with _mpl.manual_mp(self._mp_axis, program=True):
             loss, grads = fn(pvals, bvals, xv, yv, kd)
         from ....ops.dispatch import note_dispatch
 

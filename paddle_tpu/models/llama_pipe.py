@@ -25,7 +25,10 @@ Design notes:
   lax.switch stage dispatch — see pp_1f1b.py), so the layers switch to
   their Megatron manual-TP forwards (``mp_layers.manual_mp``: local-shard
   matmuls + explicit f/g collectives over ``mp``). Any NEW layer used in a
-  pipeline chunk must either be mp-free or implement the manual mode.
+  pipeline chunk must either be mp-free or implement the manual mode —
+  ENFORCED at trace time: staging a GSPMD sharding constraint inside a
+  chunk raises with the offending layer's name
+  (``parallel.mesh._guard_manual_program``) instead of deadlocking.
 """
 
 from __future__ import annotations
@@ -197,7 +200,7 @@ def _tied_head_forward(embed_pipe: LlamaEmbeddingPipe, x):
         mp_layers as _mpl,
     )
 
-    ax = _mpl._MANUAL_MP[0]
+    ax = _mpl.manual_axis()
     if ax is not None:
         from ..ops.dispatch import run_op
 

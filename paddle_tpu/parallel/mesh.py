@@ -114,4 +114,29 @@ def with_sharding_constraint(x, spec: PartitionSpec, mesh: Optional[Mesh] = None
     mesh = mesh or _GLOBAL_MESH
     if mesh is None:
         return x
+    _guard_manual_program(spec)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def _guard_manual_program(spec) -> None:
+    """Raise (naming the offending pipeline layer) when a GSPMD constraint
+    is staged inside a fully-manual shard_map trace — the compiled 1F1B
+    program — where it would deadlock on a real mesh. The flag lives in
+    fleet's mp_layers (set by the 1F1B engine around its trace)."""
+    try:
+        from ..distributed.fleet.meta_parallel.parallel_layers import (
+            mp_layers as _mpl,
+        )
+    except Exception:
+        return
+    if _mpl.in_manual_program():
+        who = _mpl._CURRENT_PIPE_LAYER_VAR.get()
+        raise ValueError(
+            f"layer {who or '<unknown>'} stages a GSPMD sharding "
+            f"constraint (spec {spec}) inside the compiled 1F1B pipeline "
+            "program. GSPMD collectives cannot ride inside the lax.switch "
+            "stage dispatch (only the selected stage's devices would "
+            "execute them — deadlock on a real mesh). Make the layer "
+            "mp-free inside pipeline chunks, or give it a manual-TP "
+            "forward (mp_layers.manual_tp_fns) like "
+            "Column/RowParallelLinear.")
