@@ -110,6 +110,37 @@ class Tensor:
     def is_leaf(self) -> bool:
         return self._grad_node is None
 
+    @property
+    def strides(self):
+        """Element strides of the (always densely-packed) row-major layout.
+
+        Reference: ``Tensor.strides`` / ``DenseTensor::strides()``
+        (SURVEY §2.1 other-tensor-kinds). XLA arrays carry no user-visible
+        aliasing layout — every jax.Array is logically contiguous — so the
+        strides are the canonical C-order ones; the strided-READ ops
+        (``as_strided``, ``Tensor.unfold``) are gather-based shims over
+        this contract, and strided aliasing MUTATION is out of scope by
+        design (immutable arrays)."""
+        shape = self._value.shape
+        out = []
+        acc = 1
+        for s in reversed(shape):
+            out.append(acc)
+            acc *= int(s)
+        return list(reversed(out))
+
+    def get_strides(self):
+        return self.strides
+
+    def is_contiguous(self) -> bool:
+        """Always True: XLA buffers have no non-contiguous aliasing views
+        (reference Tensor.is_contiguous)."""
+        return True
+
+    def contiguous(self) -> "Tensor":
+        """Identity — see ``is_contiguous`` (reference Tensor.contiguous)."""
+        return self
+
     def numel(self) -> int:
         return self.size
 

@@ -34,6 +34,8 @@ __all__ = [
     "conv2d_transpose", "conv3d_transpose", "max_pool1d", "max_pool2d",
     "max_pool3d", "avg_pool1d", "avg_pool2d", "avg_pool3d",
     "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d", "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "lp_pool1d", "lp_pool2d",
     "unfold", "interpolate", "upsample", "pixel_shuffle",
     # norm / dropout / embedding
     "batch_norm", "layer_norm", "instance_norm", "group_norm", "rms_norm",
@@ -44,6 +46,8 @@ __all__ = [
     "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
     "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
     "cosine_similarity", "ctc_loss", "sigmoid_focal_loss", "square_error_cost",
+    "soft_margin_loss", "multi_label_soft_margin_loss", "poisson_nll_loss",
+    "gaussian_nll_loss",
     # attention
     "scaled_dot_product_attention", "sequence_mask", "pad",
     "affine_grid", "grid_sample",
@@ -342,27 +346,43 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
                               dilation, groups, 3, data_format, "conv3d_transpose")
 
 
+def _ceil_extra(I, k, s, p):
+    """Extra upper padding for ceil_mode output sizing (reference pooling
+    rule: the last window may overhang the input but must START inside
+    input+padding)."""
+    of = (I + 2 * p - k) // s + 1
+    oc = -((-(I + 2 * p - k)) // s) + 1
+    if oc > of and (oc - 1) * s >= I + p:
+        oc = of
+    return max(0, (oc - 1) * s + k - I - 2 * p), oc
+
+
 def _pool_nd(x, kernel, stride, padding, nd, kind, ceil_mode, exclusive,
              data_format, op_name):
     ks = _pair(kernel, nd)
     st = _pair(stride if stride is not None else kernel, nd)
     pd = _pair(padding, nd)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    in_sz = tuple(x.shape[-nd - 1:-1]) if channel_last else tuple(x.shape[-nd:])
+    # ceil_mode: asymmetric tail pad so reduce_window emits the ceil count
+    up = tuple(_ceil_extra(in_sz[d], ks[d], st[d], pd[d])[0] if ceil_mode
+               else 0 for d in range(nd))
     if channel_last:
         window = (1,) + ks + (1,)
         strides = (1,) + st + (1,)
-        pads = ((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),)
+        pads = ((0, 0),) + tuple(
+            (p, p + u) for p, u in zip(pd, up)) + ((0, 0),)
     else:
         window = (1, 1) + ks
         strides = (1, 1) + st
-        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+        pads = ((0, 0), (0, 0)) + tuple((p, p + u) for p, u in zip(pd, up))
 
     def f(a):
         if kind == "max":
             init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
             return jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pads)
         s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
-        if exclusive and any(p > 0 for p in pd):
+        if exclusive and (any(p > 0 for p in pd) or any(u > 0 for u in up)):
             ones = jnp.ones_like(a)
             cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
             return s / cnt
@@ -373,16 +393,31 @@ def _pool_nd(x, kernel, stride, padding, nd, kind, ceil_mode, exclusive,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCL", name=None):
+    if return_mask:
+        return _max_pool_gather(x, 1, ks=_pair(kernel_size, 1),
+                                st=_pair(stride or kernel_size, 1),
+                                pd=_pair(padding, 1), ceil_mode=ceil_mode,
+                                data_format=data_format)
     return _pool_nd(x, kernel_size, stride, padding, 1, "max", ceil_mode, True, data_format, "max_pool1d")
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_gather(x, 2, ks=_pair(kernel_size, 2),
+                                st=_pair(stride or kernel_size, 2),
+                                pd=_pair(padding, 2), ceil_mode=ceil_mode,
+                                data_format=data_format)
     return _pool_nd(x, kernel_size, stride, padding, 2, "max", ceil_mode, True, data_format, "max_pool2d")
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_gather(x, 3, ks=_pair(kernel_size, 3),
+                                st=_pair(stride or kernel_size, 3),
+                                pd=_pair(padding, 3), ceil_mode=ceil_mode,
+                                data_format=data_format)
     return _pool_nd(x, kernel_size, stride, padding, 3, "max", ceil_mode, True, data_format, "max_pool3d")
 
 
@@ -410,7 +445,199 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _max_pool_gather(x, 2, adaptive=output_size)
     return _adaptive_pool(x, output_size, 2, "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    """Counterpart of paddle.nn.functional.adaptive_max_pool3d
+    (phi adaptive max_pool3d kernel; SURVEY §2.1 kernel corpus)."""
+    if return_mask:
+        return _max_pool_gather(x, 3, adaptive=output_size)
+    return _adaptive_pool(x, output_size, 3, "max", data_format="NCDHW")
+
+
+def _window_starts(nd, in_sz, adaptive=None, ks=None, st=None, pd=None,
+                   ceil_mode=False):
+    """Per-axis (starts, K, ends) for pooling windows — strided (ks/st/pd,
+    optionally ceil-counted) or adaptive (output cell i pools
+    [floor(i*I/O), ceil((i+1)*I/O)))."""
+    axes = []
+    for a in range(nd):
+        I = in_sz[a]
+        if adaptive is not None:
+            O = adaptive[a]
+            starts = np.floor(np.arange(O) * I / O).astype(np.int64)
+            ends = np.ceil((np.arange(O) + 1) * I / O).astype(np.int64)
+            K = int((ends - starts).max())
+        else:
+            O = _ceil_extra(I, ks[a], st[a], pd[a])[1] if ceil_mode \
+                else (I + 2 * pd[a] - ks[a]) // st[a] + 1
+            starts = np.arange(O) * st[a] - pd[a]
+            K = ks[a]
+            ends = starts + K
+        axes.append((starts, K, ends))
+    return axes
+
+
+def _max_pool_gather(x, nd, adaptive=None, ks=None, st=None, pd=None,
+                     ceil_mode=False, data_format=""):
+    """(out, mask) max pooling via joint window gather — the return_mask
+    path (the reduce_window fast path cannot emit argmax indices). Mask is
+    the reference's convention: flat index into the input's spatial dims.
+    Channel-first layouts only (the reference's mask-producing
+    max_pool_with_index kernels are NC* as well)."""
+    if data_format in ("NHWC", "NLC", "NDHWC"):
+        raise ValueError(
+            f"return_mask pooling supports channel-first layouts only "
+            f"(got data_format={data_format!r}) — the reference's "
+            "max_pool_with_index kernels have the same NC* contract")
+    in_sz = tuple(x.shape[2:])
+    out_sz = _pair(adaptive, nd) if adaptive is not None else None
+    axes = _window_starts(nd, in_sz, out_sz, ks, st, pd, ceil_mode)
+
+    def f(a):
+        idxs, valids = [], []
+        for d, (starts, K, ends) in enumerate(axes):
+            idx = starts[:, None] + np.arange(K)[None, :]      # [O, K]
+            valid = (idx >= 0) & (idx < ends[:, None]) & (idx < in_sz[d])
+            idxs.append(jnp.asarray(np.clip(idx, 0, in_sz[d] - 1)))
+            valids.append(jnp.asarray(valid))
+        # joint gather: [N, C, O1, .., Ond, K1, .., Knd]
+        w = a
+        for d in range(nd):
+            # take along the current spatial axis; each take moves that
+            # axis's [O, K] pair into place
+            w = jnp.take(w, idxs[d].reshape(-1), axis=2 + 2 * d)
+            w = w.reshape(w.shape[:2 + 2 * d] + idxs[d].shape
+                          + w.shape[3 + 2 * d:])
+        # reorder to [N, C, O1..Ond, K1..Knd]
+        perm = ([0, 1] + [2 + 2 * d for d in range(nd)]
+                + [3 + 2 * d for d in range(nd)])
+        w = jnp.transpose(w, perm)
+        Ks = tuple(ax[1] for ax in axes)
+        wf = w.reshape(w.shape[:2 + nd] + (-1,))
+        # joint validity over the flattened window
+        vshapes = []
+        for d in range(nd):
+            vv = valids[d]  # [Od, Kd]
+            sh = ([1] * d + [vv.shape[0]] + [1] * (nd - 1 - d)
+                  + [1] * d + [vv.shape[1]] + [1] * (nd - 1 - d))
+            vshapes.append(vv.reshape(sh))
+        vj = vshapes[0]
+        for vv in vshapes[1:]:
+            vj = vj & vv
+        vj = vj.reshape(vj.shape[:nd] + (-1,))                 # [O.., K]
+        neg = (-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+               else jnp.iinfo(a.dtype).min)
+        wf = jnp.where(vj[None, None], wf, neg)
+        out = jnp.max(wf, axis=-1)
+        loc = jnp.argmax(wf, axis=-1)                          # local flat
+        # local flat -> per-axis local -> global flat over input spatial
+        gflat = jnp.zeros_like(loc)
+        rem = loc
+        for d in range(nd - 1, -1, -1):
+            ld = rem % Ks[d]
+            rem = rem // Ks[d]
+            starts_b = jnp.asarray(axes[d][0]).reshape(
+                (1, 1) + (1,) * d + (-1,) + (1,) * (nd - 1 - d))
+            gd = starts_b + ld
+            scale = int(np.prod(in_sz[d + 1:], dtype=np.int64))
+            gflat = gflat + gd * scale
+        return out, gflat.astype(jnp.int32)
+
+    return run_op("max_pool_with_mask", f, x)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 1,
+                       output_size, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 2,
+                       output_size, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 3,
+                       output_size, "max_unpool3d")
+
+
+def _max_unpool(x, indices, kernel, stride, padding, nd, output_size,
+                op_name):
+    """Scatter pooled values back to their argmax positions (reference phi
+    max_unpoolNd kernels): out[flat mask index] = value, zeros elsewhere.
+    ``indices`` is the flat-spatial mask from max_poolNd(return_mask=True)."""
+    ks = _pair(kernel, nd)
+    st = _pair(stride if stride is not None else kernel, nd)
+    pd = _pair(padding, nd)
+    in_sz = tuple(x.shape[2:])
+    if output_size is None:
+        out_sz = tuple((in_sz[d] - 1) * st[d] - 2 * pd[d] + ks[d]
+                       for d in range(nd))
+    else:
+        out_sz = tuple(output_size)[-nd:]
+    flat_bound = int(np.prod(out_sz, dtype=np.int64))
+    iv = indices._value if hasattr(indices, "_value") else indices
+    if not isinstance(iv, jax.core.Tracer):
+        hi = int(np.asarray(iv).max()) if np.asarray(iv).size else -1
+        if hi >= flat_bound:
+            raise ValueError(
+                f"{op_name}: index {hi} is out of range for output size "
+                f"{out_sz} ({flat_bound} positions) — pass the pooled "
+                "input's original spatial dims as output_size (required "
+                "when the pool used ceil_mode, whose extent the default "
+                "floor-mode formula cannot reconstruct)")
+
+    def f(v, idx):
+        N, C = v.shape[:2]
+        flat_out = int(np.prod(out_sz, dtype=np.int64))
+        vf = v.reshape(N * C, -1)
+        jf = idx.reshape(N * C, -1).astype(jnp.int32)
+        rows = jnp.arange(N * C)[:, None]
+        out = jnp.zeros((N * C, flat_out), v.dtype)
+        out = out.at[rows, jf].set(vf)
+        return out.reshape((N, C) + out_sz)
+
+    return run_op(op_name, f, x, indices)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 1,
+                    ceil_mode, data_format, "lp_pool1d")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 2,
+                    ceil_mode, data_format, "lp_pool2d")
+
+
+def _lp_pool(x, p, kernel, stride, padding, nd, ceil_mode, data_format,
+             op_name):
+    """Lp pooling: (sum over window of x^p)^(1/p); p=inf degrades to max
+    (reference lp_pool semantics). Ride the avg reduce_window and multiply
+    the window size back in."""
+    if np.isinf(p):
+        return _pool_nd(x, kernel, stride, padding, nd, "max", ceil_mode,
+                        True, data_format, op_name)
+    ks = _pair(kernel, nd)
+    K = float(np.prod(ks))
+
+    def f(a):
+        return a ** p
+
+    powed = run_op(op_name + "_pow", f, x)
+    s = _pool_nd(powed, kernel, stride, padding, nd, "avg", ceil_mode,
+                 False, data_format, op_name + "_sum")
+    return run_op(op_name + "_root",
+                  lambda a: (a * K) ** (1.0 / p), s)
 
 
 def _adaptive_pool(x, output_size, nd, kind, data_format="NCHW"):
@@ -1082,6 +1309,69 @@ def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
         lambda a, y: _reduce(jnp.where(y == 1, a, jnp.maximum(0.0, margin - a)), reduction),
         input, label,
     )
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-label * input)) — reference phi soft_margin_loss
+    (labels in {-1, +1}). log1p(exp(.)) via the stable softplus form."""
+    def f(a, y):
+        z = -y.astype(a.dtype) * a
+        loss = jnp.maximum(z, 0) + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        return _reduce(loss, reduction)
+
+    return run_op("soft_margin_loss", f, input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    """Per-class sigmoid BCE averaged over classes (reference phi
+    multi_label_soft_margin_loss): labels multi-hot in {0,1}."""
+    def f(a, y, *rest):
+        y = y.astype(a.dtype)
+        # stable log-sigmoid pair
+        logsig = -(jnp.maximum(-a, 0) + jnp.log1p(jnp.exp(-jnp.abs(a))))
+        lognegsig = -(jnp.maximum(a, 0) + jnp.log1p(jnp.exp(-jnp.abs(a))))
+        loss = -(y * logsig + (1.0 - y) * lognegsig)
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return run_op("multi_label_soft_margin_loss", f, *args)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    """Poisson NLL (reference phi poisson_nll_loss): exp(in) - t*in under
+    log_input, else in - t*log(in+eps); ``full`` adds the Stirling term
+    t*log(t) - t + 0.5*log(2*pi*t) for t > 1."""
+    def f(a, t):
+        t = t.astype(a.dtype)
+        if log_input:
+            loss = jnp.exp(a) - t * a
+        else:
+            loss = a - t * jnp.log(a + epsilon)
+        if full:
+            stirling = t * jnp.log(t) - t + 0.5 * jnp.log(2.0 * np.pi * t)
+            loss = loss + jnp.where(t > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return run_op("poisson_nll_loss", f, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """Gaussian NLL with per-element variance (reference phi
+    gaussian_nll_loss): 0.5*(log(max(var,eps)) + (in-t)^2/max(var,eps)),
+    plus 0.5*log(2*pi) when ``full``."""
+    def f(a, t, v):
+        v = jnp.maximum(v.astype(a.dtype), epsilon)
+        loss = 0.5 * (jnp.log(v) + (a - t.astype(a.dtype)) ** 2 / v)
+        if full:
+            loss = loss + 0.5 * float(np.log(2.0 * np.pi))
+        return _reduce(loss, reduction)
+
+    return run_op("gaussian_nll_loss", f, input, label, variance)
 
 
 def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):

@@ -21,7 +21,62 @@ __all__ = [
     "triangular_solve", "cholesky_solve", "lstsq", "lu", "lu_unpack",
     "cond", "cov",
     "corrcoef", "householder_product", "multi_dot", "norm",
+    "svd_lowrank", "pca_lowrank",
 ]
+
+
+def _lowrank_svd(a, q, niter, key):
+    """Randomized range-finder SVD (Halko et al., the reference's
+    svd_lowrank algorithm): project onto a q-dim random range, power-
+    iterate with QR re-orthonormalisation, SVD the small projection."""
+    n = a.shape[-1]
+    g = jax.random.normal(key, a.shape[:-2] + (n, q), a.dtype)
+    y = a @ g
+    qm, _ = jnp.linalg.qr(y)
+    for _ in range(niter):
+        z = jnp.swapaxes(a, -1, -2) @ qm
+        qz, _ = jnp.linalg.qr(z)
+        y = a @ qz
+        qm, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(qm, -1, -2) @ a                    # [.., q, n]
+    ub, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    return qm @ ub, s, jnp.swapaxes(vh, -1, -2)
+
+
+@register_op()
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """(U, S, V) with U [m, q], S [q], V [n, q]-transposed convention of
+    the reference paddle.linalg.svd_lowrank; randomized, so exact values
+    depend on the framework RNG — the CONTRACT is U diag(S) V^T ≈ x for
+    rank<=q inputs and orthonormal U/V."""
+    from ..framework.random import next_key
+
+    key = next_key()
+
+    def f(a, *rest):
+        am = a - rest[0] if rest else a
+        return _lowrank_svd(am, int(q), int(niter), key)
+
+    args = (x,) if M is None else (x, M)
+    return run_op("svd_lowrank", f, *args, n_diff_outputs=0)
+
+
+@register_op()
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (reference paddle.linalg.pca_lowrank): centers the
+    columns then runs the same randomized SVD; V's columns are the
+    principal directions."""
+    from ..framework.random import next_key
+
+    m, n = x.shape[-2], x.shape[-1]
+    qq = min(6, m, n) if q is None else int(q)
+    key = next_key()
+
+    def f(a):
+        am = a - jnp.mean(a, axis=-2, keepdims=True) if center else a
+        return _lowrank_svd(am, qq, int(niter), key)
+
+    return run_op("pca_lowrank", f, x, n_diff_outputs=0)
 
 
 @register_op()

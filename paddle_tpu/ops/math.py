@@ -25,6 +25,7 @@ __all__ = [
     "asin", "acos", "atan", "atan2", "sinh", "cosh", "tanh", "asinh", "acosh",
     "atanh", "floor", "ceil", "round", "trunc", "frac", "clip", "maximum",
     "minimum", "fmax", "fmin", "erf", "erfinv", "lerp", "lgamma", "digamma",
+    "gammaln", "gammainc", "gammaincc",
     "logit", "logaddexp", "hypot", "nan_to_num", "deg2rad", "rad2deg",
     "cumsum", "cumprod", "cummax", "cummin", "diff", "trace", "kron",
     "isnan", "isinf", "isposinf", "isneginf", "isfinite", "scale", "stanh",
@@ -113,6 +114,12 @@ erf = _unary("erf", lambda a: jax.scipy.special.erf(a))
 erfinv = _unary("erfinv", lambda a: jax.scipy.special.erfinv(a))
 lgamma = _unary("lgamma", lambda a: jax.scipy.special.gammaln(a))
 digamma = _unary("digamma", lambda a: jax.scipy.special.digamma(a))
+gammaln = _unary("gammaln", lambda a: jax.scipy.special.gammaln(a))
+# regularized lower/upper incomplete gamma (reference phi gammainc[c]):
+# paddle's (x, y) argument order is (input, other) = (a, x) of P(a, x)
+gammainc = _binary("gammainc", lambda a, x: jax.scipy.special.gammainc(a, x))
+gammaincc = _binary("gammaincc",
+                    lambda a, x: jax.scipy.special.gammaincc(a, x))
 deg2rad = _unary("deg2rad", lambda a: jnp.deg2rad(a))
 rad2deg = _unary("rad2deg", lambda a: jnp.rad2deg(a))
 isnan = _unary("isnan", lambda a: jnp.isnan(a), differentiable=False)

@@ -19,6 +19,7 @@ __all__ = [
     "vander", "frexp", "ldexp", "copysign", "nextafter", "heaviside",
     "trapezoid", "cumulative_trapezoid", "logcumsumexp", "index_fill",
     "masked_scatter", "diag_embed", "take", "select_scatter",
+    "diagonal_scatter", "unfold",
     "slice_scatter", "column_stack", "row_stack", "dstack", "hstack",
     "vstack", "tensor_split", "as_strided", "nanquantile", "msort",
     "aminmax", "positive", "negative", "signbit", "sinc", "fix", "sgn",
@@ -243,6 +244,57 @@ def select_scatter(x, values, axis, index, name=None):
         return jnp.moveaxis(moved, 0, axis)
 
     return run_op("select_scatter", f, x, values)
+
+
+@register_op()
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write ``y`` onto the (offset) diagonal of the (axis1, axis2) planes
+    (reference phi diagonal_scatter / Tensor.diagonal_scatter). The scatter
+    is an ``.at[]`` update on the moved-to-front diagonal axes — the exact
+    inverse selection of ``paddle.diagonal``."""
+    def f(a, v):
+        moved = jnp.moveaxis(a, (axis1, axis2), (0, 1))
+        n1, n2 = moved.shape[0], moved.shape[1]
+        if offset >= 0:
+            dlen = min(n1, n2 - offset)
+            r1 = jnp.arange(dlen)
+            r2 = jnp.arange(dlen) + offset
+        else:
+            dlen = min(n1 + offset, n2)
+            r1 = jnp.arange(dlen) - offset
+            r2 = jnp.arange(dlen)
+        # v's diagonal dim is LAST (paddle.diagonal convention) — move it
+        # to the front to line up with the advanced-index result layout
+        vm = jnp.moveaxis(jnp.asarray(v), -1, 0) if jnp.ndim(v) > 1 \
+            else jnp.asarray(v)
+        moved = moved.at[r1, r2].set(vm)
+        return jnp.moveaxis(moved, (0, 1), (axis1, axis2))
+
+    return run_op("diagonal_scatter", f, x, y)
+
+
+@register_op()
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along ``axis`` (reference phi unfold / the
+    Tensor.unfold view): out.shape[axis] = (n - size)//step + 1 windows,
+    with a new trailing dim of length ``size``. Gather-based — XLA has no
+    aliasing views, so this materialises (SURVEY §2.1 other-tensor-kinds:
+    strided READ shims are exact; strided aliasing MUTATION is out of
+    scope on immutable jax arrays)."""
+    def f(a):
+        ax = axis % a.ndim
+        n = a.shape[ax]
+        if size > n:
+            raise ValueError(
+                f"unfold size {size} exceeds dim {ax} length {n}")
+        starts = jnp.arange(0, n - size + 1, step)
+        idx = starts[:, None] + jnp.arange(size)[None, :]  # [W, size]
+        w = jnp.take(a, idx.reshape(-1), axis=ax)
+        w = w.reshape(a.shape[:ax] + idx.shape + a.shape[ax + 1:])
+        # windows stay at ``axis``; the in-window dim moves to the END
+        return jnp.moveaxis(w, ax + 1, -1)
+
+    return run_op("unfold", f, x)
 
 
 @register_op()

@@ -114,15 +114,31 @@ def with_sharding_constraint(x, spec: PartitionSpec, mesh: Optional[Mesh] = None
     mesh = mesh or _GLOBAL_MESH
     if mesh is None:
         return x
-    _guard_manual_program(spec)
+    _guard_manual_program(spec, mesh)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
-def _guard_manual_program(spec) -> None:
+def _guard_manual_program(spec, mesh=None) -> None:
     """Raise (naming the offending pipeline layer) when a GSPMD constraint
     is staged inside a fully-manual shard_map trace — the compiled 1F1B
     program — where it would deadlock on a real mesh. The flag lives in
-    fleet's mp_layers (set by the 1F1B engine around its trace)."""
+    fleet's mp_layers (set by the 1F1B engine around its trace).
+
+    Only a constraint that NAMES a mesh axis of size > 1 is an error: a
+    fully-replicated spec (or one over size-1 axes) stages no collective
+    and cannot deadlock — TP-capable layers legitimately emit those on
+    pp-only meshes where their GSPMD branch is a no-op."""
+    mesh = mesh or _GLOBAL_MESH
+    if mesh is None:
+        return
+    names = []
+    for e in tuple(spec):
+        if e is None:
+            continue
+        names.extend(e if isinstance(e, tuple) else (e,))
+    if not any(n in mesh.axis_names and int(mesh.shape[n]) > 1
+               for n in names):
+        return
     try:
         from ..distributed.fleet.meta_parallel.parallel_layers import (
             mp_layers as _mpl,
