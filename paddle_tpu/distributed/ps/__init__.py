@@ -188,19 +188,25 @@ class PsServer:
         with self._bar_lock:
             return self._bar.get(key, 0)
 
-    def _op_barrier_abort(self, key, world):
+    def _op_barrier_abort(self, key, world, n=None):
         """Retract one arrival (a client timing out takes its arrival back
         so the NEXT generation on this key isn't off by one — the r2
         footgun of a stale arrival poisoning the counter). GENERATION-
         AWARE, atomically under the lock: if the counter shows the
         aborter's generation actually COMPLETED (a late peer arrived
         between the client's last poll and this abort), the arrival was
-        consumed by a successful barrier and must NOT be retracted —
-        decrementing a completed generation would skew every later one."""
+        consumed by a successful barrier and must NOT be retracted.
+        ``n`` is the aborter's OWN arrival index (returned by the barrier
+        op): the retraction additionally requires the counter to still sit
+        inside n's generation — 'counter % world != 0' alone cannot tell
+        WHICH generation is incomplete, so without the check an abort
+        racing a later generation's early arrivals would steal one of
+        THEIR slots and hang that generation one short."""
         with self._bar_lock:
-            n = self._bar.get(key, 0)
-            if n > 0 and n % world != 0:  # current generation incomplete
-                self._bar[key] = n - 1
+            c = self._bar.get(key, 0)
+            same_gen = n is None or (c - 1) // world == (n - 1) // world
+            if c > 0 and c % world != 0 and same_gen:
+                self._bar[key] = c - 1
             return self._bar.get(key, 0)
 
     def stop(self):
@@ -279,9 +285,10 @@ class PsClient:
         deadline = _time.time() + timeout
         while self._call("barrier_stat", key) < target:
             if _time.time() > deadline:
-                # take the arrival back (no-op server-side if a late peer
-                # completed the generation in the meantime)
-                self._call("barrier_abort", key, world)
+                # take the arrival back, passing OUR arrival index so the
+                # server only retracts within our own generation (no-op if
+                # a late peer completed it, or a later generation started)
+                self._call("barrier_abort", key, world, n)
                 raise TimeoutError(f"ps barrier {key!r} timed out")
             _time.sleep(0.02)
 

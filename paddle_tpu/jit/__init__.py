@@ -522,7 +522,7 @@ class FusedTrainStep:
         self._model = model
         self._cache: Dict[Any, Any] = {}
         self._const_key = None  # fixed key for randomness-free programs
-        self._setup_cache = None  # (model, param-ids) -> static state lists
+        self._setup_cache = None  # (model, ids, params, ...) static state
         self._key_sharding = _UNSET  # lazily scanned from the param set
 
     def _state_setup(self):
@@ -530,7 +530,12 @@ class FusedTrainStep:
         params = opt._params()
         pid = tuple(id(p) for p in params)
         cached = self._setup_cache
-        if cached is None or cached[0] is not self._model or cached[1] != pid:
+        # the cache holds the param OBJECTS (cached[2]) purely to pin
+        # their ids alive: while the entry exists no new Tensor can reuse
+        # those addresses, so the id-tuple comparison alone is sound (the
+        # unpinned form had a GC'd-params/id-reuse false-hit hazard)
+        if (cached is None or cached[0] is not self._model
+                or cached[1] != pid):
             # per-(model, param-set) constants: ensure_state walk, state-key
             # names, per-param extras (static decay coefficients), and the
             # model's buffer list (a sublayer walk that costs ~1 ms/call on
@@ -542,12 +547,12 @@ class FusedTrainStep:
             evals = [opt._per_param_extras(p) for p in params]
             buffers = (self._model.buffers()
                        if self._model is not None else [])
-            self._setup_cache = (self._model, pid, state_keys, evals,
-                                 buffers)
+            self._setup_cache = (self._model, pid, list(params),
+                                 state_keys, evals, buffers)
             self._key_sharding = _UNSET  # param set changed: rescan mesh
             self._const_key = None
         else:
-            _, _, state_keys, evals, buffers = cached
+            _, _, _, state_keys, evals, buffers = cached
         svals = [{k: opt._accumulators[id(p)][k] for k in state_keys}
                  for p in params]
         return params, state_keys, svals, evals, buffers

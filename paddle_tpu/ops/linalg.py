@@ -195,10 +195,15 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
 
 @register_op(differentiable=False)
 def lu(x, pivot=True, get_infos=False, name=None):
+    # +1: the reference documents 1-BASED LAPACK getrf pivots for
+    # paddle.linalg.lu (jax.scipy's lu_factor returns 0-based); keeping
+    # the reference convention means pivots in checkpoints / exchanged
+    # with reference-trained code are interpreted identically
     lu_, piv = jax.scipy.linalg.lu_factor(x._value)
+    piv = piv.astype(jnp.int32) + 1
     if get_infos:
-        return to_tensor(lu_), to_tensor(piv.astype(jnp.int32)), to_tensor(jnp.zeros((), jnp.int32))
-    return to_tensor(lu_), to_tensor(piv.astype(jnp.int32))
+        return to_tensor(lu_), to_tensor(piv), to_tensor(jnp.zeros((), jnp.int32))
+    return to_tensor(lu_), to_tensor(piv)
 
 
 @register_op(differentiable=False)
@@ -206,11 +211,12 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
     """Unpack the packed LU factorization from ``paddle.lu`` into
     (P, L, U) with A = P @ L @ U (reference: ``paddle.linalg.lu_unpack``).
 
-    The sequential-swap pivot vector (LAPACK getrf convention: row i was
-    interchanged with row piv[i]) is replayed with a ``lax.fori_loop`` over
-    an identity permutation — pivot VALUES are runtime data, so the replay
-    uses dynamic `.at[]` updates rather than Python control flow, keeping
-    the op jittable for static shapes."""
+    The sequential-swap pivot vector (1-BASED LAPACK getrf convention, as
+    ``paddle.linalg.lu`` returns it: row i was interchanged with row
+    piv[i]-1) is replayed with a ``lax.fori_loop`` over an identity
+    permutation — pivot VALUES are runtime data, so the replay uses
+    dynamic `.at[]` updates rather than Python control flow, keeping the
+    op jittable for static shapes."""
 
     def unpack_one(lu_, piv):
         m, n = lu_.shape
@@ -221,7 +227,7 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
         u_mat = jnp.triu(lu_[:k, :])
 
         def swap(i, perm):
-            j = piv[i].astype(jnp.int32)
+            j = piv[i].astype(jnp.int32) - 1  # 1-based LAPACK pivot
             pi, pj = perm[i], perm[j]
             return perm.at[i].set(pj).at[j].set(pi)
 

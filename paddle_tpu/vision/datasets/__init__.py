@@ -196,8 +196,19 @@ class Flowers(_CifarBase):
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode="train", transform=None, download=True, backend="cv2",
                  synthetic_size=None):
+        if data_file is not None or label_file is not None \
+                or setid_file is not None:
+            # loud, not silent: a user pointing at REAL flowers archives
+            # must not end up training on synthetic noise (the exact
+            # typo'd-path failure mode the MNIST/Cifar file parsers fixed)
+            raise NotImplementedError(
+                "Flowers archive parsing (tgz of JPEGs + .mat labels) is "
+                "not implemented in this offline build — it falls back to "
+                "synthetic data ONLY when no files are passed. Drop the "
+                "data_file/label_file/setid_file arguments for synthetic "
+                "mode, or use DatasetFolder on an extracted image tree.")
         n = synthetic_size or (1020 if mode.lower() == "train" else 102)
-        super().__init__(data_file=data_file, mode=mode, transform=transform,
+        super().__init__(data_file=None, mode=mode, transform=transform,
                          download=download, backend=backend,
                          synthetic_size=n)
 

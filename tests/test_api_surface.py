@@ -76,6 +76,12 @@ def test_flowers_dataset():
     img, label = ds[0]
     assert img.shape == (3, 96, 96)
     assert 0 <= int(np.asarray(label).reshape(-1)[0]) < 102
+    # ADVICE r3: a user pointing at REAL archives must not silently train
+    # on synthetic noise — archive parsing is unimplemented, loudly
+    import pytest as _pytest
+
+    with _pytest.raises(NotImplementedError, match="archive"):
+        Flowers(data_file="/tmp/102flowers.tgz", mode="test")
 
 
 def test_text_datasets():

@@ -395,3 +395,25 @@ def test_barrier_timeout_retracts_arrival(ps):
     t.join(timeout=10)
     assert not t.is_alive()
     other.close()
+
+
+def test_barrier_abort_is_generation_scoped(ps):
+    """ADVICE r3: an abort must only retract within the aborter's OWN
+    generation — if that generation completed and a LATER generation's
+    arrivals landed before the abort, retracting would steal one of their
+    slots and hang them one short. Exercised at the server-op level (the
+    race window is between the client's last poll and its abort call)."""
+    server, _ = ps
+    # generation 1 completes: arrivals 1 and 2
+    n_a = server._op_barrier("g", 2)
+    server._op_barrier("g", 2)
+    assert server._op_barrier_stat("g") == 2
+    # generation 2 starts: arrival 3 lands BEFORE A's late abort
+    server._op_barrier("g", 2)
+    # A aborts with its own arrival index (gen 1): counter sits in gen 2,
+    # so nothing may be retracted
+    assert server._op_barrier_abort("g", 2, n_a) == 3
+    # the same abort WITHOUT the index (legacy form) would have retracted:
+    # pin that the generation check is what protects the counter
+    server._op_barrier("g", 2)  # arrival 4 completes gen 2
+    assert server._op_barrier_stat("g") == 4

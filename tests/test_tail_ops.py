@@ -202,6 +202,9 @@ def test_pdist_and_lu_unpack():
     # lu_unpack reconstructs A = P @ L @ U from paddle.lu's packed output
     a = rng.standard_normal((5, 5)).astype(np.float32)
     lu_, piv = paddle.linalg.lu(_t(a))
+    # reference convention: 1-BASED LAPACK getrf pivots (ADVICE r3) —
+    # checkpoints exchanged with reference code read identically
+    assert piv.numpy().min() >= 1
     p, l, u = paddle.linalg.lu_unpack(lu_, piv)
     recon = p.numpy() @ l.numpy() @ u.numpy()
     np.testing.assert_allclose(recon, a, rtol=1e-4, atol=1e-4)
