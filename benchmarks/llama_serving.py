@@ -77,7 +77,8 @@ def run_engine(cfg, params, reqs, slots):
     eng.run()
     dt = time.perf_counter() - t0
     slot_steps = eng.last_run_chunks * eng.chunk * eng.slots
-    return total / dt, dt, slot_steps
+    lats = sorted(eng.last_latencies.values())
+    return total / dt, dt, slot_steps, lats
 
 
 def packing(reqs, batch, engine_slot_steps):
@@ -107,8 +108,11 @@ def main():
 
     fixed_tps, fixed_dt = run_fixed(cfg, params, reqs, batch=8, llama=llama)
     log(f"fixed-shape batch-8: {fixed_tps:,.0f} tok/s ({fixed_dt:.1f}s)")
-    eng_tps, eng_dt, eng_steps = run_engine(cfg, params, reqs, slots=8)
+    eng_tps, eng_dt, eng_steps, lats = run_engine(cfg, params, reqs, slots=8)
     log(f"continuous batching (8 slots): {eng_tps:,.0f} tok/s ({eng_dt:.1f}s)")
+    p50 = lats[len(lats) // 2] if lats else 0.0
+    p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))] if lats else 0.0
+    log(f"slot latency: p50 {p50:.2f}s p99 {p99:.2f}s over {len(lats)} reqs")
     pack_fixed, pack_eng = packing(reqs, 8, eng_steps)
     log(f"decode-step packing: engine {pack_eng:.0%} vs fixed "
         f"{pack_fixed:.0%} (hardware-independent scheduling win "
@@ -126,6 +130,9 @@ def main():
         "unit": "tokens/sec",
         "vs_baseline": round(eng_tps / fixed_tps, 4) if fixed_tps else 0.0,
         "packing_vs_fixed": round(pack_eng / pack_fixed, 3),
+        "p50_slot_latency_s": round(p50, 3),
+        "p99_slot_latency_s": round(p99, 3),
+        "n_requests": len(lats),
     }))
 
 

@@ -1,0 +1,80 @@
+"""Serving/decode lane: run the decode + serving benches on the real chip
+and record the result as a per-round artifact (VERDICT r3 item 6: the
+README's serving claims had no captured artifact, so a serving regression
+was invisible to the round record).
+
+Writes ``SERVING_r<N>.json`` at the repo root:
+  {"round": N, "decode": {...llama_decode json...},
+   "serving": {...llama_serving json incl. packing + p50/p99...}}
+
+Usage: python benchmarks/serving_lane.py [round_number]
+(no args: derives the round from the highest existing BENCH_r*.json,
+matching benchmarks/tpu_test_lane.py).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _round_number(argv) -> int:
+    if len(argv) > 1:
+        return int(argv[1])
+    rounds = [int(m.group(1)) for f in glob.glob(os.path.join(ROOT, "BENCH_r*.json"))
+              if (m := re.search(r"BENCH_r(\d+)\.json$", f))]
+    return (max(rounds) + 1) if rounds else 1
+
+
+def _run_json(script: str, timeout: int = 900):
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join("benchmarks", script)],
+            cwd=ROOT, capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        # a hung bench must still leave an artifact (the whole point of
+        # this lane is making serving regressions visible)
+        return {"rc": -1, "error": f"timeout after {timeout}s",
+                "stderr_tail": (e.stderr or b"")[-1500:].decode(
+                    "utf-8", "replace") if isinstance(e.stderr, bytes)
+                else str(e.stderr or "")[-1500:],
+                "duration_s": round(time.time() - t0, 1)}
+    out = {}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    out["rc"] = proc.returncode
+    out["duration_s"] = round(time.time() - t0, 1)
+    if proc.returncode != 0:
+        out["stderr_tail"] = proc.stderr[-1500:]
+    return out
+
+
+def main() -> int:
+    rnd = _round_number(sys.argv)
+    result = {
+        "round": rnd,
+        "decode": _run_json("llama_decode.py"),
+        "serving": _run_json("llama_serving.py"),
+    }
+    path = os.path.join(ROOT, f"SERVING_r{rnd:02d}.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    ok = (result["decode"].get("rc") == 0 and result["serving"].get("rc") == 0)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

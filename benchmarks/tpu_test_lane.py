@@ -27,6 +27,9 @@ TPU_TEST_FILES = [
     "tests/test_flash_attention_tpu.py",
     "tests/test_flash_packed_gating.py",
     "tests/test_resnet_fusion_tpu.py",
+    # r4: on-chip END-TO-END certification — full bf16 train steps
+    # (framework numerics + fused optimizer), not just kernels
+    "tests/test_train_step_tpu.py",
 ]
 
 

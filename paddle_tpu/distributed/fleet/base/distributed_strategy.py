@@ -81,7 +81,11 @@ class DistributedStrategy:
     gradient_merge_configs: Dict[str, Any] = field(default_factory=lambda: {"k_steps": 1, "avg": True})
     lamb: bool = False
     dgc: bool = False
+    dgc_configs: Dict[str, Any] = field(default_factory=lambda: {
+        "rampup_begin_step": 0, "sparsity": 0.999})
     localsgd: bool = False
+    localsgd_configs: Dict[str, Any] = field(default_factory=lambda: {
+        "k_steps": 1})
     find_unused_parameters: bool = False
     fuse_all_reduce_ops: bool = True
     fuse_grad_size_in_MB: int = 32

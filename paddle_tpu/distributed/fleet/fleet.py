@@ -130,12 +130,54 @@ class Fleet:
         return model
 
     def distributed_optimizer(self, optimizer, strategy=None):
+        """Wrap the user optimizer per the strategy (reference
+        fleet.distributed_optimizer → meta-optimizer selection): the
+        strategy's meta-optimizer FLAGS compose the matching adaptors
+        around the inner optimizer (lamb swaps the update rule; gradient
+        merge / DGC / LocalSGD transform grads around the step), then
+        HybridParallelOptimizer goes outermost for per-axis grad sync +
+        global-norm clip — the reference's apply order. amp/recompute/
+        sharding/pipeline flags have dygraph-native homes instead of
+        optimizer wraps (see ARCHITECTURE.md meta-optimizer table)."""
         from .meta_optimizers import HybridParallelOptimizer
+        from .meta_optimizers.strategy_optimizers import (
+            DGCOptimizer,
+            GradientMergeOptimizer,
+            LocalSGDOptimizer,
+        )
 
         if not self._is_initialized:
             raise RuntimeError("call fleet.init() before distributed_optimizer()")
-        return HybridParallelOptimizer(optimizer, self._hcg,
-                                       strategy or self._strategy)
+        strat = strategy or self._strategy
+        inner = optimizer
+        if getattr(strat, "lamb", False):
+            from ...optimizer import Lamb
+
+            if not isinstance(inner, Lamb):
+                # reference LambOptimizer: swap the update rule, KEEPING
+                # the parameter list, learning rate, grad clip, and weight
+                # decay (dropping the clip silently disables clipping)
+                inner = Lamb(learning_rate=inner._learning_rate,
+                             parameters=inner._parameter_list,
+                             grad_clip=inner._grad_clip,
+                             lamb_weight_decay=getattr(
+                                 inner, "_l2_coeff", 0.0) or 0.01)
+        if getattr(strat, "dgc", False):
+            cfg = dict(getattr(strat, "dgc_configs", {}) or {})
+            inner = DGCOptimizer(
+                inner,
+                rampup_begin_step=int(cfg.get("rampup_begin_step", 0)),
+                sparsity=float(cfg.get("sparsity", 0.999)))
+        if getattr(strat, "localsgd", False):
+            cfg = dict(getattr(strat, "localsgd_configs", {}) or {})
+            inner = LocalSGDOptimizer(inner,
+                                      k_steps=int(cfg.get("k_steps", 1)))
+        if getattr(strat, "gradient_merge", False):
+            cfg = dict(getattr(strat, "gradient_merge_configs", {}) or {})
+            inner = GradientMergeOptimizer(
+                inner, k_steps=int(cfg.get("k_steps", 1)),
+                avg=bool(cfg.get("avg", True)))
+        return HybridParallelOptimizer(inner, self._hcg, strat)
 
     # --- state ---
     def save(self, *a, **k):

@@ -82,11 +82,17 @@ class HybridParallelOptimizer:
             self._sharding_stage = 1
         # only global-norm clips get the hybrid treatment (the reference
         # swaps exactly ClipGradByGlobalNorm); by-norm/by-value clips are
-        # per-tensor and need no cross-axis awareness — leave them alone
-        if isinstance(optimizer._grad_clip, ClipGradByGlobalNorm) and \
-                not isinstance(optimizer._grad_clip, HybridParallelClipGrad):
-            optimizer._grad_clip = HybridParallelClipGrad(
-                optimizer._grad_clip, self._hcg)
+        # per-tensor and need no cross-axis awareness — leave them alone.
+        # Walk through meta-optimizer wrappers to the INNERMOST optimizer:
+        # that's who reads self._grad_clip at step time — assigning on a
+        # wrapper would only shadow the delegated attribute.
+        innermost = optimizer
+        while hasattr(innermost, "_inner_opt"):
+            innermost = innermost._inner_opt
+        if isinstance(innermost._grad_clip, ClipGradByGlobalNorm) and \
+                not isinstance(innermost._grad_clip, HybridParallelClipGrad):
+            innermost._grad_clip = HybridParallelClipGrad(
+                innermost._grad_clip, self._hcg)
         self._states_placed = set()
 
     # passthrough API surface

@@ -54,6 +54,8 @@ class Request:
     prompt: np.ndarray            # [S] int32
     max_new_tokens: int
     tokens: List[int] = field(default_factory=list)
+    submit_time: float = 0.0      # perf_counter at add_request
+    finish_time: float = 0.0      # perf_counter at retirement
 
     @property
     def done(self) -> bool:
@@ -81,6 +83,7 @@ class ServingEngine:
         self._rem_host = [0] * self.slots  # host mirror of remaining counts
         self._finished: List[Request] = []
         self.last_run_chunks = 0  # decode chunks issued by the last run()
+        self.last_latencies = {}  # rid -> submit->finish seconds (last run)
         self._next_rid = 0
         self._cache = llama.init_kv_cache(cfg, self.slots, self.max_len)
         self._pos = jnp.zeros((self.slots,), jnp.int32)
@@ -100,8 +103,17 @@ class ServingEngine:
                 f"exceeds cache max_len {self.max_len}")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(Request(rid, prompt, int(max_new_tokens)))
+        import time as _time
+
+        self._queue.append(Request(rid, prompt, int(max_new_tokens),
+                                   submit_time=_time.perf_counter()))
         return rid
+
+    def _retire(self, r: Request) -> None:
+        import time as _time
+
+        r.finish_time = _time.perf_counter()
+        self._finished.append(r)
 
     # --- compiled programs ------------------------------------------------
     def _admit_prog(self, bucket: int, nb: int):
@@ -212,7 +224,7 @@ class ServingEngine:
                     hit_eos = self.eos is not None and \
                         r.tokens[-1] == self.eos
                     if r.done or hit_eos:
-                        self._finished.append(r)
+                        self._retire(r)
                         self._rem_host[s] = 0
                         # slot was inserted live; freeze it again
                         self._rem = self._rem.at[s].set(0)
@@ -270,9 +282,14 @@ class ServingEngine:
                         self._rem_host[slot] = 0
                         break
                 if self._rem_host[slot] == 0:
-                    self._finished.append(req)
+                    self._retire(req)
                     self._active[slot] = None
             self._fill_slots()
         done = {r.rid: r.tokens[:r.max_new_tokens] for r in self._finished}
+        # per-request slot latency (continuous batching's OTHER win besides
+        # packing: short requests retire early instead of waiting for the
+        # batch's longest) — consumed by benchmarks/serving artifacts
+        self.last_latencies = {r.rid: r.finish_time - r.submit_time
+                               for r in self._finished if r.finish_time}
         self._finished = []
         return done
