@@ -14,23 +14,18 @@ matching benchmarks/tpu_test_lane.py).
 
 from __future__ import annotations
 
-import glob
 import json
 import os
-import re
 import subprocess
 import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-
-def _round_number(argv) -> int:
-    if len(argv) > 1:
-        return int(argv[1])
-    rounds = [int(m.group(1)) for f in glob.glob(os.path.join(ROOT, "BENCH_r*.json"))
-              if (m := re.search(r"BENCH_r(\d+)\.json$", f))]
-    return (max(rounds) + 1) if rounds else 1
+# ONE round-derivation rule for every artifact lane (a copy here would
+# silently drift from the TPU test lane's numbering)
+from tpu_test_lane import _round_number  # noqa: E402
 
 
 def _run_json(script: str, timeout: int = 900):

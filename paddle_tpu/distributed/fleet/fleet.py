@@ -157,11 +157,16 @@ class Fleet:
                 # reference LambOptimizer: swap the update rule, KEEPING
                 # the parameter list, learning rate, grad clip, and weight
                 # decay (dropping the clip silently disables clipping)
+                # decay lives in _wd for AdamW/Lion (decoupled) and
+                # _l2_coeff for the L2-style family; an EXPLICIT 0.0 is a
+                # user choice and must survive the swap
+                wd = getattr(inner, "_wd", None)
+                if wd is None:
+                    wd = getattr(inner, "_l2_coeff", 0.0)
                 inner = Lamb(learning_rate=inner._learning_rate,
                              parameters=inner._parameter_list,
                              grad_clip=inner._grad_clip,
-                             lamb_weight_decay=getattr(
-                                 inner, "_l2_coeff", 0.0) or 0.01)
+                             lamb_weight_decay=float(wd))
         if getattr(strat, "dgc", False):
             cfg = dict(getattr(strat, "dgc_configs", {}) or {})
             inner = DGCOptimizer(

@@ -611,7 +611,7 @@ class FusedTrainStep:
             l2 = opt._l2_coeff
             decay_in_grad = opt._apply_weight_decay_to_grad()
             grad_clip = opt._grad_clip
-            update_one = opt._update_one
+
 
             has_aux = self._has_aux
             rng_state = [False, False]  # [traced once, randomness consumed]
@@ -659,15 +659,16 @@ class FusedTrainStep:
                 if grad_clip is not None:
                     clipped = grad_clip(list(zip(params, grads)))
                     grads = [g for _, g in clipped]
-                new_p, new_s = [], []
-                for p, pv, g, s, e in zip(params, pvals, grads, svals_,
-                                          evals_):
-                    g = g.astype(pv.dtype) if g.dtype != pv.dtype else g
-                    if l2 and decay_in_grad:
-                        g = g + l2 * pv
-                    np_, ns_ = update_one(pv, g, s, lr_, step_, e)
-                    new_p.append(np_)
-                    new_s.append(ns_)
+                grads = [g.astype(pv.dtype) if g.dtype != pv.dtype else g
+                         for pv, g in zip(pvals, grads)]
+                if l2 and decay_in_grad:
+                    grads = [g + l2 * pv for pv, g in zip(pvals, grads)]
+                # multi-tensor fused update (flat-packed for elementwise
+                # optimizers — see Optimizer.apply_updates): `evals` (the
+                # closure's HOST scalars) key the static grouping, the
+                # traced evals_ carry the values
+                new_p, new_s = opt.apply_updates(
+                    list(pvals), grads, svals_, evals_, evals, lr_, step_)
                 return loss, aux, new_p, new_s, new_b
 
             jitted = _AOTCachedJit(jax.jit(pure, donate_argnums=(1, 3)))

@@ -14,6 +14,10 @@ __all__ = ["Adam", "AdamW", "Lamb", "Adamax", "NAdam", "RAdam", "Lion"]
 
 
 class Adam(Optimizer):
+    # every update op is per-element (scalar coefficients; bias correction
+    # is a scalar of `step`) -> eligible for the flat-packed multi-tensor
+    # path (Optimizer.apply_updates). Lamb is NOT (per-param trust ratio).
+    _elementwise_update = True
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
@@ -94,6 +98,7 @@ class AdamW(Adam):
 
 
 class Lamb(Optimizer):
+    _elementwise_update = False  # per-param trust ratio: NOT elementwise
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
                  exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
@@ -218,6 +223,9 @@ class Adamax(Adam):
 
 
 class NAdam(Adam):
+    # scalar 'mu_product' state is NOT param-shaped: the flat/stack
+    # packing would concatenate it per GROUP and slice it per PARAM SIZE
+    _elementwise_update = False
     """Nesterov-momentum Adam (reference ``paddle.optimizer.NAdam``)."""
 
     def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,

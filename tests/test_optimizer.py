@@ -275,3 +275,62 @@ class TestNewOptimizers:
             opt.step(closure)
         lN = float(closure())
         assert lN < l0 * 0.01, (l0, lN)
+
+
+def test_multi_tensor_packing_matches_per_param():
+    """Optimizer.apply_updates flat/stack packing is numerically identical
+    to the per-param path (r4 multi-tensor fused update), including AdamW
+    extras grouping (decay vs no-decay) and repeated-shape stacking."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    def build(seed):
+        paddle.seed(seed)
+        layers = []
+        for _ in range(6):  # repeated shapes -> the stack path
+            layers += [paddle.nn.Linear(16, 16), paddle.nn.LayerNorm(16)]
+        return paddle.nn.Sequential(*layers)
+
+    def train(packed):
+        m = build(3)
+        opt = paddle.optimizer.AdamW(
+            learning_rate=1e-2, parameters=m.parameters(),
+            apply_decay_param_fun=lambda n: "w_0" in n)
+        if not packed:
+            opt._elementwise_update = False
+        x = paddle.to_tensor(
+            np.random.RandomState(5).randn(8, 16).astype("float32"))
+        for _ in range(3):
+            loss = paddle.mean(m(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return [p.numpy().copy() for p in m.parameters()]
+
+    a = train(packed=True)
+    b = train(packed=False)
+    assert len(a) == len(b) > 8
+    for pa, pb in zip(a, b):
+        np.testing.assert_allclose(pa, pb, rtol=1e-4, atol=1e-6)
+
+
+def test_nadam_scalar_state_stays_unpacked():
+    """NAdam's scalar mu_product state cannot ride the flat/stack packing;
+    it must keep the per-param path and still train on >8-param models."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    assert paddle.optimizer.NAdam._elementwise_update is False
+    paddle.seed(9)
+    m = paddle.nn.Sequential(*[paddle.nn.Linear(8, 8) for _ in range(6)])
+    opt = paddle.optimizer.NAdam(learning_rate=1e-2,
+                                 parameters=m.parameters())
+    x = paddle.to_tensor(np.random.RandomState(1).randn(4, 8)
+                         .astype("float32"))
+    w0 = m[0].weight.numpy().copy()
+    loss = paddle.mean(m(x) ** 2)
+    loss.backward()
+    opt.step()
+    assert not np.allclose(m[0].weight.numpy(), w0)
