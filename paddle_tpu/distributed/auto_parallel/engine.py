@@ -20,6 +20,11 @@ the analytic cost model:
   compiled step can simply be RUN. ``prepare()`` times one warm step per
   candidate hybrid layout over the available devices and keeps the
   fastest — an autotuner, which is how XLA-world tooling picks configs.
+  CAVEAT: trials measure on the PLATFORM THE MESH LIVES ON. On a real
+  TPU slice the argmin is the production argmin; under the virtual-CPU
+  test platform, compile time and CPU op costs dominate and the ranking
+  need not transfer to TPUs — treat the exposed ``measurements`` dict as
+  platform-relative evidence, not portable truth.
 
 The searched model must express its parallelism through the mesh (e.g.
 ``fleet.meta_parallel`` layers or sharding-rule functional models like
