@@ -31,11 +31,28 @@ def _global_key():
 
 
 def seed(s: int):
-    """``paddle.seed`` analog: reset the global generator."""
+    """``paddle.seed`` analog: reset the global generator (device key AND
+    the host-side numpy generator used by host-geometry ops — fractional
+    pooling windows, class-center sampling)."""
     with _lock:
         _state["key"] = jax.random.key(int(s))
         _state["seed"] = int(s)
+        _state["host_rng"] = None  # lazily rebuilt from the new seed
     return s
+
+
+def host_rng():
+    """Host-side ``np.random.RandomState`` tied to ``paddle.seed`` — for
+    ops whose randomness must be HOST data (it shapes the compiled
+    program: fractional-pool window geometry, sampled class sets)."""
+    import numpy as _np
+
+    with _lock:
+        rng = _state.get("host_rng")
+        if rng is None:
+            rng = _state["host_rng"] = _np.random.RandomState(
+                _state.get("seed", 0))
+        return rng
 
 
 def get_rng_state() -> Any:

@@ -33,9 +33,11 @@ __all__ = [
     "linear", "bilinear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
     "conv2d_transpose", "conv3d_transpose", "max_pool1d", "max_pool2d",
     "max_pool3d", "avg_pool1d", "avg_pool2d", "avg_pool3d",
-    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_max_pool2d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d",
     "adaptive_max_pool3d", "max_unpool1d", "max_unpool2d", "max_unpool3d",
-    "lp_pool1d", "lp_pool2d",
+    "lp_pool1d", "lp_pool2d", "fractional_max_pool2d",
+    "fractional_max_pool3d",
     "unfold", "interpolate", "upsample", "pixel_shuffle",
     # norm / dropout / embedding
     "batch_norm", "layer_norm", "instance_norm", "group_norm", "rms_norm",
@@ -47,7 +49,10 @@ __all__ = [
     "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
     "cosine_similarity", "ctc_loss", "sigmoid_focal_loss", "square_error_cost",
     "soft_margin_loss", "multi_label_soft_margin_loss", "poisson_nll_loss",
-    "gaussian_nll_loss",
+    "gaussian_nll_loss", "multi_margin_loss",
+    "triplet_margin_with_distance_loss", "npair_loss", "dice_loss",
+    "log_loss", "temperature_scaled_softmax", "zeropad2d",
+    "adaptive_log_softmax_with_loss", "class_center_sample",
     # attention
     "scaled_dot_product_attention", "sequence_mask", "pad",
     "affine_grid", "grid_sample",
@@ -440,6 +445,16 @@ def adaptive_avg_pool1d(x, output_size, name=None):
     return _adaptive_pool(x, output_size, 1, "avg")
 
 
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format=data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _max_pool_gather(x, 1, adaptive=output_size)
+    return _adaptive_pool(x, output_size, 1, "max", data_format="NCL")
+
+
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
     return _adaptive_pool(x, output_size, 2, "avg", data_format=data_format)
 
@@ -482,7 +497,7 @@ def _window_starts(nd, in_sz, adaptive=None, ks=None, st=None, pd=None,
 
 
 def _max_pool_gather(x, nd, adaptive=None, ks=None, st=None, pd=None,
-                     ceil_mode=False, data_format=""):
+                     ceil_mode=False, data_format="", axes=None):
     """(out, mask) max pooling via joint window gather — the return_mask
     path (the reduce_window fast path cannot emit argmax indices). Mask is
     the reference's convention: flat index into the input's spatial dims.
@@ -494,8 +509,9 @@ def _max_pool_gather(x, nd, adaptive=None, ks=None, st=None, pd=None,
             f"(got data_format={data_format!r}) — the reference's "
             "max_pool_with_index kernels have the same NC* contract")
     in_sz = tuple(x.shape[2:])
-    out_sz = _pair(adaptive, nd) if adaptive is not None else None
-    axes = _window_starts(nd, in_sz, out_sz, ks, st, pd, ceil_mode)
+    if axes is None:
+        out_sz = _pair(adaptive, nd) if adaptive is not None else None
+        axes = _window_starts(nd, in_sz, out_sz, ks, st, pd, ceil_mode)
 
     def f(a):
         idxs, valids = [], []
@@ -617,6 +633,84 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
               ceil_mode=False, data_format="NCHW", name=None):
     return _lp_pool(x, norm_type, kernel_size, stride, padding, 2,
                     ceil_mode, data_format, "lp_pool2d")
+
+
+def _fractional_axes(nd, in_sz, out_sz, kernel_size, u):
+    """Per-axis (starts, K, ends) for fractional max pooling (Graham):
+    pseudo-random window edges ``edge_i = ceil(alpha*(i+u)) - ceil(alpha*u)``
+    with alpha = I/O — window widths alternate floor/ceil(alpha) and tile
+    the input exactly. A ``kernel_size`` makes the windows overlapping
+    ([start, start+k)) like the reference's disjoint/overlapping modes."""
+    axes = []
+    ks = _pair(kernel_size, nd) if kernel_size is not None else None
+    for d in range(nd):
+        I, O = in_sz[d], out_sz[d]
+        alpha = I / O
+        base = int(np.ceil(alpha * u))
+        edges = np.minimum(
+            np.ceil(alpha * (np.arange(O + 1) + u)).astype(np.int64) - base,
+            I)
+        starts = edges[:-1]
+        if ks is not None:
+            K = ks[d]
+            ends = np.minimum(starts + K, I)
+        else:
+            ends = edges[1:]
+            K = int((ends - starts).max())
+        axes.append((starts, K, ends))
+    return axes
+
+
+def _fractional_max_pool(x, nd, output_size, kernel_size, random_u,
+                         return_mask, op_name):
+    out_sz = _pair(output_size, nd)
+    in_sz = tuple(x.shape[2:])
+    if random_u is None:
+        # host-side draw (window geometry must be static for the compiled
+        # program), from the paddle.seed-tied host generator
+        from ...framework.random import host_rng
+
+        u = float(host_rng().uniform(1e-6, 1 - 1e-6))
+    else:
+        u = float(random_u)
+    if not 0 < u < 1:
+        raise ValueError(f"{op_name}: random_u must be in (0, 1), got {u}")
+    axes = _fractional_axes(nd, in_sz, out_sz, kernel_size, u)
+    if return_mask:
+        return _max_pool_gather(x, nd, axes=axes)
+    # no mask wanted: cheaper axis-at-a-time window max (no joint gather
+    # or flat-argmax arithmetic)
+    def f(a):
+        for d, (starts, K, ends) in enumerate(axes):
+            ax = 2 + d
+            idx = starts[:, None] + np.arange(K)[None, :]
+            valid = (idx < ends[:, None]) & (idx < in_sz[d])
+            g = jnp.take(a, jnp.asarray(np.clip(idx, 0, in_sz[d] - 1))
+                         .reshape(-1), axis=ax)
+            g = g.reshape(g.shape[:ax] + idx.shape + g.shape[ax + 1:])
+            m = jnp.asarray(valid).reshape(
+                (1,) * ax + idx.shape + (1,) * (a.ndim - 1 - ax))
+            neg = (-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                   else jnp.iinfo(a.dtype).min)
+            a = jnp.max(jnp.where(m, g, neg), axis=ax + 1)
+        return a
+
+    return run_op(op_name, f, x)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling (reference phi fractional_max_pool2d; Graham
+    2014 pseudo-random windows). ``random_u`` fixes the shift for
+    deterministic tests; None draws one."""
+    return _fractional_max_pool(x, 2, output_size, kernel_size, random_u,
+                                return_mask, "fractional_max_pool2d")
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_max_pool(x, 3, output_size, kernel_size, random_u,
+                                return_mask, "fractional_max_pool3d")
 
 
 def _lp_pool(x, p, kernel, stride, padding, nd, ceil_mode, data_format,
@@ -1372,6 +1466,198 @@ def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
         return _reduce(loss, reduction)
 
     return run_op("gaussian_nll_loss", f, input, label, variance)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class margin (hinge) loss — reference phi multi_margin_loss:
+    mean over classes of max(0, margin - x_y + x_j)^p for j != y,
+    optionally scaled by weight[y]."""
+    def f(x, y, *rest):
+        C = x.shape[-1]
+        xy = jnp.take_along_axis(x, y[..., None], axis=-1)
+        h = jnp.maximum(0.0, margin - xy + x)
+        if p != 1:
+            h = h ** p
+        # zero the true-class column
+        mask = jax.nn.one_hot(y, C, dtype=x.dtype)
+        h = h * (1.0 - mask)
+        if rest:
+            h = h * jnp.take(rest[0], y)[..., None]
+        return _reduce(jnp.sum(h, axis=-1) / C, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return run_op("multi_margin_loss", f, *args)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Triplet loss with a caller-supplied distance (reference
+    paddle.nn.functional.triplet_margin_with_distance_loss); default
+    distance is pairwise L2."""
+    if distance_function is None:
+        def distance_function(a, b):
+            return pairwise_distance(a, b)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dn2 = distance_function(positive, negative)
+        from ...ops.math import minimum as _min
+
+        dn = _min(dn, dn2)
+
+    def f(dp_, dn_):
+        return _reduce(jnp.maximum(0.0, dp_ - dn_ + margin), reduction)
+
+    return run_op("triplet_margin_with_distance", f, dp, dn)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (reference phi npair_loss): softmax cross entropy over
+    the anchor x positive similarity matrix with equal-label soft targets,
+    plus an L2 pull on the embeddings."""
+    def f(a, pos, y):
+        yf = y.reshape(-1).astype(jnp.float32)
+        tgt = (yf[:, None] == yf[None, :]).astype(jnp.float32)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        sim = a @ pos.T
+        logp = jax.nn.log_softmax(sim, axis=-1)
+        ce = jnp.mean(jnp.sum(-tgt * logp, axis=-1))
+        l2 = (jnp.sum(a * a) + jnp.sum(pos * pos)) / a.shape[0] * \
+            (l2_reg * 0.25)
+        return ce + l2
+
+    return run_op("npair_loss", f, anchor, positive, labels)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice loss (reference phi dice_loss): input [..., C] probabilities,
+    integer labels; per-sample 1 - 2|X∩Y| / (|X|+|Y|)."""
+    def f(x, y):
+        C = x.shape[-1]
+        yid = y[..., 0] if (y.ndim == x.ndim and y.shape[-1] == 1) else y
+        onehot = jax.nn.one_hot(yid, C, dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * onehot, axis=red)
+        union = jnp.sum(x, axis=red) + jnp.sum(onehot, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter) / (union + epsilon))
+
+    return run_op("dice_loss", f, input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """Negative log likelihood of Bernoulli probabilities (reference phi
+    log_loss): -y*log(p+eps) - (1-y)*log(1-p+eps)."""
+    return run_op(
+        "log_loss",
+        lambda p, y: -y * jnp.log(p + epsilon)
+        - (1.0 - y) * jnp.log(1.0 - p + epsilon),
+        input, label)
+
+
+def temperature_scaled_softmax(x, temperature=1.0, axis=-1, name=None):
+    """softmax(x / T) (reference paddle temperature_scaled_softmax)."""
+    if temperature <= 0:
+        raise ValueError("temperature must be positive")
+    return run_op("temperature_scaled_softmax",
+                  lambda a: jax.nn.softmax(a / temperature, axis=axis), x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Zero-pad H/W (reference paddle.nn.functional.zeropad2d):
+    padding = [left, right, top, bottom]. Delegates to the one constant-pad
+    implementation (``ops.manipulation.pad``: pairs apply from the LAST dim
+    backwards)."""
+    from ...ops.manipulation import pad as _pad
+
+    l, r, t, b = _pair(padding, 4)
+    if data_format == "NHWC":
+        return _pad(x, [0, 0, l, r, t, b], mode="constant", value=0.0)
+    return _pad(x, [l, r, t, b], mode="constant", value=0.0)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (Grave et al.; reference
+    paddle.nn.functional.adaptive_log_softmax_with_loss): frequent classes
+    score in the head, rare classes through per-cluster low-rank tails.
+    Returns (per-sample log-prob of the TRUE class, mean nll loss).
+
+    Dense formulation (TPU-friendly: no data-dependent gather of cluster
+    subsets — every cluster's tail logits are computed and the true one
+    selected by mask; the cost is the point of adaptive softmax only at
+    vocab scale, but the API contract is exactness, which this keeps)."""
+    cutoffs = list(cutoffs)
+    n_clusters = len(cutoffs)
+    shortlist = cutoffs[0]
+
+    def f(x, y, hw, *rest):
+        off = 0
+        hb = None
+        if head_bias is not None:
+            hb = rest[0]
+            off = 1
+        tails = rest[off:]
+        head = x @ hw  # [N, shortlist + n_clusters]
+        if hb is not None:
+            head = head + hb
+        head_logp = jax.nn.log_softmax(head, axis=-1)
+        yv = y.reshape(-1)
+        # head part: true class in shortlist
+        in_head = yv < shortlist
+        head_class_logp = jnp.take_along_axis(
+            head_logp, jnp.clip(yv, 0, shortlist - 1)[:, None],
+            axis=-1)[:, 0]
+        out = jnp.where(in_head, head_class_logp, 0.0)
+        lo = shortlist
+        for ci in range(n_clusters):
+            hi = cutoffs[ci + 1] if ci + 1 < len(cutoffs) else None
+            if hi is None:
+                break
+            proj, cls_w = tails[2 * ci], tails[2 * ci + 1]
+            tail_logp = jax.nn.log_softmax((x @ proj) @ cls_w, axis=-1)
+            in_c = (yv >= lo) & (yv < hi)
+            rel = jnp.clip(yv - lo, 0, cls_w.shape[-1] - 1)
+            lp = head_logp[:, shortlist + ci] + jnp.take_along_axis(
+                tail_logp, rel[:, None], axis=-1)[:, 0]
+            out = jnp.where(in_c, lp, out)
+            lo = hi
+        return out, -jnp.mean(out)
+
+    flat_tails = [w for pair in tail_weights for w in pair]
+    args = [input, label, head_weight] + \
+        ([head_bias] if head_bias is not None else []) + flat_tails
+    return run_op("adaptive_log_softmax_with_loss", f, *args,
+                  n_diff_outputs=2)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """Sample negative class centers for partial-FC training (reference
+    phi class_center_sample): keep every positive class in ``label``, pad
+    with random negatives up to ``num_samples``; returns (remapped_label,
+    sampled_class_index). HOST-side (the sampled set is data-dependent) —
+    eager only, like the reference's CPU sampling step."""
+    import numpy as _np
+
+    lab = label.numpy().reshape(-1).astype(_np.int64)
+    pos = _np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        from ...framework.random import host_rng
+
+        neg_pool = _np.setdiff1d(_np.arange(num_classes), pos,
+                                 assume_unique=True)
+        extra = host_rng().permutation(neg_pool)[:num_samples - len(pos)]
+        sampled = _np.sort(_np.concatenate([pos, extra]))
+    remap = {int(c): i for i, c in enumerate(sampled)}
+    remapped = _np.array([remap[int(v)] for v in lab], _np.int64)
+    from ...core.tensor import to_tensor as _tt
+
+    return _tt(remapped.reshape(label.shape)), _tt(sampled)
 
 
 def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):

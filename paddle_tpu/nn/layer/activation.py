@@ -7,7 +7,7 @@ from .. import initializer as I
 from .layers import Layer
 
 __all__ = [
-    "ReLU", "ReLU6", "GELU", "SiLU", "Swish", "Sigmoid", "LogSigmoid", "Tanh",
+    "ReLU", "ReLU6", "GELU", "SiLU", "Silu", "Swish", "Sigmoid", "LogSigmoid", "Tanh",
     "Softmax", "LogSoftmax", "LeakyReLU", "ELU", "SELU", "CELU", "PReLU",
     "Hardtanh", "Hardshrink", "Hardsigmoid", "Hardswish", "Mish", "Softplus",
     "Softshrink", "Softsign", "Tanhshrink", "ThresholdedReLU", "GLU", "Maxout",
@@ -256,3 +256,7 @@ class Maxout(Layer):
 
     def forward(self, x):
         return F.maxout(x, self._groups, self._axis)
+
+
+# reference spelling alias (paddle.nn.Silu)
+Silu = SiLU

@@ -6,6 +6,9 @@ from .. import functional as F
 from .layers import Layer
 
 __all__ = [
+    "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool3D",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "LPPool1D", "LPPool2D",
+    "FractionalMaxPool2D", "FractionalMaxPool3D",
     "MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
     "AvgPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D", "AdaptiveMaxPool2D",
 ]
@@ -88,6 +91,117 @@ class AdaptiveMaxPool2D(Layer):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
         self.output_size = output_size
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.adaptive_max_pool2d(x, self.output_size)
+        return F.adaptive_max_pool2d(x, self.output_size,
+                                     return_mask=self.return_mask)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size,
+                                     return_mask=self.return_mask)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size,
+                                     return_mask=self.return_mask)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size,
+                                     data_format=self.data_format)
+
+
+class _MaxUnPoolNd(Layer):
+    _fn = None
+    _fmt = ""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format or self._fmt
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return type(self)._fn(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format,
+                              self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPoolNd):
+    _fn = staticmethod(F.max_unpool1d)
+    _fmt = "NCL"
+
+
+class MaxUnPool2D(_MaxUnPoolNd):
+    _fn = staticmethod(F.max_unpool2d)
+    _fmt = "NCHW"
+
+
+class MaxUnPool3D(_MaxUnPoolNd):
+    _fn = staticmethod(F.max_unpool3d)
+    _fmt = "NCDHW"
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                     data_format)
+
+    def forward(self, x):
+        return F.lp_pool1d(x, *self.args)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                     data_format)
+
+    def forward(self, x):
+        return F.lp_pool2d(x, *self.args)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, *self.args)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, *self.args)

@@ -26,7 +26,8 @@ __all__ = [
     "atanh", "floor", "ceil", "round", "trunc", "frac", "clip", "maximum",
     "minimum", "fmax", "fmin", "erf", "erfinv", "lerp", "lgamma", "digamma",
     "gammaln", "gammainc", "gammaincc",
-    "logit", "logaddexp", "hypot", "nan_to_num", "deg2rad", "rad2deg",
+    "logit", "logaddexp", "logaddexp2", "exp2", "hypot", "nan_to_num",
+    "deg2rad", "rad2deg",
     "cumsum", "cumprod", "cummax", "cummin", "diff", "trace", "kron",
     "isnan", "isinf", "isposinf", "isneginf", "isfinite", "scale", "stanh",
     "rsqrt_",
@@ -76,6 +77,8 @@ fmax = _binary("fmax", lambda a, b: jnp.fmax(a, b))
 fmin = _binary("fmin", lambda a, b: jnp.fmin(a, b))
 atan2 = _binary("atan2", lambda a, b: jnp.arctan2(a, b))
 logaddexp = _binary("logaddexp", lambda a, b: jnp.logaddexp(a, b))
+logaddexp2 = _binary("logaddexp2", lambda a, b: jnp.logaddexp2(a, b))
+exp2 = _unary("exp2", lambda a: jnp.exp2(a))
 hypot = _binary("hypot", lambda a, b: jnp.hypot(a, b))
 gcd = _binary("gcd", lambda a, b: jnp.gcd(a, b))
 lcm = _binary("lcm", lambda a, b: jnp.lcm(a, b))

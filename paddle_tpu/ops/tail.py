@@ -25,6 +25,8 @@ __all__ = [
     "aminmax", "positive", "negative", "signbit", "sinc", "fix", "sgn",
     "conj", "real", "imag", "angle", "polar", "complex", "is_complex",
     "is_integer", "isreal", "bitwise_left_shift", "bitwise_right_shift",
+    "bitwise_invert", "is_floating_point", "shard_index",
+    "triu_indices", "tril_indices",
 ]
 
 
@@ -67,6 +69,59 @@ polar = _b("polar", lambda r, t: jax.lax.complex(r * jnp.cos(t),
                                                  r * jnp.sin(t)))
 complex = _b("complex",
              lambda r, i: jax.lax.complex(*jnp.broadcast_arrays(r, i)))
+
+
+@register_op(differentiable=False)
+def is_floating_point(x, name=None) -> bool:
+    """Reference paddle.is_floating_point."""
+    return bool(jnp.issubdtype(x._value.dtype, jnp.floating))
+
+
+def bitwise_invert(x, name=None):
+    """Alias of bitwise_not (reference paddle.bitwise_invert)."""
+    from .logic import bitwise_not
+
+    return bitwise_not(x)
+
+
+@register_op(differentiable=False)
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Map global ids to shard-local ids (reference phi shard_index — the
+    sharded-embedding lookup's label remap): ids inside this shard's
+    [shard_id*size, (shard_id+1)*size) range become id - base, everything
+    else becomes ``ignore_value``. ``size = ceil(index_num / nshards)``."""
+    if not (0 <= shard_id < nshards):
+        from ..enforce import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"shard_id {shard_id} out of range for nshards {nshards}")
+    size = (index_num + nshards - 1) // nshards
+    base = shard_id * size
+
+    def f(a):
+        inside = (a >= base) & (a < base + size)
+        return jnp.where(inside, a - base, jnp.asarray(ignore_value, a.dtype))
+
+    return run_op("shard_index", f, input)
+
+
+@register_op(differentiable=False)
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    """[2, n] indices of the upper triangle (reference paddle.triu_indices)."""
+    col = row if col is None else col
+    r, c = np.triu_indices(row, k=offset, m=col)
+    # to_tensor coerces int64 to the canonical int silently (repo
+    # convention under no-x64 jax; an explicit jnp dtype request warns)
+    return to_tensor(np.stack([r, c]).astype(np.dtype(dtype)))
+
+
+@register_op(differentiable=False)
+def tril_indices(row, col=None, offset=0, dtype="int64", name=None):
+    """[2, n] indices of the lower triangle (reference paddle.tril_indices)."""
+    col = row if col is None else col
+    r, c = np.tril_indices(row, k=offset, m=col)
+    return to_tensor(np.stack([r, c]).astype(np.dtype(dtype)))
 
 
 @register_op("sgn")

@@ -1,0 +1,265 @@
+"""Round-4 API-breadth additions (OpTest pattern: numpy references).
+
+Pre-emptive closure of the next probe ring: exp2/logaddexp2/shard_index/
+triu-tril indices, adaptive/fractional/lp pooling completions, the loss
+family (multi-margin, triplet-with-distance, npair, dice, log), adaptive
+log-softmax, class-center sampling, and their nn.Layer wrappers.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestSmallOps:
+    def test_exp2_logaddexp2(self):
+        x = np.array([-1.0, 0.5, 3.0], np.float32)
+        y = np.array([0.0, 1.0, 2.0], np.float32)
+        np.testing.assert_allclose(paddle.exp2(_t(x)).numpy(), np.exp2(x),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(paddle.logaddexp2(_t(x), _t(y)).numpy(),
+                                   np.logaddexp2(x, y), rtol=1e-5)
+
+    def test_bitwise_invert_is_floating_point(self):
+        v = paddle.bitwise_invert(_t(np.array([0, -1, 5], np.int32)))
+        np.testing.assert_array_equal(v.numpy(), [-1, 0, -6])
+        assert paddle.is_floating_point(_t(np.float32(1)))
+        assert not paddle.is_floating_point(_t(np.int32(1)))
+
+    def test_shard_index(self):
+        ids = _t(np.arange(8, dtype=np.int64))
+        out = paddle.shard_index(ids, 8, 2, 1, ignore_value=-7)
+        np.testing.assert_array_equal(out.numpy(),
+                                      [-7, -7, -7, -7, 0, 1, 2, 3])
+        with pytest.raises(Exception):
+            paddle.shard_index(ids, 8, 2, 5)
+
+    def test_triu_tril_indices(self):
+        np.testing.assert_array_equal(
+            paddle.triu_indices(3, 4, offset=1).numpy(),
+            np.stack(np.triu_indices(3, k=1, m=4)))
+        np.testing.assert_array_equal(
+            paddle.tril_indices(4).numpy(),
+            np.stack(np.tril_indices(4)))
+
+
+class TestPoolingCompletions:
+    def test_adaptive_max_pool1d(self):
+        x = np.random.RandomState(0).randn(2, 3, 8).astype(np.float32)
+        got = F.adaptive_max_pool1d(_t(x), 4).numpy()
+        np.testing.assert_allclose(got, x.reshape(2, 3, 4, 2).max(-1))
+        out, mask = F.adaptive_max_pool1d(_t(x), 4, return_mask=True)
+        np.testing.assert_allclose(out.numpy(), got)
+        np.testing.assert_array_equal(
+            mask.numpy(), x.reshape(2, 3, 4, 2).argmax(-1)
+            + np.arange(4)[None, None, :] * 2)
+
+    def test_adaptive_avg_pool3d(self):
+        x = np.random.RandomState(1).randn(1, 2, 4, 4, 6).astype(np.float32)
+        got = F.adaptive_avg_pool3d(_t(x), (2, 2, 3)).numpy()
+        ref = x.reshape(1, 2, 2, 2, 2, 2, 3, 2).mean((3, 5, 7))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        got_l = nn.AdaptiveAvgPool3D((2, 2, 3))(_t(x)).numpy()
+        np.testing.assert_allclose(got_l, ref, rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("u", [0.25, 0.61])
+    def test_fractional_max_pool2d_windows_tile(self, u):
+        rng = np.random.RandomState(2)
+        x = rng.randn(1, 2, 7, 11).astype(np.float32)
+        O = (3, 5)
+        out, mask = F.fractional_max_pool2d(_t(x), O, random_u=u,
+                                            return_mask=True)
+        assert out.shape == [1, 2, 3, 5]
+        # every window max must equal the value its mask index points to
+        o = out.numpy()
+        m = mask.numpy()
+        flat = x.reshape(1, 2, -1)
+        np.testing.assert_allclose(
+            o, np.take_along_axis(flat, m.reshape(1, 2, -1),
+                                  axis=-1).reshape(o.shape))
+        # windows tile the input: union of picked windows covers max of x
+        assert np.isclose(o.max(), x.max())
+
+    def test_fractional_max_pool3d_shape(self):
+        x = np.random.RandomState(3).randn(1, 1, 6, 6, 6).astype(np.float32)
+        out = F.fractional_max_pool3d(_t(x), (2, 3, 2), random_u=0.4)
+        assert out.shape == [1, 1, 2, 3, 2]
+        assert np.isclose(out.numpy().max(), x.max())
+
+    def test_unpool_and_lp_layers(self):
+        rng = np.random.RandomState(4)
+        x = _t(rng.randn(1, 2, 8, 8).astype(np.float32))
+        out, mask = F.max_pool2d(x, 2, 2, return_mask=True)
+        up = nn.MaxUnPool2D(2, 2)(out, mask)
+        assert up.shape == [1, 2, 8, 8]
+        lp = nn.LPPool2D(2.0, 2, 2)(x)
+        assert lp.shape == [1, 2, 4, 4]
+        s = nn.Silu()(x)
+        np.testing.assert_allclose(
+            s.numpy(), x.numpy() / (1 + np.exp(-x.numpy())), rtol=1e-5)
+
+
+class TestLossCompletions:
+    def test_multi_margin_loss(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(4, 6).astype(np.float32)
+        y = rng.randint(0, 6, (4,)).astype(np.int64)
+        w = rng.rand(6).astype(np.float32)
+        margin = 0.7
+        h = np.maximum(0.0, margin - x[np.arange(4), y][:, None] + x)
+        h[np.arange(4), y] = 0.0
+        ref = (h.sum(-1) / 6).mean()
+        np.testing.assert_allclose(
+            F.multi_margin_loss(_t(x), _t(y), margin=margin).numpy(), ref,
+            rtol=1e-5)
+        ref_w = ((h * w[y][:, None]).sum(-1) / 6).sum()
+        np.testing.assert_allclose(
+            F.multi_margin_loss(_t(x), _t(y), margin=margin, weight=_t(w),
+                                reduction="sum").numpy(), ref_w, rtol=1e-5)
+        got_layer = nn.MultiMarginLoss(margin=margin)(_t(x), _t(y))
+        np.testing.assert_allclose(got_layer.numpy(), ref, rtol=1e-5)
+
+    def test_triplet_margin_with_distance_loss(self):
+        rng = np.random.RandomState(6)
+        a, p, n = (rng.randn(5, 8).astype(np.float32) for _ in range(3))
+        dp = np.sqrt(((a - p) ** 2).sum(-1))
+        dn = np.sqrt(((a - n) ** 2).sum(-1))
+        ref = np.maximum(0.0, dp - dn + 1.0).mean()
+        got = F.triplet_margin_with_distance_loss(_t(a), _t(p), _t(n))
+        np.testing.assert_allclose(got.numpy(), ref, rtol=1e-4)
+        # swap uses min(dn, d(p, n))
+        dpn = np.sqrt(((p - n) ** 2).sum(-1))
+        ref_s = np.maximum(0.0, dp - np.minimum(dn, dpn) + 1.0).mean()
+        got_s = nn.TripletMarginWithDistanceLoss(swap=True)(
+            _t(a), _t(p), _t(n))
+        np.testing.assert_allclose(got_s.numpy(), ref_s, rtol=1e-4)
+        # custom distance function (L1)
+        got_l1 = F.triplet_margin_with_distance_loss(
+            _t(a), _t(p), _t(n),
+            distance_function=lambda u, v: paddle.sum(paddle.abs(u - v),
+                                                      axis=-1))
+        dl = np.abs(a - p).sum(-1) - np.abs(a - n).sum(-1) + 1.0
+        np.testing.assert_allclose(got_l1.numpy(),
+                                   np.maximum(0, dl).mean(), rtol=1e-4)
+
+    def test_npair_dice_log_losses(self):
+        rng = np.random.RandomState(7)
+        a = rng.randn(4, 6).astype(np.float32)
+        p = rng.randn(4, 6).astype(np.float32)
+        y = np.array([0, 1, 0, 2], np.int64)
+        tgt = (y[:, None] == y[None, :]).astype(np.float32)
+        tgt /= tgt.sum(1, keepdims=True)
+        sim = a @ p.T
+        logp = sim - np.log(np.exp(sim - sim.max(1, keepdims=True)).sum(
+            1, keepdims=True)) - sim.max(1, keepdims=True)
+        ce = (-tgt * logp).sum(1).mean()
+        l2 = ((a * a).sum() + (p * p).sum()) / 4 * (0.002 * 0.25)
+        np.testing.assert_allclose(
+            F.npair_loss(_t(a), _t(p), _t(y)).numpy(), ce + l2, rtol=1e-4)
+
+        probs = rng.rand(3, 5, 4).astype(np.float32)
+        lab = rng.randint(0, 4, (3, 5, 1)).astype(np.int64)
+        onehot = np.eye(4, dtype=np.float32)[lab[..., 0]]
+        inter = (probs * onehot).sum((1, 2))
+        union = probs.sum((1, 2)) + onehot.sum((1, 2))
+        ref = (1 - 2 * inter / (union + 1e-5)).mean()
+        np.testing.assert_allclose(
+            F.dice_loss(_t(probs), _t(lab)).numpy(), ref, rtol=1e-5)
+
+        pr = rng.rand(6).astype(np.float32)
+        yy = rng.randint(0, 2, (6,)).astype(np.float32)
+        ref = -yy * np.log(pr + 1e-4) - (1 - yy) * np.log(1 - pr + 1e-4)
+        np.testing.assert_allclose(F.log_loss(_t(pr), _t(yy)).numpy(), ref,
+                                   rtol=1e-5)
+
+    def test_temperature_scaled_softmax_and_zeropad(self):
+        x = np.random.RandomState(8).randn(3, 5).astype(np.float32)
+        got = F.temperature_scaled_softmax(_t(x), 2.5).numpy()
+        e = np.exp(x / 2.5 - (x / 2.5).max(-1, keepdims=True))
+        np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
+                                   rtol=1e-5)
+        z = F.zeropad2d(_t(np.ones((1, 1, 2, 2), np.float32)),
+                        [1, 2, 3, 4]).numpy()
+        assert z.shape == (1, 1, 9, 5)
+        assert z.sum() == 4.0 and z[0, 0, 3, 1] == 1.0
+
+
+class TestAdaptiveLogSoftmax:
+    def test_normalizes_and_matches_manual(self):
+        """Exactness contract: the implied class distribution normalizes
+        to 1 and the returned values are the true-class log-probs."""
+        rng = np.random.RandomState(9)
+        N, D = 3, 6
+        cutoffs = [4, 8]  # shortlist 4, one tail cluster of classes 4..7
+        x = rng.randn(N, D).astype(np.float32)
+        hw = rng.randn(D, 4 + 1).astype(np.float32)  # shortlist + 1 cluster
+        proj = rng.randn(D, 3).astype(np.float32)
+        cls = rng.randn(3, 4).astype(np.float32)
+
+        total = np.zeros(N)
+        logps = {}
+        for c in range(8):
+            y = np.full((N,), c, np.int64)
+            out, loss = F.adaptive_log_softmax_with_loss(
+                _t(x), _t(y), _t(hw), [(_t(proj), _t(cls))], cutoffs)
+            logps[c] = out.numpy()
+            total += np.exp(out.numpy())
+            np.testing.assert_allclose(loss.numpy(), -out.numpy().mean(),
+                                       rtol=1e-5)
+        np.testing.assert_allclose(total, np.ones(N), rtol=1e-4)
+        # head classes match a plain log_softmax over the head logits
+        head = x @ hw
+        head_lp = head - np.log(np.exp(
+            head - head.max(1, keepdims=True)).sum(1, keepdims=True)) \
+            - head.max(1, keepdims=True)
+        for c in range(4):
+            np.testing.assert_allclose(logps[c], head_lp[:, c], rtol=1e-4)
+
+
+class TestClassCenterSample:
+    def test_positives_kept_and_remapped(self):
+        lab = _t(np.array([3, 7, 3, 50], np.int64))
+        remapped, sampled = F.class_center_sample(lab, 100, 8)
+        s = sampled.numpy()
+        assert len(s) == 8 and len(np.unique(s)) == 8
+        for c in (3, 7, 50):
+            assert c in s
+        r = remapped.numpy()
+        np.testing.assert_array_equal(s[r], lab.numpy())
+
+
+def test_host_randomness_tied_to_paddle_seed():
+    """Review finding: host-geometry randomness (fractional windows,
+    class-center sampling) must be reproducible under paddle.seed."""
+    x = _t(np.random.RandomState(0).randn(1, 1, 7, 7).astype(np.float32))
+    paddle.seed(123)
+    a = F.fractional_max_pool2d(x, (3, 3)).numpy()
+    lab = _t(np.array([1, 2], np.int64))
+    _, s1 = F.class_center_sample(lab, 50, 8)
+    paddle.seed(123)
+    b = F.fractional_max_pool2d(x, (3, 3)).numpy()
+    _, s2 = F.class_center_sample(lab, 50, 8)
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(s1.numpy(), s2.numpy())
+
+
+def test_fractional_no_mask_path_matches_mask_path():
+    x = _t(np.random.RandomState(11).randn(2, 3, 9, 7).astype(np.float32))
+    out_m, _ = F.fractional_max_pool2d(x, (4, 3), random_u=0.37,
+                                       return_mask=True)
+    out = F.fractional_max_pool2d(x, (4, 3), random_u=0.37)
+    np.testing.assert_allclose(out.numpy(), out_m.numpy())
+
+
+def test_zeropad2d_nhwc():
+    z = F.zeropad2d(_t(np.ones((1, 2, 2, 1), np.float32)), [1, 0, 0, 2],
+                    data_format="NHWC").numpy()
+    assert z.shape == (1, 4, 3, 1)
+    assert z.sum() == 4.0 and z[0, 0, 1, 0] == 1.0
