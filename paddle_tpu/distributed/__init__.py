@@ -11,6 +11,9 @@ from .collective import (
     all_reduce,
     all_to_all,
     alltoall,
+    alltoall_single,
+    gather,
+    broadcast_object_list,
     barrier,
     broadcast,
     get_default_group,
@@ -52,6 +55,7 @@ from .auto_parallel import (
     Replicate,
     Shard,
     dtensor_from_fn,
+    unshard_dtensor,
     reshard,
     shard_layer,
     shard_tensor,
@@ -69,6 +73,7 @@ __all__ = [
     "spawn", "launch", "fleet", "sharding", "group_sharded_parallel",
     "save_group_sharded_model", "auto_parallel", "ProcessMesh", "Placement",
     "Shard", "Replicate", "Partial", "shard_tensor", "dtensor_from_fn",
+    "unshard_dtensor", "alltoall_single", "gather", "broadcast_object_list",
     "reshard", "shard_layer", "TCPStore",
 ]
 

@@ -11,9 +11,10 @@ from .api import (
     set_mesh,
     shard_layer,
     shard_tensor,
+    unshard_dtensor,
     to_placements,
 )
 
 __all__ = ["Engine", "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
-           "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+           "shard_tensor", "unshard_dtensor", "dtensor_from_fn", "reshard", "shard_layer",
            "to_placements", "get_mesh", "set_mesh"]

@@ -35,7 +35,7 @@ from jax.sharding import PartitionSpec as P
 from ...core.tensor import Tensor, to_tensor
 
 __all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
-           "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+           "shard_tensor", "dtensor_from_fn", "reshard", "unshard_dtensor", "shard_layer",
            "get_mesh", "set_mesh", "to_placements"]
 
 
@@ -267,6 +267,23 @@ def reshard(dist_tensor, mesh: ProcessMesh, placements: Sequence[Placement]):
     out = _put(t, NamedSharding(mesh.mesh, spec))
     out.process_mesh = mesh
     out.placements = list(placements)
+    return out
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather a dist tensor back to a fully-replicated dense tensor
+    (reference ``paddle.distributed.unshard_dtensor``): the inverse of
+    ``shard_tensor`` — one device_put to the replicated layout."""
+    t = dist_tensor if isinstance(dist_tensor, Tensor) else \
+        to_tensor(dist_tensor)
+    mesh = getattr(t, "process_mesh", None)
+    if mesh is None:
+        return t
+    rep = [Replicate() for _ in mesh.dim_names]
+    spec = _placements_to_spec(rep, mesh, t.ndim)
+    out = _put(t, NamedSharding(mesh.mesh, spec))
+    out.process_mesh = None
+    out.placements = None
     return out
 
 

@@ -30,7 +30,8 @@ from .env import ParallelEnv, get_rank, get_world_size
 __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "all_reduce", "all_gather",
     "all_gather_object", "reduce", "reduce_scatter", "broadcast", "scatter",
-    "alltoall", "all_to_all", "send", "recv", "send_next", "recv_prev",
+    "alltoall", "all_to_all", "alltoall_single", "gather",
+    "broadcast_object_list", "send", "recv", "send_next", "recv_prev",
     "isend", "irecv", "barrier",
     "get_default_group",
 ]
@@ -445,6 +446,88 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 
 
 all_to_all = alltoall
+
+
+def alltoall_single(in_tensor, out_tensor=None,
+                    in_split_sizes=None, out_split_sizes=None,
+                    group=None, sync_op=True):
+    """One-tensor all-to-all (reference
+    ``paddle.distributed.alltoall_single``): row-blocks of ``in_tensor``
+    scatter across the group and the received blocks concatenate into
+    ``out_tensor``. Only EQUAL splits are supported — XLA's all_to_all is
+    uniform (the reference's unequal-split mode rides NCCL's variable
+    send/recv, which has no ICI analog); unequal sizes raise."""
+    g = group or get_default_group()
+    for s in (in_split_sizes, out_split_sizes):
+        if s is not None and len(set(int(v) for v in s)) > 1:
+            raise NotImplementedError(
+                "alltoall_single: unequal split sizes are not supported on "
+                "the XLA collective (uniform all_to_all); pad to equal "
+                "splits")
+    out = alltoall(in_tensor, None, group=g, sync_op=sync_op)
+    out_val = _unwrap(out)
+    if isinstance(out_tensor, Tensor) and \
+            not isinstance(out_val, jax.core.Tracer):
+        if tuple(out_val.shape) != tuple(out_tensor.shape):
+            raise ValueError(
+                f"alltoall_single: out_tensor shape {tuple(out_tensor.shape)}"
+                f" does not match the result {tuple(out_val.shape)} — the "
+                "reference errors here too (reading a stale out buffer "
+                "would be silent corruption)")
+        out_tensor._inplace_set(out_val)
+        return out_tensor
+    return out
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather every rank's tensor to ``dst`` (reference
+    ``paddle.distributed.gather``). Single-controller SPMD note: the
+    all-gather runs on every rank (XLA has no single-destination gather
+    cheaper than all-gather on ICI); following the reference convention
+    only ``dst`` fills ``gather_list``."""
+    g = group or get_default_group()
+    chunks: list = []
+    all_gather(chunks, tensor, group=g)
+    if gather_list is not None:
+        r = g.get_group_rank(dst)
+        r = r if r >= 0 else dst
+        me = max(g.get_group_rank(get_rank()), 0)
+        if me == r or g.nranks == 1:
+            gather_list.extend(chunks)
+    return chunks
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Broadcast picklable python objects from ``src`` (reference
+    ``paddle.distributed.broadcast_object_list``): pickle -> byte-tensor
+    broadcast -> unpickle, the reference's own transport."""
+    g = group or get_default_group()
+    if g.nranks == 1 or jax.process_count() == 1:
+        return object_list
+    import pickle
+
+    r = g.get_group_rank(src)
+    r = r if r >= 0 else src
+    me = max(g.get_group_rank(get_rank()), 0)
+    blobs = [np.frombuffer(pickle.dumps(o), dtype=np.uint8)
+             for o in object_list]
+    # lengths first (count is caller-uniform per the reference contract)
+    lens = np.array([b.size for b in blobs], np.int64)
+    lens_all: list = []
+    all_gather_object(lens_all, lens.tolist(), group=g)
+    src_lens = lens_all[r]
+    mx = max(int(v) for v in src_lens) if src_lens else 0
+    padded = np.zeros((len(object_list), mx), np.uint8)
+    for i, b in enumerate(blobs):
+        n = min(b.size, mx)
+        padded[i, :n] = b[:n]
+    out = broadcast(to_tensor(padded), src=src, group=g)
+    if me != r:
+        raw = np.asarray(_unwrap(out))
+        for i in range(len(object_list)):
+            object_list[i] = pickle.loads(
+                bytes(raw[i][:int(src_lens[i])]))
+    return object_list
 
 
 def send(tensor, dst=0, group=None, sync_op=True):

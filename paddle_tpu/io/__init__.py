@@ -23,7 +23,7 @@ from ..enforce import InvalidArgumentError
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "ChainDataset", "Subset", "random_split", "Sampler", "SequenceSampler",
-    "RandomSampler", "WeightedRandomSampler", "BatchSampler",
+    "RandomSampler", "WeightedRandomSampler", "SubsetRandomSampler", "BatchSampler",
     "DistributedBatchSampler", "DataLoader", "get_worker_info",
     "default_collate_fn",
 ]
@@ -160,6 +160,21 @@ class WeightedRandomSampler(Sampler):
 
     def __len__(self):
         return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    """Random permutation over a fixed index subset (reference
+    ``paddle.io.SubsetRandomSampler``)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        return iter(self.indices[i]
+                    for i in np.random.permutation(len(self.indices)))
+
+    def __len__(self):
+        return len(self.indices)
 
 
 class BatchSampler(Sampler):

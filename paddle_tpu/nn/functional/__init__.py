@@ -635,6 +635,32 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
                     ceil_mode, data_format, "lp_pool2d")
 
 
+def _window_reduce_axis(starts, ends, K, in_len, axis, kind):
+    """One-axis windowed reduction from explicit (starts, ends) windows —
+    the shared kernel of adaptive pooling and fractional max pooling
+    (gather the max-width window per output index, mask the overhang,
+    reduce). ``kind``: "max" or "avg"; integer inputs use iinfo.min as the
+    masked fill for max."""
+    idx = starts[:, None] + np.arange(K)[None, :]            # [O, K]
+    valid = (idx < ends[:, None]) & (idx < in_len)
+    idx = np.clip(idx, 0, in_len - 1)
+
+    def f(v):
+        g = jnp.take(v, jnp.asarray(idx), axis=axis)         # [..., O, K, ...]
+        m = jnp.asarray(valid)
+        m = m.reshape((1,) * (axis % v.ndim) + m.shape +
+                      (1,) * (v.ndim - 1 - (axis % v.ndim)))
+        if kind == "avg":
+            g = jnp.where(m, g, 0.0)
+            return jnp.sum(g, axis=axis + 1) / jnp.sum(
+                m.astype(g.dtype), axis=axis + 1)
+        neg = (-jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+               else jnp.iinfo(v.dtype).min)
+        return jnp.max(jnp.where(m, g, neg), axis=axis + 1)
+
+    return f
+
+
 def _fractional_axes(nd, in_sz, out_sz, kernel_size, u):
     """Per-axis (starts, K, ends) for fractional max pooling (Graham):
     pseudo-random window edges ``edge_i = ceil(alpha*(i+u)) - ceil(alpha*u)``
@@ -679,20 +705,11 @@ def _fractional_max_pool(x, nd, output_size, kernel_size, random_u,
     if return_mask:
         return _max_pool_gather(x, nd, axes=axes)
     # no mask wanted: cheaper axis-at-a-time window max (no joint gather
-    # or flat-argmax arithmetic)
+    # or flat-argmax arithmetic), via the shared window-reduce helper
     def f(a):
         for d, (starts, K, ends) in enumerate(axes):
-            ax = 2 + d
-            idx = starts[:, None] + np.arange(K)[None, :]
-            valid = (idx < ends[:, None]) & (idx < in_sz[d])
-            g = jnp.take(a, jnp.asarray(np.clip(idx, 0, in_sz[d] - 1))
-                         .reshape(-1), axis=ax)
-            g = g.reshape(g.shape[:ax] + idx.shape + g.shape[ax + 1:])
-            m = jnp.asarray(valid).reshape(
-                (1,) * ax + idx.shape + (1,) * (a.ndim - 1 - ax))
-            neg = (-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
-                   else jnp.iinfo(a.dtype).min)
-            a = jnp.max(jnp.where(m, g, neg), axis=ax + 1)
+            a = _window_reduce_axis(starts, ends, K, in_sz[d], 2 + d,
+                                    "max")(a)
         return a
 
     return run_op(op_name, f, x)
@@ -749,23 +766,7 @@ def _adaptive_pool(x, output_size, nd, kind, data_format="NCHW"):
         starts = np.floor(np.arange(O) * I / O).astype(np.int64)
         ends = np.ceil((np.arange(O) + 1) * I / O).astype(np.int64)
         K = int((ends - starts).max())
-        idx = starts[:, None] + np.arange(K)[None, :]        # [O, K]
-        valid = idx < ends[:, None]
-        idx = np.clip(idx, 0, I - 1)
-
-        def f(v):
-            g = jnp.take(v, jnp.asarray(idx), axis=axis)     # [..., O, K, ...]
-            m = jnp.asarray(valid)
-            m = m.reshape((1,) * (axis % v.ndim) + m.shape +
-                          (1,) * (v.ndim - 1 - (axis % v.ndim)))
-            if kind == "avg":
-                g = jnp.where(m, g, 0.0)
-                return jnp.sum(g, axis=axis + 1) / jnp.sum(
-                    m.astype(g.dtype), axis=axis + 1)
-            g = jnp.where(m, g, -jnp.inf)
-            return jnp.max(g, axis=axis + 1)
-
-        return f
+        return _window_reduce_axis(starts, ends, K, I, axis, kind)
 
     def f(a):
         for d in range(nd):
@@ -1640,8 +1641,15 @@ def class_center_sample(label, num_classes, num_samples, group=None,
     with random negatives up to ``num_samples``; returns (remapped_label,
     sampled_class_index). HOST-side (the sampled set is data-dependent) —
     eager only, like the reference's CPU sampling step."""
+    import jax as _jax
     import numpy as _np
 
+    if group is not None and _jax.process_count() > 1:
+        raise NotImplementedError(
+            "class_center_sample: multi-process coordinated sampling "
+            "(rank-consistent negative sets over a group) is not "
+            "implemented — run it on one rank and broadcast, or pass "
+            "group=None in single-process SPMD")
     lab = label.numpy().reshape(-1).astype(_np.int64)
     pos = _np.unique(lab)
     if len(pos) >= num_samples:

@@ -263,3 +263,60 @@ def test_zeropad2d_nhwc():
                     data_format="NHWC").numpy()
     assert z.shape == (1, 4, 3, 1)
     assert z.sum() == 4.0 and z[0, 0, 1, 0] == 1.0
+
+
+class TestDistributedCompletions:
+    def test_alltoall_single_and_gather(self):
+        import jax
+
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
+
+        mesh = create_hybrid_mesh(dp=4, devices=jax.devices()[:4])
+        try:
+            dist.init_parallel_env()
+            x = _t(np.arange(8, dtype=np.float32).reshape(4, 2))
+            out = dist.alltoall_single(x)
+            assert out.shape == [4, 2]
+            with pytest.raises(NotImplementedError, match="unequal"):
+                dist.alltoall_single(x, in_split_sizes=[1, 3])
+            got = []
+            chunks = dist.gather(_t(np.ones((2,), np.float32)), got, dst=0)
+            assert len(chunks) >= 1
+            # single-process world: dst receives the list
+            assert len(got) == len(chunks)
+        finally:
+            set_mesh(None)
+
+    def test_broadcast_object_list_world_of_one(self):
+        import paddle_tpu.distributed as dist
+
+        objs = [{"a": 1}, [1, 2, 3]]
+        out = dist.broadcast_object_list(objs, src=0)
+        assert out == [{"a": 1}, [1, 2, 3]]
+
+    def test_unshard_dtensor_roundtrip(self):
+        import jax
+
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import ProcessMesh, Replicate, Shard
+        from paddle_tpu.parallel import set_mesh
+
+        try:
+            pm = ProcessMesh(np.arange(4), ["x"])
+            d = dist.shard_tensor(np.arange(8, dtype=np.float32),
+                                  pm, [Shard(0)])
+            u = dist.unshard_dtensor(d)
+            np.testing.assert_allclose(u.numpy(),
+                                       np.arange(8, dtype=np.float32))
+            assert getattr(u, "process_mesh", None) is None
+        finally:
+            set_mesh(None)
+
+
+def test_subset_random_sampler():
+    from paddle_tpu.io import SubsetRandomSampler
+
+    s = SubsetRandomSampler([3, 7, 9])
+    got = sorted(list(iter(s)))
+    assert got == [3, 7, 9] and len(s) == 3
