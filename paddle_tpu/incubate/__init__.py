@@ -3,7 +3,7 @@ experimental APIs — MoE expert parallelism and fused-op entry points."""
 
 from . import asp, distributed, nn
 
-__all__ = ["asp", "distributed", "nn"]
+__all__ = ["asp", "distributed", "nn", "autograd"]
 
 
 def softmax_mask_fuse(x, mask, name=None):
@@ -37,3 +37,59 @@ def graph_send_recv(x, src_index, dst_index, pool_type="sum",
 
 __all__ += ["softmax_mask_fuse", "segment_sum", "segment_mean",
             "graph_send_recv"]
+
+
+def segment_max(data, segment_ids, name=None):
+    from .. import geometric
+
+    return geometric.segment_max(data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    from .. import geometric
+
+    return geometric.segment_min(data, segment_ids)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax over the last two dims in one compiled region
+    (reference incubate.softmax_mask_fuse_upper_triangle: scores [..., S, S]
+    with the strict upper triangle masked out)."""
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import run_op
+
+    def f(a):
+        import jax
+
+        s = a.shape[-1]
+        q = jax.lax.broadcasted_iota(jnp.int32, (a.shape[-2], s), 0)
+        k = jax.lax.broadcasted_iota(jnp.int32, (a.shape[-2], s), 1)
+        masked = jnp.where(q >= k, a, jnp.asarray(-jnp.inf, a.dtype))
+        return jax.nn.softmax(masked, axis=-1)
+
+    return run_op("softmax_mask_fuse_upper_triangle", f, x)
+
+
+def identity_loss(x, reduction="mean", name=None):
+    """Pass-through loss head (reference incubate.identity_loss: marks a
+    tensor as the loss; reduction 'none'/'sum'/'mean')."""
+    from ..ops.dispatch import run_op
+    import jax.numpy as jnp
+
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+
+    def f(a):
+        if red == "mean":
+            return jnp.mean(a)
+        if red == "sum":
+            return jnp.sum(a)
+        return a
+
+    return run_op("identity_loss", f, x)
+
+
+# ``incubate.autograd`` (reference: paddle.incubate.autograd primitive
+# jvp/vjp/Jacobian/Hessian APIs) — the stable implementations live in
+# paddle.autograd; expose them under the incubate path too
+from .. import autograd as autograd  # noqa: E402

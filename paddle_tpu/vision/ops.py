@@ -19,7 +19,8 @@ from ..core.tensor import Tensor, to_tensor
 from ..ops.dispatch import run_op
 
 __all__ = ["nms", "box_iou", "roi_align", "roi_pool", "box_coder",
-           "box_area"]
+           "box_area", "prior_box", "yolo_box", "distribute_fpn_proposals",
+           "psroi_pool", "deform_conv2d"]
 
 
 def box_area(boxes, name=None):
@@ -230,3 +231,266 @@ def box_coder(prior_box, prior_box_var, target_box,
                           cx + w / 2 - norm, cy + h / 2 - norm], axis=1)
 
     return run_op("box_coder", f, prior_box, prior_box_var, target_box)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes for one feature map (reference phi
+    prior_box): per cell, one box per (min_size, aspect_ratio) plus the
+    sqrt(min*max) box. Geometry is shape-only — computed host-side once,
+    like the reference's CPU kernel, and returned as (boxes [H,W,P,4],
+    variances [H,W,P,4]) normalized to the image."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        ms = float(ms)
+        ar_boxes = [(ms * np.sqrt(ar), ms / np.sqrt(ar)) for ar in ars]
+        max_box = []
+        if max_sizes:
+            big = np.sqrt(ms * float(max_sizes[ms_i]))
+            max_box = [(big, big)]
+        if min_max_aspect_ratios_order:
+            # reference flag: (min, max, remaining ARs) ordering — SSD
+            # checkpoints trained with it pair priors positionally
+            boxes += [ar_boxes[0]] + max_box + ar_boxes[1:]
+        else:
+            boxes += ar_boxes + max_box
+    P = len(boxes)
+    cx = (np.arange(fw) + offset) * step_w
+    cy = (np.arange(fh) + offset) * step_h
+    out = np.zeros((fh, fw, P, 4), np.float32)
+    for p, (bw, bh) in enumerate(boxes):
+        out[:, :, p, 0] = (cx[None, :] - bw / 2) / iw
+        out[:, :, p, 1] = (cy[:, None] - bh / 2) / ih
+        out[:, :, p, 2] = (cx[None, :] + bw / 2) / iw
+        out[:, :, p, 3] = (cy[:, None] + bh / 2) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    from ..core.tensor import to_tensor
+
+    return to_tensor(out), to_tensor(var)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode one YOLOv3 head (reference phi yolo_box): x [N, A*(5+C), H, W]
+    -> (boxes [N, A*H*W, 4] xyxy in image pixels, scores [N, A*H*W, C]).
+    Low-confidence boxes are zeroed (the reference's conf_thresh gating
+    keeps shapes static — exactly XLA's requirement)."""
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    A = anchors.shape[0]
+
+    def f(xv, img):
+        N, _, H, W = xv.shape
+        v = xv.reshape(N, A, 5 + class_num, H, W)
+        gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        sig = jax.nn.sigmoid
+        bx = (sig(v[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 + gx) / W
+        by = (sig(v[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 + gy) / H
+        aw = anchors[:, 0][None, :, None, None]
+        ah = anchors[:, 1][None, :, None, None]
+        bw = jnp.exp(v[:, :, 2]) * aw / (W * downsample_ratio)
+        bh = jnp.exp(v[:, :, 3]) * ah / (H * downsample_ratio)
+        conf = sig(v[:, :, 4])
+        cls = sig(v[:, :, 5:]) * conf[:, :, None]
+        ih = img[:, 0].astype(jnp.float32)[:, None, None, None]
+        iw = img[:, 1].astype(jnp.float32)[:, None, None, None]
+        x0 = (bx - bw / 2) * iw
+        y0 = (by - bh / 2) * ih
+        x1 = (bx + bw / 2) * iw
+        y1 = (by + bh / 2) * ih
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0, iw - 1)
+            y0 = jnp.clip(y0, 0, ih - 1)
+            x1 = jnp.clip(x1, 0, iw - 1)
+            y1 = jnp.clip(y1, 0, ih - 1)
+        keep = (conf >= conf_thresh)[..., None]
+        # stack(axis=-1) is ALREADY [N, A, H, W, 4]; rows flatten in
+        # (A, H, W) order, matching the scores below
+        boxes = (jnp.stack([x0, y0, x1, y1], axis=-1) * keep).reshape(
+            N, -1, 4)
+        scores = (cls * keep.squeeze(-1)[:, :, None]).transpose(
+            0, 1, 3, 4, 2).reshape(N, -1, class_num)
+        return boxes, scores
+
+    return run_op("yolo_box", f, x, img_size)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels (reference phi distribute_fpn_proposals):
+    level = floor(refer_level + log2(sqrt(area)/refer_scale)). Host-side
+    (output partition is data-dependent, like the reference's CPU op)."""
+    rois = np.asarray(fpn_rois.numpy(), np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-12))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    from ..core.tensor import to_tensor
+
+    outs, nums = [], []
+    order = []
+    for L in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == L)[0]
+        order.append(idx)
+        outs.append(to_tensor(rois[idx]))
+        nums.append(to_tensor(np.array([len(idx)], np.int32)))
+    # restore index: position of each original roi in the concatenated outs
+    concat_order = np.concatenate(order) if order else np.zeros((0,))
+    restore = np.argsort(concat_order).astype(np.int64)
+    return outs, to_tensor(restore), nums
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference phi psroi_pool): input
+    channels C = out_c * ph * pw; bin (i, j) of an RoI average-pools its
+    OWN channel group — the R-FCN head."""
+    ph, pw = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    bn = [int(v) for v in np.asarray(boxes_num.numpy()).reshape(-1)]
+    img_ids = np.concatenate([np.full((n,), i, np.int32)
+                              for i, n in enumerate(bn)]) if bn else \
+        np.zeros((0,), np.int32)
+
+    def f(xv, bv):
+        C = xv.shape[1]
+        out_c = C // (ph * pw)
+        H, W = xv.shape[2], xv.shape[3]
+
+        def one_roi(box, img_id):
+            x0 = box[0] * spatial_scale
+            y0 = box[1] * spatial_scale
+            x1 = box[2] * spatial_scale
+            y1 = box[3] * spatial_scale
+            rw = jnp.maximum(x1 - x0, 0.1)
+            rh = jnp.maximum(y1 - y0, 0.1)
+            img = xv[img_id].reshape(out_c, ph, pw, H, W)
+            cols = []
+            for i in range(ph):
+                for j in range(pw):
+                    ys = y0 + rh * i / ph
+                    ye = y0 + rh * (i + 1) / ph
+                    xs = x0 + rw * j / pw
+                    xe = x0 + rw * (j + 1) / pw
+                    yy = jnp.arange(H, dtype=jnp.float32)
+                    xx = jnp.arange(W, dtype=jnp.float32)
+                    my = (yy >= jnp.floor(ys)) & (yy < jnp.ceil(ye))
+                    mx = (xx >= jnp.floor(xs)) & (xx < jnp.ceil(xe))
+                    m = my[:, None] & mx[None, :]
+                    cnt = jnp.maximum(jnp.sum(m), 1)
+                    # channel group of THIS bin: [out_c, H, W]
+                    grp = img[:, i, j]
+                    cols.append(jnp.sum(grp * m[None], axis=(1, 2)) / cnt)
+            return jnp.stack(cols, axis=1).reshape(out_c, ph, pw)
+
+        return jax.vmap(one_roi)(bv, jnp.asarray(img_ids))
+
+    return run_op("psroi_pool", f, x, boxes)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference phi deformable_conv):
+    bilinear-sample the input at offset-shifted tap positions, then a
+    plain dense contraction — the gather-based TPU formulation (the CUDA
+    kernel's im2col-with-offsets becomes an explicit sampled patch
+    tensor feeding one einsum on the MXU).
+
+    mask=None → v1; mask [N, dg*kh*kw, Ho, Wo] → v2 modulation."""
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph_, pw_ = (padding, padding) if isinstance(padding, int) \
+        else tuple(padding)
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    def f(xv, ov, wv, *rest):
+        N, C, H, W = xv.shape
+        Co, Cg, kh, kw = wv.shape
+        Ho = (H + 2 * ph_ - (dh * (kh - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+        K = kh * kw
+        dg = deformable_groups
+        # base tap positions [Ho, Wo, K]
+        oy = jnp.arange(Ho) * sh - ph_
+        ox = jnp.arange(Wo) * sw - pw_
+        ky = jnp.arange(kh) * dh
+        kx = jnp.arange(kw) * dw
+        base_y = jnp.broadcast_to(
+            oy[:, None, None, None] + ky[None, None, :, None],
+            (Ho, Wo, kh, kw)).reshape(Ho, Wo, K).astype(jnp.float32)
+        base_x = jnp.broadcast_to(
+            ox[None, :, None, None] + kx[None, None, None, :],
+            (Ho, Wo, kh, kw)).reshape(Ho, Wo, K).astype(jnp.float32)
+        # offsets [N, dg, K, 2, Ho, Wo] (reference layout: y then x)
+        off = ov.reshape(N, dg, K, 2, Ho, Wo)
+        sy = base_y[None, None] + off[:, :, :, 0].transpose(0, 1, 3, 4, 2)
+        sx = base_x[None, None] + off[:, :, :, 1].transpose(0, 1, 3, 4, 2)
+        # bilinear sample each deformable group's channels at (sy, sx):
+        # [N, dg, Ho, Wo, K] sampling grid over [N, C, H, W]
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        wy = sy - y0
+        wx = sx - x0
+
+        def gather(img, yy, xx):
+            # img [N, dg, Cdg, H, W]; yy/xx [N, dg, Ho, Wo, K] int
+            valid = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yc = jnp.clip(yy, 0, H - 1)
+            xc = jnp.clip(xx, 0, W - 1)
+            flat = img.reshape(N, dg, -1, H * W)
+            idx = (yc * W + xc).reshape(N, dg, 1, -1)
+            g = jnp.take_along_axis(
+                flat, jnp.broadcast_to(idx, flat.shape[:3] + (idx.shape[-1],)),
+                axis=-1)
+            g = g.reshape(N, dg, -1, Ho, Wo, K)
+            return g * valid[:, :, None].astype(g.dtype)
+
+        img = xv.reshape(N, dg, C // dg, H, W)
+        samp = 0.0
+        for dy, wyy in ((0, 1 - wy), (1, wy)):
+            for dx_, wxx in ((0, 1 - wx), (1, wx)):
+                g = gather(img, (y0 + dy).astype(jnp.int32),
+                           (x0 + dx_).astype(jnp.int32))
+                samp = samp + g * (wyy * wxx)[:, :, None]
+        # v2 modulation
+        if rest and mask is not None:
+            mval = rest[-1].reshape(N, dg, K, Ho, Wo).transpose(0, 1, 3, 4, 2)
+            samp = samp * mval[:, :, None]
+        # samp [N, dg, C/dg, Ho, Wo, K] -> [N, C, K, Ho, Wo]
+        samp = samp.reshape(N, C, Ho, Wo, K).transpose(0, 1, 4, 2, 3)
+        # grouped contraction: weight [Co, C/groups, kh, kw]
+        sampg = samp.reshape(N, groups, C // groups, K, Ho, Wo)
+        wg = wv.reshape(groups, Co // groups, Cg, K)
+        out = jnp.einsum("ngckhw,gock->ngohw", sampg, wg).reshape(
+            N, Co, Ho, Wo)
+        if bias is not None:
+            # args append bias BEFORE mask: bias is always rest[0]
+            out = out + rest[0].reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if bias is not None:
+        args.append(bias)
+    if mask is not None:
+        args.append(mask)
+    return run_op("deform_conv2d", f, *args)

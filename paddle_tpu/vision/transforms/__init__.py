@@ -11,6 +11,7 @@ from . import functional  # noqa: F401
 __all__ = [
     "Compose", "ToTensor", "Normalize", "Resize", "RandomCrop", "CenterCrop",
     "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "BrightnessTransform",
+    "Pad", "Grayscale", "ColorJitter", "RandomRotation", "RandomResizedCrop",
 ]
 
 
@@ -160,3 +161,172 @@ class BrightnessTransform:
         img = np.asarray(img, "float32")
         factor = 1.0 + np.random.uniform(-self.value, self.value)
         return np.clip(img * factor, 0, 1)
+
+
+class Pad:
+    """Pad all sides (int) or (left/top, right/bottom) or 4-tuple
+    (left, top, right, bottom) — reference paddle.vision.transforms.Pad."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, int):
+            padding = (padding,) * 4
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = tuple(padding)  # (left, top, right, bottom)
+        self.fill = fill
+        self.mode = {"constant": "constant", "edge": "edge",
+                     "reflect": "reflect",
+                     "symmetric": "symmetric"}[padding_mode]
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        l, t, r, b = self.padding
+        h_axis, w_axis = ((1, 2) if _chw(img) else (0, 1))
+        pads = [(0, 0)] * img.ndim
+        pads[h_axis] = (t, b)
+        pads[w_axis] = (l, r)
+        if self.mode == "constant":
+            return np.pad(img, pads, mode="constant",
+                          constant_values=self.fill)
+        return np.pad(img, pads, mode=self.mode)
+
+
+class Grayscale:
+    """RGB -> luminance (ITU-R 601), optionally replicated to 3 channels."""
+
+    def __init__(self, num_output_channels=1):
+        self.n = int(num_output_channels)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        w = np.array([0.299, 0.587, 0.114], img.dtype
+                     if np.issubdtype(img.dtype, np.floating)
+                     else np.float32)
+        if _chw(img):
+            g = np.tensordot(w, img.astype(w.dtype), axes=([0], [0]))[None]
+            out = np.repeat(g, self.n, axis=0)
+        else:
+            g = np.tensordot(img.astype(w.dtype), w, axes=([-1], [0]))[..., None]
+            out = np.repeat(g, self.n, axis=-1)
+        return out.astype(img.dtype) if np.issubdtype(
+            img.dtype, np.integer) else out
+
+
+class ColorJitter:
+    """Random brightness/contrast/saturation/hue jitter (reference
+    transforms.ColorJitter). Factors sampled uniformly per call from
+    [max(0, 1-v), 1+v] (hue from [-v, v])."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0,
+                 hue=0.0):
+        self.b, self.c, self.s, self.h = brightness, contrast, saturation, hue
+
+    @staticmethod
+    def _rand(v):
+        return float(np.random.uniform(max(0.0, 1 - v), 1 + v))
+
+    def __call__(self, img):
+        img = np.asarray(img).astype(np.float32)
+        chw = _chw(img)
+        caxis = 0 if chw else -1
+        if self.b:
+            img = img * self._rand(self.b)
+        if self.c:
+            mean = img.mean()
+            img = (img - mean) * self._rand(self.c) + mean
+        if self.s:
+            w = np.array([0.299, 0.587, 0.114], np.float32)
+            gray = np.tensordot(w, img, axes=([0], [caxis]))
+            gray = np.expand_dims(gray, caxis)
+            img = (img - gray) * self._rand(self.s) + gray
+        if self.h:
+            # cheap hue approx: rotate RGB channels toward their mean
+            shift = float(np.random.uniform(-self.h, self.h))
+            mean = img.mean(axis=caxis, keepdims=True)
+            img = img + shift * (np.roll(img, 1, axis=caxis) - mean)
+        return np.clip(img, 0.0, 255.0 if img.max() > 1.5 else 1.0)
+
+
+class RandomRotation:
+    """Rotate by a uniform random angle in degrees (nearest-neighbor
+    resampling on the host, reference transforms.RandomRotation)."""
+
+    def __init__(self, degrees, expand=False, center=None, fill=0):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = tuple(degrees)
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        ang = np.deg2rad(np.random.uniform(*self.degrees))
+        chw = _chw(img)
+        h_axis, w_axis = ((1, 2) if chw else (0, 1))
+        H, W = img.shape[h_axis], img.shape[w_axis]
+        if self.center is not None:
+            cx, cy = self.center
+        else:
+            cy, cx = (H - 1) / 2.0, (W - 1) / 2.0
+        if self.expand:
+            # enlarged canvas holding the whole rotated image
+            Ho = int(np.ceil(abs(H * np.cos(ang)) + abs(W * np.sin(ang))))
+            Wo = int(np.ceil(abs(H * np.sin(ang)) + abs(W * np.cos(ang))))
+        else:
+            Ho, Wo = H, W
+        oy, ox = (Ho - 1) / 2.0, (Wo - 1) / 2.0
+        yy, xx = np.mgrid[0:Ho, 0:Wo]
+        if self.expand:
+            # output centered on its own canvas; sample around (cy, cx)
+            yy = yy - oy + cy
+            xx = xx - ox + cx
+        ys = cy + (yy - cy) * np.cos(ang) - (xx - cx) * np.sin(ang)
+        xs = cx + (yy - cy) * np.sin(ang) + (xx - cx) * np.cos(ang)
+        yi = np.clip(np.rint(ys).astype(np.int64), 0, H - 1)
+        xi = np.clip(np.rint(xs).astype(np.int64), 0, W - 1)
+        valid = (ys >= 0) & (ys <= H - 1) & (xs >= 0) & (xs <= W - 1)
+        if chw:
+            out = img[:, yi, xi]
+            out = np.where(valid[None], out, self.fill)
+        else:
+            out = img[yi, xi]
+            out = np.where(valid[..., None] if img.ndim == 3 else valid,
+                           out, self.fill)
+        return out.astype(img.dtype)
+
+
+class RandomResizedCrop:
+    """Random area/aspect crop resized to ``size`` (reference
+    transforms.RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        chw = _chw(img)
+        h_axis, w_axis = ((1, 2) if chw else (0, 1))
+        H, W = img.shape[h_axis], img.shape[w_axis]
+        area = H * W
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if 0 < w <= W and 0 < h <= H:
+                top = np.random.randint(0, H - h + 1)
+                left = np.random.randint(0, W - w + 1)
+                break
+        else:
+            h, w = min(H, W), min(H, W)
+            top, left = (H - h) // 2, (W - w) // 2
+        sl = [slice(None)] * img.ndim
+        sl[h_axis] = slice(top, top + h)
+        sl[w_axis] = slice(left, left + w)
+        crop = img[tuple(sl)]
+        return Resize(self.size)(crop)

@@ -320,3 +320,206 @@ def test_subset_random_sampler():
     s = SubsetRandomSampler([3, 7, 9])
     got = sorted(list(iter(s)))
     assert got == [3, 7, 9] and len(s) == 3
+
+
+class TestVisionOpsCompletions:
+    def test_deform_conv2d_zero_offsets_equals_conv(self):
+        """v1 with all-zero offsets IS the dense conv — exact parity."""
+        rng = np.random.RandomState(21)
+        x = rng.randn(2, 4, 7, 7).astype(np.float32)
+        w = rng.randn(6, 4, 3, 3).astype(np.float32)
+        b = rng.randn(6).astype(np.float32)
+        off = np.zeros((2, 2 * 9, 7, 7), np.float32)
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        got = deform_conv2d(_t(x), _t(off), _t(w), bias=_t(b),
+                            padding=1).numpy()
+        ref = F.conv2d(_t(x), _t(w), bias=_t(b), padding=1).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_deform_conv2d_v2_mask_scales(self):
+        rng = np.random.RandomState(22)
+        x = rng.randn(1, 2, 5, 5).astype(np.float32)
+        w = rng.randn(3, 2, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 5, 5), np.float32)
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        full = deform_conv2d(_t(x), _t(off), _t(w), padding=1,
+                             mask=_t(np.ones((1, 9, 5, 5), np.float32)))
+        half = deform_conv2d(_t(x), _t(off), _t(w), padding=1,
+                             mask=_t(np.full((1, 9, 5, 5), 0.5,
+                                             np.float32)))
+        np.testing.assert_allclose(half.numpy(), full.numpy() * 0.5,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_prior_box_geometry(self):
+        feat = _t(np.zeros((1, 1, 2, 2), np.float32))
+        img = _t(np.zeros((1, 3, 32, 32), np.float32))
+        from paddle_tpu.vision.ops import prior_box
+
+        boxes, var = prior_box(feat, img, min_sizes=[16])
+        assert boxes.shape == [2, 2, 1, 4]
+        b00 = boxes.numpy()[0, 0, 0]
+        # cell (0,0) center at (8, 8) px, box 16x16 -> [0, 0, 16, 16]/32
+        np.testing.assert_allclose(b00, [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+        np.testing.assert_allclose(var.numpy()[0, 0, 0],
+                                   [0.1, 0.1, 0.2, 0.2])
+
+    def test_yolo_box_decode_single_cell(self):
+        from paddle_tpu.vision.ops import yolo_box
+
+        A, C = 1, 1
+        x = np.zeros((1, A * (5 + C), 1, 1), np.float32)
+        x[0, 4] = 10.0  # conf ~ 1
+        x[0, 5] = 10.0  # class ~ 1
+        boxes, scores = yolo_box(_t(x), _t(np.array([[32, 32]], np.int32)),
+                                 [16, 16], C, 0.5, downsample_ratio=32,
+                                 clip_bbox=False)
+        # sigmoid(0)=0.5 -> center (0.5, 0.5) of the 1x1 grid; w=h=16/32
+        np.testing.assert_allclose(boxes.numpy()[0, 0],
+                                   [8.0, 8.0, 24.0, 24.0], atol=1e-3)
+        assert scores.numpy()[0, 0, 0] > 0.99
+
+    def test_distribute_fpn_and_psroi(self):
+        from paddle_tpu.vision.ops import distribute_fpn_proposals, psroi_pool
+
+        rois = _t(np.array([[0, 0, 20, 20], [0, 0, 220, 220],
+                            [0, 0, 500, 500]], np.float32))
+        outs, restore, nums = distribute_fpn_proposals(rois, 2, 5, 4, 224)
+        sizes = [int(np.asarray(n.numpy())[0]) for n in nums]
+        assert sum(sizes) == 3
+        # small roi -> low level, big roi -> high level
+        assert sizes[0] >= 1 and sizes[-1] >= 1
+        cat = np.concatenate([o.numpy() for o in outs if o.shape[0]])
+        np.testing.assert_allclose(cat[restore.numpy()], rois.numpy())
+
+        # psroi: constant per-channel input -> output equals the channel
+        # group's constant
+        x = np.zeros((1, 4, 4, 4), np.float32)  # out_c=1, ph=pw=2
+        for c in range(4):
+            x[0, c] = c
+        out = psroi_pool(_t(x), _t(np.array([[0, 0, 3, 3]], np.float32)),
+                         _t(np.array([1], np.int32)), 2).numpy()
+        np.testing.assert_allclose(out[0, 0].reshape(-1), [0, 1, 2, 3])
+
+
+class TestTransformCompletions:
+    def test_pad_grayscale_shapes_and_values(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = np.ones((3, 4, 4), np.float32)
+        assert T.Pad(2)(img).shape == (3, 8, 8)
+        assert T.Pad((1, 2))(img).shape == (3, 8, 6)
+        g = T.Grayscale(1)(img)
+        assert g.shape == (1, 4, 4)
+        np.testing.assert_allclose(g, 1.0, rtol=1e-5)
+
+    def test_random_transforms_deterministic_under_seed(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = np.random.RandomState(0).rand(3, 16, 16).astype(np.float32)
+        outs = []
+        for _ in range(2):
+            np.random.seed(77)
+            outs.append((T.ColorJitter(0.3, 0.3, 0.3, 0.1)(img),
+                         T.RandomRotation(25)(img),
+                         T.RandomResizedCrop(8)(img)))
+        for a, b in zip(outs[0], outs[1]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        assert outs[0][2].shape[-2:] == (8, 8)
+
+
+class TestIncubateCompletions:
+    def test_segment_minmax_and_masked_softmax(self):
+        import paddle_tpu.incubate as inc
+
+        d = _t(np.array([[1.0, 5], [3, 2], [0, 9]], np.float32))
+        ids = _t(np.array([0, 0, 1]))
+        np.testing.assert_allclose(inc.segment_max(d, ids).numpy(),
+                                   [[3, 5], [0, 9]])
+        np.testing.assert_allclose(inc.segment_min(d, ids).numpy(),
+                                   [[1, 2], [0, 9]])
+        x = np.random.RandomState(3).randn(2, 4, 4).astype(np.float32)
+        sm = inc.softmax_mask_fuse_upper_triangle(_t(x)).numpy()
+        assert np.allclose(np.triu(sm[0], 1), 0.0)
+        np.testing.assert_allclose(sm.sum(-1), 1.0, rtol=1e-5)
+        assert hasattr(inc.autograd, "jacobian")
+        np.testing.assert_allclose(
+            float(inc.identity_loss(_t(np.array([2.0, 4.0], np.float32)),
+                                    reduction="sum")), 6.0)
+
+
+class TestVisionOpsReviewFixes:
+    def test_yolo_box_multicell_grid_alignment(self):
+        """Review finding: boxes on an H>1 grid must stay aligned with
+        their cells (the scores-path transpose scrambled them)."""
+        from paddle_tpu.vision.ops import yolo_box
+
+        A, C, H, W = 1, 1, 2, 2
+        x = np.zeros((1, A * (5 + C), H, W), np.float32)
+        x[0, 4] = 10.0
+        x[0, 5] = 10.0
+        boxes, scores = yolo_box(_t(x), _t(np.array([[64, 64]], np.int32)),
+                                 [32, 32], C, 0.5, downsample_ratio=32,
+                                 clip_bbox=False)
+        b = boxes.numpy().reshape(H, W, 4)
+        # cell (i, j) center at ((j+0.5)/W, (i+0.5)/H) of a 64px image,
+        # box 32x32: x range = 64*(j+0.5)/2 +- 16
+        for i in range(H):
+            for j in range(W):
+                cx = 64 * (j + 0.5) / W
+                cy = 64 * (i + 0.5) / H
+                np.testing.assert_allclose(
+                    b[i, j], [cx - 16, cy - 16, cx + 16, cy + 16],
+                    atol=1e-3)
+
+    def test_deform_conv2d_bias_with_mask(self):
+        """Review finding: bias must be rest[0] even when a mask is also
+        passed (DCNv2's standard call)."""
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        rng = np.random.RandomState(23)
+        x = rng.randn(1, 2, 5, 5).astype(np.float32)
+        w = rng.randn(3, 2, 3, 3).astype(np.float32)
+        b = rng.randn(3).astype(np.float32)
+        off = np.zeros((1, 18, 5, 5), np.float32)
+        ones_mask = np.ones((1, 9, 5, 5), np.float32)
+        with_b = deform_conv2d(_t(x), _t(off), _t(w), bias=_t(b),
+                               padding=1, mask=_t(ones_mask)).numpy()
+        no_b = deform_conv2d(_t(x), _t(off), _t(w), padding=1,
+                             mask=_t(ones_mask)).numpy()
+        np.testing.assert_allclose(with_b - no_b,
+                                   np.broadcast_to(b.reshape(1, -1, 1, 1),
+                                                   with_b.shape),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_prior_box_min_max_order(self):
+        from paddle_tpu.vision.ops import prior_box
+
+        feat = _t(np.zeros((1, 1, 1, 1), np.float32))
+        img = _t(np.zeros((1, 3, 32, 32), np.float32))
+        kw = dict(min_sizes=[8], max_sizes=[16], aspect_ratios=[2.0])
+        b_def, _ = prior_box(feat, img, **kw)
+        b_mm, _ = prior_box(feat, img, min_max_aspect_ratios_order=True,
+                            **kw)
+        wdef = (b_def.numpy()[0, 0, :, 2] - b_def.numpy()[0, 0, :, 0]) * 32
+        wmm = (b_mm.numpy()[0, 0, :, 2] - b_mm.numpy()[0, 0, :, 0]) * 32
+        # default: [min(8), ar2, max(sqrt(128))]; flag: [min, max, ar2]
+        np.testing.assert_allclose(wdef[0], 8, atol=1e-4)
+        np.testing.assert_allclose(wmm[1], np.sqrt(8 * 16), atol=1e-4)
+        assert set(np.round(wdef, 3)) == set(np.round(wmm, 3))
+
+    def test_random_rotation_expand_and_center(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = np.ones((1, 10, 20), np.float32)
+        np.random.seed(5)
+        out = T.RandomRotation((90, 90), expand=True)(img)
+        # 90-degree rotation of 10x20 -> canvas ~20x10
+        assert abs(out.shape[1] - 20) <= 1 and abs(out.shape[2] - 10) <= 1
+        # most content preserved under expand (nearest-neighbor resampling
+        # clips a boundary row/col at exact 90 degrees)
+        assert out.sum() >= img.sum() * 0.85
+        np.random.seed(5)
+        out_c = T.RandomRotation((90, 90), center=(5.0, 5.0))(img)
+        assert out_c.shape == img.shape
