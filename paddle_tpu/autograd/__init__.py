@@ -11,7 +11,8 @@ from typing import Any, List, Optional, Sequence, Union
 import jax
 
 from ..core import autograd as _engine
-from ..core.autograd import GradNode, enable_grad, is_grad_enabled, no_grad, set_grad_enabled
+from ..core.autograd import (GradNode, enable_grad, is_grad_enabled,
+                             no_grad, saved_tensors_hooks, set_grad_enabled)
 from ..core.tensor import Tensor
 from ..enforce import InvalidArgumentError, raise_unimplemented
 
@@ -21,6 +22,7 @@ __all__ = [
     "PyLayer",
     "PyLayerContext",
     "no_grad",
+    "saved_tensors_hooks",
     "enable_grad",
     "is_grad_enabled",
     "set_grad_enabled",

@@ -75,6 +75,33 @@ class enable_grad(contextlib.ContextDecorator):
 Edge = Tuple[str, Any, int]
 
 
+# --- saved-tensors hooks (reference paddle.autograd.saved_tensors_hooks):
+# pack_hook transforms each tensor SAVED for backward at record time;
+# unpack_hook restores it at backward time. The TPU-native realisation:
+# with hooks active, an op's vjp is built LAZILY at backward from the
+# unpacked inputs (recompute-from-packed) — the packed form is what stays
+# alive, which is the whole point (offload/compress saved activations).
+_SAVED_HOOKS: list = []
+
+
+class saved_tensors_hooks:
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _SAVED_HOOKS.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _SAVED_HOOKS.pop()
+        return False
+
+
+def active_saved_hooks():
+    return _SAVED_HOOKS[-1] if _SAVED_HOOKS else None
+
+
 class GradNode:
     """One recorded op: holds the VJP closure and edges to producers.
 

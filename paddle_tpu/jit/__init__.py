@@ -31,7 +31,8 @@ from ..static import InputSpec
 
 __all__ = ["to_static", "enable_to_static", "TracedProgram", "save", "load",
            "ignore_module", "not_to_static", "is_tracing",
-           "fused_train_step", "FusedTrainStep"]
+           "fused_train_step", "FusedTrainStep", "TranslatedLayer",
+           "set_code_level", "set_verbosity"]
 
 _TRACING = [False]
 
@@ -414,6 +415,39 @@ def save(layer, path, input_spec=None, **configs):
         pickle.dump(meta, f)
 
 
+class TranslatedLayer:
+    """The object ``jit.load`` returns (reference jit.TranslatedLayer):
+    call-compatible with the original Layer, running the deserialized
+    StableHLO program over the reloaded weights."""
+
+    def __init__(self, state, meta, exported):
+        self.state = state
+        self._meta = meta
+        self._exported = exported
+
+    def __call__(self, *inputs):
+        # reconstruct (params, buffers, *inputs) calling convention using
+        # the key order recorded at save time (frozen params were baked
+        # into the export and appear in neither list)
+        pv = [self.state[k]._value
+              for k in self._meta.get("param_keys", [])]
+        bv = [self.state[k]._value
+              for k in self._meta.get("buffer_keys", [])]
+        ivals = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                 for t in inputs]
+        outs = self._exported.call(pv, bv, *ivals)
+        outs = [to_tensor(o) for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        return self
+
+
 def load(path, **configs):
     from ..framework.io import load as fload
 
@@ -428,30 +462,27 @@ def load(path, **configs):
         with open(path + ".pdmodel", "rb") as f:
             exported = jexport.deserialize(f.read())
 
-        class _Callable:
-            def __init__(self):
-                self.state = state
-
-            def __call__(self, *inputs):
-                # reconstruct (params, buffers, *inputs) calling convention
-                # using the key order recorded at save time (frozen params
-                # were baked into the export and appear in neither list)
-                pv = [state[k]._value for k in meta.get("param_keys", [])]
-                bv = [state[k]._value for k in meta.get("buffer_keys", [])]
-                ivals = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
-                         for t in inputs]
-                outs = exported.call(pv, bv, *ivals)
-                outs = [to_tensor(o) for o in outs]
-                return outs[0] if len(outs) == 1 else tuple(outs)
-
-            def eval(self):
-                return self
-
-        return _Callable()
+        return TranslatedLayer(state, meta, exported)
     raise InvalidArgumentError(
         f"No exported program at {path}.pdmodel — only weights were saved "
         f"(export_error: {meta.get('export_error')})"
     )
+
+
+_DEBUG = {"code_level": 0, "verbosity": 0}
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Debug knob (reference jit.set_code_level): level > 0 makes
+    dy2static print the rewritten source of each converted function."""
+    _DEBUG["code_level"] = int(level)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Debug knob (reference jit.set_verbosity): level > 0 logs one line
+    per dy2static-converted function (``also_to_stdout`` is accepted for
+    signature compatibility; output already goes to stdout)."""
+    _DEBUG["verbosity"] = int(level)
 
 
 def ignore_module(modules):

@@ -367,8 +367,9 @@ _JST = _JstNamespace()
 # Entry
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=256)
-def _transform_cached(code, name, filename):
+def _build_tree(code):
+    """Parse + rewrite: the ONE transform both execution and the
+    set_code_level debug dump use (a second transform could diverge)."""
     tree = ast.parse(code)
     fdef = tree.body[0]
     fdef.decorator_list = []
@@ -381,7 +382,14 @@ def _transform_cached(code, name, filename):
     tr = _Transformer(params)
     fdef.body = tr._visit_block(fdef.body)
     ast.fix_missing_locations(tree)
-    return compile(tree, filename=f"<dy2static {filename}>", mode="exec")
+    return tree
+
+
+@functools.lru_cache(maxsize=256)
+def _transform_cached(code, name, filename):
+    tree = _build_tree(code)
+    return (compile(tree, filename=f"<dy2static {filename}>", mode="exec"),
+            ast.unparse(tree))
 
 
 def convert_to_static(fn: Callable) -> Callable:
@@ -393,8 +401,8 @@ def convert_to_static(fn: Callable) -> Callable:
             if conv is not fn.__func__ else fn
     try:
         src = textwrap.dedent(inspect.getsource(fn))
-        code = _transform_cached(src, fn.__name__,
-                                 getattr(fn, "__module__", "?"))
+        code, rewritten_src = _transform_cached(
+            src, fn.__name__, getattr(fn, "__module__", "?"))
     except (OSError, TypeError, SyntaxError, IndentationError):
         return fn
 
@@ -408,6 +416,14 @@ def convert_to_static(fn: Callable) -> Callable:
                 glb[name] = cell.cell_contents
             except ValueError:
                 pass
+    from .. import _DEBUG
+
+    if _DEBUG.get("code_level", 0) > 0:
+        # jit.set_code_level: show the EXACT rewritten source that will
+        # execute (same tree the compiled code came from)
+        print(f"-- dy2static: {fn.__qualname__} --\n{rewritten_src}")
+    elif _DEBUG.get("verbosity", 0) > 0:
+        print(f"dy2static: converted {fn.__qualname__}")
     loc: dict = {}
     exec(code, glb, loc)
     out = loc[fn.__name__]

@@ -208,7 +208,41 @@ def _run_op_impl(
             full[i] = a
         return pure_fn(*full)
 
-    out, vjp_fn = jax.vjp(f, *(arrays[i] for i in diff_idx))
+    hooks = autograd.active_saved_hooks()
+    if hooks is not None:
+        # saved-tensors hooks: pack the would-be-saved inputs NOW, build
+        # the vjp lazily at backward from the unpacked values (see
+        # core.autograd.saved_tensors_hooks)
+        pack_hook, unpack_hook = hooks
+        out = f(*(arrays[i] for i in diff_idx))
+        packed = [pack_hook(Tensor(arrays[i], stop_gradient=True))
+                  for i in diff_idx]
+        # the lazy closure must NOT capture `f` (its `frozen` list pins
+        # every original device buffer — defeating pack hooks that
+        # offload); null the diff slots and refill from the unpacked
+        # values at backward time
+        frozen_rest = [None if i in set(diff_idx) else a
+                       for i, a in enumerate(arrays)]
+
+        def vjp_fn(cot, _packed=packed, _rest=frozen_rest,
+                   _didx=tuple(diff_idx), _fn=pure_fn,
+                   _unpack=unpack_hook):
+            vals = []
+            for pk in _packed:
+                u = _unpack(pk)
+                vals.append(u._value if isinstance(u, Tensor)
+                            else jnp.asarray(u))
+
+            def g(*diff_arrays):
+                full = list(_rest)
+                for i, a in zip(_didx, diff_arrays):
+                    full[i] = a
+                return _fn(*full)
+
+            _, inner = jax.vjp(g, *vals)
+            return inner(cot)
+    else:
+        out, vjp_fn = jax.vjp(f, *(arrays[i] for i in diff_idx))
 
     in_edges: List[autograd.Edge] = []
     for i in diff_idx:

@@ -9,7 +9,7 @@ __all__ = [
     "LRScheduler", "NoamDecay", "PiecewiseDecay", "NaturalExpDecay",
     "InverseTimeDecay", "PolynomialDecay", "LinearWarmup", "ExponentialDecay",
     "MultiStepDecay", "StepDecay", "LambdaDecay", "ReduceOnPlateau",
-    "CosineAnnealingDecay", "MultiplicativeDecay", "OneCycleLR",
+    "CosineAnnealingDecay", "CosineAnnealingWarmRestarts", "MultiplicativeDecay", "OneCycleLR",
     "CyclicLR", "LinearLR",
 ]
 
@@ -205,6 +205,32 @@ class CosineAnnealingDecay(LRScheduler):
     def get_lr(self):
         return (self.eta_min + (self.base_lr - self.eta_min)
                 * (1 + math.cos(math.pi * self.last_epoch / self.T_max)) / 2)
+
+
+class CosineAnnealingWarmRestarts(LRScheduler):
+    """SGDR (reference paddle.optimizer.lr.CosineAnnealingWarmRestarts):
+    cosine anneal over T_i epochs, restart, T_{i+1} = T_i * T_mult."""
+
+    def __init__(self, learning_rate, T_0, T_mult=1, eta_min=0,
+                 last_epoch=-1, verbose=False):
+        if T_0 <= 0 or T_mult < 1:
+            from ..enforce import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                "CosineAnnealingWarmRestarts needs T_0 > 0 and T_mult >= 1")
+        self.T_0 = T_0
+        self.T_mult = T_mult
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = max(self.last_epoch, 0)
+        T_i = self.T_0
+        while t >= T_i:
+            t -= T_i
+            T_i *= self.T_mult
+        return (self.eta_min + (self.base_lr - self.eta_min)
+                * (1 + math.cos(math.pi * t / T_i)) / 2)
 
 
 class LinearLR(LRScheduler):

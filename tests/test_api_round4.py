@@ -523,3 +523,79 @@ class TestVisionOpsReviewFixes:
         np.random.seed(5)
         out_c = T.RandomRotation((90, 90), center=(5.0, 5.0))(img)
         assert out_c.shape == img.shape
+
+
+class TestFinalCompletions:
+    def test_saved_tensors_hooks_pack_unpack(self):
+        """paddle.autograd.saved_tensors_hooks: pack transforms saved
+        tensors at record time, unpack restores at backward — gradients
+        must be exact, and the hooks must actually fire."""
+        calls = {"pack": 0, "unpack": 0}
+
+        def pack(t):
+            calls["pack"] += 1
+            return np.asarray(t.numpy())  # e.g. offload to host
+
+        def unpack(v):
+            calls["unpack"] += 1
+            return paddle.to_tensor(v)
+
+        x = _t(np.array([2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        with paddle.autograd.saved_tensors_hooks(pack, unpack):
+            y = paddle.sum(x * x)
+        assert calls["pack"] > 0 and calls["unpack"] == 0
+        y.backward()
+        assert calls["unpack"] > 0
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0], rtol=1e-6)
+        # without hooks: unchanged behavior
+        x2 = _t(np.array([2.0, 3.0], np.float32))
+        x2.stop_gradient = False
+        paddle.sum(x2 * x2).backward()
+        np.testing.assert_allclose(x2.grad.numpy(), [4.0, 6.0], rtol=1e-6)
+
+    def test_cosine_warm_restarts(self):
+        from paddle_tpu.optimizer.lr import CosineAnnealingWarmRestarts
+
+        s = CosineAnnealingWarmRestarts(0.1, T_0=4, T_mult=2)
+        lrs = []
+        for _ in range(12):
+            lrs.append(s.get_lr())
+            s.step()
+        assert abs(lrs[0] - 0.1) < 1e-9          # epoch 0: max
+        assert lrs[2] < lrs[1] < lrs[0]          # annealing
+        assert abs(lrs[4] - 0.1) < 1e-9          # restart at T_0
+        assert abs(lrs[12 - 8 + 4] - lrs[4]) > 0  # second period longer
+        assert all(v <= 0.1 + 1e-9 for v in lrs)
+
+    def test_jit_debug_knobs_and_translated_layer(self, capsys, tmp_path):
+        import paddle_tpu.jit as jit
+
+        jit.set_code_level(100)
+        try:
+            @jit.to_static
+            def f(x):
+                if x.sum() > 0:
+                    y = x + 1
+                else:
+                    y = x - 1
+                return y
+
+            out = f(_t(np.ones(2, np.float32)))
+            np.testing.assert_allclose(out.numpy(), [2, 2])
+            assert "dy2static" in capsys.readouterr().out
+        finally:
+            jit.set_code_level(0)
+        jit.set_verbosity(3)
+        jit.set_verbosity(0)
+
+        # TranslatedLayer round-trip through jit.save/load
+        lin = paddle.nn.Linear(4, 2)
+        xs = _t(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+        ref = lin(xs).numpy()
+        path = str(tmp_path / "m")
+        paddle.jit.save(lin, path, input_spec=[
+            paddle.static.InputSpec([None, 4], "float32")])
+        loaded = paddle.jit.load(path)
+        assert isinstance(loaded, jit.TranslatedLayer)
+        np.testing.assert_allclose(loaded(xs).numpy(), ref, rtol=1e-5)
