@@ -1,0 +1,136 @@
+"""Bare-``dot_general`` microbenchmark at the EXACT headline-step dot shapes.
+
+Purpose (r5): the r4 per-instruction profile says the backward dots run at
+81-92% of the bf16 roofline inside the full train step. This script times a
+bare ``jnp.dot`` at each of those exact (M, K, N) shapes in isolation,
+slope-timed on-device like ``flash_micro.py``, so we can distinguish
+
+  - *intrinsic*: the bare dot ALSO tops out at ~the in-step fraction ->
+    that fraction IS the chip's achievable rate for this shape and the
+    in-step rate is pinned, vs
+  - *scheduling/fusion gap*: the bare dot runs significantly faster ->
+    the step is leaving time on the table around that dot.
+
+Shapes (bench model = LlamaConfig.bert_base_equiv, b=44 s=512 ->
+M = 44*512 = 22528 tokens; lm_head sees Mv = 44*511 = 22484 after the
+next-token shift; H=768 F=3072 V=32000):
+
+  per layer (x12)             M       K       N
+    qkv/out proj fwd        22528     768     768
+    proj dW                   768   22528     768
+    mlp gate/up fwd         22528     768    3072
+    mlp down fwd            22528    3072     768
+    mlp dW (gate/up)          768   22528    3072
+    mlp dW (down)            3072   22528     768
+    mlp dx (of gate/up)     22528    3072     768   (same shape as down fwd)
+    mlp dx (of down)        22528     768    3072   (same shape as up fwd)
+  lm_head complex (x1)
+    head fwd                22484     768   32000
+    head dW                   768   22484   32000
+    head dx                 22484   32000     768
+
+Each shape is timed with the in-step output dtype: fwd dots emit bf16,
+dW dots emit fp32 (grads are fp32 by default), dx dots emit bf16. A second
+column re-times dW with bf16 output to expose how much of any deficit is
+the fp32 HBM write.
+
+Usage: python benchmarks/dot_micro.py [iters]
+Writes a per-shape achievable-fraction table to stdout (markdown) for
+ARCHITECTURE.md.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PEAK_TFS = 197e12  # v5e bf16
+
+
+def timeit(fn, args, iters):
+    """Slope-timed on-device loop (see flash_micro.timeit for rationale:
+    ~4 ms tunneled dispatch => per-call host timing is latency-bound, and
+    the additive near-zero carry keeps the body loop-variant without
+    getting algebraically hoisted)."""
+    def loop(c, a0, rest, n):
+        def body(carry, _):
+            out = fn(a0 + (carry - 1.0).astype(a0.dtype), *rest)
+            # consume EVERY output element: a single-element read lets XLA
+            # slice through the dot and DCE the rest of the matmul (observed:
+            # fp32-out dW shapes timed at "13,825 TF/s"). The full-reduce
+            # epilogue costs ~0.01 ms of HBM traffic — noise vs the dot.
+            s = jnp.sum(jax.tree.leaves(out)[0].astype(jnp.float32))
+            return 1.0 + 1e-24 * s, None
+        c, _ = jax.lax.scan(body, c, None, length=n)
+        return c
+    jloop = jax.jit(loop, static_argnums=(3,))
+    c = jnp.float32(1.0)
+    times = {}
+    for n in (iters, 2 * iters):
+        float(jloop(c, args[0], args[1:], n))
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(jloop(c, args[0], args[1:], n))
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        times[n] = best
+    return (times[2 * iters] - times[iters]) / iters
+
+
+def bench_shape(rng, M, K, N, out_dtype, iters):
+    a = jnp.asarray(rng.randn(M, K), jnp.bfloat16)
+    b = jnp.asarray(rng.randn(K, N), jnp.bfloat16)
+    f = jax.jit(lambda x, y: jax.lax.dot_general(
+        x, y, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(out_dtype))
+    per = timeit(f, (a, b), iters)
+    tfs = 2.0 * M * N * K / per
+    return per, tfs / PEAK_TFS
+
+
+def main():
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    M, H, F, V = 44 * 512, 768, 3072, 32000
+    Mv = 44 * 511
+    shapes = [
+        # tag, M, K, N, in-step output dtype, in-step measured fraction (r4)
+        ("proj fwd      ", M, H, H, jnp.bfloat16),
+        ("proj dW       ", H, M, H, jnp.float32),
+        ("mlp gate/up fwd", M, H, F, jnp.bfloat16),
+        ("mlp down fwd  ", M, F, H, jnp.bfloat16),
+        ("mlp dW gate/up", H, M, F, jnp.float32),
+        ("mlp dW down   ", F, M, H, jnp.float32),
+        ("mlp dx gate/up", M, F, H, jnp.bfloat16),
+        ("mlp dx down   ", M, H, F, jnp.bfloat16),
+        ("head fwd      ", Mv, H, V, jnp.bfloat16),
+        ("head dW       ", H, Mv, V, jnp.float32),
+        ("head dx       ", Mv, V, H, jnp.bfloat16),
+    ]
+    rng = np.random.RandomState(0)
+    print(f"devices: {jax.devices()}", flush=True)
+    print("| shape | M | K | N | out | ms | TF/s | frac of peak |")
+    print("|---|---|---|---|---|---|---|---|")
+    rows = []
+    for tag, m, k, n, dt in shapes:
+        per, frac = bench_shape(rng, m, k, n, dt, iters)
+        name = jnp.dtype(dt).name
+        print(f"| {tag.strip()} | {m} | {k} | {n} | {name} | "
+              f"{per*1e3:.3f} | {2.0*m*n*k/per/1e12:.1f} | {frac:.1%} |",
+              flush=True)
+        rows.append((tag, m, k, n, name, per, frac))
+        # for fp32-output dW shapes, also time the bf16-output variant to
+        # split "fp32 HBM write cost" out of any observed deficit
+        if dt == jnp.float32:
+            per2, frac2 = bench_shape(rng, m, k, n, jnp.bfloat16, iters)
+            print(f"| {tag.strip()} (bf16 out) | {m} | {k} | {n} | bfloat16 | "
+                  f"{per2*1e3:.3f} | {2.0*m*n*k/per2/1e12:.1f} | {frac2:.1%} |",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

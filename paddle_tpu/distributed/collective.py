@@ -466,6 +466,12 @@ def alltoall_single(in_tensor, out_tensor=None,
                 "splits")
     out = alltoall(in_tensor, None, group=g, sync_op=sync_op)
     out_val = _unwrap(out)
+    if isinstance(out_tensor, Tensor) and isinstance(out_val, jax.core.Tracer):
+        raise RuntimeError(
+            "alltoall_single: out_tensor cannot be filled inside a traced "
+            "(jit/shard_map) program — the buffer would silently keep stale "
+            "data. Use the RETURN value instead: "
+            "out = alltoall_single(x, None, ...)")
     if isinstance(out_tensor, Tensor) and \
             not isinstance(out_val, jax.core.Tracer):
         if tuple(out_val.shape) != tuple(out_tensor.shape):
@@ -487,7 +493,12 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     only ``dst`` fills ``gather_list``."""
     g = group or get_default_group()
     chunks: list = []
-    all_gather(chunks, tensor, group=g)
+    out = all_gather(chunks, tensor, group=g)
+    if isinstance(_unwrap(out), jax.core.Tracer):
+        # traced (shard_map/jit) context: per-rank python lists cannot be
+        # populated — hand back the concatenated gather like all_gather does
+        # so traced callers receive the data instead of an empty list
+        return out
     if gather_list is not None:
         r = g.get_group_rank(dst)
         r = r if r >= 0 else dst

@@ -456,16 +456,19 @@ def load(path, **configs):
     if os.path.exists(path + ".pdmeta"):
         with open(path + ".pdmeta", "rb") as f:
             meta = pickle.load(f)
-    if os.path.exists(path + ".pdmodel"):
-        from jax import export as jexport
+    # .stablehlo is the honesty-named artifact paddle.onnx.export writes
+    # (same serialized jax.export payload as .pdmodel)
+    for ext in (".pdmodel", ".stablehlo"):
+        if os.path.exists(path + ext):
+            from jax import export as jexport
 
-        with open(path + ".pdmodel", "rb") as f:
-            exported = jexport.deserialize(f.read())
+            with open(path + ext, "rb") as f:
+                exported = jexport.deserialize(f.read())
 
-        return TranslatedLayer(state, meta, exported)
+            return TranslatedLayer(state, meta, exported)
     raise InvalidArgumentError(
-        f"No exported program at {path}.pdmodel — only weights were saved "
-        f"(export_error: {meta.get('export_error')})"
+        f"No exported program at {path}.pdmodel or {path}.stablehlo — only "
+        f"weights were saved (export_error: {meta.get('export_error')})"
     )
 
 

@@ -1627,6 +1627,18 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
             lo = hi
         return out, -jnp.mean(out)
 
+    # eager range check (reference raises on labels outside [0, n_classes));
+    # without it an out-of-range label would silently score log-prob 0
+    lv = getattr(label, "_value", label)
+    if not isinstance(lv, jax.core.Tracer):
+        import numpy as _np
+
+        la = _np.asarray(lv)
+        if la.size and (int(la.min()) < 0 or int(la.max()) >= cutoffs[-1]):
+            raise ValueError(
+                "adaptive_log_softmax_with_loss: label values must be in "
+                f"[0, {cutoffs[-1]}), got range [{int(la.min())}, "
+                f"{int(la.max())}]")
     flat_tails = [w for pair in tail_weights for w in pair]
     args = [input, label, head_weight] + \
         ([head_bias] if head_bias is not None else []) + flat_tails

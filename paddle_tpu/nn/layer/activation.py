@@ -11,6 +11,7 @@ __all__ = [
     "Softmax", "LogSoftmax", "LeakyReLU", "ELU", "SELU", "CELU", "PReLU",
     "Hardtanh", "Hardshrink", "Hardsigmoid", "Hardswish", "Mish", "Softplus",
     "Softshrink", "Softsign", "Tanhshrink", "ThresholdedReLU", "GLU", "Maxout",
+    "Softmax2D",
 ]
 
 
@@ -94,6 +95,21 @@ class Softmax(Layer):
 
     def forward(self, x):
         return F.softmax(x, self._axis)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW/CHW inputs (reference
+    ``paddle.nn.Softmax2D``): softmax along axis -3."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D expects a 3D (CHW) or 4D (NCHW) input, got "
+                f"{x.ndim}D")
+        return F.softmax(x, axis=-3)
 
 
 class LogSoftmax(Layer):

@@ -14,7 +14,7 @@ from .common import Pad2D
 __all__ = ["PixelUnshuffle", "ChannelShuffle", "Fold", "Unflatten",
            "ZeroPad2D", "HuberLoss", "TripletMarginLoss",
            "PairwiseDistance", "CosineEmbeddingLoss", "CTCLoss", "RReLU",
-           "RNN"]
+           "RNN", "BiRNN"]
 
 
 class PixelUnshuffle(Layer):
@@ -183,3 +183,26 @@ class RNN(Layer):
         if self._reverse:
             outs = outs[::-1]
         return M.stack(outs, axis=t_axis), states
+
+
+class BiRNN(Layer):
+    """Bidirectional cell runner (reference ``paddle.nn.BiRNN``): steps
+    ``cell_fw`` forward and ``cell_bw`` backward over the time axis and
+    concatenates the per-step outputs on the last dim."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self._fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self._bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as M
+
+        st_fw = st_bw = None
+        if initial_states is not None:
+            st_fw, st_bw = initial_states
+        out_fw, fin_fw = self._fw(inputs, st_fw, sequence_length)
+        out_bw, fin_bw = self._bw(inputs, st_bw, sequence_length)
+        return M.concat([out_fw, out_bw], axis=-1), (fin_fw, fin_bw)

@@ -10,7 +10,7 @@ __all__ = [
     "BCEWithLogitsLoss", "SmoothL1Loss", "KLDivLoss", "MarginRankingLoss",
     "HingeEmbeddingLoss", "SoftMarginLoss", "MultiLabelSoftMarginLoss",
     "PoissonNLLLoss", "GaussianNLLLoss", "MultiMarginLoss",
-    "TripletMarginWithDistanceLoss",
+    "TripletMarginWithDistanceLoss", "AdaptiveLogSoftmaxWithLoss",
 ]
 
 
@@ -177,6 +177,84 @@ class MultiMarginLoss(Layer):
         p, margin, weight, reduction = self._args
         return F.multi_margin_loss(input, label, p, margin, weight,
                                    reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax layer (reference ``paddle.nn.AdaptiveLogSoftmaxWithLoss``
+    over the functional in ``nn/functional``): the head scores the
+    ``cutoffs[0]`` frequent classes plus one entry per tail cluster; each
+    tail cluster scores through an ``in_features / div_value**(i+1)``
+    low-rank projection. ``forward`` returns (per-sample log-prob of the
+    true class, mean nll)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, weight_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if (not cutoffs or sorted(set(cutoffs)) != cutoffs
+                or cutoffs[-1] > n_classes - 1 or min(cutoffs) <= 0):
+            raise ValueError(
+                "cutoffs must be a sorted list of unique positive ints "
+                f"< n_classes-1, got {cutoffs} for n_classes={n_classes}")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self._cutoffs = cutoffs + [n_classes]
+        self._div_value = div_value
+        shortlist = cutoffs[0]
+        n_clusters = len(cutoffs)
+        self.head_weight = self.create_parameter(
+            [in_features, shortlist + n_clusters], weight_attr)
+        self.head_bias = self.create_parameter(
+            [shortlist + n_clusters], bias_attr, is_bias=True) \
+            if head_bias else None
+        self.tail_weights = []
+        for i in range(n_clusters):
+            hsz = max(1, int(in_features // (div_value ** (i + 1))))
+            osz = self._cutoffs[i + 1] - self._cutoffs[i]
+            proj = self.create_parameter([in_features, hsz], weight_attr)
+            cls_w = self.create_parameter([hsz, osz], weight_attr)
+            self.add_parameter(f"tail_proj_{i}", proj)
+            self.add_parameter(f"tail_cls_{i}", cls_w)
+            self.tail_weights.append([proj, cls_w])
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self._cutoffs, head_bias=self.head_bias)
+
+    def log_prob(self, input):
+        """Full [N, n_classes] log-probabilities."""
+        import jax
+        import jax.numpy as jnp
+
+        from ...ops.dispatch import run_op
+
+        shortlist = self._cutoffs[0]
+        n_clusters = len(self._cutoffs) - 1
+
+        def f(x, hw, *rest):
+            off = 1 if self.head_bias is not None else 0
+            head = x @ hw + (rest[0] if off else 0.0)
+            head_logp = jax.nn.log_softmax(head, axis=-1)
+            parts = [head_logp[:, :shortlist]]
+            tails = rest[off:]
+            for ci in range(n_clusters):
+                proj, cls_w = tails[2 * ci], tails[2 * ci + 1]
+                tail_logp = jax.nn.log_softmax((x @ proj) @ cls_w, axis=-1)
+                parts.append(head_logp[:, shortlist + ci:shortlist + ci + 1]
+                             + tail_logp)
+            return jnp.concatenate(parts, axis=-1)
+
+        args = [input, self.head_weight] + \
+            ([self.head_bias] if self.head_bias is not None else []) + \
+            [w for pair in self.tail_weights for w in pair]
+        return run_op("adaptive_log_softmax_log_prob", f, *args)
+
+    def predict(self, input):
+        from ...ops import reduction as R
+
+        return R.argmax(self.log_prob(input), axis=-1)
 
 
 class TripletMarginWithDistanceLoss(Layer):

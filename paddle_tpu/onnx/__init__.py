@@ -17,10 +17,43 @@ __all__ = ["export"]
 
 
 def export(layer, path, input_spec=None, opset_version=None, **configs):
-    """Export ``layer`` for deployment. Writes ``{path}.pdmodel`` (StableHLO)
-    + ``{path}.pdiparams`` via ``paddle.jit.save`` and returns the prefix."""
+    """Export ``layer`` for deployment.
+
+    HONESTY NOTE: what is written is **StableHLO, not ONNX** (the onnx
+    package is not available in this environment; StableHLO is the XLA
+    ecosystem's interchange format). The program artifact is therefore
+    named ``{path}.stablehlo`` — never ``.onnx`` — plus ``{path}.pdiparams``
+    for the weights, and a ``UserWarning`` states the substitution. Mapping
+    vs the reference's paddle2onnx flow: ONNX graph -> StableHLO module
+    (via ``paddle.jit.save``'s ``jax.export``), ONNX initializers ->
+    ``.pdiparams``. Returns the ``.stablehlo`` path."""
+    import warnings
+
     from .. import jit
 
     prefix = path[:-5] if path.endswith(".onnx") else path
+    warnings.warn(
+        "paddle.onnx.export: true ONNX emission is unavailable in this "
+        "environment; exporting a StableHLO module instead (written to "
+        f"{prefix}.stablehlo). StableHLO is the XLA-world interchange "
+        "format; load it back with paddle.jit.load.", UserWarning,
+        stacklevel=2)
     jit.save(layer, prefix, input_spec=input_spec)
-    return prefix
+    out = prefix + ".stablehlo"
+    if not os.path.exists(prefix + ".pdmodel"):
+        # jit.save fell back to weights-only (program export failed) —
+        # fail HERE rather than hand back a path to a file that was
+        # never written
+        import pickle
+
+        err = None
+        if os.path.exists(prefix + ".pdmeta"):
+            with open(prefix + ".pdmeta", "rb") as f:
+                err = pickle.load(f).get("export_error")
+        raise RuntimeError(
+            "paddle.onnx.export: program export failed — only weights were "
+            f"saved to {prefix}.pdiparams (export_error: {err}). The layer "
+            "must be traceable (static shapes, no data-dependent python "
+            "control flow) to emit a StableHLO module.")
+    os.replace(prefix + ".pdmodel", out)
+    return out

@@ -21,7 +21,7 @@ __all__ = [
     "triangular_solve", "cholesky_solve", "lstsq", "lu", "lu_unpack",
     "cond", "cov",
     "corrcoef", "householder_product", "multi_dot", "norm",
-    "svd_lowrank", "pca_lowrank",
+    "svd_lowrank", "pca_lowrank", "ormqr", "vector_norm", "matrix_norm",
 ]
 
 
@@ -288,6 +288,84 @@ def householder_product(x, tau, name=None):
         return q[..., :, :n]
 
     return run_op("householder_product", f, x, tau)
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply ``y`` by the orthogonal Q encoded as Householder
+    reflectors ``(x, tau)`` from a QR factorisation (reference
+    ``paddle.linalg.ormqr`` over cuSOLVER ormqr). Q = H_1 ... H_k with
+    H_i = I - tau_i v_i v_i^T; the product is formed by applying the k
+    reflectors to ``y`` directly (no m x m Q materialisation), a static
+    python loop XLA unrolls into k rank-1 updates."""
+    def f(a, t, other):
+        m, k = a.shape[-2], a.shape[-1]
+        vs = []
+        for i in range(k):
+            v = jnp.where(jnp.arange(m) < i, 0.0,
+                          a[..., :, i].at[..., i].set(1.0))
+            vs.append(v[..., :, None])  # [.., m, 1]
+        # Q @ z applies H_1(H_2(...H_k z)); Q^T @ z applies in reverse
+        def apply_q(z, trans):
+            order = range(k - 1, -1, -1) if not trans else range(k)
+            for i in order:
+                v = vs[i]
+                z = z - t[..., i][..., None, None] * (
+                    v @ (jnp.swapaxes(v, -1, -2) @ z))
+            return z
+
+        if left:
+            return apply_q(other, transpose)
+        # right: y @ Q == (Q^T y^T)^T
+        zt = jnp.swapaxes(other, -1, -2)
+        return jnp.swapaxes(apply_q(zt, not transpose), -1, -2)
+
+    return run_op("ormqr", f, x, tau, y)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """Vector p-norm over ``axis`` (reference ``paddle.linalg.vector_norm``;
+    axis=None reduces over ALL elements, unlike ``norm``'s fro default)."""
+    def f(a):
+        ax = tuple(range(a.ndim)) if axis is None else (
+            tuple(axis) if isinstance(axis, (list, tuple)) else (axis,))
+        ab = jnp.abs(a)
+        if p == float("inf"):
+            return jnp.max(ab, axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(ab, axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(ab ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return run_op("vector_norm", f, x)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """Matrix norm over the two ``axis`` dims (reference
+    ``paddle.linalg.matrix_norm``): 'fro', 'nuc', +-1, +-2, +-inf."""
+    def f(a):
+        r, c = [ax % a.ndim for ax in axis]
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(a * a, axis=(r, c), keepdims=keepdim))
+        if p in (1, -1, float("inf"), float("-inf")):
+            # +-1: max/min column abs-sum; +-inf: max/min row abs-sum
+            sum_ax, pick_ax = (r, c) if p in (1, -1) else (c, r)
+            red = jnp.max if p in (1, float("inf")) else jnp.min
+            s = jnp.sum(jnp.abs(a), axis=sum_ax, keepdims=True)
+            out = red(s, axis=pick_ax, keepdims=True)
+            return out if keepdim else jnp.squeeze(out, (r, c))
+        if p in (2, -2, "nuc"):
+            m = jnp.moveaxis(a, (r, c), (-2, -1))
+            sv = jnp.linalg.svd(m, compute_uv=False)
+            red = {"nuc": jnp.sum, 2: jnp.max, -2: jnp.min}[p]
+            out = red(sv, axis=-1)  # batch dims keep original order
+            if keepdim:
+                for ax in sorted((r, c)):
+                    out = jnp.expand_dims(out, ax)
+            return out
+        raise ValueError(f"matrix_norm: unsupported p={p!r}")
+
+    return run_op("matrix_norm", f, x)
 
 
 def multi_dot(tensors, name=None):

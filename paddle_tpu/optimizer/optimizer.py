@@ -259,7 +259,14 @@ class Optimizer:
         static_evals = [self._per_param_extras(p) for p, _ in pgs]
         # read by the jitted update AT TRACE TIME (a structure change in
         # the param pytree retraces, picking up the current list — a
-        # closure captured at build time would go stale)
+        # closure captured at build time would go stale). A VALUE change
+        # with the same pytree structure would NOT retrace, so the evals
+        # repr is part of the cache key: any change drops the cached jit
+        # (the stale grouping would silently mis-update fused groups).
+        evals_key = repr(static_evals)
+        if getattr(self, "_static_evals_key", None) != evals_key:
+            self._jit_update = None
+            self._static_evals_key = evals_key
         self._static_evals = static_evals
         if self._jit_update is None:
             l2 = self._l2_coeff

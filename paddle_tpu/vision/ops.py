@@ -296,6 +296,11 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
 
     def f(xv, img):
         N, _, H, W = xv.shape
+        if iou_aware:
+            # reference layout: [N, A + A*(5+C), H, W] — the first A
+            # channels are per-anchor IoU logits, then the standard block
+            iou = jax.nn.sigmoid(xv[:, :A].reshape(N, A, H, W))
+            xv = xv[:, A:]
         v = xv.reshape(N, A, 5 + class_num, H, W)
         gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
         gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
@@ -307,6 +312,10 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
         bw = jnp.exp(v[:, :, 2]) * aw / (W * downsample_ratio)
         bh = jnp.exp(v[:, :, 3]) * ah / (H * downsample_ratio)
         conf = sig(v[:, :, 4])
+        if iou_aware:
+            # PP-YOLO rescore: conf^(1-f) * iou^f
+            f_ = jnp.float32(iou_aware_factor)
+            conf = jnp.power(conf, 1.0 - f_) * jnp.power(iou, f_)
         cls = sig(v[:, :, 5:]) * conf[:, :, None]
         ih = img[:, 0].astype(jnp.float32)[:, None, None, None]
         iw = img[:, 1].astype(jnp.float32)[:, None, None, None]

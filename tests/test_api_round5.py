@@ -1,0 +1,330 @@
+"""Round-5 API residue closure + r4 advisor-finding regression tests.
+
+Covers the judge's r4 probe residue (linalg.ormqr / matrix_norm /
+vector_norm, nn.BiRNN / Softmax2D / AdaptiveLogSoftmaxWithLoss) with
+numpy references, and locks in the r4 advisor fixes (yolo_box iou_aware,
+gather-under-trace, alltoall_single out_tensor guard, optimizer
+static-evals retrace, adaptive-softmax label range check).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestLinalgResidue:
+    def _householder_q(self, a, tau):
+        """Independent numpy reconstruction of Q from geqrf output."""
+        m, k = a.shape
+        q = np.eye(m, dtype=np.float64)
+        for i in range(k):
+            v = a[:, i].astype(np.float64).copy()
+            v[:i] = 0.0
+            v[i] = 1.0
+            h = np.eye(m) - tau[i] * np.outer(v, v)
+            q = q @ h
+        return q
+
+    def _geqrf(self, A):
+        import scipy.linalg as sl
+
+        (a, tau), _ = sl.qr(A.astype(np.float64), mode="raw")
+        return np.asarray(a, np.float32), np.asarray(tau, np.float32)
+
+    def test_ormqr_left(self):
+        rng = np.random.RandomState(0)
+        A = rng.randn(6, 4).astype(np.float32)
+        a, tau = self._geqrf(A)
+        q = self._householder_q(a, tau)
+        y = rng.randn(6, 3).astype(np.float32)
+        got = paddle.linalg.ormqr(_t(a), _t(tau), _t(y)).numpy()
+        np.testing.assert_allclose(got, q @ y, rtol=1e-4, atol=1e-5)
+        got_t = paddle.linalg.ormqr(_t(a), _t(tau), _t(y),
+                                    transpose=True).numpy()
+        np.testing.assert_allclose(got_t, q.T @ y, rtol=1e-4, atol=1e-5)
+
+    def test_ormqr_right(self):
+        rng = np.random.RandomState(1)
+        A = rng.randn(5, 3).astype(np.float32)
+        a, tau = self._geqrf(A)
+        q = self._householder_q(a, tau)
+        y = rng.randn(2, 5).astype(np.float32)
+        got = paddle.linalg.ormqr(_t(a), _t(tau), _t(y), left=False).numpy()
+        np.testing.assert_allclose(got, y @ q, rtol=1e-4, atol=1e-5)
+        got_t = paddle.linalg.ormqr(_t(a), _t(tau), _t(y), left=False,
+                                    transpose=True).numpy()
+        np.testing.assert_allclose(got_t, y @ q.T, rtol=1e-4, atol=1e-5)
+
+    def test_ormqr_reconstructs_qr(self):
+        # Q @ R == A: apply ormqr to the R factor from geqrf
+        rng = np.random.RandomState(2)
+        A = rng.randn(5, 5).astype(np.float32)
+        a, tau = self._geqrf(A)
+        r = np.triu(a)
+        got = paddle.linalg.ormqr(_t(a), _t(tau), _t(r)).numpy()
+        np.testing.assert_allclose(got, A, rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("p", [2.0, 1.0, 3.0, 0,
+                                   float("inf"), float("-inf")])
+    def test_vector_norm(self, p):
+        rng = np.random.RandomState(3)
+        x = rng.randn(4, 5).astype(np.float32)
+        x[0, 0] = 0.0
+        got = paddle.linalg.vector_norm(_t(x), p=p).numpy()
+        if p == 0:
+            ref = np.count_nonzero(x)
+        else:
+            ref = np.linalg.norm(x.ravel(), ord=p)
+        np.testing.assert_allclose(got, np.float32(ref), rtol=1e-5)
+        got_ax = paddle.linalg.vector_norm(_t(x), p=p, axis=1,
+                                           keepdim=True).numpy()
+        if p == 0:
+            ref_ax = (x != 0).sum(1, keepdims=True).astype(np.float32)
+        else:
+            ref_ax = np.linalg.norm(x, ord=p, axis=1, keepdims=True)
+        np.testing.assert_allclose(got_ax, ref_ax, rtol=1e-5)
+
+    @pytest.mark.parametrize("p", ["fro", "nuc", 1, -1, 2, -2,
+                                   float("inf"), float("-inf")])
+    def test_matrix_norm(self, p):
+        rng = np.random.RandomState(4)
+        x = rng.randn(3, 4, 5).astype(np.float32)
+        got = paddle.linalg.matrix_norm(_t(x), p=p).numpy()
+        ref = np.stack([np.linalg.norm(x[i], ord=p) for i in range(3)])
+        np.testing.assert_allclose(got, ref.astype(np.float32),
+                                   rtol=2e-4, atol=1e-5)
+        got_kd = paddle.linalg.matrix_norm(_t(x), p=p, keepdim=True)
+        assert tuple(got_kd.shape) == (3, 1, 1)
+
+    def test_matrix_norm_2d(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(4, 6).astype(np.float32)
+        for p in ("fro", "nuc", 1, float("inf")):
+            got = paddle.linalg.matrix_norm(_t(x), p=p).numpy()
+            np.testing.assert_allclose(got, np.linalg.norm(x, ord=p),
+                                       rtol=2e-4)
+
+
+class TestNnResidue:
+    def test_softmax2d(self):
+        rng = np.random.RandomState(6)
+        x = rng.randn(2, 3, 4, 5).astype(np.float32)
+        m = nn.Softmax2D()
+        got = m(_t(x)).numpy()
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        np.testing.assert_allclose(got, e / e.sum(axis=1, keepdims=True),
+                                   rtol=1e-5)
+        assert got.sum(axis=1).max() == pytest.approx(1.0, rel=1e-5)
+        with pytest.raises(ValueError):
+            m(_t(np.zeros((2, 3), np.float32)))
+
+    def test_birnn_matches_manual(self):
+        rng = np.random.RandomState(7)
+        paddle.seed(7)
+        cf = nn.SimpleRNNCell(4, 3)
+        cb = nn.SimpleRNNCell(4, 3)
+        bi = nn.BiRNN(cf, cb)
+        x = rng.randn(2, 5, 4).astype(np.float32)
+        out, (hf, hb) = bi(_t(x))
+        assert tuple(out.shape) == (2, 5, 6)
+
+        # independent numpy reference
+        def cell_np(c):
+            wi = c.weight_ih.numpy()
+            wh = c.weight_hh.numpy()
+            bi_ = c.bias_ih.numpy()
+            bh = c.bias_hh.numpy()
+            return lambda xt, h: np.tanh(xt @ wi.T + bi_ + h @ wh.T + bh)
+
+        f_fw, f_bw = cell_np(cf), cell_np(cb)
+        h = np.zeros((2, 3), np.float32)
+        fw = []
+        for t in range(5):
+            h = f_fw(x[:, t], h)
+            fw.append(h)
+        h = np.zeros((2, 3), np.float32)
+        bw = []
+        for t in range(4, -1, -1):
+            h = f_bw(x[:, t], h)
+            bw.append(h)
+        bw = bw[::-1]
+        ref = np.concatenate([np.stack(fw, 1), np.stack(bw, 1)], axis=-1)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(hf.numpy(), fw[-1], rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(hb.numpy(), bw[0], rtol=1e-4, atol=1e-5)
+
+    def test_adaptive_log_softmax_layer(self):
+        rng = np.random.RandomState(8)
+        paddle.seed(8)
+        m = nn.AdaptiveLogSoftmaxWithLoss(8, 12, [4, 8], div_value=2.0)
+        x = rng.randn(6, 8).astype(np.float32)
+        y = np.array([0, 3, 5, 7, 9, 11], np.int64)
+        out, loss = m(_t(x), _t(y))
+        assert tuple(out.shape) == (6,)
+        # loss == -mean(out), and out agrees with the full log_prob matrix
+        np.testing.assert_allclose(loss.numpy(), -out.numpy().mean(),
+                                   rtol=1e-5)
+        lp = m.log_prob(_t(x)).numpy()
+        assert lp.shape == (6, 12)
+        # rows are valid log-distributions
+        np.testing.assert_allclose(np.exp(lp).sum(-1), np.ones(6), rtol=1e-4)
+        np.testing.assert_allclose(out.numpy(), lp[np.arange(6), y],
+                                   rtol=1e-4, atol=1e-5)
+        pred = m.predict(_t(x)).numpy()
+        np.testing.assert_array_equal(pred, lp.argmax(-1))
+
+    def test_adaptive_log_softmax_label_range(self):
+        m = nn.AdaptiveLogSoftmaxWithLoss(4, 6, [2], div_value=2.0)
+        x = np.zeros((2, 4), np.float32)
+        with pytest.raises(ValueError):
+            m(_t(x), _t(np.array([0, 6], np.int64)))
+        with pytest.raises(ValueError):
+            m(_t(x), _t(np.array([-1, 0], np.int64)))
+
+    def test_adaptive_log_softmax_bad_cutoffs(self):
+        with pytest.raises(ValueError):
+            nn.AdaptiveLogSoftmaxWithLoss(4, 6, [2, 2])
+        with pytest.raises(ValueError):
+            nn.AdaptiveLogSoftmaxWithLoss(4, 6, [5, 2])
+
+
+class TestAdvisorFixes:
+    def test_yolo_box_iou_aware(self):
+        # A=1 anchor, C=2 classes, 1x1 grid: layout [N, A + A*(5+C), H, W]
+        from paddle_tpu.vision.ops import yolo_box
+
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        iou_logit, factor = 1.2, 0.5
+        x = np.zeros((1, 8, 1, 1), np.float32)
+        x[0, 0] = iou_logit          # iou channel
+        x[0, 5] = 2.0                # conf logit
+        x[0, 6] = 0.7                # class-0 logit
+        img = np.array([[64, 64]], np.int32)
+        boxes, scores = yolo_box(_t(x), _t(img), [(10, 10)], 2,
+                                 conf_thresh=0.01, iou_aware=True,
+                                 iou_aware_factor=factor)
+        conf = sig(2.0) ** (1 - factor) * sig(iou_logit) ** factor
+        np.testing.assert_allclose(scores.numpy()[0, 0, 0],
+                                   sig(0.7) * conf, rtol=1e-5)
+        # parity: same tensor without the iou channel, iou_aware=False,
+        # must produce the plain-conf score
+        b2, s2 = yolo_box(_t(x[:, 1:]), _t(img), [(10, 10)], 2,
+                          conf_thresh=0.01, iou_aware=False)
+        np.testing.assert_allclose(s2.numpy()[0, 0, 0],
+                                   sig(0.7) * sig(2.0), rtol=1e-5)
+        np.testing.assert_allclose(boxes.numpy(), b2.numpy(), rtol=1e-5)
+
+    def test_gather_under_trace_returns_value(self):
+        import jax
+        import jax.numpy as jnp
+
+        import paddle_tpu.distributed as dist
+        from jax import shard_map
+        from jax.sharding import Mesh
+        from jax.sharding import PartitionSpec as P
+
+        dist.init_parallel_env()
+        g = dist.get_default_group()
+        devs = np.array(jax.devices()[:4])
+        mesh = Mesh(devs, (g.axis_name,))
+
+        def f(x):
+            out = dist.gather(x, gather_list=[], dst=0)
+            # traced context: gather must hand back the gathered VALUE
+            # (an empty python list would silently drop the data)
+            val = getattr(out, "_value", out)
+            assert not isinstance(val, list)
+            return val
+
+        x = jnp.arange(8.0).reshape(4, 2)
+        res = shard_map(f, mesh=mesh, in_specs=P(g.axis_name),
+                        out_specs=P(), check_vma=False)(x)
+        np.testing.assert_allclose(np.asarray(res), x)
+
+    def test_alltoall_single_out_tensor_raises_under_trace(self):
+        import jax
+        import jax.numpy as jnp
+
+        import paddle_tpu.distributed as dist
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        dist.init_parallel_env()
+        g = dist.get_default_group()
+        devs = np.array(jax.devices()[:4])
+        mesh = Mesh(devs, (g.axis_name,))
+
+        def f(x):
+            buf = paddle.zeros([4, 2])
+            with pytest.raises(RuntimeError, match="out_tensor"):
+                dist.alltoall_single(x, buf)
+            out = dist.alltoall_single(x, None)
+            return getattr(out, "_value", out)
+
+        x = jnp.arange(32.0).reshape(16, 2)
+        res = shard_map(f, mesh=mesh, in_specs=P(g.axis_name),
+                        out_specs=P(g.axis_name))(x)
+        assert np.asarray(res).shape == (16, 2)
+
+    def test_optimizer_retraces_on_static_eval_change(self):
+        # two same-shape params fuse into one multi-tensor update group
+        # keyed (at trace time) by their per-param extras; changing an
+        # extra's VALUE with identical pytree structure must retrace — a
+        # stale cached grouping would apply param-1's decay to param-2.
+        paddle.seed(0)
+        l1 = nn.Linear(4, 4, bias_attr=False)
+        l2 = nn.Linear(4, 4, bias_attr=False)
+        w1, w2 = l1.weight, l2.weight
+        nodecay: set = set()
+        opt = paddle.optimizer.AdamW(
+            learning_rate=0.1, parameters=[w1, w2], weight_decay=0.5,
+            apply_decay_param_fun=lambda n: n not in nodecay)
+
+        def step():
+            # zero gradients: the adam term vanishes, isolating the decay
+            loss = (w1.sum() + w2.sum()) * 0.0
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+
+        before = w2.numpy().copy()
+        step()
+        decayed_once = w2.numpy()
+        assert not np.allclose(before, decayed_once)  # decay applied
+        # flip w2's decay off: same extras STRUCTURE, different value
+        nodecay.add(w2.name)
+        step()
+        np.testing.assert_allclose(w2.numpy(), decayed_once)  # no decay now
+        decayed_w1 = w1.numpy().copy()
+        step()
+        assert not np.allclose(w1.numpy(), decayed_w1)  # w1 still decays
+
+
+class TestOnnxHonesty:
+    def test_onnx_export_names_stablehlo(self, tmp_path):
+        import warnings
+
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        path = str(tmp_path / "model")
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = paddle.onnx.export(
+                m, path, input_spec=[paddle.static.InputSpec([1, 4],
+                                                             "float32")])
+        assert any("ONNX" in str(x.message) for x in w)
+        import os
+
+        assert out.endswith(".stablehlo")
+        assert os.path.exists(out) or os.path.isdir(out) or \
+            any(p.startswith("model") for p in os.listdir(tmp_path))

@@ -151,9 +151,18 @@ def test_onnx_export_and_hub(tmp_path):
     from paddle_tpu.static import InputSpec
 
     net = nn.Linear(4, 2)
-    prefix = paddle.onnx.export(net, str(tmp_path / "m.onnx"),
-                                input_spec=[InputSpec([1, 4], "float32")])
+    out = paddle.onnx.export(net, str(tmp_path / "m.onnx"),
+                             input_spec=[InputSpec([1, 4], "float32")])
+    # honesty contract (r4 verdict): the artifact is StableHLO and is
+    # NAMED .stablehlo — nothing pretends to be ONNX
+    assert out.endswith(".stablehlo") and os.path.exists(out)
+    prefix = out[:-len(".stablehlo")]
     assert os.path.exists(prefix + ".pdiparams")
+    # round-trips through jit.load's .stablehlo fallback
+    loaded = paddle.jit.load(prefix)
+    x = paddle.ones([1, 4])
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                               rtol=1e-5)
 
     (tmp_path / "hubconf.py").write_text(
         "def tiny(n=4):\n"
