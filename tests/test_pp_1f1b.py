@@ -323,6 +323,103 @@ class Test1F1BParity:
         finally:
             set_mesh(None)
 
+    def test_llama_pipe_parity_4axis_16dev(self):
+        """The FULL 4-axis hybrid (VERDICT r4 item 7): dp2 x pp2 x mp2 x
+        sharding2 — compiled 1F1B with manual TP, in-program ZeRO (entry
+        all-gather / exit reduce-scatter over 'sharding') AND dp
+        grad-averaging, in ONE program on a 16-device mesh. The suite's
+        conftest pins 8 virtual devices, so this runs in a subprocess
+        with 16 (same recipe, SURVEY §7.3.5); parity covers loss and
+        every parameter gradient, and the HLO must carry all three
+        collective families (all-gather, reduce-scatter,
+        collective-permute)."""
+        import os
+        import subprocess
+        import sys
+        import textwrap
+
+        code = textwrap.dedent("""
+            import numpy as np
+            import jax
+            import paddle_tpu as paddle
+            from paddle_tpu.distributed.fleet import DistributedStrategy
+            from paddle_tpu.distributed.fleet.meta_parallel import (
+                PipelineParallel,
+            )
+            from paddle_tpu.models.llama import LlamaConfig
+            from paddle_tpu.models.llama_pipe import build_llama_pipe
+            from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
+
+            mesh = create_hybrid_mesh(dp=2, pp=2, mp=2, sharding=2)
+            paddle.seed(0)
+            cfg = LlamaConfig.tiny(num_layers=4)
+            pl = build_llama_pipe(cfg, num_stages=2)
+            strategy = DistributedStrategy()
+            strategy.pipeline_configs = {"accumulate_steps": 4}
+            pp = PipelineParallel(pl, None, strategy)
+            rng = np.random.RandomState(0)
+            x = paddle.to_tensor(
+                rng.randint(0, cfg.vocab_size, (16, 16)).astype("int64"))
+            y = paddle.to_tensor(
+                rng.randint(0, cfg.vocab_size, (16, 16)).astype("int64"))
+
+            loss_ref = pp.train_batch((x, y))
+            g_ref = [None if p.grad is None
+                     else np.asarray(p.grad.numpy()).copy()
+                     for p in pl.parameters() if not p.stop_gradient]
+            for p in pl.parameters():
+                p.clear_grad()
+            loss_1f1b = pp.train_batch((x, y), schedule="1f1b")
+            g_new = [None if p.grad is None
+                     else np.asarray(p.grad.numpy()).copy()
+                     for p in pl.parameters() if not p.stop_gradient]
+
+            np.testing.assert_allclose(loss_1f1b.numpy(), loss_ref.numpy(),
+                                       rtol=2e-5, atol=1e-6)
+            assert len(g_ref) == len(g_new) and len(g_ref) > 10
+            for a, b in zip(g_ref, g_new):
+                assert (a is None) == (b is None)
+                if a is not None:
+                    np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
+
+            # the one compiled program must carry the ZeRO pair AND the
+            # pp ring on top of the dp/mp reductions
+            eng = pp._1f1b_engine
+            fn = next(iter(eng._cache.values()))
+            pvals = [p._value for p in eng._params]
+            bvals = [b._value for b in eng._buffers]
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            rep = NamedSharding(mesh, P())
+            kd = jax.device_put(
+                jax.random.key_data(jax.random.PRNGKey(0)), rep)
+            hlo = fn.lower(pvals, bvals,
+                           jax.device_put(x._value, rep),
+                           jax.device_put(y._value, rep),
+                           kd).compile().as_text()
+            assert "all-gather" in hlo
+            assert "reduce-scatter" in hlo
+            assert "collective-permute" in hlo
+
+            qw = pl.run_functions[1].wq.weight
+            # strict: the grad must be at REST in the ZeRO shard layout
+            # (the 'mp' placement alone comes from TP and would mask a
+            # dropped reduce-scatter exit)
+            assert "sharding" in str(qw.grad._value.sharding.spec)
+            set_mesh(None)
+            print("4AXIS-PARITY-OK", float(loss_1f1b.numpy()))
+        """)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+        proc = subprocess.run([sys.executable, "-c", code],
+                              cwd="/root/repo", env=env, timeout=900,
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        assert "4AXIS-PARITY-OK" in proc.stdout
+
     def test_gspmd_layer_in_chunk_raises_at_trace(self):
         """The manual-TP footgun guard (VERDICT r3 item 3): a layer that
         stages a GSPMD sharding constraint inside a 1F1B stage chunk must
