@@ -1,0 +1,68 @@
+"""Per-instruction profile of the DECODE tick (the generate() scan body) —
+where does the gap between the measured ms/token and the HBM roofline go?
+
+Usage: python benchmarks/decode_profile.py [batch] [top_n]
+"""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    prompt_len, new_tokens = 64, 128
+    from paddle_tpu.models import llama
+    from paddle_tpu.parallel import set_mesh
+
+    set_mesh(None)
+    cfg = llama.LlamaConfig.bert_base_equiv(max_seq_len=512)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    prompt = jnp.array(rng.randint(0, cfg.vocab_size, (batch, prompt_len)),
+                       jnp.int32)
+    max_len = prompt_len + new_tokens
+    np.asarray(llama.generate(params, prompt, cfg,
+                              max_new_tokens=new_tokens, max_len=max_len))
+
+    tmp = tempfile.mkdtemp(prefix="xplane_dec_")
+    with jax.profiler.trace(tmp):
+        np.asarray(llama.generate(params, prompt, cfg,
+                                  max_new_tokens=new_tokens,
+                                  max_len=max_len))
+
+    from paddle_tpu.profiler import _xplane
+    path = _xplane.latest_xplane(tmp)
+    from jax.profiler import ProfileData
+    pd = ProfileData.from_file(path)
+    agg = {}
+    total = 0.0
+    for plane in pd.planes:
+        if not plane.name.startswith("/device:"):
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                name = ev.name.split(" ", 1)[0]
+                a = agg.setdefault(name, [0, 0.0])
+                a[0] += 1
+                a[1] += ev.duration_ns
+                total += ev.duration_ns
+    ticks = new_tokens - 1
+    print(f"batch {batch}: {len(agg)} instrs, {total/1e6:.1f} ms device "
+          f"total, {total/1e6/ticks:.3f} ms/tick over {ticks} ticks")
+    print(f"{'instr':<58} {'calls':>6} {'us/tick':>8} {'share':>6}")
+    for name, (c, ns) in sorted(agg.items(), key=lambda kv: -kv[1][1])[:top_n]:
+        print(f"{name[:58]:<58} {c:>6} {ns/1e3/ticks:>8.2f} "
+              f"{ns/total:>6.1%}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+"""Alternate formulations of the WORST bare-dot shape (lm_head dx:
+[Mv,V]x[V,H] at ~72-76% of peak) — can any beat XLA's default emitter?
+
+Variants:
+  base      dx = do[Mv,V] @ W[V,H]            (the in-step formulation)
+  padM      Mv padded 22484 -> 22528 (8-aligned rows)
+  transT    dx^T = W^T[H,V] @ do^T[V,Mv]      (different MXU mapping)
+  ksplit2/4 K=32000 contracted in 2/4 chunks, summed (pipelining probe)
+  pallas    hand-written Mosaic kernel: grid (M/bm, H/bn), K-loop in-kernel
+            accumulating f32 in VMEM
+
+Also re-times the head dW fp32-out shape with a split emit (bf16 dot +
+separate convert) to price the fp32-emission tax seen in dot_micro.
+
+Usage: python benchmarks/dot_variants.py [iters]
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PEAK = 197e12
+
+
+def timeit(fn, args, iters, reps=5):
+    def loop(c, a0, rest, n):
+        def body(carry, _):
+            out = fn(a0 + (carry - 1.0).astype(a0.dtype), *rest)
+            s = jnp.sum(jax.tree.leaves(out)[0].astype(jnp.float32))
+            return 1.0 + 1e-24 * s, None
+        c, _ = jax.lax.scan(body, c, None, length=n)
+        return c
+    jloop = jax.jit(loop, static_argnums=(3,))
+    c = jnp.float32(1.0)
+    times = {}
+    for n in (iters, 2 * iters):
+        float(jloop(c, args[0], args[1:], n))
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(jloop(c, args[0], args[1:], n))
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        times[n] = best
+    return (times[2 * iters] - times[iters]) / iters
+
+
+def report(tag, per, flops):
+    tfs = flops / per
+    print(f"{tag:<28} {per*1e3:8.3f} ms  {tfs/1e12:6.1f} TF/s  "
+          f"{tfs/PEAK:6.1%} of peak", flush=True)
+
+
+def pallas_matmul(a, b, bm=512, bn=768, bk=2048):
+    """Plain blocked matmul a[M,K]@b[K,N] -> bf16, f32 VMEM accumulator,
+    K as the innermost (sequential) grid dim so the accumulator lives
+    across K steps (Mosaic revisiting pattern)."""
+    from jax.experimental import pallas as pl
+
+    M, K = a.shape
+    K2, N = b.shape
+    assert K == K2
+
+    def kernel(a_ref, b_ref, o_ref, acc_ref):
+        k = pl.program_id(2)
+
+        @pl.when(k == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        acc_ref[...] += jnp.dot(a_ref[...], b_ref[...],
+                                preferred_element_type=jnp.float32)
+
+        @pl.when(k == pl.num_programs(2) - 1)
+        def _():
+            o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+    grid = (M // bm, N // bn, K // bk)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+                  pl.BlockSpec((bk, bn), lambda i, j, k: (k, j))],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), jnp.bfloat16),
+        scratch_shapes=[pl.MemorySpace.VMEM(
+            jax.ShapeDtypeStruct((bm, bn), jnp.float32))],
+    )(a, b)
+
+
+def main():
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    Mv, V, H = 44 * 511, 32000, 768
+    Mp = 44 * 512
+    rng = np.random.RandomState(0)
+    do = jnp.asarray(rng.randn(Mv, V), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(V, H), jnp.bfloat16)
+    flops = 2.0 * Mv * V * H
+    print(f"devices: {jax.devices()}  head dx shape [{Mv},{V}]x[{V},{H}]",
+          flush=True)
+
+    base = jax.jit(lambda x, y: x @ y)
+    report("base", timeit(base, (do, w), iters), flops)
+
+    dop = jnp.asarray(rng.randn(Mp, V), jnp.bfloat16)
+    report("padM (22528 rows)", timeit(base, (dop, w), iters),
+           2.0 * Mp * V * H)
+
+    transT = jax.jit(lambda x, y: (y.T @ x.T))
+    report("transT (W^T do^T)", timeit(transT, (do, w), iters), flops)
+
+    def ksplit(x, y, n):
+        parts = jnp.split(x, n, axis=1)
+        wparts = jnp.split(y, n, axis=0)
+        acc = parts[0] @ wparts[0]
+        for p_, w_ in zip(parts[1:], wparts[1:]):
+            acc = acc + p_ @ w_
+        return acc
+    for n in (2, 4):
+        f = jax.jit(functools.partial(lambda x, y, n=n: ksplit(x, y, n)))
+        report(f"ksplit{n}", timeit(f, (do, w), iters), flops)
+
+    # pallas hand-kernel sweep over block shapes (Mv is not bm-divisible:
+    # use the padded M — the extra 44 rows are 0.2% flops)
+    for bm, bn, bk in ((512, 768, 2000), (1024, 768, 1000),
+                      (2048, 768, 500), (704, 768, 2000)):
+        if Mp % bm or V % bk or H % bn:
+            print(f"pallas bm{bm} bn{bn} bk{bk}: skip (not divisible)")
+            continue
+        try:
+            f = jax.jit(functools.partial(pallas_matmul, bm=bm, bn=bn, bk=bk))
+            ref = np.asarray(base(dop[:2048], w[:, :]) if False else 0)
+            got = f(dop, w)
+            exp = base(dop, w)
+            err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                        - exp.astype(jnp.float32))))
+            report(f"pallas bm{bm} bn{bn} bk{bk}",
+                   timeit(f, (dop, w), iters), 2.0 * Mp * V * H)
+            print(f"    max|err| vs XLA = {err:.3f}", flush=True)
+        except Exception as e:
+            print(f"pallas bm{bm} bn{bn} bk{bk}: FAILED {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
+    # price the head-dW fp32-emission tax: fused fp32-out dot vs bf16 dot
+    # + separate convert (the optimizer reads f32 master grads either way)
+    a = jnp.asarray(rng.randn(H, Mv), jnp.bfloat16)
+    b = jnp.asarray(rng.randn(Mv, V), jnp.bfloat16)
+    fl = 2.0 * H * Mv * V
+    f32out = jax.jit(lambda x, y: jax.lax.dot_general(
+        x, y, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32))
+    report("head dW f32-out", timeit(f32out, (a, b), iters), fl)
+    split = jax.jit(lambda x, y: (x @ y).astype(jnp.float32))
+    report("head dW bf16-out + convert", timeit(split, (a, b), iters), fl)
+
+
+if __name__ == "__main__":
+    main()

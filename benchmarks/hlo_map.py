@@ -1,0 +1,93 @@
+"""Map hot HLO instruction names from step_profile.py to their fused
+computations: for each requested %name, print its definition line and the
+dots (with shapes) inside its called computation — so "fusion.7 = 7.3 ms"
+becomes "dW lm_head: f32[768,32000] = dot(bf16[22484,768]^T, ...)".
+
+Usage: python benchmarks/hlo_map.py fusion.7 fusion.67 fusion.1174 ...
+"""
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    names = sys.argv[1:] or ["fusion.7", "fusion.67", "fusion.1174"]
+    batch, seq = 44, 512
+    from paddle_tpu.models import llama
+    from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
+
+    cfg = llama.LlamaConfig.bert_base_equiv(max_seq_len=seq)
+    mesh = create_hybrid_mesh(devices=jax.devices()[:1])
+    params = llama.init_params(cfg)
+    opt_state = llama.init_opt_state(params)
+    rng = np.random.RandomState(0)
+    tokens = jnp.array(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                       jnp.int32)
+    step = llama.make_sharded_train_step(cfg, mesh, lr=1e-4)
+    txt = step.lower(params, opt_state, tokens, tokens).compile().as_text()
+    set_mesh(None)
+
+    # index: computation name -> its body lines
+    comps = {}
+    cur = None
+    for line in txt.splitlines():
+        m = re.match(r"\s*%?([\w.\-]+)\s*\([^)]*\)\s*->.*{", line)
+        if line.startswith(("ENTRY", "HloModule")):
+            cur = "__entry__" if line.startswith("ENTRY") else None
+            comps.setdefault(cur, [])
+            continue
+        if m and line.rstrip().endswith("{"):
+            cur = m.group(1)
+            comps[cur] = []
+            continue
+        if cur is not None:
+            comps.setdefault(cur, []).append(line)
+
+    entry = comps.get("__entry__", [])
+    for want in names:
+        print(f"=== %{want} ===")
+        defline = None
+        for line in entry:
+            if f"%{want} " in line and "= " in line.split("%" + want)[0] + "x":
+                if re.search(rf"%{re.escape(want)}\s*=", line):
+                    defline = line.strip()
+                    break
+        if defline is None:
+            for body in comps.values():
+                for line in body or []:
+                    if re.search(rf"%{re.escape(want)}\s*=", line):
+                        defline = line.strip()
+                        break
+                if defline:
+                    break
+        if not defline:
+            print("  (not found)")
+            continue
+        print(" ", defline[:300])
+        m = re.search(r"calls=%?([\w.\-]+)", defline) or \
+            re.search(r"fusion\(.*\), kind=\w+, calls=%?([\w.\-]+)", defline)
+        called = m.group(1) if m else None
+        if called and called in comps:
+            dots = [ln.strip() for ln in comps[called]
+                    if " dot(" in ln or "convolution(" in ln]
+            for d in dots:
+                print("    DOT:", d[:260])
+            if not dots:
+                # show the root + a few representative op lines
+                interesting = [ln.strip() for ln in comps[called]
+                               if re.search(r"= (f|bf|s|u)\d", ln)
+                               and not re.search(r"parameter|constant",
+                                                 ln)][:8]
+                for ln in interesting:
+                    print("    ", ln[:200])
+        print()
+
+
+if __name__ == "__main__":
+    main()

@@ -83,6 +83,7 @@ class ServingEngine:
         self._rem_host = [0] * self.slots  # host mirror of remaining counts
         self._finished: List[Request] = []
         self.last_run_chunks = 0  # decode chunks issued by the last run()
+        self.last_run_ticks = 0   # decode TICKS (fused: exact; windowed: chunks*K)
         self.last_latencies = {}  # rid -> submit->finish seconds (last run)
         self._next_rid = 0
         self._cache = llama.init_kv_cache(cfg, self.slots, self.max_len)
@@ -124,7 +125,7 @@ class ServingEngine:
         cached = self._progs.get((bucket, nb))
         if cached is not None:
             return cached
-        cfg, max_len = self.cfg, self.max_len
+        cfg, max_len, eos = self.cfg, self.max_len, self.eos
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def admit(params, cache, prompts, true_lens, slot_ids,
@@ -141,6 +142,11 @@ class ServingEngine:
             v = cache["v"].at[:, slot_ids].set(c["v"])
             pos = pos.at[slot_ids].set(true_lens)
             nxt = nxt.at[slot_ids].set(tok0)
+            if eos is not None:
+                # EOS at prefill freezes the slot IN-PROGRAM — the host
+                # only learns at the next sync point (r5: host reads are
+                # deferred/batched), so the device must not decode on
+                rems_new = jnp.where(tok0 == eos, 0, rems_new)
             rem = rem.at[slot_ids].set(rems_new)
             return {"k": k, "v": v}, pos, nxt, rem, tok0
 
@@ -179,13 +185,21 @@ class ServingEngine:
                 return b
         raise ValueError(f"no bucket for prompt length {n}")
 
-    def _fill_slots(self) -> None:
+    def _fill_slots(self, admits: List[tuple]) -> None:
         """Admission wave: take as many queued requests as there are free
         slots (longest-remaining-first), group them by prompt bucket, and
         run ONE fused prefill+insert program per sub-group. Hysteresis:
-        between chunks, refill only once a few slots are free (the
+        between windows, refill only once a few slots are free (the
         threshold shrinks with the queue so the tail always drains) —
-        wide waves amortise per-program dispatch latency."""
+        wide waves amortise per-program dispatch latency.
+
+        r5: tok0 is NOT fetched here — the device future and its
+        (request, slot) mapping append to ``admits`` and the host reads
+        them in ONE batched ``jax.device_get`` at the next sync point
+        (per-wave blocking fetches were the dominant serving cost on a
+        ~30 ms-round-trip dispatch path). Requests with
+        ``max_new_tokens == 1`` are retired host-side immediately (a
+        host-known condition); their token is delivered at the sync."""
         free = [s for s in range(self.slots) if self._active[s] is None]
         if not free or not self._queue:
             return
@@ -218,27 +232,28 @@ class ServingEngine:
                         self.params, self._cache, jnp.asarray(prompts),
                         jnp.asarray(lens), jnp.asarray(slots, jnp.int32),
                         self._pos, self._nxt, self._rem, jnp.asarray(rems))
-                tok0 = np.asarray(tok0)
-                for j, (r, s) in enumerate(zip(sub, slots)):
-                    r.tokens.append(int(tok0[j]))
-                    hit_eos = self.eos is not None and \
-                        r.tokens[-1] == self.eos
-                    if r.done or hit_eos:
-                        self._retire(r)
+                admits.append((tok0, list(zip(sub, slots))))
+                for r, s in zip(sub, slots):
+                    if r.max_new_tokens <= 1:
+                        # done at prefill (host-known): free the slot now;
+                        # the device-side rem is already 0
                         self._rem_host[s] = 0
-                        # slot was inserted live; freeze it again
-                        self._rem = self._rem.at[s].set(0)
                         self._active[s] = None
                     else:
                         self._active[s] = r
                         self._rem_host[s] = r.max_new_tokens - 1
-        # recurse: retiring at-prefill frees slots for remaining queue
+        # recurse: host-known prefill retirements free slots for the rest
         if self._queue and any(a is None for a in self._active):
-            self._fill_slots()
+            self._fill_slots(admits)
 
     def warmup(self) -> None:
-        """Compile every program shape (fused admit per bucket x wave
-        width, the decode chunk) so serving excludes compiles."""
+        """Compile the WINDOWED path's program shapes (fused admit per
+        bucket x wave width, the decode chunk) so incremental serving
+        excludes compiles. The fused drain (``run()``'s default) is
+        specialised to the padded workload shape (n_pad, p_max, g_max)
+        and compiles on the first ``run()`` that sees that shape — warm
+        it by running a representative workload once (the serving
+        benchmark does exactly this)."""
         for b in self.buckets:
             for nb in _WAVE_WIDTHS:
                 if nb > self.slots:
@@ -257,25 +272,233 @@ class ServingEngine:
         self._nxt = jnp.zeros((self.slots,), jnp.int32)
         self._rem = jnp.zeros((self.slots,), jnp.int32)
 
+    # --- fused whole-drain program (r5) -----------------------------------
+    def _drain_prog(self, n_pad: int, p_max: int, g_max: int):
+        """The WHOLE queue drain as ONE compiled program (the decode
+        analog of ``llama.generate``'s single-scan design, prescribed by
+        r4's verdict): slot state lives on device and a ``while_loop``
+        alternates two branches —
+
+          admit:  a free slot exists and requests remain -> prefill the
+                  next request (bucket-padded [1, p_max]) inside a
+                  ``lax.cond`` branch and scatter its KV/pos/token into
+                  the slot arrays;
+          decode: one ragged tick for all slots (frozen slots idle).
+
+        Admission costs no host round trip, so refill is GREEDY (every
+        free slot refills the moment work is queued — better packing
+        than the windowed path's hysteresis). Host round trips for the
+        whole drain: ONE dispatch + ONE result fetch, making the engine
+        dispatch-latency-robust by construction. Memoised per
+        (n_pad, p_max, g_max) padded workload shape."""
+        key = ("drain", n_pad, p_max, g_max)
+        cached = self._progs.get(key)
+        if cached is not None:
+            return cached
+        cfg, max_len, slots, eos = (self.cfg, self.max_len, self.slots,
+                                    self.eos)
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def drain(params, cache, prompts, lens, gens, n_real):
+            i32 = jnp.int32
+            st = dict(
+                cache=cache,
+                pos=jnp.zeros((slots,), i32),
+                nxt=jnp.zeros((slots,), i32),
+                rem=jnp.zeros((slots,), i32),
+                rid=jnp.full((slots,), n_pad, i32),   # n_pad = trash row
+                cnt=jnp.zeros((slots,), i32),
+                out=jnp.zeros((n_pad + 1, g_max), i32),
+                fin=jnp.zeros((n_pad + 1,), i32),     # finish step / req
+                qidx=i32(0), step=i32(0), ndec=i32(0),
+            )
+
+            def cond(st):
+                return jnp.any(st["rem"] > 0) | (st["qidx"] < n_real)
+
+            def admit(st):
+                s = jnp.argmin(st["rem"])  # a rem==0 slot (min is 0)
+                q = st["qidx"]
+                # every prefill pads to the batch-global p_max (no per-
+                # bucket lax.switch): prefill here is HBM-bound — it
+                # streams the whole weight set regardless of width — so a
+                # 32-token prompt padded to 256 costs ~the same wall time,
+                # and one branch keeps the program small
+                prow = jax.lax.dynamic_slice(prompts, (q, 0), (1, p_max))
+                ln = lens[q]
+                c1 = llama.init_kv_cache(cfg, 1, p_max)
+                logits, c1 = llama.forward_with_cache(
+                    params, prow, cfg, c1, jnp.int32(0), logit_pos=ln - 1)
+                t0 = jnp.argmax(logits, axis=-1).astype(i32).reshape(())
+                k = jax.lax.dynamic_update_slice(
+                    st["cache"]["k"], c1["k"], (0, s, 0, 0, 0))
+                v = jax.lax.dynamic_update_slice(
+                    st["cache"]["v"], c1["v"], (0, s, 0, 0, 0))
+                rem_new = gens[q] - 1
+                if eos is not None:
+                    rem_new = jnp.where(t0 == eos, 0, rem_new)
+                fin = jnp.where(rem_new == 0,
+                                st["fin"].at[q].set(st["step"]), st["fin"])
+                return dict(
+                    cache={"k": k, "v": v},
+                    pos=st["pos"].at[s].set(ln),
+                    nxt=st["nxt"].at[s].set(t0),
+                    rem=st["rem"].at[s].set(rem_new),
+                    rid=st["rid"].at[s].set(q),
+                    cnt=st["cnt"].at[s].set(1),
+                    out=st["out"].at[q, 0].set(t0),
+                    fin=fin,
+                    qidx=q + 1, step=st["step"], ndec=st["ndec"],
+                )
+
+            def decode(st):
+                live = st["rem"] > 0
+                logits, cache = llama.forward_with_cache(
+                    params, st["nxt"][:, None], cfg, st["cache"], st["pos"])
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                tok = jnp.where(live, tok, st["nxt"])
+                rows = jnp.where(live, st["rid"], n_pad)
+                cols = jnp.minimum(st["cnt"], g_max - 1)
+                out = st["out"].at[rows, cols].set(tok)
+                rem = st["rem"] - live.astype(jnp.int32)
+                if eos is not None:
+                    rem = jnp.where(live & (tok == eos), 0, rem)
+                finished = live & (rem == 0)
+                fin = st["fin"].at[
+                    jnp.where(finished, st["rid"], n_pad)].set(st["step"])
+                return dict(
+                    cache=cache,
+                    pos=st["pos"] + live.astype(jnp.int32),
+                    nxt=tok,
+                    rem=rem,
+                    rid=st["rid"], cnt=st["cnt"] + live.astype(jnp.int32),
+                    out=out, fin=fin,
+                    qidx=st["qidx"], step=st["step"],
+                    ndec=st["ndec"] + 1,
+                )
+
+            def body(st):
+                can_admit = (st["qidx"] < n_real) & jnp.any(st["rem"] == 0)
+                st = jax.lax.cond(can_admit, admit, decode, st)
+                st["step"] = st["step"] + 1
+                return st
+
+            st = jax.lax.while_loop(cond, body, st)
+            return (st["cache"], st["out"], st["fin"], st["step"],
+                    st["ndec"])
+
+        self._progs[key] = drain
+        return drain
+
+    @staticmethod
+    def _pow2(n: int, lo: int = 1) -> int:
+        p = lo
+        while p < n:
+            p *= 2
+        return p
+
+    def _run_fused(self) -> Dict[int, List[int]]:
+        import time as _time
+
+        self._queue.sort(key=lambda r: -r.max_new_tokens)
+        picked, self._queue = self._queue, []
+        n = len(picked)
+        n_pad = self._pow2(n)
+        p_max = self._bucket_for(max(len(r.prompt) for r in picked))
+        g_max = self._pow2(max(r.max_new_tokens for r in picked), lo=16)
+        prompts = np.zeros((n_pad, p_max), np.int32)
+        lens = np.ones((n_pad,), np.int32)   # pad rows: 1-token dummy
+        gens = np.zeros((n_pad,), np.int32)  # gen 0 -> never admitted
+        for j, r in enumerate(picked):
+            prompts[j, :len(r.prompt)] = r.prompt
+            lens[j] = len(r.prompt)
+            gens[j] = r.max_new_tokens
+        t0 = _time.perf_counter()
+        self._cache, out, fin, steps, ndec = self._drain_prog(
+            n_pad, p_max, g_max)(
+                self.params, self._cache, jnp.asarray(prompts),
+                jnp.asarray(lens), jnp.asarray(gens), jnp.int32(n))
+        out, fin, steps, ndec = jax.device_get([out, fin, steps, ndec])
+        wall = _time.perf_counter() - t0
+        self.last_run_ticks = int(ndec)
+        self.last_run_chunks = -(-int(ndec) // self.chunk)
+        per_step = wall / max(int(steps), 1)
+        for j, r in enumerate(picked):
+            toks = [int(t) for t in out[j, :r.max_new_tokens]]
+            if self.eos is not None and self.eos in toks:
+                toks = toks[:toks.index(self.eos) + 1]
+            r.tokens = toks
+            # latency estimate: request finished at loop step fin[j] of
+            # steps total (single-program drain has no per-request host
+            # clock; the step clock scales by measured wall time).
+            # Uniform step weighting is deliberate: at this model scale
+            # BOTH branch kinds are HBM-bound and stream the full weight
+            # set once — an admit (prefill [1, p_max]) and a decode tick
+            # ([slots, 1]) cost within ~2x of each other, not the ~p_max x
+            # a FLOP-count model would suggest.
+            r.finish_time = r.submit_time + (int(fin[j]) + 1) * per_step
+            self._finished.append(r)
+        done = {r.rid: r.tokens for r in self._finished}
+        self.last_latencies = {r.rid: r.finish_time - r.submit_time
+                               for r in self._finished if r.finish_time}
+        self._finished = []
+        return done
+
     # --- the engine loop --------------------------------------------------
-    def run(self) -> Dict[int, List[int]]:
-        """Drain the queue: continuous batching until every request is
-        served. Returns rid -> generated tokens (greedy, incl. the first
-        token sampled at prefill)."""
-        self.last_run_chunks = 0
-        self._fill_slots()
-        while any(r is not None for r in self._active):
-            out = self._decode_prog(self.params, self._cache, self._pos,
-                                    self._nxt, self._rem)
-            self.last_run_chunks += 1
-            self._cache, self._pos, self._nxt, self._rem, toks = out
-            toks = np.asarray(toks)  # the one device->host fetch per chunk
+    def _chunks_until_sync(self) -> int:
+        """How many decode chunks to issue before the next host sync.
+
+        Retirement times are HOST-KNOWN absent EOS (rem counts are fixed
+        at admission), so the host can run the device ahead to exactly
+        the point where the refill hysteresis would admit new work — no
+        per-chunk fetch needed. With EOS enabled, in-program freezing
+        keeps results exact but a frozen slot idles until the host
+        notices, so the run-ahead is capped to bound the waste."""
+        rems = sorted(self._rem_host[s] for s in range(self.slots)
+                      if self._active[s] is not None)
+        if not rems:
+            return 0
+        if self._queue:
+            threshold = min(4, self.slots, len(self._queue))
+            free_now = self.slots - len(rems)
+            need = min(max(threshold - free_now, 1), len(rems))
+            target = rems[need - 1]
+        else:
+            target = rems[-1]  # no queue: drain every active slot
+        n = -(-target // self.chunk)
+        if self.eos is not None:
+            n = min(n, 4)  # EOS can freeze slots the host can't see yet
+        return n
+
+    def _sync(self, admits: List[tuple], chunk_toks: List[object]) -> None:
+        """ONE batched device->host fetch for a whole window (admit tok0s
+        + every decode chunk's [K, slots] tokens), then distribute
+        chronologically: a slot admitted this window consumes its tok0
+        first, then the chunk ticks. Tokens after a slot's remaining
+        count or its first EOS are in-program frozen repeats and are
+        dropped."""
+        if not admits and not chunk_toks:
+            return
+        fetched = jax.device_get([[a[0] for a in admits], chunk_toks])
+        tok0s, toks = fetched
+        for (_, pairs), t0 in zip(admits, tok0s):
+            for (r, s), t in zip(pairs, np.asarray(t0).tolist()):
+                r.tokens.append(int(t))
+                hit_eos = self.eos is not None and int(t) == self.eos
+                if r.done or hit_eos:
+                    if self._active[s] is r:  # not already freed host-side
+                        self._active[s] = None
+                    self._rem_host[s] = 0
+                    self._retire(r)
+        if toks:
+            stream = np.concatenate([np.asarray(t) for t in toks], axis=0)
+            ticks = stream.shape[0]
             for slot, req in enumerate(self._active):
                 if req is None:
                     continue
-                take = min(self.chunk, self._rem_host[slot])
+                take = min(ticks, self._rem_host[slot])
                 for k in range(take):
-                    t = int(toks[k, slot])
+                    t = int(stream[k, slot])
                     req.tokens.append(t)
                     self._rem_host[slot] -= 1
                     if self.eos is not None and t == self.eos:
@@ -284,7 +507,40 @@ class ServingEngine:
                 if self._rem_host[slot] == 0:
                     self._retire(req)
                     self._active[slot] = None
-            self._fill_slots()
+
+    def run(self, fused: bool = True) -> Dict[int, List[int]]:
+        """Drain the queue: continuous batching until every request is
+        served. Returns rid -> generated tokens (greedy, incl. the first
+        token sampled at prefill).
+
+        ``fused=True`` (default): the whole drain compiles into ONE
+        program — in-program admission + slot freeze, one dispatch + one
+        fetch total (see ``_drain_prog``). The windowed host loop below
+        (``fused=False``) remains for incremental serving on top of an
+        already-partial slot state; it batches its host reads per
+        admission window: admission programs plus every decode chunk up
+        to the next host-known refill point issue without reading
+        anything back (chunks chain device-side through jax async
+        dispatch) and the window ends in ONE batched fetch."""
+        if fused and self._queue and \
+                all(r is None for r in self._active):
+            return self._run_fused()
+        self.last_run_chunks = 0
+        admits: List[tuple] = []
+        self._fill_slots(admits)
+        while any(r is not None for r in self._active):
+            chunk_toks: List[object] = []
+            for _ in range(self._chunks_until_sync()):
+                out = self._decode_prog(self.params, self._cache, self._pos,
+                                        self._nxt, self._rem)
+                self.last_run_chunks += 1
+                self._cache, self._pos, self._nxt, self._rem, toks = out
+                chunk_toks.append(toks)
+            self._sync(admits, chunk_toks)
+            admits = []
+            self._fill_slots(admits)
+        self._sync(admits, [])  # tail: admits whose requests all retired
+        self.last_run_ticks = self.last_run_chunks * self.chunk
         done = {r.rid: r.tokens[:r.max_new_tokens] for r in self._finished}
         # per-request slot latency (continuous batching's OTHER win besides
         # packing: short requests retire early instead of waiting for the

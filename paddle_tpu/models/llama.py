@@ -687,8 +687,34 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos,
         attn = _cache_attention(cfg, q, kc, vc, positions)
         return _layer_post(cfg, x, attn, lp), (kc, vc)
 
-    x, (kcs, vcs) = jax.lax.scan(body, x,
-                                 (layer_weights, cache["k"], cache["v"]))
+    if cfg.scan_layers:
+        x, (kcs, vcs) = jax.lax.scan(body, x,
+                                     (layer_weights, cache["k"], cache["v"]))
+    else:
+        # Unrolled layers (scan_layers=False): write the new K/V rows at a
+        # STATIC layer index directly into the stacked cache buffers. The
+        # layer-scan path must slice layer l's [B,S,Hkv,D] cache out of
+        # the stacked xs and re-stack the updated copy into ys EVERY
+        # layer — on the decode tick that is 4 full cache copies per
+        # layer (~360 us/tick at the serving bench shape, measured in
+        # benchmarks/decode_profile.py, vs ~0 for the in-place row DUS
+        # here). Prefill/decode programs donate the cache, so these
+        # updates happen in place.
+        kcs, vcs = cache["k"], cache["v"]
+        for i in range(cfg.num_layers):
+            lp = {kk: layer_weights[kk][i] for kk in layer_weights}
+            q, k_new, v_new = _qkv_proj(cfg, x, lp, positions)
+            if ragged:
+                rows = jnp.arange(B)
+                kcs = kcs.at[i, rows, pos].set(k_new[:, 0].astype(kcs.dtype))
+                vcs = vcs.at[i, rows, pos].set(v_new[:, 0].astype(vcs.dtype))
+            else:
+                kcs = jax.lax.dynamic_update_slice(
+                    kcs, k_new[None].astype(kcs.dtype), (i, 0, pos, 0, 0))
+                vcs = jax.lax.dynamic_update_slice(
+                    vcs, v_new[None].astype(vcs.dtype), (i, 0, pos, 0, 0))
+            attn = _cache_attention(cfg, q, kcs[i], vcs[i], positions)
+            x = _layer_post(cfg, x, attn, lp)
     x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
     if logit_pos is None:
         last = x[:, -1]

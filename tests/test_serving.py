@@ -112,3 +112,82 @@ class TestServingEos:
             else:
                 want = ref
             assert results[rid] == want, (rid, results[rid], want)
+
+
+class TestWindowedPath:
+    def test_windowed_matches_fused(self, tiny):
+        """run(fused=False) — the incremental host loop with batched
+        window syncs — must produce the same greedy tokens as the
+        single-program drain."""
+        cfg, params = tiny
+        rng = np.random.RandomState(3)
+        reqs = [(rng.randint(0, cfg.vocab_size, (l,)).astype(np.int32), n)
+                for l, n in [(5, 7), (12, 3), (30, 9), (3, 12), (17, 5)]]
+
+        def serve(fused):
+            eng = ServingEngine(cfg, params, slots=3, max_len=96, chunk=4,
+                                prompt_buckets=(8, 16, 32))
+            rids = [eng.add_request(p, n) for p, n in reqs]
+            out = eng.run(fused=fused)
+            assert eng.last_run_ticks > 0
+            return [out[r] for r in rids]
+
+        assert serve(True) == serve(False)
+
+    def test_windowed_eos_deferred_freeze(self, tiny):
+        """The windowed path's deferred-EOS machinery (in-program freeze
+        at admit + _sync's tok0 EOS handling) must truncate at the first
+        EOS exactly like the dense path — including EOS emitted AT
+        prefill, which the host only learns at the next batched sync."""
+        cfg, params = tiny
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(0, cfg.vocab_size, (6 + i,)).astype(np.int32)
+                   for i in range(5)]
+        refs = [_dense_reference(cfg, params, p, 8) for p in prompts]
+        eos_mid = refs[0][2]      # EOS mid-generation for request 0
+        eos_pre = refs[1][0]      # EOS at the PREFILL token of request 1
+        for eos in (eos_mid, eos_pre):
+            eng = ServingEngine(cfg, params, slots=2, max_len=96, chunk=4,
+                                prompt_buckets=(16,), eos_token_id=eos)
+            rids = [eng.add_request(p, 8) for p in prompts]
+            results = eng.run(fused=False)
+            for rid, ref in zip(rids, refs):
+                want = ref[:ref.index(eos) + 1] if eos in ref else ref
+                assert results[rid] == want, (eos, rid, results[rid], want)
+
+
+class TestUnrolledCachePath:
+    def test_unrolled_matches_scan_generate_and_ragged(self, tiny):
+        """scan_layers=False routes forward_with_cache through the
+        unrolled static-index row-DUS branch (the decode fast path every
+        bert_base_equiv benchmark runs); it must match the layer-scan
+        branch token-for-token on generate AND on the ragged per-slot
+        decode the serving engine uses."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        cfg_s, params = tiny
+        cfg_u = dataclasses.replace(cfg_s, scan_layers=False)
+        rng = np.random.RandomState(11)
+        prompt = jnp.array(rng.randint(0, cfg_s.vocab_size, (2, 10)),
+                           jnp.int32)
+        o_s = np.asarray(llama.generate(params, prompt, cfg_s,
+                                        max_new_tokens=8, max_len=32))
+        o_u = np.asarray(llama.generate(params, prompt, cfg_u,
+                                        max_new_tokens=8, max_len=32))
+        np.testing.assert_array_equal(o_s, o_u)
+
+        caches = [llama.init_kv_cache(c, 2, 32) for c in (cfg_s, cfg_u)]
+        outs = []
+        for cfg, cache in zip((cfg_s, cfg_u), caches):
+            lg, cache = llama.forward_with_cache(params, prompt, cfg,
+                                                 cache, jnp.int32(0))
+            posv = jnp.array([10, 10], jnp.int32)
+            l2, cache = llama.forward_with_cache(
+                params, jnp.array([[3], [5]], jnp.int32), cfg, cache, posv)
+            outs.append((np.asarray(lg), np.asarray(l2),
+                         np.asarray(cache["k"])))
+        for a, b in zip(*outs):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
