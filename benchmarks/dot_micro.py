@@ -51,35 +51,7 @@ import numpy as np
 PEAK_TFS = 197e12  # v5e bf16
 
 
-def timeit(fn, args, iters):
-    """Slope-timed on-device loop (see flash_micro.timeit for rationale:
-    ~4 ms tunneled dispatch => per-call host timing is latency-bound, and
-    the additive near-zero carry keeps the body loop-variant without
-    getting algebraically hoisted)."""
-    def loop(c, a0, rest, n):
-        def body(carry, _):
-            out = fn(a0 + (carry - 1.0).astype(a0.dtype), *rest)
-            # consume EVERY output element: a single-element read lets XLA
-            # slice through the dot and DCE the rest of the matmul (observed:
-            # fp32-out dW shapes timed at "13,825 TF/s"). The full-reduce
-            # epilogue costs ~0.01 ms of HBM traffic — noise vs the dot.
-            s = jnp.sum(jax.tree.leaves(out)[0].astype(jnp.float32))
-            return 1.0 + 1e-24 * s, None
-        c, _ = jax.lax.scan(body, c, None, length=n)
-        return c
-    jloop = jax.jit(loop, static_argnums=(3,))
-    c = jnp.float32(1.0)
-    times = {}
-    for n in (iters, 2 * iters):
-        float(jloop(c, args[0], args[1:], n))
-        best = None
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(jloop(c, args[0], args[1:], n))
-            dt = time.perf_counter() - t0
-            best = dt if best is None else min(best, dt)
-        times[n] = best
-    return (times[2 * iters] - times[iters]) / iters
+from microbench import slope_timeit as timeit  # noqa: E402
 
 
 def bench_shape(rng, M, K, N, out_dtype, iters):

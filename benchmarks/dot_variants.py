@@ -28,27 +28,7 @@ import numpy as np
 PEAK = 197e12
 
 
-def timeit(fn, args, iters, reps=5):
-    def loop(c, a0, rest, n):
-        def body(carry, _):
-            out = fn(a0 + (carry - 1.0).astype(a0.dtype), *rest)
-            s = jnp.sum(jax.tree.leaves(out)[0].astype(jnp.float32))
-            return 1.0 + 1e-24 * s, None
-        c, _ = jax.lax.scan(body, c, None, length=n)
-        return c
-    jloop = jax.jit(loop, static_argnums=(3,))
-    c = jnp.float32(1.0)
-    times = {}
-    for n in (iters, 2 * iters):
-        float(jloop(c, args[0], args[1:], n))
-        best = None
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            float(jloop(c, args[0], args[1:], n))
-            dt = time.perf_counter() - t0
-            best = dt if best is None else min(best, dt)
-        times[n] = best
-    return (times[2 * iters] - times[iters]) / iters
+from microbench import slope_timeit as timeit  # noqa: E402
 
 
 def report(tag, per, flops):
@@ -66,6 +46,8 @@ def pallas_matmul(a, b, bm=512, bn=768, bk=2048):
     M, K = a.shape
     K2, N = b.shape
     assert K == K2
+
+    from jax.experimental.pallas import tpu as pltpu
 
     def kernel(a_ref, b_ref, o_ref, acc_ref):
         k = pl.program_id(2)
@@ -89,8 +71,7 @@ def pallas_matmul(a, b, bm=512, bn=768, bk=2048):
                   pl.BlockSpec((bk, bn), lambda i, j, k: (k, j))],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
         out_shape=jax.ShapeDtypeStruct((M, N), jnp.bfloat16),
-        scratch_shapes=[pl.MemorySpace.VMEM(
-            jax.ShapeDtypeStruct((bm, bn), jnp.float32))],
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
     )(a, b)
 
 
@@ -127,15 +108,16 @@ def main():
         report(f"ksplit{n}", timeit(f, (do, w), iters), flops)
 
     # pallas hand-kernel sweep over block shapes (Mv is not bm-divisible:
-    # use the padded M — the extra 44 rows are 0.2% flops)
-    for bm, bn, bk in ((512, 768, 2000), (1024, 768, 1000),
-                      (2048, 768, 500), (704, 768, 2000)):
+    # use the padded M — the extra 44 rows are 0.2% flops). Mosaic needs
+    # the trailing two block dims %8 / %128; 32000 = 128*250, so valid bk
+    # are multiples of 128 dividing 32000: 640, 3200, 6400.
+    for bm, bn, bk in ((512, 768, 3200), (1024, 768, 3200),
+                       (2048, 768, 640), (512, 768, 6400)):
         if Mp % bm or V % bk or H % bn:
             print(f"pallas bm{bm} bn{bn} bk{bk}: skip (not divisible)")
             continue
         try:
             f = jax.jit(functools.partial(pallas_matmul, bm=bm, bn=bn, bk=bk))
-            ref = np.asarray(base(dop[:2048], w[:, :]) if False else 0)
             got = f(dop, w)
             exp = base(dop, w)
             err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
