@@ -89,6 +89,13 @@ def main():
     base = jax.jit(lambda x, y: x @ y)
     report("base", timeit(base, (do, w), iters), flops)
 
+    # same dot but an explicit fp32 accumulator then cast — the emitter
+    # picks a different (sometimes far better) tiling for preferred=f32
+    pf32 = jax.jit(lambda x, y: jax.lax.dot_general(
+        x, y, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.bfloat16))
+    report("base pf32-acc", timeit(pf32, (do, w), iters), flops)
+
     dop = jnp.asarray(rng.randn(Mp, V), jnp.bfloat16)
     report("padM (22528 rows)", timeit(base, (dop, w), iters),
            2.0 * Mp * V * H)

@@ -17,12 +17,22 @@ import numpy as np
 
 
 def main():
-    names = sys.argv[1:] or ["fusion.7", "fusion.67", "fusion.1174"]
+    args = sys.argv[1:] or ["fusion.7", "fusion.67", "fusion.1174"]
+    names = [a for a in args if "=" not in a]
+    ov = {}
+    for a in args:
+        if "=" in a:
+            k, v = a.split("=", 1)
+            try:
+                v = int(v)
+            except ValueError:
+                v = {"True": True, "False": False}.get(v, v)
+            ov[k] = v
     batch, seq = 44, 512
     from paddle_tpu.models import llama
     from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
 
-    cfg = llama.LlamaConfig.bert_base_equiv(max_seq_len=seq)
+    cfg = llama.LlamaConfig.bert_base_equiv(max_seq_len=seq, **ov)
     mesh = create_hybrid_mesh(devices=jax.devices()[:1])
     params = llama.init_params(cfg)
     opt_state = llama.init_opt_state(params)

@@ -90,6 +90,15 @@ class LlamaConfig:
     # touched by the optimizer. Matches the reference's O2 GradScaler
     # contract (fp16/bf16 grads + fp32 master params).
     bf16_grads: bool = False
+    # custom-VJP head+CE tail (single-chip, non-chunked path only): the
+    # backward picks each dot's MXU orientation independently — dx runs
+    # as (W @ dlogits^T)^T, the wide-N transpose formulation a bare-dot
+    # microbench clocks at ~96% of peak vs ~60% for autodiff's
+    # dlogits @ W^T (benchmarks/dot_variants.py); the softmax recompute
+    # stays fused inside both bwd dots (no [M,V] cotangent materialises),
+    # and dx's one-hot term becomes a cheap GATHER of W columns at the
+    # target ids instead of a mask pass.
+    ce_tail_custom: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -113,7 +122,7 @@ class LlamaConfig:
         the scan residual-stacking copies is worth ~25% step time."""
         d = dict(vocab_size=32000, hidden_size=768, intermediate_size=3072,
                  num_layers=12, num_heads=12, num_kv_heads=12, max_seq_len=512,
-                 remat=False, scan_layers=False)
+                 remat=False, scan_layers=False, ce_tail_custom=True)
         d.update(kw)
         return cls(**d)
 
@@ -439,6 +448,95 @@ def _nll_sum(logits, targets, weights) -> jax.Array:
     return jnp.sum((m + jnp.log(sumexp) - gold) * weights)
 
 
+@jax.custom_vjp
+def _head_ce_tail(h2, W, targets, wgt):
+    """lm_head matmul + weighted token-nll SUM with a hand-picked backward.
+
+    Forward math is bit-identical to ``_nll_sum(h2 @ W, targets, wgt)``;
+    ``wgt`` [T] row-weights let the caller score ALL S positions with a
+    zero on the last (no next-token label) — keeping the token dim a
+    multiple of the pallas block so the kernel sees no ragged edge (a
+    non-divisible M makes pallas materialise a PADDED copy of the 1.4 GB
+    logits, measured 6.7 ms/step). The backward differs from autodiff
+    only in SCHEDULING (same algebra):
+
+    - the dx softmax term is a hand-written pallas kernel
+      (ops/pallas/head_dx.py): softmax computed in-kernel from natural-
+      layout logits tiles, tile-dots against a pre-transposed W with an
+      fp32 VMEM accumulator (in-step 6.0 ms vs autodiff's 7.3 ms at the
+      bench shape). Its one-hot term is a GATHER of W columns at the
+      target ids (34 MB) — scatter-free.
+    - dW keeps autodiff's wide-N orientation; its one-hot term is an
+      in-tile iota mask fused into the dot's operand read.
+    - the softmax recompute never materialises an [M, V] cotangent
+      (saving one would cost ~1.8 ms of HBM at the bench shape).
+    """
+    return _nll_sum(h2 @ W.astype(h2.dtype), targets, wgt[None, :])
+
+
+def _head_ce_tail_fwd(h2, W, targets, wgt):
+    logits = h2 @ W.astype(h2.dtype)
+    m = jax.lax.stop_gradient(
+        jnp.max(logits, axis=-1).astype(jnp.float32))
+    se = jnp.sum(
+        jnp.exp(logits.astype(jnp.float32) - m[..., None]), axis=-1)
+    gold = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0].astype(jnp.float32)
+    out = jnp.sum((m + jnp.log(se) - gold) * wgt[None, :])
+    return out, (h2, W, logits, m, se, targets, wgt)
+
+
+def _head_ce_tail_bwd(res, gs):
+    h2, W, logits, m, se, targets, wgt = res
+    B, T, H = h2.shape
+    V = logits.shape[-1]
+    dt = h2.dtype
+    M = B * T
+    lf = logits.reshape(M, V)
+    mf, sef, tf = m.reshape(M), se.reshape(M), targets.reshape(M)
+    gsf = jnp.asarray(gs, jnp.float32)
+    # per-row cotangent scale: gs * row-weight / sumexp feeds the softmax
+    # terms; gs * row-weight scales the one-hot terms
+    wf = jnp.broadcast_to(wgt[None, :], (B, T)).reshape(M)
+    gw = gsf * wf
+    Wd = W.astype(dt)
+
+    # dx softmax term. On TPU this is the hand-written pallas kernel
+    # (ops/pallas/head_dx.py): softmax computed in-kernel from natural-
+    # layout logits tiles, tile-dots against a pre-transposed W with an
+    # fp32 VMEM accumulator. XLA-level alternatives all lose (r5 ledger):
+    # autodiff's orientation runs the dot at ~60-77% of peak, and every
+    # transpose-orientation rewrite forces a >=1.4 GB materialisation
+    # (the algebraic simplifier folds dot^T back, and a transposing
+    # consumer cannot fuse the convert chain) that outweighs the win.
+    from ..ops.pallas.flash_attention import _on_tpu
+    from ..ops.pallas.head_dx import head_dx_softmax
+
+    if _on_tpu():
+        dh_soft = head_dx_softmax(lf, mf, gw / sef, Wd.T)
+    else:
+        p = (jnp.exp(lf.astype(jnp.float32) - mf[:, None])
+             * (gw / sef)[:, None]).astype(dt)
+        dh_soft = p @ Wd.T
+    gold_rows = (jnp.take(Wd, tf, axis=1).T.astype(jnp.float32)
+                 * gw[:, None]).astype(dt)                # [M, H]
+    dh = (dh_soft - gold_rows).reshape(B, T, H)
+
+    # dW: autodiff's wide-N orientation; one-hot as an in-tile iota mask
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, (M, V), 1)
+              == tf[:, None])
+    dlog = ((jnp.exp(lf.astype(jnp.float32) - mf[:, None]) / sef[:, None]
+             - onehot.astype(jnp.float32)) * gw[:, None]).astype(dt)
+    dW = jax.lax.dot_general(h2.reshape(M, H), dlog,
+                             (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32
+                             ).astype(W.dtype)            # [H, V]
+    return dh, dW, None, None
+
+
+_head_ce_tail.defvjp(_head_ce_tail_fwd, _head_ce_tail_bwd)
+
+
 def loss_fn(params, tokens, labels, cfg: LlamaConfig) -> jax.Array:
     """Next-token cross entropy (the reference's ``ParallelCrossEntropy`` /
     ``c_softmax_with_cross_entropy`` — here the vocab-sharded logsumexp
@@ -480,6 +578,18 @@ def loss_fn(params, tokens, labels, cfg: LlamaConfig) -> jax.Array:
         total = jnp.float32(0.0)
         for i in range(nc):
             total = total + body(hc[i], tc[i])
+        return total / (B * (S - 1))
+    if cfg.ce_tail_custom and not multi:
+        # custom-VJP tail: same forward math, hand-scheduled backward
+        # (see _head_ce_tail) — single-chip only (the mesh path needs
+        # the wsc sharding constraint + GSPMD's vocab-sharded CE). ALL S
+        # positions are scored with weight 0 on the last: B*S is a
+        # multiple of the pallas dx block, so the kernel sees no ragged
+        # edge (a padded-copy of the logits costs 6.7 ms — r5 ledger).
+        targets = jnp.concatenate(
+            [labels[:, 1:], jnp.zeros((B, 1), labels.dtype)], axis=1)
+        wgt = jnp.ones((S,), jnp.float32).at[-1].set(0.0)
+        total = _head_ce_tail(h, params["lm_head"], targets, wgt)
         return total / (B * (S - 1))
     # slice h BEFORE the head matmul: slicing the [B,S,V] product instead
     # would materialise a second ~1.5 GB logits copy (the last position

@@ -95,3 +95,65 @@ def test_fused_train_step_product_surface_tpu_matches_cpu():
     assert tpu_losses[-1] < tpu_losses[0], tpu_losses
     np.testing.assert_allclose(tpu_losses, cpu_losses, rtol=2e-3,
                                atol=1e-3)
+
+
+def test_head_dx_pallas_kernel_parity_tpu():
+    """r5 CE-tail kernel (ops/pallas/head_dx.py) on the chip: in-kernel
+    softmax + blocked dots against the fp32 XLA reference, including a
+    ragged M (non-divisible by the block) and zero row-weights."""
+    from paddle_tpu.ops.pallas.head_dx import head_dx_softmax
+
+    rng = np.random.RandomState(0)
+    for M, bm in ((1024, 512), (1000, 512)):  # divisible + ragged
+        V, H = 2048, 256
+        l = jnp.asarray(rng.randn(M, V), jnp.bfloat16)
+        wt = jnp.asarray(rng.randn(V, H), jnp.bfloat16)
+        m = jnp.max(l, axis=-1).astype(jnp.float32)
+        se = jnp.sum(jnp.exp(l.astype(jnp.float32) - m[:, None]), axis=-1)
+        scale = (np.r_[np.zeros(3), np.ones(M - 3)].astype(np.float32)
+                 / np.asarray(se))
+        got = np.asarray(head_dx_softmax(
+            l, m, jnp.asarray(scale), wt, bm=bm, bk=512), np.float32)
+        p = (np.exp(np.float32(l) - np.asarray(m)[:, None])
+             * scale[:, None])
+        ref = p @ np.float32(wt)
+        denom = np.abs(ref).max() + 1e-9
+        assert np.abs(got - ref).max() / denom < 2e-2
+        assert np.abs(got[:3]).max() == 0.0  # zero-weight rows stay zero
+
+
+def test_ce_tail_custom_train_step_tpu_matches_cpu():
+    """The custom-VJP CE tail through a FULL train step on the chip (the
+    bench's exact head path: pallas dx kernel + iota-mask dW) vs the same
+    program with autodiff CE on the CPU backend."""
+    import dataclasses
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
+
+    def run(device, custom):
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(),
+                                  ce_tail_custom=custom)
+        mesh = create_hybrid_mesh(devices=[device])
+        try:
+            params = llama.init_params(cfg)
+            opt_state = llama.init_opt_state(params)
+            params, opt_state = llama.shard_state(cfg, mesh, params,
+                                                  opt_state)
+            tokens = jax.device_put(
+                np.random.RandomState(0).randint(
+                    0, cfg.vocab_size, (4, 64)).astype(np.int32), device)
+            step = llama.make_sharded_train_step(cfg, mesh, lr=1e-2)
+            losses = []
+            for _ in range(3):
+                params, opt_state, loss = step(params, opt_state,
+                                               tokens, tokens)
+                losses.append(float(loss))
+            return losses
+        finally:
+            set_mesh(None)
+
+    tpu_custom = run(jax.devices()[0], True)
+    cpu_autodiff = run(jax.devices("cpu")[0], False)
+    np.testing.assert_allclose(tpu_custom, cpu_autodiff, rtol=2e-3,
+                               atol=1e-3)
