@@ -17,17 +17,12 @@ import numpy as np
 
 
 def main():
-    args = sys.argv[1:] or ["fusion.7", "fusion.67", "fusion.1174"]
-    names = [a for a in args if "=" not in a]
-    ov = {}
-    for a in args:
-        if "=" in a:
-            k, v = a.split("=", 1)
-            try:
-                v = int(v)
-            except ValueError:
-                v = {"True": True, "False": False}.get(v, v)
-            ov[k] = v
+    from microbench import parse_overrides
+
+    args = sys.argv[1:]
+    names = [a for a in args if "=" not in a] or \
+        ["fusion.7", "fusion.67", "fusion.1174"]
+    ov = parse_overrides([a for a in args if "=" in a])
     batch, seq = 44, 512
     from paddle_tpu.models import llama
     from paddle_tpu.parallel import create_hybrid_mesh, set_mesh

@@ -52,3 +52,17 @@ def slope_timeit(fn, args, iters, reps=5):
             best = dt if best is None else min(best, dt)
         times[n] = best
     return (times[2 * iters] - times[iters]) / iters
+
+
+def parse_overrides(argv):
+    """key=value CLI args -> LlamaConfig override dict (ints and bools
+    coerced) — shared by perf_lab/step_profile/hlo_map/compiler_opt_probe."""
+    ov = {}
+    for a in argv:
+        k, v = a.split("=", 1)
+        try:
+            v = int(v)
+        except ValueError:
+            v = {"True": True, "False": False}.get(v, v)
+        ov[k] = v
+    return ov

@@ -46,12 +46,6 @@ def measure(cfg_overrides, batch=48, seq=512, tag=""):
 
 
 if __name__ == "__main__":
-    ov = {}
-    for a in sys.argv[1:]:
-        k, v = a.split("=", 1)
-        try:
-            v = int(v)
-        except ValueError:
-            v = {"True": True, "False": False}.get(v, v)
-        ov[k] = v
-    measure(ov)
+    from microbench import parse_overrides
+
+    measure(parse_overrides(sys.argv[1:]))

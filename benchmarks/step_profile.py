@@ -20,15 +20,9 @@ def main():
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 44
     top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 40
     seq = 512
-    # trailing key=value args become LlamaConfig overrides (perf_lab style)
-    ov = {}
-    for a in sys.argv[3:]:
-        k, v = a.split("=", 1)
-        try:
-            v = int(v)
-        except ValueError:
-            v = {"True": True, "False": False}.get(v, v)
-        ov[k] = v
+    from microbench import parse_overrides
+
+    ov = parse_overrides(sys.argv[3:])
     from paddle_tpu.models import llama
     from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
 

@@ -509,10 +509,13 @@ def _head_ce_tail_bwd(res, gs):
     # transpose-orientation rewrite forces a >=1.4 GB materialisation
     # (the algebraic simplifier folds dot^T back, and a transposing
     # consumer cannot fuse the convert chain) that outweighs the win.
+    from .. import flags
     from ..ops.pallas.flash_attention import _on_tpu
     from ..ops.pallas.head_dx import head_dx_softmax
 
-    if _on_tpu():
+    use_kernel = (_on_tpu() and
+                  flags.get_flags("use_pallas_kernels")["use_pallas_kernels"])
+    if use_kernel:
         dh_soft = head_dx_softmax(lf, mf, gw / sef, Wd.T)
     else:
         p = (jnp.exp(lf.astype(jnp.float32) - mf[:, None])

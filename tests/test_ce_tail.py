@@ -64,9 +64,11 @@ class TestCeTailCustom:
         np.testing.assert_allclose(losses[True], losses[False], rtol=2e-5)
 
     def test_head_dx_softmax_fallback_matches_reference(self):
-        """The kernel's contract (exp(l - m) * scale) @ wt against a
-        numpy reference — exercised through the CPU fallback branch and
-        directly against the pallas interface's semantics."""
+        """head_dx_softmax on a shape its blocked kernel cannot tile
+        (V=96 < one lane tile) must take the XLA fallback branch and
+        match the numpy reference (reduction-order tolerances)."""
+        from paddle_tpu.ops.pallas.head_dx import head_dx_softmax
+
         rng = np.random.RandomState(3)
         M, V, H = 48, 96, 16
         l = rng.randn(M, V).astype(np.float32)
@@ -74,7 +76,8 @@ class TestCeTailCustom:
         se = np.exp(l - m[:, None]).sum(-1)
         scale = rng.rand(M).astype(np.float32) / se
         wt = rng.randn(V, H).astype(np.float32)
+        got = np.asarray(head_dx_softmax(
+            jnp.asarray(l), jnp.asarray(m), jnp.asarray(scale),
+            jnp.asarray(wt)))
         ref = (np.exp(l - m[:, None]) * scale[:, None]) @ wt
-        got = (jnp.exp(jnp.asarray(l) - jnp.asarray(m)[:, None])
-               * jnp.asarray(scale)[:, None]) @ jnp.asarray(wt)
-        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
