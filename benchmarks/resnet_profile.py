@@ -1,0 +1,78 @@
+"""Per-instruction xplane profile of the ResNet-50 fused train step —
+where do the ~19 ms between the measured step and the 40.8 ms
+tiling-aware roofline (SCALING.md §3b) go?
+
+Usage: python benchmarks/resnet_profile.py [batch] [top_n]
+"""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import numpy as np
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.vision import models
+
+    model = models.resnet50(num_classes=1000, data_format="NHWC")
+    model.train()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters(),
+                                    weight_decay=1e-4)
+    model, opt = paddle.amp.decorate(models=model, optimizers=opt,
+                                     level="O2", dtype="bfloat16")
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(x, y):
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            return ce(model(x), y)
+
+    step_fn = paddle.jit.fused_train_step(loss_fn, opt, model=model)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(batch, 224, 224, 3).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 1000, (batch,)))
+    float(step_fn(x, y))
+    float(step_fn(x, y))
+
+    tmp = tempfile.mkdtemp(prefix="xplane_rn_")
+    n_steps = 6
+    with jax.profiler.trace(tmp):
+        for _ in range(n_steps):
+            loss = step_fn(x, y)
+        float(loss)
+
+    from paddle_tpu.profiler import _xplane
+    path = _xplane.latest_xplane(tmp)
+    from jax.profiler import ProfileData
+    pd = ProfileData.from_file(path)
+    agg = {}
+    total = 0.0
+    for plane in pd.planes:
+        if not plane.name.startswith("/device:"):
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                name = ev.name.split(" ", 1)[0]
+                a = agg.setdefault(name, [0, 0.0])
+                a[0] += 1
+                a[1] += ev.duration_ns
+                total += ev.duration_ns
+    print(f"batch {batch}: {len(agg)} instrs, "
+          f"{total/1e6/n_steps:.1f} ms device/step")
+    print(f"{'instr':<58} {'calls':>6} {'ms/step':>8} {'share':>6}")
+    for name, (c, ns) in sorted(agg.items(), key=lambda kv: -kv[1][1])[:top_n]:
+        print(f"{name[:58]:<58} {c:>6} {ns/1e6/n_steps:>8.3f} "
+              f"{ns/total:>6.1%}")
+
+
+if __name__ == "__main__":
+    main()

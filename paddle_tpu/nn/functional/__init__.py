@@ -940,9 +940,10 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         # static recording: stat updates become program ops whose outputs are
         # written back onto the buffers at replay (the _inplace_set hook)
         def stats_f(a, rm, rv):
+            af = a.astype(jnp.float32)
             return (
-                momentum * rm + (1 - momentum) * jnp.mean(a, axis=axes).astype(rm.dtype),
-                momentum * rv + (1 - momentum) * jnp.var(a, axis=axes).astype(rv.dtype),
+                momentum * rm + (1 - momentum) * jnp.mean(af, axis=axes).astype(rm.dtype),
+                momentum * rv + (1 - momentum) * jnp.var(af, axis=axes).astype(rv.dtype),
             )
 
         new_m, new_v = run_op("bn_stats", stats_f, x, running_mean, running_var)
@@ -951,8 +952,8 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     elif use_batch_stats and not is_tracing():
         # update running stats (host-side in-place on the buffer tensors);
         # skipped under to_static tracing — tracers must not leak into buffers
-        with_mean = jnp.mean(x._value, axis=axes)
-        with_var = jnp.var(x._value, axis=axes)
+        with_mean = jnp.mean(x._value.astype(jnp.float32), axis=axes)
+        with_var = jnp.var(x._value.astype(jnp.float32), axis=axes)
         running_mean._inplace_set(momentum * running_mean._value + (1 - momentum) * with_mean)
         running_var._inplace_set(momentum * running_var._value + (1 - momentum) * with_var)
     elif use_batch_stats:
@@ -964,11 +965,15 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         record_buffer_write(
             running_mean,
             momentum * running_mean._value
-            + (1 - momentum) * jnp.mean(x._value, axis=axes))
+            + (1 - momentum) * jnp.mean(
+                x._value.astype(jnp.float32), axis=axes).astype(
+                    running_mean._value.dtype))
         record_buffer_write(
             running_var,
             momentum * running_var._value
-            + (1 - momentum) * jnp.var(x._value, axis=axes))
+            + (1 - momentum) * jnp.var(
+                x._value.astype(jnp.float32), axis=axes).astype(
+                    running_var._value.dtype))
 
     shape = [1] * x.ndim
     shape[channel_axis] = x.shape[channel_axis]
@@ -977,19 +982,28 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     # programs capture the buffers — eval-mode programs then see stats
     # loaded/updated after the program was built
     def f(a, rm, rv, *rest):
+        # mixed-precision I/O (the reference's cudnnBatchNorm contract
+        # under AMP: half/bf16 activations, fp32 params+statistics):
+        # ALL arithmetic runs in fp32 — XLA fuses the converts inline —
+        # but the output rounds back to the input dtype, so no fp32
+        # activation (or fp32 backward residual) ever materialises.
+        # Dispatch-level blacklist upcasting would instead store fp32
+        # copies of every BN-adjacent activation: measured ~8 ms/step of
+        # pure HBM traffic on the ResNet-50 bench (r5 ledger).
         i = 0
+        af = a.astype(jnp.float32)
         if use_batch_stats:
-            m = jnp.mean(a, axis=axes)
-            v = jnp.var(a, axis=axes)
+            m = jnp.mean(af, axis=axes)
+            v = jnp.var(af, axis=axes)
         else:
-            m, v = rm, rv
-        out = (a - m.reshape(shape)) / jnp.sqrt(v.reshape(shape) + epsilon)
+            m, v = rm.astype(jnp.float32), rv.astype(jnp.float32)
+        out = (af - m.reshape(shape)) / jnp.sqrt(v.reshape(shape) + epsilon)
         if weight is not None:
-            out = out * rest[0].reshape(shape)
+            out = out * rest[0].astype(jnp.float32).reshape(shape)
             i = 1
         if bias is not None:
-            out = out + rest[i].reshape(shape)
-        return out
+            out = out + rest[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
 
     args = [x, running_mean, running_var]
     if weight is not None:
