@@ -38,30 +38,9 @@ def main():
                                   max_len=max_len))
 
     from paddle_tpu.profiler import _xplane
-    path = _xplane.latest_xplane(tmp)
-    from jax.profiler import ProfileData
-    pd = ProfileData.from_file(path)
-    agg = {}
-    total = 0.0
-    for plane in pd.planes:
-        if not plane.name.startswith("/device:"):
-            continue
-        for line in plane.lines:
-            if line.name != "XLA Ops":
-                continue
-            for ev in line.events:
-                name = ev.name.split(" ", 1)[0]
-                a = agg.setdefault(name, [0, 0.0])
-                a[0] += 1
-                a[1] += ev.duration_ns
-                total += ev.duration_ns
     ticks = new_tokens - 1
-    print(f"batch {batch}: {len(agg)} instrs, {total/1e6:.1f} ms device "
-          f"total, {total/1e6/ticks:.3f} ms/tick over {ticks} ticks")
-    print(f"{'instr':<58} {'calls':>6} {'us/tick':>8} {'share':>6}")
-    for name, (c, ns) in sorted(agg.items(), key=lambda kv: -kv[1][1])[:top_n]:
-        print(f"{name[:58]:<58} {c:>6} {ns/1e3/ticks:>8.2f} "
-              f"{ns/total:>6.1%}")
+    _xplane.print_instr_profile(tmp, ticks, top_n,
+                                header=f"batch {batch}: ")
 
 
 if __name__ == "__main__":

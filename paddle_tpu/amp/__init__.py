@@ -29,6 +29,12 @@ WHITE_LIST: Set[str] = {
     "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
     "addmm", "scaled_dot_product_attention", "embedding",
 }
+# Mixed-I/O ops: the op manages precision INTERNALLY (low-precision
+# activations, fp32 parameters/statistics — the cudnn BN AMP contract).
+# The dispatch layer must neither upcast their low-precision inputs
+# (blacklist behavior would materialise fp32 activations) nor downcast
+# their fp32 state (O2 white-cast would round running stats to bf16).
+MIXED_IO_LIST: Set[str] = {"batch_norm"}
 # Numerically sensitive ops kept in fp32 (reference's black list).
 BLACK_LIST: Set[str] = {
     "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax",
@@ -166,18 +172,21 @@ class GradScaler:
         self._found_inf = found
 
     def step(self, optimizer):
+        """Unscale and conditionally apply — loss-scale DYNAMICS belong to
+        ``update()`` (reference contract: ``scaler.step(opt)`` then
+        ``scaler.update()``; step() updating internally would double-count
+        every iteration's good/bad-step bookkeeping)."""
         if not self._enable:
             optimizer.step()
             return
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
-        self._unscaled = False
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
 
     def update(self):
         self._unscaled = False

@@ -954,8 +954,12 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         # skipped under to_static tracing — tracers must not leak into buffers
         with_mean = jnp.mean(x._value.astype(jnp.float32), axis=axes)
         with_var = jnp.var(x._value.astype(jnp.float32), axis=axes)
-        running_mean._inplace_set(momentum * running_mean._value + (1 - momentum) * with_mean)
-        running_var._inplace_set(momentum * running_var._value + (1 - momentum) * with_var)
+        running_mean._inplace_set(
+            (momentum * running_mean._value
+             + (1 - momentum) * with_mean).astype(running_mean._value.dtype))
+        running_var._inplace_set(
+            (momentum * running_var._value
+             + (1 - momentum) * with_var).astype(running_var._value.dtype))
     elif use_batch_stats:
         # traced (fused_train_step): route the new stats to the trace's
         # buffer-write collector so the compiled program RETURNS them and

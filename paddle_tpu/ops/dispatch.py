@@ -163,9 +163,9 @@ def _run_op_impl(
 
     # AMP autocast hook (the reference's C++ dispatch-level autocast): cast
     # inputs according to the active white/black lists before execution.
-    from ..amp import amp_state
+    from ..amp import MIXED_IO_LIST, amp_state
 
-    if amp_state.enabled:
+    if amp_state.enabled and name not in MIXED_IO_LIST:
         lo = amp_state.dtype
         casts = [None] * len(arrays)
         if name in amp_state.black:

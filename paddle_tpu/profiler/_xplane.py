@@ -120,3 +120,41 @@ def format_table(title: str, rows: Dict[str, List[float]],
         out.append(f"{name[:34]:<34} {calls:>6} {ns / 1e6:>10.3f} "
                    f"{ns / calls / 1e3:>9.1f} {ns / total:>6.1%}")
     return "\n".join(out)
+
+
+def instr_profile(log_dir: str, n_steps: int = 1):
+    """Aggregate per-HLO-instruction device time from the latest xplane in
+    ``log_dir``: returns (agg, total_ns) with agg[name] = [calls, ns].
+    Shared by the benchmark profilers (step/decode/resnet)."""
+    path = latest_xplane(log_dir)
+    assert path, f"no xplane in {log_dir}"
+    from jax.profiler import ProfileData
+
+    pd = ProfileData.from_file(path)
+    agg: Dict[str, List[float]] = {}
+    total = 0.0
+    for plane in pd.planes:
+        if not plane.name.startswith("/device:"):
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                name = ev.name.split(" ", 1)[0]
+                a = agg.setdefault(name, [0, 0.0])
+                a[0] += 1
+                a[1] += ev.duration_ns
+                total += ev.duration_ns
+    return agg, total
+
+
+def print_instr_profile(log_dir: str, n_steps: int, top_n: int,
+                        header: str = "") -> None:
+    agg, total = instr_profile(log_dir)
+    print(f"{header}{len(agg)} distinct HLO instrs, "
+          f"{total / 1e6 / n_steps:.1f} ms device time/step")
+    print(f"{'instr':<58} {'calls':>6} {'ms/step':>8} {'share':>6}")
+    for name, (c, ns) in sorted(agg.items(),
+                                key=lambda kv: -kv[1][1])[:top_n]:
+        print(f"{name[:58]:<58} {c:>6} {ns / 1e6 / n_steps:>8.3f} "
+              f"{ns / total:>6.1%}")

@@ -100,6 +100,8 @@ def test_grad_scaler_skips_on_inf():
     before = w.numpy().copy()
     scaler.step(opt)
     np.testing.assert_array_equal(w.numpy(), before)  # step skipped
+    assert scaler._scale == 4.0  # dynamics deferred to update()
+    scaler.update()
     assert scaler._scale == 2.0  # halved
 
 
