@@ -235,6 +235,15 @@ class Tensor:
         """API parity: jax.Arrays are always dense/contiguous."""
         return self
 
+    def coalesce(self) -> "Tensor":
+        """Reference ``Tensor.coalesce``: only meaningful for sparse COO
+        tensors (``paddle.sparse.sparse_coo_tensor(...).coalesce()``,
+        where SparseCooTensor implements it); a dense tensor raises like
+        the reference does."""
+        raise ValueError(
+            "coalesce() expects a sparse COO tensor; this tensor is dense "
+            "(create one with paddle.sparse.sparse_coo_tensor)")
+
     def is_contiguous(self) -> bool:
         return True
 

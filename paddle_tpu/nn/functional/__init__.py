@@ -1188,6 +1188,34 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     return run_op("alpha_dropout", f, x)
 
 
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole CHANNELS (reference
+    ``paddle.nn.functional.feature_alpha_dropout``): the keep mask has
+    shape [N, C, 1, ...] so each feature map drops or survives whole,
+    with SELU-preserving alpha scaling."""
+    if not training or p == 0.0:
+        return x
+    alpha_p = -1.7580993408473766
+    if p >= 1.0:
+        # every channel dropped: the affine constant the formula limits to
+        return run_op("feature_alpha_dropout",
+                      lambda a: jnp.zeros_like(a), x)
+    mask_shape = tuple(x.shape[:2]) + (1,) * (x.ndim - 2)
+    a_coef = (1.0 - p + p * alpha_p**2 * (1.0 - p)) ** -0.5
+    b_coef = -a_coef * p * alpha_p
+    # the key rides as a tensor INPUT (not a baked closure constant) so
+    # static/to_static replays re-randomize per run, like dropout()
+    key_t = Tensor(jax.random.key_data(next_key()), stop_gradient=True,
+                   name="rngkey_feature_alpha_dropout")
+
+    def f(a, kd):
+        keep = jax.random.bernoulli(jax.random.wrap_key_data(kd), 1.0 - p,
+                                    mask_shape)
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+
+    return run_op("feature_alpha_dropout", f, x, key_t)
+
+
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     def f(w):
         out = jnp.take(w, x._value, axis=0)

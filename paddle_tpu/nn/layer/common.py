@@ -10,7 +10,8 @@ from .. import initializer as I
 from .layers import Layer, ParamAttr
 
 __all__ = [
-    "Linear", "Dropout", "Dropout2D", "Dropout3D", "AlphaDropout", "Embedding",
+    "Linear", "Dropout", "Dropout2D", "Dropout3D", "AlphaDropout",
+    "FeatureAlphaDropout", "Embedding",
     "Flatten", "Identity", "Upsample", "UpsamplingBilinear2D",
     "UpsamplingNearest2D", "Pad1D", "Pad2D", "Pad3D", "CosineSimilarity",
     "Bilinear", "PixelShuffle", "Unfold",
@@ -85,6 +86,18 @@ class AlphaDropout(Layer):
 
     def forward(self, x):
         return F.alpha_dropout(x, self.p, training=self.training)
+
+
+class FeatureAlphaDropout(Layer):
+    """Channel-wise alpha dropout (reference
+    ``paddle.nn.FeatureAlphaDropout``)."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, training=self.training)
 
 
 class Embedding(Layer):

@@ -22,6 +22,7 @@ __all__ = [
     "cond", "cov",
     "corrcoef", "householder_product", "multi_dot", "norm",
     "svd_lowrank", "pca_lowrank", "ormqr", "vector_norm", "matrix_norm",
+    "cholesky_inverse", "lu_solve",
 ]
 
 
@@ -288,6 +289,32 @@ def householder_product(x, tau, name=None):
         return q[..., :, :n]
 
     return run_op("householder_product", f, x, tau)
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A from its Cholesky factor (reference
+    ``paddle.linalg.cholesky_inverse`` over LAPACK potri): A = L L^T (or
+    U^T U), returns A^{-1} via two triangular solves against I."""
+    def f(a):
+        eye = jnp.eye(a.shape[-1], dtype=a.dtype)
+        inv_f = jax.scipy.linalg.solve_triangular(a, eye, lower=not upper)
+        # A^{-1} = L^{-T} L^{-1}  (or U^{-1} U^{-T})
+        return inv_f.T @ inv_f if not upper else inv_f @ inv_f.T
+
+    return run_op("cholesky_inverse", f, x)
+
+
+def lu_solve(b, lu, pivots, trans="N", name=None):
+    """Solve A x = b from ``paddle.linalg.lu``'s output (reference
+    ``paddle.linalg.lu_solve`` over getrs). Pivots are 1-based (the
+    convention ``lu`` documents); jax.scipy wants 0-based."""
+    t = {"N": 0, "T": 1, "H": 2}.get(trans, trans)
+
+    def f(bv, luv, piv):
+        return jax.scipy.linalg.lu_solve(
+            (luv, piv.astype(jnp.int32) - 1), bv, trans=t)
+
+    return run_op("lu_solve", f, b, lu, pivots)
 
 
 def ormqr(x, tau, y, left=True, transpose=False, name=None):

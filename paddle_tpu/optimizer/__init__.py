@@ -3,9 +3,11 @@
 from . import lr
 from .adam import Adam, AdamW, Adamax, Lamb, Lion, NAdam, RAdam
 from .lbfgs import LBFGS
-from .optimizer import SGD, Adadelta, Adagrad, Momentum, Optimizer, RMSProp
+from .optimizer import (ASGD, SGD, Adadelta, Adagrad, Momentum,
+                        Optimizer, RMSProp, Rprop)
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Lamb", "Adagrad",
-    "Adadelta", "RMSProp", "Adamax", "NAdam", "RAdam", "Lion", "LBFGS", "lr",
+    "Adadelta", "RMSProp", "Adamax", "NAdam", "RAdam", "Lion", "LBFGS",
+    "ASGD", "Rprop", "lr",
 ]

@@ -19,7 +19,8 @@ from ..core.tensor import Tensor, to_tensor
 from ..enforce import InvalidArgumentError
 from .lr import LRScheduler
 
-__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp"]
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp",
+           "ASGD", "Rprop"]
 
 
 class L2Decay:
@@ -438,3 +439,80 @@ class RMSProp(Optimizer):
             denom = jnp.sqrt(ms + eps)
         mom = mu * state["momentum"] + lr.astype(p.dtype) * g / denom
         return p - mom, {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference ``paddle.optimizer.ASGD``): keeps the last
+    ``batch_num`` gradients' running sum ``d`` (a cyclic buffer ``ys``
+    holds the individual entries) and steps by lr * d / n."""
+
+    # ys carries an extra leading [batch_num] dim, so the flat-pack
+    # reshape(-1) grouping cannot treat it like a param-shaped state
+    _elementwise_update = False
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._batch_num = int(batch_num)
+
+    def _state_names(self):
+        return ["d", "ys"]
+
+    def _init_state(self, p):
+        return {
+            "d": jnp.zeros_like(p._value),
+            "ys": jnp.zeros((self._batch_num,) + tuple(p._value.shape),
+                            p._value.dtype),
+        }
+
+    def _update_one(self, p, g, state, lr, step, extras=None):
+        bn = self._batch_num
+        idx = (step - 1) % bn
+        y_old = jax.lax.dynamic_index_in_dim(state["ys"], idx,
+                                             keepdims=False)
+        d = state["d"] - y_old + g
+        ys = jax.lax.dynamic_update_index_in_dim(state["ys"], g, idx, 0)
+        n = jnp.minimum(step, bn).astype(jnp.float32)
+        new_p = p - (lr / n).astype(p.dtype) * d
+        return new_p, {"d": d, "ys": ys}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference ``paddle.optimizer.Rprop``):
+    per-ELEMENT step sizes grown/shrunk by gradient sign agreement;
+    magnitude of the gradient is ignored."""
+
+    _elementwise_update = True
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = (float(learning_rate_range[0]),
+                                      float(learning_rate_range[1]))
+        self._eta_n, self._eta_p = float(etas[0]), float(etas[1])
+        self._init_lr = float(learning_rate)
+
+    def _state_names(self):
+        return ["prev_grad", "learning_rate"]
+
+    def _init_state(self, p):
+        return {
+            "prev_grad": jnp.zeros_like(p._value),
+            "learning_rate": jnp.full_like(p._value, self._init_lr),
+        }
+
+    def _update_one(self, p, g, state, lr, step, extras=None):
+        sign = g * state["prev_grad"]
+        lr_e = jnp.where(
+            sign > 0,
+            jnp.minimum(state["learning_rate"] * self._eta_p, self._lr_max),
+            jnp.where(sign < 0,
+                      jnp.maximum(state["learning_rate"] * self._eta_n,
+                                  self._lr_min),
+                      state["learning_rate"]))
+        g_eff = jnp.where(sign < 0, jnp.zeros_like(g), g)
+        new_p = p - jnp.sign(g_eff).astype(p.dtype) * lr_e.astype(p.dtype)
+        return new_p, {"prev_grad": g_eff, "learning_rate": lr_e}

@@ -20,7 +20,7 @@ from ..ops.dispatch import run_op
 
 __all__ = ["nms", "box_iou", "roi_align", "roi_pool", "box_coder",
            "box_area", "prior_box", "yolo_box", "distribute_fpn_proposals",
-           "psroi_pool", "deform_conv2d"]
+           "psroi_pool", "deform_conv2d", "generate_proposals"]
 
 
 def box_area(boxes, name=None):
@@ -503,3 +503,92 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     if mask is not None:
         args.append(mask)
     return run_op("deform_conv2d", f, *args)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference phi generate_proposals_v2):
+    per image — decode anchor deltas, clip to the image, drop boxes
+    smaller than ``min_size``, keep the pre-NMS top-N by score, NMS, keep
+    the post-NMS top-N. HOST-side like the reference's CPU op: every
+    stage's survivor count is data-dependent, which has no static-shape
+    XLA form; serving pipelines run it between compiled stages.
+
+    scores [N, A, H, W]; bbox_deltas [N, 4*A, H, W]; img_size [N, 2]
+    (h, w); anchors [H, W, A, 4] or [H*W*A, 4]; variances same layout.
+    Returns (rois [R, 4], roi_probs [R, 1]) concatenated over the batch
+    (+ rois_num [N] when ``return_rois_num``).
+    """
+    from ..core.tensor import to_tensor
+
+    if eta != 1.0:
+        raise NotImplementedError(
+            "generate_proposals: adaptive-threshold NMS (eta < 1.0) is not "
+            "implemented — pass eta=1.0 (fixed nms_thresh)")
+    sv = np.asarray(scores.numpy() if isinstance(scores, Tensor) else scores,
+                    np.float32)
+    dv = np.asarray(bbox_deltas.numpy() if isinstance(bbox_deltas, Tensor)
+                    else bbox_deltas, np.float32)
+    iszv = np.asarray(img_size.numpy() if isinstance(img_size, Tensor)
+                      else img_size, np.float32)
+    av = np.asarray(anchors.numpy() if isinstance(anchors, Tensor)
+                    else anchors, np.float32).reshape(-1, 4)
+    varv = np.asarray(variances.numpy() if isinstance(variances, Tensor)
+                      else variances, np.float32).reshape(-1, 4)
+    N, A, H, W = sv.shape
+    off = 1.0 if pixel_offset else 0.0
+    # reference FilterBoxes clamps the size threshold to at least 1 px
+    min_size = max(float(min_size), 1.0)
+
+    all_rois, all_probs, nums = [], [], []
+    for n in range(N):
+        # [A,H,W] -> rows in (H, W, A) order matching the anchor layout
+        s = sv[n].transpose(1, 2, 0).reshape(-1)
+        d = dv[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        # reference order: pre-NMS top-N by RAW score BEFORE decoding
+        order = np.argsort(-s)[:int(pre_nms_top_n)]
+        s, d, a_sel, v_sel = s[order], d[order], av[order], varv[order]
+        aw = a_sel[:, 2] - a_sel[:, 0] + off
+        ah = a_sel[:, 3] - a_sel[:, 1] + off
+        acx = a_sel[:, 0] + 0.5 * aw
+        acy = a_sel[:, 1] + 0.5 * ah
+        dx, dy, dw, dh = (d[:, 0] * v_sel[:, 0], d[:, 1] * v_sel[:, 1],
+                          d[:, 2] * v_sel[:, 2], d[:, 3] * v_sel[:, 3])
+        cx = dx * aw + acx
+        cy = dy * ah + acy
+        # the reference clips exp inputs at log(1000/16)
+        bw = np.exp(np.minimum(dw, np.log(1000.0 / 16.0))) * aw
+        bh = np.exp(np.minimum(dh, np.log(1000.0 / 16.0))) * ah
+        boxes = np.stack([cx - 0.5 * bw, cy - 0.5 * bh,
+                          cx + 0.5 * bw - off, cy + 0.5 * bh - off], 1)
+        h_img, w_img = iszv[n, 0], iszv[n, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, w_img - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, h_img - off)
+        ws = boxes[:, 2] - boxes[:, 0] + off
+        hs = boxes[:, 3] - boxes[:, 1] + off
+        keep = (ws >= min_size) & (hs >= min_size)
+        if pixel_offset:
+            # reference: the box CENTER must lie inside the image
+            cxs = boxes[:, 0] + 0.5 * ws
+            cys = boxes[:, 1] + 0.5 * hs
+            keep &= (cxs <= w_img) & (cys <= h_img)
+        boxes, s = boxes[keep], s[keep]
+        if len(boxes):
+            kept = nms(to_tensor(boxes.astype(np.float32)),
+                       iou_threshold=nms_thresh,
+                       scores=to_tensor(s.astype(np.float32)))
+            ki = np.asarray(kept.numpy())[:int(post_nms_top_n)]
+            boxes, s = boxes[ki], s[ki]
+        all_rois.append(boxes)
+        all_probs.append(s[:, None])
+        nums.append(len(boxes))
+    rois = to_tensor(np.concatenate(all_rois, 0).astype(np.float32)
+                     if all_rois else np.zeros((0, 4), np.float32))
+    probs = to_tensor(np.concatenate(all_probs, 0).astype(np.float32)
+                      if all_probs else np.zeros((0, 1), np.float32))
+    if return_rois_num:
+        return rois, probs, to_tensor(np.asarray(nums, np.int32))
+    return rois, probs

@@ -328,3 +328,135 @@ class TestOnnxHonesty:
         assert out.endswith(".stablehlo")
         assert os.path.exists(out) or os.path.isdir(out) or \
             any(p.startswith("model") for p in os.listdir(tmp_path))
+
+
+class TestSecondRing:
+    """Pre-emptive closure of the next probe ring (r5 self-probe)."""
+
+    def test_cholesky_inverse(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 4).astype(np.float32)
+        A = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        L = np.linalg.cholesky(A)
+        got = paddle.linalg.cholesky_inverse(_t(L)).numpy()
+        np.testing.assert_allclose(got, np.linalg.inv(A), rtol=1e-3,
+                                   atol=1e-4)
+        U = L.T.copy()
+        got_u = paddle.linalg.cholesky_inverse(_t(U), upper=True).numpy()
+        np.testing.assert_allclose(got_u, np.linalg.inv(A), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_lu_solve(self):
+        rng = np.random.RandomState(1)
+        A = rng.randn(5, 5).astype(np.float32) + 5 * np.eye(5,
+                                                            dtype=np.float32)
+        b = rng.randn(5, 2).astype(np.float32)
+        lu, piv = paddle.linalg.lu(_t(A))
+        x = paddle.linalg.lu_solve(_t(b), lu, piv).numpy()
+        np.testing.assert_allclose(A @ x, b, rtol=1e-3, atol=1e-4)
+
+    def test_feature_alpha_dropout(self):
+        paddle.seed(0)
+        x = _t(np.random.RandomState(2).randn(4, 6, 5, 5).astype(np.float32))
+        m = nn.FeatureAlphaDropout(p=0.5)
+        m.train()
+        out = m(x).numpy()
+        # channel-wise: within one (sample, channel) map, the dropped-or-
+        # kept decision is uniform -> the map is either an affine copy of
+        # the input map or constant
+        a = out.reshape(4, 6, -1)
+        xin = x.numpy().reshape(4, 6, -1)
+        for i in range(4):
+            for c in range(6):
+                stds = np.std(a[i, c] - xin[i, c] * (a[i, c].std()
+                                                     / max(xin[i, c].std(),
+                                                           1e-6)))
+                ptp = np.ptp(a[i, c])
+                assert ptp < 1e-5 or np.corrcoef(
+                    a[i, c], xin[i, c])[0, 1] > 0.99, (i, c)
+        m.eval()
+        np.testing.assert_allclose(m(x).numpy(), x.numpy())
+
+    def test_asgd(self):
+        paddle.seed(0)
+        w = nn.Linear(4, 1, bias_attr=False)
+        opt = paddle.optimizer.ASGD(learning_rate=0.1, batch_num=2,
+                                    parameters=w.parameters())
+        x = _t(np.ones((2, 4), np.float32))
+        # two steps with constant grad g: step1 d=g, n=1 -> p -= .1*g
+        # step2 d=g+g=2g? no: d = d - ys[idx] + g; slots cycle
+        before = w.weight.numpy().copy()
+        loss = w(x).sum()
+        loss.backward()
+        g1 = w.weight.grad.numpy().copy()
+        opt.step()
+        after1 = w.weight.numpy()
+        np.testing.assert_allclose(after1, before - 0.1 * g1, rtol=1e-5)
+        opt.clear_grad()
+        loss = w(x).sum()
+        loss.backward()
+        g2 = w.weight.grad.numpy().copy()
+        opt.step()
+        after2 = w.weight.numpy()
+        # step2: d = g1 + g2, n = 2 -> p -= 0.1/2 * (g1+g2)
+        np.testing.assert_allclose(after2,
+                                   after1 - 0.05 * (g1 + g2), rtol=1e-5)
+
+    def test_rprop(self):
+        paddle.seed(0)
+        w = nn.Linear(3, 1, bias_attr=False)
+        opt = paddle.optimizer.Rprop(learning_rate=0.01,
+                                     learning_rate_range=(1e-4, 1.0),
+                                     parameters=w.parameters(),
+                                     etas=(0.5, 1.2))
+        x = _t(np.ones((2, 3), np.float32))
+        before = w.weight.numpy().copy()
+        w(x).sum().backward()
+        g = w.weight.grad.numpy()
+        opt.step()
+        # first step: prev=0 -> sign=0 -> lr unchanged, move by sign(g)*lr
+        np.testing.assert_allclose(w.weight.numpy(),
+                                   before - np.sign(g) * 0.01, rtol=1e-5)
+        opt.clear_grad()
+        w(x).sum().backward()
+        opt.step()
+        # same grad sign -> lr grows by eta_plus
+        np.testing.assert_allclose(
+            w.weight.numpy(),
+            before - np.sign(g) * 0.01 - np.sign(g) * 0.012, rtol=1e-4)
+
+    def test_generate_proposals(self):
+        from paddle_tpu.vision.ops import generate_proposals
+
+        rng = np.random.RandomState(3)
+        N, A, H, W = 1, 3, 4, 4
+        scores = rng.rand(N, A, H, W).astype(np.float32)
+        deltas = (rng.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+        anchors = np.zeros((H, W, A, 4), np.float32)
+        for y in range(H):
+            for x_ in range(W):
+                for a in range(A):
+                    cx, cy, s = x_ * 8 + 4, y * 8 + 4, 8 * (a + 1)
+                    anchors[y, x_, a] = [cx - s/2, cy - s/2,
+                                         cx + s/2, cy + s/2]
+        var = np.ones_like(anchors)
+        rois, probs, num = generate_proposals(
+            _t(scores), _t(deltas), _t(np.array([[32, 32]], np.float32)),
+            _t(anchors), _t(var), pre_nms_top_n=20, post_nms_top_n=5,
+            nms_thresh=0.7, min_size=1.0, return_rois_num=True)
+        r = rois.numpy()
+        assert r.shape[1] == 4 and 1 <= r.shape[0] <= 5
+        assert int(num.numpy()[0]) == r.shape[0]
+        assert (r[:, 0] >= 0).all() and (r[:, 2] <= 32).all()
+        assert (r[:, 2] > r[:, 0]).all() and (r[:, 3] > r[:, 1]).all()
+        p = probs.numpy().ravel()
+        assert (np.diff(p) <= 1e-6).all()  # sorted by score desc
+
+    def test_tensor_coalesce(self):
+        with pytest.raises(ValueError, match="sparse"):
+            _t(np.ones(3, np.float32)).coalesce()
+        sp = paddle.sparse.sparse_coo_tensor(
+            _t(np.array([[0, 0, 1]])), _t(np.array([1., 2., 3.],
+                                                   np.float32)), (3,))
+        c = sp.coalesce()
+        assert c.is_coalesced()
