@@ -87,14 +87,12 @@ def main():
     print(f"devices: {jax.devices()}", flush=True)
     print("| shape | M | K | N | out | ms | TF/s | frac of peak |")
     print("|---|---|---|---|---|---|---|---|")
-    rows = []
     for tag, m, k, n, dt in shapes:
         per, frac = bench_shape(rng, m, k, n, dt, iters)
         name = jnp.dtype(dt).name
         print(f"| {tag.strip()} | {m} | {k} | {n} | {name} | "
               f"{per*1e3:.3f} | {2.0*m*n*k/per/1e12:.1f} | {frac:.1%} |",
               flush=True)
-        rows.append((tag, m, k, n, name, per, frac))
         # for fp32-output dW shapes, also time the bf16-output variant to
         # split "fp32 HBM write cost" out of any observed deficit
         if dt == jnp.float32:

@@ -34,6 +34,7 @@ so engine output is bit-comparable to the dense path request-by-request.
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -104,16 +105,12 @@ class ServingEngine:
                 f"exceeds cache max_len {self.max_len}")
         rid = self._next_rid
         self._next_rid += 1
-        import time as _time
-
         self._queue.append(Request(rid, prompt, int(max_new_tokens),
-                                   submit_time=_time.perf_counter()))
+                                   submit_time=time.perf_counter()))
         return rid
 
     def _retire(self, r: Request) -> None:
-        import time as _time
-
-        r.finish_time = _time.perf_counter()
+        r.finish_time = time.perf_counter()
         self._finished.append(r)
 
     # --- compiled programs ------------------------------------------------
@@ -398,8 +395,6 @@ class ServingEngine:
         return p
 
     def _run_fused(self) -> Dict[int, List[int]]:
-        import time as _time
-
         self._queue.sort(key=lambda r: -r.max_new_tokens)
         picked, self._queue = self._queue, []
         n = len(picked)
@@ -413,13 +408,13 @@ class ServingEngine:
             prompts[j, :len(r.prompt)] = r.prompt
             lens[j] = len(r.prompt)
             gens[j] = r.max_new_tokens
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         self._cache, out, fin, steps, ndec = self._drain_prog(
             n_pad, p_max, g_max)(
                 self.params, self._cache, jnp.asarray(prompts),
                 jnp.asarray(lens), jnp.asarray(gens), jnp.int32(n))
         out, fin, steps, ndec = jax.device_get([out, fin, steps, ndec])
-        wall = _time.perf_counter() - t0
+        wall = time.perf_counter() - t0
         self.last_run_ticks = int(ndec)
         self.last_run_chunks = -(-int(ndec) // self.chunk)
         per_step = wall / max(int(steps), 1)

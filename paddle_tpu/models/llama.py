@@ -513,8 +513,9 @@ def _head_ce_tail_bwd(res, gs):
     from ..ops.pallas.flash_attention import _on_tpu
     from ..ops.pallas.head_dx import head_dx_softmax
 
-    use_kernel = (_on_tpu() and
-                  flags.get_flags("use_pallas_kernels")["use_pallas_kernels"])
+    use_kernel = (
+        _on_tpu()
+        and flags.get_flags("use_pallas_kernels")["use_pallas_kernels"])
     if use_kernel:
         dh_soft = head_dx_softmax(lf, mf, gw / sef, Wd.T)
     else:

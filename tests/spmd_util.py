@@ -1,0 +1,38 @@
+"""Shared helper for the multi-process SPMD launch tests: the
+single-process reference computation that the launcher-spawned workers'
+loss must match (same tiny llama step on this pytest process's own
+virtual devices)."""
+
+import numpy as np
+
+
+def single_process_llama_loss(dp, mp, batch=4, seq=64, seed=0, lr=1e-3):
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.parallel import create_hybrid_mesh, host_to_global, set_mesh
+
+    mesh = create_hybrid_mesh(dp=dp, mp=mp)
+    try:
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg)
+        opt = llama.init_opt_state(params)
+        ps = llama.param_specs(cfg)
+        os_ = llama.opt_state_specs(cfg)
+        gp = {k: host_to_global(np.asarray(v), ps[k], mesh)
+              for k, v in params.items()}
+        go = {
+            "step": host_to_global(np.asarray(opt["step"]), P(), mesh),
+            "m": {k: host_to_global(np.asarray(v), os_[k], mesh)
+                  for k, v in opt["m"].items()},
+            "v": {k: host_to_global(np.asarray(v), os_[k], mesh)
+                  for k, v in opt["v"].items()},
+        }
+        tokens = np.random.RandomState(seed).randint(
+            0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        gtok = host_to_global(tokens, P(("dp", "sharding"), None), mesh)
+        step = llama.make_sharded_train_step(cfg, mesh, lr=lr)
+        _, _, loss = step(gp, go, gtok, gtok)
+        return float(np.asarray(loss))
+    finally:
+        set_mesh(None)

@@ -155,39 +155,9 @@ class TestFourProcessSPMD:
         assert m, text0[-3000:]
         loss_mp = float(m.group(1))
 
-        from jax.sharding import PartitionSpec as P
+        from spmd_util import single_process_llama_loss
 
-        from paddle_tpu.models import llama
-        from paddle_tpu.parallel import (
-            create_hybrid_mesh,
-            host_to_global,
-            set_mesh,
-        )
-
-        mesh = create_hybrid_mesh(dp=4, mp=2)
-        try:
-            cfg = llama.LlamaConfig.tiny()
-            params = llama.init_params(cfg)
-            opt = llama.init_opt_state(params)
-            ps = llama.param_specs(cfg)
-            os_ = llama.opt_state_specs(cfg)
-            gp = {k: host_to_global(np.asarray(v), ps[k], mesh)
-                  for k, v in params.items()}
-            go = {
-                "step": host_to_global(np.asarray(opt["step"]), P(), mesh),
-                "m": {k: host_to_global(np.asarray(v), os_[k], mesh)
-                      for k, v in opt["m"].items()},
-                "v": {k: host_to_global(np.asarray(v), os_[k], mesh)
-                      for k, v in opt["v"].items()},
-            }
-            tokens = np.random.RandomState(0).randint(
-                0, cfg.vocab_size, (4, 64)).astype(np.int32)
-            gtok = host_to_global(tokens, P(("dp", "sharding"), None), mesh)
-            step = llama.make_sharded_train_step(cfg, mesh, lr=1e-3)
-            _, _, loss = step(gp, go, gtok, gtok)
-            loss_sp = float(np.asarray(loss))
-        finally:
-            set_mesh(None)
+        loss_sp = single_process_llama_loss(dp=4, mp=2)
         np.testing.assert_allclose(loss_mp, loss_sp, rtol=2e-5)
 
 
