@@ -64,14 +64,19 @@ def head_dx_softmax(logits, m, scale, wt, bm: int = 1408, bk: int = 512):
     """
     M, V = logits.shape
     H = wt.shape[1]
-    # clamp blocks to the problem; on shapes the blocked kernel can't
-    # tile cleanly (tiny V, non-divisible V) use the XLA formulation —
-    # an empty grid dim (e.g. V < bk) would silently never write out
-    bm = min(bm, max(8, -(-M // 8) * 8))
+    # pick the largest candidate bm that DIVIDES M: a ragged M makes
+    # pallas materialise a padded copy of the whole logits tensor
+    # (measured 6.7 ms at the bench shape), which costs more than any
+    # block-size preference. Candidates stay within the VMEM budget
+    # (acc bm x H fp32 + double-buffered tiles < 16 MB at H<=1024).
+    bm = next((b for b in (bm, 1024, 512, 256, 128) if M % b == 0), bm)
     bk = min(bk, V)
     while bk > 8 and V % bk:
         bk //= 2
-    if V % bk or bm % 8 or bk % 128:
+    if M % bm or V % bk or bm % 8 or bk % 128:
+        # shapes the blocked kernel can't tile cleanly (tiny/ragged M or
+        # V) take the XLA formulation — an empty grid dim (e.g. V < bk)
+        # would silently never write out, and a ragged M would pad-copy
         p = jnp.exp(logits.astype(jnp.float32)
                     - m[:, None]) * scale[:, None]
         return (p.astype(logits.dtype) @ wt).astype(logits.dtype)
