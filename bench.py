@@ -54,9 +54,15 @@ def run(batch: int, seq: int):
     params, opt_state, loss = step(params, opt_state, tokens, tokens)
     log(f"warmup loss {float(loss):.4f}; params {n_params/1e6:.1f}M")
 
-    iters = 20
+    # 40-step chains: each timing block ends in ONE blocking scalar fetch
+    # whose ~30-60 ms tunnel round trip rides inside the measurement —
+    # at 20 iters that contaminated the per-step number by 1.5-3 ms
+    # (r5: 148.3k -> 151.6k tok/s from amortizing it alone). best-of-4
+    # also gives the varying per-block dispatch overhead a shot at a
+    # quiet window.
+    iters = 40
     best_dt = None
-    for _ in range(3):
+    for _ in range(4):
         t0 = time.perf_counter()
         for _ in range(iters):
             params, opt_state, loss = step(params, opt_state, tokens, tokens)
