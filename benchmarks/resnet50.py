@@ -37,7 +37,7 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def run(batch=128, size=224, iters=20, host_input=False):
+def run(batch=128, size=224, iters=40, host_input=False):
     import jax
 
     import paddle_tpu as paddle
