@@ -26,7 +26,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["ring_attention", "RingFlashAttention", "context_parallel_attention"]
+__all__ = ["ring_attention", "RingFlashAttention",
+           "context_parallel_attention", "ulysses_attention",
+           "ulysses_parallel_attention"]
 
 
 def _chunk_attention(q, k, v, scale, q_offset, k_offset, is_causal):
@@ -127,19 +129,13 @@ def ring_attention(q, k, v, axis_name: str = "sep", is_causal: bool = False,
 RingFlashAttention = ring_attention
 
 
-def context_parallel_attention(q, k, v, mesh=None, axis_name: str = "sep",
-                               is_causal: bool = False, batch_axes=None,
-                               head_axes=None, fallback=None):
-    """GSPMD-level entry: q/k/v are *global* arrays; shard the seq dim over
-    ``axis_name`` and run ring attention under shard_map. Falls back
-    (``fallback()`` if given, else the XLA formulation) when the axis has
-    size 1 / no mesh, or when any sharded dim doesn't divide its axes.
-
-    ``batch_axes``/``head_axes`` name the mesh axes the batch and head
-    dims are already sharded over (e.g. ('dp', 'sharding') and 'mp' in the
-    hybrid llama layout) so the shard_map specs match the surrounding
-    GSPMD sharding — those axes stay pure data parallelism inside the
-    ring."""
+def _sp_gspmd_entry(local_fn, q, k, v, mesh, axis_name, is_causal,
+                    batch_axes, head_axes, fallback,
+                    needs_head_divisible=False):
+    """Shared GSPMD prologue for the sequence-parallel attention entries:
+    resolve the mesh, validate that EVERY operand's sharded dims divide
+    their axes (else take the fallback), and run ``local_fn`` under
+    shard_map with matching PartitionSpecs."""
     from jax.sharding import PartitionSpec as P
 
     from ...parallel.mesh import get_mesh
@@ -165,16 +161,93 @@ def context_parallel_attention(q, k, v, mesh=None, axis_name: str = "sep",
     baxes, haxes = _present(batch_axes), _present(head_axes)
     b_size = int(np.prod([mesh.shape[a] for a in (baxes or ())]))
     h_size = int(np.prod([mesh.shape[a] for a in (haxes or ())]))
-    if (q.shape[1] % mesh.shape[axis_name]
-            or q.shape[0] % b_size
-            or q.shape[2] % h_size):
-        return fall_back()
+    n = mesh.shape[axis_name]
+    for x in (q, k, v):
+        if x.shape[1] % n or x.shape[0] % b_size or x.shape[2] % h_size:
+            return fall_back()
+        if needs_head_divisible and (x.shape[2] // max(h_size, 1)) % n:
+            return fall_back()
 
     spec = P(baxes, axis_name, haxes, None)
     fn = jax.shard_map(
-        functools.partial(ring_attention, axis_name=axis_name,
+        functools.partial(local_fn, axis_name=axis_name,
                           is_causal=is_causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )
     return fn(q, k, v)
+
+
+def context_parallel_attention(q, k, v, mesh=None, axis_name: str = "sep",
+                               is_causal: bool = False, batch_axes=None,
+                               head_axes=None, fallback=None):
+    """GSPMD-level entry: q/k/v are *global* arrays; shard the seq dim over
+    ``axis_name`` and run ring attention under shard_map. Falls back
+    (``fallback()`` if given, else the XLA formulation) when the axis has
+    size 1 / no mesh, or when any sharded dim doesn't divide its axes.
+
+    ``batch_axes``/``head_axes`` name the mesh axes the batch and head
+    dims are already sharded over (e.g. ('dp', 'sharding') and 'mp' in the
+    hybrid llama layout) so the shard_map specs match the surrounding
+    GSPMD sharding — those axes stay pure data parallelism inside the
+    ring."""
+    return _sp_gspmd_entry(ring_attention, q, k, v, mesh, axis_name,
+                           is_causal, batch_axes, head_axes, fallback)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sep",
+                      is_causal: bool = False,
+                      scale: Optional[float] = None):
+    """Ulysses-style sequence parallelism (reference: PaddleNLP/DeepSpeed
+    "Ulysses" SP; SURVEY §5.7 [LOW] row): instead of ring-passing K/V
+    chunks, ALL-TO-ALL reshards seq-parallel activations into
+    head-parallel ones — each rank then holds the FULL sequence for a
+    1/n subset of heads, computes ordinary (exact) attention, and an
+    inverse all-to-all restores the seq-parallel layout.
+
+    Call inside shard_map with q/k/v [B, S/n, H, D] seq-sharded over
+    ``axis_name``; H must divide by the axis size. vs ring attention:
+    2 all-to-alls of the activations instead of (n-1) K/V permutes —
+    cheaper when 2·|q| < (n-1)·|kv| (e.g. GQA with few KV heads favours
+    the ring; MHA at moderate n favours Ulysses) — the same trade the
+    reference documents between its two SP implementations.
+    """
+    from .flash_attention import _xla_attention
+
+    n = jax.lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(f"ulysses_attention: head count {h} must be "
+                         f"divisible by the '{axis_name}' axis size {n}")
+
+    def seq_to_heads(x):
+        # [B, S/n, H, D] -> [B, S, H/n, D]: head-split piece r goes to
+        # rank r; received seq chunks concatenate in source-rank order,
+        # i.e. global sequence order (tiled all_to_all does both in one
+        # collective, and is its own well-defined transpose for autodiff)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        # inverse: [B, S, H/n, D] -> [B, S/n, H, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    # full sequence per rank: plain exact attention (global positions are
+    # just 0..S-1, so causal masking needs no cross-rank offsets)
+    out = _xla_attention(qh, kh, vh, is_causal=is_causal, scale=scale)
+    return heads_to_seq(out)  # _xla_attention already emits q.dtype
+
+
+def ulysses_parallel_attention(q, k, v, mesh=None, axis_name: str = "sep",
+                               is_causal: bool = False, batch_axes=None,
+                               head_axes=None, fallback=None):
+    """GSPMD-level Ulysses entry, mirroring ``context_parallel_attention``:
+    q/k/v are global arrays; seq shards over ``axis_name`` and the
+    all-to-all resharding runs under shard_map. Falls back when the axis
+    is absent/size-1 or shapes (incl. per-shard head count % axis) don't
+    divide."""
+    return _sp_gspmd_entry(ulysses_attention, q, k, v, mesh, axis_name,
+                           is_causal, batch_axes, head_axes, fallback,
+                           needs_head_divisible=True)

@@ -140,3 +140,75 @@ class TestIncubateFused:
         q = paddle.to_tensor(rng.randn(1, 16, 2, 8).astype("float32"))
         out, _ = flash_attention(q, q, q, causal=True)
         assert list(out.shape) == [1, 16, 2, 8]
+
+
+class TestUlyssesAttention:
+    """Ulysses SP (SURVEY §5.7 [LOW] row, closed in r5): all-to-all
+    seq->head resharding + exact full-sequence attention per head shard
+    must equal full attention, values and grads."""
+
+    @pytest.fixture
+    def sep_mesh(self):
+        mesh = create_hybrid_mesh(sep=8)
+        yield mesh
+        set_mesh(None)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_parity_vs_full_attention(self, sep_mesh, causal):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.flash_attention import _xla_attention
+        from paddle_tpu.ops.pallas.ring_attention import (
+            ulysses_parallel_attention,
+        )
+
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(2, 64, 8, 16), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 64, 8, 16), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 64, 8, 16), jnp.float32)
+        out = ulysses_parallel_attention(q, k, v, is_causal=causal)
+        ref = _xla_attention(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_grad_parity(self, sep_mesh):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.flash_attention import _xla_attention
+        from paddle_tpu.ops.pallas.ring_attention import (
+            ulysses_parallel_attention,
+        )
+
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(1, 32, 8, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(1, 32, 8, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(1, 32, 8, 8), jnp.float32)
+        g1 = jax.grad(lambda *a: jnp.sum(
+            ulysses_parallel_attention(*a, is_causal=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: jnp.sum(
+            _xla_attention(*a, is_causal=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_head_indivisible_falls_back(self, sep_mesh):
+        """heads % axis_size != 0 must fall back to full attention, not
+        produce a wrong-shaped or silently-sharded result."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.flash_attention import _xla_attention
+        from paddle_tpu.ops.pallas.ring_attention import (
+            ulysses_parallel_attention,
+        )
+
+        rng = np.random.RandomState(2)
+        q = jnp.asarray(rng.randn(2, 64, 3, 8), jnp.float32)  # 3 heads
+        k = jnp.asarray(rng.randn(2, 64, 3, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 64, 3, 8), jnp.float32)
+        out = ulysses_parallel_attention(q, k, v, is_causal=True)
+        ref = _xla_attention(q, k, v, is_causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
