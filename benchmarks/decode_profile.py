@@ -1,7 +1,21 @@
 """Per-instruction profile of the DECODE tick (the generate() scan body) —
 where does the gap between the measured ms/token and the HBM roofline go?
 
-Usage: python benchmarks/decode_profile.py [batch] [top_n]
+Usage:
+  python benchmarks/decode_profile.py [batch] [top_n]   on-chip xplane profile
+  python benchmarks/decode_profile.py --smoke           CPU-safe regression gate
+  python benchmarks/decode_profile.py --bytes           ragged-vs-dense KV bytes
+
+On-chip, run twice with FLAGS_use_ragged_decode / FLAGS_use_tick_fusion
+flipped to get the before/after per-tick op table the r6 ledger cites.
+
+``--smoke`` is the serving-lane hook (tests/test_serving.py): it forces
+the Pallas decode kernels through the interpreter on CPU and asserts
+(1) the ragged kernel is SELECTED for the serving decode shape,
+(2) the fused tick epilogue REDUCES the traced per-tick op count,
+(3) fused and dense ticks agree numerically,
+(4) per-slot KV blocks fetched scale with pos, not max_len —
+so a regression in kernel selection or dispatch fails loudly off-chip.
 """
 import os
 import sys
@@ -12,6 +26,123 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _count_ops(jaxpr) -> int:
+    """Traced ops incl. nested jaxprs (scan/cond/custom_jvp bodies), but
+    NOT inside pallas_call — a kernel is ONE launch regardless of its
+    internal math, which is the whole point of the fusion."""
+    n = 0
+    for eqn in jaxpr.eqns:
+        n += 1
+        if eqn.primitive.name == "pallas_call":
+            continue
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else (v,)
+            for sub in vs:
+                inner = getattr(sub, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    n += _count_ops(inner)
+                elif hasattr(sub, "eqns"):
+                    n += _count_ops(sub)
+    return n
+
+
+def _tick_jaxpr(cfg, params, batch, max_len):
+    """Jaxpr of ONE ragged decode tick (the serving engine's step)."""
+    from paddle_tpu.models import llama
+
+    cache = llama.init_kv_cache(cfg, batch, max_len)
+    nxt = jnp.zeros((batch, 1), jnp.int32)
+    posv = jnp.arange(batch, dtype=jnp.int32) * 7 % max_len
+
+    def tick(params, cache, nxt, posv):
+        return llama.forward_with_cache(params, nxt, cfg, cache, posv)
+
+    return jax.make_jaxpr(tick)(params, cache, nxt, posv)
+
+
+def smoke() -> dict:
+    """CPU-safe kernel-selection + op-count gate; returns the evidence
+    dict (also printed when run from the CLI)."""
+    import dataclasses
+
+    import paddle_tpu.ops.pallas.decode_attention as da
+    import paddle_tpu.ops.pallas.tick_fusion as tf
+    from paddle_tpu.models import llama
+    from paddle_tpu.parallel import set_mesh
+
+    set_mesh(None)
+    cfg = llama.LlamaConfig(
+        vocab_size=128, hidden_size=256, intermediate_size=512,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=256,
+        dtype=jnp.float32, remat=False, scan_layers=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    batch, max_len = 4, 256
+    cache = llama.init_kv_cache(cfg, batch, max_len)
+    nxt = jnp.array([[3], [5], [7], [11]], jnp.int32)
+    posv = jnp.array([0, 17, 130, 255], jnp.int32)
+
+    force_prev = (da.FORCE_INTERPRET, tf.FORCE_INTERPRET)
+    try:
+        # dense baseline: kernels off
+        da.FORCE_INTERPRET = tf.FORCE_INTERPRET = False
+        cfg_off = dataclasses.replace(cfg, fused_tick_epilogue=False)
+        ops_dense = _count_ops(_tick_jaxpr(cfg_off, params, batch,
+                                           max_len).jaxpr)
+        ref, _ = llama.forward_with_cache(params, nxt, cfg_off, cache, posv)
+
+        # fused path, kernels forced through the interpreter
+        da.FORCE_INTERPRET = tf.FORCE_INTERPRET = True
+        assert da.decode_attention_active(
+            max_len, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim), \
+            "ragged decode kernel NOT selectable for the serving shape"
+        da.reset_selection_count()
+        ops_fused = _count_ops(_tick_jaxpr(cfg, params, batch,
+                                           max_len).jaxpr)
+        assert da.selection_count() >= 1, \
+            "ragged decode kernel was not selected for the decode tick"
+        assert ops_fused < ops_dense, (
+            f"fused tick must trace fewer ops: {ops_fused} vs {ops_dense}")
+        out, _ = llama.forward_with_cache(params, nxt, cfg, cache, posv)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=1e-5)
+    finally:
+        da.FORCE_INTERPRET, tf.FORCE_INTERPRET = force_prev
+
+    # (4) analytic bytes contract enforced by the BlockSpec clamp
+    blk = da.pick_kv_block(max_len)
+    rows = {int(p): int(da.kv_blocks_read(int(p), blk)) * blk
+            for p in posv}
+    for p, r in rows.items():
+        assert r == ((p // blk) + 1) * blk <= max_len
+    assert rows[0] == blk < max_len == rows[255], rows
+    return {"ops_dense": ops_dense, "ops_fused": ops_fused,
+            "block_k": blk, "kv_rows_read": rows, "kv_rows_dense": max_len}
+
+
+def bytes_table(batch=8, max_len=512):
+    """Per-slot KV rows/bytes read per tick: ragged kernel vs the dense
+    max_len window, at the serving cache shape (the (a) evidence of the
+    r6 acceptance bar; the BlockSpec clamp in decode_attention.py is
+    what enforces the ragged column on-chip)."""
+    from paddle_tpu.models import llama
+    from paddle_tpu.ops.pallas import decode_attention as da
+
+    cfg = llama.LlamaConfig.bert_base_equiv(max_seq_len=max_len)
+    blk = da.pick_kv_block(max_len)
+    row_bytes = 2 * cfg.num_kv_heads * cfg.head_dim * 2  # K+V bf16
+    print(f"kv block {blk} rows; per-row K+V bytes {row_bytes}; "
+          f"L={cfg.num_layers}")
+    print("| pos | ragged rows | dense rows | ragged MB/tick | "
+          "dense MB/tick | ratio |")
+    print("|---|---|---|---|---|---|")
+    for pos in (0, 63, 64, 128, 200, 256, 511):
+        rr = int(da.kv_blocks_read(pos, blk)) * blk
+        rb = rr * row_bytes * cfg.num_layers * batch / 1e6
+        db = max_len * row_bytes * cfg.num_layers * batch / 1e6
+        print(f"| {pos} | {rr} | {max_len} | {rb:.1f} | {db:.1f} | "
+              f"{max_len / rr:.2f}x |")
 
 
 def main():
@@ -44,4 +175,10 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--smoke" in sys.argv:
+        print(smoke())
+        print("decode smoke OK")
+    elif "--bytes" in sys.argv:
+        bytes_table()
+    else:
+        main()

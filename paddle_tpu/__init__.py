@@ -58,7 +58,8 @@ from .ops import *  # noqa: F401,F403
 from .ops import creation, linalg, logic, manipulation, math, reduction  # noqa: F401
 from .ops.registry import all_ops
 
-from .framework.random import get_rng_state, seed, set_rng_state
+from .framework.random import (get_cuda_rng_state, get_rng_state, seed,
+                               set_cuda_rng_state, set_rng_state)
 from .framework.io import load, save
 
 from . import _C_ops  # noqa: F401

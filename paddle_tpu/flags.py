@@ -100,4 +100,6 @@ define_flag("cudnn_deterministic", False, "Deterministic kernel selection (XLA d
 define_flag("eager_delete_tensor_gb", 0.0, "Compat: GC threshold; XLA manages memory so this is advisory.", float)
 define_flag("allocator_strategy", "auto_growth", "Compat: allocator strategy name (XLA owns allocation).", str)
 define_flag("use_pallas_kernels", True, "Use Pallas TPU kernels for fused ops when on TPU.", bool)
+define_flag("use_ragged_decode", True, "Decode attention reads only KV rows [0, pos) per slot (Pallas ragged kernel) instead of the full max_len window.", bool)
+define_flag("use_tick_fusion", True, "Fuse the decode tick's between-matmul small-op chains (rms/rope/residual) into single Pallas ops.", bool)
 define_flag("log_level", "WARNING", "Python logging level for paddle_tpu.", str)

@@ -1,2 +1,3 @@
 from . import random
-from .random import get_rng_state, seed, set_rng_state
+from .random import (get_cuda_rng_state, get_rng_state, seed,
+                     set_cuda_rng_state, set_rng_state)

@@ -15,7 +15,8 @@ from typing import Any, List
 
 import jax
 
-__all__ = ["seed", "get_rng_state", "set_rng_state", "next_key", "fold_in"]
+__all__ = ["seed", "get_rng_state", "set_rng_state", "next_key", "fold_in",
+           "get_cuda_rng_state", "set_cuda_rng_state"]
 
 _lock = threading.Lock()
 # key is created LAZILY: materialising it at import would initialise the
@@ -63,6 +64,26 @@ def get_rng_state() -> Any:
 def set_rng_state(key: Any) -> None:
     with _lock:
         _state["key"] = key
+
+
+def get_cuda_rng_state() -> List[Any]:
+    """``paddle.get_cuda_rng_state`` alias: the reference returns one
+    generator state PER accelerator device; here every device shares the
+    one functional key, returned once per visible device so round-trips
+    through ``set_cuda_rng_state`` keep the reference's list shape."""
+    import jax as _jax
+
+    state = get_rng_state()
+    return [state for _ in _jax.devices()]
+
+
+def set_cuda_rng_state(states: List[Any]) -> None:
+    """Inverse of ``get_cuda_rng_state`` (list-of-states convention)."""
+    if isinstance(states, (list, tuple)):
+        if not states:
+            raise ValueError("set_cuda_rng_state: empty state list")
+        states = states[0]
+    set_rng_state(states)
 
 
 import threading as _threading

@@ -27,7 +27,13 @@ batch stays full — that scheduling idea, TPU-native:
   [0, max_len) of the shared [L, slots, max_len, H, D] cache. Paging adds
   an indirection XLA can't fuse well; at serving's typical length spread
   the ragged layout wins on TPU (documented trade-off vs the reference's
-  paged pools).
+  paged pools). r6: the decode tick's attention READS are ragged too —
+  the Pallas kernel (`ops/pallas/decode_attention.py`) fetches only KV
+  blocks [0, pos] per slot instead of the full max_len window, and the
+  tick's between-matmul small-op chains run as fused Pallas epilogue
+  ops (`ops/pallas/tick_fusion.py`); both dispatch inside
+  ``llama.forward_with_cache`` so every path here (windowed chunks and
+  the fused drain's decode branch) picks them up (SCALING.md §3c).
 
 Greedy decoding (temperature 0) — matching ``llama.generate``'s default —
 so engine output is bit-comparable to the dense path request-by-request.
@@ -94,6 +100,17 @@ class ServingEngine:
         self._pos = jnp.zeros((self.slots,), jnp.int32)
         self._nxt = jnp.zeros((self.slots,), jnp.int32)
         self._rem = jnp.zeros((self.slots,), jnp.int32)
+
+    def decode_kernel_active(self) -> bool:
+        """True when this engine's decode ticks route to the ragged
+        Pallas decode-attention kernel (a trace-time dispatch decision —
+        the serving lane's smoke gate asserts it so a selection
+        regression fails off-chip)."""
+        from ..ops.pallas.decode_attention import decode_attention_active
+
+        return decode_attention_active(self.max_len, self.cfg.num_heads,
+                                       self.cfg.num_kv_heads,
+                                       self.cfg.head_dim)
 
     # --- request intake ---------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int) -> int:

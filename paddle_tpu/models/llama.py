@@ -90,6 +90,14 @@ class LlamaConfig:
     # touched by the optimizer. Matches the reference's O2 GradScaler
     # contract (fp16/bf16 grads + fp32 master params).
     bf16_grads: bool = False
+    # decode-tick fusion: on the KV-cache single-token path, collapse the
+    # between-matmul small-op chains (rms, rope, residual+norm) into one
+    # Pallas op each and run attention as the RAGGED kernel that reads
+    # only KV rows [0, pos] per slot instead of the full max_len window
+    # (ops/pallas/decode_attention.py, tick_fusion.py). Dispatch falls
+    # back to the inline jnp chains off-TPU / under a mesh / on
+    # non-tileable shapes — identical math either way.
+    fused_tick_epilogue: bool = True
     # custom-VJP head+CE tail (single-chip, non-chunked path only): the
     # backward picks each dot's MXU orientation independently — dx runs
     # as (W @ dlogits^T)^T, the wide-N transpose formulation a bare-dot
@@ -746,8 +754,24 @@ def _cache_attention(cfg: LlamaConfig, q, kc, vc, positions):
     GQA contracts via a grouped einsum (q reshaped [B,T,Hkv,rep,D]) —
     the repeated cache is never materialised. Keys j > token position are
     masked (covers both causality and the unwritten cache tail).
-    ``positions``: [T] shared, or [B, T] ragged (per-slot decode)."""
+    ``positions``: [T] shared, or [B, T] ragged (per-slot decode).
+
+    Single-token decode (T=1) dispatches to the RAGGED Pallas kernel when
+    shapes tile: each slot reads only ceil((pos+1)/block) KV blocks from
+    HBM instead of the full static max_len window — the dense einsum
+    below streams max_len rows per slot regardless of position, which at
+    serving shapes is most of the tick's non-weight HBM traffic."""
     B, T, nH, D = q.shape
+    if T == 1:
+        from ..ops.pallas.decode_attention import (
+            decode_attention_active, ragged_decode_attention)
+
+        if decode_attention_active(kc.shape[1], cfg.num_heads,
+                                   cfg.num_kv_heads, cfg.head_dim):
+            pos_b = jnp.broadcast_to(
+                jnp.reshape(jnp.asarray(positions)[..., 0], (-1,)),
+                (B,)).astype(jnp.int32)
+            return ragged_decode_attention(q[:, 0], kc, vc, pos_b)[:, None]
     Smax = kc.shape[1]
     rep = cfg.num_heads // cfg.num_kv_heads
     dt = q.dtype
@@ -763,6 +787,64 @@ def _cache_attention(cfg: LlamaConfig, q, kc, vc, positions):
     attn = jnp.einsum("bhrts,bshd->bthrd", probs.astype(dt), vc,
                       preferred_element_type=jnp.float32).astype(dt)
     return attn.reshape(B, T, nH, D)
+
+
+def _tick_fused_active(cfg: LlamaConfig) -> bool:
+    """Does this decode tick use the fused Pallas epilogue kernels?"""
+    if not cfg.fused_tick_epilogue:
+        return False
+    from ..ops.pallas.tick_fusion import tick_fusion_active
+
+    return (tick_fusion_active(cfg.hidden_size)
+            and cfg.head_dim % 8 == 0 and cfg.head_dim % 2 == 0)
+
+
+def _decode_qkv(cfg: LlamaConfig, x, lp, pos_b):
+    """T=1 fused-tick variant of ``_qkv_proj``: the rmsnorm chain is one
+    Pallas op and the q/k rope chains (cos/sin/slice/concat per head,
+    twice) collapse into one shared-cos/sin kernel. Same math — the
+    projections themselves stay XLA dots (they carry the weight stream
+    the tick is roofline-bound on)."""
+    from ..ops.pallas.tick_fusion import fused_rms_norm, fused_rope_qk
+
+    B = x.shape[0]
+    dt = x.dtype
+    h = fused_rms_norm(x[:, 0], lp["ln_attn"], cfg.rms_eps)
+    Hq = cfg.num_heads * cfg.head_dim
+    Hkv = cfg.num_kv_heads * cfg.head_dim
+    if cfg.fused_weights:
+        z = h @ lp["wqkv"].astype(dt)
+        zq, zk, zv = (z[..., :Hq], z[..., Hq:Hq + Hkv], z[..., Hq + Hkv:])
+    else:
+        zq = h @ lp["wq"].astype(dt)
+        zk = h @ lp["wk"].astype(dt)
+        zv = h @ lp["wv"].astype(dt)
+    zq, zk = fused_rope_qk(zq, zk, pos_b, cfg.head_dim, cfg.rope_theta)
+    q = zq.reshape(B, 1, cfg.num_heads, cfg.head_dim)
+    k = zk.reshape(B, 1, cfg.num_kv_heads, cfg.head_dim)
+    v = zv.reshape(B, 1, cfg.num_kv_heads, cfg.head_dim)
+    return q, k, v
+
+
+def _decode_post(cfg: LlamaConfig, x, attn, lp):
+    """T=1 fused-tick variant of ``_layer_post``: the attention-residual
+    add and the mlp pre-norm are ONE kernel emitting both the new
+    residual stream and the normed value (single-device path — no wsc)."""
+    from ..ops.pallas.tick_fusion import fused_add_rms_norm
+
+    B, _, H = x.shape
+    dt = x.dtype
+    o = attn.reshape(B, H) @ lp["wo"].astype(dt)
+    x2, h = fused_add_rms_norm(x[:, 0], o, lp["ln_mlp"], cfg.rms_eps)
+    if cfg.fused_weights:
+        F_ = cfg.intermediate_size
+        zz = h @ lp["w_gate_up"].astype(dt)
+        zg, up = zz[..., :F_], zz[..., F_:]
+    else:
+        zg = h @ lp["w_gate"].astype(dt)
+        up = h @ lp["w_up"].astype(dt)
+    x3 = x2 + (jax.nn.silu(zg) * up) @ lp["w_down"].astype(dt)
+    return x3[:, None]
 
 
 def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos,
@@ -785,9 +867,26 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos,
     positions = pos[:, None] if ragged else pos + jnp.arange(T)
     layer_weights = {kk: params[kk] for kk in layer_keys(cfg)}
 
+    # fused tick epilogue: single-token decode collapses each
+    # between-matmul small-op chain into one Pallas op (dispatch-gated;
+    # prefill T>1 and CPU keep the inline jnp chains — same math)
+    fused_tick = T == 1 and _tick_fused_active(cfg)
+    if fused_tick:
+        pos_b = jnp.broadcast_to(
+            jnp.reshape(jnp.asarray(positions)[..., 0], (-1,)),
+            (B,)).astype(jnp.int32)
+
+    def _qkv(x, lp):
+        return (_decode_qkv(cfg, x, lp, pos_b) if fused_tick
+                else _qkv_proj(cfg, x, lp, positions))
+
+    def _post(x, attn, lp):
+        return (_decode_post(cfg, x, attn, lp) if fused_tick
+                else _layer_post(cfg, x, attn, lp))
+
     def body(x, per_layer):
         lp, kc, vc = per_layer
-        q, k_new, v_new = _qkv_proj(cfg, x, lp, positions)
+        q, k_new, v_new = _qkv(x, lp)
         if ragged:
             # scatter each slot's new row at its own position
             rows = jnp.arange(B)
@@ -799,7 +898,7 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos,
             vc = jax.lax.dynamic_update_slice(
                 vc, v_new.astype(vc.dtype), (0, pos, 0, 0))
         attn = _cache_attention(cfg, q, kc, vc, positions)
-        return _layer_post(cfg, x, attn, lp), (kc, vc)
+        return _post(x, attn, lp), (kc, vc)
 
     if cfg.scan_layers:
         x, (kcs, vcs) = jax.lax.scan(body, x,
@@ -817,7 +916,7 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos,
         kcs, vcs = cache["k"], cache["v"]
         for i in range(cfg.num_layers):
             lp = {kk: layer_weights[kk][i] for kk in layer_weights}
-            q, k_new, v_new = _qkv_proj(cfg, x, lp, positions)
+            q, k_new, v_new = _qkv(x, lp)
             if ragged:
                 rows = jnp.arange(B)
                 kcs = kcs.at[i, rows, pos].set(k_new[:, 0].astype(kcs.dtype))
@@ -828,8 +927,13 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos,
                 vcs = jax.lax.dynamic_update_slice(
                     vcs, v_new[None].astype(vcs.dtype), (i, 0, pos, 0, 0))
             attn = _cache_attention(cfg, q, kcs[i], vcs[i], positions)
-            x = _layer_post(cfg, x, attn, lp)
-    x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
+            x = _post(x, attn, lp)
+    if fused_tick:
+        from ..ops.pallas.tick_fusion import fused_rms_norm
+
+        x = fused_rms_norm(x[:, 0], params["ln_f"], cfg.rms_eps)[:, None]
+    else:
+        x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
     if logit_pos is None:
         last = x[:, -1]
     elif getattr(logit_pos, "ndim", 0) == 1:

@@ -8,3 +8,5 @@ ring attention over ICI.
 """
 
 from . import flash_attention
+from . import decode_attention
+from . import tick_fusion

@@ -1,0 +1,165 @@
+"""Fused decode-tick epilogue kernels — collapse the per-tick small ops.
+
+The decode tick at serving batch sizes is HBM-bound on the WEIGHT
+streams; the matmuls are fine. What fragments the step is everything
+between them: at batch 8 the profile shows ~60 small fused ops per tick
+(SCALING.md §3c) — rmsnorm reduce+scale pairs, the rope cos/sin/slice/
+concat chains, residual adds — each a separate launch over a [8, 768]
+tensor whose fixed per-op cost dwarfs its arithmetic. XLA will not fuse
+ACROSS these chains because the matmuls sit between them.
+
+These kernels collapse each between-matmul chain into ONE Pallas call
+(the tick's tensors are tiny — every kernel is a single grid cell wholly
+in VMEM):
+
+- ``fused_rms_norm``      rmsnorm chain -> 1 op
+- ``fused_add_rms_norm``  residual add + next rmsnorm -> 1 op, 2 outputs
+                          (the new residual stream AND the normed value)
+- ``fused_rope_qk``       rope on q AND k in one kernel: positions ->
+                          cos/sin computed in-kernel, per-head
+                          rotate-half on the FLAT [B, H] layout (the
+                          packed flash-kernel trick) -> 1 op for the
+                          whole ~15-op chain, shared across q and k
+
+Dispatch mirrors ``flash_attention``: TPU + flag + single-device, with
+the jnp formulation (bit-identical math to ``models/llama``'s inline
+chains) as the CPU/fallback path, and ``FORCE_INTERPRET`` so tier-1 CPU
+tests can run the real kernels through the pallas interpreter.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ... import flags
+
+__all__ = ["tick_fusion_active", "fused_rms_norm", "fused_add_rms_norm",
+           "fused_rope_qk"]
+
+# tests set this True to force the kernels (pallas interpret mode) on CPU
+FORCE_INTERPRET = False
+
+
+def _interp() -> bool:
+    from .flash_attention import _on_tpu
+
+    return FORCE_INTERPRET and not _on_tpu()
+
+
+def tick_fusion_active(hidden_size: int) -> bool:
+    """True when the decode tick should use the fused epilogue kernels:
+    TPU (or test force), kernels + flag enabled, single device, and a
+    lane-aligned hidden dim (tiny test configs fall back to the inline
+    jnp chains — same math)."""
+    from .flash_attention import _multi_device_mesh_active, _on_tpu
+
+    f = flags.get_flags(["use_pallas_kernels", "use_tick_fusion"])
+    if not (f["use_pallas_kernels"] and f["use_tick_fusion"]):
+        return False
+    if not (_on_tpu() or FORCE_INTERPRET):
+        return False
+    if _multi_device_mesh_active():
+        return False
+    return hidden_size % 128 == 0
+
+
+# ---------------------------------------------------------------------------
+# rmsnorm (+ residual add) — one kernel per chain, [B, H] single block
+# ---------------------------------------------------------------------------
+
+
+def _rms_kernel(eps):
+    def kernel(x_ref, w_ref, o_ref):
+        xf = x_ref[...].astype(jnp.float32)
+        var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        normed = (xf * jax.lax.rsqrt(var + eps)).astype(x_ref.dtype)
+        o_ref[...] = normed * w_ref[...].astype(x_ref.dtype)
+
+    return kernel
+
+
+def fused_rms_norm(x, w, eps: float):
+    """rmsnorm(x) * w as ONE op. x: [B, H]; w: [H]. Math matches
+    ``llama._rms_norm`` (fp32 mean-square, cast before the gain)."""
+    B, H = x.shape
+    return pl.pallas_call(
+        _rms_kernel(float(eps)),
+        out_shape=jax.ShapeDtypeStruct((B, H), x.dtype),
+        interpret=_interp(),
+    )(x, jnp.broadcast_to(w, (1, H)))
+
+
+def _add_rms_kernel(eps):
+    def kernel(x_ref, y_ref, w_ref, s_ref, o_ref):
+        s = x_ref[...] + y_ref[...]
+        s_ref[...] = s
+        sf = s.astype(jnp.float32)
+        var = jnp.mean(sf * sf, axis=-1, keepdims=True)
+        normed = (sf * jax.lax.rsqrt(var + eps)).astype(s.dtype)
+        o_ref[...] = normed * w_ref[...].astype(s.dtype)
+
+    return kernel
+
+
+def fused_add_rms_norm(x, y, w, eps: float):
+    """(x + y, rmsnorm(x + y) * w) as ONE op — the residual add feeding
+    the next pre-norm never round-trips HBM between two launches."""
+    B, H = x.shape
+    return pl.pallas_call(
+        _add_rms_kernel(float(eps)),
+        out_shape=[jax.ShapeDtypeStruct((B, H), x.dtype),
+                   jax.ShapeDtypeStruct((B, H), x.dtype)],
+        interpret=_interp(),
+    )(x, y, jnp.broadcast_to(w, (1, H)))
+
+
+# ---------------------------------------------------------------------------
+# rope on q and k — one kernel, cos/sin shared, flat [B, H] head slices
+# ---------------------------------------------------------------------------
+
+
+def _rope_qk_kernel(D, nq, nk, theta):
+    half = D // 2
+
+    def rotate(z_ref, o_ref, nheads, cos, sin):
+        z = z_ref[...]
+        dt = z.dtype
+        cos = cos.astype(dt)
+        sin = sin.astype(dt)
+        for h in range(nheads):
+            x1 = z[:, h * D:h * D + half]
+            x2 = z[:, h * D + half:(h + 1) * D]
+            o_ref[:, h * D:h * D + half] = x1 * cos - x2 * sin
+            o_ref[:, h * D + half:(h + 1) * D] = x1 * sin + x2 * cos
+
+    def kernel(pos_ref, q_ref, k_ref, oq_ref, ok_ref):
+        B = q_ref.shape[0]
+        # angles in fp32 like llama._rope_at: pos * theta^(-2i/D)
+        i2 = jax.lax.broadcasted_iota(jnp.float32, (B, half), 1) * 2.0
+        freqs = jnp.power(jnp.float32(theta), -i2 / D)
+        ang = pos_ref[...].astype(jnp.float32) * freqs  # [B, half]
+        cos, sin = jnp.cos(ang), jnp.sin(ang)
+        rotate(q_ref, oq_ref, nq, cos, sin)
+        rotate(k_ref, ok_ref, nk, cos, sin)
+
+    return kernel
+
+
+def fused_rope_qk(zq, zk, pos, head_dim: int, theta: float):
+    """Rope both projections in ONE op. zq: [B, nH*D]; zk: [B, Hkv*D];
+    pos: [B] int32 (each row at its own absolute position — the ragged
+    decode convention; broadcast a scalar for the shared-position path).
+    cos/sin are computed in-kernel from ``pos`` — the XLA chain's iota/
+    power/cos/sin/broadcast ops never exist as separate launches."""
+    B, Hq = zq.shape
+    Hk = zk.shape[1]
+    return pl.pallas_call(
+        _rope_qk_kernel(head_dim, Hq // head_dim, Hk // head_dim,
+                        float(theta)),
+        out_shape=[jax.ShapeDtypeStruct((B, Hq), zq.dtype),
+                   jax.ShapeDtypeStruct((B, Hk), zk.dtype)],
+        interpret=_interp(),
+    )(jnp.asarray(pos, jnp.int32).reshape(B, 1), zq, zk)

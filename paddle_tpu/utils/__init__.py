@@ -1,8 +1,9 @@
 """``paddle.utils`` — extension loading and misc helpers."""
 
 from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401
 
-__all__ = ["cpp_extension"]
+__all__ = ["cpp_extension", "dlpack"]
 
 
 def deprecated(update_to="", since="", reason="", level=0):

@@ -1,0 +1,31 @@
+"""``paddle.utils.dlpack`` — zero-copy tensor interop via the DLPack
+protocol (reference: ``paddle.utils.dlpack.to_dlpack/from_dlpack`` over
+DLManagedTensor capsules; SURVEY.md §2.1 tensor API row). ``jax.dlpack``
+carries the actual exchange; this module adds the Tensor wrapping and
+the reference's capsule-or-producer calling convention."""
+
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor/array -> DLPack capsule. Accepts a paddle Tensor or any
+    jax array; the capsule is consumable exactly once (DLPack contract)."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    arr = x.value if isinstance(x, Tensor) else jax.numpy.asarray(x)
+    return jax.dlpack.to_dlpack(arr)
+
+
+def from_dlpack(ext):
+    """DLPack capsule (or any object with ``__dlpack__``) -> Tensor.
+    Matches the reference's from_dlpack, which takes either a capsule
+    from ``to_dlpack`` or a producer tensor directly."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    return Tensor(jax.dlpack.from_dlpack(ext))

@@ -156,6 +156,29 @@ class TestWindowedPath:
                 assert results[rid] == want, (eos, rid, results[rid], want)
 
 
+class TestDecodeKernelLane:
+    def test_decode_profile_smoke(self):
+        """The serving-lane kernel-selection gate (r6): run
+        ``benchmarks/decode_profile.py --smoke`` in-process — asserts the
+        ragged decode kernel is selected for the serving decode shape,
+        the fused tick epilogue reduces the traced per-tick op count,
+        fused/dense numerics agree, and per-slot KV blocks fetched scale
+        with pos. A dispatch regression fails HERE, not on the chip."""
+        import importlib.util
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "..",
+                            "benchmarks", "decode_profile.py")
+        spec = importlib.util.spec_from_file_location("_decode_profile",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        ev = mod.smoke()
+        assert ev["ops_fused"] < ev["ops_dense"]
+        assert ev["kv_rows_read"][0] == ev["block_k"]
+        assert max(ev["kv_rows_read"].values()) <= ev["kv_rows_dense"]
+
+
 class TestUnrolledCachePath:
     def test_unrolled_matches_scan_generate_and_ragged(self, tiny):
         """scan_layers=False routes forward_with_cache through the
