@@ -4,8 +4,13 @@ README's serving claims had no captured artifact, so a serving regression
 was invisible to the round record).
 
 Writes ``SERVING_r<N>.json`` at the repo root:
-  {"round": N, "decode": {...llama_decode json...},
-   "serving": {...llama_serving json incl. packing + p50/p99...}}
+  {"round": N, "platform": ..., "decode": {...llama_decode json...},
+   "serving": {...llama_serving json incl. packing + p50/p99...},
+   "online": {...llama_serving --online json: Poisson arrivals at
+              0.5/1/2x the measured service rate, MEASURED per-request
+              TTFT + e2e p50/p99, vs fixed batching...},
+   "prefix": {...llama_serving --prefix json: shared-prefix KV cache
+              on/off tok/s...}}  (r7: the online serving subsystem)
 
 Usage: python benchmarks/serving_lane.py [round_number]
 (no args: derives the round from the highest existing BENCH_r*.json,
@@ -28,11 +33,11 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from tpu_test_lane import _round_number  # noqa: E402
 
 
-def _run_json(script: str, timeout: int = 900):
+def _run_json(script: str, timeout: int = 900, args: tuple = ()):
     t0 = time.time()
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.join("benchmarks", script)],
+            [sys.executable, os.path.join("benchmarks", script), *args],
             cwd=ROOT, capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired as e:
         # a hung bench must still leave an artifact (the whole point of
@@ -58,16 +63,23 @@ def _run_json(script: str, timeout: int = 900):
 
 def main() -> int:
     rnd = _round_number(sys.argv)
+    # platform comes from a CHILD's report — importing jax in this parent
+    # could initialize a broken TPU backend and abort the whole lane (the
+    # same reason __graft_entry__.dryrun_multichip re-execs)
     result = {
         "round": rnd,
         "decode": _run_json("llama_decode.py"),
         "serving": _run_json("llama_serving.py"),
+        "online": _run_json("llama_serving.py", args=("--online",)),
+        "prefix": _run_json("llama_serving.py", args=("--prefix",)),
     }
+    result["platform"] = result["online"].get("platform", "unknown")
     path = os.path.join(ROOT, f"SERVING_r{rnd:02d}.json")
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
-    ok = (result["decode"].get("rc") == 0 and result["serving"].get("rc") == 0)
+    ok = all(result[k].get("rc") == 0
+             for k in ("decode", "serving", "online", "prefix"))
     return 0 if ok else 1
 
 

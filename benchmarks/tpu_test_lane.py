@@ -30,6 +30,12 @@ TPU_TEST_FILES = [
     # r4: on-chip END-TO-END certification — full bf16 train steps
     # (framework numerics + fused optimizer), not just kernels
     "tests/test_train_step_tpu.py",
+    # r7 (VERDICT r5 item 6): the INFERENCE surface — generate() chip-vs-
+    # CPU greedy parity, fused-drain mixed-lengths+EOS, re-entrant
+    # segments, unrolled-KV vs scan-layers cache parity, prefix-cache hit
+    # (tests/test_decode_attention.py stays OUT of this lane: its
+    # cpu-defaults-stay-dense assertion is false on a chip by design)
+    "tests/test_inference_tpu.py",
 ]
 
 

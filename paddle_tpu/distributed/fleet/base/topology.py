@@ -140,7 +140,9 @@ class HybridCommunicateGroup:
         self.nranks = topology.world_size()
 
         mesh = get_mesh()
-        want = (self._dp, self._pp, self._sharding, self._mp, self._sep)
+        # trailing 1 = the ep axis (fleet's topology doesn't route expert
+        # parallelism; MoE meshes are built via create_hybrid_mesh(ep=...))
+        want = (self._dp, self._pp, self._sharding, self._mp, self._sep, 1)
         if mesh is None or tuple(mesh.shape[a] for a in HYBRID_AXES) != want:
             import jax
 

@@ -12,9 +12,10 @@ from .parallel_layers import (
 )
 from .pipeline_parallel import PipelineParallel
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+from .tensor_parallel import TensorParallel
 
 __all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
            "RowParallelLinear", "ParallelCrossEntropy", "RNGStatesTracker",
            "get_rng_state_tracker", "model_parallel_random_seed",
            "LayerDesc", "SharedLayerDesc", "PipelineLayer",
-           "PipelineParallel"]
+           "PipelineParallel", "TensorParallel"]

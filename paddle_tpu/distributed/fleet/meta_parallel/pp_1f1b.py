@@ -414,11 +414,12 @@ class OneFOneBEngine:
         layouts = [self._param_layout(v) for v in pvals0]
         pspecs = [sp for sp, _ in layouts]
         zero_dims = [zd for _, zd in layouts]
-        mapped = jax.shard_map(
+        from ....parallel.mesh import shard_map_compat
+
+        mapped = shard_map_compat(
             program, mesh=mesh,
             in_specs=(pspecs, P(), data_spec, data_spec, P()),
             out_specs=(P(), pspecs),
-            check_vma=False,
         )
 
         def run(pvals, bvals, x, y, key):

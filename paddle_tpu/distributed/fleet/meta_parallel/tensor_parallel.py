@@ -1,0 +1,66 @@
+"""TensorParallel model wrapper.
+
+Reference counterpart: ``python/paddle/distributed/fleet/meta_parallel/
+tensor_parallel.py`` (SURVEY.md §2.2 TP row): a thin model wrapper whose
+job is CONSISTENCY, not computation — at construction it broadcasts every
+non-distributed parameter from the mp-group's source rank so replicated
+state (norms, embeddings outside the vocab shard, biases of row-parallel
+layers) starts bit-identical across tensor-parallel ranks; the sharded
+parameters (marked ``is_distributed`` by Column/Row/VocabParallel layers)
+are left alone. Forward simply delegates.
+
+TPU-native note: under the single-controller SPMD path replicated
+consistency is automatic (one host initialises one array), so the
+broadcast only does work on the launcher's multi-process runtime — the
+same condition under which the reference's NCCL broadcast matters. The
+wrapper is still worth having single-process: it is the documented fleet
+entry (``fleet.distributed_model`` returns one when mp_degree > 1) and
+scripts type-check against it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ....nn.layer.layers import Layer
+
+__all__ = ["TensorParallel"]
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers: Layer, hcg=None, strategy=None, **kwargs):
+        super().__init__()
+        self._layers = layers
+        self._strategy = strategy
+        self.add_sublayer("_layers", layers)
+        if hcg is None:
+            from ..base.topology import get_hybrid_communicate_group
+
+            hcg = get_hybrid_communicate_group()
+        self._hcg = hcg
+        self._sync_params()
+
+    # --- the reference's sync_params_buffers ------------------------------
+    def _mp_group(self) -> Optional[object]:
+        if self._hcg is None:
+            return None
+        if self._hcg.get_model_parallel_world_size() <= 1:
+            return None
+        return self._hcg.get_model_parallel_group()
+
+    def _sync_params(self) -> None:
+        group = self._mp_group()
+        if group is None:
+            return
+        from ... import collective as C
+
+        src = self._hcg.get_model_parallel_group_src_rank()
+        for p in self._layers.parameters():
+            if getattr(p, "is_distributed", False):
+                continue  # mp-sharded: each rank owns its shard
+            synced = C.broadcast(p, src=src, group=group)
+            if synced is not p and hasattr(synced, "_value"):
+                p._value = synced._value
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)

@@ -28,7 +28,9 @@ __all__ = [
     "Gumbel", "Geometric", "Cauchy", "Multinomial", "Poisson",
     "Independent", "TransformedDistribution", "kl_divergence",
     "register_kl", "Transform", "AffineTransform", "ExpTransform",
-    "SigmoidTransform",
+    "SigmoidTransform", "TanhTransform", "PowerTransform",
+    "ReshapeTransform", "StickBreakingTransform", "ChainTransform",
+    "StackTransform", "IndependentTransform",
 ]
 
 
@@ -663,6 +665,242 @@ class SigmoidTransform(Transform):
         return run_op(
             "sigmoid_ldj",
             lambda a: -jax.nn.softplus(-a) - jax.nn.softplus(a), _t(x))
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference ``paddle.distribution.TanhTransform``)."""
+
+    def forward(self, x):
+        from ..ops.dispatch import run_op
+
+        return run_op("tanh", jnp.tanh, _t(x))
+
+    def inverse(self, y):
+        from ..ops.dispatch import run_op
+
+        return run_op("atanh", jnp.arctanh, _t(y))
+
+    def forward_log_det_jacobian(self, x):
+        from ..ops.dispatch import run_op
+
+        # log(1 - tanh(x)^2) = 2*(log 2 - x - softplus(-2x)): the
+        # softplus form stays finite where tanh saturates
+        return run_op(
+            "tanh_ldj",
+            lambda a: 2.0 * (jnp.log(2.0) - a - jax.nn.softplus(-2.0 * a)),
+            _t(x))
+
+
+class PowerTransform(Transform):
+    """y = x**power on x > 0 (reference ``PowerTransform``)."""
+
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def forward(self, x):
+        from ..ops.dispatch import run_op
+
+        return run_op("pow", jnp.power, _t(x), self.power)
+
+    def inverse(self, y):
+        from ..ops.dispatch import run_op
+
+        return run_op("pow_inv",
+                      lambda a, p: jnp.power(a, 1.0 / p), _t(y), self.power)
+
+    def forward_log_det_jacobian(self, x):
+        from ..ops.dispatch import run_op
+
+        return run_op(
+            "pow_ldj",
+            lambda a, p: jnp.broadcast_to(
+                jnp.log(jnp.abs(p)) + (p - 1.0) * jnp.log(a), a.shape),
+            _t(x), self.power)
+
+
+class ReshapeTransform(Transform):
+    """Reshape the event block; jacobian is identity (reference
+    ``ReshapeTransform(in_event_shape, out_event_shape)``)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(int(d) for d in in_event_shape)
+        self.out_event_shape = tuple(int(d) for d in out_event_shape)
+        if int(np.prod(self.in_event_shape)) != \
+                int(np.prod(self.out_event_shape)):
+            raise ValueError(
+                f"in_event_shape {self.in_event_shape} and out_event_shape "
+                f"{self.out_event_shape} have different sizes")
+
+    def _reshape(self, x, src, dst):
+        from ..ops.dispatch import run_op
+
+        def f(a):
+            batch = a.shape[:a.ndim - len(src)]
+            return a.reshape(batch + dst)
+
+        return run_op("reshape_transform", f, _t(x))
+
+    def forward(self, x):
+        return self._reshape(x, self.in_event_shape, self.out_event_shape)
+
+    def inverse(self, y):
+        return self._reshape(y, self.out_event_shape, self.in_event_shape)
+
+    def forward_log_det_jacobian(self, x):
+        from ..ops.dispatch import run_op
+
+        def f(a):
+            return jnp.zeros(a.shape[:a.ndim - len(self.in_event_shape)],
+                             jnp.float32)
+
+        return run_op("reshape_ldj", f, _t(x))
+
+
+class StickBreakingTransform(Transform):
+    """R^K -> interior of the (K+1)-simplex by iterated stick-breaking
+    (reference ``StickBreakingTransform``; the Dirichlet reparameterisation
+    path). Offset-logit convention: z_k = sigmoid(x_k - log(K - k)) is the
+    fraction of the remaining stick taken at step k, so a zero input maps
+    to the uniform simplex point."""
+
+    @staticmethod
+    def _offsets(K):
+        return jnp.arange(K, 0, -1, dtype=jnp.float32)  # K, K-1, .., 1
+
+    def forward(self, x):
+        from ..ops.dispatch import run_op
+
+        def f(a):
+            z = jax.nn.sigmoid(a - jnp.log(self._offsets(a.shape[-1])))
+            zc = jnp.cumprod(1.0 - z, axis=-1)
+            pad = jnp.ones(a.shape[:-1] + (1,), a.dtype)
+            return jnp.concatenate([z, pad], -1) * \
+                jnp.concatenate([pad, zc], -1)
+
+        return run_op("stickbreaking_fwd", f, _t(x))
+
+    def inverse(self, y):
+        from ..ops.dispatch import run_op
+
+        def f(b):
+            yc = b[..., :-1]
+            sf = 1.0 - jnp.cumsum(yc, axis=-1)        # stick left AFTER k
+            return (jnp.log(yc) - jnp.log(sf)
+                    + jnp.log(self._offsets(yc.shape[-1])))
+
+        return run_op("stickbreaking_inv", f, _t(y))
+
+    def forward_log_det_jacobian(self, x):
+        from ..ops.dispatch import run_op
+
+        def f(a):
+            xs = a - jnp.log(self._offsets(a.shape[-1]))
+            z = jax.nn.sigmoid(xs)
+            zc = jnp.cumprod(1.0 - z, axis=-1)
+            pad = jnp.ones(a.shape[:-1] + (1,), a.dtype)
+            y_head = (jnp.concatenate([z, pad], -1)
+                      * jnp.concatenate([pad, zc], -1))[..., :-1]
+            # dy_k/dx_k = y_k * (1 - z_k); log-sigmoid spelling is stable
+            return jnp.sum(-xs + jax.nn.log_sigmoid(xs)
+                           + jnp.log(y_head), axis=-1)
+
+        return run_op("stickbreaking_ldj", f, _t(x))
+
+
+class ChainTransform(Transform):
+    """Function composition of transforms, applied left-to-right
+    (reference ``ChainTransform``)."""
+
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        from ..ops.dispatch import run_op
+
+        total = None
+        for t in self.transforms:
+            ldj = t.forward_log_det_jacobian(x)
+            total = ldj if total is None else run_op(
+                "add", jnp.add, total, ldj)
+            x = t.forward(x)
+        return total
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i along ``axis`` (reference
+    ``StackTransform``)."""
+
+    def __init__(self, transforms: Sequence[Transform], axis: int = 0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _apply(self, x, method):
+        from ..ops.dispatch import run_op
+
+        x = _t(x)
+        n = len(self.transforms)
+        parts = [
+            getattr(t, method)(run_op(
+                "stack_slice",
+                lambda a, i=i: jnp.take(a, i, axis=self.axis), x))
+            for i, t in enumerate(self.transforms)]
+
+        def f(*vals):
+            return jnp.stack(list(vals), axis=self.axis)
+
+        if x._value.shape[self.axis] != n:
+            raise ValueError(
+                f"axis {self.axis} has size {x._value.shape[self.axis]}, "
+                f"expected {n} (one slice per transform)")
+        return run_op("stack_join", f, *parts)
+
+    def forward(self, x):
+        return self._apply(x, "forward")
+
+    def inverse(self, y):
+        return self._apply(y, "inverse")
+
+    def forward_log_det_jacobian(self, x):
+        return self._apply(x, "forward_log_det_jacobian")
+
+
+class IndependentTransform(Transform):
+    """Promote ``reinterpreted_batch_rank`` trailing batch dims of the
+    base transform to event dims: forward/inverse delegate, the
+    log-det-jacobian SUMS over those dims (reference
+    ``IndependentTransform`` — the transform-side mirror of
+    ``Independent``)."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        if self.rank < 1:
+            raise ValueError(
+                f"reinterpreted_batch_rank must be >= 1, got {self.rank}")
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        from ..ops.dispatch import run_op
+
+        ldj = self.base.forward_log_det_jacobian(x)
+        axes = tuple(range(-self.rank, 0))
+        return run_op("independent_ldj_sum",
+                      lambda a: jnp.sum(a, axis=axes), ldj)
 
 
 class TransformedDistribution(Distribution):

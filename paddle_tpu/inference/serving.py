@@ -66,6 +66,15 @@ class Request:
     tokens: List[int] = field(default_factory=list)
     submit_time: float = 0.0      # perf_counter at add_request
     finish_time: float = 0.0      # perf_counter at retirement
+    # online-serving measured lifecycle (perf_counter; 0.0 = not yet):
+    # these are HOST-OBSERVED times — a token "exists" for a client only
+    # once a device->host sync delivered it, so first_token/finish are
+    # stamped at the segment sync that surfaced them (r7: the measured
+    # replacement for r5's uniform-step latency model)
+    arrival_time: float = 0.0     # entered the system (arrival process)
+    admit_time: float = 0.0       # packed into a slot (prefill dispatched)
+    first_token_time: float = 0.0  # first generated token host-visible
+    prefix_hit_len: int = 0       # KV rows reused from the prefix cache
 
     @property
     def done(self) -> bool:
@@ -456,6 +465,340 @@ class ServingEngine:
         done = {r.rid: r.tokens for r in self._finished}
         self.last_latencies = {r.rid: r.finish_time - r.submit_time
                                for r in self._finished if r.finish_time}
+        self._finished = []
+        return done
+
+    # --- re-entrant fused segments (r7: online continuous batching) -------
+    def _segment_prog(self, n_pad: int, s_max: int, pre_max: int,
+                      max_steps: int):
+        """The fused drain, RE-ENTRANT: one compiled program that starts
+        from the engine's *current* slot state (cache/pos/nxt/rem as
+        inputs, not zeros), admits up to ``n_pad`` queued requests into
+        slots as they free, decodes for at most ``max_steps`` loop
+        iterations, and returns the slot state plus an event log the host
+        replays. This is ``_drain_prog``'s while_loop with three changes:
+
+        * slot state is an argument — a segment composes with previous
+          segments (and with the windowed path) instead of assuming an
+          empty engine, so newly arrived requests join slots freed by
+          EOS/retirement mid-flight;
+        * the loop is step-bounded — the host regains control every
+          ``max_steps`` ticks to ingest arrivals and stamp real
+          (measured) per-request times at the sync;
+        * outputs are an event log indexed by (local step, slot)
+          (``out``) plus per-step admit records (``aq``/``aslot``) —
+          NOT per-request rows — so requests admitted in *earlier*
+          segments keep streaming into the same log and the host replay
+          attributes tokens by tracking slot occupancy.
+
+        Shared-prefix admission (``pre_max > 0``): each queue row carries
+        ``pre_len`` already-prefilled KV rows (from the prefix cache);
+        the admit branch writes those rows into a temp cache and runs
+        prefill ONLY on the [1, s_max] suffix at positions
+        pre_len..pre_len+s_max-1 — the quadratic attention and the
+        per-token matmul work of the shared prefix are not re-done.
+        Memoised per (n_pad, s_max, pre_max, max_steps) shape."""
+        key = ("seg", n_pad, s_max, pre_max, max_steps)
+        cached = self._progs.get(key)
+        if cached is not None:
+            return cached
+        cfg, slots, eos = self.cfg, self.slots, self.eos
+        if pre_max + s_max > self.max_len:
+            raise ValueError(
+                f"segment admit window {pre_max}+{s_max} exceeds cache "
+                f"max_len {self.max_len}")
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def segment(params, cache, pos, nxt, rem, prompts, lens, gens,
+                    pre_k, pre_v, pre_lens, n_real):
+            i32 = jnp.int32
+            st = dict(
+                cache=cache, pos=pos, nxt=nxt, rem=rem,
+                out=jnp.zeros((max_steps, slots), i32),
+                aq=jnp.full((max_steps,), n_pad, i32),    # n_pad = decode
+                aslot=jnp.zeros((max_steps,), i32),
+                qidx=i32(0), step=i32(0),
+            )
+
+            def cond(st):
+                work = jnp.any(st["rem"] > 0) | (st["qidx"] < n_real)
+                return work & (st["step"] < max_steps)
+
+            def admit(st):
+                s = jnp.argmin(st["rem"])          # a rem==0 slot
+                q = st["qidx"]
+                prow = jax.lax.dynamic_slice(prompts, (q, 0), (1, s_max))
+                ln = lens[q]
+                pln = pre_lens[q]
+                c1 = llama.init_kv_cache(cfg, 1, pre_max + s_max)
+                if pre_max:
+                    # reused prefix rows land at absolute rows [0, pre_max)
+                    # of the temp cache; rows beyond this request's true
+                    # pre_len are zeros and stay masked (suffix tokens
+                    # write at absolute positions pre_len+t, and decode
+                    # attention never looks past pos)
+                    pk = jax.lax.dynamic_slice(
+                        pre_k, (q, 0, 0, 0, 0),
+                        (1,) + pre_k.shape[1:]).transpose(1, 0, 2, 3, 4)
+                    pv = jax.lax.dynamic_slice(
+                        pre_v, (q, 0, 0, 0, 0),
+                        (1,) + pre_v.shape[1:]).transpose(1, 0, 2, 3, 4)
+                    c1 = {
+                        "k": jax.lax.dynamic_update_slice(
+                            c1["k"], pk.astype(c1["k"].dtype),
+                            (0, 0, 0, 0, 0)),
+                        "v": jax.lax.dynamic_update_slice(
+                            c1["v"], pv.astype(c1["v"].dtype),
+                            (0, 0, 0, 0, 0)),
+                    }
+                logits, c1 = llama.forward_with_cache(
+                    params, prow, cfg, c1, pln, logit_pos=ln - 1)
+                t0 = jnp.argmax(logits, axis=-1).astype(i32).reshape(())
+                k = jax.lax.dynamic_update_slice(
+                    st["cache"]["k"], c1["k"], (0, s, 0, 0, 0))
+                v = jax.lax.dynamic_update_slice(
+                    st["cache"]["v"], c1["v"], (0, s, 0, 0, 0))
+                rem_new = gens[q] - 1
+                if eos is not None:
+                    rem_new = jnp.where(t0 == eos, 0, rem_new)
+                return dict(
+                    cache={"k": k, "v": v},
+                    pos=st["pos"].at[s].set(pln + ln),
+                    nxt=st["nxt"].at[s].set(t0),
+                    rem=st["rem"].at[s].set(rem_new),
+                    out=st["out"].at[st["step"], s].set(t0),
+                    aq=st["aq"].at[st["step"]].set(q),
+                    aslot=st["aslot"].at[st["step"]].set(s),
+                    qidx=q + 1, step=st["step"],
+                )
+
+            def decode(st):
+                live = st["rem"] > 0
+                logits, cache = llama.forward_with_cache(
+                    params, st["nxt"][:, None], cfg, st["cache"], st["pos"])
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                tok = jnp.where(live, tok, st["nxt"])
+                rem = st["rem"] - live.astype(jnp.int32)
+                if eos is not None:
+                    rem = jnp.where(live & (tok == eos), 0, rem)
+                return dict(
+                    cache=cache,
+                    pos=st["pos"] + live.astype(jnp.int32),
+                    nxt=tok, rem=rem,
+                    out=st["out"].at[st["step"]].set(tok),
+                    aq=st["aq"], aslot=st["aslot"],
+                    qidx=st["qidx"], step=st["step"],
+                )
+
+            def body(st):
+                can_admit = (st["qidx"] < n_real) & jnp.any(st["rem"] == 0)
+                st = jax.lax.cond(can_admit, admit, decode, st)
+                st["step"] = st["step"] + 1
+                return st
+
+            st = jax.lax.while_loop(cond, body, st)
+            return (st["cache"], st["pos"], st["nxt"], st["rem"],
+                    st["out"], st["aq"], st["aslot"], st["step"],
+                    st["qidx"])
+
+        self._progs[key] = segment
+        return segment
+
+    def free_slot_count(self) -> int:
+        return sum(1 for r in self._active if r is None)
+
+    def reset_slots(self) -> None:
+        """Clear all slot state (cache rows stay allocated — pos masking
+        makes stale rows invisible). Used between warmup and a timed run."""
+        assert all(r is None for r in self._active), \
+            "reset_slots with live requests"
+        self._pos = jnp.zeros((self.slots,), jnp.int32)
+        self._nxt = jnp.zeros((self.slots,), jnp.int32)
+        self._rem = jnp.zeros((self.slots,), jnp.int32)
+        self._rem_host = [0] * self.slots
+        self._queue = []
+        self._finished = []
+        self.last_run_ticks = 0
+        self.last_run_chunks = 0
+        self.last_latencies = {}
+
+    def run_segment(self, max_steps: int, prefix_cache=None,
+                    n_pad: Optional[int] = None,
+                    now: Optional[float] = None) -> dict:
+        """One fused continuous-batching segment: admit FCFS from the
+        queue into free slots (at most ``n_pad``), decode up to
+        ``max_steps`` ticks, ONE dispatch + ONE fetch, then replay the
+        event log host-side to distribute tokens and retire requests.
+
+        Returns {"steps", "admitted", "first_tokens", "finished"} — rid
+        lists the caller (the online scheduler) stamps with the sync
+        wall-clock time; ``now`` defaults to time.perf_counter() and is
+        recorded as each admitted request's admit_time."""
+        if now is None:
+            now = time.perf_counter()
+        n_pad = n_pad or self._pow2(self.slots)
+        # pick up to n_pad regardless of CURRENT free slots: in-program
+        # admission refills slots the moment they retire mid-segment, so
+        # over-picking is exactly what keeps the batch full (requests the
+        # step budget couldn't admit are re-queued below)
+        picked = self._queue[:n_pad]
+        del self._queue[:len(picked)]
+        n = len(picked)
+
+        # prefix-cache lookup (admission-time detection): per request the
+        # longest cached block-aligned prefix; suffix = the rest
+        pre_lens = np.zeros((n_pad,), np.int32)
+        pre_entries = [None] * n
+        if prefix_cache is not None:
+            for j, r in enumerate(picked):
+                ent = prefix_cache.match(r.prompt)
+                if ent is not None and ent.length < len(r.prompt):
+                    pre_entries[j] = ent
+                    pre_lens[j] = ent.length
+                    r.prefix_hit_len = ent.length
+        pre_max = int(max(pre_lens)) if n else 0
+        if pre_max:
+            pre_max = prefix_cache.round_up(pre_max)
+
+        # prompt width: WITHOUT prefix reuse, pin to the largest bucket —
+        # prefill pads there anyway on the drain path (HBM-bound: it
+        # streams the full weight set regardless of width) and ONE
+        # program shape means no mid-serve XLA compile when arrival
+        # jitter regroups admissions (measured: a stray 64-wide segment
+        # compiled 2.5s into an online run, dwarfing the work). WITH
+        # prefix reuse the suffix width IS the saving, so bucket it —
+        # shared-prefix workloads have uniform tails, so the shape set
+        # stays small and the warm pass covers it.
+        if prefix_cache is None or pre_max == 0:
+            s_max = self.buckets[-1]
+        else:
+            suf_max = max((len(r.prompt) - int(pre_lens[j])
+                           for j, r in enumerate(picked)), default=1)
+            s_max = self._bucket_for(suf_max)
+        if pre_max and pre_max + s_max > self.max_len:
+            # prefix + suffix window must fit the cache; drop the hits
+            pre_max = 0
+            pre_lens[:] = 0
+            pre_entries = [None] * n
+            for r in picked:
+                r.prefix_hit_len = 0
+            s_max = self.buckets[-1]
+
+        prompts = np.zeros((n_pad, s_max), np.int32)
+        lens = np.ones((n_pad,), np.int32)
+        gens = np.zeros((n_pad,), np.int32)   # gen 0 -> never admitted
+        for j, r in enumerate(picked):
+            suf = r.prompt[int(pre_lens[j]):]
+            prompts[j, :len(suf)] = suf
+            lens[j] = len(suf)
+            gens[j] = r.max_new_tokens
+            r.admit_time = now
+        if pre_max:
+            L = self.cfg.num_layers
+            Hkv, D = self.cfg.num_kv_heads, self.cfg.head_dim
+            pk = jnp.zeros((n_pad, L, pre_max, Hkv, D), self._cache["k"].dtype)
+            pv = jnp.zeros((n_pad, L, pre_max, Hkv, D), self._cache["v"].dtype)
+            for j, ent in enumerate(pre_entries):
+                if ent is not None:
+                    pk = pk.at[j, :, :ent.length].set(ent.k[:, :ent.length])
+                    pv = pv.at[j, :, :ent.length].set(ent.v[:, :ent.length])
+        else:
+            # zero-width prefix block: the program specialises pre_max=0
+            # and skips the prefix writes entirely
+            L = self.cfg.num_layers
+            Hkv, D = self.cfg.num_kv_heads, self.cfg.head_dim
+            pk = jnp.zeros((n_pad, L, 0, Hkv, D), self._cache["k"].dtype)
+            pv = jnp.zeros((n_pad, L, 0, Hkv, D), self._cache["v"].dtype)
+
+        out = self._segment_prog(n_pad, s_max, pre_max, max_steps)(
+            self.params, self._cache, self._pos, self._nxt, self._rem,
+            jnp.asarray(prompts), jnp.asarray(lens), jnp.asarray(gens),
+            pk, pv, jnp.asarray(pre_lens), jnp.int32(n))
+        self._cache, self._pos, self._nxt, self._rem = out[:4]
+        toks, aq, aslot, steps, qadm = jax.device_get(out[4:])
+        steps, qadm = int(steps), int(qadm)
+        self.last_run_ticks += steps
+        self.last_run_chunks += 1
+
+        # host replay: walk the event log chronologically, tracking slot
+        # occupancy — admits rebind a slot; decode ticks append one token
+        # to every slot the HOST knows is live (its rem mirror), so
+        # frozen-slot repeats and pad rows are dropped exactly as the
+        # windowed _sync does
+        admitted, first_tokens, finished = [], [], []
+        for st in range(steps):
+            q = int(aq[st])
+            if q < n:                      # admit event
+                r = picked[q]
+                s = int(aslot[st])
+                assert self._active[s] is None, "admit into a live slot"
+                t = int(toks[st, s])
+                r.tokens.append(t)
+                admitted.append(r.rid)
+                first_tokens.append(r.rid)
+                hit_eos = self.eos is not None and t == self.eos
+                if r.done or hit_eos:
+                    self._rem_host[s] = 0
+                    self._retire(r)
+                    finished.append(r.rid)
+                else:
+                    self._active[s] = r
+                    self._rem_host[s] = r.max_new_tokens - 1
+            else:                          # decode tick
+                for s, r in enumerate(self._active):
+                    if r is None or self._rem_host[s] <= 0:
+                        continue
+                    t = int(toks[st, s])
+                    r.tokens.append(t)
+                    if len(r.tokens) == 1:
+                        first_tokens.append(r.rid)
+                    self._rem_host[s] -= 1
+                    if self.eos is not None and t == self.eos:
+                        self._rem_host[s] = 0
+                    if self._rem_host[s] == 0:
+                        self._retire(r)
+                        self._active[s] = None
+                        finished.append(r.rid)
+        if qadm < n:
+            # step budget ran out before every picked request found a
+            # slot: back to the queue head, FCFS order preserved
+            for r in picked[qadm:]:
+                r.admit_time = 0.0
+            self._queue[:0] = picked[qadm:]
+
+        # prefix-cache population: insert each admitted request's full
+        # prompt KV (block-trimmed device slices of the slot cache —
+        # rows [0, plen) hold exactly the prompt's keys until the slot
+        # is reused, and insertion right after the sync precedes any
+        # donation of this cache buffer)
+        if prefix_cache is not None:
+            last_admit = {}                # slot -> its latest admit event
+            for st in range(steps):
+                q = int(aq[st])
+                if q < n:
+                    last_admit[int(aslot[st])] = q
+            for s, q in last_admit.items():
+                r = picked[q]
+                plen_b = prefix_cache.round_down(len(r.prompt))
+                if plen_b > int(pre_lens[q]):
+                    prefix_cache.insert(
+                        r.prompt[:plen_b],
+                        self._cache["k"][:, s, :plen_b],
+                        self._cache["v"][:, s, :plen_b])
+        return {"steps": steps, "admitted": admitted,
+                "first_tokens": first_tokens, "finished": finished}
+
+    def collect_finished(self) -> Dict[int, List[int]]:
+        """Drain the finished list (segment mode's result channel),
+        truncating at max_new_tokens / first EOS like run()."""
+        done = {}
+        for r in self._finished:
+            toks = r.tokens[:r.max_new_tokens]
+            if self.eos is not None and self.eos in toks:
+                toks = toks[:toks.index(self.eos) + 1]
+            r.tokens = toks
+            done[r.rid] = toks
+            self.last_latencies[r.rid] = r.finish_time - r.submit_time
         self._finished = []
         return done
 

@@ -69,6 +69,14 @@ class LlamaConfig:
     scan_layers: bool = True
     # sequence parallel: shard activations' seq dim over 'sep' outside matmuls
     sequence_parallel: bool = False
+    # which SP attention formulation carries the sep axis (r7, mirroring
+    # the reference's two SP implementations): "ring" = K/V blocks
+    # ppermute around the sep ring with online-softmax merging; "ulysses"
+    # = two all-to-alls reshard seq-parallel activations head-parallel,
+    # exact attention per rank (cheaper when 2*|q| < (n-1)*|kv| — MHA at
+    # moderate sep; GQA favours the ring). Both fall back dense when the
+    # axis is absent or shapes don't divide.
+    sp_impl: str = "ring"
     # single-chip chunked cross-entropy: head+CE recomputed per batch-chunk
     # so [B,S,V] logits never materialise (0 = off; see loss_fn)
     ce_chunks: int = 0
@@ -131,6 +139,19 @@ class LlamaConfig:
         d = dict(vocab_size=32000, hidden_size=768, intermediate_size=3072,
                  num_layers=12, num_heads=12, num_kv_heads=12, max_seq_len=512,
                  remat=False, scan_layers=False, ce_tail_custom=True)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def cpu_small(cls, **kw):
+        """~3M-param decoder: the serving benchmarks' CPU-tractable shape
+        (the chip lane runs bert_base_equiv; off-chip artifact runs record
+        this model so scheduling behaviour — not matmul speed — is what
+        the numbers exercise). Unrolled+fp32 like bert_base_equiv so the
+        same decode code paths run."""
+        d = dict(vocab_size=2048, hidden_size=128, intermediate_size=512,
+                 num_layers=4, num_heads=8, num_kv_heads=8, max_seq_len=512,
+                 dtype=jnp.float32, remat=False, scan_layers=False)
         d.update(kw)
         return cls(**d)
 
@@ -368,9 +389,12 @@ def _attention(cfg: LlamaConfig, q, k, v):
     the whole sequence onto every device. The axis/divisibility fallback
     lives in context_parallel_attention itself (one guard, not two)."""
     if cfg.sequence_parallel:
-        from ..ops.pallas.ring_attention import context_parallel_attention
+        from ..ops.pallas.ring_attention import (
+            context_parallel_attention, ulysses_parallel_attention)
 
-        return context_parallel_attention(
+        sp_fn = {"ring": context_parallel_attention,
+                 "ulysses": ulysses_parallel_attention}[cfg.sp_impl]
+        return sp_fn(
             q, k, v, axis_name="sep", is_causal=True,
             batch_axes=("dp", "sharding"), head_axes="mp",
             fallback=lambda: dot_product_attention(q, k, v, is_causal=True))
@@ -943,6 +967,24 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos,
                                             keepdims=False)
     logits = last @ params["lm_head"].astype(dt)  # [B, V]
     return logits.astype(jnp.float32), {"k": kcs, "v": vcs}
+
+
+def prompt_kv(params, prompt, cfg: LlamaConfig,
+              max_len: Optional[int] = None):
+    """KV rows for a prompt, standalone: the prefix-cache registration
+    path (inference/prefix_cache.py) and its parity tests. Returns
+    ({"k","v"} [L, B, S_pad, Hkv, D], logits [B, V]) where S_pad =
+    ``max_len or S`` — rows past S are zeros. Rope is position-dependent,
+    so these rows are reusable by ANY request whose prompt starts with
+    ``prompt`` (the keys live at the same absolute positions)."""
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim == 1:
+        prompt = prompt[None]
+    B, S = prompt.shape
+    cache = init_kv_cache(cfg, B, max_len or S)
+    logits, cache = forward_with_cache(params, prompt, cfg, cache,
+                                       jnp.int32(0))
+    return cache, logits
 
 
 def _sample(logits, temperature, top_k, key, top_p=1.0):

@@ -26,6 +26,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+
+def _axis_size(axis_name):
+    # jax.lax.axis_size is newer than this container's jax; psum(1) is
+    # the portable spelling (resolved at trace time, zero runtime cost)
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
 __all__ = ["ring_attention", "RingFlashAttention",
            "context_parallel_attention", "ulysses_attention",
            "ulysses_parallel_attention"]
@@ -77,7 +85,7 @@ def ring_attention(q, k, v, axis_name: str = "sep", is_causal: bool = False,
     """Ring attention over the ``axis_name`` mesh axis (call inside
     shard_map with q/k/v seq-sharded). Exact — numerically equal to full
     attention over the gathered sequence."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
@@ -168,12 +176,13 @@ def _sp_gspmd_entry(local_fn, q, k, v, mesh, axis_name, is_causal,
         if needs_head_divisible and (x.shape[2] // max(h_size, 1)) % n:
             return fall_back()
 
+    from ...parallel.mesh import shard_map_compat
+
     spec = P(baxes, axis_name, haxes, None)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         functools.partial(local_fn, axis_name=axis_name,
                           is_causal=is_causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
     )
     return fn(q, k, v)
 
@@ -214,7 +223,7 @@ def ulysses_attention(q, k, v, axis_name: str = "sep",
     """
     from .flash_attention import _xla_attention
 
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     h = q.shape[2]
     if h % n:
         raise ValueError(f"ulysses_attention: head count {h} must be "

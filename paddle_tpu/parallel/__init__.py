@@ -17,6 +17,7 @@ from .mesh import (
     host_to_global,
     named_sharding,
     set_mesh,
+    shard_map_compat,
     with_sharding_constraint,
 )
 
@@ -25,6 +26,7 @@ __all__ = [
     "create_hybrid_mesh",
     "get_mesh",
     "set_mesh",
+    "shard_map_compat",
     "mesh_axis_size",
     "named_sharding",
     "host_to_global",

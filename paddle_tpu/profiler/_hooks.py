@@ -10,6 +10,7 @@ cost when no profiler is active is one falsy check on ``COLLECTORS``.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import List
 
@@ -28,3 +29,17 @@ def now_ns() -> int:
 def emit(name: str, start_ns: int, end_ns: int, kind: str = "op") -> None:
     for c in COLLECTORS:
         c._host_event(name, start_ns, end_ns, kind)
+
+
+@contextlib.contextmanager
+def span(name: str, kind: str = "op"):
+    """RAII host span (the RecordEvent analog for non-op subsystems —
+    r7: the serving scheduler wraps segment dispatch/sync in these so a
+    profiler capture shows scheduling alongside op dispatch). Free when
+    no profiler is active beyond the two clock reads."""
+    t0 = now_ns()
+    try:
+        yield
+    finally:
+        if COLLECTORS:
+            emit(name, t0, now_ns(), kind)

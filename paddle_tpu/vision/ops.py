@@ -20,7 +20,8 @@ from ..ops.dispatch import run_op
 
 __all__ = ["nms", "box_iou", "roi_align", "roi_pool", "box_coder",
            "box_area", "prior_box", "yolo_box", "distribute_fpn_proposals",
-           "psroi_pool", "deform_conv2d", "generate_proposals"]
+           "psroi_pool", "deform_conv2d", "DeformConv2D",
+           "generate_proposals"]
 
 
 def box_area(boxes, name=None):
@@ -503,6 +504,44 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     if mask is not None:
         args.append(mask)
     return run_op("deform_conv2d", f, *args)
+
+
+from ..nn import initializer as _I               # noqa: E402
+from ..nn.layer.layers import Layer as _Layer    # noqa: E402
+
+
+class DeformConv2D(_Layer):
+    """Layer form of ``deform_conv2d`` (reference
+    ``paddle.vision.ops.DeformConv2D``; r7 API-residue closure): owns the
+    conv weight/bias, takes the offset (and v2 mask) per call —
+    ``forward(x, offset, mask=None)``."""
+
+    def __init__(self, in_channels, out_channels, kernel_size,
+                 stride=1, padding=0, dilation=1, deformable_groups=1,
+                 groups=1, weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        fan_in = (in_channels // groups) * ks[0] * ks[1]
+        k = 1.0 / np.sqrt(fan_in) if fan_in else 1.0
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr, default_initializer=_I.Uniform(-k, k))
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=_I.Uniform(-k, k))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, stride=self._stride,
+            padding=self._padding, dilation=self._dilation,
+            deformable_groups=self._deformable_groups,
+            groups=self._groups, mask=mask)
 
 
 def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,

@@ -228,9 +228,10 @@ class TestAdvisorFixes:
         import jax.numpy as jnp
 
         import paddle_tpu.distributed as dist
-        from jax import shard_map
         from jax.sharding import Mesh
         from jax.sharding import PartitionSpec as P
+
+        from paddle_tpu.parallel import shard_map_compat
 
         dist.init_parallel_env()
         g = dist.get_default_group()
@@ -246,8 +247,8 @@ class TestAdvisorFixes:
             return val
 
         x = jnp.arange(8.0).reshape(4, 2)
-        res = shard_map(f, mesh=mesh, in_specs=P(g.axis_name),
-                        out_specs=P(), check_vma=False)(x)
+        res = shard_map_compat(f, mesh=mesh, in_specs=P(g.axis_name),
+                               out_specs=P())(x)
         np.testing.assert_allclose(np.asarray(res), x)
 
     def test_alltoall_single_out_tensor_raises_under_trace(self):

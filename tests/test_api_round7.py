@@ -1,0 +1,239 @@
+"""Round-7 API residue closure (VERDICT r5 item 7 remainder):
+``vision.ops.DeformConv2D`` layer, the distribution transform family
+(Tanh/Power/Reshape/StickBreaking/Chain/Stack/Independent), and the
+``fleet.meta_parallel.TensorParallel`` model wrapper — each with a parity
+test. Plus the r7 ``sp_impl`` knob: the flagship's sequence-parallel
+attention can route through Ulysses instead of the ring."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestDeformConv2DLayer:
+    def test_layer_matches_functional(self):
+        from paddle_tpu.vision.ops import DeformConv2D, deform_conv2d
+
+        paddle.seed(71)
+        rng = np.random.RandomState(0)
+        layer = DeformConv2D(4, 6, 3, stride=1, padding=1,
+                             deformable_groups=2)
+        x = paddle.to_tensor(rng.randn(2, 4, 8, 8).astype("float32"))
+        off = paddle.to_tensor(
+            (0.5 * rng.randn(2, 2 * 2 * 9, 8, 8)).astype("float32"))
+        y = layer(x, off)
+        assert list(y.shape) == [2, 6, 8, 8]
+        ref = deform_conv2d(x, off, layer.weight, layer.bias, stride=1,
+                            padding=1, deformable_groups=2)
+        np.testing.assert_allclose(y.numpy(), ref.numpy(), rtol=1e-6)
+
+    def test_v2_mask_modulation(self):
+        from paddle_tpu.vision.ops import DeformConv2D
+
+        paddle.seed(72)
+        rng = np.random.RandomState(1)
+        layer = DeformConv2D(3, 5, 3, padding=1)
+        x = paddle.to_tensor(rng.randn(1, 3, 6, 6).astype("float32"))
+        off = paddle.to_tensor(np.zeros((1, 18, 6, 6), "float32"))
+        ones = paddle.to_tensor(np.ones((1, 9, 6, 6), "float32"))
+        # zero offsets + all-ones mask == plain v1 path
+        np.testing.assert_allclose(layer(x, off, mask=ones).numpy(),
+                                   layer(x, off).numpy(), rtol=1e-6)
+        # zero mask kills everything but the bias
+        zeros = paddle.to_tensor(np.zeros((1, 9, 6, 6), "float32"))
+        out = layer(x, off, mask=zeros).numpy()
+        np.testing.assert_allclose(
+            out, np.broadcast_to(
+                layer.bias.numpy().reshape(1, -1, 1, 1), out.shape),
+            atol=1e-6)
+
+
+class TestTransformFamily:
+    def _roundtrip(self, t, x):
+        y = t.forward(paddle.to_tensor(x))
+        back = t.inverse(y)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-5, atol=1e-5)
+        return y
+
+    def test_tanh_roundtrip_and_ldj(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.distribution import TanhTransform
+
+        t = TanhTransform()
+        x = np.linspace(-2, 2, 7).astype("float32")
+        self._roundtrip(t, x)
+        ldj = t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy()
+        ref = np.log(np.abs(jax.vmap(jax.grad(jnp.tanh))(jnp.asarray(x))))
+        np.testing.assert_allclose(ldj, np.asarray(ref), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_power_roundtrip_and_ldj(self):
+        from paddle_tpu.distribution import PowerTransform
+
+        t = PowerTransform(3.0)
+        x = np.array([0.5, 1.0, 2.0], "float32")
+        y = self._roundtrip(t, x)
+        np.testing.assert_allclose(y.numpy(), x ** 3, rtol=1e-6)
+        ldj = t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(ldj, np.log(3 * x ** 2), rtol=1e-5)
+
+    def test_reshape_roundtrip_zero_ldj(self):
+        from paddle_tpu.distribution import ReshapeTransform
+
+        t = ReshapeTransform((2, 3), (6,))
+        x = np.arange(12, dtype="float32").reshape(2, 2, 3)
+        y = t.forward(paddle.to_tensor(x))
+        assert list(y.shape) == [2, 6]
+        np.testing.assert_array_equal(
+            t.inverse(y).numpy(), x)
+        ldj = t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy()
+        np.testing.assert_array_equal(ldj, np.zeros((2,), "float32"))
+
+    def test_stickbreaking_simplex_roundtrip_ldj(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.distribution import StickBreakingTransform
+
+        t = StickBreakingTransform()
+        rng = np.random.RandomState(3)
+        x = rng.randn(4, 5).astype("float32")
+        y = t.forward(paddle.to_tensor(x)).numpy()
+        assert y.shape == (4, 6)
+        assert (y > 0).all()
+        np.testing.assert_allclose(y.sum(-1), np.ones(4), rtol=1e-5)
+        np.testing.assert_allclose(
+            t.inverse(paddle.to_tensor(y)).numpy(), x, rtol=1e-4,
+            atol=1e-4)
+        # ldj vs autodiff: det of d y[:K] / d x (the K+1-th coord is
+        # determined by the simplex constraint)
+        fwd = lambda a: t.forward(paddle.to_tensor(np.asarray(a))).numpy()
+
+        def head(a):
+            z = jax.nn.sigmoid(a - jnp.log(jnp.arange(5, 0, -1.0)))
+            zc = jnp.cumprod(1 - z)
+            return (jnp.concatenate([z, jnp.ones(1)])
+                    * jnp.concatenate([jnp.ones(1), zc]))[:-1]
+
+        for row in range(2):
+            J = jax.jacfwd(head)(jnp.asarray(x[row]))
+            ref = np.linalg.slogdet(np.asarray(J))[1]
+            got = t.forward_log_det_jacobian(
+                paddle.to_tensor(x[row])).numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_chain_matches_manual_composition(self):
+        from paddle_tpu.distribution import (
+            AffineTransform, ChainTransform, ExpTransform)
+
+        aff = AffineTransform(1.0, 2.0)
+        exp = ExpTransform()
+        chain = ChainTransform([aff, exp])
+        x = np.array([-1.0, 0.0, 0.5], "float32")
+        y = chain.forward(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(y, np.exp(1.0 + 2.0 * x), rtol=1e-6)
+        np.testing.assert_allclose(
+            chain.inverse(paddle.to_tensor(y)).numpy(), x, rtol=1e-5)
+        ldj = chain.forward_log_det_jacobian(paddle.to_tensor(x)).numpy()
+        # |dy/dx| = 2 * exp(1 + 2x)
+        np.testing.assert_allclose(ldj, np.log(2.0) + (1.0 + 2.0 * x),
+                                   rtol=1e-5)
+
+    def test_stack_per_slice(self):
+        from paddle_tpu.distribution import (
+            ExpTransform, StackTransform, TanhTransform)
+
+        t = StackTransform([ExpTransform(), TanhTransform()], axis=1)
+        x = np.array([[0.3, 0.4], [-0.2, 0.1]], "float32")
+        y = t.forward(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(y[:, 0], np.exp(x[:, 0]), rtol=1e-6)
+        np.testing.assert_allclose(y[:, 1], np.tanh(x[:, 1]), rtol=1e-6)
+        np.testing.assert_allclose(
+            t.inverse(paddle.to_tensor(y)).numpy(), x, rtol=1e-5)
+
+    def test_independent_sums_ldj(self):
+        from paddle_tpu.distribution import (
+            IndependentTransform, TanhTransform)
+
+        base = TanhTransform()
+        t = IndependentTransform(base, 1)
+        x = np.random.RandomState(5).randn(3, 4).astype("float32")
+        np.testing.assert_allclose(
+            t.forward(paddle.to_tensor(x)).numpy(),
+            base.forward(paddle.to_tensor(x)).numpy())
+        ldj = t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy()
+        ref = base.forward_log_det_jacobian(
+            paddle.to_tensor(x)).numpy().sum(-1)
+        np.testing.assert_allclose(ldj, ref, rtol=1e-5)
+
+
+class TestTensorParallelWrapper:
+    def test_forward_delegates_and_syncs(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import TensorParallel
+
+        paddle.seed(77)
+        model = paddle.nn.Sequential(
+            paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+            paddle.nn.Linear(16, 4))
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8).astype("float32"))
+        ref = model(x).numpy()
+        tp = TensorParallel(model)          # no hcg: sync is a no-op
+        np.testing.assert_allclose(tp(x).numpy(), ref, rtol=1e-6)
+        # wrapper exposes the wrapped parameters (optimizer contract)
+        assert len(tp.parameters()) == len(model.parameters())
+
+    def test_sync_runs_under_mp_topology(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.base.topology import (
+            HybridCommunicateGroup,
+        )
+        from paddle_tpu.distributed.fleet.meta_parallel import TensorParallel
+        from paddle_tpu.parallel import set_mesh
+
+        dist.init_parallel_env()
+        paddle.seed(78)
+        try:
+            hcg = HybridCommunicateGroup(dp=4, mp=2)
+            model = paddle.nn.Linear(4, 4)
+            before = model.weight.numpy().copy()
+            tp = TensorParallel(model, hcg=hcg)
+            # single-controller: params are host-identical already; the
+            # broadcast must be value-preserving
+            np.testing.assert_allclose(tp._layers.weight.numpy(), before,
+                                       rtol=1e-6)
+        finally:
+            set_mesh(None)
+
+
+class TestUlyssesSpImpl:
+    def test_attention_dispatch_matches_dense(self):
+        """cfg.sp_impl='ulysses' under a sep mesh must equal the dense
+        XLA attention (exact algorithm, just resharded)."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.llama import LlamaConfig, _attention
+        from paddle_tpu.ops.pallas.flash_attention import _xla_attention
+        from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
+
+        rng = np.random.RandomState(11)
+        q = jnp.asarray(rng.randn(2, 16, 4, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 16, 4, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 16, 4, 8), jnp.float32)
+        import jax
+
+        ref = _xla_attention(q, k, v, is_causal=True)
+        # sep=4: the 4 heads divide the axis, so ulysses really runs
+        # (sep=8 would silently take the head-divisibility fallback)
+        mesh = create_hybrid_mesh(sep=4, devices=jax.devices()[:4])
+        try:
+            for impl in ("ring", "ulysses"):
+                cfg = LlamaConfig.tiny(sequence_parallel=True, sp_impl=impl)
+                out = _attention(cfg, q, k, v)
+                np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                           rtol=1e-4, atol=1e-5)
+        finally:
+            set_mesh(None)

@@ -156,6 +156,217 @@ class TestWindowedPath:
                 assert results[rid] == want, (eos, rid, results[rid], want)
 
 
+class TestSegmentReentry:
+    def test_segments_match_dense_with_midflight_arrivals(self, tiny):
+        """The re-entrant fused segment (r7): requests added BETWEEN
+        segments — i.e. while earlier requests still occupy slots — must
+        come out token-identical to dense generate(). This is the
+        continuous-batching contract the one-shot drain can't express."""
+        cfg, params = tiny
+        rng = np.random.RandomState(21)
+        wave1 = [(rng.randint(0, cfg.vocab_size, (l,)).astype(np.int32), n)
+                 for l, n in [(5, 9), (12, 6), (8, 12)]]
+        wave2 = [(rng.randint(0, cfg.vocab_size, (l,)).astype(np.int32), n)
+                 for l, n in [(20, 4), (3, 8), (15, 5), (7, 10)]]
+        eng = ServingEngine(cfg, params, slots=2, max_len=96,
+                            prompt_buckets=(8, 16, 32))
+        rids1 = [eng.add_request(p, n) for p, n in wave1]
+        ev = eng.run_segment(5)           # partial: slots still live
+        assert ev["steps"] == 5
+        rids2 = [eng.add_request(p, n) for p, n in wave2]  # arrive mid-run
+        while eng._queue or eng.free_slot_count() < eng.slots:
+            eng.run_segment(7)
+        out = eng.collect_finished()
+        for rid, (p, n) in zip(rids1 + rids2, wave1 + wave2):
+            ref = _dense_reference(cfg, params, p, n)
+            assert out[rid] == ref, (rid, out[rid], ref)
+
+    def test_segment_eos_freeze_and_reuse(self, tiny):
+        """EOS inside a segment frees the slot in-program; a queued
+        request must take it over within the SAME segment run."""
+        cfg, params = tiny
+        rng = np.random.RandomState(23)
+        prompts = [rng.randint(0, cfg.vocab_size, (6 + i,)).astype(np.int32)
+                   for i in range(4)]
+        refs = [_dense_reference(cfg, params, p, 8) for p in prompts]
+        eos = refs[0][1]                  # early EOS for request 0 only
+        eng = ServingEngine(cfg, params, slots=1, max_len=96,
+                            prompt_buckets=(16,), eos_token_id=eos)
+        rids = [eng.add_request(p, 8) for p in prompts]
+        while eng._queue or eng.free_slot_count() < eng.slots:
+            eng.run_segment(24)
+        out = eng.collect_finished()
+        for rid, ref in zip(rids, refs):
+            want = ref[:ref.index(eos) + 1] if eos in ref else ref
+            assert out[rid] == want, (rid, out[rid], want)
+
+
+class TestOnlineScheduler:
+    def test_serve_matches_dense_per_request(self, tiny):
+        """Scheduler-served output parity under a seeded staggered trace
+        (satellite test (ii)): every request == dense generate()."""
+        from paddle_tpu.inference.scheduler import (
+            OnlineScheduler, staggered_arrivals)
+
+        cfg, params = tiny
+        arr = staggered_arrivals(31, 9, 0.02, cfg.vocab_size,
+                                 prompt_lens=(5, 11, 23),
+                                 gen_lens=(3, 7, 11))
+        eng = ServingEngine(cfg, params, slots=3, max_len=96,
+                            prompt_buckets=(8, 16, 32))
+        sch = OnlineScheduler(eng, seg_steps=6)
+        rep = sch.serve(arr)
+        out = sch.results()
+        assert rep.n_requests == len(arr) == len(out)
+        for a, rid in zip(sorted(arr, key=lambda x: x.t), sorted(out)):
+            ref = _dense_reference(cfg, params, a.prompt, a.max_new_tokens)
+            assert out[rid] == ref, (rid, out[rid], ref)
+        # measured telemetry is present and ordered
+        for r in rep.per_request:
+            assert r["ttft_s"] >= 0 and r["e2e_s"] >= r["ttft_s"]
+        assert rep.ticks > 0 and rep.segments > 0
+
+    def test_backpressure_bounded_queue(self, tiny):
+        """Admission control: a bounded intake queue defers arrivals
+        client-side (counted), yet every request is eventually served."""
+        from paddle_tpu.inference.scheduler import (
+            OnlineScheduler, staggered_arrivals)
+
+        cfg, params = tiny
+        arr = staggered_arrivals(33, 10, 0.0, cfg.vocab_size,
+                                 prompt_lens=(6,), gen_lens=(6,))
+        eng = ServingEngine(cfg, params, slots=2, max_len=96,
+                            prompt_buckets=(8,))
+        sch = OnlineScheduler(eng, max_queue=2, seg_steps=4)
+        rep = sch.serve(arr)
+        assert rep.backpressure_events > 0
+        assert rep.n_requests == 10
+        assert len(sch.results()) == 10
+
+    def test_segments_emit_profiler_spans(self, tiny, tmp_path):
+        """Scheduler telemetry rides the profiler's host-span channel
+        (profiler/_hooks): an active Profiler sees one 'serving.segment'
+        span per segment, kind='serving'."""
+        import paddle_tpu.profiler as profiler
+        from paddle_tpu.inference.scheduler import (
+            OnlineScheduler, staggered_arrivals)
+
+        cfg, params = tiny
+        eng = ServingEngine(cfg, params, slots=2, max_len=96,
+                            prompt_buckets=(8,))
+        sch = OnlineScheduler(eng, seg_steps=4)
+        arr = staggered_arrivals(35, 4, 0.0, cfg.vocab_size,
+                                 prompt_lens=(6,), gen_lens=(5,))
+        p = profiler.Profiler(timer_only=True, log_dir=str(tmp_path))
+        p.start()
+        rep = sch.serve(arr)
+        p.stop()
+        spans = [s for s in p._host_spans if s[0] == "serving.segment"]
+        assert len(spans) == rep.segments
+        assert all(s[1] == "serving" and s[3] > 0 for s in spans)
+
+    def test_smoke_gate(self):
+        """The tier-1 scheduler gate (satellite: llama_serving --online
+        --smoke): engine >= 1.0x fixed batching on the staggered mixed
+        workload, no slot leaks/starvation, prefix-cache hit path
+        token-identical. A scheduler regression fails HERE, on CPU."""
+        import importlib.util
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "..",
+                            "benchmarks", "llama_serving.py")
+        spec = importlib.util.spec_from_file_location("_llama_serving",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        ev = mod.smoke()
+        assert ev["served"] == ev["n_requests"]
+        assert not ev["slot_leak"], ev
+        assert ev["prefix_identical"], ev
+        assert ev["prefix_hits"] > 0, ev
+        assert ev["throughput_vs_fixed"] >= 1.0, ev
+
+
+class TestPrefixCache:
+    def test_hit_path_token_identical_and_cheaper(self, tiny):
+        """Satellite test (iii): admission through a prefix-cache hit
+        must produce token-identical output to the cold path — and the
+        hit must actually shorten the prefill (suffix-only)."""
+        from paddle_tpu.inference.prefix_cache import PrefixCache
+
+        cfg, params = tiny
+        rng = np.random.RandomState(41)
+        prefix = rng.randint(0, cfg.vocab_size, (32,)).astype(np.int32)
+        # 4 requests over 2 slots: the first SEGMENT co-admits two cold
+        # (insertion is per-segment), the second segment's two both hit
+        tails = [rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+                 for _ in range(4)]
+        prompts = [np.concatenate([prefix, t]) for t in tails]
+        refs = [_dense_reference(cfg, params, p, 6) for p in prompts]
+
+        def serve(pc):
+            eng = ServingEngine(cfg, params, slots=2, max_len=96,
+                                prompt_buckets=(8, 16, 64))
+            rids = [eng.add_request(p, 6) for p in prompts]
+            while eng._queue or eng.free_slot_count() < eng.slots:
+                eng.run_segment(16, prefix_cache=pc)
+            done = eng.collect_finished()
+            return [done[r] for r in rids]
+
+        cold = serve(None)
+        pc = PrefixCache(block=16, capacity_tokens=2048)
+        hot = serve(pc)
+        assert cold == hot == refs
+        assert pc.hits >= 2 and pc.hit_tokens >= 2 * 32
+
+    def test_partial_overlap_and_eviction(self, tiny):
+        """Block-aligned partial overlap hits; LRU eviction keeps the
+        held-token budget."""
+        from paddle_tpu.inference.prefix_cache import PrefixCache
+        from paddle_tpu.models import llama
+
+        cfg, params = tiny
+        rng = np.random.RandomState(43)
+        base = rng.randint(0, cfg.vocab_size, (48,)).astype(np.int32)
+        pc = PrefixCache(block=16, capacity_tokens=64)
+        pc.put_prompt(params, base, cfg)
+        # same first 16 tokens, different continuation -> 16-row hit
+        probe = np.concatenate(
+            [base[:16], rng.randint(0, cfg.vocab_size, (20,))]
+        ).astype(np.int32)
+        m = pc.match(probe)
+        assert m is not None and m.length == 16
+        # a second insert pushes past capacity_tokens=64 -> LRU eviction
+        other = rng.randint(0, cfg.vocab_size, (48,)).astype(np.int32)
+        pc.put_prompt(params, other, cfg)
+        assert pc.tokens_held <= 64
+        assert pc.evictions >= 1
+
+    def test_harvested_rows_match_standalone_prefill(self, tiny):
+        """Cache plumbing parity: rows harvested from a serving slot
+        after admission equal llama.prompt_kv's standalone prefill."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.inference.prefix_cache import PrefixCache
+        from paddle_tpu.models import llama
+
+        cfg, params = tiny
+        rng = np.random.RandomState(45)
+        prompt = rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+        pc = PrefixCache(block=16, capacity_tokens=1024)
+        eng = ServingEngine(cfg, params, slots=1, max_len=96,
+                            prompt_buckets=(16,))
+        eng.add_request(prompt, 2)
+        while eng._queue or eng.free_slot_count() < eng.slots:
+            eng.run_segment(8, prefix_cache=pc)
+        m = pc.match(np.concatenate([prompt, prompt[:4]]))
+        assert m is not None and m.length == 16
+        cache, _ = llama.prompt_kv(params, prompt, cfg)
+        np.testing.assert_allclose(
+            np.asarray(m.k[:, :16]), np.asarray(cache["k"][:, 0]),
+            rtol=1e-5, atol=1e-6)
+
+
 class TestDecodeKernelLane:
     def test_decode_profile_smoke(self):
         """The serving-lane kernel-selection gate (r6): run
