@@ -1,0 +1,103 @@
+"""Flash-attention-bound roofline for the long-context bench shapes —
+SCALING.md §3d (VERDICT r5 item 5: every other perf claim carries a
+%-of-ceiling figure; seq-4096's tokens/s had none).
+
+Pure arithmetic over the bench model (``LlamaConfig.bert_base_equiv``:
+H=768, F=3072, L=12, h=12, d=64, V=32000), stated assumptions:
+
+- bf16 MXU peak 197 TF/s, HBM 819 GB/s (the §2 constants);
+- dense (non-attention) dots at their MEASURED bare-achievable fractions
+  (r5 dot_micro medians: proj 0.76, mlp 0.95, head 0.96 — the in-step
+  rates sit within noise of these, so they ARE the ceiling);
+- attention matmuls at the d=64 structural MXU cap of 0.5 (r4 ledger:
+  the flash kernels' matmuls-only ablation shows K=64 half-depth /
+  N=64 half-width contractions are intrinsically ~2x off peak — no
+  kernel can beat the systolic array's geometry at this head dim);
+- causal block-skip: attention FLOPs use the S/2 average visible length
+  (the packed kernels skip fully-masked blocks);
+- fwd 2 dots (QK, PV) + bwd 5 dots (recompute QK, dP, dV, dQ, dK) per
+  (layer, head) -> 7*d*S FLOPs/token at causal average;
+- per-token "other" (rope/rms/CE chains + the optimizer, measured
+  ~17 ms at the S=512/22528-token step) charged per token — the
+  long-context runs keep tokens/step roughly constant (b5 x 4096).
+
+The HBM side of the flash kernels (streaming q/k/v/o rows ~3x across
+fwd+bwd) is printed to show it is subdominant: the kernel is MXU-bound
+at these sequence lengths, so the MXU cap is the binding term.
+
+Usage:
+  python benchmarks/longctx_roofline.py            print the §3d table
+  python benchmarks/longctx_roofline.py --measure  also run the S=4096
+      step on the chip (perf_lab methodology) and report %-of-ceiling
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+PEAK = 197e12     # bf16 TF/s, v5e
+HBM = 819e9       # B/s
+H, F, V, L, NH, D = 768, 3072, 32000, 12, 12, 64
+F_PROJ, F_MLP, F_HEAD = 0.76, 0.95, 0.96   # r5 dot_micro medians
+F_ATTN = 0.5                               # d=64 structural MXU cap
+OTHER_US = 17.2e-3 / 22528 * 1e6           # ms measured @ S=512 step
+MEASURED = {4096: 80600.0}                 # r5 re-measured (README)
+
+
+def ceiling(S: int) -> dict:
+    # dense matmul FLOPs/token: fwd 2*weights, train = 3x fwd (dx + dW)
+    f_proj = 6 * L * 4 * H * H
+    f_mlp = 6 * L * 3 * H * F
+    f_head = 6 * V * H
+    t_dense = (f_proj / F_PROJ + f_mlp / F_MLP + f_head / F_HEAD) / PEAK
+    # attention: 7*d*(S/2 avg causal)*2 ... folded: 7*d*S per (L, h)
+    f_attn = 7 * D * (S // 2) * 2 * L * NH  # = 7*d*S*L*h
+    t_attn = f_attn / (PEAK * F_ATTN)
+    # flash HBM/token: q,k,v,o rows ~3 passes across fwd+bwd
+    attn_bytes = L * 4 * H * 2 * 3
+    t_attn_hbm = attn_bytes / HBM
+    t_tok = t_dense + OTHER_US * 1e-6 + max(t_attn, t_attn_hbm)
+    return {
+        "S": S,
+        "t_dense_us": t_dense * 1e6,
+        "t_attn_us": t_attn * 1e6,
+        "t_attn_hbm_us": t_attn_hbm * 1e6,
+        "t_other_us": OTHER_US,
+        "tok_s_ceiling": 1.0 / t_tok,
+        "attn_share": max(t_attn, t_attn_hbm) / t_tok,
+    }
+
+
+def table():
+    print("| S | dense µs/tok | attn µs/tok (MXU @0.5) | attn HBM µs/tok "
+          "| other µs/tok | ceiling tok/s | attn share | measured | % of "
+          "ceiling |")
+    print("|---|---|---|---|---|---|---|---|---|")
+    rows = {}
+    for S in (512, 4096, 8192):
+        c = ceiling(S)
+        rows[S] = c
+        meas = MEASURED.get(S)
+        mcol = f"{meas:,.0f}" if meas else "—"
+        pcol = (f"**{meas / c['tok_s_ceiling']:.0%}**" if meas else "—")
+        print(f"| {S} | {c['t_dense_us']:.2f} | {c['t_attn_us']:.2f} | "
+              f"{c['t_attn_hbm_us']:.2f} | {c['t_other_us']:.2f} | "
+              f"{c['tok_s_ceiling']:,.0f} | {c['attn_share']:.0%} | "
+              f"{mcol} | {pcol} |")
+    return rows
+
+
+def main():
+    rows = table()
+    if "--measure" in sys.argv:
+        from perf_lab import measure
+
+        for S, batch in ((4096, 5), (8192, 2)):
+            tps = measure({}, batch=batch, seq=S, tag=f"S={S}")
+            c = rows[S]["tok_s_ceiling"]
+            print(f"S={S}: measured {tps:,.0f} tok/s = {tps / c:.0%} of "
+                  f"the {c:,.0f} flash-bound ceiling")
+
+
+if __name__ == "__main__":
+    main()

@@ -36,6 +36,9 @@ TPU_TEST_FILES = [
     # (tests/test_decode_attention.py stays OUT of this lane: its
     # cpu-defaults-stay-dense assertion is false on a chip by design)
     "tests/test_inference_tpu.py",
+    # r8 (ISSUE 3): the Pallas fused multi-tensor optimizer update —
+    # real-Mosaic (SMEM scalars, in-place aliasing) trajectory parity
+    "tests/test_fused_update_tpu.py",
 ]
 
 

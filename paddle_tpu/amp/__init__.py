@@ -156,20 +156,29 @@ class GradScaler:
         return multiply(var, self._scale)
 
     def unscale_(self, optimizer):
+        """Unscale all grads and set ``found_inf`` with ONE device->host
+        sync (the reference's fused ``check_finite_and_unscale`` kernel):
+        the per-param ``bool()`` of the old loop cost a blocking round
+        trip per tensor — a ResNet-sized list paid ~161 of them. The
+        host-side gate in ``step()`` is what keeps skip-update semantics
+        for the Pallas fused update too (the kernel additionally accepts
+        a traced skip flag for in-program gating — see
+        ops/pallas/multi_tensor_update.py)."""
         if not self._enable or self._unscaled:
             return
         self._unscaled = True
         inv = 1.0 / self._scale
-        found = False
+        found = None
         from ..core.autograd import densify_grad_
 
         for p in optimizer._params():
             if p.grad is not None:
                 densify_grad_(p)
                 g = p.grad._value * inv
-                found = found or bool(jnp.logical_not(jnp.isfinite(g)).any())
+                bad = jnp.logical_not(jnp.isfinite(g)).any()
+                found = bad if found is None else jnp.logical_or(found, bad)
                 p.grad._inplace_set(g)
-        self._found_inf = found
+        self._found_inf = bool(found) if found is not None else False
 
     def step(self, optimizer):
         """Unscale and conditionally apply — loss-scale DYNAMICS belong to

@@ -21,6 +21,13 @@ TPU-native simplifications (single-controller GSPMD):
   optimizer accumulator with a ``NamedSharding`` that shards its largest
   divisible dim over the combined ('dp','sharding') axes — the reference's
   DygraphShardingOptimizer state partitioning, done as layout not ownership.
+* **Pallas fused update inheritance**: the wrapper delegates ``step`` to
+  the inner optimizer, so the flat-buffer fused update
+  (ops/pallas/multi_tensor_update.py) engages through it automatically on
+  single-device runs; under a >1-device mesh the kernel's own dispatch
+  falls back to the XLA packing (GSPMD can't partition the custom call),
+  and accumulators that were left in the flat ``[rows, 128]`` layout by
+  earlier single-device steps shard on their row dim like any other state.
 """
 
 from __future__ import annotations

@@ -632,10 +632,15 @@ class FusedTrainStep:
         tensor_args, arg_tree, rest_args, rest_kwargs = _split_args(inputs, {})
         ivals = [t._value for t in tensor_args]
 
+        from ..ops.pallas.multi_tensor_update import fused_update_signature
+
         key = (_tree_key(arg_tree),
                tuple((tuple(v.shape), str(v.dtype)) for v in ivals),
                tuple(id(p) for p in params),  # unfreezing params recompiles
-               getattr(self._model, "training", None))
+               getattr(self._model, "training", None),
+               # optimizer-kernel dispatch state: a use_pallas_fused_update
+               # flip mid-run must not reuse a program traced the other way
+               fused_update_signature())
         jitted = self._cache.get(key)
         if jitted is None:
             loss_fn = self._loss_fn

@@ -10,3 +10,4 @@ ring attention over ICI.
 from . import flash_attention
 from . import decode_attention
 from . import tick_fusion
+from . import multi_tensor_update

@@ -18,6 +18,7 @@ class Adam(Optimizer):
     # is a scalar of `step`) -> eligible for the flat-packed multi-tensor
     # path (Optimizer.apply_updates). Lamb is NOT (per-param trust ratio).
     _elementwise_update = True
+    _FUSED_PALLAS_KIND = "adam"  # subclasses with different math reset it
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
@@ -30,6 +31,10 @@ class Adam(Optimizer):
 
     def _use_master(self, p):
         return self._multi_precision and p._value.dtype in (jnp.bfloat16, jnp.float16)
+
+    def _fused_hyper(self, extras):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon}
 
     def _state_names(self):
         if self._multi_precision:
@@ -87,6 +92,12 @@ class AdamW(Adam):
             decay = 0.0
         return {"decay": np.float32(decay)}  # host scalar: placement-neutral under meshes
 
+    def _fused_hyper(self, extras):
+        h = super()._fused_hyper(extras)
+        h["decay"] = float(extras.get("decay", self._wd))
+        h["decoupled"] = True
+        return h
+
     def _update_one(self, p, g, state, lr, step, extras=None):
         new_p, new_state = super()._update_one(p, g, state, lr, step)
         if self._multi_precision and "master" in new_state:
@@ -99,6 +110,9 @@ class AdamW(Adam):
 
 class Lamb(Optimizer):
     _elementwise_update = False  # per-param trust ratio: NOT elementwise
+    # ... for the XLA packing. The Pallas flat path handles the trust
+    # reduction via the plan's segment ids, so Lamb still fuses there.
+    _FUSED_PALLAS_KIND = "lamb"
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
                  exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
@@ -123,6 +137,11 @@ class Lamb(Optimizer):
         if self._multi_precision:
             st["master"] = p._value.astype(jnp.float32)
         return st
+
+    def _fused_hyper(self, extras):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon,
+                "decay": float(extras.get("decay", self._wd))}
 
     def _per_param_extras(self, p):
         # BERT-recipe: LayerNorm/bias params excluded from LAMB decay
@@ -197,6 +216,8 @@ class Adamax(Adam):
     """Adam with infinity-norm second moment (reference
     ``paddle.optimizer.Adamax``)."""
 
+    _FUSED_PALLAS_KIND = None  # inf-norm moment: NOT the adam kernel math
+
     def __init__(self, *args, **kwargs):
         if kwargs.pop("multi_precision", False):
             from ..enforce import raise_unimplemented
@@ -226,6 +247,7 @@ class NAdam(Adam):
     # scalar 'mu_product' state is NOT param-shaped: the flat/stack
     # packing would concatenate it per GROUP and slice it per PARAM SIZE
     _elementwise_update = False
+    _FUSED_PALLAS_KIND = None
     """Nesterov-momentum Adam (reference ``paddle.optimizer.NAdam``)."""
 
     def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
@@ -265,6 +287,8 @@ class NAdam(Adam):
 class RAdam(Adam):
     """Rectified Adam (reference ``paddle.optimizer.RAdam``): variance
     rectification term switches between SGD-with-momentum and Adam."""
+
+    _FUSED_PALLAS_KIND = None  # rectification switch: NOT the adam kernel
 
     def __init__(self, *args, **kwargs):
         if kwargs.pop("multi_precision", False):

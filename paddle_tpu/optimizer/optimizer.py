@@ -89,6 +89,9 @@ class Optimizer:
             st = self._accumulators.get(id(p))
             if st is None:
                 continue
+            # export param-shaped state (the Pallas fused path keeps
+            # accumulators as flat [rows, 128] segments between steps)
+            st = self._shaped_state(p._value, st)
             for k, v in st.items():
                 out[f"{p.name}.{k}"] = to_tensor(v) if not isinstance(v, Tensor) else v
         out["@step"] = self._step_count
@@ -159,6 +162,16 @@ class Optimizer:
     # this False.
     _elementwise_update = False
     _FLAT_PACK_MAX = 65536  # elements; larger tensors update solo
+    # kind tag for the Pallas flat-buffer fused update
+    # (ops/pallas/multi_tensor_update.py). None -> XLA packing only.
+    # Lamb sets this DESPITE _elementwise_update=False: the kernel path
+    # handles its per-tensor trust reduction via the plan's segment ids.
+    _FUSED_PALLAS_KIND: Optional[str] = None
+
+    def _fused_hyper(self, extras: Dict[str, Any]) -> Dict[str, Any]:
+        """Static per-group scalars for the Pallas fused update (groups
+        are split by ``extras``, so e.g. AdamW decay is one scalar)."""
+        return {}
 
     def apply_updates(self, pvals, gvals, svals, evals, static_evals,
                       lr_, step_):
@@ -178,8 +191,28 @@ class Optimizer:
         (measured: packing everything traded 14 ms of launches for 32 ms
         of reshapes/copies on ResNet-50), while a big tensor's single
         fused update amortizes its launch anyway. Small 1-D/score tensors
-        are exactly the launch-bound population."""
+        are exactly the launch-bound population.
+
+        On TPU (flag ``use_pallas_fused_update``) supported optimizers
+        route every group through the Pallas flat-buffer kernels instead
+        (ops/pallas/multi_tensor_update.py): no stack/concat temporaries,
+        params/moments updated in place via aliasing, and state kept in
+        the flat layout between steps. CPU / meshes / unsupported kinds
+        keep the XLA packing below."""
         n = len(pvals)
+        kind = self._FUSED_PALLAS_KIND
+        if kind is not None and n > 8:
+            from ..ops.pallas import multi_tensor_update as _mtu
+
+            if _mtu.fused_update_active(n, kind):
+                return self._apply_updates_pallas(
+                    _mtu, kind, pvals, gvals, svals, evals, static_evals,
+                    lr_, step_)
+        # state may arrive as flat [rows, 128] segments from an earlier
+        # Pallas-fused program (the flag was live then); the XLA paths
+        # below work on shaped state
+        svals = [self._shaped_state(pv, sv)
+                 for pv, sv in zip(pvals, svals)]
         if not self._elementwise_update or n <= 8:
             out = [self._update_one(p, g, s, lr_, step_, e)
                    for p, g, s, e in zip(pvals, gvals, svals, evals)]
@@ -237,6 +270,59 @@ class Optimizer:
                 off += sz
         return new_p, new_s
 
+    def _shaped_state(self, pv, sv: Dict[str, Any]) -> Dict[str, Any]:
+        """Undo the Pallas flat [rows, 128] state layout for paths that
+        need param-shaped state (XLA packing after a flag flip, state
+        export). Only kind-tagged optimizers can ever hold flat state."""
+        if self._FUSED_PALLAS_KIND is None or not sv:
+            return sv
+        import numpy as _np
+        n = int(_np.prod(pv.shape)) if len(pv.shape) else 1
+        rows = -(-n // 128)
+        out = {}
+        for k, v in sv.items():
+            if (hasattr(v, "ndim") and v.ndim == 2
+                    and tuple(v.shape) == (rows, 128)
+                    and tuple(pv.shape) != (rows, 128)):
+                v = v.reshape(-1)[:n].reshape(tuple(pv.shape))
+            out[k] = v
+        return out
+
+    def _apply_updates_pallas(self, mtu, kind, pvals, gvals, svals, evals,
+                              static_evals, lr_, step_):
+        """The flat-buffer fused path: one Pallas launch per (dtype,
+        state-structure, static-extras) group, whole population — big
+        conv weights included (the stack path's size split existed to
+        bound XLA relayouts; the kernel has none)."""
+        n = len(pvals)
+        groups: Dict[Any, list] = {}
+        for i, pv in enumerate(pvals):
+            skey = tuple(sorted((k, str(v.dtype))
+                                for k, v in svals[i].items()))
+            ekey = tuple(sorted((k, float(v)) for k, v in
+                                (static_evals[i] or {}).items()))
+            groups.setdefault((str(pv.dtype), skey, ekey), []).append(i)
+        new_p: list = [None] * n
+        new_s: list = [None] * n
+        for key, idxs in groups.items():
+            if len(idxs) == 1:
+                i = idxs[0]
+                new_p[i], new_s[i] = self._update_one(
+                    pvals[i], gvals[i],
+                    self._shaped_state(pvals[i], svals[i]),
+                    lr_, step_, evals[i])
+                continue
+            plan = mtu.FlatPlan([pvals[i].shape for i in idxs])
+            hyper = self._fused_hyper(static_evals[idxs[0]] or {})
+            npl, nsl = mtu.apply_flat_update(
+                kind, plan, [pvals[i] for i in idxs],
+                [gvals[i] for i in idxs], [svals[i] for i in idxs],
+                hyper, lr_, step_)
+            for j, i in enumerate(idxs):
+                new_p[i] = npl[j]
+                new_s[i] = nsl[j]
+        return new_p, new_s
+
     def step(self):
         params = self._params()
         # SelectedRows grads (sparse embeddings) densify here: default-mode
@@ -264,7 +350,10 @@ class Optimizer:
         # with the same pytree structure would NOT retrace, so the evals
         # repr is part of the cache key: any change drops the cached jit
         # (the stale grouping would silently mis-update fused groups).
-        evals_key = repr(static_evals)
+        # The Pallas fused-update dispatch state rides the key too: a
+        # runtime flag flip must rebuild the program (layout is traced).
+        from ..ops.pallas.multi_tensor_update import fused_update_signature
+        evals_key = repr((static_evals, fused_update_signature()))
         if getattr(self, "_static_evals_key", None) != evals_key:
             self._jit_update = None
             self._static_evals_key = evals_key
@@ -328,6 +417,7 @@ class Optimizer:
 
 class SGD(Optimizer):
     _elementwise_update = True
+    _FUSED_PALLAS_KIND = "sgd"
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
@@ -338,11 +428,15 @@ class SGD(Optimizer):
 
 class Momentum(Optimizer):
     _elementwise_update = True
+    _FUSED_PALLAS_KIND = "momentum"
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._momentum = momentum
         self._nesterov = use_nesterov
+
+    def _fused_hyper(self, extras):
+        return {"momentum": self._momentum, "nesterov": self._nesterov}
 
     def _state_names(self):
         return ["velocity"]
