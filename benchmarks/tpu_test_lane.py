@@ -39,7 +39,37 @@ TPU_TEST_FILES = [
     # r8 (ISSUE 3): the Pallas fused multi-tensor optimizer update —
     # real-Mosaic (SMEM scalars, in-place aliasing) trajectory parity
     "tests/test_fused_update_tpu.py",
+    # r9 (ISSUE 4): the program auditor — sync/recompile/relayout/
+    # donation passes on the REAL backend (the 8-device collective
+    # fixtures skip on a single chip; the budget gate below certifies
+    # the canonical programs' budgets on hardware)
+    "tests/test_analysis.py",
 ]
+
+
+def _run_budget_gate(env) -> dict:
+    """r9: certify the four canonical programs' hazard budgets on the
+    real chip (``python -m paddle_tpu.analysis --gate``) and record the
+    per-program metrics next to the test outcomes. On TPU the relayout
+    ledger counts the REAL tiled-layout copies, so a chip-only
+    regression (a new relayout XLA:TPU materialises that the CPU
+    lowering fused) fails here even when tier-1 stayed green."""
+    import tempfile
+
+    out_json = os.path.join(tempfile.gettempdir(),
+                            f"_analysis_gate_{os.getpid()}.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "--gate",
+         "--json", out_json],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=900)
+    gate = {"returncode": proc.returncode, "programs": []}
+    if os.path.exists(out_json):
+        with open(out_json) as f:
+            gate["programs"] = json.load(f)
+        os.remove(out_json)
+    if proc.returncode != 0:
+        gate["tail"] = proc.stdout[-1500:]
+    return gate
 
 
 def _round_number(argv) -> int:
@@ -86,6 +116,7 @@ def main() -> int:
         counts["failed"] = int(m.group(1)) if m else 0
         m = re.search(r"(\d+) skipped", proc.stdout)
         counts["skipped"] = int(m.group(1)) if m else 0
+    gate = _run_budget_gate(env)
     result = {
         "round": rnd,
         "platform": "tpu" if counts["passed"] else "unknown",
@@ -94,16 +125,18 @@ def main() -> int:
         "skipped": counts.get("skipped", 0),
         "duration_s": round(dur, 1),
         "returncode": proc.returncode,
+        "analysis_gate": gate,
         "tests": tests,
     }
     out_path = os.path.join(ROOT, f"TPU_TESTS_r{rnd:02d}.json")
     with open(out_path, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps({k: result[k] for k in
-                      ("round", "passed", "failed", "skipped", "duration_s")}))
+                      ("round", "passed", "failed", "skipped", "duration_s")}
+                     | {"analysis_gate_rc": gate["returncode"]}))
     if proc.returncode != 0:
         sys.stderr.write(proc.stdout[-2000:])
-    return proc.returncode
+    return proc.returncode or gate["returncode"]
 
 
 if __name__ == "__main__":

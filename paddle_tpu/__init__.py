@@ -98,7 +98,7 @@ from .ops import linalg  # noqa: F401
 # paddle.DataParallel / distributed entry points live in paddle_tpu.distributed
 # (imported lazily to keep single-process import light)
 
-_LAZY_SUBMODULES = ("distributed", "incubate")
+_LAZY_SUBMODULES = ("distributed", "incubate", "analysis")
 
 
 def __getattr__(name):

@@ -178,7 +178,13 @@ class GradScaler:
                 bad = jnp.logical_not(jnp.isfinite(g)).any()
                 found = bad if found is None else jnp.logical_or(found, bad)
                 p.grad._inplace_set(g)
-        self._found_inf = bool(found) if found is not None else False
+        # the ONE sanctioned sync of the scaler step (audited: the
+        # program auditor flags any bool() beyond this fused check —
+        # the exact regression that r8 removed cannot silently return)
+        from ..analysis.syncs import allowed_sync
+
+        with allowed_sync("amp.grad_scaler.finite_check"):
+            self._found_inf = bool(found) if found is not None else False
 
     def step(self, optimizer):
         """Unscale and conditionally apply — loss-scale DYNAMICS belong to

@@ -1,0 +1,62 @@
+"""``paddle_tpu.analysis`` — static analysis of traced programs with
+enforced TPU-hazard budgets (ISSUE 4 tentpole).
+
+Five passes over any jit-compiled callable or registered canonical
+program:
+
+1. **host-sync detector** (``syncs``) — instruments the ``Tensor`` /
+   ``jax.Array`` coercion surface under an audit context; flags any
+   device→host sync in a warm hot loop that is not inside an
+   ``allowed_sync`` region (the GradScaler per-param ``bool()`` class).
+2. **recompile-hazard lint** (``recompile``) — counts real XLA backend
+   compilations during warm replay and lints jit cache keys for
+   unbucketed dynamic dims (the 2.5 s mid-serve compile class).
+3. **relayout accounting** (``hlo.relayout_inventory``) — materialised
+   transpose/copy/reshape + pack traffic bytes from optimized HLO (the
+   r8 255.5→153.3 MB/step ledger, automated).
+4. **donation/aliasing audit** (``hlo.donation_report``) — large entry
+   parameters that neither donate nor alias (HBM-peak class).
+5. **collective/mesh audit** (``hlo.collective_check``) — every
+   collective must attribute to a declared mesh-axis subset (the
+   promoted ``benchmarks/collective_audit`` pass).
+
+``budgets`` pins per-program ceilings; ``python -m paddle_tpu.analysis
+--gate`` audits the four canonical programs (``programs``) and exits
+nonzero when any budget regresses — wired into tier-1 so hazards fail
+the suite, not the next profiling round.
+
+Quick use::
+
+    from paddle_tpu import analysis
+
+    report = analysis.audit_fn(jitted, x, y)     # any jit callable
+    print(report.format())
+
+    report = analysis.audit_program("decode_tick")   # canonical
+    violations = analysis.budgets.check(report)
+"""
+
+from __future__ import annotations
+
+from . import budgets, hlo, programs, recompile, syncs
+from .auditor import AuditReport, Finding, audit_fn, audit_replay, audit_static
+from .recompile import CompileWatch, lint_cache_keys, live_cache_report
+from .syncs import SyncAudit, allowed_sync
+
+__all__ = [
+    "AuditReport", "Finding", "SyncAudit", "allowed_sync", "CompileWatch",
+    "lint_cache_keys", "live_cache_report", "audit_fn", "audit_replay",
+    "audit_static", "audit_program", "budgets", "hlo", "programs",
+    "recompile", "syncs",
+]
+
+
+def audit_program(name: str, replays: int = 2) -> AuditReport:
+    """Build + audit one canonical program (static + dynamic passes)."""
+    handle = programs.build(name)
+    rep = audit_static(name, handle.hlo(), mesh=handle.mesh,
+                       donation_threshold=handle.donation_threshold,
+                       expected_undonated=handle.expected_undonated,
+                       allowed_axes=handle.allowed_axes)
+    rep.merge(audit_replay(name, handle.replay, replays=replays))
+    return rep

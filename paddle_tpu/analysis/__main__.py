@@ -1,0 +1,66 @@
+"""CLI: audit the canonical programs and enforce their budgets.
+
+Usage::
+
+    python -m paddle_tpu.analysis                 # audit all, report
+    python -m paddle_tpu.analysis --program NAME  # one program
+    python -m paddle_tpu.analysis --gate          # exit 1 on any budget
+                                                  # violation (tier-1 +
+                                                  # chip-lane entry)
+    python -m paddle_tpu.analysis --json out.json # machine-readable dump
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="paddle_tpu.analysis")
+    ap.add_argument("--program", action="append", default=None,
+                    help="canonical program name (repeatable; default all)")
+    ap.add_argument("--gate", action="store_true",
+                    help="fail (exit 1) on any budget violation")
+    ap.add_argument("--replays", type=int, default=2)
+    ap.add_argument("--json", default=None, help="write results as JSON")
+    args = ap.parse_args(argv)
+
+    from . import audit_program, budgets, programs
+
+    targets = args.program or programs.names()
+    results = []
+    any_violation = False
+    for name in targets:
+        rep = audit_program(name, replays=args.replays)
+        violations = budgets.check(rep)
+        any_violation |= bool(violations)
+        results.append({
+            "program": name,
+            "metrics": {k: v for k, v in rep.metrics.items()},
+            "hazards": [str(f) for f in rep.hazards],
+            "violations": violations,
+        })
+        print(rep.format())
+        if violations:
+            print("  BUDGET VIOLATIONS:")
+            for v in violations:
+                print(f"    !! {v}")
+        else:
+            print("  budget: OK")
+        print()
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1, default=str)
+    if args.gate and any_violation:
+        print("GATE: FAIL")
+        return 1
+    if args.gate:
+        print("GATE: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,156 @@
+"""Per-program hazard budgets — the ledgers, made enforceable.
+
+Every number here was once a hand-computed ledger entry guarding a perf
+win (ARCHITECTURE.md r6/r7/r8 ledgers). The registry pins them per
+canonical program; ``check`` turns an ``AuditReport`` into a list of
+violations and ``python -m paddle_tpu.analysis --gate`` fails on any —
+so a reintroduced host sync, a stray shape compile, a new relayout or a
+dropped donation breaks the suite instead of waiting for the next
+profiling round.
+
+Adding a budget: measure the program's metrics once (``python -m
+paddle_tpu.analysis --program <name>``), pin the measured value (NOT a
+padded guess — the point is that growth fails), and cite why the number
+is what it is. Byte ceilings get a small (≤5%) allowance only when a
+metric is platform-sensitive; counts are exact.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = ["Budget", "BUDGETS", "budget_for", "check"]
+
+
+@dataclass
+class Budget:
+    # dynamic (per warm replay) — platform-INDEPENDENT contracts: a sync
+    # is a sync and a warm compile is a hazard on every backend
+    flagged_syncs: int = 0                 # non-allowed device→host syncs
+    allowed_syncs_per_replay: Dict[str, int] = field(default_factory=dict)
+    warm_compiles: int = 0                 # XLA compiles after warmup
+    # static (per compiled program) — byte ledgers are PLATFORM-SCOPED:
+    # the values below were pinned on the `bytes_platform` lowering and
+    # only bind there (XLA:TPU materialises different copies than
+    # XLA:CPU; the chip lane records its own measured ledger into
+    # TPU_TESTS_r<N>.json, from which a "tpu" budget gets pinned)
+    relayout_bytes_max: Optional[int] = None
+    pack_bytes_max: Optional[int] = None
+    undonated_bytes_max: Optional[int] = None
+    bytes_platform: str = "cpu"
+    require_collectives_clean: bool = True
+    notes: str = ""
+
+
+_MiB = 1 << 20
+
+
+BUDGETS: Dict[str, Budget] = {
+    # Fused AMP-O2 train step: ONE program per step, params + velocity
+    # donated, loss fetch happens outside the replay closure (the loop
+    # body never reads it) — so the hot loop holds ZERO syncs. The
+    # relayout/pack bytes are the optimizer's flat-pack traffic for this
+    # 20-tensor population plus conv layout copies (measured on the CPU
+    # lowering, pinned at measurement).
+    "amp_o2_train_step": Budget(
+        flagged_syncs=0,
+        warm_compiles=0,
+        # measured 15,108,056 B on the CPU lowering (fp32 dW transposes
+        # of the 4096x128 linear + conv backward layout copies) + ~5%
+        relayout_bytes_max=15_900_000,
+        pack_bytes_max=1 * _MiB,       # measured 0
+        undonated_bytes_max=_MiB // 2,  # measured 0 (batch rides < thresh)
+        notes="r8 class: GradScaler-free bf16 path; params+state alias"),
+    # The fused decode chunk is a pure device loop: no syncs, no
+    # compiles, and the KV cache must ride donated (an undonated cache
+    # doubles serving HBM — the r6 bug class).
+    "decode_tick": Budget(
+        flagged_syncs=0,
+        warm_compiles=0,
+        # measured 663,664 B (scan-carry cache copies + the scatter's
+        # KV-row transpose) + ~5%
+        relayout_bytes_max=700_000,
+        pack_bytes_max=_MiB // 2,      # measured 0
+        undonated_bytes_max=_MiB // 2,  # measured 0 (tiny weights)
+        notes="pure device loop; cache donated, weights live by design"),
+    # One fused segment = ONE dispatch + ONE event fetch (the measured
+    # r7 contract). The fetch is the allowed per-segment sync; anything
+    # else in the loop is the 2.5 s-mid-serve class.
+    "serving_segment": Budget(
+        flagged_syncs=0,
+        allowed_syncs_per_replay={"serving.segment_event_fetch": 1},
+        warm_compiles=0,
+        # measured 999,988 B (while-body cache carries + admit DUS
+        # copies) + ~5%
+        relayout_bytes_max=1_050_000,
+        pack_bytes_max=_MiB // 2,      # measured 0
+        undonated_bytes_max=_MiB // 2,  # measured 0
+        notes="r7 contract: one dispatch + one fetch per segment"),
+    # The donated multi-tensor update: the r8 ledger program. The pack
+    # bytes ARE the stack/flat packing traffic the Pallas kernel
+    # eliminates on chip; the CPU lowering keeps the XLA packing, so
+    # the ceiling pins THAT path's bytes for this population.
+    "fused_optimizer_update": Budget(
+        flagged_syncs=0,
+        warm_compiles=0,
+        # measured 0/0 on this CPU lowering (the flat-pack concats fuse
+        # into kLoop bodies as index math); headroom = one stray copy
+        relayout_bytes_max=256 * 1024,
+        pack_bytes_max=256 * 1024,
+        # measured 262,144 B: exactly the two (128,256) f32 gradient
+        # inputs — grads are inputs, never donated; params+velocity alias
+        undonated_bytes_max=300_000,
+        notes="r8 ledger program: 255.5->153.3 MB/step class, miniature"),
+}
+
+
+def budget_for(program: str) -> Optional[Budget]:
+    return BUDGETS.get(program)
+
+
+def check(report, budget: Optional[Budget] = None) -> List[str]:
+    """Violations of ``budget`` (default: the program's registry entry)
+    in ``report``. Empty list = within budget."""
+    if budget is None:
+        budget = budget_for(report.program)
+    if budget is None:
+        return [f"no budget registered for program {report.program!r}"]
+    v: List[str] = []
+    m = report.metrics
+
+    flagged = m.get("host_syncs_flagged")
+    if flagged is not None and flagged > budget.flagged_syncs:
+        v.append(f"host_syncs_flagged {flagged} > {budget.flagged_syncs}")
+    allowed = m.get("host_syncs_allowed") or {}
+    replays = max(1, m.get("replays", 1))
+    for label, count in allowed.items():
+        cap = budget.allowed_syncs_per_replay.get(label)
+        if cap is None:
+            v.append(f"allowed sync label {label!r} not in budget "
+                     f"({count}x)")
+        elif count > cap * replays:
+            v.append(f"allowed sync {label!r}: {count} > "
+                     f"{cap}/replay x {replays}")
+
+    compiles = m.get("warm_compiles")
+    if compiles is not None and compiles > budget.warm_compiles:
+        v.append(f"warm_compiles {compiles} > {budget.warm_compiles}")
+
+    import jax
+
+    if jax.default_backend() == budget.bytes_platform:
+        for key, cap in (("relayout_bytes", budget.relayout_bytes_max),
+                         ("pack_bytes", budget.pack_bytes_max),
+                         ("undonated_bytes", budget.undonated_bytes_max)):
+            val = m.get(key)
+            if cap is not None and val is not None and val > cap:
+                v.append(f"{key} {val / _MiB:.2f} MiB > "
+                         f"{cap / _MiB:.2f} MiB")
+
+    if budget.require_collectives_clean:
+        bad = [f for f in report.findings
+               if f.pass_name == "collective" and f.severity == "hazard"]
+        if bad:
+            v.append(f"{len(bad)} collective hazards: {bad[0].message}")
+    return v

@@ -1,0 +1,277 @@
+"""The four registered canonical programs the gate audits.
+
+Each is a miniaturised-but-structurally-faithful instance of a hot path
+whose hazard ledger earlier rounds paid for by hand:
+
+* ``amp_o2_train_step``      — conv+BN+linear AMP-O2 ``fused_train_step``
+  (the r8 GradScaler/donation territory: params+opt state must alias,
+  zero host syncs per step).
+* ``decode_tick``            — the serving engine's fused decode chunk
+  (r6 territory: pure device loop, zero syncs, zero relayouts of the KV
+  cache).
+* ``serving_segment``        — the re-entrant continuous-batching
+  segment + its host replay (r7 territory: exactly ONE allowed
+  device_get per segment, no stray shape compiles).
+* ``fused_optimizer_update`` — ``Optimizer.step``'s donated jit update
+  over a mixed-shape population (the r8 relayout-ledger territory: the
+  stack/concat pack bytes are THE metric).
+
+Builders are deterministic (fixed seeds, fixed shapes) so the measured
+metrics are stable run to run and ``budgets.py`` can pin them as exact
+ceilings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["ProgramHandle", "register", "build", "names", "CANONICAL"]
+
+
+@dataclass
+class ProgramHandle:
+    name: str
+    hlo: Callable[[], str]          # optimized HLO text (compiled, cached)
+    replay: Callable[[], Any]       # ONE warm iteration of the hot loop
+    mesh: Any = None
+    donation_threshold: int = 1 << 20
+    expected_undonated: Tuple[str, ...] = ()
+    allowed_axes: Optional[Tuple[str, ...]] = None
+    notes: str = ""
+    keepalive: tuple = ()           # pins models/engines for the handle's life
+
+
+CANONICAL: Dict[str, Callable[[], ProgramHandle]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        CANONICAL[name] = fn
+        return fn
+    return deco
+
+
+def names() -> List[str]:
+    return sorted(CANONICAL)
+
+
+def build(name: str) -> ProgramHandle:
+    if name not in CANONICAL:
+        raise KeyError(f"unknown canonical program {name!r}; "
+                       f"registered: {names()}")
+    return CANONICAL[name]()
+
+
+def _memo(fn):
+    box: list = []
+
+    def wrapped():
+        if not box:
+            box.append(fn())
+        return box[0]
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# 1. AMP-O2 train step
+# ---------------------------------------------------------------------------
+
+
+@register("amp_o2_train_step")
+def _build_amp_o2_train_step() -> ProgramHandle:
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    model = nn.Sequential(
+        nn.Conv2D(3, 16, 3, padding=1), nn.BatchNorm2D(16), nn.ReLU(),
+        nn.MaxPool2D(2), nn.Flatten(),
+        nn.Linear(16 * 16 * 16, 128), nn.ReLU(), nn.Linear(128, 10))
+    model.train()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    model, opt = paddle.amp.decorate(models=model, optimizers=opt,
+                                     level="O2", dtype="bfloat16")
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(x, y):
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            return ce(model(x), y)
+
+    step = paddle.jit.fused_train_step(loss_fn, opt, model=model)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(8, 3, 32, 32).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (8,)))
+
+    return ProgramHandle(
+        name="amp_o2_train_step",
+        hlo=_memo(lambda: step.compiled_text(x, y)),
+        replay=lambda: step(x, y),
+        # the batch, labels, RNG key, BN buffers and per-step scalars ride
+        # undonated by design; params + velocity alias in place
+        donation_threshold=1 << 18,
+        expected_undonated=(),
+        notes="conv+BN AMP-O2 fused train step, b8 32x32, Momentum",
+        keepalive=(model, opt, step, x, y))
+
+
+# ---------------------------------------------------------------------------
+# 2 + 3. Serving programs (one tiny engine serves both)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_engine():
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg)
+    eng = ServingEngine(cfg, params, slots=4, max_len=64, chunk=8,
+                        prompt_buckets=(16,))
+    return cfg, params, eng, jnp
+
+
+@register("decode_tick")
+def _build_decode_tick() -> ProgramHandle:
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import llama
+
+    cfg, params, eng, _ = _tiny_engine()
+    decode = eng._decode_prog
+
+    def fresh_args():
+        cache = llama.init_kv_cache(cfg, eng.slots, eng.max_len)
+        pos = jnp.full((eng.slots,), 4, jnp.int32)
+        nxt = jnp.ones((eng.slots,), jnp.int32)
+        rem = jnp.full((eng.slots,), eng.chunk, jnp.int32)
+        return params, cache, pos, nxt, rem
+
+    def hlo():
+        return decode.lower(*fresh_args()).compile().as_text()
+
+    def replay():
+        # the chunk donates the cache, so every iteration rebuilds one
+        # (zeros program: compiles once in warmup); NO host fetch — the
+        # tick is the pure device loop
+        return decode(*fresh_args())
+
+    return ProgramHandle(
+        name="decode_tick",
+        hlo=_memo(hlo),
+        replay=replay,
+        # model weights legitimately stay live across ticks; only the KV
+        # cache is donation-critical and the budget pins the measured
+        # undonated total so a NEW large undonated buffer regresses it
+        donation_threshold=1 << 16,
+        expected_undonated=(),
+        notes="fused decode chunk (8 ticks), llama-tiny, 4 slots",
+        keepalive=(eng,))
+
+
+@register("serving_segment")
+def _build_serving_segment() -> ProgramHandle:
+    import numpy as np
+
+    cfg, params, eng, jnp = _tiny_engine()
+    rng = np.random.RandomState(0)
+
+    def replay():
+        # end-to-end segment: enqueue two requests, run ONE fused
+        # segment, host-replay the event log. The device_get inside
+        # run_segment is the intended per-segment fetch (allowed_sync);
+        # every request finishes inside the segment so slot state drains
+        for _ in range(2):
+            eng.add_request(rng.randint(0, cfg.vocab_size, (12,)), 4)
+        return eng.run_segment(12)
+
+    def hlo():
+        seg = eng._segment_prog(eng._pow2(eng.slots), eng.buckets[-1], 0, 12)
+        n_pad = eng._pow2(eng.slots)
+        s_max = eng.buckets[-1]
+        import jax.numpy as j
+
+        from paddle_tpu.models import llama
+
+        L, Hkv, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+        cache = llama.init_kv_cache(cfg, eng.slots, eng.max_len)
+        return seg.lower(
+            params, cache, j.zeros((eng.slots,), j.int32),
+            j.zeros((eng.slots,), j.int32), j.zeros((eng.slots,), j.int32),
+            j.zeros((n_pad, s_max), j.int32), j.ones((n_pad,), j.int32),
+            j.zeros((n_pad,), j.int32),
+            j.zeros((n_pad, L, 0, Hkv, D), cache["k"].dtype),
+            j.zeros((n_pad, L, 0, Hkv, D), cache["v"].dtype),
+            j.zeros((n_pad,), j.int32), j.int32(2)).compile().as_text()
+
+    return ProgramHandle(
+        name="serving_segment",
+        hlo=_memo(hlo),
+        replay=replay,
+        donation_threshold=1 << 16,
+        expected_undonated=(),
+        notes="re-entrant fused segment + host event replay, llama-tiny",
+        keepalive=(eng,))
+
+
+# ---------------------------------------------------------------------------
+# 4. Fused optimizer update
+# ---------------------------------------------------------------------------
+
+
+@register("fused_optimizer_update")
+def _build_fused_optimizer_update() -> ProgramHandle:
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    # the r8 ledger population in miniature: a few big tiled tensors +
+    # a crowd of small 1-D rows (the launch-bound class the flat pack
+    # exists for)
+    shapes = ([(128, 256)] * 2 + [(256,)] * 8 + [(64, 64)] * 4
+              + [(32,)] * 6)
+    rng = np.random.RandomState(0)
+    params = [nn.Parameter(jnp.asarray(rng.randn(*s), jnp.float32))
+              for s in shapes]
+    opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                    parameters=params)
+
+    def grads(seed):
+        r = np.random.RandomState(seed)
+        return [jnp.asarray(r.randn(*s).astype(np.float32)) for s in shapes]
+
+    gsets = [grads(s) for s in range(3)]
+    it = [0]
+
+    def replay():
+        gs = gsets[it[0] % len(gsets)]
+        it[0] += 1
+        for p, g in zip(params, gs):
+            p.grad = paddle.Tensor(g, stop_gradient=True)
+        opt.step()
+
+    def hlo():
+        replay()  # materialise _jit_update + warm state
+        pvals = [p._value for p in params]
+        svals = [{k: opt._accumulators[id(p)][k]
+                  for k in opt._state_names()} for p in params]
+        evals = [opt._per_param_extras(p) for p in params]
+        return opt._jit_update.lower(
+            pvals, gsets[0], svals, evals, jnp.float32(opt.get_lr()),
+            jnp.int32(opt._step_count + 1)).compile().as_text()
+
+    return ProgramHandle(
+        name="fused_optimizer_update",
+        hlo=_memo(hlo),
+        replay=replay,
+        donation_threshold=1 << 16,
+        expected_undonated=(),
+        notes="Momentum multi-tensor update, 20 mixed-shape tensors "
+              "(pack/relayout ledger program)",
+        keepalive=(params, opt))

@@ -1,0 +1,249 @@
+"""Host-sync detector — the GradScaler bug class, made un-reintroducible.
+
+Every perf round so far found at least one hidden device→host sync in a
+hot loop (r8: per-param ``bool()`` in ``GradScaler.unscale_`` cost ~161
+blocking round trips per ResNet step; r7: stray ``.item()`` polls in
+early scheduler drafts). A sync is invisible in the jaxpr — it happens in
+HOST code between dispatches — so the static HLO passes can't see it.
+This module instruments the coercion surface instead:
+
+* framework ``Tensor`` coercions (``__bool__``/``item()``/``numpy()``/
+  ``__array__``/``__float__``/``__int__``) via the audit hook
+  ``core.tensor`` exposes (zero overhead when no audit is active);
+* raw ``jax.Array`` coercions and ``jax.device_get`` via context-scoped
+  patches (serving fetches its event log through ``device_get``, never
+  through a framework Tensor).
+
+``allowed_sync(label)`` marks a region whose syncs are INTENDED — the
+per-segment event fetch in ``ServingEngine.run_segment``, the single
+fused finite-check in ``GradScaler.unscale_``. The audit separates
+allowed from flagged events; budgets pin allowed labels to exact counts
+and flagged syncs to zero.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = ["SyncEvent", "SyncAudit", "allowed_sync", "audit_active"]
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.allowed: List[str] = []   # stack of allowed-sync labels
+        self.suppress = False          # one sync = one event (bool -> item)
+
+
+_tls = _TLS()
+_AUDITS: List["SyncAudit"] = []       # active audit stack (outermost first)
+
+
+def audit_active() -> bool:
+    return bool(_AUDITS)
+
+
+@dataclass
+class SyncEvent:
+    kind: str                 # 'tensor.bool', 'array.item', 'device_get', ...
+    site: str                 # "file.py:123 in fn" — first non-framework frame
+    label: Optional[str]      # allowed-sync label, None = flagged
+    phase: Optional[str]      # audit phase active when it fired (replay tag)
+    nbytes: int = 0           # payload when known (0 when not)
+    stack: List[str] = field(default_factory=list)
+
+    @property
+    def flagged(self) -> bool:
+        return self.label is None
+
+
+_SKIP_FRAMES = ("paddle_tpu/analysis/", "paddle_tpu/core/tensor.py",
+                "contextlib.py", "threading.py")
+
+
+def _call_site() -> tuple:
+    """(site, short-stack) of the user code that forced the sync."""
+    frames = traceback.extract_stack()[:-3]  # drop notify/_record/ourselves
+    stack = [f"{f.filename}:{f.lineno} in {f.name}" for f in frames[-8:]]
+    for f in reversed(frames):
+        if not any(s in f.filename for s in _SKIP_FRAMES):
+            return f"{f.filename}:{f.lineno} in {f.name}", stack
+    return stack[-1] if stack else "<unknown>", stack
+
+
+def _leaf_bytes(value: Any) -> int:
+    try:
+        import jax
+
+        return sum(int(l.size) * l.dtype.itemsize
+                   for l in jax.tree_util.tree_leaves(value)
+                   if hasattr(l, "dtype"))
+    except Exception:
+        return 0
+
+
+def _notify(kind: str, value: Any = None) -> None:
+    """Record one device→host sync on every active audit."""
+    if not _AUDITS or _tls.suppress:
+        return
+    site, stack = _call_site()
+    label = _tls.allowed[-1] if _tls.allowed else None
+    nbytes = _leaf_bytes(value) if value is not None else 0
+    for audit in _AUDITS:
+        audit._record(SyncEvent(kind=kind, site=site, label=label,
+                                phase=audit.phase, nbytes=nbytes,
+                                stack=stack))
+
+
+@contextlib.contextmanager
+def _sync_scope(kind: str, value: Any = None):
+    """Notify once, then suppress nested notifications for the duration
+    (``Tensor.__bool__`` → ``item()`` → ``ArrayImpl.__array__`` is ONE
+    sync, not three)."""
+    _notify(kind, value)
+    saved = _tls.suppress
+    _tls.suppress = True
+    try:
+        yield
+    finally:
+        _tls.suppress = saved
+
+
+class allowed_sync:
+    """Mark the enclosed region's syncs as intended, under ``label``.
+
+    Used by the framework at its sanctioned hot-loop sync points
+    (serving's per-segment event fetch, AMP's fused finite check) and by
+    user code to whitelist its own fetches. Cheap enough for hot loops:
+    two list ops, audit or no audit."""
+
+    __slots__ = ("label",)
+
+    def __init__(self, label: str):
+        self.label = label
+
+    def __enter__(self):
+        _tls.allowed.append(self.label)
+        return self
+
+    def __exit__(self, *exc):
+        _tls.allowed.pop()
+        return False
+
+
+class SyncAudit:
+    """Context manager collecting every device→host sync in scope.
+
+    ``phase`` tags let a caller separate warmup from the measured replay::
+
+        with SyncAudit() as audit:
+            audit.phase = "warm"
+            step(x, y)              # compiles + first syncs — not judged
+            audit.phase = "replay"
+            step(x, y)
+        flagged = audit.flagged("replay")
+    """
+
+    def __init__(self):
+        self.events: List[SyncEvent] = []
+        self.phase: Optional[str] = None
+
+    # -- collection --------------------------------------------------------
+    def _record(self, ev: SyncEvent) -> None:
+        self.events.append(ev)
+
+    def flagged(self, phase: Optional[str] = None) -> List[SyncEvent]:
+        return [e for e in self.events if e.flagged
+                and (phase is None or e.phase == phase)]
+
+    def allowed(self, phase: Optional[str] = None) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self.events:
+            if e.label is not None and (phase is None or e.phase == phase):
+                out[e.label] = out.get(e.label, 0) + 1
+        return out
+
+    # -- scope management --------------------------------------------------
+    def __enter__(self):
+        _AUDITS.append(self)
+        if len(_AUDITS) == 1:
+            _install_patches()
+        return self
+
+    def __exit__(self, *exc):
+        _AUDITS.remove(self)
+        if not _AUDITS:
+            _remove_patches()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Instrumentation: framework Tensors notify through the hook list in
+# core.tensor; raw jax arrays (serving's device_get, host int() reads of
+# device scalars) need the array type itself wrapped. Patches live only
+# while at least one audit is active and are fully restored after.
+# ---------------------------------------------------------------------------
+
+_ORIG: Dict[str, Any] = {}
+
+
+def _wrap_method(cls, name: str, kind: str):
+    orig = getattr(cls, name)
+
+    def wrapped(self, *a, **kw):
+        _notify(kind, self)
+        saved = _tls.suppress
+        _tls.suppress = True
+        try:
+            return orig(self, *a, **kw)
+        finally:
+            _tls.suppress = saved
+
+    wrapped.__name__ = name
+    _ORIG[f"{cls.__name__}.{name}"] = (cls, name, orig)
+    setattr(cls, name, wrapped)
+
+
+def _install_patches() -> None:
+    import jax
+    from jax._src import array as _jarray
+
+    from ..core import tensor as _tensor
+
+    _tensor._SYNC_AUDIT_HOOK.append(_sync_scope)
+
+    cls = _jarray.ArrayImpl
+    try:
+        for name, kind in (("__bool__", "array.bool"),
+                           ("__int__", "array.int"),
+                           ("__float__", "array.float"),
+                           ("__index__", "array.index"),
+                           ("item", "array.item"),
+                           ("__array__", "array.numpy"),
+                           ("tolist", "array.tolist")):
+            if hasattr(cls, name):
+                _wrap_method(cls, name, kind)
+    except (AttributeError, TypeError):  # C-extension type: degrade to
+        pass                             # Tensor + device_get coverage
+
+    orig_get = jax.device_get
+
+    def device_get(x):
+        with _sync_scope("device_get", x):
+            return orig_get(x)
+
+    _ORIG["jax.device_get"] = (jax, "device_get", orig_get)
+    jax.device_get = device_get
+
+
+def _remove_patches() -> None:
+    from ..core import tensor as _tensor
+
+    if _sync_scope in _tensor._SYNC_AUDIT_HOOK:
+        _tensor._SYNC_AUDIT_HOOK.remove(_sync_scope)
+    for cls, name, orig in _ORIG.values():
+        setattr(cls, name, orig)
+    _ORIG.clear()

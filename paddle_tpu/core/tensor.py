@@ -25,6 +25,20 @@ from .place import CPUPlace, CUDAPlace, Place, TPUPlace, device_for_place, expec
 
 __all__ = ["Tensor", "to_tensor"]
 
+# Host-sync audit hook (analysis.syncs): while a SyncAudit is active it
+# holds ONE context-factory `(kind, value) -> contextmanager`; every
+# device→host coercion below enters it so the auditor can record the
+# sync (and its call site) without the framework paying anything when no
+# audit is running — the list is empty then and the check is one truth
+# test. Reference hazard class: the r8 GradScaler per-param ``bool()``.
+_SYNC_AUDIT_HOOK: list = []
+
+
+def _sync_scope(kind, value):
+    """Audit scope for one coercion; nullcontext-free fast path."""
+    return _SYNC_AUDIT_HOOK[0](kind, value)
+
+
 _tensor_counter = [0]
 
 
@@ -152,6 +166,12 @@ class Tensor:
 
     # -- conversion ---------------------------------------------------------
     def numpy(self) -> np.ndarray:
+        if _SYNC_AUDIT_HOOK:
+            with _sync_scope("tensor.numpy", self._value):
+                return self._numpy_impl()
+        return self._numpy_impl()
+
+    def _numpy_impl(self) -> np.ndarray:
         v = self._value
         if jnp.issubdtype(v.dtype, jnp.complexfloating):
             # some PJRT transports (the axon TPU tunnel) can't transfer
@@ -165,23 +185,42 @@ class Tensor:
         return np.asarray(v)
 
     def item(self):
+        if _SYNC_AUDIT_HOOK:
+            with _sync_scope("tensor.item", self._value):
+                return self._item_impl()
+        return self._item_impl()
+
+    def _item_impl(self):
         return self._value.item() if hasattr(self._value, "item") else self._value
 
     def tolist(self):
         return self.numpy().tolist()
 
     def __array__(self, dtype=None):
-        a = self.numpy()
+        if _SYNC_AUDIT_HOOK:
+            with _sync_scope("tensor.numpy", self._value):
+                a = self._numpy_impl()
+        else:
+            a = self._numpy_impl()
         return a.astype(dtype) if dtype is not None else a
 
     def __float__(self):
-        return float(self.item())
+        if _SYNC_AUDIT_HOOK:
+            with _sync_scope("tensor.float", self._value):
+                return float(self._item_impl())
+        return float(self._item_impl())
 
     def __int__(self):
-        return int(self.item())
+        if _SYNC_AUDIT_HOOK:
+            with _sync_scope("tensor.int", self._value):
+                return int(self._item_impl())
+        return int(self._item_impl())
 
     def __bool__(self):
-        return bool(self.item())
+        if _SYNC_AUDIT_HOOK:
+            with _sync_scope("tensor.bool", self._value):
+                return bool(self._item_impl())
+        return bool(self._item_impl())
 
     def __len__(self):
         if not self._value.shape:

@@ -172,6 +172,26 @@ def _attribute_pairs(pairs, mesh_shape: Dict[str, int]) -> Optional[Tuple[str, .
                 return (a,)
             if got and got < expect and partial is None:
                 partial = (f"{a}:partial-ring",)
+    # a BIJECTION over ALL devices that equals re-enumerating the mesh
+    # in a different axis order is GSPMD's resharding relabel (this
+    # container's XLA emits a few hundred bytes of them around small
+    # replicated buffers in hybrid programs) — categorically not axis
+    # traffic, so tag it distinctly instead of crediting an axis or
+    # reporting unknown traffic
+    n = int(np.prod(sizes))
+    if (len(got) == n
+            and {s for s, _ in got} == set(range(n))
+            and {t for _, t in got} == set(range(n))):
+        for perm in itertools.permutations(range(len(sizes))):
+            if perm == tuple(range(len(sizes))):
+                continue
+            relabeled = ids.transpose(perm).reshape(-1)
+            fwd = frozenset((int(s), int(t))
+                            for t, s in enumerate(relabeled))
+            rev = frozenset((int(s), int(t))
+                            for s, t in enumerate(relabeled))
+            if got in (fwd, rev):
+                return ("<mesh-relabel>",)
     return partial
 
 

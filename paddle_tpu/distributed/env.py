@@ -84,6 +84,20 @@ def init_parallel_env(strategy=None):
                 "PADDLE_TRAINERS_NUM>1 but no PADDLE_MASTER/PADDLE_TRAINER_ENDPOINTS "
                 "set — launch with python -m paddle_tpu.distributed.launch"
             )
+        # platform WITHOUT initializing the backend (default_backend()
+        # would lock the runtime single-process before initialize())
+        platforms = (getattr(jax.config, "jax_platforms", None)
+                     or os.environ.get("JAX_PLATFORMS") or "")
+        if "cpu" in platforms:
+            # this jaxlib's CPU client refuses multi-process computations
+            # under its default (in-process) collectives — the gloo
+            # transport is the supported cross-process path (the virtual
+            # Gloo-fallback role the reference plays on CPU)
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass  # older/newer jax without the knob: keep defaults
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=env.world_size,

@@ -89,15 +89,34 @@ class _GroupShardedOptimizer(HybridParallelOptimizer):
         if mesh is None:
             return
         opt = self._inner_opt
+        host_kind, device_kind = self._memory_kinds(mesh)
         for state in opt._accumulators.values():
             for k, v in list(state.items()):
                 if not hasattr(v, "ndim") or v.ndim == 0:
                     continue
                 spec = zero_shard_spec(v.shape, mesh) or P(*([None] * v.ndim))
                 sh = NamedSharding(mesh, spec,
-                                   memory_kind="pinned_host" if to_host
-                                   else "device")
+                                   memory_kind=host_kind if to_host
+                                   else device_kind)
                 state[k] = jax.device_put(v, sh)
+
+    @staticmethod
+    def _memory_kinds(mesh):
+        """(host_kind, device_kind) the mesh's devices actually address.
+        TPUs expose ("pinned_host", "device"); this container's CPU
+        backend advertises only "unpinned_host" for BOTH roles — same
+        host-residency semantics for the offload contract, so take what
+        the runtime offers instead of hard-coding the TPU names."""
+        try:
+            dev = mesh.devices.flat[0]
+            kinds = {m.kind for m in dev.addressable_memories()}
+            device_kind = dev.default_memory().kind
+        except Exception:
+            return "pinned_host", "device"
+        for kind in ("pinned_host", "unpinned_host"):
+            if kind in kinds:
+                return kind, device_kind
+        return device_kind, device_kind
 
     def step(self):
         if self._offload:

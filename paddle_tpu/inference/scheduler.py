@@ -30,6 +30,15 @@ module owns that loop:
 * **Shared-prefix KV reuse** — pass a ``PrefixCache``; admission
   detects cached prefixes and the segment program prefills suffixes
   only (see inference/prefix_cache.py).
+
+Audited sync contract (r9, ``paddle_tpu.analysis``): the serve loop
+performs exactly ONE device→host sync per segment — the event fetch in
+``ServingEngine.run_segment``, marked ``allowed_sync
+("serving.segment_event_fetch")``. The r9 audit over the full online
+loop found no other sync: the host replay, telemetry stamping, queue
+management and prefix bookkeeping all work on host mirrors of the
+fetched event log. ``tests/test_analysis.py::TestSchedulerAudit``
+enforces this per segment, so a per-token poll cannot silently return.
 """
 
 from __future__ import annotations

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.syncs import allowed_sync
 from ..models import llama
 
 __all__ = ["Request", "ServingEngine"]
@@ -109,6 +110,18 @@ class ServingEngine:
         self._pos = jnp.zeros((self.slots,), jnp.int32)
         self._nxt = jnp.zeros((self.slots,), jnp.int32)
         self._rem = jnp.zeros((self.slots,), jnp.int32)
+        from ..jit import register_compiled_cache
+
+        register_compiled_cache(self)  # analysis.recompile introspection
+
+    def cache_info(self) -> dict:
+        """Compiled-program cache keys (analysis.recompile lint): admit
+        programs key on (bucket, nb), segments on ("seg", n_pad, s_max,
+        pre_max, steps) — all bucketed by construction, so key-count
+        growth here means a shape leaked past the buckets (the 2.5 s
+        mid-serve compile class this engine's width pinning fixed)."""
+        return {"name": f"serving_engine:slots{self.slots}",
+                "keys": list(self._progs.keys())}
 
     def decode_kernel_active(self) -> bool:
         """True when this engine's decode ticks route to the ragged
@@ -715,7 +728,11 @@ class ServingEngine:
             jnp.asarray(prompts), jnp.asarray(lens), jnp.asarray(gens),
             pk, pv, jnp.asarray(pre_lens), jnp.int32(n))
         self._cache, self._pos, self._nxt, self._rem = out[:4]
-        toks, aq, aslot, steps, qadm = jax.device_get(out[4:])
+        # THE per-segment sync: the one place the online serve loop is
+        # allowed to block on the device (audited — see analysis.syncs;
+        # the budget pins it to exactly one per segment)
+        with allowed_sync("serving.segment_event_fetch"):
+            toks, aq, aslot, steps, qadm = jax.device_get(out[4:])
         steps, qadm = int(steps), int(qadm)
         self.last_run_ticks += steps
         self.last_run_chunks += 1

@@ -16,6 +16,7 @@ from __future__ import annotations
 import functools
 import os
 import pickle
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -35,6 +36,25 @@ __all__ = ["to_static", "enable_to_static", "TracedProgram", "save", "load",
            "set_code_level", "set_verbosity"]
 
 _TRACING = [False]
+
+# ---------------------------------------------------------------------------
+# Compiled-program cache registry (analysis.recompile introspection):
+# every object that owns a jit cache (TracedProgram, FusedTrainStep,
+# ServingEngine, Optimizer) registers itself here so the recompile-hazard
+# lint can enumerate live caches and inspect their keys. Weak refs — the
+# registry must not pin models/engines alive.
+# ---------------------------------------------------------------------------
+
+_PROGRAM_CACHES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_compiled_cache(obj) -> None:
+    """Register an object exposing ``cache_info() -> {"name", "keys"}``."""
+    _PROGRAM_CACHES.add(obj)
+
+
+def live_program_caches() -> List[Any]:
+    return list(_PROGRAM_CACHES)
 
 
 def is_tracing() -> bool:
@@ -142,6 +162,14 @@ class TracedProgram:
         functools.update_wrapper(self, self._orig_fn,
                                  assigned=("__name__", "__doc__", "__qualname__"),
                                  updated=())
+        register_compiled_cache(self)
+
+    def cache_info(self) -> Dict[str, Any]:
+        """Cache-key introspection for the recompile-hazard lint: each
+        key is ``(arg_tree, shape-signature, kwargs, training)`` — many
+        shape variants under one structure means an unbucketed dim."""
+        return {"name": f"to_static:{getattr(self, '__name__', 'fn')}",
+                "keys": list(self._cache.keys())}
 
     def _make_pure(self, params, buffers, tensor_args, rest_args, rest_kwargs,
                    arg_tree):
@@ -558,6 +586,23 @@ class FusedTrainStep:
         self._const_key = None  # fixed key for randomness-free programs
         self._setup_cache = None  # (model, ids, params, ...) static state
         self._key_sharding = _UNSET  # lazily scanned from the param set
+        register_compiled_cache(self)
+
+    def cache_info(self) -> Dict[str, Any]:
+        """Cache-key introspection (analysis.recompile): keys carry the
+        arg tree, input shape signature, param-set identity, train/eval
+        mode and the optimizer-kernel dispatch signature."""
+        name = getattr(self._loss_fn, "__name__", "loss_fn")
+        return {"name": f"fused_train_step:{name}",
+                "keys": list(self._cache.keys())}
+
+    def compiled_text(self, *inputs) -> str:
+        """Optimized HLO of the step compiled for these inputs (the
+        program-auditor entry point: compiles AOT, executes nothing)."""
+        entry, _, call_tail = self._prepare(inputs)
+        dummy_key = self._place_key(jax.random.key_data(jax.random.key(0)))
+        compiled = entry.ensure_compiled(dummy_key, *call_tail)
+        return compiled.as_text()
 
     def _state_setup(self):
         opt = self._opt

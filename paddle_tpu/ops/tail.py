@@ -60,7 +60,19 @@ nextafter = _b("nextafter", jnp.nextafter, differentiable=False)
 heaviside = _b("heaviside", lambda a, b: jnp.where(
     jnp.isnan(a), jnp.nan,
     jnp.where(a > 0, 1.0, jnp.where(a < 0, 0.0, b))).astype(a.dtype))
-ldexp = _b("ldexp", lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)))
+def _ldexp(a, b):
+    # split the exponent: this container's jnp.ldexp computes a * 2.0**b
+    # directly, so |b| >= 128 overflows f32 even when a * 2**b is
+    # representable (1e-30 * 2**130 ~ 1.4e9); two half-sized exp2 factors
+    # keep every representable result finite
+    b = b.astype(jnp.int32)
+    f = a if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) \
+        else jnp.asarray(a, jnp.float32)
+    h = b // 2
+    return f * jnp.exp2(h.astype(f.dtype)) * jnp.exp2((b - h).astype(f.dtype))
+
+
+ldexp = _b("ldexp", _ldexp)
 bitwise_left_shift = _b("bitwise_left_shift", jnp.left_shift,
                         differentiable=False)
 bitwise_right_shift = _b("bitwise_right_shift", jnp.right_shift,

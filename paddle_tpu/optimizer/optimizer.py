@@ -359,6 +359,9 @@ class Optimizer:
             self._static_evals_key = evals_key
         self._static_evals = static_evals
         if self._jit_update is None:
+            from ..jit import register_compiled_cache
+
+            register_compiled_cache(self)  # analysis.recompile introspection
             l2 = self._l2_coeff
             decay_in_grad = self._apply_weight_decay_to_grad()
             opt = self
@@ -409,6 +412,14 @@ class Optimizer:
         loss.backward()
         self.step()
         return None, None
+
+    def cache_info(self):
+        """Cache-key introspection (analysis.recompile): the donated jit
+        update retraces per (static-extras, kernel-dispatch) signature;
+        jax.jit handles shape keying underneath."""
+        key = getattr(self, "_static_evals_key", None)
+        return {"name": f"optimizer_update:{type(self).__name__}",
+                "keys": [key] if key is not None else []}
 
     def _set_parameters(self, parameters):
         self._parameter_list = list(parameters)

@@ -134,6 +134,11 @@ def with_sharding_constraint(x, spec: PartitionSpec, mesh: Optional[Mesh] = None
     mesh = mesh or _GLOBAL_MESH
     if mesh is None:
         return x
+    if mesh.devices.size == 1:
+        # a 1-device mesh constrains nothing, and pinning it would break
+        # callers whose ARGUMENTS ride a bigger mesh than the (stale)
+        # global one — this jax rejects the device-set mismatch outright
+        return x
     _guard_manual_program(spec, mesh)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 

@@ -28,6 +28,20 @@ def latest_xplane(log_dir: str) -> Optional[str]:
     return max(files, key=os.path.getmtime) if files else None
 
 
+def _profile_data():
+    """The xplane reader: jax's ProfileData binding when this jaxlib
+    ships it, else the in-tree wire-format parser (same attribute
+    surface; see _xplane_pb)."""
+    try:
+        from jax.profiler import ProfileData
+
+        return ProfileData
+    except ImportError:
+        from ._xplane_pb import XSpaceData
+
+        return XSpaceData
+
+
 _HLO_RE = re.compile(r"=\s*\S+\s+([a-zA-Z][\w-]*)\(")
 
 
@@ -56,9 +70,7 @@ def parse(log_dir: str):
     path = latest_xplane(log_dir)
     if path is None:
         return None, []
-    from jax.profiler import ProfileData
-
-    pd = ProfileData.from_file(path)
+    pd = _profile_data().from_file(path)
     tables = None
     chrome: List[dict] = []
     occs: List[float] = []
@@ -128,9 +140,7 @@ def instr_profile(log_dir: str, n_steps: int = 1):
     Shared by the benchmark profilers (step/decode/resnet)."""
     path = latest_xplane(log_dir)
     assert path, f"no xplane in {log_dir}"
-    from jax.profiler import ProfileData
-
-    pd = ProfileData.from_file(path)
+    pd = _profile_data().from_file(path)
     agg: Dict[str, List[float]] = {}
     total = 0.0
     for plane in pd.planes:

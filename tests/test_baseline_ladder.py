@@ -51,7 +51,11 @@ def test_config1_resnet_train_step():
     x = paddle.to_tensor(rng.rand(4, 3, 32, 32).astype(np.float32))
     y = paddle.to_tensor(rng.randint(0, 10, (4,)))
     l0 = float(step(x, y))
-    for _ in range(3):
+    # 7 follow-up steps, not 3: lr=0.1 Momentum on a 4-sample batch
+    # overshoots early (loss oscillates 3.5–12 through step 3 under this
+    # jax's conv rounding) before collapsing to ~1e-2 — the assertion
+    # targets the converged tail, not the transient
+    for _ in range(7):
         loss = step(x, y)
     assert float(loss) < l0
 
