@@ -74,6 +74,14 @@ def run(batch: int, seq: int):
     tokens_per_s = iters * batch * seq / best_dt
     flops_per_token = 6.0 * n_params  # fwd+bwd matmul FLOPs estimate
     mfu = tokens_per_s * flops_per_token / 197e12  # v5e bf16 peak ≈197 TF/s
+    # r10: headline utilisation reports THROUGH the metrics layer — the
+    # same gauges an operator scrapes, so the bench and the telemetry
+    # surface cannot drift apart
+    from paddle_tpu import observability as obs
+
+    obs.gauge("train.mfu").set(mfu)
+    obs.gauge("train.tokens_per_s").set(tokens_per_s)
+    obs.histogram("train.step_time_s").observe(best_dt / iters)
     log(f"b{batch}: {tokens_per_s:,.0f} tokens/s, step {best_dt/iters*1e3:.1f} ms, "
         f"MFU≈{mfu:.1%} (v5e)")
     return tokens_per_s
@@ -107,11 +115,18 @@ def main():
             "unit": "tokens/sec", "vs_baseline": 0.0, "error": "all batch sizes failed",
         }))
         return
+    from paddle_tpu import observability as obs
+
     print(json.dumps({
         "metric": "bert_base_equiv_pretrain_throughput",
         "value": round(tokens_per_s, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(tokens_per_s / A100_BALLPARK_TOKENS_PER_S, 4),
+        # read back from the gauge, not a local: the artifact publishes
+        # what the telemetry layer holds
+        "mfu": round(obs.gauge("train.mfu").value, 4),
+        "step_time_p50_s": round(
+            obs.histogram("train.step_time_s").quantile(0.5), 4),
     }))
 
 

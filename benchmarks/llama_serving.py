@@ -41,10 +41,54 @@ def log(*a):
 
 
 def _pctl(xs, q):
-    if not xs:
-        return 0.0
-    xs = sorted(xs)
-    return xs[min(len(xs) - 1, int(len(xs) * q))]
+    # the shared nearest-rank rule (r10: observability.metrics.percentile
+    # replaced this file's private copy, bit-identical)
+    from paddle_tpu.observability.metrics import percentile
+
+    return percentile(xs, q)
+
+
+def _telemetry_section(reset=False):
+    """Runtime-telemetry section for the JSON artifacts (r10): headline
+    operator numbers (occupancy, queue depth, hit rate, backpressure)
+    plus the full rank-tagged snapshot — SERVING_r*.json carries what an
+    operator would scrape, not just headline ratios. ``reset=True``
+    zeroes the registry first (call before a run so the section covers
+    exactly that run)."""
+    from paddle_tpu import observability as obs
+
+    if reset:
+        obs.reset()
+        obs.flight.clear()
+        return None
+    m = obs.metrics
+    hits = m.counter("serving.prefix_cache.hits").value
+    misses = m.counter("serving.prefix_cache.misses").value
+    lookups = hits + misses
+    return {
+        "headline": {
+            "slot_occupancy": round(
+                m.gauge("serving.slot_occupancy").value, 4),
+            "queue_depth_last": m.gauge("serving.queue_depth").value,
+            "segments": m.counter("serving.segments").value,
+            "ticks": m.counter("serving.ticks").value,
+            "admissions": m.counter("serving.admissions").value,
+            "tokens_generated": m.counter(
+                "serving.tokens_generated").value,
+            "backpressure_events": m.counter(
+                "serving.backpressure_events").value,
+            "prefix_hit_rate": round(hits / lookups, 4) if lookups else 0.0,
+            "ttft_p50_est_s": round(
+                m.histogram("serving.ttft_s").quantile(0.5), 4),
+            "ttft_p99_est_s": round(
+                m.histogram("serving.ttft_s").quantile(0.99), 4),
+            "e2e_p50_est_s": round(
+                m.histogram("serving.e2e_s").quantile(0.5), 4),
+            "backend_compiles": m.counter("jit.backend_compiles").value,
+        },
+        "snapshot": m.snapshot(),
+        "flight_tail": obs.flight.events()[-20:],
+    }
 
 
 def pick_model(name: str):
@@ -270,6 +314,7 @@ def run_online(model_name, cfg, params, llama, n=32, seed=0, slots=8,
     svc_tok_s, svc_req_s = measure_service_rate(cfg, params, n, seed, slots)
     log(f"service rate (offline fused drain): {svc_tok_s:,.0f} tok/s = "
         f"{svc_req_s:.2f} req/s")
+    _telemetry_section(reset=True)  # section covers the rated serves only
     per_rate = []
     for ratio in ratios:
         rate = ratio * svc_req_s
@@ -314,6 +359,7 @@ def run_online(model_name, cfg, params, llama, n=32, seed=0, slots=8,
         "per_rate": per_rate,
         "vs_fixed_throughput_min": round(
             min(r["vs_fixed_throughput"] for r in per_rate), 3),
+        "telemetry": _telemetry_section(),
     }
 
 
@@ -346,6 +392,7 @@ def run_prefix(model_name, cfg, params, llama, n=16, seed=3, slots=4,
         return rep, pc, sch.results()
 
     rep_cold, _, out_cold = serve(False)
+    _telemetry_section(reset=True)  # section covers the hit run only
     rep_hit, pc, out_hit = serve(True)
     assert out_cold == out_hit, "prefix-cache path changed tokens"
     gain = (rep_hit.throughput_tok_s / rep_cold.throughput_tok_s
@@ -369,6 +416,7 @@ def run_prefix(model_name, cfg, params, llama, n=16, seed=3, slots=4,
         "prefix_e2e_p50_s": round(rep_hit.e2e_p50_s, 4),
         "tokens_identical": True,
         "cache": pc.stats(),
+        "telemetry": _telemetry_section(),
     }
 
 
@@ -391,6 +439,7 @@ def smoke():
     from paddle_tpu.parallel import set_mesh
 
     set_mesh(None)
+    _telemetry_section(reset=True)  # evidence carries this run's metrics
     cfg = llama.LlamaConfig.tiny(max_seq_len=96)
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     # arrival rate ABOVE the tiny-config service rate: the run is
@@ -447,6 +496,7 @@ def smoke():
         "segments": rep.segments,
         "prefix_hits": pc.stats()["hits"],
         "prefix_identical": cold == hit,
+        "telemetry": _telemetry_section(),
     }
 
 

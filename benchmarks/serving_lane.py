@@ -10,7 +10,12 @@ Writes ``SERVING_r<N>.json`` at the repo root:
               0.5/1/2x the measured service rate, MEASURED per-request
               TTFT + e2e p50/p99, vs fixed batching...},
    "prefix": {...llama_serving --prefix json: shared-prefix KV cache
-              on/off tok/s...}}  (r7: the online serving subsystem)
+              on/off tok/s...},  (r7: the online serving subsystem)
+   "telemetry_headlines": {...r10 runtime-telemetry headlines per mode —
+              queue depth / slot occupancy / prefix hit rate /
+              backpressure counters from paddle_tpu.observability; the
+              full rank-tagged snapshots ride inside each mode's
+              "telemetry" section...}}
 
 Usage: python benchmarks/serving_lane.py [round_number]
 (no args: derives the round from the highest existing BENCH_r*.json,
@@ -74,6 +79,13 @@ def main() -> int:
         "prefix": _run_json("llama_serving.py", args=("--prefix",)),
     }
     result["platform"] = result["online"].get("platform", "unknown")
+    # r10: lift each mode's runtime-telemetry headline (queue depth,
+    # occupancy, hit rate, backpressure — the operator-scrape numbers) to
+    # the top level; the full rank-tagged snapshots stay nested under
+    # online/prefix "telemetry"
+    result["telemetry_headlines"] = {
+        k: (result[k].get("telemetry") or {}).get("headline")
+        for k in ("online", "prefix")}
     path = os.path.join(ROOT, f"SERVING_r{rnd:02d}.json")
     with open(path, "w") as f:
         json.dump(result, f, indent=1)

@@ -72,6 +72,32 @@ def _run_budget_gate(env) -> dict:
     return gate
 
 
+def _run_serving_telemetry(env) -> dict:
+    """r10: record a CHIP-SIDE runtime-telemetry snapshot — the serving
+    smoke workload on the real backend with the observability subsystem
+    on, so TPU_TESTS_r<N>.json embeds measured serving occupancy / TTFT
+    / admission metrics next to the test outcomes (the telemetry analog
+    of the budget gate: a metric that silently stops moving on chip is
+    visible in the round record)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join("benchmarks", "llama_serving.py"),
+         "--smoke"],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=600)
+    out = {"returncode": proc.returncode}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            ev = json.loads(line)
+            out["telemetry"] = ev.get("telemetry")
+            out["throughput_vs_fixed"] = ev.get("throughput_vs_fixed")
+            out["ttft_p50_s"] = ev.get("ttft_p50_s")
+            break
+        except json.JSONDecodeError:
+            continue
+    if proc.returncode != 0:
+        out["tail"] = proc.stderr[-1500:]
+    return out
+
+
 def _round_number(argv) -> int:
     if len(argv) > 1:
         return int(argv[1])
@@ -117,6 +143,7 @@ def main() -> int:
         m = re.search(r"(\d+) skipped", proc.stdout)
         counts["skipped"] = int(m.group(1)) if m else 0
     gate = _run_budget_gate(env)
+    serving_telemetry = _run_serving_telemetry(env)
     result = {
         "round": rnd,
         "platform": "tpu" if counts["passed"] else "unknown",
@@ -126,6 +153,7 @@ def main() -> int:
         "duration_s": round(dur, 1),
         "returncode": proc.returncode,
         "analysis_gate": gate,
+        "serving_telemetry": serving_telemetry,
         "tests": tests,
     }
     out_path = os.path.join(ROOT, f"TPU_TESTS_r{rnd:02d}.json")

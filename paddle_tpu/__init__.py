@@ -90,6 +90,7 @@ from . import text  # noqa: F401
 from . import utils  # noqa: F401
 from . import vision  # noqa: F401
 from . import inference  # noqa: F401
+from . import observability  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi.model_summary import flops, summary  # noqa: F401
 from .nn.layer.layers import ParamAttr  # noqa: F401

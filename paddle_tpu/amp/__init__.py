@@ -163,20 +163,32 @@ class GradScaler:
         host-side gate in ``step()`` is what keeps skip-update semantics
         for the Pallas fused update too (the kernel additionally accepts
         a traced skip flag for in-program gating — see
-        ops/pallas/multi_tensor_update.py)."""
+        ops/pallas/multi_tensor_update.py).
+
+        r10 telemetry: the global grad-norm RIDES the same fetch — its
+        square-sum accumulates next to the finite check and both scalars
+        come back in one batched ``device_get``, so the audited sync
+        count stays exactly one (zero-extra-sync contract; skipped
+        entirely when telemetry is disabled)."""
         if not self._enable or self._unscaled:
             return
         self._unscaled = True
         inv = 1.0 / self._scale
         found = None
         from ..core.autograd import densify_grad_
+        from ..observability import metrics as _obs
 
+        want_norm = _obs.enabled()
+        norm_sq = None
         for p in optimizer._params():
             if p.grad is not None:
                 densify_grad_(p)
                 g = p.grad._value * inv
                 bad = jnp.logical_not(jnp.isfinite(g)).any()
                 found = bad if found is None else jnp.logical_or(found, bad)
+                if want_norm:
+                    sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    norm_sq = sq if norm_sq is None else norm_sq + sq
                 p.grad._inplace_set(g)
         # the ONE sanctioned sync of the scaler step (audited: the
         # program auditor flags any bool() beyond this fused check —
@@ -184,7 +196,16 @@ class GradScaler:
         from ..analysis.syncs import allowed_sync
 
         with allowed_sync("amp.grad_scaler.finite_check"):
-            self._found_inf = bool(found) if found is not None else False
+            if found is None:
+                self._found_inf = False
+            elif norm_sq is not None:
+                import jax
+
+                f, n2 = jax.device_get([found, norm_sq])
+                self._found_inf = bool(f)
+                _obs.gauge("amp.grad_norm").set(float(n2) ** 0.5)
+            else:
+                self._found_inf = bool(found)
 
     def step(self, optimizer):
         """Unscale and conditionally apply — loss-scale DYNAMICS belong to
@@ -207,7 +228,13 @@ class GradScaler:
         self._unscaled = False
         if not (self._enable and self._dynamic):
             return
+        from ..observability import flight as _flight
+        from ..observability import metrics as _obs
+
         if self._found_inf:
+            _obs.counter("amp.found_inf_skips").inc()
+            _flight.record("loss_scale_skip", scale=self._scale,
+                           bad_steps=self._bad_steps + 1)
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every:
@@ -219,6 +246,7 @@ class GradScaler:
             if self._good_steps >= self._incr_every:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+        _obs.gauge("amp.loss_scale").set(self._scale)
         self._found_inf = False
 
     def is_enable(self):

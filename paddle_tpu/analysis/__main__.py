@@ -8,6 +8,10 @@ Usage::
                                                   # violation (tier-1 +
                                                   # chip-lane entry)
     python -m paddle_tpu.analysis --json out.json # machine-readable dump
+    python -m paddle_tpu.analysis --gate --telemetry on   # (default) the
+                                                  # r10 contract: budgets
+                                                  # identical with the
+                                                  # observability layer on
 """
 
 from __future__ import annotations
@@ -25,10 +29,16 @@ def main(argv=None) -> int:
                     help="fail (exit 1) on any budget violation")
     ap.add_argument("--replays", type=int, default=2)
     ap.add_argument("--json", default=None, help="write results as JSON")
+    ap.add_argument("--telemetry", choices=("on", "off"), default="on",
+                    help="audit with the observability subsystem enabled "
+                         "(default: on — the zero-extra-sync contract "
+                         "means budgets must be identical either way)")
     args = ap.parse_args(argv)
 
+    from .. import observability
     from . import audit_program, budgets, programs
 
+    prev_telemetry = observability.set_enabled(args.telemetry == "on")
     targets = args.program or programs.names()
     results = []
     any_violation = False
@@ -51,6 +61,7 @@ def main(argv=None) -> int:
             print("  budget: OK")
         print()
 
+    observability.set_enabled(prev_telemetry)
     if args.json:
         with open(args.json, "w") as f:
             json.dump(results, f, indent=1, default=str)

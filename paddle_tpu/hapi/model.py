@@ -19,6 +19,8 @@ from ..enforce import InvalidArgumentError
 from ..framework.io import load as _load
 from ..framework.io import save as _save
 from ..metric import Metric
+from ..observability import metrics as _obs
+from ..profiler import _hooks
 from .callbacks import config_callbacks
 
 __all__ = ["Model"]
@@ -136,7 +138,27 @@ class Model:
             return self._loss(*outs, *labs)
         return self._loss(*outs, *labs)
 
+    def _record_train_step(self, t0_ns: int, inputs, loss_val) -> None:
+        """Telemetry for one optimizer step (ISSUE 5): step-time histogram
+        + samples/s + loss gauges, and a host span in the profiler
+        timeline. Runs AFTER the loss fetch that already ended the step —
+        every input is a host value, so this adds zero device syncs."""
+        t1_ns = _hooks.now_ns()
+        _hooks.emit("hapi.train_batch", t0_ns, t1_ns, kind="train")
+        dt = (t1_ns - t0_ns) / 1e9
+        _obs.histogram("train.step_time_s").observe(dt)
+        _obs.counter("train.steps").inc()
+        if loss_val is not None:
+            _obs.gauge("train.loss").set(float(loss_val))
+        try:
+            bs = int(inputs[0].shape[0]) if inputs else 0
+        except Exception:
+            bs = 0
+        if bs and dt > 0:
+            _obs.gauge("train.samples_per_s").set(bs / dt)
+
     def train_batch(self, inputs, labels=None, update=True):
+        t0_ns = _hooks.now_ns()
         self.network.train()
         inputs = _as_tensor_batch(inputs)
         labels = _as_tensor_batch(labels) if labels is not None else []
@@ -204,10 +226,13 @@ class Model:
                     # optimizer update already committed, so a failure here
                     # must propagate rather than re-run the batch eagerly
                     # (which would apply the gradient twice)
-                    return self._finish_fused(
+                    res = self._finish_fused(
                         stepped, labels,
                         getattr(self, "_fused_pre_counts",
                                 [0] * len(self._metrics)))
+                    losses = res[0] if isinstance(res, tuple) else res
+                    self._record_train_step(t0_ns, inputs, losses[0])
+                    return res
         outputs = self.network(*inputs)
         loss = self._compute_loss(outputs, labels)
         loss.backward()
@@ -215,7 +240,10 @@ class Model:
             self._optimizer.step()
             self._optimizer.clear_grad()
         metrics = self._update_metrics(outputs, labels)
-        return ([float(loss.item())], metrics) if metrics else [float(loss.item())]
+        loss_f = float(loss.item())
+        if update and self._optimizer is not None:
+            self._record_train_step(t0_ns, inputs, loss_f)
+        return ([loss_f], metrics) if metrics else [loss_f]
 
     def eval_batch(self, inputs, labels=None):
         from ..core.autograd import no_grad

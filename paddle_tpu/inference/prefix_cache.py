@@ -40,6 +40,9 @@ from typing import Optional
 
 import numpy as np
 
+from ..observability import flight as _flight
+from ..observability import metrics as _metrics
+
 __all__ = ["PrefixCache", "PrefixMatch"]
 
 
@@ -108,11 +111,16 @@ class PrefixCache:
                     best_l, best_key = m, key
         if best_key is None:
             self.misses += 1
+            _metrics.counter("serving.prefix_cache.misses").inc()
             return None
         ent = self._entries[best_key]
         self._entries.move_to_end(best_key)
         self.hits += 1
         self.hit_tokens += best_l
+        _metrics.counter("serving.prefix_cache.hits").inc()
+        _metrics.counter("serving.prefix_cache.hit_tokens").inc(best_l)
+        _flight.record("prefix_hit", rows=best_l,
+                       prompt_len=int(len(prompt)))
         return PrefixMatch(best_l, ent.k, ent.v)
 
     def insert(self, tokens, k, v) -> None:
@@ -145,6 +153,11 @@ class PrefixCache:
             _, old = self._entries.popitem(last=False)
             self._tokens_held -= len(old.tokens)
             self.evictions += 1
+            _metrics.counter("serving.prefix_cache.evictions").inc()
+            _flight.record("prefix_evict", rows=len(old.tokens),
+                           tokens_held=self._tokens_held)
+        _metrics.gauge("serving.prefix_cache.tokens_held").set(
+            self._tokens_held)
 
     def put_prompt(self, params, tokens, cfg) -> None:
         """Ahead-of-traffic registration: prefill ``tokens`` standalone

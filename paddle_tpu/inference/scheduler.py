@@ -39,6 +39,14 @@ loop found no other sync: the host replay, telemetry stamping, queue
 management and prefix bookkeeping all work on host mirrors of the
 fetched event log. ``tests/test_analysis.py::TestSchedulerAudit``
 enforces this per segment, so a per-token poll cannot silently return.
+
+r10 (``paddle_tpu.observability``): the loop feeds the runtime
+telemetry registry from those same host mirrors — queue-depth /
+occupancy gauges, TTFT / e2e / queue-wait histograms, backpressure
+counters, per-request lifecycle spans, flight-recorder events — with
+zero additional syncs (the metrics layer refuses device values, and the
+audit above passes with telemetry enabled; overhead gated at ≤2 % in
+``tests/test_observability.py``).
 """
 
 from __future__ import annotations
@@ -49,6 +57,10 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..observability import flight as _flight
+from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
+from ..observability.metrics import percentile as _pctl
 from ..profiler import _hooks
 from .prefix_cache import PrefixCache
 from .serving import Request, ServingEngine
@@ -130,12 +142,9 @@ class OnlineReport:
         return d
 
 
-def _pctl(xs: List[float], q: float) -> float:
-    if not xs:
-        return 0.0
-    xs = sorted(xs)
-    return xs[min(len(xs) - 1, int(len(xs) * q))]
-
+# percentiles: the ONE shared nearest-rank rule (r10 dedup — this module's
+# private copy moved to observability.metrics.percentile, bit-identical;
+# tests/test_observability.py pins exact parity against the r7 rule)
 
 class OnlineScheduler:
     """Drive a ``ServingEngine`` under a clocked arrival trace.
@@ -173,6 +182,9 @@ class OnlineScheduler:
             self._reqs[rid] = r
         if refused:
             self.backpressure_events += 1
+            _metrics.counter("serving.backpressure_events").inc()
+            _flight.record("backpressure", refused=refused,
+                           queue=len(self.engine._queue))
         return refused
 
     # --- the serve loop --------------------------------------------------
@@ -200,10 +212,19 @@ class OnlineScheduler:
         eng.last_run_ticks = 0
         eng.last_run_chunks = 0
         segments = 0
+        # telemetry handles hoisted out of the loop (one dict lookup each,
+        # paid once per serve, not per segment); all values recorded below
+        # are host mirrors — the loop's only device contact stays the one
+        # audited allowed_sync fetch inside run_segment
+        m_queue = _metrics.gauge("serving.queue_depth")
+        m_ttft = _metrics.histogram("serving.ttft_s")
+        m_e2e = _metrics.histogram("serving.e2e_s")
+        m_qwait = _metrics.histogram("serving.queue_wait_s")
         t0 = time.perf_counter()
         while pending or eng._queue or eng.free_slot_count() < eng.slots:
             now = time.perf_counter() - t0
             self._ingest(pending, now, t0)
+            m_queue.set(len(eng._queue))
             idle = (not eng._queue
                     and eng.free_slot_count() == eng.slots)
             if idle:
@@ -222,12 +243,20 @@ class OnlineScheduler:
                         kind="serving")
             segments += 1
             for rid in ev["first_tokens"]:
-                self._reqs[rid].first_token_time = t_sync
+                r = self._reqs[rid]
+                r.first_token_time = t_sync
+                m_ttft.observe(t_sync - r.arrival_time)
+                m_qwait.observe(r.admit_time - r.arrival_time)
             for rid in ev["finished"]:
                 # the engine stamps finish during replay (marginally
                 # earlier); the sync is when the client can SEE the
                 # tokens, and keeps finish >= first_token by definition
-                self._reqs[rid].finish_time = t_sync
+                r = self._reqs[rid]
+                r.finish_time = t_sync
+                m_e2e.observe(t_sync - r.arrival_time)
+                _tracing.emit_request_trace(
+                    rid, r.arrival_time, r.admit_time, r.first_token_time,
+                    r.finish_time, prefix_hit_len=r.prefix_hit_len)
         makespan = time.perf_counter() - t0
 
         reqs = list(self._reqs.values())
@@ -240,6 +269,9 @@ class OnlineScheduler:
         qwaits = [r.admit_time - r.arrival_time for r in reqs]
         occupancy = (total_tokens / (eng.last_run_ticks * eng.slots)
                      if eng.last_run_ticks else 0.0)
+        _metrics.gauge("serving.slot_occupancy").set(occupancy)
+        _metrics.gauge("serving.throughput_tok_s").set(
+            total_tokens / makespan if makespan else 0.0)
         return OnlineReport(
             n_requests=len(reqs),
             total_tokens=total_tokens,

@@ -53,6 +53,8 @@ import numpy as np
 
 from ..analysis.syncs import allowed_sync
 from ..models import llama
+from ..observability import flight as _flight
+from ..observability import metrics as _metrics
 
 __all__ = ["Request", "ServingEngine"]
 
@@ -743,6 +745,7 @@ class ServingEngine:
         # frozen-slot repeats and pad rows are dropped exactly as the
         # windowed _sync does
         admitted, first_tokens, finished = [], [], []
+        new_tokens = eos_stops = 0
         for st in range(steps):
             q = int(aq[st])
             if q < n:                      # admit event
@@ -751,9 +754,11 @@ class ServingEngine:
                 assert self._active[s] is None, "admit into a live slot"
                 t = int(toks[st, s])
                 r.tokens.append(t)
+                new_tokens += 1
                 admitted.append(r.rid)
                 first_tokens.append(r.rid)
                 hit_eos = self.eos is not None and t == self.eos
+                eos_stops += hit_eos
                 if r.done or hit_eos:
                     self._rem_host[s] = 0
                     self._retire(r)
@@ -767,11 +772,13 @@ class ServingEngine:
                         continue
                     t = int(toks[st, s])
                     r.tokens.append(t)
+                    new_tokens += 1
                     if len(r.tokens) == 1:
                         first_tokens.append(r.rid)
                     self._rem_host[s] -= 1
                     if self.eos is not None and t == self.eos:
                         self._rem_host[s] = 0
+                        eos_stops += 1
                     if self._rem_host[s] == 0:
                         self._retire(r)
                         self._active[s] = None
@@ -802,6 +809,21 @@ class ServingEngine:
                         r.prompt[:plen_b],
                         self._cache["k"][:, s, :plen_b],
                         self._cache["v"][:, s, :plen_b])
+
+        # telemetry (ISSUE 5): everything below is host arithmetic on the
+        # ALREADY-fetched event log — the segment's device contact stays
+        # the single audited allowed_sync above
+        _metrics.counter("serving.segments").inc()
+        _metrics.counter("serving.ticks").inc(steps)
+        _metrics.counter("serving.admissions").inc(len(admitted))
+        _metrics.counter("serving.tokens_generated").inc(new_tokens)
+        if eos_stops:
+            _metrics.counter("serving.eos_stops").inc(eos_stops)
+        _metrics.gauge("serving.slots_live").set(
+            self.slots - self.free_slot_count())
+        _flight.record("segment", steps=steps, admitted=len(admitted),
+                       finished=len(finished), eos=eos_stops,
+                       tokens=new_tokens, requeued=max(0, n - qadm))
         return {"steps": steps, "admitted": admitted,
                 "first_tokens": first_tokens, "finished": finished}
 

@@ -19,6 +19,7 @@ import numpy as np
 
 from ..core.tensor import Tensor, to_tensor
 from ..enforce import InvalidArgumentError
+from ..observability import metrics as _obs_metrics
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
@@ -437,6 +438,9 @@ class DataLoader:
                     raise RuntimeError(f"DataLoader worker failed: {err}")
                 pending[seq] = samples
                 while next_out in pending:  # preserve sampler order
+                    _obs_metrics.gauge("io.prefetch_queue_depth").set(
+                        submitted - next_out - 1)  # in-flight after this
+                    _obs_metrics.counter("io.batches").inc()
                     yield self.collate_fn(pending.pop(next_out))
                     next_out += 1
                     submit()
@@ -498,7 +502,14 @@ class DataLoader:
                 alive = submit_next()
                 if not alive:
                     break
+            g_depth = _obs_metrics.gauge("io.prefetch_queue_depth")
+            c_batches = _obs_metrics.counter("io.batches")
             while not futures.empty():
                 fut = futures.get()
                 submit_next()
+                # depth AFTER this batch is consumed = batches still
+                # prefetched ahead of the training loop (a persistently
+                # empty queue means the input pipeline is the bottleneck)
+                g_depth.set(futures.qsize())
+                c_batches.inc()
                 yield fut.result()

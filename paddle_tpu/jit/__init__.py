@@ -27,6 +27,8 @@ from ..core import autograd
 from ..core.tensor import Tensor, to_tensor
 from ..enforce import InvalidArgumentError
 from ..nn.layer.layers import Layer
+from ..observability import flight as _flight
+from ..observability import metrics as _obs_metrics
 from ..ops.dispatch import run_op
 from ..static import InputSpec
 
@@ -228,6 +230,14 @@ class TracedProgram:
         if hit is None:
             jitted = jax.jit(pure)
             self._cache[key] = (jitted, out_store)
+            # telemetry: a cache miss on a warm workload is the recompile
+            # hazard class (analysis.recompile); the flight event names
+            # the program so the postmortem doesn't need the lint rerun
+            _obs_metrics.counter("jit.program_cache_misses").inc()
+            _flight.record("program_cache_miss",
+                           program=f"to_static:"
+                                   f"{getattr(self, '__name__', 'fn')}",
+                           entries=len(self._cache))
         else:
             jitted, out_store = hit
         out = run_op(getattr(self._fn, "__name__", "traced_program"), jitted, *all_inputs)
@@ -758,6 +768,12 @@ class FusedTrainStep:
             jitted = _AOTCachedJit(jax.jit(pure, donate_argnums=(1, 3)))
             jitted.rng_state = rng_state
             self._cache[key] = jitted
+            _obs_metrics.counter("jit.program_cache_misses").inc()
+            _flight.record(
+                "program_cache_miss",
+                program=f"fused_train_step:"
+                        f"{getattr(self._loss_fn, '__name__', 'loss_fn')}",
+                entries=len(self._cache))
 
         bvals = [b._value for b in buffers]
         pvals = [p._value for p in params]
@@ -791,6 +807,10 @@ class FusedTrainStep:
 
         note_dispatch(loss)  # Stream/Event.query honesty for the fused path
         opt._step_count += 1
+        # the optimizer update is INSIDE this program, so the step
+        # counter ticks here (Optimizer.step() never runs on this path)
+        _obs_metrics.counter("optimizer.steps").inc()
+        _obs_metrics.gauge("optimizer.lr").set(float(call_tail[4]))
         for p, np_, ns_ in zip(params, new_p, new_s):
             p._inplace_set(np_)
             opt._accumulators[id(p)] = ns_

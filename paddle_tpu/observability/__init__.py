@@ -1,0 +1,92 @@
+"""``paddle_tpu.observability`` — runtime telemetry with zero EXTRA
+device→host syncs (ISSUE 5 tentpole).
+
+Three layers over signals the framework already holds on the host:
+
+* :mod:`metrics` — process-wide registry of counters / gauges /
+  fixed-bucket histograms, Prometheus-text + JSON snapshot export, and
+  rank-tagged snapshot merge for multi-process runs (launcher log-dir
+  aggregation; no collective required).
+* :mod:`tracing` — per-request lifecycle spans (enqueue → admit →
+  prefill → decode → finish) and per-step training spans emitted through
+  ``profiler._hooks`` so they land in the SAME chrome-trace/xplane
+  timeline as op dispatch and serving segments.
+* :mod:`flight` — a bounded ring of recent structured events
+  (admissions, backpressure, EOS, recompiles, loss-scale skips,
+  prefix-cache hits/evictions) dumpable on demand or on exception.
+
+The hard contract: instrumentation consumes device values ONLY at the
+two sanctioned ``allowed_sync`` points (serving's per-segment event
+fetch, AMP's fused finite check). ``metrics`` refuses device values
+outright, and ``python -m paddle_tpu.analysis --gate`` runs with
+telemetry enabled — per-program sync/compile/relayout budgets must be
+bit-identical to the uninstrumented programs
+(``tests/test_observability.py::TestTelemetryAudit``).
+
+Quick use::
+
+    from paddle_tpu import observability as obs
+
+    obs.metrics.counter("my.requests").inc()
+    obs.metrics.histogram("my.latency_s").observe(0.012)   # host float!
+    print(obs.metrics.render_prometheus())
+    snap = obs.metrics.snapshot()                # JSON-able dict
+    obs.flight.dump("postmortem.json")           # recent events
+
+``set_enabled(False)`` turns every record path into a single-branch
+no-op (the ≤2 % serving overhead gate compares against exactly that).
+"""
+
+from __future__ import annotations
+
+from . import flight, metrics, tracing
+from .flight import FLIGHT, dump_on_exception
+from .metrics import (counter, enabled, gauge, histogram, merge_log_dir,
+                      merge_snapshots, percentile, registry,
+                      render_prometheus, reset, set_enabled, snapshot,
+                      write_snapshot)
+from .tracing import emit_request_trace, span, step_span
+
+__all__ = [
+    "metrics", "tracing", "flight", "counter", "gauge", "histogram",
+    "percentile", "registry", "snapshot", "render_prometheus",
+    "merge_snapshots", "merge_log_dir", "write_snapshot", "reset",
+    "set_enabled", "enabled", "span", "step_span", "emit_request_trace",
+    "FLIGHT", "dump_on_exception", "install_compile_listener",
+]
+
+
+# ---------------------------------------------------------------------------
+# Compile events: the PR 4 CompileWatch monitoring channel, made a
+# standing telemetry source — every real XLA backend compile increments
+# ``jit.backend_compiles`` and leaves a flight event (a mid-serve
+# recompile is the 2.5 s latency-cliff class; the flight ring makes the
+# postmortem trivial). The listener is one string compare per monitoring
+# event, installed once at package import (idempotent; jax is already an
+# unconditional framework dependency by the time anything imports this).
+# ---------------------------------------------------------------------------
+
+_COMPILE_LISTENER = [None]
+
+
+def install_compile_listener() -> None:
+    if _COMPILE_LISTENER[0] is not None:
+        return
+    import jax.monitoring as mon
+
+    from ..analysis.recompile import CompileWatch
+
+    compiles = metrics.counter(
+        "jit.backend_compiles",
+        "real XLA backend compilations (CompileWatch channel)")
+
+    def listener(event: str, duration: float, **kw) -> None:
+        if event == CompileWatch._EVENT:
+            compiles.inc()
+            flight.record("recompile", duration_s=round(duration, 4))
+
+    mon.register_event_duration_secs_listener(listener)
+    _COMPILE_LISTENER[0] = listener
+
+
+install_compile_listener()

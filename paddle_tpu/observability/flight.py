@@ -1,0 +1,144 @@
+"""Flight recorder — a bounded ring of recent structured events for
+postmortems.
+
+The serving/training stack already KNOWS every operationally interesting
+moment (an admission, a backpressure drop, an EOS retirement, an XLA
+recompile, a loss-scale skip, a prefix-cache eviction) at the instant it
+handles it on the host — the flight recorder just keeps the last N of
+them so a crash or a p99 investigation can replay the run's tail without
+having had logging enabled. Costs one deque append of a small tuple per
+event (the deque's maxlen does the eviction); dump on demand
+(``dump()``), on exception (``dump_on_exception`` /
+``install_excepthook``), or never.
+
+Zero-extra-sync: events carry host data only — the recording sites are
+the same host replay/bookkeeping paths the metrics layer instruments, so
+``python -m paddle_tpu.analysis --gate`` sees identical budgets with the
+recorder on.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import sys
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["FlightRecorder", "FLIGHT", "record", "events", "dump",
+           "dump_on_exception", "install_excepthook", "set_capacity",
+           "clear"]
+
+
+class FlightRecorder:
+    """Bounded ring buffer of (wall_time_s, kind, data) events."""
+
+    def __init__(self, capacity: int = 2048):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._buf = collections.deque(maxlen=int(capacity))
+        self._seq = 0
+        self._lock = threading.Lock()  # resize only; appends are GIL-safe
+
+    @property
+    def capacity(self) -> int:
+        return self._buf.maxlen
+
+    def set_capacity(self, capacity: int) -> None:
+        """Resize, keeping the newest events."""
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        with self._lock:
+            self._buf = collections.deque(self._buf, maxlen=int(capacity))
+
+    def record(self, kind: str, **data) -> None:
+        from .metrics import _STATE
+
+        if not _STATE.enabled:
+            return
+        self._seq += 1
+        self._buf.append((self._seq, time.time(), kind, data))
+
+    def events(self, kind: Optional[str] = None) -> List[dict]:
+        """Oldest-first structured view of the ring (optionally one
+        kind). ``seq`` is a monotonic id — gaps mean the ring evicted."""
+        return [{"seq": s, "t": t, "kind": k, **d}
+                for s, t, k, d in list(self._buf)
+                if kind is None or k == kind]
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def clear(self) -> None:
+        self._buf.clear()
+
+    def dump(self, path: Optional[str] = None, reason: str = "on_demand"
+             ) -> List[dict]:
+        """Return the event list; when ``path`` is given also write it as
+        JSON ({"reason", "dumped_at", "events"})."""
+        evs = self.events()
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump({"reason": reason, "dumped_at": time.time(),
+                           "capacity": self.capacity, "events": evs},
+                          f, indent=1, default=str)
+        return evs
+
+
+FLIGHT = FlightRecorder()
+
+
+def record(kind: str, **data) -> None:
+    FLIGHT.record(kind, **data)
+
+
+def events(kind: Optional[str] = None) -> List[dict]:
+    return FLIGHT.events(kind)
+
+
+def dump(path: Optional[str] = None, reason: str = "on_demand"):
+    return FLIGHT.dump(path, reason=reason)
+
+
+def set_capacity(capacity: int) -> None:
+    FLIGHT.set_capacity(capacity)
+
+
+def clear() -> None:
+    FLIGHT.clear()
+
+
+@contextlib.contextmanager
+def dump_on_exception(path: str):
+    """Postmortem scope: an exception escaping the block dumps the ring
+    to ``path`` (tagged with the exception) and re-raises."""
+    try:
+        yield FLIGHT
+    except BaseException as e:
+        FLIGHT.record("exception", type=type(e).__name__, message=str(e))
+        FLIGHT.dump(path, reason=f"exception: {type(e).__name__}")
+        raise
+
+
+_HOOK_INSTALLED = [False]
+
+
+def install_excepthook(path: str) -> None:
+    """Process-level postmortem: chain onto ``sys.excepthook`` so ANY
+    uncaught exception dumps the ring before the interpreter reports."""
+    if _HOOK_INSTALLED[0]:
+        return
+    prev = sys.excepthook
+
+    def hook(etype, value, tb):
+        try:
+            FLIGHT.record("exception", type=etype.__name__,
+                          message=str(value))
+            FLIGHT.dump(path, reason=f"uncaught: {etype.__name__}")
+        finally:
+            prev(etype, value, tb)
+
+    sys.excepthook = hook
+    _HOOK_INSTALLED[0] = True

@@ -1,0 +1,67 @@
+"""Per-request and per-step spans over ``profiler._hooks``.
+
+The host-span channel already exists (r7: the scheduler emits one
+``serving.segment`` span per segment and ``paddle.profiler`` merges host
+spans into its chrome-trace/xplane timeline). This module generalises it
+into a request/step vocabulary WITHOUT adding a clock source or a sync:
+
+* **Request traces** — the scheduler stamps each ``Request``'s lifecycle
+  (arrival → admit → first-token → finish) at the per-segment
+  ``allowed_sync`` fetch; ``emit_request_trace`` replays those host
+  stamps as spans (``request.queue_wait`` / ``request.prefill`` /
+  ``request.decode`` / ``request.e2e``) so a p99 outlier decomposes in
+  the same trace viewer that shows segments and op dispatch.
+* **Step spans** — ``step_span("hapi.train_batch")`` wraps a training
+  step; free when no profiler records (two clock reads).
+
+Everything is emit-only: when no ``Profiler`` is active, ``emit`` walks
+an empty collector list and ``_hooks.active()`` short-circuits the
+request replay entirely.
+"""
+
+from __future__ import annotations
+
+from ..profiler import _hooks
+
+__all__ = ["span", "step_span", "emit_request_trace", "active"]
+
+span = _hooks.span          # re-export: the RAII host span
+active = _hooks.active
+
+
+def step_span(name: str = "train.step"):
+    """RAII span for one training step (kind='train')."""
+    return _hooks.span(name, kind="train")
+
+
+def _ns(t_s: float) -> int:
+    return int(t_s * 1e9)
+
+
+def emit_request_trace(rid: int, arrival_s: float, admit_s: float,
+                       first_token_s: float, finish_s: float,
+                       prefix_hit_len: int = 0) -> None:
+    """Emit one finished request's lifecycle as host spans.
+
+    Stamps are ``time.perf_counter`` seconds taken at the syncs that
+    actually surfaced each event (the r7 measured-latency contract);
+    zero-duration phases (e.g. first token AT finish) are skipped. The
+    rid and prefix reuse ride in the span name so the trace viewer can
+    group and filter without a metadata channel."""
+    if not _hooks.COLLECTORS:
+        return
+    tag = f"req{rid}" + (f"+prefix{prefix_hit_len}" if prefix_hit_len
+                         else "")
+    kind = "serving.request"
+    if admit_s > arrival_s > 0:
+        _hooks.emit(f"request.queue_wait[{tag}]", _ns(arrival_s),
+                    _ns(admit_s), kind=kind)
+    if first_token_s > admit_s > 0:
+        _hooks.emit(f"request.prefill[{tag}]", _ns(admit_s),
+                    _ns(first_token_s), kind=kind)
+    if finish_s > first_token_s > 0:
+        _hooks.emit(f"request.decode[{tag}]", _ns(first_token_s),
+                    _ns(finish_s), kind=kind)
+    if finish_s > arrival_s > 0:
+        _hooks.emit(f"request.e2e[{tag}]", _ns(arrival_s), _ns(finish_s),
+                    kind=kind)

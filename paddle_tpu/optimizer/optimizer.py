@@ -340,6 +340,10 @@ class Optimizer:
             pgs = self._grad_clip(pgs)
         lr = self.get_lr()
         self._step_count += 1
+        from ..observability import metrics as _obs
+
+        _obs.counter("optimizer.steps").inc()
+        _obs.gauge("optimizer.lr").set(float(lr))
         states = [self._ensure_state(p) for p, _ in pgs]
         state_keys = self._state_names()
 
