@@ -11,6 +11,11 @@ Writes ``SERVING_r<N>.json`` at the repo root:
               TTFT + e2e p50/p99, vs fixed batching...},
    "prefix": {...llama_serving --prefix json: shared-prefix KV cache
               on/off tok/s...},  (r7: the online serving subsystem)
+   "paged": {...llama_serving --paged json: paged-KV engine vs
+              contiguous on the same trace (token-identical), TTFT
+              p50/p99, pages-per-token, tight-pool max_len-wall run,
+              shared-prefix dedup ratio vs the row-copy cache...},
+              (r11: the paged KV subsystem)
    "telemetry_headlines": {...r10 runtime-telemetry headlines per mode —
               queue depth / slot occupancy / prefix hit rate /
               backpressure counters from paddle_tpu.observability; the
@@ -77,6 +82,7 @@ def main() -> int:
         "serving": _run_json("llama_serving.py"),
         "online": _run_json("llama_serving.py", args=("--online",)),
         "prefix": _run_json("llama_serving.py", args=("--prefix",)),
+        "paged": _run_json("llama_serving.py", args=("--paged",)),
     }
     result["platform"] = result["online"].get("platform", "unknown")
     # r10: lift each mode's runtime-telemetry headline (queue depth,
@@ -85,13 +91,13 @@ def main() -> int:
     # online/prefix "telemetry"
     result["telemetry_headlines"] = {
         k: (result[k].get("telemetry") or {}).get("headline")
-        for k in ("online", "prefix")}
+        for k in ("online", "prefix", "paged")}
     path = os.path.join(ROOT, f"SERVING_r{rnd:02d}.json")
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
     ok = all(result[k].get("rc") == 0
-             for k in ("decode", "serving", "online", "prefix"))
+             for k in ("decode", "serving", "online", "prefix", "paged"))
     return 0 if ok else 1
 
 

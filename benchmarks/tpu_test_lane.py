@@ -44,6 +44,11 @@ TPU_TEST_FILES = [
     # fixtures skip on a single chip; the budget gate below certifies
     # the canonical programs' budgets on hardware)
     "tests/test_analysis.py",
+    # r11 (ISSUE 6): the paged KV subsystem — on chip the engine/kernel
+    # parity tests route attention through the REAL unified
+    # page-indirect Mosaic kernel (scalar-prefetched page tables), so a
+    # paging regression the CPU gather fallback hides fails here
+    "tests/test_paged_kv.py",
 ]
 
 

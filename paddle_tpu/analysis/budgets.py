@@ -87,6 +87,22 @@ BUDGETS: Dict[str, Budget] = {
         pack_bytes_max=_MiB // 2,      # measured 0
         undonated_bytes_max=_MiB // 2,  # measured 0
         notes="r7 contract: one dispatch + one fetch per segment"),
+    # The PAGED segment (r11): same one-dispatch/one-fetch contract as
+    # serving_segment, with page tables as DATA (no prefix-width shape
+    # family — zero unbucketed-dim hazards from paging) and ZERO pack
+    # bytes (no pre_k/pre_v staging concats: a prefix hit contributes no
+    # row copies to the program — the acceptance criterion, enforced).
+    "paged_serving_segment": Budget(
+        flagged_syncs=0,
+        allowed_syncs_per_replay={"serving.segment_event_fetch": 1},
+        warm_compiles=0,
+        # measured 1,040,964 B (while-body pool carries + the admit
+        # branch's page-scatter copies) + ~5%
+        relayout_bytes_max=1_095_000,
+        pack_bytes_max=_MiB // 2,      # measured 0
+        undonated_bytes_max=_MiB // 2,  # measured 0 (pool+table donated)
+        notes="r11 contract: paged pool + page tables, one fetch/segment, "
+              "prefix reuse is refcount data not program shape"),
     # The donated multi-tensor update: the r8 ledger program. The pack
     # bytes ARE the stack/flat packing traffic the Pallas kernel
     # eliminates on chip; the CPU lowering keeps the XLA packing, so

@@ -218,6 +218,55 @@ def _build_serving_segment() -> ProgramHandle:
         keepalive=(eng,))
 
 
+@register("paged_serving_segment")
+def _build_paged_serving_segment() -> ProgramHandle:
+    import numpy as np
+
+    import jax.numpy as j
+
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg)
+    eng = ServingEngine(cfg, params, slots=4, max_len=64, chunk=8,
+                        prompt_buckets=(16,), paged=True, page_size=16)
+    rng = np.random.RandomState(0)
+
+    def replay():
+        # end-to-end PAGED segment: reserve pages host-side, one fused
+        # dispatch, one allowed event fetch, page bookkeeping on host
+        # mirrors — every request finishes inside the segment so pages
+        # drain back to the free list each iteration
+        for _ in range(2):
+            eng.add_request(rng.randint(0, cfg.vocab_size, (12,)), 4)
+        return eng.run_segment(12)
+
+    def hlo():
+        n_pad = eng._pow2(eng.slots)
+        s_max = eng.buckets[-1]
+        seg = eng._paged_segment_prog(n_pad, s_max, 12)
+        pgr = eng.pager
+        return seg.lower(
+            params, pgr.pool, pgr.page_table,
+            j.zeros((eng.slots,), j.int32), j.zeros((eng.slots,), j.int32),
+            j.zeros((eng.slots,), j.int32),
+            j.zeros((n_pad, s_max), j.int32), j.ones((n_pad,), j.int32),
+            j.zeros((n_pad,), j.int32), j.zeros((n_pad,), j.int32),
+            j.zeros((n_pad, pgr.max_pages), j.int32),
+            j.int32(2)).compile().as_text()
+
+    return ProgramHandle(
+        name="paged_serving_segment",
+        hlo=_memo(hlo),
+        replay=replay,
+        donation_threshold=1 << 16,
+        expected_undonated=(),
+        notes="paged re-entrant segment (page-table pool, COW-ready) + "
+              "host event replay with page bookkeeping, llama-tiny",
+        keepalive=(eng,))
+
+
 # ---------------------------------------------------------------------------
 # 4. Fused optimizer update
 # ---------------------------------------------------------------------------

@@ -102,5 +102,6 @@ define_flag("allocator_strategy", "auto_growth", "Compat: allocator strategy nam
 define_flag("use_pallas_kernels", True, "Use Pallas TPU kernels for fused ops when on TPU.", bool)
 define_flag("use_ragged_decode", True, "Decode attention reads only KV rows [0, pos) per slot (Pallas ragged kernel) instead of the full max_len window.", bool)
 define_flag("use_tick_fusion", True, "Fuse the decode tick's between-matmul small-op chains (rms/rope/residual) into single Pallas ops.", bool)
+define_flag("use_paged_attention", True, "Attention over the paged KV pool runs as the unified page-indirect Pallas kernel (scalar-prefetched page tables) instead of a gather + dense einsum.", bool)
 define_flag("use_pallas_fused_update", True, "Multi-tensor optimizer updates run as one Pallas kernel per group over flat buffers (in-place aliased) instead of XLA stack/concat packing.", bool)
 define_flag("log_level", "WARNING", "Python logging level for paddle_tpu.", str)

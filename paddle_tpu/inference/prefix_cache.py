@@ -43,7 +43,8 @@ import numpy as np
 from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 
-__all__ = ["PrefixCache", "PrefixMatch"]
+__all__ = ["PrefixCache", "PrefixMatch", "PagedPrefixCache",
+           "PagedPrefixMatch"]
 
 
 @dataclass
@@ -173,6 +174,12 @@ class PrefixCache:
         cache, _ = llama.prompt_kv(params, tokens[:n], cfg)
         self.insert(tokens[:n], cache["k"][:, 0], cache["v"][:, 0])
 
+    def reset(self) -> None:
+        """Drop all entries and zero counters (the scheduler's warm-run
+        isolation hook — warmup must not pre-populate measured hits)."""
+        self.__init__(block=self.block,
+                      capacity_tokens=self.capacity_tokens)
+
     # --- stats ------------------------------------------------------------
     @property
     def tokens_held(self) -> int:
@@ -182,5 +189,174 @@ class PrefixCache:
         return {"hits": self.hits, "misses": self.misses,
                 "hit_tokens": self.hit_tokens,
                 "tokens_held": self._tokens_held,
+                "entries": len(self._entries),
+                "evictions": self.evictions}
+
+
+# ---------------------------------------------------------------------------
+# Paged prefix cache (r11): page-ref LRU — a hit is a ref bump, not a copy
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _PagedEntry:
+    tokens: np.ndarray   # [n] int32, n a multiple of page_size
+    pages: list          # physical page ids, one per page_size tokens
+
+
+@dataclass
+class PagedPrefixMatch:
+    length: int          # reusable rows (page multiple, < len(prompt))
+    pages: list          # the physical pages holding those rows
+
+
+class PagedPrefixCache:
+    """Shared-prefix cache over the PAGED KV pool (the r7 row-copy LRU
+    rewritten for inference/paged_kv.py): entries hold page IDS, not KV
+    arrays. Insertion retains the admitted request's prompt pages (one
+    refcount bump per page — the rows are harvested by REFERENCE, the
+    slot and the cache literally share physical pages); a hit hands the
+    same page ids to the new request's reservation, which retains them
+    again. Zero KV rows are copied anywhere in the hit path — the r7
+    cache's dynamic_update_slice of reused rows into the admit window
+    is gone, and "reuse" is true dedup across every live request +
+    the cache (N sharers of a 192-row prefix hold its pages ONCE).
+
+    Granularity is whole pages (the page IS the block — sharers must
+    never write a shared page, and suffix writes start at the page
+    boundary after the hit, so the serving path never needs a COW
+    break). Matching is exact-token over a flat LRU, same policy as the
+    r7 cache; capacity is bounded in PAGES held and eviction releases
+    page refs (a page shared with a live slot frees only when that slot
+    retires — eviction can't corrupt anyone). ``evict_until`` lets the
+    admission path reclaim cache-held pages under page pressure before
+    deferring a request (the cache must yield to live traffic)."""
+
+    def __init__(self, pager, capacity_pages: int = 512):
+        self.pager = pager
+        self.block = pager.page_size      # alignment rule = the page
+        self.capacity_pages = int(capacity_pages)
+        self._entries: "OrderedDict[bytes, _PagedEntry]" = OrderedDict()
+        self._pages_held = 0
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+        self.evictions = 0
+
+    def round_down(self, n: int) -> int:
+        return (int(n) // self.block) * self.block
+
+    def round_up(self, n: int) -> int:
+        return -(-int(n) // self.block) * self.block
+
+    # --- lookup -----------------------------------------------------------
+    def match(self, prompt) -> Optional[PagedPrefixMatch]:
+        """Longest whole-page common prefix between ``prompt`` and any
+        cached entry — STRICT (at least one token must remain to
+        prefill). Returns page ids WITHOUT retaining them: the
+        reservation (``PagedKVCache.reserve``) takes the refs, so a
+        deferred admission leaves no dangling count."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        cap = self.round_down(len(prompt))
+        if cap == len(prompt):
+            cap -= self.block
+        best_l, best_key = 0, None
+        if cap > 0:
+            for key, ent in self._entries.items():
+                m = self.round_down(min(_common_prefix(prompt, ent.tokens),
+                                        cap))
+                if m > best_l:
+                    best_l, best_key = m, key
+        if best_key is None:
+            self.misses += 1
+            _metrics.counter("serving.prefix_cache.misses").inc()
+            return None
+        ent = self._entries[best_key]
+        self._entries.move_to_end(best_key)
+        self.hits += 1
+        self.hit_tokens += best_l
+        _metrics.counter("serving.prefix_cache.hits").inc()
+        _metrics.counter("serving.prefix_cache.hit_tokens").inc(best_l)
+        _flight.record("prefix_hit", rows=best_l,
+                       prompt_len=int(len(prompt)),
+                       pages=best_l // self.block)
+        return PagedPrefixMatch(best_l, ent.pages[:best_l // self.block])
+
+    # --- population -------------------------------------------------------
+    def insert(self, tokens, pages) -> None:
+        """Insert the prefix ``tokens`` held by the given LIVE pages
+        (one page per ``page_size`` tokens, currently referenced by the
+        admitted slot). The cache RETAINS them — harvest by reference.
+        Covered/subsumed entries are handled like the r7 cache."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = len(tokens)
+        if n % self.block or n == 0:
+            raise ValueError(
+                f"prefix length {n} is not a positive multiple of "
+                f"page_size {self.block}")
+        if len(pages) != n // self.block:
+            raise ValueError(f"{len(pages)} pages cannot hold {n} rows "
+                             f"at {self.block}/page")
+        stale = []
+        for key, ent in self._entries.items():
+            m = _common_prefix(tokens, ent.tokens)
+            if m == n and len(ent.tokens) >= n:
+                self._entries.move_to_end(key)
+                return                      # already covered
+            if m == len(ent.tokens):
+                stale.append(key)           # subsumed by the new entry
+        for key in stale:
+            self._evict(key)
+        self.pager.allocator.retain(pages)
+        self._entries[tokens.tobytes()] = _PagedEntry(tokens, list(pages))
+        self._pages_held += len(pages)
+        while self._pages_held > self.capacity_pages and \
+                len(self._entries) > 1:
+            self._evict(next(iter(self._entries)), count=True)
+        _metrics.gauge("serving.prefix_cache.pages_held").set(
+            self._pages_held)
+
+    def _evict(self, key: bytes, count: bool = False) -> None:
+        ent = self._entries.pop(key)
+        self.pager.release_pages(ent.pages)
+        self._pages_held -= len(ent.pages)
+        if count:
+            self.evictions += 1
+            _metrics.counter("serving.prefix_cache.evictions").inc()
+            _flight.record("page_evict", pages=len(ent.pages),
+                           pages_held=self._pages_held)
+
+    def evict_until(self, pages_free: int) -> int:
+        """Release LRU entries until the allocator has ``pages_free``
+        free pages (or the cache is empty). The page-pressure valve:
+        admission calls this before deferring a request, so cache-held
+        history never starves live traffic. Returns entries evicted."""
+        n = 0
+        while (self._entries
+               and self.pager.allocator.pages_free < pages_free):
+            self._evict(next(iter(self._entries)), count=True)
+            n += 1
+        return n
+
+    def clear(self) -> None:
+        while self._entries:
+            self._evict(next(iter(self._entries)))
+
+    def reset(self) -> None:
+        """Release all page refs and zero counters (warm-run isolation —
+        same hook as ``PrefixCache.reset``; the PAGER keeps its pool)."""
+        self.clear()
+        self.hits = self.misses = self.hit_tokens = self.evictions = 0
+
+    # --- stats ------------------------------------------------------------
+    @property
+    def pages_held(self) -> int:
+        return self._pages_held
+
+    def stats(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "hit_tokens": self.hit_tokens,
+                "pages_held": self._pages_held,
+                "tokens_held": self._pages_held * self.block,
                 "entries": len(self._entries),
                 "evictions": self.evictions}

@@ -132,6 +132,11 @@ class OnlineReport:
     segments: int
     ticks: int
     backpressure_events: int
+    # r11 paged engine: admissions deferred because the PAGE POOL (not
+    # the queue bound) was the constraint — backpressure{reason="pages"}
+    # — plus the pool's occupancy stats; 0/None on contiguous engines
+    backpressure_pages: int = 0
+    pages: Optional[dict] = None
     prefix: Optional[dict] = None  # PrefixCache.stats() when enabled
     per_request: List[dict] = field(default_factory=list)
 
@@ -202,10 +207,9 @@ class OnlineScheduler:
             self._reqs.clear()
             self.backpressure_events = 0
             if self.prefix_cache is not None:
-                # warmup must not pre-populate measured-run hits
-                self.prefix_cache.__init__(
-                    block=self.prefix_cache.block,
-                    capacity_tokens=self.prefix_cache.capacity_tokens)
+                # warmup must not pre-populate measured-run hits (paged
+                # caches also hand their page refs back to the pool)
+                self.prefix_cache.reset()
 
         pending = sorted(arrivals, key=lambda a: a.t)
         eng = self.engine
@@ -286,6 +290,8 @@ class OnlineScheduler:
             segments=segments,
             ticks=eng.last_run_ticks,
             backpressure_events=self.backpressure_events,
+            backpressure_pages=eng.page_backpressure_events,
+            pages=eng.pager.stats() if eng.paged else None,
             prefix=(self.prefix_cache.stats()
                     if self.prefix_cache is not None else None),
             per_request=[{

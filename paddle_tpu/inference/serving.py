@@ -88,7 +88,9 @@ class ServingEngine:
     def __init__(self, cfg: llama.LlamaConfig, params, slots: int = 8,
                  max_len: Optional[int] = None, chunk: int = 32,
                  prompt_buckets: Sequence[int] = (32, 64, 128, 256),
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None,
+                 paged: bool = False, page_size: int = 16,
+                 num_pages: Optional[int] = None):
         self.cfg = cfg
         self.params = params
         self.slots = int(slots)
@@ -108,7 +110,30 @@ class ServingEngine:
         self.last_run_ticks = 0   # decode TICKS (fused: exact; windowed: chunks*K)
         self.last_latencies = {}  # rid -> submit->finish seconds (last run)
         self._next_rid = 0
-        self._cache = llama.init_kv_cache(cfg, self.slots, self.max_len)
+        self.paged = bool(paged)
+        self.page_backpressure_events = 0  # admissions deferred for pages
+        if self.paged:
+            # paged mode (r11, inference/paged_kv.py): ONE flat page pool
+            # + per-slot page tables replace the [slots, max_len] block.
+            # max_len keeps its meaning as the PER-SLOT virtual cap
+            # (max_pages * page_size); num_pages sizes the PHYSICAL pool
+            # — below slots * max_pages it is the pages-free admission
+            # regime the contiguous cache cannot express.
+            from .paged_kv import PagedKVCache
+
+            self.page_size = int(page_size)
+            if self.max_len % self.page_size:
+                raise ValueError(f"max_len {self.max_len} is not a "
+                                 f"multiple of page_size {self.page_size}")
+            max_pages = self.max_len // self.page_size
+            self.pager = PagedKVCache(
+                cfg, self.slots, self.page_size,
+                num_pages=int(num_pages or self.slots * max_pages + 1),
+                max_pages=max_pages)
+            self._cache = None  # no contiguous block exists in paged mode
+        else:
+            self.pager = None
+            self._cache = llama.init_kv_cache(cfg, self.slots, self.max_len)
         self._pos = jnp.zeros((self.slots,), jnp.int32)
         self._nxt = jnp.zeros((self.slots,), jnp.int32)
         self._rem = jnp.zeros((self.slots,), jnp.int32)
@@ -119,9 +144,12 @@ class ServingEngine:
     def cache_info(self) -> dict:
         """Compiled-program cache keys (analysis.recompile lint): admit
         programs key on (bucket, nb), segments on ("seg", n_pad, s_max,
-        pre_max, steps) — all bucketed by construction, so key-count
-        growth here means a shape leaked past the buckets (the 2.5 s
-        mid-serve compile class this engine's width pinning fixed)."""
+        pre_max, steps), paged segments on ("pseg", n_pad, s_max, steps)
+        — all bucketed by construction, so key-count growth here means a
+        shape leaked past the buckets (the 2.5 s mid-serve compile class
+        this engine's width pinning fixed). Note the PAGED key carries
+        no pre_max: shared-prefix geometry rides the page tables as
+        DATA, so prefix reuse adds zero program shapes."""
         return {"name": f"serving_engine:slots{self.slots}",
                 "keys": list(self._progs.keys())}
 
@@ -136,6 +164,16 @@ class ServingEngine:
                                        self.cfg.num_kv_heads,
                                        self.cfg.head_dim)
 
+    def paged_kernel_active(self) -> bool:
+        """True when this engine's paged segments route attention to the
+        unified page-indirect Pallas kernel (trace-time dispatch — the
+        paged serving lane asserts it like ``decode_kernel_active``)."""
+        from ..ops.pallas.paged_attention import paged_attention_active
+
+        return self.paged and paged_attention_active(
+            self.page_size, self.cfg.num_heads, self.cfg.num_kv_heads,
+            self.cfg.head_dim)
+
     # --- request intake ---------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int) -> int:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -147,6 +185,12 @@ class ServingEngine:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
                 f"exceeds cache max_len {self.max_len}")
+        if self.paged:
+            need = self.pager.pages_needed(len(prompt) + max_new_tokens - 1)
+            if need > self.pager.num_pages - 1:
+                raise ValueError(
+                    f"request spans {need} pages but the pool holds only "
+                    f"{self.pager.num_pages - 1} — it could never admit")
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append(Request(rid, prompt, int(max_new_tokens),
@@ -295,6 +339,11 @@ class ServingEngine:
         and compiles on the first ``run()`` that sees that shape — warm
         it by running a representative workload once (the serving
         benchmark does exactly this)."""
+        if self.paged:
+            # paged engines serve through segments only; each
+            # (n_pad, s_max, steps) shape compiles on its first
+            # run_segment and the scheduler's warm pass covers it
+            return
         for b in self.buckets:
             for nb in _WAVE_WIDTHS:
                 if nb > self.slots:
@@ -619,6 +668,83 @@ class ServingEngine:
         self._progs[key] = segment
         return segment
 
+    def _replay_segment(self, picked, toks, aq, aslot, steps: int, n: int,
+                        on_admit=None, on_retire=None):
+        """Host replay of a segment's event log — ONE contract for the
+        contiguous and paged engines: walk the log chronologically,
+        tracking slot occupancy (admits rebind a slot; decode ticks
+        append one token to every slot the HOST knows is live via its
+        rem mirror, so frozen-slot repeats and pad rows are dropped
+        exactly as the windowed _sync does). ``on_admit(q, slot)`` /
+        ``on_retire(req, slot)`` are the paged engine's page-table
+        bookkeeping hooks, called in event order so a slot freed and
+        re-admitted mid-segment releases the old occupant's pages
+        before the new page list installs."""
+        admitted, first_tokens, finished = [], [], []
+        new_tokens = eos_stops = 0
+        for st in range(steps):
+            q = int(aq[st])
+            if q < n:                      # admit event
+                r = picked[q]
+                s = int(aslot[st])
+                assert self._active[s] is None, "admit into a live slot"
+                if on_admit is not None:
+                    on_admit(q, s)
+                t = int(toks[st, s])
+                r.tokens.append(t)
+                new_tokens += 1
+                admitted.append(r.rid)
+                first_tokens.append(r.rid)
+                hit_eos = self.eos is not None and t == self.eos
+                eos_stops += hit_eos
+                if r.done or hit_eos:
+                    self._rem_host[s] = 0
+                    self._retire(r)
+                    finished.append(r.rid)
+                    if on_retire is not None:
+                        on_retire(r, s)
+                else:
+                    self._active[s] = r
+                    self._rem_host[s] = r.max_new_tokens - 1
+            else:                          # decode tick
+                for s, r in enumerate(self._active):
+                    if r is None or self._rem_host[s] <= 0:
+                        continue
+                    t = int(toks[st, s])
+                    r.tokens.append(t)
+                    new_tokens += 1
+                    if len(r.tokens) == 1:
+                        first_tokens.append(r.rid)
+                    self._rem_host[s] -= 1
+                    if self.eos is not None and t == self.eos:
+                        self._rem_host[s] = 0
+                        eos_stops += 1
+                    if self._rem_host[s] == 0:
+                        self._retire(r)
+                        self._active[s] = None
+                        finished.append(r.rid)
+                        if on_retire is not None:
+                            on_retire(r, s)
+        return admitted, first_tokens, finished, new_tokens, eos_stops
+
+    def _segment_telemetry(self, steps, admitted, finished, eos_stops,
+                           new_tokens, requeued) -> None:
+        """Post-sync counters/flight for one segment — host arithmetic
+        on the already-fetched event log (ISSUE 5 contract: the
+        segment's device contact stays the single audited allowed_sync
+        in the caller)."""
+        _metrics.counter("serving.segments").inc()
+        _metrics.counter("serving.ticks").inc(steps)
+        _metrics.counter("serving.admissions").inc(len(admitted))
+        _metrics.counter("serving.tokens_generated").inc(new_tokens)
+        if eos_stops:
+            _metrics.counter("serving.eos_stops").inc(eos_stops)
+        _metrics.gauge("serving.slots_live").set(
+            self.slots - self.free_slot_count())
+        _flight.record("segment", steps=steps, admitted=len(admitted),
+                       finished=len(finished), eos=eos_stops,
+                       tokens=new_tokens, requeued=requeued)
+
     def free_slot_count(self) -> int:
         return sum(1 for r in self._active if r is None)
 
@@ -636,6 +762,9 @@ class ServingEngine:
         self.last_run_ticks = 0
         self.last_run_chunks = 0
         self.last_latencies = {}
+        self.page_backpressure_events = 0
+        if self.paged:
+            self.pager.reset()
 
     def run_segment(self, max_steps: int, prefix_cache=None,
                     n_pad: Optional[int] = None,
@@ -652,6 +781,9 @@ class ServingEngine:
         if now is None:
             now = time.perf_counter()
         n_pad = n_pad or self._pow2(self.slots)
+        if self.paged:
+            return self._run_segment_paged(max_steps, prefix_cache,
+                                           n_pad, now)
         # pick up to n_pad regardless of CURRENT free slots: in-program
         # admission refills slots the moment they retire mid-segment, so
         # over-picking is exactly what keeps the batch full (requests the
@@ -739,50 +871,8 @@ class ServingEngine:
         self.last_run_ticks += steps
         self.last_run_chunks += 1
 
-        # host replay: walk the event log chronologically, tracking slot
-        # occupancy — admits rebind a slot; decode ticks append one token
-        # to every slot the HOST knows is live (its rem mirror), so
-        # frozen-slot repeats and pad rows are dropped exactly as the
-        # windowed _sync does
-        admitted, first_tokens, finished = [], [], []
-        new_tokens = eos_stops = 0
-        for st in range(steps):
-            q = int(aq[st])
-            if q < n:                      # admit event
-                r = picked[q]
-                s = int(aslot[st])
-                assert self._active[s] is None, "admit into a live slot"
-                t = int(toks[st, s])
-                r.tokens.append(t)
-                new_tokens += 1
-                admitted.append(r.rid)
-                first_tokens.append(r.rid)
-                hit_eos = self.eos is not None and t == self.eos
-                eos_stops += hit_eos
-                if r.done or hit_eos:
-                    self._rem_host[s] = 0
-                    self._retire(r)
-                    finished.append(r.rid)
-                else:
-                    self._active[s] = r
-                    self._rem_host[s] = r.max_new_tokens - 1
-            else:                          # decode tick
-                for s, r in enumerate(self._active):
-                    if r is None or self._rem_host[s] <= 0:
-                        continue
-                    t = int(toks[st, s])
-                    r.tokens.append(t)
-                    new_tokens += 1
-                    if len(r.tokens) == 1:
-                        first_tokens.append(r.rid)
-                    self._rem_host[s] -= 1
-                    if self.eos is not None and t == self.eos:
-                        self._rem_host[s] = 0
-                        eos_stops += 1
-                    if self._rem_host[s] == 0:
-                        self._retire(r)
-                        self._active[s] = None
-                        finished.append(r.rid)
+        admitted, first_tokens, finished, new_tokens, eos_stops = \
+            self._replay_segment(picked, toks, aq, aslot, steps, n)
         if qadm < n:
             # step budget ran out before every picked request found a
             # slot: back to the queue head, FCFS order preserved
@@ -810,20 +900,277 @@ class ServingEngine:
                         self._cache["k"][:, s, :plen_b],
                         self._cache["v"][:, s, :plen_b])
 
-        # telemetry (ISSUE 5): everything below is host arithmetic on the
-        # ALREADY-fetched event log — the segment's device contact stays
-        # the single audited allowed_sync above
-        _metrics.counter("serving.segments").inc()
-        _metrics.counter("serving.ticks").inc(steps)
-        _metrics.counter("serving.admissions").inc(len(admitted))
-        _metrics.counter("serving.tokens_generated").inc(new_tokens)
-        if eos_stops:
-            _metrics.counter("serving.eos_stops").inc(eos_stops)
-        _metrics.gauge("serving.slots_live").set(
-            self.slots - self.free_slot_count())
-        _flight.record("segment", steps=steps, admitted=len(admitted),
-                       finished=len(finished), eos=eos_stops,
-                       tokens=new_tokens, requeued=max(0, n - qadm))
+        self._segment_telemetry(steps, admitted, finished, eos_stops,
+                                new_tokens, max(0, n - qadm))
+        return {"steps": steps, "admitted": admitted,
+                "first_tokens": first_tokens, "finished": finished}
+
+    # --- paged segments (r11: page-table KV, inference/paged_kv.py) -------
+    def _paged_segment_prog(self, n_pad: int, s_max: int, max_steps: int):
+        """``_segment_prog`` over the PAGED pool: same while_loop, same
+        event log, same one-dispatch/one-fetch contract — three changes:
+
+        * slot KV state is (pool, page_table) instead of a contiguous
+          block; both are donated and updated in place;
+        * the admit branch INSTALLS the request's host-reserved page
+          list into the slot's table row and prefills the suffix
+          directly into those pages (``llama.forward_with_pages``) —
+          shared-prefix rows are already resident in the shared pages,
+          so a hit contributes ZERO KV row copies to the program (the
+          contiguous segment's pre_k/pre_v staging tensors and their
+          dynamic_update_slice writes do not exist here);
+        * the decode branch passes the live mask so retired slots'
+          writes route to the trash page.
+
+        The memo key carries NO prefix width: prefix geometry is page
+        DATA (pre_lens + tables), not shape — a shared-prefix workload
+        adds zero program shapes (one fewer recompile hazard than the
+        contiguous engine's ("seg", ..., pre_max, ...) family)."""
+        key = ("pseg", n_pad, s_max, max_steps)
+        cached = self._progs.get(key)
+        if cached is not None:
+            return cached
+        cfg, slots, eos = self.cfg, self.slots, self.eos
+        max_pages = self.pager.max_pages
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def segment(params, pool, ptab, pos, nxt, rem, prompts, lens,
+                    gens, pre_lens, req_tables, n_real):
+            i32 = jnp.int32
+            st = dict(
+                pool=pool, pt=ptab, pos=pos, nxt=nxt, rem=rem,
+                out=jnp.zeros((max_steps, slots), i32),
+                aq=jnp.full((max_steps,), n_pad, i32),    # n_pad = decode
+                aslot=jnp.zeros((max_steps,), i32),
+                qidx=i32(0), step=i32(0),
+            )
+
+            def cond(st):
+                work = jnp.any(st["rem"] > 0) | (st["qidx"] < n_real)
+                return work & (st["step"] < max_steps)
+
+            def admit(st):
+                s = jnp.argmin(st["rem"])          # a rem==0 slot
+                q = st["qidx"]
+                row = jax.lax.dynamic_slice(req_tables, (q, 0),
+                                            (1, max_pages))
+                prow = jax.lax.dynamic_slice(prompts, (q, 0), (1, s_max))
+                ln = lens[q]
+                pln = pre_lens[q]
+                # suffix-only prefill AT context offset pln: queries sit
+                # at positions pln..pln+s_max-1 and attend the shared
+                # prefix pages in place — the prefix's quadratic
+                # attention, its per-token matmuls AND its KV writes are
+                # all skipped
+                logits, pool = llama.forward_with_pages(
+                    params, prow, cfg, st["pool"], row,
+                    jnp.reshape(pln, (1,)), logit_pos=ln - 1)
+                t0 = jnp.argmax(logits, axis=-1).astype(i32).reshape(())
+                rem_new = gens[q] - 1
+                if eos is not None:
+                    rem_new = jnp.where(t0 == eos, 0, rem_new)
+                return dict(
+                    pool=pool,
+                    pt=st["pt"].at[s].set(row[0]),
+                    pos=st["pos"].at[s].set(pln + ln),
+                    nxt=st["nxt"].at[s].set(t0),
+                    rem=st["rem"].at[s].set(rem_new),
+                    out=st["out"].at[st["step"], s].set(t0),
+                    aq=st["aq"].at[st["step"]].set(q),
+                    aslot=st["aslot"].at[st["step"]].set(s),
+                    qidx=q + 1, step=st["step"],
+                )
+
+            def decode(st):
+                live = st["rem"] > 0
+                logits, pool = llama.forward_with_pages(
+                    params, st["nxt"][:, None], cfg, st["pool"],
+                    st["pt"], st["pos"], live=live)
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                tok = jnp.where(live, tok, st["nxt"])
+                rem = st["rem"] - live.astype(jnp.int32)
+                if eos is not None:
+                    rem = jnp.where(live & (tok == eos), 0, rem)
+                return dict(
+                    pool=pool, pt=st["pt"],
+                    pos=st["pos"] + live.astype(jnp.int32),
+                    nxt=tok, rem=rem,
+                    out=st["out"].at[st["step"]].set(tok),
+                    aq=st["aq"], aslot=st["aslot"],
+                    qidx=st["qidx"], step=st["step"],
+                )
+
+            def body(st):
+                can_admit = (st["qidx"] < n_real) & jnp.any(st["rem"] == 0)
+                st = jax.lax.cond(can_admit, admit, decode, st)
+                st["step"] = st["step"] + 1
+                return st
+
+            st = jax.lax.while_loop(cond, body, st)
+            return (st["pool"], st["pt"], st["pos"], st["nxt"], st["rem"],
+                    st["out"], st["aq"], st["aslot"], st["step"],
+                    st["qidx"])
+
+        self._progs[key] = segment
+        return segment
+
+    def _run_segment_paged(self, max_steps: int, prefix_cache, n_pad: int,
+                           now: float) -> dict:
+        """The paged ``run_segment``: pick FCFS gated on PAGES FREE
+        (admission control is memory admission — the request's page
+        span is known exactly at admission since generation length is
+        fixed), reserve page lists host-side, launch ONE fused paged
+        segment, host-replay the shared event log with page-table
+        bookkeeping hooks. Same single audited sync per segment."""
+        if prefix_cache is not None and not hasattr(prefix_cache, "pager"):
+            raise TypeError("paged engine requires a PagedPrefixCache "
+                            "(inference/prefix_cache.py), got "
+                            f"{type(prefix_cache).__name__}")
+        pgr = self.pager
+        psz = self.page_size
+        picked: List[Request] = []
+        req_pages: List[List[int]] = []
+        pre_lens_l: List[int] = []
+        tables: List[np.ndarray] = []
+        deferred = 0
+        while self._queue and len(picked) < n_pad:
+            r = self._queue[0]
+            rows = len(r.prompt) + r.max_new_tokens - 1
+            total = pgr.pages_needed(rows)
+            hit_pages: List[int] = []
+            hit_len = 0
+            if prefix_cache is not None:
+                m = prefix_cache.match(r.prompt)
+                if m is not None:
+                    hit_pages, hit_len = list(m.pages), m.length
+            need_new = total - len(hit_pages)
+            if need_new > pgr.pages_free:
+                if prefix_cache is not None:
+                    # page-pressure valve: cached history yields LRU
+                    # pages before live traffic defers; eviction may
+                    # have freed the very pages the hit named, so trim
+                    # the hit at the first no-longer-referenced page
+                    prefix_cache.evict_until(need_new)
+                    k = 0
+                    while (k < len(hit_pages)
+                           and pgr.allocator.ref(hit_pages[k]) > 0):
+                        k += 1
+                    hit_pages, hit_len = hit_pages[:k], k * psz
+                    need_new = total - k
+                if need_new > pgr.pages_free:
+                    # FCFS: the queue head blocks, everything waits —
+                    # pages free as live requests retire
+                    deferred = len(self._queue)
+                    if (not picked
+                            and all(not p for p in pgr.slot_pages)):
+                        # nothing live to free pages and nothing being
+                        # admitted: the pool is pinned by references
+                        # outside this engine's control — fail loudly
+                        # rather than spin the serve loop forever
+                        raise RuntimeError(
+                            f"page pool starved: request needs "
+                            f"{need_new} pages, {pgr.pages_free} free, "
+                            f"no live slots to retire (pages held by an "
+                            f"external prefix cache or fork?)")
+                    break
+            pages, row = pgr.reserve(rows, hit_pages)
+            self._queue.pop(0)
+            r.prefix_hit_len = hit_len
+            r.admit_time = now
+            picked.append(r)
+            req_pages.append(pages)
+            pre_lens_l.append(hit_len)
+            tables.append(row)
+        if deferred:
+            self.page_backpressure_events += 1
+            _metrics.counter("serving.backpressure_pages").inc()
+            _flight.record("backpressure", reason="pages",
+                           deferred=deferred, pages_free=pgr.pages_free)
+        n = len(picked)
+
+        # suffix width: same pinning rule as the contiguous segment —
+        # largest bucket when nothing was reused, the suffix bucket when
+        # prefix hits shorten the prefill
+        if prefix_cache is None or not any(pre_lens_l):
+            s_max = self.buckets[-1]
+        else:
+            suf_max = max((len(r.prompt) - pre_lens_l[j]
+                           for j, r in enumerate(picked)), default=1)
+            s_max = self._bucket_for(suf_max)
+
+        prompts = np.zeros((n_pad, s_max), np.int32)
+        lens = np.ones((n_pad,), np.int32)
+        gens = np.zeros((n_pad,), np.int32)   # gen 0 -> never admitted
+        pre_lens = np.zeros((n_pad,), np.int32)
+        req_tables = np.zeros((n_pad, pgr.max_pages), np.int32)
+        for j, r in enumerate(picked):
+            suf = r.prompt[pre_lens_l[j]:]
+            prompts[j, :len(suf)] = suf
+            lens[j] = len(suf)
+            gens[j] = r.max_new_tokens
+            pre_lens[j] = pre_lens_l[j]
+            req_tables[j] = tables[j]
+
+        out = self._paged_segment_prog(n_pad, s_max, max_steps)(
+            self.params, pgr.pool, pgr.page_table, self._pos, self._nxt,
+            self._rem, jnp.asarray(prompts), jnp.asarray(lens),
+            jnp.asarray(gens), jnp.asarray(pre_lens),
+            jnp.asarray(req_tables), jnp.int32(n))
+        pgr.pool, pgr.page_table = out[0], out[1]
+        self._pos, self._nxt, self._rem = out[2:5]
+        # THE per-segment sync (same audited label + budget as the
+        # contiguous engine: exactly one device contact per segment)
+        with allowed_sync("serving.segment_event_fetch"):
+            toks, aq, aslot, steps, qadm = jax.device_get(out[5:])
+        steps, qadm = int(steps), int(qadm)
+        self.last_run_ticks += steps
+        self.last_run_chunks += 1
+
+        # page bookkeeping rides the SHARED replay via hooks; retired
+        # slots' releases are DEFERRED past the prefix-cache inserts so
+        # harvest-by-reference can still retain a finished request's
+        # prompt pages
+        pending_frees: List[List[int]] = []
+
+        def on_admit(q, s):
+            pgr.install(s, req_pages[q])
+
+        def on_retire(r, s):
+            pending_frees.append(pgr.slot_pages[s])
+            pgr.slot_pages[s] = []
+
+        admitted, first_tokens, finished, new_tokens, eos_stops = \
+            self._replay_segment(picked, toks, aq, aslot, steps, n,
+                                 on_admit, on_retire)
+        if qadm < n:
+            # step budget ran out before every picked request found a
+            # slot: release the reservations and requeue FCFS
+            for j in range(qadm, n):
+                picked[j].admit_time = 0.0
+                pgr.release_pages(req_pages[j])
+            self._queue[:0] = picked[qadm:]
+
+        # prefix-cache population: harvest BY REFERENCE — retain the
+        # admitted request's prompt-spanning pages (zero row copies; the
+        # cache and the slot share physical pages from this moment)
+        if prefix_cache is not None:
+            last_admit = {}                # slot -> its latest admit event
+            for st in range(steps):
+                q = int(aq[st])
+                if q < n:
+                    last_admit[int(aslot[st])] = q
+            for s, q in last_admit.items():
+                r = picked[q]
+                plen_b = prefix_cache.round_down(len(r.prompt))
+                if plen_b > pre_lens_l[q]:
+                    prefix_cache.insert(r.prompt[:plen_b],
+                                        req_pages[q][:plen_b // psz])
+        for pages in pending_frees:
+            pgr.release_pages(pages)
+        pgr._gauges()
+
+        self._segment_telemetry(steps, admitted, finished, eos_stops,
+                                new_tokens, max(0, n - qadm))
         return {"steps": steps, "admitted": admitted,
                 "first_tokens": first_tokens, "finished": finished}
 
@@ -919,6 +1266,16 @@ class ServingEngine:
         to the next host-known refill point issue without reading
         anything back (chunks chain device-side through jax async
         dispatch) and the window ends in ONE batched fetch."""
+        if self.paged:
+            # paged engines drain through the segment path (the online
+            # product's loop): same greedy in-program admission, one
+            # dispatch + one fetch per segment
+            self.last_run_ticks = 0
+            self.last_run_chunks = 0
+            self.last_latencies = {}
+            while self._queue or any(r is not None for r in self._active):
+                self.run_segment(4 * self.chunk)
+            return self.collect_finished()
         if fused and self._queue and \
                 all(r is None for r in self._active):
             return self._run_fused()

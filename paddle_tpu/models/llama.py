@@ -796,6 +796,13 @@ def _cache_attention(cfg: LlamaConfig, q, kc, vc, positions):
                 jnp.reshape(jnp.asarray(positions)[..., 0], (-1,)),
                 (B,)).astype(jnp.int32)
             return ragged_decode_attention(q[:, 0], kc, vc, pos_b)[:, None]
+    return _dense_cache_attention(cfg, q, kc, vc, positions)
+
+
+def _dense_cache_attention(cfg: LlamaConfig, q, kc, vc, positions):
+    """The dense XLA formulation of cache attention (the dispatch
+    fallback, shared by the contiguous and paged-gather paths)."""
+    B, T, nH, D = q.shape
     Smax = kc.shape[1]
     rep = cfg.num_heads // cfg.num_kv_heads
     dt = q.dtype
@@ -967,6 +974,123 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos,
                                             keepdims=False)
     logits = last @ params["lm_head"].astype(dt)  # [B, V]
     return logits.astype(jnp.float32), {"k": kcs, "v": vcs}
+
+
+def _paged_attention(cfg: LlamaConfig, q, kc, vc, page_table, positions):
+    """Attention over a paged KV pool. q [B,T,nH,D]; kc/vc
+    [P, page_size, Hkv, D] (the flat pool); page_table [B, max_pages];
+    ``positions`` [B, T] absolute query positions (row t of slot b at
+    ``positions[b, t]``, keys [0, positions[b, t]] visible). Dispatches
+    to the unified page-indirect Pallas kernel when the shape tiles
+    (per-slot KV reads scale with position); the fallback gathers the
+    slot's pages into a contiguous window and reuses the dense
+    formulation — identical math, CPU/tier-1's path."""
+    from ..ops.pallas.paged_attention import (paged_attention_active,
+                                              ragged_paged_attention)
+
+    B, T = q.shape[:2]
+    psz = kc.shape[1]
+    if paged_attention_active(psz, cfg.num_heads, cfg.num_kv_heads,
+                              cfg.head_dim):
+        return ragged_paged_attention(q, kc, vc, page_table,
+                                      positions[:, 0])
+    W = page_table.shape[1] * psz
+    gk = kc[page_table].reshape(B, W, kc.shape[2], kc.shape[3])
+    gv = vc[page_table].reshape(B, W, vc.shape[2], vc.shape[3])
+    return _dense_cache_attention(cfg, q, gk, gv, positions)
+
+
+def forward_with_pages(params, tokens, cfg: LlamaConfig, pool, page_table,
+                       pos, live=None, logit_pos=None):
+    """``forward_with_cache`` over a PAGED KV pool (inference/paged_kv).
+
+    tokens [B, T] run at absolute positions ``pos[b] .. pos[b]+T-1``
+    per row (``pos``: [B] int32 — every slot at its OWN base position:
+    T == 1 is a ragged decode tick, T > 1 a prefill chunk at context
+    offset ``pos[b]``). ``pool``: {"k","v"} [L, num_pages, page_size,
+    Hkv, D] flat page pools; ``page_table``: [B, max_pages] int32 —
+    virtual page slot j of row b is physical page ``page_table[b, j]``.
+    K/V rows scatter page-indirectly at their positions; ``live``
+    ([B] bool, optional) routes retired slots' writes to the reserved
+    trash page 0 instead (a frozen slot must never write a page the
+    allocator may have handed to someone else), as do positions past
+    the table. Returns (logits [B, V], updated pool)."""
+    dt = cfg.dtype
+    B, T = tokens.shape
+    psz = pool["k"].shape[2]
+    max_pages = page_table.shape[1]
+    x = params["embed"].astype(dt)[tokens]
+    pos = jnp.asarray(pos, jnp.int32).reshape(B)
+    positions = pos[:, None] + jnp.arange(T)            # [B, T]
+    # destination coordinates for the chunk's K/V rows — shared by all
+    # layers (virtual page -> physical page via the table; dead slots
+    # and rows past the table land in trash page 0)
+    vpage = positions // psz
+    prow = positions % psz
+    phys = jnp.take_along_axis(page_table,
+                               jnp.minimum(vpage, max_pages - 1), axis=1)
+    writable = vpage < max_pages
+    if live is not None:
+        writable = writable & live[:, None]
+    phys = jnp.where(writable, phys, 0)
+    layer_weights = {kk: params[kk] for kk in layer_keys(cfg)}
+
+    fused_tick = T == 1 and _tick_fused_active(cfg)
+
+    def _qkv(x, lp):
+        return (_decode_qkv(cfg, x, lp, pos) if fused_tick
+                else _qkv_proj(cfg, x, lp, positions))
+
+    def _post(x, attn, lp):
+        return (_decode_post(cfg, x, attn, lp) if fused_tick
+                else _layer_post(cfg, x, attn, lp))
+
+    def body(x, per_layer):
+        lp, kc, vc = per_layer
+        q, k_new, v_new = _qkv(x, lp)
+        kc = kc.at[phys, prow].set(k_new.astype(kc.dtype))
+        vc = vc.at[phys, prow].set(v_new.astype(vc.dtype))
+        attn = _paged_attention(cfg, q, kc, vc, page_table, positions)
+        return _post(x, attn, lp), (kc, vc)
+
+    if cfg.scan_layers:
+        x, (kps, vps) = jax.lax.scan(body, x,
+                                     (layer_weights, pool["k"], pool["v"]))
+    else:
+        kps, vps = pool["k"], pool["v"]
+        for i in range(cfg.num_layers):
+            lp = {kk: layer_weights[kk][i] for kk in layer_weights}
+            q, k_new, v_new = _qkv(x, lp)
+            kps = kps.at[i, phys, prow].set(k_new.astype(kps.dtype))
+            vps = vps.at[i, phys, prow].set(v_new.astype(vps.dtype))
+            attn = _paged_attention(cfg, q, kps[i], vps[i], page_table,
+                                    positions)
+            x = _post(x, attn, lp)
+    if fused_tick:
+        from ..ops.pallas.tick_fusion import fused_rms_norm
+
+        x = fused_rms_norm(x[:, 0], params["ln_f"], cfg.rms_eps)[:, None]
+    else:
+        x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
+    if logit_pos is None:
+        last = x[:, -1]
+    elif getattr(logit_pos, "ndim", 0) == 1:
+        last = x[jnp.arange(B), logit_pos]
+    else:
+        last = jax.lax.dynamic_index_in_dim(x, logit_pos, axis=1,
+                                            keepdims=False)
+    logits = last @ params["lm_head"].astype(dt)  # [B, V]
+    return logits.astype(jnp.float32), {"k": kps, "v": vps}
+
+
+def init_paged_pool(cfg: LlamaConfig, num_pages: int, page_size: int,
+                    dtype=None) -> Dict[str, jax.Array]:
+    """Flat paged K/V pool: [L, num_pages, page_size, Hkv, D]. Page 0 is
+    the allocator's reserved trash page (see inference/paged_kv.py)."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
 def prompt_kv(params, prompt, cfg: LlamaConfig,

@@ -34,10 +34,11 @@ def forced(monkeypatch):
     llama._decode_program.cache_clear()
 
 
-@pytest.fixture
+@pytest.fixture(scope="module")
 def kcfg():
     """Smallest config on which BOTH kernels activate: hidden % 128 == 0
-    and num_kv_heads * head_dim % 128 == 0 (GQA: 4 q heads over 2 kv)."""
+    and num_kv_heads * head_dim % 128 == 0 (GQA: 4 q heads over 2 kv).
+    Module scope (r11): params are seeded and read-only here."""
     set_mesh(None)
     cfg = llama.LlamaConfig(
         vocab_size=128, hidden_size=256, intermediate_size=512,

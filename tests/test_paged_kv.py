@@ -1,0 +1,539 @@
+"""Paged KV-cache subsystem (r11 tentpole): allocator property tests,
+COW break-on-write, the unified page-indirect kernel's interpret-mode
+parity (the tests/test_decode_attention.py pattern — exact kernel code
+paths on the CPU backend), token-identical greedy parity of the paged
+engine vs the contiguous engine on the r7 serving workload, pages-free
+admission with the ``max_len`` provisioning wall removed, and the
+one-sync-per-segment audit over the paged serve loop."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.ops.pallas.paged_attention as pa
+from paddle_tpu.inference.paged_kv import PageAllocator, PagedKVCache
+from paddle_tpu.inference.prefix_cache import PagedPrefixCache
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models import llama
+from paddle_tpu.parallel import set_mesh
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    set_mesh(None)
+    cfg = llama.LlamaConfig.tiny(max_seq_len=96)
+    params = llama.init_params(cfg)
+    return cfg, params
+
+
+def _dense_reference(cfg, params, prompt, n):
+    out = llama.generate(params, np.asarray(prompt, np.int32)[None], cfg,
+                         max_new_tokens=n, max_len=96)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+# ---------------------------------------------------------------------------
+# allocator property tests (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+class TestPageAllocator:
+    def test_alloc_free_refcount_roundtrip(self):
+        a = PageAllocator(9)                      # 8 usable + trash
+        assert a.pages_free == 8
+        pages = a.alloc(3)
+        assert a.pages_free == 5 and all(a.ref(p) == 1 for p in pages)
+        a.retain(pages[:2])                       # COW share
+        assert [a.ref(p) for p in pages] == [2, 2, 1]
+        assert a.release(pages) == 1              # only the unshared frees
+        assert a.pages_free == 6
+        assert a.release(pages[:2]) == 2
+        assert a.pages_free == 8
+        assert a.check() == []
+
+    def test_misuse_raises(self):
+        a = PageAllocator(5)
+        pages = a.alloc(2)
+        a.release(pages)
+        with pytest.raises(RuntimeError, match="double free"):
+            a.release(pages[:1])
+        with pytest.raises(RuntimeError, match="unallocated"):
+            a.retain([pages[0]])
+        with pytest.raises(RuntimeError, match="exhausted"):
+            a.alloc(5)
+        assert a.check() == []
+
+    def test_randomized_schedule_no_leak_no_double_free(self):
+        """Randomized admit / COW-share / finish / preempt schedule: the
+        free-list + refcount invariant must hold at every step and every
+        page must come back once everything retires."""
+        rng = np.random.RandomState(0)
+        a = PageAllocator(33)                     # 32 usable
+        live = []                                 # reservations: page lists
+        for step in range(300):
+            op = rng.randint(4)
+            if op == 0 and a.pages_free >= 4:     # admit
+                live.append(a.alloc(int(rng.randint(1, 5))))
+            elif op == 1 and live:                # COW prefix share
+                src = live[rng.randint(len(live))]
+                k = int(rng.randint(1, len(src) + 1))
+                shared = src[:k]
+                a.retain(shared)
+                extra = (a.alloc(int(rng.randint(0, min(3, a.pages_free)
+                                                 + 1)))
+                         if a.pages_free else [])
+                live.append(shared + extra)
+            elif op == 2 and live:                # finish
+                a.release(live.pop(rng.randint(len(live))))
+            elif op == 3 and live:                # preempt: free + resume
+                idx = rng.randint(len(live))
+                pages = live.pop(idx)
+                a.release(pages)
+                if a.pages_free >= len(pages):
+                    live.append(a.alloc(len(pages)))
+            assert a.check() == [], f"invariant broke at step {step}"
+        for pages in live:
+            a.release(pages)
+        assert a.check() == []
+        assert a.pages_free == 32
+
+
+class TestCopyOnWrite:
+    def test_break_on_write_gives_private_page(self, tiny):
+        """fork -> shared pages (ref 2, zero copies); ensure_writable on
+        the sharer -> ONE private page copy whose mutation leaves the
+        original bit-identical; unshared pages break for free."""
+        cfg, _ = tiny
+        pgr = PagedKVCache(cfg, slots=2, page_size=8, num_pages=9,
+                           max_pages=4)
+        pages, row = pgr.reserve(16)              # 2 pages for slot 0
+        pgr.install(0, pages)
+        pgr.page_table = pgr.page_table.at[0].set(jnp.asarray(row))
+        marker = jnp.ones_like(pgr.pool["k"][:, pages[0]]) * 7.0
+        pgr.pool["k"] = pgr.pool["k"].at[:, pages[0]].set(marker)
+
+        pgr.fork_slot(0, 1)                       # ref bump only
+        assert pgr.slot_pages[1] == pages
+        assert pgr.allocator.ref(pages[0]) == 2
+        assert pgr.cow_breaks == 0
+
+        new = pgr.ensure_writable(1, 0)           # break on write
+        assert new != pages[0] and pgr.cow_breaks == 1
+        assert pgr.allocator.ref(pages[0]) == 1
+        np.testing.assert_array_equal(np.asarray(pgr.pool["k"][:, new]),
+                                      np.asarray(marker))
+        pgr.pool["k"] = pgr.pool["k"].at[:, new].set(marker * 2)
+        np.testing.assert_array_equal(
+            np.asarray(pgr.pool["k"][:, pages[0]]), np.asarray(marker))
+        # already-private page: no further copy
+        assert pgr.ensure_writable(1, 0) == new
+        assert pgr.cow_breaks == 1
+        pgr.free_slot(0)
+        pgr.free_slot(1)
+        assert pgr.leak_report() == []
+
+
+# ---------------------------------------------------------------------------
+# unified page-indirect kernel (interpret-mode parity, r6 pattern)
+# ---------------------------------------------------------------------------
+
+
+class TestUnifiedKernel:
+    @pytest.mark.parametrize("nH,Hkv,D", [(4, 2, 64), (2, 2, 128),
+                                          (8, 8, 64)])
+    def test_mixed_phase_parity(self, nH, Hkv, D):
+        """One launch serving co-resident prefill chunks (q_len > 1) and
+        decode ticks (q_len == 1) over a SHUFFLED page table, vs the
+        dense gather formulation."""
+        rng = np.random.RandomState(0)
+        B, Tq, psz, P, max_pages = 4, 8, 16, 33, 8
+        q = jnp.asarray(rng.randn(B, Tq, nH, D), jnp.float32)
+        kp = jnp.asarray(rng.randn(P, psz, Hkv, D), jnp.float32)
+        vp = jnp.asarray(rng.randn(P, psz, Hkv, D), jnp.float32)
+        pt = jnp.asarray(rng.permutation(np.arange(1, P))[:B * max_pages]
+                         .reshape(B, max_pages), jnp.int32)
+        ctx = jnp.asarray([0, 5, 37, 100], jnp.int32)
+        qlen = jnp.asarray([1, 8, 3, 1], jnp.int32)
+        out = pa.ragged_paged_attention(q, kp, vp, pt, ctx, qlen,
+                                        interpret=True)
+        cfg = llama.LlamaConfig.tiny(num_heads=nH, num_kv_heads=Hkv,
+                                     hidden_size=nH * D)
+        gk = kp[pt].reshape(B, max_pages * psz, Hkv, D)
+        gv = vp[pt].reshape(B, max_pages * psz, Hkv, D)
+        ref = llama._dense_cache_attention(
+            cfg, q, gk, gv, ctx[:, None] + jnp.arange(Tq))
+        for b in range(B):
+            t = int(qlen[b])  # rows past q_len are padding (discarded)
+            np.testing.assert_allclose(np.asarray(out)[b, :t],
+                                       np.asarray(ref)[b, :t],
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_pages_read_scale_with_position(self):
+        """The analytic pages-fetched contract the BlockSpec clamp
+        enforces: reads track ctx + q_len, not the table width."""
+        assert pa.pages_read(0, 1, 16) == 1
+        assert pa.pages_read(15, 1, 16) == 1
+        assert pa.pages_read(16, 1, 16) == 2
+        assert pa.pages_read(100, 1, 16) == 7
+        assert pa.pages_read(32, 8, 16) == 3   # prefill chunk spans more
+
+    def test_dispatch_gates(self, monkeypatch):
+        if jax.default_backend() == "cpu":
+            assert not pa.paged_attention_active(16, 4, 2, 64)  # dense
+        monkeypatch.setattr(pa, "FORCE_INTERPRET", True)
+        assert pa.paged_attention_active(16, 4, 2, 64)
+        assert not pa.paged_attention_active(12, 4, 2, 64)   # psz % 8
+        assert not pa.paged_attention_active(16, 4, 2, 32)   # lanes < 128
+        assert not pa.paged_attention_active(16, 3, 2, 64)   # GQA ragged
+        import paddle_tpu
+
+        paddle_tpu.set_flags({"use_paged_attention": False})
+        try:
+            assert not pa.paged_attention_active(16, 4, 2, 64)
+        finally:
+            paddle_tpu.set_flags({"use_paged_attention": True})
+
+    def test_forward_with_pages_kernel_matches_fallback(self, monkeypatch):
+        """llama.forward_with_pages with the kernel FORCED (interpret)
+        vs the gather+dense fallback — one ragged decode tick on a
+        shuffled page table, logits AND pool writes identical."""
+        set_mesh(None)
+        cfg = llama.LlamaConfig(
+            vocab_size=128, hidden_size=256, intermediate_size=512,
+            num_layers=1, num_heads=4, num_kv_heads=2, max_seq_len=128,
+            dtype=jnp.float32, remat=False, scan_layers=False)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(3)
+        pool = llama.init_paged_pool(cfg, 17, 16)
+        pool = {k: jnp.asarray(rng.randn(*v.shape), jnp.float32) * 0.1
+                for k, v in pool.items()}
+        pt = jnp.asarray(rng.permutation(np.arange(1, 17))
+                         .reshape(2, 8), jnp.int32)
+        toks = jnp.asarray([[3], [5]], jnp.int32)
+        pos = jnp.asarray([9, 37], jnp.int32)
+        ref_l, ref_pool = llama.forward_with_pages(params, toks, cfg,
+                                                   pool, pt, pos)
+        monkeypatch.setattr(pa, "FORCE_INTERPRET", True)
+        pa.reset_selection_count()
+        out_l, out_pool = llama.forward_with_pages(params, toks, cfg,
+                                                   pool, pt, pos)
+        assert pa.selection_count() >= 1
+        np.testing.assert_allclose(np.asarray(out_l), np.asarray(ref_l),
+                                   rtol=2e-4, atol=1e-5)
+        for kk in ("k", "v"):
+            np.testing.assert_allclose(np.asarray(out_pool[kk]),
+                                       np.asarray(ref_pool[kk]),
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_cpu_defaults_stay_dense(self):
+        """Without the force, CPU dispatch must not select the paged
+        kernel — tier-1 numerics ride the gather+dense path."""
+        if jax.default_backend() != "cpu":
+            pytest.skip("dispatch default differs on an accelerator")
+        pa.reset_selection_count()
+        cfg = llama.LlamaConfig.tiny(max_seq_len=64)
+        params = llama.init_params(cfg)
+        pool = llama.init_paged_pool(cfg, 9, 16)
+        pt = jnp.asarray(np.arange(1, 9).reshape(2, 4), jnp.int32)
+        llama.forward_with_pages(params, jnp.asarray([[1], [2]], jnp.int32),
+                                 cfg, pool, pt,
+                                 jnp.asarray([4, 9], jnp.int32))
+        assert pa.selection_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# paged engine: token-identical serving (acceptance criterion 3)
+# ---------------------------------------------------------------------------
+
+
+def _serve_r7_workload(cfg, params, paged, prefix_cache=None, slots=3,
+                       **paged_kw):
+    """The r7 serving workload shape (mixed prompt/gen lengths through
+    re-entrant segments with mid-flight arrivals), parameterised on the
+    cache layout."""
+    rng = np.random.RandomState(21)
+    wave1 = [(rng.randint(0, cfg.vocab_size, (l,)).astype(np.int32), n)
+             for l, n in [(5, 9), (12, 6), (8, 12)]]
+    wave2 = [(rng.randint(0, cfg.vocab_size, (l,)).astype(np.int32), n)
+             for l, n in [(20, 4), (3, 8), (15, 5), (7, 10)]]
+    eng = ServingEngine(cfg, params, slots=slots, max_len=96,
+                        prompt_buckets=(8, 16, 32), paged=paged,
+                        **paged_kw)
+    pc = None
+    if prefix_cache:
+        pc = (PagedPrefixCache(eng.pager, capacity_pages=64) if paged
+              else prefix_cache)
+    rids = [eng.add_request(p, n) for p, n in wave1]
+    eng.run_segment(5, prefix_cache=pc)       # partial: slots still live
+    rids += [eng.add_request(p, n) for p, n in wave2]
+    while eng._queue or eng.free_slot_count() < eng.slots:
+        eng.run_segment(7, prefix_cache=pc)
+    out = eng.collect_finished()
+    return eng, [out[r] for r in rids], wave1 + wave2
+
+
+class TestPagedEngineParity:
+    def test_r7_workload_token_identical_vs_contiguous(self, tiny):
+        """Acceptance: the paged engine's greedy tokens == the
+        contiguous engine's == dense generate(), on the r7 mixed
+        workload with mid-flight arrivals — and every page comes back."""
+        cfg, params = tiny
+        eng_c, out_c, reqs = _serve_r7_workload(cfg, params, paged=False)
+        eng_p, out_p, _ = _serve_r7_workload(cfg, params, paged=True,
+                                             page_size=16)
+        assert out_p == out_c
+        # one dense spot-check (contiguous==dense on this workload is
+        # already pinned by test_serving.py::TestSegmentReentry)
+        p0, n0 = reqs[0]
+        assert out_p[0] == _dense_reference(cfg, params, p0, n0)
+        assert eng_p.pager.leak_report() == []
+
+    def test_eos_freeze_and_slot_reuse(self, tiny):
+        """EOS freezes a paged slot in-program, its pages free at the
+        sync, and a queued request takes the slot within the same
+        segment — token parity with the dense path's truncation."""
+        cfg, params = tiny
+        rng = np.random.RandomState(23)
+        prompts = [rng.randint(0, cfg.vocab_size, (6 + i,)).astype(np.int32)
+                   for i in range(4)]
+        refs = [_dense_reference(cfg, params, p, 8) for p in prompts]
+        eos = refs[0][1]                  # early EOS for request 0 only
+        eng = ServingEngine(cfg, params, slots=1, max_len=96,
+                            prompt_buckets=(16,), eos_token_id=eos,
+                            paged=True, page_size=16)
+        rids = [eng.add_request(p, 8) for p in prompts]
+        while eng._queue or eng.free_slot_count() < eng.slots:
+            eng.run_segment(24)
+        out = eng.collect_finished()
+        for rid, ref in zip(rids, refs):
+            want = ref[:ref.index(eos) + 1] if eos in ref else ref
+            assert out[rid] == want, (rid, out[rid], want)
+        assert eng.pager.leak_report() == []
+
+    def test_prefix_hit_is_ref_bump_only(self, tiny):
+        """Acceptance: a prefix hit performs ZERO KV row copies — pages
+        are shared by refcount (cow_shares moves, cow_breaks stays 0)
+        and the hit path is token-identical to cold."""
+        from paddle_tpu.observability import metrics
+
+        cfg, params = tiny
+        rng = np.random.RandomState(41)
+        prefix = rng.randint(0, cfg.vocab_size, (32,)).astype(np.int32)
+        tails = [rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+                 for _ in range(4)]
+        prompts = [np.concatenate([prefix, t]) for t in tails]
+        refs = [_dense_reference(cfg, params, p, 6) for p in prompts]
+
+        def serve(with_cache):
+            eng = ServingEngine(cfg, params, slots=2, max_len=96,
+                                prompt_buckets=(8, 16, 64), paged=True,
+                                page_size=16)
+            pc = (PagedPrefixCache(eng.pager, capacity_pages=64)
+                  if with_cache else None)
+            rids = [eng.add_request(p, 6) for p in prompts]
+            while eng._queue or eng.free_slot_count() < eng.slots:
+                eng.run_segment(16, prefix_cache=pc)
+            done = eng.collect_finished()
+            return eng, pc, [done[r] for r in rids]
+
+        _, _, cold = serve(False)
+        shares0 = metrics.counter("serving.pages.cow_shares").value
+        breaks0 = metrics.counter("serving.pages.cow_breaks").value
+        eng, pc, hot = serve(True)
+        assert cold == hot == refs
+        assert pc.hits >= 2 and pc.hit_tokens >= 2 * 32
+        assert metrics.counter("serving.pages.cow_shares").value > shares0
+        assert metrics.counter("serving.pages.cow_breaks").value == breaks0
+        assert eng.pager.cow_breaks == 0
+        # dedup, not copy: the cache's entry pages ARE slot pages that
+        # were live — clearing the cache returns everything
+        pc.clear()
+        assert eng.pager.leak_report() == []
+
+
+# ---------------------------------------------------------------------------
+# pages-free admission: the max_len wall, backpressure, eviction valve
+# ---------------------------------------------------------------------------
+
+
+class TestPagesFreeAdmission:
+    def test_max_len_wall_removed(self, tiny):
+        """Acceptance: a pool provisioned WELL below slots x max_len
+        serves a workload at full slot concurrency — per-slot footprint
+        is live pages, not the worst-case window. 4 slots x max_len 96
+        would need 384 contiguous rows; the pool holds 208."""
+        cfg, params = tiny
+        eng = ServingEngine(cfg, params, slots=4, max_len=96,
+                            prompt_buckets=(8, 16, 32), paged=True,
+                            page_size=16, num_pages=14)   # 13*16 = 208
+        assert (eng.pager.num_pages - 1) * eng.page_size \
+            < eng.slots * eng.max_len
+        rng = np.random.RandomState(7)
+        reqs = [(rng.randint(0, cfg.vocab_size, (l,)).astype(np.int32), n)
+                for l, n in [(30, 9), (5, 7), (12, 3), (3, 12), (17, 5),
+                             (25, 4), (8, 8), (6, 6)]]
+        rids = [eng.add_request(p, n) for p, n in reqs]
+        peak_live = 0
+        while eng._queue or eng.free_slot_count() < eng.slots:
+            eng.run_segment(8)
+            peak_live = max(peak_live,
+                            eng.slots - eng.free_slot_count())
+        out = eng.collect_finished()
+        for rid, (p, n) in zip(rids, reqs):
+            assert out[rid] == _dense_reference(cfg, params, p, n)
+        assert peak_live == eng.slots    # full concurrency, 54% the HBM
+        assert eng.pager.leak_report() == []
+
+    def test_backpressure_pages_counted(self, tiny):
+        """Satellite 2: admission defers on pages-free (NOT slots-free)
+        and counts backpressure{reason='pages'}; deferred requests serve
+        once pages retire. FCFS order preserved."""
+        from paddle_tpu.observability import metrics
+
+        cfg, params = tiny
+        # 5 usable pages; each request spans 3 -> only one admits at a
+        # time even though TWO slots are free
+        eng = ServingEngine(cfg, params, slots=2, max_len=96,
+                            prompt_buckets=(32,), paged=True,
+                            page_size=16, num_pages=6)
+        rng = np.random.RandomState(5)
+        reqs = [(rng.randint(0, cfg.vocab_size, (30,)).astype(np.int32), 9)
+                for _ in range(3)]
+        rids = [eng.add_request(p, n) for p, n in reqs]
+        before = metrics.counter("serving.backpressure_pages").value
+        while eng._queue or eng.free_slot_count() < eng.slots:
+            eng.run_segment(16)
+        out = eng.collect_finished()
+        assert eng.page_backpressure_events > 0
+        assert metrics.counter("serving.backpressure_pages").value > before
+        for rid, (p, n) in zip(rids, reqs):
+            assert out[rid] == _dense_reference(cfg, params, p, n)
+        assert eng.pager.leak_report() == []
+
+    def test_prefix_cache_yields_pages_under_pressure(self, tiny):
+        """The eviction valve: cached history releases LRU pages before
+        live traffic defers — cache-held pages never starve admission."""
+        cfg, params = tiny
+        # 5 usable pages; each request spans 4 and leaves a 3-page
+        # prefix entry behind — the next admission MUST reclaim it
+        eng = ServingEngine(cfg, params, slots=2, max_len=96,
+                            prompt_buckets=(64,), paged=True,
+                            page_size=16, num_pages=6)
+        pc = PagedPrefixCache(eng.pager, capacity_pages=8)
+        rng = np.random.RandomState(11)
+        reqs = [(rng.randint(0, cfg.vocab_size, (50,)).astype(np.int32), 6)
+                for _ in range(3)]
+        rids = [eng.add_request(p, n) for p, n in reqs]
+        while eng._queue or eng.free_slot_count() < eng.slots:
+            eng.run_segment(16, prefix_cache=pc)
+        out = eng.collect_finished()
+        for rid, (p, n) in zip(rids, reqs):
+            assert out[rid] == _dense_reference(cfg, params, p, n)
+        assert pc.evictions > 0          # the valve actually opened
+        pc.clear()
+        assert eng.pager.leak_report() == []
+
+
+# ---------------------------------------------------------------------------
+# paged prefix cache unit behaviour
+# ---------------------------------------------------------------------------
+
+
+class TestPagedPrefixCacheUnit:
+    def test_match_insert_evict_mechanics(self, tiny):
+        cfg, _ = tiny
+        pgr = PagedKVCache(cfg, slots=1, page_size=8, num_pages=17,
+                           max_pages=8)
+        pc = PagedPrefixCache(pgr, capacity_pages=4)
+        rng = np.random.RandomState(43)
+        base = rng.randint(0, cfg.vocab_size, (32,)).astype(np.int32)
+        pages, _ = pgr.reserve(32)               # a "slot" holding base
+        pc.insert(base, pages)
+        assert pc.pages_held == 4
+        assert all(pgr.allocator.ref(p) == 2 for p in pages)
+        # partial overlap: same first 8 tokens -> one-page hit, strict
+        probe = np.concatenate(
+            [base[:8], rng.randint(0, cfg.vocab_size, (12,))]
+        ).astype(np.int32)
+        m = pc.match(probe)
+        assert m is not None and m.length == 8 and len(m.pages) == 1
+        assert m.pages[0] == pages[0]
+        # whole-prompt coverage is refused (one token must prefill)
+        assert pc.match(base[:8]) is None
+        # capacity eviction: a second entry pushes past 4 pages
+        other_pages, _ = pgr.reserve(32)
+        pc.insert(rng.randint(0, cfg.vocab_size, (32,)).astype(np.int32),
+                  other_pages)
+        assert pc.pages_held <= 4 and pc.evictions >= 1
+        pgr.release_pages(pages)
+        pgr.release_pages(other_pages)
+        pc.clear()
+        assert pgr.leak_report() == []
+
+    def test_contiguous_engine_rejects_paged_cache_mix(self, tiny):
+        """A paged engine passed the r7 row-copy cache fails loudly."""
+        from paddle_tpu.inference.prefix_cache import PrefixCache
+
+        cfg, params = tiny
+        eng = ServingEngine(cfg, params, slots=1, max_len=96,
+                            prompt_buckets=(16,), paged=True, page_size=16)
+        eng.add_request(np.arange(8, dtype=np.int32), 2)
+        with pytest.raises(TypeError, match="PagedPrefixCache"):
+            eng.run_segment(4, prefix_cache=PrefixCache(block=16))
+
+
+# ---------------------------------------------------------------------------
+# audit: the one-sync-per-segment invariant survives paging
+# ---------------------------------------------------------------------------
+
+
+class TestPagedSchedulerAudit:
+    def test_online_serve_loop_syncs(self, tiny):
+        """The paged serve loop keeps the r7/r9 contract: exactly ONE
+        allowed device->host sync per segment (the event fetch), zero
+        flagged — page-table bookkeeping is pure host arithmetic."""
+        from paddle_tpu.analysis import syncs
+        from paddle_tpu.inference.scheduler import (OnlineScheduler,
+                                                    staggered_arrivals)
+
+        cfg, params = tiny
+        eng = ServingEngine(cfg, params, slots=4, max_len=96, chunk=8,
+                            prompt_buckets=(16,), paged=True, page_size=16)
+        pc = PagedPrefixCache(eng.pager, capacity_pages=16)
+        sched = OnlineScheduler(eng, seg_steps=16, prefix_cache=pc)
+        arrivals = staggered_arrivals(0, 6, 0.01, cfg.vocab_size,
+                                      prompt_lens=(8, 12), gen_lens=(4, 6))
+        sched.serve(arrivals)          # warm: compiles + first fetches
+        eng.reset_slots()
+        pc.clear()
+        sched._reqs.clear()
+        with syncs.SyncAudit() as sa:
+            sa.phase = "replay"
+            report = sched.serve(arrivals)
+        assert report.n_requests == 6
+        flagged = sa.flagged("replay")
+        assert flagged == [], [f"{e.kind}@{e.site}" for e in flagged]
+        allowed = sa.allowed("replay")
+        assert set(allowed) == {"serving.segment_event_fetch"}
+        assert allowed["serving.segment_event_fetch"] == report.segments
+        assert report.pages is not None and report.backpressure_pages == 0
+
+    def test_paged_cache_keys_bucketed(self, tiny):
+        """Page tables must be DATA, not shape: repeated paged segments
+        (prefix on and off) grow no unbucketed program keys."""
+        from paddle_tpu.analysis import recompile
+
+        cfg, params = tiny
+        eng = ServingEngine(cfg, params, slots=4, max_len=96, chunk=8,
+                            prompt_buckets=(16,), paged=True, page_size=16)
+        pc = PagedPrefixCache(eng.pager, capacity_pages=16)
+        for _ in range(2):
+            eng.add_request(np.arange(8, dtype=np.int32) % cfg.vocab_size,
+                            3)
+            eng.run_segment(8, prefix_cache=pc)
+        lint = recompile.lint_cache_keys(**eng.cache_info())
+        assert not lint.hazard
+        pc.clear()
+        assert eng.pager.leak_report() == []

@@ -10,8 +10,11 @@ from paddle_tpu.models import llama
 from paddle_tpu.parallel import set_mesh
 
 
-@pytest.fixture
+@pytest.fixture(scope="module")
 def tiny():
+    # module scope (r11 suite-time maintenance): params are seeded and
+    # every test builds its own engine, so nothing leaks between tests —
+    # the per-test init_params + first-dispatch cost was pure overhead
     set_mesh(None)
     cfg = llama.LlamaConfig.tiny(max_seq_len=96)
     params = llama.init_params(cfg)
