@@ -22,6 +22,12 @@ Modes (r7 — VERDICT r5 items 3 and 9):
                      (token-identical asserted), pages-per-token, the
                      tight-pool max_len-wall run, and the shared-prefix
                      DEDUP ratio vs the r7 row-copy cache.
+* ``--fleet``        fleet router (r12, ISSUE 7): one seeded Poisson
+                     trace served at N x its base rate by N engine
+                     replicas (N = 1, 2, 4) behind the prefix-affinity
+                     router — tok/s + TTFT/e2e scaling vs N, token
+                     identity across fleet sizes, affinity/dispatch
+                     accounting, rank-merged telemetry.
 * ``--smoke``        tiny-config in-process invariant check (tier-1 CPU
                      suite hook; see ``smoke()``).
 
@@ -568,6 +574,175 @@ def run_paged(model_name, cfg, params, llama, n=24, seed=5, slots=8,
 
 
 # ---------------------------------------------------------------------------
+# fleet: N engine replicas behind the prefix-affinity router (r12)
+# ---------------------------------------------------------------------------
+
+def measure_fleet_service_rate(cfg, params, n, seed, slots, seg_steps):
+    """Saturated SEGMENT-mode throughput of one replica behind the
+    router (a burst trace: every request due at t~0) — the capacity pin
+    the fleet's arrival rates are expressed against. The offline fused
+    drain (``measure_service_rate``) over-states what the online
+    segment loop can serve; rating against it pushed the N=4 point past
+    saturation on this container."""
+    from paddle_tpu.inference.fleet import FleetRouter, build_fleet
+    from paddle_tpu.inference.scheduler import poisson_arrivals
+
+    arr = poisson_arrivals(seed + 1, n, 1e4, cfg.vocab_size,
+                           _ONLINE_PLENS, _ONLINE_GLENS)
+    router = FleetRouter(build_fleet(cfg, params, 1, slots=slots,
+                                     max_len=256,
+                                     prompt_buckets=(32, 64, 128)),
+                         max_queue=10 ** 6, seg_steps=seg_steps)
+    rep = router.serve(arr, warm=True)
+    return (rep.throughput_tok_s,
+            rep.throughput_tok_s / (rep.total_tokens / rep.n_requests))
+
+
+def run_fleet(model_name, cfg, params, llama, n=96, seed=0, slots=8,
+              replica_counts=(1, 2, 4), seg_steps=16, base_ratio=0.12):
+    """The replica-scaling evidence (ISSUE 7): ONE seeded Poisson trace,
+    served at N x its base arrival rate by a fleet of N replicas, for
+    N = 1, 2, 4 — tok/s, TTFT/e2e p50/p99, dispatch/backpressure
+    accounting, and per-request token identity across fleet sizes
+    (greedy decode is placement-independent, asserted).
+
+    Honesty notes, recorded in the JSON: this container exposes ONE cpu
+    core and one jax device, so the N replicas timeslice instead of
+    running on N chips — the base rate is pinned at ``base_ratio`` of
+    the measured single-replica SEGMENT-mode service rate so the
+    N x-rate offered load stays inside the shared-core capacity. The
+    scaling axis measured here is the ROUTER: fan-out of N x the load
+    at near-linear served tok/s and flat TTFT p99, with per-request
+    tokens identical at every fleet size. N x capacity itself needs one
+    chip per replica (``build_fleet(devices=...)`` commits each
+    replica's weights to its own device and the dispatch/finish split
+    overlaps their segments); the harness and bars carry over
+    unchanged (SCALING §3g)."""
+    import tempfile
+
+    import jax
+
+    from paddle_tpu.inference.fleet import FleetRouter, build_fleet
+    from paddle_tpu.inference.scheduler import poisson_arrivals, scale_rate
+
+    svc_tok_s, svc_req_s = measure_fleet_service_rate(
+        cfg, params, min(n, 48), seed, slots, seg_steps)
+    base_rate = base_ratio * svc_req_s
+    base = poisson_arrivals(seed + 1, n, base_rate, cfg.vocab_size,
+                            _ONLINE_PLENS, _ONLINE_GLENS)
+    log(f"segment-mode service rate {svc_tok_s:,.0f} tok/s = "
+        f"{svc_req_s:.2f} req/s; base rate {base_rate:.2f} req/s "
+        f"({base_ratio:.2f}x), {len(jax.devices())} devices")
+
+    per_n = []
+    outputs = {}
+    for N in replica_counts:
+        _telemetry_section(reset=True)
+        arr = scale_rate(base, N)
+        engines = build_fleet(cfg, params, N, slots=slots, max_len=256,
+                              prompt_buckets=(32, 64, 128))
+        # per-segment tick budget splits across replicas: N staggered
+        # in-flight segments serialize on this one core, so 16/N ticks
+        # each holds the fleet's control latency (and with it TTFT)
+        # flat as N grows; on real parallel devices the staggered
+        # dispatch overlaps the segments and the knob can stay flat
+        router = FleetRouter(engines, max_queue=4 * slots,
+                             seg_steps=max(4, seg_steps // N))
+        rep = router.serve(arr, warm=True)
+        out = router.results()
+        # fleet rids are assigned in arrival order, which the shared
+        # seeded trace fixes — so index i is the same request at every N
+        outputs[N] = [out[r] for r in sorted(out)]
+        with tempfile.TemporaryDirectory() as d:
+            merged = router.merged_telemetry(d)
+        log(f"N={N} ({rep.dispatches_affinity} affinity / "
+            f"{rep.dispatches_least_loaded} least-loaded): "
+            f"{rep.throughput_tok_s:,.0f} tok/s, ttft p50 "
+            f"{rep.ttft_p50_s*1e3:.0f} ms p99 {rep.ttft_p99_s*1e3:.0f} ms, "
+            f"e2e p99 {rep.e2e_p99_s:.2f}s, makespan {rep.makespan_s:.1f}s")
+        d = rep.as_dict()
+        d = {k: (round(v, 4) if isinstance(v, float) else v)
+             for k, v in d.items()}
+        per_n.append({
+            "replicas": N,
+            "rate_req_s": round(base_rate * N, 3),
+            "report": d,
+            "telemetry_ranks": merged["ranks"],
+            "telemetry_counters": {
+                k: merged["counters"][k]["value"]
+                for k in ("serving.segments", "serving.tokens_generated",
+                          "serving.admissions")
+                if k in merged["counters"]},
+        })
+        assert router.leak_report() == [], router.leak_report()
+
+    for N in replica_counts[1:]:
+        assert outputs[N] == outputs[replica_counts[0]], \
+            f"fleet N={N} changed tokens vs N={replica_counts[0]}"
+    t1 = per_n[0]["report"]["throughput_tok_s"]
+    scaling = {str(p["replicas"]):
+               round(p["report"]["throughput_tok_s"] / t1, 3)
+               for p in per_n} if t1 else {}
+    ttft1 = per_n[0]["report"]["ttft_p99_s"]
+    ttft_ratio = {str(p["replicas"]):
+                  round(p["report"]["ttft_p99_s"] / ttft1, 3)
+                  for p in per_n} if ttft1 else {}
+    log(f"scaling vs N=1: {scaling}; ttft p99 ratio: {ttft_ratio}")
+
+    # affinity evidence: a shared-prefix trace over 2 replicas with
+    # per-replica caches — repeat prefixes must route BACK to the
+    # replica whose cache holds them (hits instead of re-prefills)
+    from paddle_tpu.inference.scheduler import Arrival
+
+    rng = np.random.RandomState(seed + 7)
+    prefixes = [rng.randint(0, cfg.vocab_size, (96,)).astype(np.int32)
+                for _ in range(4)]
+    arr_a = [Arrival(i * 0.001,
+                     np.concatenate([prefixes[i % 4], rng.randint(
+                         0, cfg.vocab_size, (32,)).astype(np.int32)]),
+                     16)
+             for i in range(16)]
+    engines = build_fleet(cfg, params, 2, slots=4, max_len=256,
+                          prompt_buckets=(32, 64, 128))
+    router = FleetRouter(engines, max_queue=16, seg_steps=seg_steps,
+                         prefix_caches="auto")
+    rep_a = router.serve(arr_a, warm=True)
+    hits = sum(p["prefix"]["hits"] for p in rep_a.per_replica)
+    log(f"affinity: {rep_a.dispatches_affinity} affinity dispatches, "
+        f"{hits} prefix hits across 2 replica caches")
+
+    return {
+        "metric": "serving_fleet_scaling",
+        "model": model_name,
+        "platform": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "container_cores": os.cpu_count(),
+        "n_requests": n,
+        "seed": seed,
+        "arrival_process": "poisson, one seeded trace, clock scaled Nx",
+        "service_rate_req_s": round(svc_req_s, 3),
+        "base_ratio_of_service_rate": base_ratio,
+        "per_replica_count": per_n,
+        "throughput_scaling_vs_n1": scaling,
+        "ttft_p99_ratio_vs_n1": ttft_ratio,
+        "tokens_identical_across_n": True,
+        "affinity": {
+            "dispatches_affinity": rep_a.dispatches_affinity,
+            "dispatches_least_loaded": rep_a.dispatches_least_loaded,
+            "prefix_hits": hits,
+            "per_replica": rep_a.per_replica,
+        },
+        "capacity_note": (
+            "single-core container: replicas timeslice one cpu, so the "
+            "measured axis is the router serving Nx offered load at "
+            "flat latency (base rate pinned below shared capacity); "
+            "Nx capacity itself needs one chip per replica — the "
+            "harness and the >=0.85xN bar carry over unchanged"),
+        "telemetry": _telemetry_section(),
+    }
+
+
+# ---------------------------------------------------------------------------
 # smoke: tiny-config invariants for the tier-1 CPU suite (r7 satellite)
 # ---------------------------------------------------------------------------
 
@@ -659,6 +834,7 @@ def main():
     ap.add_argument("--online", action="store_true")
     ap.add_argument("--prefix", action="store_true")
     ap.add_argument("--paged", action="store_true")
+    ap.add_argument("--fleet", action="store_true")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--model", default="auto",
                     choices=("auto", "base", "small", "tiny"))
@@ -686,6 +862,8 @@ def main():
     if args.online:
         print(json.dumps(run_online(model_name, cfg, params, llama,
                                     n=args.n)))
+    elif args.fleet:
+        print(json.dumps(run_fleet(model_name, cfg, params, llama)))
     elif args.prefix:
         print(json.dumps(run_prefix(model_name, cfg, params, llama)))
     elif args.paged:

@@ -16,6 +16,12 @@ Writes ``SERVING_r<N>.json`` at the repo root:
               p50/p99, pages-per-token, tight-pool max_len-wall run,
               shared-prefix dedup ratio vs the row-copy cache...},
               (r11: the paged KV subsystem)
+   "fleet": {...llama_serving --fleet json: N=1/2/4 engine replicas
+              behind the prefix-affinity router on ONE seeded Poisson
+              trace at N x the base rate — tok/s + TTFT p99 scaling vs
+              N, token identity across fleet sizes, affinity/dispatch
+              accounting, rank-merged telemetry...},
+              (r12: the fleet serving subsystem)
    "telemetry_headlines": {...r10 runtime-telemetry headlines per mode —
               queue depth / slot occupancy / prefix hit rate /
               backpressure counters from paddle_tpu.observability; the
@@ -83,6 +89,7 @@ def main() -> int:
         "online": _run_json("llama_serving.py", args=("--online",)),
         "prefix": _run_json("llama_serving.py", args=("--prefix",)),
         "paged": _run_json("llama_serving.py", args=("--paged",)),
+        "fleet": _run_json("llama_serving.py", args=("--fleet",)),
     }
     result["platform"] = result["online"].get("platform", "unknown")
     # r10: lift each mode's runtime-telemetry headline (queue depth,
@@ -91,13 +98,14 @@ def main() -> int:
     # online/prefix "telemetry"
     result["telemetry_headlines"] = {
         k: (result[k].get("telemetry") or {}).get("headline")
-        for k in ("online", "prefix", "paged")}
+        for k in ("online", "prefix", "paged", "fleet")}
     path = os.path.join(ROOT, f"SERVING_r{rnd:02d}.json")
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
     ok = all(result[k].get("rc") == 0
-             for k in ("decode", "serving", "online", "prefix", "paged"))
+             for k in ("decode", "serving", "online", "prefix", "paged",
+                       "fleet"))
     return 0 if ok else 1
 
 

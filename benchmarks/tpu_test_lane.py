@@ -49,6 +49,11 @@ TPU_TEST_FILES = [
     # page-indirect Mosaic kernel (scalar-prefetched page tables), so a
     # paging regression the CPU gather fallback hides fails here
     "tests/test_paged_kv.py",
+    # r12 (ISSUE 7): the fleet serving subsystem — router determinism /
+    # affinity / backpressure smoke on the real backend, plus the mp=2
+    # tensor-parallel segment parity tests (these skip on a single-chip
+    # host and run when the lane sees a multi-device TPU)
+    "tests/test_fleet_serving.py",
 ]
 
 

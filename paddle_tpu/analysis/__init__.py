@@ -21,7 +21,8 @@ program:
    promoted ``benchmarks/collective_audit`` pass).
 
 ``budgets`` pins per-program ceilings; ``python -m paddle_tpu.analysis
---gate`` audits the four canonical programs (``programs``) and exits
+--gate`` audits the registered canonical programs (``programs`` — six
+as of r12, including the mp-sharded ``tp_serving_segment``) and exits
 nonzero when any budget regresses — wired into tier-1 so hazards fail
 the suite, not the next profiling round.
 

@@ -103,6 +103,22 @@ BUDGETS: Dict[str, Budget] = {
         undonated_bytes_max=_MiB // 2,  # measured 0 (pool+table donated)
         notes="r11 contract: paged pool + page tables, one fetch/segment, "
               "prefix reuse is refcount data not program shape"),
+    # The TENSOR-PARALLEL segment (r12): the serving_segment contract,
+    # GSPMD-sharded — same one fetch per segment and zero warm compiles,
+    # PLUS every collective must attribute to the 'mp' axis (enforced
+    # via require_collectives_clean + the handle's allowed_axes). Byte
+    # ceiling covers both lowering regimes the CPU lane produces:
+    # measured 500,356 B at mp=2 (per-shard while-body carries halve)
+    # and ~999,988 B at mp=1 (== serving_segment) + ~5%.
+    "tp_serving_segment": Budget(
+        flagged_syncs=0,
+        allowed_syncs_per_replay={"serving.segment_event_fetch": 1},
+        warm_compiles=0,
+        relayout_bytes_max=1_050_000,
+        pack_bytes_max=_MiB // 2,      # measured 0 at both degrees
+        undonated_bytes_max=_MiB // 2,  # measured 0 (sharded cache donates)
+        notes="r12 contract: mp-sharded segment — one fetch/segment, "
+              "all collectives ride the declared 'mp' axis"),
     # The donated multi-tensor update: the r8 ledger program. The pack
     # bytes ARE the stack/flat packing traffic the Pallas kernel
     # eliminates on chip; the CPU lowering keeps the XLA packing, so

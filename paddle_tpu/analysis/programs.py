@@ -1,4 +1,4 @@
-"""The four registered canonical programs the gate audits.
+"""The registered canonical programs the gate audits.
 
 Each is a miniaturised-but-structurally-faithful instance of a hot path
 whose hazard ledger earlier rounds paid for by hand:
@@ -15,6 +15,11 @@ whose hazard ledger earlier rounds paid for by hand:
 * ``fused_optimizer_update`` — ``Optimizer.step``'s donated jit update
   over a mixed-shape population (the r8 relayout-ledger territory: the
   stack/concat pack bytes are THE metric).
+* ``paged_serving_segment``  — the r11 page-table segment (zero pack
+  bytes: prefix reuse is refcount data, not row copies).
+* ``tp_serving_segment``     — the r12 mp-sharded segment (collectives
+  must attribute to the 'mp' axis; the one-fetch contract survives
+  GSPMD).
 
 Builders are deterministic (fixed seeds, fixed shapes) so the measured
 metrics are stable run to run and ``budgets.py`` can pin them as exact
@@ -264,6 +269,82 @@ def _build_paged_serving_segment() -> ProgramHandle:
         expected_undonated=(),
         notes="paged re-entrant segment (page-table pool, COW-ready) + "
               "host event replay with page bookkeeping, llama-tiny",
+        keepalive=(eng,))
+
+
+@register("tp_serving_segment")
+def _build_tp_serving_segment() -> ProgramHandle:
+    """The r12 tensor-parallel serving segment: the re-entrant fused
+    segment with weights GSPMD-sharded Megatron-style and the KV cache
+    sharded on the head dim over an 'mp' mesh. The contract the budget
+    pins: the ONE-dispatch/one-fetch shape survives sharding (same
+    single allowed event fetch, zero warm compiles) and every collective
+    in the program attributes to the 'mp' axis — an unattributed or
+    off-axis collective is a GSPMD repartition hazard, exactly the class
+    ``collective_check`` was promoted to catch. Builds mp=2 when two
+    devices exist (tier-1's virtual-CPU platform, the MULTICHIP dryrun
+    pattern), mp=1 on a single chip — the sync/compile budgets bind
+    either way, the collective attribution bites at mp=2."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as j
+
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import llama
+    from paddle_tpu.parallel.mesh import create_hybrid_mesh
+
+    devs = jax.devices()
+    mp = 2 if len(devs) >= 2 else 1
+    mesh = create_hybrid_mesh(mp=mp, devices=devs[:mp],
+                              set_as_global=False)
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg)
+    eng = ServingEngine(cfg, params, slots=4, max_len=64, chunk=8,
+                        prompt_buckets=(16,), mesh=mesh)
+    rng = np.random.RandomState(0)
+
+    def replay():
+        # end-to-end mp-sharded segment: two requests, ONE fused
+        # dispatch over the mesh, the single allowed event fetch, host
+        # replay — every request finishes inside the segment so slot
+        # state drains (the engine scopes the mesh itself)
+        for _ in range(2):
+            eng.add_request(rng.randint(0, cfg.vocab_size, (12,)), 4)
+        return eng.run_segment(12)
+
+    def hlo():
+        from jax.sharding import NamedSharding
+
+        n_pad = eng._pow2(eng.slots)
+        s_max = eng.buckets[-1]
+        seg = eng._progs[("seg", n_pad, s_max, 0, 12)]
+        L, Hkv, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+        cache = jax.device_put(
+            llama.init_kv_cache(cfg, eng.slots, eng.max_len),
+            NamedSharding(mesh, llama.kv_cache_spec()))
+        return seg.lower(
+            eng.params, cache, eng._pos, eng._nxt, eng._rem,
+            j.zeros((n_pad, s_max), j.int32), j.ones((n_pad,), j.int32),
+            j.zeros((n_pad,), j.int32),
+            j.zeros((n_pad, L, 0, Hkv, D), cache["k"].dtype),
+            j.zeros((n_pad, L, 0, Hkv, D), cache["v"].dtype),
+            j.zeros((n_pad,), j.int32), j.int32(2)).compile().as_text()
+
+    def hlo_warm():
+        replay()              # materialise the ("seg", ...) program
+        return hlo()
+
+    return ProgramHandle(
+        name="tp_serving_segment",
+        hlo=_memo(hlo_warm),
+        replay=replay,
+        mesh=mesh,
+        donation_threshold=1 << 16,
+        expected_undonated=(),
+        allowed_axes=("mp",),
+        notes=f"mp={mp} GSPMD-sharded re-entrant segment (column/row-"
+              f"parallel weights, head-sharded KV cache), llama-tiny",
         keepalive=(eng,))
 
 

@@ -32,13 +32,15 @@ import numpy as np
 __all__ = ["Config", "Predictor", "InferTensor", "create_predictor",
            "PrecisionType", "PlaceType"]
 
-# Online serving subsystem (r7/r11): imported lazily by consumers —
+# Online serving subsystem (r7/r11/r12): imported lazily by consumers —
 # ``from paddle_tpu.inference.serving import ServingEngine``,
 # ``from paddle_tpu.inference.scheduler import OnlineScheduler``,
 # ``from paddle_tpu.inference.prefix_cache import PrefixCache /
-# PagedPrefixCache``, ``from paddle_tpu.inference.paged_kv import
-# PagedKVCache`` — kept out of this namespace so importing the
-# Predictor surface doesn't pull jax model code.
+# PagedPrefixCache / make_prefix_cache``, ``from
+# paddle_tpu.inference.paged_kv import PagedKVCache``, ``from
+# paddle_tpu.inference.fleet import FleetRouter / build_fleet`` — kept
+# out of this namespace so importing the Predictor surface doesn't pull
+# jax model code.
 
 
 class PrecisionType:

@@ -1,0 +1,458 @@
+"""Fleet router (r12 tentpole): N ``ServingEngine`` replicas behind one
+``serve(trace)`` entry — the data-parallel axis of multi-chip serving.
+
+One engine saturates one chip; the "millions of users" axis is engines ×
+chips (ROADMAP item 2). This module owns the layer in front of a fleet
+of replicas — each an independent ``ServingEngine`` (optionally itself
+mp-sharded over a tensor-parallel mesh, optionally pinned to its own
+device) with its OWN prefix cache and its OWN telemetry registry:
+
+* **Prefix-affinity dispatch.** The router hashes each request's
+  block-aligned prompt prefix (the same alignment rule the prefix
+  caches match on) to a preferred replica, so requests sharing a prefix
+  land on the replica whose ``PrefixCache``/``PagedPrefixCache``
+  already holds it — a per-replica cache is only as good as the
+  router's ability to route repeat prefixes back to it. Requests too
+  short to carry a cacheable prefix skip affinity entirely.
+* **Least-loaded fallback + pages-free-aware admission.** When the
+  preferred replica's bounded queue is full (or there is no affinity
+  key), the request goes to the least-loaded replica (queued + live
+  requests, ties to the lowest index — deterministic); paged replicas
+  whose pool can hold the request right now are preferred over ones
+  that would defer it on page pressure.
+* **Fleet-level backpressure accounting.** Each replica's intake queue
+  is bounded; when NO replica can take a due arrival it stays
+  client-side and the refusal is billed to the replica that would have
+  received it — the fleet counter is definitionally the sum of the
+  replica counters (``backpressure_events == sum(replica...)``,
+  enforced in tests).
+* **Overlapped segment execution.** Each serve-loop turn DISPATCHES one
+  fused segment per busy replica (jax async dispatch — no host block),
+  then FINISHES them in order: replica i+1's device work overlaps
+  replica i's event-fetch wait. The audited sync contract is unchanged
+  — every segment still costs exactly one ``allowed_sync`` event fetch
+  (``ServingEngine.dispatch_segment``/``finish_segment``).
+* **Rank-tagged telemetry.** Replica i's segment work records into its
+  own ``metrics.Registry`` (``scoped_registry``), exactly as if it were
+  launcher rank i; ``merged_telemetry()`` writes one
+  ``telemetry_rank<i>.json`` per replica and reduces them with the
+  EXISTING ``merge_log_dir`` machinery — one fleet report, counters
+  summed, gauges kept per-rank. Fleet-level routing metrics
+  (``fleet.dispatches.{affinity,least_loaded}``,
+  ``fleet.backpressure_events``, ``fleet.replica_queue_depth``) land in
+  the process registry / the replica registries respectively, and every
+  dispatch decision leaves a ``fleet_dispatch`` flight event.
+
+Determinism: routing depends only on the affinity hash (crc32 — stable
+across processes, unlike ``hash()``) and replica queue/live counts,
+which evolve deterministically with the event stream. A burst trace
+(every arrival due at t=0) therefore yields an identical per-replica
+assignment and identical tokens run-to-run (tested); under real clocked
+arrivals the assignment may shift with timing, but greedy decode makes
+per-request TOKENS independent of placement either way.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..observability import flight as _flight
+from ..observability import metrics as _metrics
+from ..observability.metrics import percentile as _pctl
+from .prefix_cache import make_prefix_cache
+from .scheduler import Arrival
+from .serving import ServingEngine
+
+__all__ = ["FleetRouter", "FleetReport", "build_fleet"]
+
+
+@dataclass
+class FleetReport:
+    """Measured outcome of one fleet serve() (all times in seconds)."""
+    replicas: int
+    n_requests: int
+    total_tokens: int
+    makespan_s: float
+    throughput_tok_s: float
+    ttft_p50_s: float
+    ttft_p99_s: float
+    e2e_p50_s: float
+    e2e_p99_s: float
+    queue_wait_p50_s: float
+    segments: int
+    ticks: int
+    backpressure_events: int       # == sum of per-replica counters
+    dispatches_affinity: int
+    dispatches_least_loaded: int
+    per_replica: List[dict] = field(default_factory=list)
+    telemetry: Optional[dict] = None   # merge_log_dir reduction
+
+    def as_dict(self, with_replicas: bool = True) -> dict:
+        d = {k: v for k, v in self.__dict__.items()
+             if k not in ("per_replica", "telemetry")}
+        if with_replicas:
+            d["per_replica"] = self.per_replica
+        return d
+
+
+class _Replica:
+    """One engine + its isolated prefix cache, registry and counters."""
+
+    def __init__(self, idx: int, engine: ServingEngine, prefix_cache):
+        self.idx = idx
+        self.engine = engine
+        self.prefix_cache = prefix_cache
+        self.registry = _metrics.Registry()
+        self.backpressure_events = 0
+        self.dispatches = {"affinity": 0, "least_loaded": 0}
+        self.segments = 0
+        self.rids: List[int] = []          # fleet rids, assignment order
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.engine._queue)
+
+    @property
+    def live(self) -> int:
+        return self.engine.slots - self.engine.free_slot_count()
+
+    @property
+    def load(self) -> int:
+        return self.queue_depth + self.live
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.engine._queue) or self.live > 0
+
+
+def build_fleet(cfg, params, n: int, devices: Optional[Sequence] = None,
+                **engine_kw) -> List[ServingEngine]:
+    """N identical engine replicas. With an explicit ``devices`` list,
+    replica i's weights are committed to device ``i % ndev`` —
+    computation follows the committed params, so replicas execute on
+    distinct chips and their segments overlap through async dispatch
+    (the data-parallel placement; a replica that should itself span
+    chips takes ``mesh=`` instead). Default (``devices=None``) keeps
+    the weights UNCOMMITTED on the default device: on a single-device
+    host per-replica commitment buys nothing and measurably costs —
+    committed args push every segment call off jax's jit fast path
+    (~2.4x slower dispatch on this container's CPU lowering) — so
+    placement is strictly opt-in."""
+    import jax
+
+    engines = []
+    for i in range(n):
+        p = params
+        if devices:
+            p = jax.device_put(params, devices[i % len(devices)])
+        engines.append(ServingEngine(cfg, p, **engine_kw))
+    return engines
+
+
+class FleetRouter:
+    """Prefix-affinity + least-loaded router over N engine replicas.
+
+    ``engines`` may be heterogeneous in placement (per-device replicas,
+    mp-sharded replicas) but must share the serving contract (same
+    model/config). ``prefix_caches``: None (no caching), "auto" (one
+    independent cache per replica via ``make_prefix_cache`` — the fleet
+    isolation contract: a cache is keyed to ITS engine, never shared),
+    or an explicit list. ``max_queue`` bounds each replica's intake
+    queue; ``seg_steps`` is per-segment tick budget (the same control-
+    latency knob as ``OnlineScheduler``)."""
+
+    def __init__(self, engines: Sequence[ServingEngine],
+                 max_queue: int = 64, seg_steps: int = 32,
+                 prefix_caches=None, affinity_block: Optional[int] = None):
+        if not engines:
+            raise ValueError("a fleet needs at least one engine")
+        if prefix_caches == "auto":
+            prefix_caches = [make_prefix_cache(e) for e in engines]
+        elif prefix_caches is None:
+            prefix_caches = [None] * len(engines)
+        if len(prefix_caches) != len(engines):
+            raise ValueError(f"{len(prefix_caches)} prefix caches for "
+                             f"{len(engines)} engines")
+        for e, pc in zip(engines, prefix_caches):
+            if pc is not None and e.paged and getattr(pc, "pager",
+                                                      None) is not e.pager:
+                raise ValueError(
+                    "paged replica's prefix cache must wrap ITS OWN "
+                    "pager (fleet isolation: one cache per engine)")
+        blocks = {pc.block for pc in prefix_caches if pc is not None}
+        if len(blocks) > 1:
+            raise ValueError(f"replica caches disagree on block size "
+                             f"{sorted(blocks)} — affinity hashing needs "
+                             f"one alignment rule")
+        self._replicas = [_Replica(i, e, pc)
+                          for i, (e, pc) in enumerate(zip(engines,
+                                                          prefix_caches))]
+        self.max_queue = int(max_queue)
+        self.seg_steps = int(seg_steps)
+        self.affinity_block = int(affinity_block
+                                  or (next(iter(blocks)) if blocks else 32))
+        # affinity exists to route repeat prefixes back to the replica
+        # whose CACHE holds them; without caches a prompt-hash pin is
+        # pure load imbalance, so the router degrades to least-loaded
+        self._use_affinity = any(pc is not None for pc in prefix_caches)
+        self.backpressure_events = 0
+        self._reqs: Dict[int, tuple] = {}   # fleet rid -> (replica, Request)
+        self._next_rid = 0
+
+    # --- routing ---------------------------------------------------------
+    def _affinity_key(self, prompt: np.ndarray) -> Optional[bytes]:
+        """Block-aligned STRICT prefix bytes (the prefix caches' rule:
+        at least one token must remain to prefill), or None when the
+        prompt is too short to carry a cacheable prefix."""
+        b = self.affinity_block
+        cap = (len(prompt) // b) * b
+        if cap == len(prompt):
+            cap -= b
+        if cap <= 0:
+            return None
+        return np.asarray(prompt[:cap], np.int32).tobytes()
+
+    def _page_ready(self, r: _Replica, a: Arrival) -> bool:
+        eng = r.engine
+        if not eng.paged:
+            return True
+        need = eng.pager.pages_needed(len(a.prompt) + a.max_new_tokens - 1)
+        return eng.pager.pages_free >= need
+
+    def _route(self, a: Arrival):
+        """(replica, reason) for a due arrival, or (bill_target, None)
+        when every queue is full (fleet backpressure)."""
+        key = (self._affinity_key(a.prompt)
+               if self._use_affinity else None)
+        pref = (self._replicas[zlib.crc32(key) % len(self._replicas)]
+                if key is not None else None)
+        if pref is not None and pref.queue_depth < self.max_queue:
+            return pref, "affinity"
+        cands = [r for r in self._replicas
+                 if r.queue_depth < self.max_queue]
+        if not cands:
+            # all queues full: bill the replica the request WOULD have
+            # gone to, so fleet backpressure == sum(replica counters)
+            bill = pref if pref is not None else \
+                min(self._replicas, key=lambda r: (r.load, r.idx))
+            return bill, None
+        best = min(cands, key=lambda r: (not self._page_ready(r, a),
+                                         r.load, r.idx))
+        return best, "least_loaded"
+
+    # --- intake ----------------------------------------------------------
+    def _ingest(self, pending: List[Arrival], now: float, t0: float) -> int:
+        refused = 0
+        while pending and pending[0].t <= now:
+            a = pending[0]
+            rep, reason = self._route(a)
+            if reason is None:
+                refused += 1
+                rep.backpressure_events += 1
+                self.backpressure_events += 1
+                with _metrics.scoped_registry(rep.registry):
+                    _metrics.counter("serving.backpressure_events").inc()
+                _metrics.counter("fleet.backpressure_events").inc()
+                _flight.record("backpressure", replica=rep.idx,
+                               queue=rep.queue_depth, fleet=True)
+                break                       # arrival stays client-side
+            pending.pop(0)
+            rid = self._next_rid
+            self._next_rid += 1
+            erid = rep.engine.add_request(a.prompt, a.max_new_tokens)
+            req = rep.engine._queue[-1]
+            assert req.rid == erid
+            req.arrival_time = t0 + a.t
+            self._reqs[rid] = (rep.idx, req)
+            rep.rids.append(rid)
+            rep.dispatches[reason] += 1
+            _metrics.counter(f"fleet.dispatches.{reason}").inc()
+            with _metrics.scoped_registry(rep.registry):
+                _metrics.gauge("fleet.replica_queue_depth").set(
+                    rep.queue_depth)
+            _flight.record("fleet_dispatch", rid=rid, replica=rep.idx,
+                           reason=reason, queue=rep.queue_depth)
+        return refused
+
+    # --- the serve loop --------------------------------------------------
+    def serve(self, arrivals: Sequence[Arrival], warm: bool = False
+              ) -> FleetReport:
+        """Serve the trace to completion across the fleet and return the
+        measured report. ``warm=True`` replays the identical trace once
+        first (compiles every replica's segment shapes), then resets all
+        fleet state so the measured pass times routing + scheduling."""
+        if warm:
+            self.serve(arrivals, warm=False)
+            self.reset()
+
+        pending = sorted(arrivals, key=lambda a: a.t)
+        reps = self._replicas
+        for r in reps:
+            r.engine.last_run_ticks = 0
+            r.engine.last_run_chunks = 0
+        segments = 0
+        # STAGGERED pipeline, not barrier turns: every busy replica
+        # keeps one async segment in flight (jax dispatch never blocks
+        # the host), and each loop iteration finishes exactly the
+        # OLDEST one, re-ingests arrivals, and tops the fleet back up.
+        # Arrivals therefore enter a queue and get dispatched at the
+        # next ANY-replica finish (~1/N of a full fleet sweep) instead
+        # of waiting out a whole synchronized turn — the TTFT lever when
+        # replicas contend for one host/core; on real parallel devices
+        # it additionally keeps every chip busy continuously.
+        inflight: List[tuple] = []          # (replica, handle), FIFO
+        t0 = time.perf_counter()
+        while pending or inflight or any(r.busy for r in reps):
+            now = time.perf_counter() - t0
+            self._ingest(pending, now, t0)
+            busy_idle = [r for r in reps
+                         if r.busy and r.engine._pending_seg is None]
+            for r in busy_idle:
+                with _metrics.scoped_registry(r.registry):
+                    h = r.engine.dispatch_segment(
+                        self.seg_steps, prefix_cache=r.prefix_cache)
+                inflight.append((r, h))
+            if not inflight:
+                if pending:
+                    gap = pending[0].t - (time.perf_counter() - t0)
+                    if gap > 0:
+                        time.sleep(min(gap, 0.05))
+                continue
+            # finish the oldest in-flight segment (its event fetch is
+            # the one audited allowed_sync for that segment)
+            r, h = inflight.pop(0)
+            with _metrics.scoped_registry(r.registry):
+                ev = r.engine.finish_segment(h)
+                t_sync = time.perf_counter()
+                self._stamp(r, ev, t_sync)
+            r.segments += 1
+            segments += 1
+        makespan = time.perf_counter() - t0
+
+        reqs = [req for _, req in self._reqs.values()]
+        assert all(
+            req.done or (reps[i].engine.eos is not None
+                         and reps[i].engine.eos in req.tokens)
+            for i, req in self._reqs.values()), \
+            "fleet exited with unserved requests"
+        total_tokens = sum(len(r.tokens) for r in reqs)
+        ttfts = [r.first_token_time - r.arrival_time for r in reqs]
+        e2es = [r.finish_time - r.arrival_time for r in reqs]
+        qwaits = [r.admit_time - r.arrival_time for r in reqs]
+        assert self.backpressure_events == sum(r.backpressure_events
+                                               for r in reps)
+        return FleetReport(
+            replicas=len(reps),
+            n_requests=len(reqs),
+            total_tokens=total_tokens,
+            makespan_s=makespan,
+            throughput_tok_s=total_tokens / makespan if makespan else 0.0,
+            ttft_p50_s=_pctl(ttfts, 0.50),
+            ttft_p99_s=_pctl(ttfts, 0.99),
+            e2e_p50_s=_pctl(e2es, 0.50),
+            e2e_p99_s=_pctl(e2es, 0.99),
+            queue_wait_p50_s=_pctl(qwaits, 0.50),
+            segments=segments,
+            ticks=sum(r.engine.last_run_ticks for r in reps),
+            backpressure_events=self.backpressure_events,
+            dispatches_affinity=sum(r.dispatches["affinity"]
+                                    for r in reps),
+            dispatches_least_loaded=sum(r.dispatches["least_loaded"]
+                                        for r in reps),
+            per_replica=[{
+                "replica": r.idx,
+                "requests": len(r.rids),
+                "tokens": sum(len(self._reqs[rid][1].tokens)
+                              for rid in r.rids),
+                "segments": r.segments,
+                "ticks": r.engine.last_run_ticks,
+                "backpressure_events": r.backpressure_events,
+                "dispatches": dict(r.dispatches),
+                "prefix": (r.prefix_cache.stats()
+                           if r.prefix_cache is not None else None),
+                "pages": (r.engine.pager.stats()
+                          if r.engine.paged else None),
+            } for r in reps],
+        )
+
+    def _stamp(self, r: _Replica, ev: dict, t_sync: float) -> None:
+        """Per-request lifecycle stamping at the sync that surfaced each
+        event — identical rules to ``OnlineScheduler.serve``, recorded
+        into the REPLICA's registry (the scoped context is active)."""
+        by_erid = {self._reqs[rid][1].rid: self._reqs[rid][1]
+                   for rid in r.rids}
+        m_ttft = _metrics.histogram("serving.ttft_s")
+        m_e2e = _metrics.histogram("serving.e2e_s")
+        m_qw = _metrics.histogram("serving.queue_wait_s")
+        for erid in ev["first_tokens"]:
+            req = by_erid[erid]
+            req.first_token_time = t_sync
+            m_ttft.observe(t_sync - req.arrival_time)
+            m_qw.observe(req.admit_time - req.arrival_time)
+        for erid in ev["finished"]:
+            req = by_erid[erid]
+            req.finish_time = t_sync
+            m_e2e.observe(t_sync - req.arrival_time)
+        _metrics.gauge("fleet.replica_queue_depth").set(r.queue_depth)
+
+    # --- results / lifecycle ---------------------------------------------
+    def results(self) -> Dict[int, List[int]]:
+        """Fleet rid -> generated tokens (truncated at max_new_tokens /
+        first EOS, like ``ServingEngine.run``)."""
+        for r in self._replicas:
+            r.engine.collect_finished()
+        return {rid: req.tokens for rid, (_, req) in self._reqs.items()}
+
+    def assignment(self) -> List[List[int]]:
+        """Per-replica fleet rids in assignment order (the determinism
+        contract's observable)."""
+        return [list(r.rids) for r in self._replicas]
+
+    def reset(self) -> None:
+        """Warm-run isolation: reset every replica's slots, cache and
+        registry, and zero fleet counters (the fleet analog of
+        ``OnlineScheduler``'s warm handling)."""
+        for r in self._replicas:
+            r.engine.reset_slots()
+            if r.prefix_cache is not None:
+                r.prefix_cache.reset()
+            r.registry.reset()
+            r.backpressure_events = 0
+            r.dispatches = {"affinity": 0, "least_loaded": 0}
+            r.segments = 0
+            r.rids = []
+        self.backpressure_events = 0
+        self._reqs.clear()
+        self._next_rid = 0
+
+    def leak_report(self) -> List[str]:
+        """Aggregated page-leak audit across replicas: with no live
+        requests, every paged replica's pool must be fully returned
+        modulo its OWN cache's held pages (the fleet-isolation audit —
+        a cache can only pin pages of the pager it wraps)."""
+        bad: List[str] = []
+        for r in self._replicas:
+            if not r.engine.paged:
+                continue
+            held = (r.prefix_cache.pages_held
+                    if r.prefix_cache is not None
+                    and hasattr(r.prefix_cache, "pages_held") else 0)
+            for msg in r.engine.pager.leak_report(expected_held=held):
+                bad.append(f"replica {r.idx}: {msg}")
+        return bad
+
+    def merged_telemetry(self, log_dir: str) -> dict:
+        """Write one rank-tagged snapshot per replica into ``log_dir``
+        and reduce them with the existing multi-process machinery
+        (``metrics.merge_log_dir``) — the fleet report an operator
+        scrapes: counters summed across replicas, gauges kept per-rank
+        with min/max/sum."""
+        for r in self._replicas:
+            _metrics.write_snapshot(log_dir, rank=r.idx,
+                                    registry=r.registry)
+        return _metrics.merge_log_dir(log_dir)

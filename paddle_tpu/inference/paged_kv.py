@@ -160,7 +160,7 @@ class PagedKVCache:
     worst case)."""
 
     def __init__(self, cfg, slots: int, page_size: int, num_pages: int,
-                 max_pages: int, dtype=None):
+                 max_pages: int, dtype=None, mesh=None):
         from ..models import llama
 
         self.cfg = cfg
@@ -169,10 +169,25 @@ class PagedKVCache:
         self.num_pages = int(num_pages)
         self.max_pages = int(max_pages)
         self.allocator = PageAllocator(self.num_pages)
+        self.mesh = mesh
         self.pool = llama.init_paged_pool(cfg, self.num_pages,
                                           self.page_size, dtype=dtype)
         self.page_table = jnp.zeros((self.slots, self.max_pages),
                                     jnp.int32)
+        if mesh is not None:
+            # tensor-parallel serving (r12): the pool shards on the
+            # kv-head dim over 'mp' (llama.paged_pool_spec — the dim the
+            # column-parallel wk/wv projections produce sharded); page
+            # TABLES stay replicated int32 indices, so every page-id
+            # operation in this class (reserve/install/fork/COW) is
+            # untouched — paging is mesh-oblivious by construction
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.pool = jax.device_put(
+                self.pool, NamedSharding(mesh, llama.paged_pool_spec()))
+            self.page_table = jax.device_put(
+                self.page_table, NamedSharding(mesh, P()))
         self.slot_pages: List[List[int]] = [[] for _ in range(self.slots)]
         self.cow_breaks = 0
         self.peak_occupancy = 0.0
@@ -291,8 +306,13 @@ class PagedKVCache:
             if self.slot_pages[s]:
                 self.allocator.release(self.slot_pages[s])
                 self.slot_pages[s] = []
-        self.page_table = jnp.zeros((self.slots, self.max_pages),
-                                    jnp.int32)
+        table = jnp.zeros((self.slots, self.max_pages), jnp.int32)
+        if self.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            table = jax.device_put(table, NamedSharding(self.mesh, P()))
+        self.page_table = table
         self.peak_occupancy = 0.0   # warm-run isolation, like reset_slots
         self.allocator.total_allocated = 0
         self._gauges()

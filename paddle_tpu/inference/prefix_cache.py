@@ -44,7 +44,30 @@ from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 
 __all__ = ["PrefixCache", "PrefixMatch", "PagedPrefixCache",
-           "PagedPrefixMatch"]
+           "PagedPrefixMatch", "make_prefix_cache"]
+
+
+def make_prefix_cache(engine, block: int = 32,
+                      capacity_tokens: int = 16384):
+    """The ONE prefix cache for ONE engine (r12 fleet isolation): a
+    paged engine gets a ``PagedPrefixCache`` wrapping ITS pager (page
+    refs must bump the allocator the slots actually draw from — sharing
+    a cache across engines would retain pages of the wrong pool), a
+    contiguous engine gets a ``PrefixCache`` at the engine-independent
+    block. The fleet router builds one per replica through here
+    (``prefix_caches="auto"``); nothing in this module is process-global
+    state, so N engines in one process never alias lookup state.
+
+    **Why:** the caches assume their entries' device rows / page ids
+    belong to the engine that harvested them; keyed-off-the-engine
+    construction makes that assumption structural instead of
+    conventional."""
+    if getattr(engine, "paged", False):
+        return PagedPrefixCache(engine.pager,
+                                capacity_pages=max(
+                                    1, capacity_tokens
+                                    // engine.pager.page_size))
+    return PrefixCache(block=block, capacity_tokens=capacity_tokens)
 
 
 @dataclass

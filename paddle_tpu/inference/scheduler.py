@@ -66,7 +66,7 @@ from .prefix_cache import PrefixCache
 from .serving import Request, ServingEngine
 
 __all__ = ["Arrival", "OnlineScheduler", "poisson_arrivals",
-           "staggered_arrivals"]
+           "staggered_arrivals", "scale_rate"]
 
 
 @dataclass
@@ -114,6 +114,19 @@ def staggered_arrivals(seed: int, n: int, gap: float, vocab: int,
             body = np.concatenate([np.asarray(prefix, np.int32), body])
         out.append(Arrival(i * gap, body, int(rng.choice(gen_lens))))
     return out
+
+
+def scale_rate(arrivals: Sequence[Arrival], factor: float) -> List[Arrival]:
+    """THE SAME trace at ``factor``x the arrival rate: identical
+    prompts, generation lengths and arrival ORDER, every inter-arrival
+    gap divided by ``factor``. The fleet benchmark's load axis (r12) —
+    comparing fleet sizes on a re-drawn trace would confound routing
+    with sampling noise; compressing the clock of one seeded trace
+    isolates the capacity question."""
+    if factor <= 0:
+        raise ValueError(f"factor must be > 0, got {factor}")
+    return [Arrival(a.t / factor, a.prompt, a.max_new_tokens)
+            for a in arrivals]
 
 
 @dataclass

@@ -42,6 +42,7 @@ so engine output is bit-comparable to the dense path request-by-request.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import time
 from dataclasses import dataclass, field
@@ -58,7 +59,46 @@ from ..observability import metrics as _metrics
 
 __all__ = ["Request", "ServingEngine"]
 
+
+@contextlib.contextmanager
+def _mesh_scope(mesh):
+    """Make ``mesh`` the global mesh for the duration of a program
+    build/call (r12 tensor-parallel serving): the model's sharding
+    constraints (``with_sharding_constraint``) read the global mesh at
+    TRACE time, so an mp-sharded engine must trace its segment programs
+    under its own mesh without leaking it into unrelated callers (tests
+    and sibling engines pin ``set_mesh(None)``)."""
+    if mesh is None:
+        yield
+        return
+    from ..parallel.mesh import get_mesh, set_mesh
+
+    prev = get_mesh()
+    set_mesh(mesh)
+    try:
+        yield
+    finally:
+        set_mesh(prev)
+
 _WAVE_WIDTHS = (8, 4, 2, 1)  # compiled prefill sub-batch sizes
+
+
+@dataclass
+class _PendingSegment:
+    """A dispatched-but-not-fetched segment (r12): the device futures of
+    one fused segment plus the host bookkeeping its replay needs. The
+    fleet router dispatches one of these per replica and only then
+    fetches them in turn — replica i+1's device work overlaps replica
+    i's fetch wait, with the per-segment sync contract intact (each
+    finish is still exactly one ``allowed_sync`` event fetch)."""
+    paged: bool
+    picked: List["Request"]
+    n: int
+    now: float
+    prefix_cache: object
+    dev: tuple                     # (out, aq, aslot, step, qidx) futures
+    pre_lens: object               # [n] reused-prefix rows per request
+    req_pages: Optional[List[List[int]]] = None   # paged reservations
 
 
 @dataclass
@@ -84,16 +124,46 @@ class Request:
         return len(self.tokens) >= self.max_new_tokens
 
 
+# Process-wide compiled-program cache (r12): every program an engine
+# builds (admit / decode / drain / segment / paged segment) closes over
+# NOTHING but config scalars (cfg, slots, max_len, eos, chunk, mesh) —
+# params and caches are arguments — so engines with identical geometry
+# can share one jitted callable. A fleet of N identical replicas then
+# compiles each segment shape ONCE per process instead of N times
+# (compile cost is per binary, not per replica — the ROADMAP item 5
+# direction), and the test suite's many tiny engines stop re-compiling
+# the same programs per test. Keys hold no arrays; the cache pins only
+# XLA executables.
+_SHARED_PROGS: Dict[tuple, object] = {}
+
+
 class ServingEngine:
     def __init__(self, cfg: llama.LlamaConfig, params, slots: int = 8,
                  max_len: Optional[int] = None, chunk: int = 32,
                  prompt_buckets: Sequence[int] = (32, 64, 128, 256),
                  eos_token_id: Optional[int] = None,
                  paged: bool = False, page_size: int = 16,
-                 num_pages: Optional[int] = None):
+                 num_pages: Optional[int] = None, mesh=None):
         self.cfg = cfg
         self.params = params
         self.slots = int(slots)
+        # r12 tensor-parallel serving: an 'mp' mesh shards the weights
+        # (llama.param_specs — Megatron column/row-parallel) and the KV
+        # store on the head dim; a model bigger than one chip's HBM then
+        # serves through the SAME one-dispatch/one-fetch segment programs
+        # (GSPMD inserts one all-reduce per layer after the row-parallel
+        # projections). Serving is segment-only under a mesh (run() and
+        # warmup() route accordingly); slot bookkeeping stays host-side
+        # and mesh-oblivious.
+        self.mesh = mesh
+        if mesh is not None:
+            mp = int(mesh.shape.get("mp", 1))
+            if mp > 1 and (cfg.num_kv_heads % mp or cfg.num_heads % mp):
+                raise ValueError(
+                    f"num_heads {cfg.num_heads} / num_kv_heads "
+                    f"{cfg.num_kv_heads} must divide the mp degree {mp} "
+                    f"(the KV cache shards on the head dim)")
+            self.params = llama.shard_state(cfg, mesh, params)
         self.max_len = int(max_len or cfg.max_seq_len)
         self.chunk = int(chunk)
         self.buckets = tuple(sorted(int(b) for b in prompt_buckets
@@ -129,17 +199,36 @@ class ServingEngine:
             self.pager = PagedKVCache(
                 cfg, self.slots, self.page_size,
                 num_pages=int(num_pages or self.slots * max_pages + 1),
-                max_pages=max_pages)
+                max_pages=max_pages, mesh=mesh)
             self._cache = None  # no contiguous block exists in paged mode
         else:
             self.pager = None
             self._cache = llama.init_kv_cache(cfg, self.slots, self.max_len)
-        self._pos = jnp.zeros((self.slots,), jnp.int32)
-        self._nxt = jnp.zeros((self.slots,), jnp.int32)
-        self._rem = jnp.zeros((self.slots,), jnp.int32)
+            if mesh is not None:
+                from jax.sharding import NamedSharding
+
+                self._cache = jax.device_put(
+                    self._cache,
+                    NamedSharding(mesh, llama.kv_cache_spec()))
+        self._pos = self._slot_vec()
+        self._nxt = self._slot_vec()
+        self._rem = self._slot_vec()
+        self._pending_seg = None  # at most ONE in-flight dispatched segment
         from ..jit import register_compiled_cache
 
         register_compiled_cache(self)  # analysis.recompile introspection
+
+    def _slot_vec(self):
+        """A zeroed [slots] int32 slot-state vector, replicated over the
+        engine's mesh when one is set (slot state is tiny and every
+        device needs all of it)."""
+        v = jnp.zeros((self.slots,), jnp.int32)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            v = jax.device_put(v, NamedSharding(self.mesh, P()))
+        return v
 
     def cache_info(self) -> dict:
         """Compiled-program cache keys (analysis.recompile lint): admit
@@ -202,14 +291,39 @@ class ServingEngine:
         self._finished.append(r)
 
     # --- compiled programs ------------------------------------------------
+    def _shared_key(self, key: tuple) -> tuple:
+        """Process-wide program-cache key: the engine geometry every
+        program closure reads, plus the per-shape key. Engines agreeing
+        on all of it trace byte-identical programs."""
+        return (self.cfg, self.slots, self.max_len, self.eos, self.chunk,
+                self.paged, self.pager.max_pages if self.paged else None,
+                self.mesh, key)
+
+    def _memo_prog(self, key: tuple, build):
+        """Two-level memo: per-engine ``_progs`` (the recompile lint's
+        introspection surface — ``cache_info`` keys stay per engine) in
+        front of the process-wide ``_SHARED_PROGS`` store."""
+        cached = self._progs.get(key)
+        if cached is not None:
+            return cached
+        gkey = self._shared_key(key)
+        fn = _SHARED_PROGS.get(gkey)
+        if fn is None:
+            fn = build()
+            _SHARED_PROGS[gkey] = fn
+        self._progs[key] = fn
+        return fn
+
     def _admit_prog(self, bucket: int, nb: int):
         """Fused prefill + slot insert: ONE program call per admission
         sub-wave (dispatch latency is the dominant admission cost).
-        Memoised per instance (a class-level lru_cache would pin the
-        engine — params and KV cache included — forever)."""
-        cached = self._progs.get((bucket, nb))
-        if cached is not None:
-            return cached
+        Memoised per geometry in the process-wide program cache (the
+        closure captures config scalars only — never the engine's params
+        or KV cache, which would pin them forever)."""
+        return self._memo_prog((bucket, nb),
+                               lambda: self._build_admit_prog(bucket, nb))
+
+    def _build_admit_prog(self, bucket: int, nb: int):
         cfg, max_len, eos = self.cfg, self.max_len, self.eos
 
         @functools.partial(jax.jit, donate_argnums=(1,))
@@ -235,11 +349,14 @@ class ServingEngine:
             rem = rem.at[slot_ids].set(rems_new)
             return {"k": k, "v": v}, pos, nxt, rem, tok0
 
-        self._progs[(bucket, nb)] = admit
         return admit
 
-    @functools.cached_property
+    @property
     def _decode_prog(self):
+        return self._memo_prog(("decode", self.chunk),
+                               self._build_decode_prog)
+
+    def _build_decode_prog(self):
         cfg, K, eos = self.cfg, self.chunk, self.eos
 
         @functools.partial(jax.jit, donate_argnums=(1,))
@@ -339,9 +456,9 @@ class ServingEngine:
         and compiles on the first ``run()`` that sees that shape — warm
         it by running a representative workload once (the serving
         benchmark does exactly this)."""
-        if self.paged:
-            # paged engines serve through segments only; each
-            # (n_pad, s_max, steps) shape compiles on its first
+        if self.paged or self.mesh is not None:
+            # paged and mp-sharded engines serve through segments only;
+            # each (n_pad, s_max, steps) shape compiles on its first
             # run_segment and the scheduler's warm pass covers it
             return
         for b in self.buckets:
@@ -382,9 +499,10 @@ class ServingEngine:
         dispatch-latency-robust by construction. Memoised per
         (n_pad, p_max, g_max) padded workload shape."""
         key = ("drain", n_pad, p_max, g_max)
-        cached = self._progs.get(key)
-        if cached is not None:
-            return cached
+        return self._memo_prog(key, lambda: self._build_drain_prog(
+            n_pad, p_max, g_max))
+
+    def _build_drain_prog(self, n_pad: int, p_max: int, g_max: int):
         cfg, max_len, slots, eos = (self.cfg, self.max_len, self.slots,
                                     self.eos)
 
@@ -477,7 +595,6 @@ class ServingEngine:
             return (st["cache"], st["out"], st["fin"], st["step"],
                     st["ndec"])
 
-        self._progs[key] = drain
         return drain
 
     @staticmethod
@@ -563,14 +680,16 @@ class ServingEngine:
         per-token matmul work of the shared prefix are not re-done.
         Memoised per (n_pad, s_max, pre_max, max_steps) shape."""
         key = ("seg", n_pad, s_max, pre_max, max_steps)
-        cached = self._progs.get(key)
-        if cached is not None:
-            return cached
-        cfg, slots, eos = self.cfg, self.slots, self.eos
         if pre_max + s_max > self.max_len:
             raise ValueError(
                 f"segment admit window {pre_max}+{s_max} exceeds cache "
                 f"max_len {self.max_len}")
+        return self._memo_prog(key, lambda: self._build_segment_prog(
+            n_pad, s_max, pre_max, max_steps))
+
+    def _build_segment_prog(self, n_pad: int, s_max: int, pre_max: int,
+                            max_steps: int):
+        cfg, slots, eos = self.cfg, self.slots, self.eos
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def segment(params, cache, pos, nxt, rem, prompts, lens, gens,
@@ -665,7 +784,6 @@ class ServingEngine:
                     st["out"], st["aq"], st["aslot"], st["step"],
                     st["qidx"])
 
-        self._progs[key] = segment
         return segment
 
     def _replay_segment(self, picked, toks, aq, aslot, steps: int, n: int,
@@ -753,9 +871,11 @@ class ServingEngine:
         makes stale rows invisible). Used between warmup and a timed run."""
         assert all(r is None for r in self._active), \
             "reset_slots with live requests"
-        self._pos = jnp.zeros((self.slots,), jnp.int32)
-        self._nxt = jnp.zeros((self.slots,), jnp.int32)
-        self._rem = jnp.zeros((self.slots,), jnp.int32)
+        assert self._pending_seg is None, \
+            "reset_slots with a dispatched segment in flight"
+        self._pos = self._slot_vec()
+        self._nxt = self._slot_vec()
+        self._rem = self._slot_vec()
         self._rem_host = [0] * self.slots
         self._queue = []
         self._finished = []
@@ -777,13 +897,59 @@ class ServingEngine:
         Returns {"steps", "admitted", "first_tokens", "finished"} — rid
         lists the caller (the online scheduler) stamps with the sync
         wall-clock time; ``now`` defaults to time.perf_counter() and is
-        recorded as each admitted request's admit_time."""
+        recorded as each admitted request's admit_time.
+
+        r12: dispatch and fetch are separable — ``dispatch_segment``
+        launches the program and returns immediately (jax async
+        dispatch), ``finish_segment`` blocks on the event fetch and runs
+        the host replay. The fleet router uses the split to overlap N
+        replicas' device work; this method is the two back to back."""
+        return self.finish_segment(
+            self.dispatch_segment(max_steps, prefix_cache, n_pad, now))
+
+    def dispatch_segment(self, max_steps: int, prefix_cache=None,
+                         n_pad: Optional[int] = None,
+                         now: Optional[float] = None) -> _PendingSegment:
+        """Launch one fused segment WITHOUT fetching its event log: picks
+        requests, (for paged engines) reserves page lists, dispatches the
+        program, and records the device futures in a ``_PendingSegment``.
+        At most one segment may be in flight per engine — the slot-state
+        arrays the next dispatch would consume are this segment's donated
+        outputs, and the host queue/slot mirrors only advance at the
+        fetch."""
+        if self._pending_seg is not None:
+            raise RuntimeError(
+                "dispatch_segment with a segment already in flight — "
+                "finish_segment must run first (one outstanding segment "
+                "per engine)")
         if now is None:
             now = time.perf_counter()
         n_pad = n_pad or self._pow2(self.slots)
         if self.paged:
-            return self._run_segment_paged(max_steps, prefix_cache,
-                                           n_pad, now)
+            pending = self._dispatch_segment_paged(max_steps, prefix_cache,
+                                                   n_pad, now)
+        else:
+            pending = self._dispatch_segment_dense(max_steps, prefix_cache,
+                                                   n_pad, now)
+        self._pending_seg = pending
+        return pending
+
+    def finish_segment(self, pending: Optional[_PendingSegment] = None
+                       ) -> dict:
+        """Block on a dispatched segment's event fetch (THE audited
+        per-segment sync) and replay it host-side. Returns the
+        ``run_segment`` result dict."""
+        p = pending if pending is not None else self._pending_seg
+        if p is None or p is not self._pending_seg:
+            raise RuntimeError("finish_segment without a matching "
+                               "dispatched segment")
+        self._pending_seg = None
+        if p.paged:
+            return self._finish_segment_paged(p)
+        return self._finish_segment_dense(p)
+
+    def _dispatch_segment_dense(self, max_steps: int, prefix_cache,
+                                n_pad: int, now: float) -> _PendingSegment:
         # pick up to n_pad regardless of CURRENT free slots: in-program
         # admission refills slots the moment they retire mid-segment, so
         # over-picking is exactly what keeps the batch full (requests the
@@ -857,16 +1023,24 @@ class ServingEngine:
             pk = jnp.zeros((n_pad, L, 0, Hkv, D), self._cache["k"].dtype)
             pv = jnp.zeros((n_pad, L, 0, Hkv, D), self._cache["v"].dtype)
 
-        out = self._segment_prog(n_pad, s_max, pre_max, max_steps)(
-            self.params, self._cache, self._pos, self._nxt, self._rem,
-            jnp.asarray(prompts), jnp.asarray(lens), jnp.asarray(gens),
-            pk, pv, jnp.asarray(pre_lens), jnp.int32(n))
+        with _mesh_scope(self.mesh):
+            out = self._segment_prog(n_pad, s_max, pre_max, max_steps)(
+                self.params, self._cache, self._pos, self._nxt, self._rem,
+                jnp.asarray(prompts), jnp.asarray(lens), jnp.asarray(gens),
+                pk, pv, jnp.asarray(pre_lens), jnp.int32(n))
         self._cache, self._pos, self._nxt, self._rem = out[:4]
+        return _PendingSegment(paged=False, picked=picked, n=n, now=now,
+                               prefix_cache=prefix_cache, dev=out[4:],
+                               pre_lens=pre_lens)
+
+    def _finish_segment_dense(self, p: _PendingSegment) -> dict:
+        picked, n, prefix_cache, pre_lens = (p.picked, p.n, p.prefix_cache,
+                                             p.pre_lens)
         # THE per-segment sync: the one place the online serve loop is
         # allowed to block on the device (audited — see analysis.syncs;
         # the budget pins it to exactly one per segment)
         with allowed_sync("serving.segment_event_fetch"):
-            toks, aq, aslot, steps, qadm = jax.device_get(out[4:])
+            toks, aq, aslot, steps, qadm = jax.device_get(p.dev)
         steps, qadm = int(steps), int(qadm)
         self.last_run_ticks += steps
         self.last_run_chunks += 1
@@ -927,9 +1101,11 @@ class ServingEngine:
         adds zero program shapes (one fewer recompile hazard than the
         contiguous engine's ("seg", ..., pre_max, ...) family)."""
         key = ("pseg", n_pad, s_max, max_steps)
-        cached = self._progs.get(key)
-        if cached is not None:
-            return cached
+        return self._memo_prog(key, lambda: self._build_paged_segment_prog(
+            n_pad, s_max, max_steps))
+
+    def _build_paged_segment_prog(self, n_pad: int, s_max: int,
+                                  max_steps: int):
         cfg, slots, eos = self.cfg, self.slots, self.eos
         max_pages = self.pager.max_pages
 
@@ -1011,11 +1187,10 @@ class ServingEngine:
                     st["out"], st["aq"], st["aslot"], st["step"],
                     st["qidx"])
 
-        self._progs[key] = segment
         return segment
 
-    def _run_segment_paged(self, max_steps: int, prefix_cache, n_pad: int,
-                           now: float) -> dict:
+    def _dispatch_segment_paged(self, max_steps: int, prefix_cache,
+                                n_pad: int, now: float) -> _PendingSegment:
         """The paged ``run_segment``: pick FCFS gated on PAGES FREE
         (admission control is memory admission — the request's page
         span is known exactly at admission since generation length is
@@ -1111,17 +1286,27 @@ class ServingEngine:
             pre_lens[j] = pre_lens_l[j]
             req_tables[j] = tables[j]
 
-        out = self._paged_segment_prog(n_pad, s_max, max_steps)(
-            self.params, pgr.pool, pgr.page_table, self._pos, self._nxt,
-            self._rem, jnp.asarray(prompts), jnp.asarray(lens),
-            jnp.asarray(gens), jnp.asarray(pre_lens),
-            jnp.asarray(req_tables), jnp.int32(n))
+        with _mesh_scope(self.mesh):
+            out = self._paged_segment_prog(n_pad, s_max, max_steps)(
+                self.params, pgr.pool, pgr.page_table, self._pos, self._nxt,
+                self._rem, jnp.asarray(prompts), jnp.asarray(lens),
+                jnp.asarray(gens), jnp.asarray(pre_lens),
+                jnp.asarray(req_tables), jnp.int32(n))
         pgr.pool, pgr.page_table = out[0], out[1]
         self._pos, self._nxt, self._rem = out[2:5]
+        return _PendingSegment(paged=True, picked=picked, n=n, now=now,
+                               prefix_cache=prefix_cache, dev=out[5:],
+                               pre_lens=pre_lens_l, req_pages=req_pages)
+
+    def _finish_segment_paged(self, p: _PendingSegment) -> dict:
+        picked, n, prefix_cache = p.picked, p.n, p.prefix_cache
+        pre_lens_l, req_pages = p.pre_lens, p.req_pages
+        pgr = self.pager
+        psz = self.page_size
         # THE per-segment sync (same audited label + budget as the
         # contiguous engine: exactly one device contact per segment)
         with allowed_sync("serving.segment_event_fetch"):
-            toks, aq, aslot, steps, qadm = jax.device_get(out[5:])
+            toks, aq, aslot, steps, qadm = jax.device_get(p.dev)
         steps, qadm = int(steps), int(qadm)
         self.last_run_ticks += steps
         self.last_run_chunks += 1
@@ -1266,10 +1451,10 @@ class ServingEngine:
         to the next host-known refill point issue without reading
         anything back (chunks chain device-side through jax async
         dispatch) and the window ends in ONE batched fetch."""
-        if self.paged:
-            # paged engines drain through the segment path (the online
-            # product's loop): same greedy in-program admission, one
-            # dispatch + one fetch per segment
+        if self.paged or self.mesh is not None:
+            # paged and mp-sharded engines drain through the segment path
+            # (the online product's loop): same greedy in-program
+            # admission, one dispatch + one fetch per segment
             self.last_run_ticks = 0
             self.last_run_chunks = 0
             self.last_latencies = {}

@@ -773,6 +773,24 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+def kv_cache_spec() -> P:
+    """PartitionSpec of the serving KV cache [L, B, Smax, Hkv, D] under
+    tensor-parallel serving (r12): the kv-head dim follows wk/wv's
+    column-parallel output sharding over 'mp', so the decode tick's new
+    K/V rows scatter into LOCAL shards and cache attention contracts
+    per-shard — GSPMD inserts exactly one all-reduce per layer (after
+    the row-parallel wo), none for the cache itself."""
+    return P(None, None, None, "mp", None)
+
+
+def paged_pool_spec() -> P:
+    """PartitionSpec of the paged KV pool [L, pages, page, Hkv, D]:
+    same rule as ``kv_cache_spec`` — pages replicate, heads shard, so
+    the host-side page tables (pure int32 indices) stay replicated and
+    page bookkeeping is unchanged under 'mp'."""
+    return P(None, None, None, "mp", None)
+
+
 def _cache_attention(cfg: LlamaConfig, q, kc, vc, positions):
     """q [B,T,nH,D] against the UNREPEATED cache kc/vc [B,Smax,Hkv,D].
     GQA contracts via a grouped einsum (q reshaped [B,T,Hkv,rep,D]) —

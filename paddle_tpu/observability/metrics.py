@@ -31,6 +31,7 @@ per-rank map plus min/max/sum aggregates. Export is Prometheus text
 from __future__ import annotations
 
 import bisect
+import contextlib
 import json
 import math
 import os
@@ -42,7 +43,7 @@ __all__ = [
     "counter", "gauge", "histogram", "percentile", "snapshot",
     "render_prometheus", "merge_snapshots", "write_snapshot",
     "merge_log_dir", "set_enabled", "enabled", "reset",
-    "LATENCY_BUCKETS_S",
+    "scoped_registry", "LATENCY_BUCKETS_S",
 ]
 
 
@@ -311,6 +312,30 @@ def registry() -> Registry:
     return _REGISTRY
 
 
+@contextlib.contextmanager
+def scoped_registry(reg: Registry):
+    """Route module-level recording (``counter``/``gauge``/``histogram``)
+    into ``reg`` for the duration of the block.
+
+    The fleet router's replica-isolation hook (r12): N engine replicas
+    share one process, but their telemetry must stay per-replica so the
+    rank-tagged snapshot/merge machinery (``write_snapshot`` with
+    ``rank=replica``, ``merge_log_dir``) reduces them exactly like a
+    multi-process launcher run. The router wraps each replica's segment
+    dispatch/finish in its registry; record paths resolve metrics at
+    call time, so hot-path cost is unchanged (one dict lookup). NOT
+    thread-safe across concurrent scopes — the serve loop is single-
+    threaded by design (device overlap comes from async dispatch, not
+    host threads)."""
+    global _REGISTRY
+    prev = _REGISTRY
+    _REGISTRY = reg
+    try:
+        yield reg
+    finally:
+        _REGISTRY = prev
+
+
 def counter(name: str, help: str = "") -> Counter:
     return _REGISTRY.counter(name, help)
 
@@ -324,8 +349,9 @@ def histogram(name: str, help: str = "",
     return _REGISTRY.histogram(name, help, buckets=buckets)
 
 
-def snapshot(rank: Optional[int] = None) -> dict:
-    return _REGISTRY.snapshot(rank=rank)
+def snapshot(rank: Optional[int] = None,
+             registry: Optional[Registry] = None) -> dict:
+    return (registry or _REGISTRY).snapshot(rank=rank)
 
 
 def render_prometheus() -> str:
@@ -385,15 +411,19 @@ def merge_snapshots(snaps: Sequence[dict]) -> dict:
     return merged
 
 
-def write_snapshot(log_dir: str, rank: Optional[int] = None) -> str:
-    """Write this process's rank-tagged snapshot into the launcher's
-    shared log dir (``telemetry_rank<r>.json``); returns the path."""
+def write_snapshot(log_dir: str, rank: Optional[int] = None,
+                   registry: Optional[Registry] = None) -> str:
+    """Write a rank-tagged snapshot into the launcher's shared log dir
+    (``telemetry_rank<r>.json``); returns the path. ``registry`` lets a
+    single-process fleet write one file per replica registry (rank =
+    replica index) so ``merge_log_dir`` reduces replicas exactly like
+    launcher ranks."""
     if rank is None:
         rank = _default_rank()
     os.makedirs(log_dir, exist_ok=True)
     path = os.path.join(log_dir, f"telemetry_rank{rank}.json")
     with open(path, "w") as f:
-        json.dump(snapshot(rank=rank), f, indent=1)
+        json.dump(snapshot(rank=rank, registry=registry), f, indent=1)
     return path
 
 

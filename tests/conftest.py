@@ -48,3 +48,21 @@ def _seeded():
     paddle.seed(2024)
     np.random.seed(2024)
     yield
+
+
+@pytest.fixture(scope="session")
+def tiny_llama():
+    """Session-scoped tiny llama (r12 suite-time satellite): ONE seeded
+    (cfg, params) shared by the serving/paged/fleet test modules —
+    params are deterministic (PRNGKey(0)) and every test builds its own
+    engine, so nothing leaks between tests or files; the per-module
+    init_params + first-dispatch warmups were pure overhead. The shared
+    geometry also maximises hits in the engines' process-wide compiled-
+    program cache (serving._SHARED_PROGS)."""
+    from paddle_tpu.models import llama
+    from paddle_tpu.parallel import set_mesh
+
+    set_mesh(None)
+    cfg = llama.LlamaConfig.tiny(max_seq_len=96)
+    params = llama.init_params(cfg)
+    return cfg, params

@@ -1,0 +1,313 @@
+"""Fleet serving subsystem (r12 tentpole): router determinism, prefix
+affinity (a hot prefix must route BACK to the replica whose cache holds
+it), least-loaded fallback under skew, fleet backpressure accounting
+(fleet counter == sum of replica counters), the one-sync-per-segment
+audit over the whole fleet serve loop, rank-merged telemetry, and mp=2
+tensor-parallel segment token parity vs the single-device reference
+(dense AND paged) — the multi-chip serving acceptance tests, runnable
+on the virtual-CPU multi-device platform and on chip."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.inference.fleet import FleetRouter, build_fleet
+from paddle_tpu.inference.scheduler import (Arrival, OnlineScheduler,
+                                            poisson_arrivals)
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models import llama
+from paddle_tpu.parallel import set_mesh
+
+
+def _dense_reference(cfg, params, prompt, n):
+    out = llama.generate(params, np.asarray(prompt, np.int32)[None], cfg,
+                         max_new_tokens=n, max_len=96)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _burst(reqs):
+    """Every arrival due at t=0: routing then depends only on the event
+    stream, never the wall clock — the determinism contract's regime."""
+    return [Arrival(0.0, p, n) for p, n in reqs]
+
+
+def _mixed_reqs(seed, n, cfg, lens=(5, 12, 8, 20, 3, 15, 7, 9),
+                gens=(9, 6, 12, 4, 8, 5, 10, 7)):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(0, cfg.vocab_size, (lens[i % len(lens)],)
+                         ).astype(np.int32), gens[i % len(gens)])
+            for i in range(n)]
+
+
+class TestFleetRouter:
+    def test_determinism_and_token_identity(self, tiny_llama):
+        """Same burst trace + same fleet -> identical per-replica
+        assignment and identical tokens across serves; every request's
+        tokens == dense generate() (greedy is placement-independent)."""
+        set_mesh(None)
+        cfg, params = tiny_llama
+        reqs = _mixed_reqs(21, 6, cfg)
+        refs = [_dense_reference(cfg, params, p, n) for p, n in reqs]
+        router = FleetRouter(build_fleet(cfg, params, 2, slots=2,
+                                         max_len=96,
+                                         prompt_buckets=(8, 16, 32)),
+                             max_queue=8, seg_steps=8)
+        rep1 = router.serve(_burst(reqs))
+        out1, asg1 = router.results(), router.assignment()
+        router.reset()
+        rep2 = router.serve(_burst(reqs))
+        out2, asg2 = router.results(), router.assignment()
+        assert asg1 == asg2 and out1 == out2
+        assert sorted(len(a) for a in asg1) != [0, 6], \
+            "router sent everything to one replica on a spread workload"
+        for rid, ref in zip(sorted(out1), refs):
+            assert out1[rid] == ref, (rid, out1[rid], ref)
+        assert rep1.n_requests == rep2.n_requests == 6
+        assert rep1.segments > 0 and rep1.ticks > 0
+
+    def test_least_loaded_fallback_under_skew(self, tiny_llama):
+        """A deliberately skewed trace — every prompt carries the SAME
+        affinity prefix — must spill past the preferred replica's full
+        queue to the least-loaded one instead of stalling."""
+        set_mesh(None)
+        cfg, params = tiny_llama
+        rng = np.random.RandomState(31)
+        shared = rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+        reqs = [(np.concatenate([shared, rng.randint(
+            0, cfg.vocab_size, (4,)).astype(np.int32)]), 5)
+            for _ in range(6)]
+        refs = [_dense_reference(cfg, params, p, n) for p, n in reqs]
+        # caches on: affinity is cache-gated (without them the router
+        # is least-loaded only — a prompt-hash pin would be pure load
+        # imbalance); block 16 so the 16-token shared head is the key
+        router = FleetRouter(build_fleet(cfg, params, 2, slots=2,
+                                         max_len=96,
+                                         prompt_buckets=(8, 16, 32)),
+                             max_queue=2, seg_steps=8,
+                             prefix_caches="auto", affinity_block=16)
+        rep = router.serve(_burst(reqs))
+        out = router.results()
+        for rid, ref in zip(sorted(out), refs):
+            assert out[rid] == ref
+        assert rep.dispatches_affinity > 0
+        assert rep.dispatches_least_loaded > 0, \
+            "skewed trace never spilled to the least-loaded replica"
+        assert all(len(a) > 0 for a in router.assignment()), \
+            "fallback left a replica idle under a full preferred queue"
+
+    def test_fleet_backpressure_counter_is_sum_of_replicas(self,
+                                                           tiny_llama):
+        """When every replica's queue is full the arrival stays client-
+        side and the refusal is billed to exactly one replica — the
+        fleet counter is definitionally the replica sum, and everything
+        still serves once queues drain."""
+        set_mesh(None)
+        cfg, params = tiny_llama
+        reqs = _mixed_reqs(33, 6, cfg, lens=(6,), gens=(6,))
+        router = FleetRouter(build_fleet(cfg, params, 2, slots=1,
+                                         max_len=96, prompt_buckets=(8,)),
+                             max_queue=1, seg_steps=8)
+        rep = router.serve(_burst(reqs))
+        assert rep.backpressure_events > 0
+        assert rep.backpressure_events == sum(
+            r["backpressure_events"] for r in rep.per_replica)
+        assert rep.n_requests == 6 and len(router.results()) == 6
+
+    def test_affinity_prefix_never_misses_across_replicas(self,
+                                                          tiny_llama):
+        """THE affinity contract: once a prefix is hot in replica A's
+        cache, every later request sharing it routes back to A and HITS
+        — round-robin to B would silently re-prefill (the r12 fleet-
+        isolation bug class this router exists to prevent)."""
+        set_mesh(None)
+        cfg, params = tiny_llama
+        rng = np.random.RandomState(41)
+        groups = [rng.randint(0, cfg.vocab_size, (32,)).astype(np.int32)
+                  for _ in range(2)]
+
+        def wave(seed, per_group):
+            r = np.random.RandomState(seed)
+            return [(np.concatenate([g, r.randint(
+                0, cfg.vocab_size, (6,)).astype(np.int32)]), 5)
+                for g in groups for _ in range(per_group)]
+
+        router = FleetRouter(build_fleet(cfg, params, 2, slots=2,
+                                         max_len=96,
+                                         prompt_buckets=(8, 16, 64)),
+                             max_queue=8, seg_steps=16,
+                             prefix_caches="auto")
+        wave1, wave2 = wave(43, 1), wave(44, 3)
+        router.serve(_burst(wave1))     # cold: populates per-replica caches
+        router.serve(_burst(wave2))     # hot: must route back + hit
+        out = router.results()
+        for rid, (p, n) in zip(sorted(out), wave1 + wave2):
+            assert out[rid] == _dense_reference(cfg, params, p, n)
+        wave2_reqs = [router._reqs[rid][1]
+                      for rid in sorted(router._reqs)[len(wave1):]]
+        assert all(r.prefix_hit_len >= 32 for r in wave2_reqs), \
+            [r.prefix_hit_len for r in wave2_reqs]
+        hits = sum(rr.prefix_cache.hits for rr in router._replicas)
+        assert hits >= len(wave2)
+        assert sum(rr.dispatches["affinity"]
+                   for rr in router._replicas) == len(wave1) + len(wave2)
+
+    def test_one_sync_per_segment_over_fleet_loop(self, tiny_llama):
+        """The r7/r9/r11 audit contract survives the fleet: the whole
+        N-replica serve loop performs exactly ONE allowed device->host
+        sync per segment (each replica's event fetch), zero flagged —
+        routing, stamping and per-replica telemetry are host arithmetic."""
+        from paddle_tpu.analysis import syncs
+
+        set_mesh(None)
+        cfg, params = tiny_llama
+        reqs = _mixed_reqs(51, 6, cfg)
+        router = FleetRouter(build_fleet(cfg, params, 2, slots=2,
+                                         max_len=96,
+                                         prompt_buckets=(8, 16, 32)),
+                             max_queue=8, seg_steps=8)
+        router.serve(_burst(reqs))          # warm: compiles + first fetch
+        router.reset()
+        with syncs.SyncAudit() as sa:
+            sa.phase = "replay"
+            rep = router.serve(_burst(reqs))
+        flagged = sa.flagged("replay")
+        assert flagged == [], [f"{e.kind}@{e.site}" for e in flagged]
+        allowed = sa.allowed("replay")
+        assert set(allowed) == {"serving.segment_event_fetch"}
+        assert allowed["serving.segment_event_fetch"] == rep.segments
+
+    def test_paged_fleet_leak_report_aggregates(self, tiny_llama):
+        """Per-replica paged pools audit through ONE fleet-level
+        leak_report (replica-tagged); a page pinned outside its own
+        engine's accounting is named with its replica."""
+        set_mesh(None)
+        cfg, params = tiny_llama
+        reqs = _mixed_reqs(61, 4, cfg)
+        router = FleetRouter(build_fleet(cfg, params, 2, slots=2,
+                                         max_len=96,
+                                         prompt_buckets=(8, 16, 32),
+                                         paged=True, page_size=16),
+                             max_queue=8, seg_steps=8,
+                             prefix_caches="auto")
+        router.serve(_burst(reqs))
+        out = router.results()
+        for rid, (p, n) in zip(sorted(out), reqs):
+            assert out[rid] == _dense_reference(cfg, params, p, n)
+        assert router.leak_report() == []
+        # inject a stray ref on replica 1's pool: the aggregate must
+        # name the replica
+        pgr = router._replicas[1].engine.pager
+        page = pgr.allocator.alloc(1)
+        bad = router.leak_report()
+        assert bad and all(b.startswith("replica 1:") for b in bad), bad
+        pgr.allocator.release(page)
+        assert router.leak_report() == []
+
+    def test_merged_telemetry_ranks(self, tiny_llama, tmp_path):
+        """Replica registries merge through the EXISTING rank machinery:
+        one telemetry_rank<i>.json per replica, counters summed, gauges
+        kept by rank."""
+        set_mesh(None)
+        cfg, params = tiny_llama
+        reqs = _mixed_reqs(71, 4, cfg)
+        router = FleetRouter(build_fleet(cfg, params, 2, slots=2,
+                                         max_len=96,
+                                         prompt_buckets=(8, 16, 32)),
+                             max_queue=8, seg_steps=8)
+        rep = router.serve(_burst(reqs))
+        merged = router.merged_telemetry(str(tmp_path))
+        assert merged["ranks"] == [0, 1]
+        assert merged["counters"]["serving.segments"]["value"] == \
+            rep.segments
+        assert merged["counters"]["serving.tokens_generated"]["value"] == \
+            rep.total_tokens
+        by_rank = merged["gauges"]["fleet.replica_queue_depth"]["by_rank"]
+        assert set(by_rank) == {"0", "1"}
+
+    def test_prefix_cache_engine_keying_enforced(self, tiny_llama):
+        """Fleet isolation: a paged replica handed a cache wrapping a
+        DIFFERENT engine's pager fails loudly at construction (sharing
+        would retain pages of the wrong pool)."""
+        from paddle_tpu.inference.prefix_cache import make_prefix_cache
+
+        set_mesh(None)
+        cfg, params = tiny_llama
+        engines = build_fleet(cfg, params, 2, slots=2, max_len=96,
+                              prompt_buckets=(8, 16, 32), paged=True,
+                              page_size=16)
+        wrong = [make_prefix_cache(engines[1]), make_prefix_cache(
+            engines[0])]
+        with pytest.raises(ValueError, match="ITS OWN"):
+            FleetRouter(engines, prefix_caches=wrong)
+
+
+class TestTensorParallelServing:
+    """Acceptance: mp=2 serving segments (dense and paged) are token-
+    identical to the single-device reference on the r7-style Poisson
+    workload, under the forced multi-device CPU platform (conftest's
+    8-device recipe; skips on a single real chip)."""
+
+    @pytest.fixture()
+    def mp2(self, tiny_llama):
+        if len(jax.devices()) < 2:
+            pytest.skip("tensor-parallel parity needs >= 2 devices")
+        from paddle_tpu.parallel.mesh import create_hybrid_mesh
+
+        set_mesh(None)
+        mesh = create_hybrid_mesh(mp=2, devices=jax.devices()[:2],
+                                  set_as_global=False)
+        set_mesh(None)
+        return mesh
+
+    def test_mp2_dense_scheduler_token_parity(self, tiny_llama, mp2):
+        """The online scheduler over an mp=2 engine serves the seeded
+        Poisson trace token-identical to dense generate() — and the
+        engine restores the global mesh (no leak into other tests)."""
+        from paddle_tpu.parallel.mesh import get_mesh
+
+        cfg, params = tiny_llama
+        arr = poisson_arrivals(31, 6, 1e4, cfg.vocab_size,
+                               prompt_lens=(5, 11, 23),
+                               gen_lens=(3, 7, 11))
+        eng = ServingEngine(cfg, params, slots=2, max_len=96,
+                            prompt_buckets=(8, 16, 32), mesh=mp2)
+        sch = OnlineScheduler(eng, seg_steps=8)
+        rep = sch.serve(arr)
+        out = sch.results()
+        assert rep.n_requests == len(arr) == len(out)
+        for a, rid in zip(sorted(arr, key=lambda x: x.t), sorted(out)):
+            ref = _dense_reference(cfg, params, a.prompt, a.max_new_tokens)
+            assert out[rid] == ref, (rid, out[rid], ref)
+        assert get_mesh() is None
+
+    def test_mp2_paged_segment_token_parity(self, tiny_llama, mp2):
+        """Paged mp=2: page tables stay replicated host data while the
+        pool shards on heads — token parity + zero page leaks."""
+        cfg, params = tiny_llama
+        reqs = _mixed_reqs(23, 5, cfg)
+        eng = ServingEngine(cfg, params, slots=2, max_len=96,
+                            prompt_buckets=(8, 16, 32), mesh=mp2,
+                            paged=True, page_size=16)
+        rids = [eng.add_request(p, n) for p, n in reqs]
+        while eng._queue or eng.free_slot_count() < eng.slots:
+            eng.run_segment(8)
+        out = eng.collect_finished()
+        for rid, (p, n) in zip(rids, reqs):
+            assert out[rid] == _dense_reference(cfg, params, p, n)
+        assert eng.pager.leak_report() == []
+
+    def test_mp2_fleet_of_sharded_replicas(self, tiny_llama, mp2):
+        """Composability: the DP router over TP-sharded replicas (the
+        engines x chips product) — both layers at once, token parity
+        preserved."""
+        cfg, params = tiny_llama
+        reqs = _mixed_reqs(29, 4, cfg)
+        engines = [ServingEngine(cfg, params, slots=2, max_len=96,
+                                 prompt_buckets=(8, 16, 32), mesh=mp2)
+                   for _ in range(2)]
+        router = FleetRouter(engines, max_queue=8, seg_steps=8)
+        router.serve(_burst(reqs))
+        out = router.results()
+        for rid, (p, n) in zip(sorted(out), reqs):
+            assert out[rid] == _dense_reference(cfg, params, p, n)

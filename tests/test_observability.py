@@ -328,14 +328,13 @@ class TestFlightRecorder:
 
 
 @pytest.fixture(scope="module")
-def tiny_serving():
+def tiny_serving(tiny_llama):
     from paddle_tpu.inference.serving import ServingEngine
-    from paddle_tpu.models import llama
     from paddle_tpu.parallel import set_mesh
 
+    # r12: model build hoisted to the session-scoped conftest fixture
     set_mesh(None)
-    cfg = llama.LlamaConfig.tiny(max_seq_len=96)
-    params = llama.init_params(cfg)
+    cfg, params = tiny_llama
     eng = ServingEngine(cfg, params, slots=4, max_len=96,
                         prompt_buckets=(8, 16, 32))
     return cfg, params, eng
@@ -579,6 +578,51 @@ class TestTelemetryAudit:
         assert main(["--program", "fused_optimizer_update", "--gate",
                      "--telemetry", "off"]) == 0
         assert metrics.enabled()  # flag restored the previous state
+
+    def test_fleet_serve_budgets_identical_with_telemetry(self,
+                                                          tiny_serving):
+        """r12 satellite: the FLEET serve loop — per-replica scoped
+        registries, dispatch counters, queue-depth gauges, fleet_dispatch
+        flight events — adds ZERO device contacts: sync metrics over a
+        2-replica fleet serve are bit-identical with telemetry on vs
+        off, and the only allowed label is the per-segment event fetch
+        (one per segment, fleet-wide)."""
+        import numpy as np
+
+        from paddle_tpu.analysis import auditor
+        from paddle_tpu.inference.fleet import FleetRouter, build_fleet
+        from paddle_tpu.inference.scheduler import Arrival
+
+        cfg, params, _ = tiny_serving
+        rng = np.random.RandomState(3)
+        reqs = [(rng.randint(0, cfg.vocab_size, (8,)).astype(np.int32), 4)
+                for _ in range(4)]
+        router = FleetRouter(build_fleet(cfg, params, 2, slots=2,
+                                         max_len=96,
+                                         prompt_buckets=(8, 16, 32)),
+                             max_queue=8, seg_steps=8)
+
+        def replay():
+            rep = router.serve([Arrival(0.0, p, n) for p, n in reqs])
+            router.reset()
+            return rep
+
+        def audit(enabled):
+            prev = metrics.set_enabled(enabled)
+            try:
+                return auditor.audit_replay("fleet_serve", replay,
+                                            replays=2)
+            finally:
+                metrics.set_enabled(prev)
+
+        rep_on, rep_off = audit(True), audit(False)
+        for key in ("host_syncs_flagged", "host_syncs_allowed",
+                    "warm_compiles"):
+            assert rep_on.metrics[key] == rep_off.metrics[key], (
+                key, rep_on.metrics[key], rep_off.metrics[key])
+        assert rep_on.metrics["host_syncs_flagged"] == 0
+        assert set(rep_on.metrics["host_syncs_allowed"]) == {
+            "serving.segment_event_fetch"}
 
 
 class TestOverheadGate:

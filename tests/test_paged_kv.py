@@ -21,11 +21,10 @@ from paddle_tpu.parallel import set_mesh
 
 
 @pytest.fixture(scope="module")
-def tiny():
+def tiny(tiny_llama):
+    # r12: model build hoisted to the session-scoped conftest fixture
     set_mesh(None)
-    cfg = llama.LlamaConfig.tiny(max_seq_len=96)
-    params = llama.init_params(cfg)
-    return cfg, params
+    return tiny_llama
 
 
 def _dense_reference(cfg, params, prompt, n):

@@ -11,14 +11,13 @@ from paddle_tpu.parallel import set_mesh
 
 
 @pytest.fixture(scope="module")
-def tiny():
-    # module scope (r11 suite-time maintenance): params are seeded and
-    # every test builds its own engine, so nothing leaks between tests —
-    # the per-test init_params + first-dispatch cost was pure overhead
+def tiny(tiny_llama):
+    # r12 suite-time satellite: the model build is hoisted to the
+    # SESSION-scoped conftest fixture (shared with test_paged_kv /
+    # test_fleet_serving); this module-level shim keeps the mesh clear
+    # for every consumer here
     set_mesh(None)
-    cfg = llama.LlamaConfig.tiny(max_seq_len=96)
-    params = llama.init_params(cfg)
-    return cfg, params
+    return tiny_llama
 
 
 def _dense_reference(cfg, params, prompt, n):
