@@ -22,6 +22,14 @@ Writes ``SERVING_r<N>.json`` at the repo root:
               N, token identity across fleet sizes, affinity/dispatch
               accounting, rank-merged telemetry...},
               (r12: the fleet serving subsystem)
+   "overload": {...llama_serving --overload json: the latency-vs-load
+              curve at 1/2/4x the measured service rate through the SLO
+              scheduler — per-class TTFT/e2e, preempt + shed counts,
+              the high-class-p99-bounded bar...},
+   "failover": {...llama_serving --failover json: seeded replica kill
+              mid-serve — zero lost requests, token identity vs the
+              no-fault run, re-admission probing...},
+              (r13: SLO-aware serving under overload and failure)
    "telemetry_headlines": {...r10 runtime-telemetry headlines per mode —
               queue depth / slot occupancy / prefix hit rate /
               backpressure counters from paddle_tpu.observability; the
@@ -90,6 +98,10 @@ def main() -> int:
         "prefix": _run_json("llama_serving.py", args=("--prefix",)),
         "paged": _run_json("llama_serving.py", args=("--paged",)),
         "fleet": _run_json("llama_serving.py", args=("--fleet",)),
+        # r13 (ISSUE 8): the SLO robustness lanes — latency-vs-load with
+        # priorities/preemption/shedding, and the replica-kill run
+        "overload": _run_json("llama_serving.py", args=("--overload",)),
+        "failover": _run_json("llama_serving.py", args=("--failover",)),
     }
     result["platform"] = result["online"].get("platform", "unknown")
     # r10: lift each mode's runtime-telemetry headline (queue depth,
@@ -98,14 +110,15 @@ def main() -> int:
     # online/prefix "telemetry"
     result["telemetry_headlines"] = {
         k: (result[k].get("telemetry") or {}).get("headline")
-        for k in ("online", "prefix", "paged", "fleet")}
+        for k in ("online", "prefix", "paged", "fleet", "overload",
+                  "failover")}
     path = os.path.join(ROOT, f"SERVING_r{rnd:02d}.json")
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
     ok = all(result[k].get("rc") == 0
              for k in ("decode", "serving", "online", "prefix", "paged",
-                       "fleet"))
+                       "fleet", "overload", "failover"))
     return 0 if ok else 1
 
 

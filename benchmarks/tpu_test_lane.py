@@ -54,6 +54,10 @@ TPU_TEST_FILES = [
     # tensor-parallel segment parity tests (these skip on a single-chip
     # host and run when the lane sees a multi-device TPU)
     "tests/test_fleet_serving.py",
+    # r13 (ISSUE 8): the SLO robustness subsystem — chunked-prefill
+    # parity through the REAL unified kernel, priority preemption /
+    # resume identity, deadline shedding, fleet kill/recover
+    "tests/test_slo_serving.py",
 ]
 
 

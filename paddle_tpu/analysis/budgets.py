@@ -103,6 +103,27 @@ BUDGETS: Dict[str, Budget] = {
         undonated_bytes_max=_MiB // 2,  # measured 0 (pool+table donated)
         notes="r11 contract: paged pool + page tables, one fetch/segment, "
               "prefix reuse is refcount data not program shape"),
+    # The CHUNKED-PREFILL paged segment (r13, ISSUE 8a): the
+    # paged_serving_segment contract with admits split into declared-
+    # ladder chunks interleaved with decode ticks. Chunking must be
+    # FREE at the hazard level: still exactly one event fetch per
+    # segment, zero warm compiles (chunk widths are declared, so the
+    # ("cseg", ...) key family is finite), zero pack bytes (chunks
+    # write page-indirectly in place — no staging concats), and the
+    # relayout ledger is the same while-body pool-carry class as the
+    # unchunked paged segment (measured slightly BELOW it: the chunk
+    # branch's [1, C] windows carry less than the [1, s_max] admit).
+    "chunked_serving_segment": Budget(
+        flagged_syncs=0,
+        allowed_syncs_per_replay={"serving.segment_event_fetch": 1},
+        warm_compiles=0,
+        # measured 967,404 B (while-body pool carries + chunk-scatter
+        # copies) + ~5%
+        relayout_bytes_max=1_015_000,
+        pack_bytes_max=_MiB // 2,      # measured 0
+        undonated_bytes_max=_MiB // 2,  # measured 0 (pool+table donated)
+        notes="r13 contract: chunked prefill interleaved with decode — "
+              "bounded time-between-tokens at zero extra syncs/compiles"),
     # The TENSOR-PARALLEL segment (r12): the serving_segment contract,
     # GSPMD-sharded — same one fetch per segment and zero warm compiles,
     # PLUS every collective must attribute to the 'mp' axis (enforced

@@ -20,6 +20,10 @@ whose hazard ledger earlier rounds paid for by hand:
 * ``tp_serving_segment``     — the r12 mp-sharded segment (collectives
   must attribute to the 'mp' axis; the one-fetch contract survives
   GSPMD).
+* ``chunked_serving_segment`` — the r13 chunked-prefill paged segment
+  (prefill split into ladder-width chunks interleaved with decode
+  ticks; still exactly one event fetch, chunk widths declared so the
+  program-key family stays finite).
 
 Builders are deterministic (fixed seeds, fixed shapes) so the measured
 metrics are stable run to run and ``budgets.py`` can pin them as exact
@@ -269,6 +273,64 @@ def _build_paged_serving_segment() -> ProgramHandle:
         expected_undonated=(),
         notes="paged re-entrant segment (page-table pool, COW-ready) + "
               "host event replay with page bookkeeping, llama-tiny",
+        keepalive=(eng,))
+
+
+@register("chunked_serving_segment")
+def _build_chunked_serving_segment() -> ProgramHandle:
+    """The r13 chunked-prefill segment (ISSUE 8a): the paged segment
+    with admits split into declared-ladder chunks interleaved with
+    decode ticks. The contract the budget pins: chunking must not cost
+    a single extra host sync (still exactly ONE event fetch per
+    segment), zero warm compiles (chunk widths come from the declared
+    ladder, so the ("cseg", ...) key family is finite and the warm
+    replay covers it), and no new relayout/pack traffic beyond the
+    while-body carries the paged segment already pays."""
+    import numpy as np
+
+    import jax.numpy as j
+
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg)
+    eng = ServingEngine(cfg, params, slots=4, max_len=64, chunk=8,
+                        prompt_buckets=(16,), paged=True, page_size=16,
+                        chunked_prefill=True, prefill_chunks=(8,))
+    rng = np.random.RandomState(0)
+
+    def replay():
+        # end-to-end CHUNKED segment: two 12-token prompts each prefill
+        # as 2 interleaved 8-token chunks, decode to completion inside
+        # the segment (slots + pages drain), one allowed event fetch
+        for _ in range(2):
+            eng.add_request(rng.randint(0, cfg.vocab_size, (12,)), 4)
+        return eng.run_segment(16)
+
+    def hlo():
+        n_pad = eng._pow2(eng.slots)
+        C = eng._prefill_chunk_for(eng.buckets[-1])
+        s_max_c = -(-eng.buckets[-1] // C) * C
+        seg = eng._chunked_segment_prog(n_pad, s_max_c, C, 16)
+        pgr = eng.pager
+        return seg.lower(
+            params, pgr.pool, pgr.page_table,
+            j.zeros((eng.slots,), j.int32), j.zeros((eng.slots,), j.int32),
+            j.zeros((eng.slots,), j.int32),
+            j.zeros((n_pad, s_max_c), j.int32), j.ones((n_pad,), j.int32),
+            j.zeros((n_pad,), j.int32), j.zeros((n_pad,), j.int32),
+            j.zeros((n_pad, pgr.max_pages), j.int32),
+            j.int32(2)).compile().as_text()
+
+    return ProgramHandle(
+        name="chunked_serving_segment",
+        hlo=_memo(hlo),
+        replay=replay,
+        donation_threshold=1 << 16,
+        expected_undonated=(),
+        notes="chunked-prefill paged segment (8-token chunks interleaved "
+              "with decode ticks) + host event replay, llama-tiny",
         keepalive=(eng,))
 
 

@@ -32,13 +32,16 @@ import numpy as np
 __all__ = ["Config", "Predictor", "InferTensor", "create_predictor",
            "PrecisionType", "PlaceType"]
 
-# Online serving subsystem (r7/r11/r12): imported lazily by consumers —
+# Online serving subsystem (r7/r11/r12/r13): imported lazily by
+# consumers —
 # ``from paddle_tpu.inference.serving import ServingEngine``,
-# ``from paddle_tpu.inference.scheduler import OnlineScheduler``,
+# ``from paddle_tpu.inference.scheduler import OnlineScheduler /
+# SLOScheduler`` (r13: priorities, preemption, deadline shedding),
 # ``from paddle_tpu.inference.prefix_cache import PrefixCache /
 # PagedPrefixCache / make_prefix_cache``, ``from
 # paddle_tpu.inference.paged_kv import PagedKVCache``, ``from
-# paddle_tpu.inference.fleet import FleetRouter / build_fleet`` — kept
+# paddle_tpu.inference.fleet import FleetRouter / build_fleet /
+# FaultInjector`` (r13: health states + failover) — kept
 # out of this namespace so importing the Predictor surface doesn't pull
 # jax model code.
 

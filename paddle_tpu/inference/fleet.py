@@ -68,7 +68,74 @@ from .prefix_cache import make_prefix_cache
 from .scheduler import Arrival
 from .serving import ServingEngine
 
-__all__ = ["FleetRouter", "FleetReport", "build_fleet"]
+__all__ = ["FleetRouter", "FleetReport", "build_fleet", "FaultInjector",
+           "ReplicaCrash", "ReplicaHang"]
+
+
+# ---------------------------------------------------------------------------
+# fault injection (r13, ISSUE 8c): deterministic replica crash/hang harness
+# ---------------------------------------------------------------------------
+
+
+class ReplicaCrash(Exception):
+    """Injected process-death: the in-flight segment's results are lost
+    and the replica is immediately DEAD (no retry can help a corpse)."""
+
+
+class ReplicaHang(Exception):
+    """Injected wedge: the segment fetch 'times out'. Retries may
+    succeed (a transient stall) — repeated hangs escalate to dead."""
+
+
+class FaultInjector:
+    """Declarative, deterministic fault schedule for the failover tests
+    and the ``--failover`` benchmark lane. Faults fire at a replica's
+    k-th ``finish_segment`` (segments are counted per replica, and the
+    fleet's dispatch order is deterministic on a burst trace — the r12
+    determinism contract — so a schedule keyed on (replica, segment) is
+    exactly reproducible). ``seed``/``crash_p`` adds a seeded random
+    crash mode on top for soak-style schedules.
+
+    * ``crash={idx: seg_no}``: that finish raises ``ReplicaCrash`` once.
+    * ``hang={idx: (seg_no, n)}``: that finish raises ``ReplicaHang``
+      ``n`` consecutive times (attempt-counted, so bounded retry can
+      ride through a transient hang when n <= the retry budget).
+    * ``recover_after``: a dead replica's k-th re-admission probe
+      succeeds (models a restart/repair completing).
+    """
+
+    def __init__(self, crash: Optional[Dict[int, int]] = None,
+                 hang: Optional[Dict[int, tuple]] = None,
+                 recover_after: int = 1, seed: int = 0,
+                 crash_p: float = 0.0):
+        self.crash = dict(crash or {})
+        self.hang = {k: [int(v[0]), int(v[1])]
+                     for k, v in (hang or {}).items()}
+        self.recover_after = int(recover_after)
+        self.crash_p = float(crash_p)
+        self._rng = np.random.RandomState(seed)
+        self.events: List[tuple] = []      # (kind, replica, detail) log
+
+    def on_finish(self, idx: int, seg_no: int) -> None:
+        """Called right before replica ``idx`` fetches its ``seg_no``-th
+        segment; raises to inject the fault."""
+        if self.crash.get(idx) == seg_no or (
+                self.crash_p and self._rng.rand() < self.crash_p):
+            self.crash.pop(idx, None)
+            self.events.append(("crash", idx, seg_no))
+            raise ReplicaCrash(f"replica {idx} crashed at its segment "
+                               f"{seg_no}")
+        h = self.hang.get(idx)
+        if h is not None and h[0] == seg_no and h[1] > 0:
+            h[1] -= 1
+            self.events.append(("hang", idx, seg_no))
+            raise ReplicaHang(f"replica {idx} hung at its segment "
+                              f"{seg_no}")
+
+    def on_probe(self, idx: int, probe_no: int) -> bool:
+        """Re-admission probe of a dead replica: True = recovered."""
+        self.events.append(("probe", idx, probe_no))
+        return probe_no >= self.recover_after
 
 
 @dataclass
@@ -89,6 +156,13 @@ class FleetReport:
     backpressure_events: int       # == sum of per-replica counters
     dispatches_affinity: int
     dispatches_least_loaded: int
+    # r13 failover accounting: replicas declared dead this serve,
+    # requests requeued to survivors, final health per replica, and the
+    # fleet-path retry_after_s backpressure hint (None = never refused)
+    failovers: int = 0
+    requeued: int = 0
+    replica_health: Optional[Dict[int, str]] = None
+    retry_after_s: Optional[float] = None
     per_replica: List[dict] = field(default_factory=list)
     telemetry: Optional[dict] = None   # merge_log_dir reduction
 
@@ -103,6 +177,8 @@ class FleetReport:
 class _Replica:
     """One engine + its isolated prefix cache, registry and counters."""
 
+    _HEALTH_CODE = {"healthy": 0.0, "suspect": 1.0, "dead": 2.0}
+
     def __init__(self, idx: int, engine: ServingEngine, prefix_cache):
         self.idx = idx
         self.engine = engine
@@ -112,6 +188,19 @@ class _Replica:
         self.dispatches = {"affinity": 0, "least_loaded": 0}
         self.segments = 0
         self.rids: List[int] = []          # fleet rids, assignment order
+        # r13 failover: health state machine (healthy -> suspect on a
+        # segment timeout / transient hang -> dead on repetition or
+        # crash -> healthy again via re-admission probe)
+        self.health = "healthy"
+        self.timeouts = 0                  # consecutive slow segments
+        self.dead_since = 0.0
+        self.probes = 0
+
+    def set_health(self, state: str) -> None:
+        self.health = state
+        with _metrics.scoped_registry(self.registry):
+            _metrics.gauge("fleet.replica_health").set(
+                self._HEALTH_CODE[state])
 
     @property
     def queue_depth(self) -> int:
@@ -168,7 +257,11 @@ class FleetRouter:
 
     def __init__(self, engines: Sequence[ServingEngine],
                  max_queue: int = 64, seg_steps: int = 32,
-                 prefix_caches=None, affinity_block: Optional[int] = None):
+                 prefix_caches=None, affinity_block: Optional[int] = None,
+                 segment_timeout_s: Optional[float] = None,
+                 max_finish_retries: int = 1, max_requeues: int = 3,
+                 fault_injector: Optional[FaultInjector] = None,
+                 probe_after_s: float = 0.05):
         if not engines:
             raise ValueError("a fleet needs at least one engine")
         if prefix_caches == "auto":
@@ -203,6 +296,24 @@ class FleetRouter:
         self.backpressure_events = 0
         self._reqs: Dict[int, tuple] = {}   # fleet rid -> (replica, Request)
         self._next_rid = 0
+        # r13 failover knobs (ISSUE 8c). segment_timeout_s: a finish
+        # slower than this marks the replica suspect (None = timeouts
+        # off — the default: a loaded single-core CI box must not
+        # false-positive its own replicas dead). max_finish_retries:
+        # bounded re-attempts of a hung segment fetch before declaring
+        # the replica dead. max_requeues: per-request failover budget —
+        # a request bounced more than this fails loudly instead of
+        # ping-ponging across a dying fleet forever.
+        self.segment_timeout_s = segment_timeout_s
+        self.max_finish_retries = int(max_finish_retries)
+        self.max_requeues = int(max_requeues)
+        self.fault_injector = fault_injector
+        self.probe_after_s = float(probe_after_s)
+        self.failovers = 0                  # replicas declared dead
+        self.requeued = 0                   # requests moved to survivors
+        self.last_retry_after_s: Optional[float] = None
+        self._finished_count = 0
+        self._serve_t0 = 0.0
 
     # --- routing ---------------------------------------------------------
     def _affinity_key(self, prompt: np.ndarray) -> Optional[bytes]:
@@ -226,18 +337,30 @@ class FleetRouter:
 
     def _route(self, a: Arrival):
         """(replica, reason) for a due arrival, or (bill_target, None)
-        when every queue is full (fleet backpressure)."""
+        when every queue is full (fleet backpressure). r13: suspect and
+        dead replicas are EXCLUDED from dispatch — an affinity pin to an
+        unhealthy replica falls through to least-loaded over the healthy
+        set (the prefix re-prefills on the survivor; correctness over
+        cache warmth), and only if NO healthy replica exists do suspects
+        take traffic as a last resort (dead never)."""
         key = (self._affinity_key(a.prompt)
                if self._use_affinity else None)
         pref = (self._replicas[zlib.crc32(key) % len(self._replicas)]
                 if key is not None else None)
-        if pref is not None and pref.queue_depth < self.max_queue:
+        if (pref is not None and pref.health == "healthy"
+                and pref.queue_depth < self.max_queue):
             return pref, "affinity"
         cands = [r for r in self._replicas
-                 if r.queue_depth < self.max_queue]
+                 if r.queue_depth < self.max_queue
+                 and r.health == "healthy"]
         if not cands:
-            # all queues full: bill the replica the request WOULD have
-            # gone to, so fleet backpressure == sum(replica counters)
+            cands = [r for r in self._replicas
+                     if r.queue_depth < self.max_queue
+                     and r.health == "suspect"]
+        if not cands:
+            # all takeable queues full: bill the replica the request
+            # WOULD have gone to, so fleet backpressure == sum(replica
+            # counters)
             bill = pref if pref is not None else \
                 min(self._replicas, key=lambda r: (r.load, r.idx))
             return bill, None
@@ -255,11 +378,15 @@ class FleetRouter:
                 refused += 1
                 rep.backpressure_events += 1
                 self.backpressure_events += 1
+                hint = self.retry_after_hint(now)
+                self.last_retry_after_s = hint
                 with _metrics.scoped_registry(rep.registry):
                     _metrics.counter("serving.backpressure_events").inc()
                 _metrics.counter("fleet.backpressure_events").inc()
+                _metrics.gauge("fleet.retry_after_s").set(hint)
                 _flight.record("backpressure", replica=rep.idx,
-                               queue=rep.queue_depth, fleet=True)
+                               queue=rep.queue_depth, fleet=True,
+                               retry_after_s=round(hint, 4))
                 break                       # arrival stays client-side
             pending.pop(0)
             rid = self._next_rid
@@ -305,33 +432,41 @@ class FleetRouter:
         # of waiting out a whole synchronized turn — the TTFT lever when
         # replicas contend for one host/core; on real parallel devices
         # it additionally keeps every chip busy continuously.
-        inflight: List[tuple] = []          # (replica, handle), FIFO
+        inflight: List[tuple] = []          # (replica, handle, t_disp) FIFO
         t0 = time.perf_counter()
+        self._serve_t0 = t0
+        self._finished_count = 0
+        self.last_retry_after_s = None
         while pending or inflight or any(r.busy for r in reps):
             now = time.perf_counter() - t0
+            self._probe_dead()
             self._ingest(pending, now, t0)
+            # r13: dead replicas are out of rotation entirely (abort
+            # emptied them); suspects still drain their own backlog —
+            # exclusion applies to NEW traffic in _route
             busy_idle = [r for r in reps
-                         if r.busy and r.engine._pending_seg is None]
+                         if r.health != "dead" and r.busy
+                         and r.engine._pending_seg is None]
             for r in busy_idle:
                 with _metrics.scoped_registry(r.registry):
                     h = r.engine.dispatch_segment(
                         self.seg_steps, prefix_cache=r.prefix_cache)
-                inflight.append((r, h))
+                inflight.append((r, h, time.perf_counter()))
             if not inflight:
                 if pending:
                     gap = pending[0].t - (time.perf_counter() - t0)
                     if gap > 0:
                         time.sleep(min(gap, 0.05))
+                elif any(r.health == "dead" for r in reps):
+                    time.sleep(0.001)       # wait out the probe window
                 continue
             # finish the oldest in-flight segment (its event fetch is
-            # the one audited allowed_sync for that segment)
-            r, h = inflight.pop(0)
-            with _metrics.scoped_registry(r.registry):
-                ev = r.engine.finish_segment(h)
-                t_sync = time.perf_counter()
-                self._stamp(r, ev, t_sync)
-            r.segments += 1
-            segments += 1
+            # the one audited allowed_sync for that segment) under the
+            # failure protocol: crash/hang/timeout drive the health
+            # state machine and failover
+            r, h, t_disp = inflight.pop(0)
+            if self._finish_one(r, h, t_disp):
+                segments += 1
         makespan = time.perf_counter() - t0
 
         reqs = [req for _, req in self._reqs.values()]
@@ -364,6 +499,10 @@ class FleetRouter:
                                     for r in reps),
             dispatches_least_loaded=sum(r.dispatches["least_loaded"]
                                         for r in reps),
+            failovers=self.failovers,
+            requeued=self.requeued,
+            replica_health={r.idx: r.health for r in reps},
+            retry_after_s=self.last_retry_after_s,
             per_replica=[{
                 "replica": r.idx,
                 "requests": len(r.rids),
@@ -371,6 +510,8 @@ class FleetRouter:
                               for rid in r.rids),
                 "segments": r.segments,
                 "ticks": r.engine.last_run_ticks,
+                "health": r.health,
+                "probes": r.probes,
                 "backpressure_events": r.backpressure_events,
                 "dispatches": dict(r.dispatches),
                 "prefix": (r.prefix_cache.stats()
@@ -379,6 +520,164 @@ class FleetRouter:
                           if r.engine.paged else None),
             } for r in reps],
         )
+
+    # --- failure protocol (r13, ISSUE 8c) --------------------------------
+    def retry_after_hint(self, now: float) -> float:
+        """Fleet-level backoff hint for a refused client — same rule as
+        ``OnlineScheduler.retry_after_hint`` (elapsed per finished
+        request, clamped to [1 ms, 60 s]; 1 s before any finish), fed
+        by the fleet-wide finish counter."""
+        if self._finished_count and now > 0:
+            return min(max(now / self._finished_count, 1e-3), 60.0)
+        return 1.0
+
+    def _finish_one(self, rep: _Replica, h, t_disp: float) -> bool:
+        """Fetch one dispatched segment under the failure protocol.
+        Returns True when the segment's results were applied; False when
+        the replica died and the segment was discarded (its requests
+        failed over inside ``_kill_replica``).
+
+        * ``ReplicaCrash`` (injected process death): immediately dead —
+          the event log in flight is lost, requests resume elsewhere
+          from their last FETCHED token.
+        * ``ReplicaHang``: suspect; the fetch is retried up to
+          ``max_finish_retries`` times (bounded-attempt retry — a
+          transient stall recovers, a wedge escalates to dead).
+        * real fetch slower than ``segment_timeout_s``: suspect on the
+          first, dead on the second consecutive timeout; a fast segment
+          clears suspect back to healthy. The slow segment's results
+          are still REAL (the fetch completed) and are applied either
+          way."""
+        attempts = 0
+        while True:
+            try:
+                if self.fault_injector is not None:
+                    self.fault_injector.on_finish(rep.idx, rep.segments)
+                with _metrics.scoped_registry(rep.registry):
+                    ev = rep.engine.finish_segment(h)
+                    t_sync = time.perf_counter()
+                    self._stamp(rep, ev, t_sync)
+                break
+            except ReplicaCrash as e:
+                self._kill_replica(rep, f"crash: {e}")
+                return False
+            except ReplicaHang as e:
+                attempts += 1
+                if rep.health == "healthy":
+                    rep.set_health("suspect")
+                    _flight.record("replica_suspect", replica=rep.idx,
+                                   reason="hang")
+                if attempts > self.max_finish_retries:
+                    self._kill_replica(
+                        rep, f"hang persisted through {attempts - 1} "
+                             f"retries: {e}")
+                    return False
+                _metrics.counter("fleet.finish_retries").inc()
+        rep.segments += 1
+        self._finished_count += len(ev["finished"])
+        if attempts and rep.health == "suspect":
+            # a retried fetch came back: the hang was transient
+            rep.set_health("healthy")
+            _flight.record("replica_recovered", replica=rep.idx,
+                           via="finish_retry")
+        elapsed = t_sync - t_disp
+        if (self.segment_timeout_s is not None
+                and elapsed > self.segment_timeout_s):
+            rep.timeouts += 1
+            if rep.timeouts >= 2:
+                self._kill_replica(
+                    rep, f"two consecutive segment timeouts "
+                         f"({elapsed:.3f}s > {self.segment_timeout_s}s)")
+                return True                 # this segment's tokens are real
+            rep.set_health("suspect")
+            _flight.record("replica_suspect", replica=rep.idx,
+                           reason="timeout", elapsed_s=round(elapsed, 4))
+        elif self.segment_timeout_s is not None:
+            rep.timeouts = 0
+            if rep.health == "suspect":
+                rep.set_health("healthy")
+                _flight.record("replica_recovered", replica=rep.idx,
+                               via="fast_segment")
+        return True
+
+    def _kill_replica(self, rep: _Replica, reason: str) -> None:
+        """Declare ``rep`` dead and fail its whole in-flight world over
+        to the survivors (the zero-loss contract): queued requests,
+        live slots, and the picked set of a dispatched-but-lost segment
+        all requeue onto the least-loaded healthy replica, each resuming
+        from its last FETCHED token — the already-replayed event log is
+        the request's durable state, and greedy decode regenerates the
+        identical continuation, so untouched requests (and in practice
+        migrated ones too) match the no-fault run token for token."""
+        rep.set_health("dead")
+        rep.timeouts = 0
+        rep.probes = 0
+        rep.dead_since = time.perf_counter()
+        self.failovers += 1
+        _metrics.counter("fleet.replica_deaths").inc()
+        _flight.record("replica_dead", replica=rep.idx, reason=reason)
+        orphans = rep.engine.abort()
+        if rep.prefix_cache is not None:
+            # cache page refs pin the dead pool; drop them so the reset
+            # pool audits clean for re-admission
+            rep.prefix_cache.reset()
+        if not orphans:
+            return
+        survivors = [x for x in self._replicas if x.health == "healthy"]
+        if not survivors:
+            raise RuntimeError(
+                f"replica {rep.idx} died with {len(orphans)} in-flight "
+                f"requests and no healthy survivor to requeue onto")
+        orphan_ids = {id(q) for q in orphans}
+        moved = sorted(((frid, req) for frid, (ridx, req)
+                        in self._reqs.items()
+                        if ridx == rep.idx and id(req) in orphan_ids),
+                       key=lambda t: t[0])
+        for frid, req in moved:
+            req.requeues += 1
+            if req.requeues > self.max_requeues:
+                raise RuntimeError(
+                    f"request {frid} exceeded {self.max_requeues} "
+                    f"failover requeues — replicas are dying faster "
+                    f"than the fleet can serve")
+            tgt = min(survivors, key=lambda x: (x.load, x.idx))
+            if len(req.prompt) + len(req.tokens) > max(tgt.engine.buckets):
+                # the grown resume prompt no longer fits an admit
+                # window: rewind and regenerate — greedy decode
+                # reproduces the identical stream from scratch
+                req.tokens = []
+            req.rid = tgt.engine._next_rid   # fresh engine-local rid
+            tgt.engine._next_rid += 1
+            tgt.engine._queue.append(req)
+            self._reqs[frid] = (tgt.idx, req)
+            tgt.rids.append(frid)
+            rep.rids.remove(frid)
+            self.requeued += 1
+            _metrics.counter("fleet.failover_requeued").inc()
+            _flight.record("failover_requeue", rid=frid, src=rep.idx,
+                           dst=tgt.idx, tokens_kept=len(req.tokens))
+
+    def _probe_dead(self) -> None:
+        """Re-admission probing: after ``probe_after_s`` a dead replica
+        is probed (through the injector when one is installed — models
+        asking the restarted process for a health check); success puts
+        it back in the healthy rotation, failure re-arms the backoff."""
+        for rep in self._replicas:
+            if rep.health != "dead":
+                continue
+            if time.perf_counter() - rep.dead_since < self.probe_after_s:
+                continue
+            rep.probes += 1
+            ok = (self.fault_injector.on_probe(rep.idx, rep.probes)
+                  if self.fault_injector is not None else True)
+            _metrics.counter("fleet.probes").inc()
+            if ok:
+                rep.timeouts = 0
+                rep.set_health("healthy")
+                _flight.record("replica_recovered", replica=rep.idx,
+                               via="probe", probes=rep.probes)
+            else:
+                rep.dead_since = time.perf_counter()
 
     def _stamp(self, r: _Replica, ev: dict, t_sync: float) -> None:
         """Per-request lifecycle stamping at the sync that surfaced each
@@ -391,6 +690,10 @@ class FleetRouter:
         m_qw = _metrics.histogram("serving.queue_wait_s")
         for erid in ev["first_tokens"]:
             req = by_erid[erid]
+            if req.first_token_time:
+                # a rewound failover request re-emits its first token;
+                # the client saw the original — the TTFT clock stands
+                continue
             req.first_token_time = t_sync
             m_ttft.observe(t_sync - req.arrival_time)
             m_qw.observe(req.admit_time - req.arrival_time)
@@ -426,7 +729,15 @@ class FleetRouter:
             r.dispatches = {"affinity": 0, "least_loaded": 0}
             r.segments = 0
             r.rids = []
+            r.health = "healthy"
+            r.timeouts = 0
+            r.probes = 0
+            r.dead_since = 0.0
         self.backpressure_events = 0
+        self.failovers = 0
+        self.requeued = 0
+        self.last_retry_after_s = None
+        self._finished_count = 0
         self._reqs.clear()
         self._next_rid = 0
 

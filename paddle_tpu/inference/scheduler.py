@@ -65,8 +65,8 @@ from ..profiler import _hooks
 from .prefix_cache import PrefixCache
 from .serving import Request, ServingEngine
 
-__all__ = ["Arrival", "OnlineScheduler", "poisson_arrivals",
-           "staggered_arrivals", "scale_rate"]
+__all__ = ["Arrival", "OnlineScheduler", "SLOScheduler",
+           "poisson_arrivals", "staggered_arrivals", "scale_rate"]
 
 
 @dataclass
@@ -74,6 +74,12 @@ class Arrival:
     t: float                  # seconds after serve() start
     prompt: np.ndarray        # [S] int32
     max_new_tokens: int
+    # r13 SLO-aware serving (ISSUE 8): smaller priority outranks larger
+    # (class 0 = interactive, class 1+ = batch); deadline_s is an e2e
+    # deadline RELATIVE to this request's arrival (None = never shed).
+    # Plain OnlineScheduler ignores both — SLOScheduler enforces them.
+    priority: int = 0
+    deadline_s: Optional[float] = None
 
 
 def poisson_arrivals(seed: int, n: int, rate: float, vocab: int,
@@ -151,6 +157,18 @@ class OnlineReport:
     backpressure_pages: int = 0
     pages: Optional[dict] = None
     prefix: Optional[dict] = None  # PrefixCache.stats() when enabled
+    # r13 SLO-aware serving: retry_after_s is the LAST machine-readable
+    # backpressure hint handed to a refused client (seconds until the
+    # bounded queue is expected to have drained one slot, derived from
+    # the measured finish rate — None when nothing was refused); the
+    # rest is the overload control plane's accounting, all zero/None
+    # under the plain scheduler.
+    retry_after_s: Optional[float] = None
+    preemptions: int = 0
+    shed: int = 0
+    shed_per_class: Optional[Dict[int, int]] = None
+    displaced: int = 0             # queue spots yielded to a higher class
+    per_class: Optional[Dict[int, dict]] = None  # class -> latency stats
     per_request: List[dict] = field(default_factory=list)
 
     def as_dict(self, with_requests: bool = False) -> dict:
@@ -182,8 +200,29 @@ class OnlineScheduler:
         self.prefix_cache = prefix_cache
         self.backpressure_events = 0
         self._reqs: Dict[int, Request] = {}
+        # r13: drain-rate bookkeeping for the retry_after_s backpressure
+        # hint (finished requests this serve / elapsed); the SLO
+        # subclass reuses it for deadline estimates
+        self.last_retry_after_s: Optional[float] = None
+        self._finished_count = 0
+        self._serve_t0 = 0.0
 
     # --- intake ----------------------------------------------------------
+    def retry_after_hint(self, now: float) -> float:
+        """Machine-readable backoff for a refused client (r13 satellite):
+        seconds until the bounded queue is expected to free one slot,
+        derived from the CURRENT drain rate (requests finished this
+        serve / elapsed). Before any finish the measured rate is
+        unknown and the hint falls back to one second — still a signal
+        to stop hammering the queue. Clamped to [1 ms, 60 s]."""
+        if self._finished_count and now > 0:
+            return min(max(now / self._finished_count, 1e-3), 60.0)
+        return 1.0
+
+    def _note_arrival(self, r: Request, a: Arrival) -> None:
+        """Per-request intake hook (the SLO subclass stamps priority /
+        deadline and reorders the queue here)."""
+
     def _ingest(self, pending: List[Arrival], now: float, t0: float) -> int:
         """Move due arrivals into the engine queue, honouring the bound.
         Returns how many were refused (left client-side) this poll."""
@@ -198,11 +237,16 @@ class OnlineScheduler:
             assert r.rid == rid
             r.arrival_time = t0 + a.t   # client-side timestamp
             self._reqs[rid] = r
+            self._note_arrival(r, a)
         if refused:
+            hint = self.retry_after_hint(now)
+            self.last_retry_after_s = hint
             self.backpressure_events += 1
             _metrics.counter("serving.backpressure_events").inc()
+            _metrics.gauge("serving.retry_after_s").set(hint)
             _flight.record("backpressure", refused=refused,
-                           queue=len(self.engine._queue))
+                           queue=len(self.engine._queue),
+                           retry_after_s=round(hint, 4))
         return refused
 
     # --- the serve loop --------------------------------------------------
@@ -229,6 +273,8 @@ class OnlineScheduler:
         eng.last_run_ticks = 0
         eng.last_run_chunks = 0
         segments = 0
+        self.last_retry_after_s = None
+        self._finished_count = 0
         # telemetry handles hoisted out of the loop (one dict lookup each,
         # paid once per serve, not per segment); all values recorded below
         # are host mirrors — the loop's only device contact stays the one
@@ -238,10 +284,15 @@ class OnlineScheduler:
         m_e2e = _metrics.histogram("serving.e2e_s")
         m_qwait = _metrics.histogram("serving.queue_wait_s")
         t0 = time.perf_counter()
+        self._serve_t0 = t0
         while pending or eng._queue or eng.free_slot_count() < eng.slots:
             now = time.perf_counter() - t0
             self._ingest(pending, now, t0)
             m_queue.set(len(eng._queue))
+            # r13 SLO hook: the subclass sheds unmeetable-deadline
+            # requests and preempts for blocked higher classes here —
+            # host bookkeeping between segments, zero device contact
+            self._pre_segment(now, t0)
             idle = (not eng._queue
                     and eng.free_slot_count() == eng.slots)
             if idle:
@@ -264,13 +315,16 @@ class OnlineScheduler:
                 r.first_token_time = t_sync
                 m_ttft.observe(t_sync - r.arrival_time)
                 m_qwait.observe(r.admit_time - r.arrival_time)
+                self._on_first_token(r, t_sync)
             for rid in ev["finished"]:
                 # the engine stamps finish during replay (marginally
                 # earlier); the sync is when the client can SEE the
                 # tokens, and keeps finish >= first_token by definition
                 r = self._reqs[rid]
                 r.finish_time = t_sync
+                self._finished_count += 1
                 m_e2e.observe(t_sync - r.arrival_time)
+                self._on_finish(r, t_sync)
                 _tracing.emit_request_trace(
                     rid, r.arrival_time, r.admit_time, r.first_token_time,
                     r.finish_time, prefix_hit_len=r.prefix_hit_len)
@@ -307,18 +361,301 @@ class OnlineScheduler:
             pages=eng.pager.stats() if eng.paged else None,
             prefix=(self.prefix_cache.stats()
                     if self.prefix_cache is not None else None),
+            retry_after_s=self.last_retry_after_s,
+            **self._report_extras(reqs),
             per_request=[{
                 "rid": r.rid,
                 "prompt_len": int(len(r.prompt)),
                 "gen_len": len(r.tokens),
                 "prefix_hit_len": r.prefix_hit_len,
+                "priority": r.priority,
+                "preemptions": r.preemptions,
                 "ttft_s": round(r.first_token_time - r.arrival_time, 4),
                 "e2e_s": round(r.finish_time - r.arrival_time, 4),
             } for r in reqs],
         )
+
+    # --- SLO hooks (no-ops here; SLOScheduler overrides) -----------------
+    def _pre_segment(self, now: float, t0: float) -> None:
+        pass
+
+    def _on_first_token(self, r: Request, t_sync: float) -> None:
+        pass
+
+    def _on_finish(self, r: Request, t_sync: float) -> None:
+        pass
+
+    def _report_extras(self, reqs) -> dict:
+        return {}
 
     def results(self) -> Dict[int, List[int]]:
         """rid -> generated tokens for every served request (truncated
         at max_new_tokens / first EOS, like ``ServingEngine.run``)."""
         self.engine.collect_finished()
         return {rid: r.tokens for rid, r in self._reqs.items()}
+
+
+class SLOScheduler(OnlineScheduler):
+    """``OnlineScheduler`` with the r13 overload control plane (ISSUE 8b):
+    priority classes, preempt-and-requeue, and deadline load-shedding.
+
+    * **Priority admission.** The intake queue is kept ordered by
+      (priority, engine rid) — class 0 ahead of class 1, FCFS within a
+      class — so the engine's FCFS segment pick IS priority scheduling.
+      A preempted request re-enters at the head of its class (it keeps
+      its original rid).
+    * **Preempt-and-requeue.** Before each segment, if the queue head
+      outranks a running request and admission is blocked (no free slot,
+      or — paged — not enough free pages), the lowest-priority running
+      slot is preempted via ``ServingEngine.preempt_slot``: its pages
+      are parked in the prefix cache by reference (or freed), the
+      request requeues with its generated prefix, and the eventual
+      resume is a page-ref bump + suffix prefill. Never same-class:
+      FCFS fairness holds within a priority level.
+    * **Deadline load-shedding.** A queued request whose e2e deadline is
+      already unmeetable — now plus a MEASURED minimum service estimate
+      (EWMA seconds/token from finished requests x tokens owed) exceeds
+      it — is shed instead of served late: removed from the queue,
+      counted per class, never billed into the latency percentiles.
+      The estimate deliberately excludes queueing (an underestimate),
+      so shedding only fires on requests that could not make it even
+      with an empty machine.
+
+    Per-class TTFT/e2e histograms land in ``request.ttft[class<p>]`` /
+    ``request.e2e[class<p>]``; shed/preempt counters in
+    ``scheduler.shed[class<p>]`` / ``scheduler.preemptions``. All of it
+    is host bookkeeping between segments — the audited one-fetch-per-
+    segment contract is untouched (tests/test_slo_serving.py pins it).
+    """
+
+    def __init__(self, engine: ServingEngine, max_queue: int = 64,
+                 seg_steps: int = 32,
+                 prefix_cache: Optional[PrefixCache] = None,
+                 preempt: bool = True, shed_deadlines: bool = True):
+        super().__init__(engine, max_queue=max_queue, seg_steps=seg_steps,
+                         prefix_cache=prefix_cache)
+        self.preempt = bool(preempt)
+        self.shed_deadlines = bool(shed_deadlines)
+        self.preemptions = 0
+        self.shed_count = 0
+        self.shed_per_class: Dict[int, int] = {}
+        self.shed_log: List[dict] = []
+        self.displaced = 0            # queue-level class displacements
+        self._arrivals: Dict[int, Arrival] = {}   # rid -> its Arrival
+        self._per_token_s = 0.0       # EWMA decode seconds/token
+
+    # --- class-ordered queue ---------------------------------------------
+    def _insert_by_class(self, r: Request) -> None:
+        """(Re)insert into the engine queue at its class position:
+        ordered by (priority, rid) — rid is assignment-ordered, so a
+        preempted request's ORIGINAL rid lands it ahead of everything
+        that arrived after it in the same class."""
+        q = self.engine._queue
+        key = (r.priority, r.rid)
+        lo = 0
+        while lo < len(q) and (q[lo].priority, q[lo].rid) < key:
+            lo += 1
+        q.insert(lo, r)
+
+    def _note_arrival(self, r: Request, a: Arrival) -> None:
+        r.priority = int(getattr(a, "priority", 0))
+        dls = getattr(a, "deadline_s", None)
+        r.deadline = r.arrival_time + dls if dls else 0.0
+        self._arrivals[r.rid] = a
+        # _ingest appended at the tail; move to the class position
+        assert self.engine._queue[-1] is r
+        self.engine._queue.pop()
+        self._insert_by_class(r)
+
+    def _ingest(self, pending: List[Arrival], now: float, t0: float) -> int:
+        """Class-aware admission control (the SLO twist on the bounded
+        queue): the base scheduler's intake is strictly FIFO — a refused
+        arrival blocks the whole client stream, so under overload a
+        high-priority request queues CLIENT-SIDE behind backpressured
+        batch traffic and its TTFT rides the overload it was supposed to
+        be insulated from. Here a full queue (1) refuses only the
+        arrival itself, not everything behind it (due arrivals are
+        scanned past a refusal), and (2) yields to a HIGHER class by
+        displacement: the worst queued request (lowest class, latest
+        rid) is bumped back client-side — it was only queued, so nothing
+        is lost and its deadline/arrival accounting carries over — and
+        the high-class arrival takes its place."""
+        refused = 0
+        i = 0
+        while i < len(pending) and pending[i].t <= now:
+            a = pending[i]
+            q = self.engine._queue
+            displaced_arrival = None
+            if len(q) >= self.max_queue:
+                victim = max(q, key=lambda r: (r.priority, r.rid))
+                if int(getattr(a, "priority", 0)) < victim.priority:
+                    q.remove(victim)
+                    del self._reqs[victim.rid]
+                    displaced_arrival = self._arrivals.pop(victim.rid)
+                    self.displaced += 1
+                    _metrics.counter("scheduler.displaced").inc()
+                    _flight.record("displaced", rid=victim.rid,
+                                   cls=victim.priority,
+                                   by_cls=int(getattr(a, "priority", 0)))
+                else:
+                    refused += 1
+                    i += 1
+                    continue
+            # admit ``a``: POP FIRST, reinsert the displaced arrival
+            # after — inserting before the pop shifts the index and a
+            # stale element gets popped (the arrival would then be
+            # admitted twice)
+            pending.pop(i)
+            if displaced_arrival is not None:
+                j = 0
+                while (j < len(pending)
+                       and pending[j].t <= displaced_arrival.t):
+                    j += 1
+                pending.insert(j, displaced_arrival)
+                if j <= i:
+                    i += 1     # keep scanning from the same arrival
+            rid = self.engine.add_request(a.prompt, a.max_new_tokens)
+            r = self.engine._queue[-1]
+            assert r.rid == rid
+            r.arrival_time = t0 + a.t
+            self._reqs[rid] = r
+            self._note_arrival(r, a)
+        if refused:
+            hint = self.retry_after_hint(now)
+            self.last_retry_after_s = hint
+            self.backpressure_events += 1
+            _metrics.counter("serving.backpressure_events").inc()
+            _metrics.gauge("serving.retry_after_s").set(hint)
+            _flight.record("backpressure", refused=refused,
+                           queue=len(self.engine._queue),
+                           retry_after_s=round(hint, 4))
+        return refused
+
+    # --- the control plane (runs between segments, host-only) -----------
+    def _pre_segment(self, now: float, t0: float) -> None:
+        if self.shed_deadlines:
+            self._shed_pass()
+        if self.preempt:
+            self._preempt_pass()
+
+    def _min_service_s(self, r: Request) -> float:
+        """Lower bound on time to FINISH ``r`` from a standing start:
+        tokens owed x the measured per-token EWMA (0.0 until the first
+        finish — before any measurement only an already-expired
+        deadline sheds)."""
+        return (r.max_new_tokens - len(r.tokens)) * self._per_token_s
+
+    def _shed_pass(self) -> None:
+        t_abs = time.perf_counter()
+        eng = self.engine
+        for r in [q for q in eng._queue if q.deadline]:
+            if t_abs + self._min_service_s(r) <= r.deadline:
+                continue
+            eng._queue.remove(r)
+            del self._reqs[r.rid]
+            self.shed_count += 1
+            self.shed_per_class[r.priority] = \
+                self.shed_per_class.get(r.priority, 0) + 1
+            self.shed_log.append({
+                "rid": r.rid, "priority": r.priority,
+                "late_by_s": round(
+                    t_abs + self._min_service_s(r) - r.deadline, 4),
+                "tokens_done": len(r.tokens)})
+            _metrics.counter("scheduler.shed").inc()
+            _metrics.counter(f"scheduler.shed[class{r.priority}]").inc()
+            _flight.record("shed", rid=r.rid, cls=r.priority,
+                           queue=len(eng._queue))
+
+    def _head_admissible(self, head: Request) -> bool:
+        """Could the queue head be admitted right now without evicting
+        anyone? Slots are the resource on a contiguous engine; pages on
+        a paged one (a conservative full-need check — prefix hits only
+        reduce it)."""
+        eng = self.engine
+        if eng.free_slot_count() == 0:
+            return False
+        if not eng.paged:
+            return True
+        fp, remaining = head.resume_view()
+        need = eng.pager.pages_needed(len(fp) + remaining - 1)
+        return need <= eng.pager.pages_free
+
+    def _preempt_pass(self) -> None:
+        eng = self.engine
+        if not eng._queue:
+            return
+        head = eng._queue[0]          # highest class, earliest rid
+        # victims: strictly LOWER class than the blocked head, worst
+        # class first, least progress first (least work discarded)
+        victims = sorted(
+            (s for s, r in enumerate(eng._active)
+             if r is not None and r.priority > head.priority),
+            key=lambda s: (-eng._active[s].priority,
+                           len(eng._active[s].tokens)))
+        for s in victims:
+            if self._head_admissible(head):
+                return
+            if not eng.can_preempt(s):
+                continue
+            victim = eng.preempt_slot(s, prefix_cache=self.prefix_cache)
+            self._insert_by_class(victim)
+            self.preemptions += 1
+            _metrics.counter("scheduler.preemptions").inc()
+
+    # --- per-class telemetry / report ------------------------------------
+    def _on_first_token(self, r: Request, t_sync: float) -> None:
+        _metrics.histogram(f"request.ttft[class{r.priority}]").observe(
+            t_sync - r.arrival_time)
+
+    def _on_finish(self, r: Request, t_sync: float) -> None:
+        _metrics.histogram(f"request.e2e[class{r.priority}]").observe(
+            t_sync - r.arrival_time)
+        if r.first_token_time and r.tokens:
+            per_tok = ((t_sync - r.admit_time) / len(r.tokens)
+                       if r.admit_time else 0.0)
+            if per_tok > 0:
+                self._per_token_s = (per_tok if not self._per_token_s
+                                     else 0.5 * self._per_token_s
+                                     + 0.5 * per_tok)
+
+    def _report_extras(self, reqs) -> dict:
+        per_class: Dict[int, dict] = {}
+        for p in sorted({r.priority for r in reqs}):
+            rs = [r for r in reqs if r.priority == p]
+            ttfts = [r.first_token_time - r.arrival_time for r in rs]
+            e2es = [r.finish_time - r.arrival_time for r in rs]
+            per_class[p] = {
+                "n": len(rs),
+                "ttft_p50_s": round(_pctl(ttfts, 0.50), 4),
+                "ttft_p99_s": round(_pctl(ttfts, 0.99), 4),
+                "e2e_p50_s": round(_pctl(e2es, 0.50), 4),
+                "e2e_p99_s": round(_pctl(e2es, 0.99), 4),
+                "preemptions": sum(r.preemptions for r in rs),
+                "shed": self.shed_per_class.get(p, 0),
+            }
+        return {"preemptions": self.preemptions,
+                "shed": self.shed_count,
+                "shed_per_class": dict(self.shed_per_class) or None,
+                "displaced": self.displaced,
+                "per_class": per_class or None}
+
+    def serve(self, arrivals: Sequence[Arrival],
+              warm: bool = False) -> OnlineReport:
+        if warm:
+            # the base warm pass resets engine/prefix state; the SLO
+            # counters must reset with it or the measured report counts
+            # warm-pass sheds/preemptions
+            self.serve(arrivals, warm=False)
+            self.engine.reset_slots()
+            self._reqs.clear()
+            self.backpressure_events = 0
+            if self.prefix_cache is not None:
+                self.prefix_cache.reset()
+            self.preemptions = 0
+            self.shed_count = 0
+            self.shed_per_class = {}
+            self.shed_log = []
+            self.displaced = 0
+            self._arrivals.clear()
+            return super().serve(arrivals, warm=False)
+        return super().serve(arrivals, warm=False)

@@ -99,6 +99,15 @@ class _PendingSegment:
     dev: tuple                     # (out, aq, aslot, step, qidx) futures
     pre_lens: object               # [n] reused-prefix rows per request
     req_pages: Optional[List[List[int]]] = None   # paged reservations
+    # r13: admission-time context per request. full_prompts[j] is the
+    # tokens the admit actually prefills — prompt + any tokens already
+    # generated before a preemption/failover requeue (the RESUME view);
+    # the prefix-cache population after the sync must harvest THIS
+    # span, not the original prompt. chunk_marker is the aq value the
+    # chunked program logs for a non-final prefill-chunk step (the host
+    # replay skips those steps — no decode happened on them).
+    full_prompts: Optional[List[np.ndarray]] = None
+    chunk_marker: Optional[int] = None
 
 
 @dataclass
@@ -118,10 +127,34 @@ class Request:
     admit_time: float = 0.0       # packed into a slot (prefill dispatched)
     first_token_time: float = 0.0  # first generated token host-visible
     prefix_hit_len: int = 0       # KV rows reused from the prefix cache
+    # r13 SLO-aware serving: smaller priority = more important (class 0
+    # outranks class 1); deadline is an ABSOLUTE perf_counter e2e
+    # deadline (0.0 = none — the request is never shed). preemptions /
+    # requeues count how often this request lost its slot (priority
+    # preemption) or its replica (fleet failover); generated tokens
+    # survive either — re-admission resumes from prompt + tokens.
+    priority: int = 0
+    deadline: float = 0.0
+    preemptions: int = 0
+    requeues: int = 0
 
     @property
     def done(self) -> bool:
         return len(self.tokens) >= self.max_new_tokens
+
+    def resume_view(self):
+        """(tokens to prefill, generations still owed) for admission.
+        Fresh requests prefill their prompt; a preempted / failed-over
+        request resumes from prompt + everything already generated —
+        greedy decode makes the continuation token-identical to an
+        uninterrupted run, and the concatenated view lets the prefix
+        cache serve the request's own harvested pages back to it (a
+        resume is then a page-ref bump + suffix prefill)."""
+        if not self.tokens:
+            return self.prompt, self.max_new_tokens
+        full = np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+        return full, self.max_new_tokens - len(self.tokens)
 
 
 # Process-wide compiled-program cache (r12): every program an engine
@@ -143,7 +176,9 @@ class ServingEngine:
                  prompt_buckets: Sequence[int] = (32, 64, 128, 256),
                  eos_token_id: Optional[int] = None,
                  paged: bool = False, page_size: int = 16,
-                 num_pages: Optional[int] = None, mesh=None):
+                 num_pages: Optional[int] = None, mesh=None,
+                 chunked_prefill: bool = False,
+                 prefill_chunks: Sequence[int] = (8, 16, 32, 64)):
         self.cfg = cfg
         self.params = params
         self.slots = int(slots)
@@ -182,6 +217,23 @@ class ServingEngine:
         self._next_rid = 0
         self.paged = bool(paged)
         self.page_backpressure_events = 0  # admissions deferred for pages
+        # r13 chunked prefill (ISSUE 8): split each admitted prompt into
+        # fixed-width chunks interleaved with decode ticks INSIDE the
+        # paged segment program, bounding time-between-tokens for
+        # co-resident decodes by one chunk's cost instead of a whole
+        # prefill. Chunk widths come from the small DECLARED ladder so
+        # program cache keys stay bucketed (a floating chunk width would
+        # be the 2.5 s mid-serve XLA-compile class all over again).
+        self.chunked = bool(chunked_prefill)
+        if self.chunked and not self.paged:
+            raise ValueError(
+                "chunked_prefill requires paged=True (chunks prefill at "
+                "a context offset through the page tables; the "
+                "contiguous admit branch stages whole windows)")
+        self.prefill_chunks = tuple(sorted(int(c) for c in prefill_chunks))
+        if self.chunked and not self.prefill_chunks:
+            raise ValueError("chunked_prefill needs a non-empty "
+                             "prefill_chunks ladder")
         if self.paged:
             # paged mode (r11, inference/paged_kv.py): ONE flat page pool
             # + per-slot page tables replace the [slots, max_len] block.
@@ -233,12 +285,14 @@ class ServingEngine:
     def cache_info(self) -> dict:
         """Compiled-program cache keys (analysis.recompile lint): admit
         programs key on (bucket, nb), segments on ("seg", n_pad, s_max,
-        pre_max, steps), paged segments on ("pseg", n_pad, s_max, steps)
-        — all bucketed by construction, so key-count growth here means a
-        shape leaked past the buckets (the 2.5 s mid-serve compile class
-        this engine's width pinning fixed). Note the PAGED key carries
-        no pre_max: shared-prefix geometry rides the page tables as
-        DATA, so prefix reuse adds zero program shapes."""
+        pre_max, steps), paged segments on ("pseg", n_pad, s_max, steps),
+        chunked paged segments on ("cseg", n_pad, s_max_c, C, steps) with
+        C drawn from the declared prefill_chunks ladder — all bucketed
+        by construction, so key-count growth here means a shape leaked
+        past the buckets (the 2.5 s mid-serve compile class this
+        engine's width pinning fixed). Note the PAGED keys carry no
+        pre_max: shared-prefix geometry rides the page tables as DATA,
+        so prefix reuse adds zero program shapes."""
         return {"name": f"serving_engine:slots{self.slots}",
                 "keys": list(self._progs.keys())}
 
@@ -787,7 +841,8 @@ class ServingEngine:
         return segment
 
     def _replay_segment(self, picked, toks, aq, aslot, steps: int, n: int,
-                        on_admit=None, on_retire=None):
+                        on_admit=None, on_retire=None,
+                        chunk_marker: Optional[int] = None):
         """Host replay of a segment's event log — ONE contract for the
         contiguous and paged engines: walk the log chronologically,
         tracking slot occupancy (admits rebind a slot; decode ticks
@@ -797,11 +852,16 @@ class ServingEngine:
         ``on_retire(req, slot)`` are the paged engine's page-table
         bookkeeping hooks, called in event order so a slot freed and
         re-admitted mid-segment releases the old occupant's pages
-        before the new page list installs."""
+        before the new page list installs. ``chunk_marker`` (chunked
+        prefill): aq values >= it mark NON-FINAL prefill-chunk steps —
+        no decode ran and no token surfaced there, so the replay skips
+        the step."""
         admitted, first_tokens, finished = [], [], []
         new_tokens = eos_stops = 0
         for st in range(steps):
             q = int(aq[st])
+            if chunk_marker is not None and q >= chunk_marker:
+                continue                   # mid-prefill chunk: no tokens
             if q < n:                      # admit event
                 r = picked[q]
                 s = int(aslot[st])
@@ -812,7 +872,11 @@ class ServingEngine:
                 r.tokens.append(t)
                 new_tokens += 1
                 admitted.append(r.rid)
-                first_tokens.append(r.rid)
+                if len(r.tokens) == 1:
+                    # a RESUMED request (preempt/failover) already
+                    # delivered its first token before losing its slot —
+                    # only a fresh admit opens the TTFT clock
+                    first_tokens.append(r.rid)
                 hit_eos = self.eos is not None and t == self.eos
                 eos_stops += hit_eos
                 if r.done or hit_eos:
@@ -823,7 +887,9 @@ class ServingEngine:
                         on_retire(r, s)
                 else:
                     self._active[s] = r
-                    self._rem_host[s] = r.max_new_tokens - 1
+                    # remaining = owed minus everything generated so far
+                    # (fresh: max_new - 1; resumed: the true tail)
+                    self._rem_host[s] = r.max_new_tokens - len(r.tokens)
             else:                          # decode tick
                 for s, r in enumerate(self._active):
                     if r is None or self._rem_host[s] <= 0:
@@ -885,6 +951,100 @@ class ServingEngine:
         self.page_backpressure_events = 0
         if self.paged:
             self.pager.reset()
+
+    # --- preemption / teardown (r13: the SLO control plane's hooks) -------
+    def can_preempt(self, slot: int) -> bool:
+        """Whether ``slot``'s occupant could be preempted AND later
+        resumed by this engine: the resume view (prompt + generated
+        tokens) must still fit the largest prompt bucket — a request
+        whose generation outgrew the admit window cannot re-prefill and
+        must be left to finish in place."""
+        r = self._active[slot]
+        return (r is not None
+                and len(r.prompt) + len(r.tokens) <= max(self.buckets))
+
+    def preempt_slot(self, slot: int, prefix_cache=None) -> Request:
+        """Evict ``slot``'s request between segments and return it for
+        requeueing — the priority-preemption primitive (ISSUE 8b). The
+        device sees one tiny scatter (rem[slot] = 0: the slot freezes
+        and, paged, its writes route to the trash page) and NO sync;
+        everything else is host bookkeeping:
+
+        * paged + ``prefix_cache``: the slot's page-aligned prefix
+          (prompt + tokens generated so far) is PARKED in the cache by
+          reference before the slot's refs release — harvest-by-
+          reference, zero KV row copies — so the resume admission is a
+          page-ref bump plus a suffix-only prefill of the unaligned
+          tail;
+        * paged without a cache: the pages free outright and resume
+          re-prefills (still token-identical — greedy);
+        * contiguous: the KV rows [0, aligned_len) are harvested into
+          the row-copy cache exactly like post-segment population.
+
+        The caller decides where the request re-enters the queue (the
+        SLO scheduler reinserts it at the head of its class)."""
+        assert self._pending_seg is None, \
+            "preempt with a dispatched segment in flight"
+        r = self._active[slot]
+        assert r is not None, f"preempt of empty slot {slot}"
+        # freeze on device: a dispatch, not a sync (the audit contract
+        # of the serve loop — one fetch per segment — is untouched)
+        self._rem = self._rem.at[slot].set(0)
+        self._rem_host[slot] = 0
+        self._active[slot] = None
+        r.preemptions += 1
+        fp, _ = r.resume_view()
+        if self.paged:
+            pgr = self.pager
+            if prefix_cache is not None:
+                plen_b = prefix_cache.round_down(len(fp))
+                if plen_b:
+                    prefix_cache.insert(
+                        fp[:plen_b],
+                        pgr.slot_pages[slot][:plen_b // self.page_size])
+            pgr.free_slot(slot)
+        elif prefix_cache is not None:
+            plen_b = prefix_cache.round_down(len(fp))
+            if plen_b:
+                prefix_cache.insert(fp[:plen_b],
+                                    self._cache["k"][:, slot, :plen_b],
+                                    self._cache["v"][:, slot, :plen_b])
+        _metrics.counter("serving.preemptions").inc()
+        _flight.record("preempt", rid=r.rid, slot=slot,
+                       tokens_done=len(r.tokens),
+                       remaining=r.max_new_tokens - len(r.tokens),
+                       parked=prefix_cache is not None)
+        return r
+
+    def abort(self) -> List[Request]:
+        """Tear the engine down after a replica failure (fleet failover,
+        ISSUE 8c) and return every request it still owed: the queue, the
+        live slots, and anything an in-flight (dispatched, never
+        fetched) segment had picked — that segment's event log is LOST,
+        but its requests' host state never advanced, so each resumes
+        elsewhere from its last fetched token (greedy decode keeps the
+        stream identical). Slot vectors and the page pool reset so a
+        recovered replica re-enters service empty."""
+        orphans: List[Request] = []
+        p, self._pending_seg = self._pending_seg, None
+        if p is not None:
+            if p.paged:
+                for pages in p.req_pages:
+                    self.pager.release_pages(pages)
+            for r in p.picked:
+                r.admit_time = 0.0
+            orphans += p.picked
+        orphans += [r for r in self._active if r is not None]
+        orphans += self._queue
+        self._queue = []
+        self._active = [None] * self.slots
+        self._rem_host = [0] * self.slots
+        self._pos = self._slot_vec()
+        self._nxt = self._slot_vec()
+        self._rem = self._slot_vec()
+        if self.paged:
+            self.pager.reset()
+        return orphans
 
     def run_segment(self, max_steps: int, prefix_cache=None,
                     n_pad: Optional[int] = None,
@@ -958,14 +1118,20 @@ class ServingEngine:
         del self._queue[:len(picked)]
         n = len(picked)
 
+        # admission view (r13): a fresh request prefills its prompt, a
+        # preempted/failed-over one resumes from prompt + generated
+        # tokens and owes only the tail
+        fulls = [r.resume_view() for r in picked]
+
         # prefix-cache lookup (admission-time detection): per request the
         # longest cached block-aligned prefix; suffix = the rest
         pre_lens = np.zeros((n_pad,), np.int32)
         pre_entries = [None] * n
         if prefix_cache is not None:
             for j, r in enumerate(picked):
-                ent = prefix_cache.match(r.prompt)
-                if ent is not None and ent.length < len(r.prompt):
+                fp = fulls[j][0]
+                ent = prefix_cache.match(fp)
+                if ent is not None and ent.length < len(fp):
                     pre_entries[j] = ent
                     pre_lens[j] = ent.length
                     r.prefix_hit_len = ent.length
@@ -985,8 +1151,8 @@ class ServingEngine:
         if prefix_cache is None or pre_max == 0:
             s_max = self.buckets[-1]
         else:
-            suf_max = max((len(r.prompt) - int(pre_lens[j])
-                           for j, r in enumerate(picked)), default=1)
+            suf_max = max((len(fulls[j][0]) - int(pre_lens[j])
+                           for j in range(n)), default=1)
             s_max = self._bucket_for(suf_max)
         if pre_max and pre_max + s_max > self.max_len:
             # prefix + suffix window must fit the cache; drop the hits
@@ -1001,10 +1167,11 @@ class ServingEngine:
         lens = np.ones((n_pad,), np.int32)
         gens = np.zeros((n_pad,), np.int32)   # gen 0 -> never admitted
         for j, r in enumerate(picked):
-            suf = r.prompt[int(pre_lens[j]):]
+            fp, remaining = fulls[j]
+            suf = fp[int(pre_lens[j]):]
             prompts[j, :len(suf)] = suf
             lens[j] = len(suf)
-            gens[j] = r.max_new_tokens
+            gens[j] = remaining
             r.admit_time = now
         if pre_max:
             L = self.cfg.num_layers
@@ -1031,7 +1198,8 @@ class ServingEngine:
         self._cache, self._pos, self._nxt, self._rem = out[:4]
         return _PendingSegment(paged=False, picked=picked, n=n, now=now,
                                prefix_cache=prefix_cache, dev=out[4:],
-                               pre_lens=pre_lens)
+                               pre_lens=pre_lens,
+                               full_prompts=[f for f, _ in fulls])
 
     def _finish_segment_dense(self, p: _PendingSegment) -> dict:
         picked, n, prefix_cache, pre_lens = (p.picked, p.n, p.prefix_cache,
@@ -1066,11 +1234,11 @@ class ServingEngine:
                 if q < n:
                     last_admit[int(aslot[st])] = q
             for s, q in last_admit.items():
-                r = picked[q]
-                plen_b = prefix_cache.round_down(len(r.prompt))
+                fp = p.full_prompts[q]     # the span actually prefilled
+                plen_b = prefix_cache.round_down(len(fp))
                 if plen_b > int(pre_lens[q]):
                     prefix_cache.insert(
-                        r.prompt[:plen_b],
+                        fp[:plen_b],
                         self._cache["k"][:, s, :plen_b],
                         self._cache["v"][:, s, :plen_b])
 
@@ -1189,6 +1357,187 @@ class ServingEngine:
 
         return segment
 
+    # --- chunked prefill (r13: bounded time-between-tokens) ----------------
+    _MAX_PREFILL_CHUNKS = 4
+
+    def _prefill_chunk_for(self, s_max: int) -> int:
+        """Chunk width for a segment whose admit window is ``s_max``
+        wide: the smallest ladder entry that bounds a full-width prefill
+        at ``_MAX_PREFILL_CHUNKS`` chunk steps — short windows get tight
+        time-between-tokens, long ones a bounded step count, and every
+        width is DECLARED (a finite ("cseg", ..) program-key family;
+        a floating chunk width would re-open the mid-serve-compile
+        hazard the bucket pinning closed). The cap matters for
+        ADMISSION throughput too: a prefill may only start while
+        2 x chunks steps remain in the segment budget, so a finer
+        ladder narrows the start window and long prompts begin to
+        monopolize segment heads (measured on the overload lane —
+        8-chunk prefills throttled admission to one start per
+        segment)."""
+        for c in self.prefill_chunks:
+            if c * self._MAX_PREFILL_CHUNKS >= s_max:
+                return c
+        return self.prefill_chunks[-1]
+
+    def _chunked_segment_prog(self, n_pad: int, s_max_c: int, C: int,
+                              max_steps: int):
+        """``_paged_segment_prog`` with the admit branch split into
+        ``C``-token prefill chunks INTERLEAVED with decode ticks: a
+        long prompt no longer stalls every co-resident decode for its
+        whole prefill — between consecutive chunks the running slots
+        each emit a token, so time-between-tokens is bounded by ONE
+        chunk's cost (the ISSUE 8 TTFT-p99-spike fix; ROADMAP item 4).
+        Same pool/page-table state, same event log, same single fetch:
+
+        * in-program prefill PROGRESS state (``pf``/``pfq``/``pfo``): at
+          most one slot is mid-prefill; each chunk step prefills tokens
+          [pfo, pfo+C) of its suffix at context offset pre_len+pfo —
+          exactly the q_len>1 page-indirect path the unified kernel
+          already serves (``llama.forward_with_pages``), so no new
+          kernel work exists here, only scheduling;
+        * the FINAL chunk samples the first token and emits the admit
+          event; non-final chunk steps log ``aq = n_pad + 1`` (the
+          chunk marker) and the host replay skips them — the replay
+          contract is unchanged;
+        * a prefill only STARTS if its 2*ceil(len/C) worst-case step
+          cost fits the remaining budget, so a segment never ends with
+          a half-prefilled slot (no cross-segment prefill state to
+          carry; un-started requests requeue exactly as before);
+        * ``phase`` alternates chunk/decode steps while anything is
+          live, and chunks run back-to-back when nothing is decoding
+          (nobody is waiting on a token, so interleaving would only
+          add latency).
+
+        ``s_max_c`` is the admit window rounded up to a chunk multiple
+        (slices never clamp); memo key ("cseg", n_pad, s_max_c, C,
+        max_steps) with C from the declared ladder."""
+        if s_max_c % C:
+            raise ValueError(f"admit window {s_max_c} is not a multiple "
+                             f"of the prefill chunk {C}")
+        key = ("cseg", n_pad, s_max_c, C, max_steps)
+        return self._memo_prog(key, lambda: self._build_chunked_segment_prog(
+            n_pad, s_max_c, C, max_steps))
+
+    def _build_chunked_segment_prog(self, n_pad: int, s_max_c: int, C: int,
+                                    max_steps: int):
+        cfg, slots, eos = self.cfg, self.slots, self.eos
+        max_pages = self.pager.max_pages
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def segment(params, pool, ptab, pos, nxt, rem, prompts, lens,
+                    gens, pre_lens, req_tables, n_real):
+            i32 = jnp.int32
+            st = dict(
+                pool=pool, pt=ptab, pos=pos, nxt=nxt, rem=rem,
+                out=jnp.zeros((max_steps, slots), i32),
+                aq=jnp.full((max_steps,), n_pad, i32),    # n_pad = decode
+                aslot=jnp.zeros((max_steps,), i32),
+                pf=i32(-1),      # slot mid-prefill (-1 = none)
+                pfq=i32(0),      # its queue row
+                pfo=i32(0),      # suffix tokens already prefilled
+                phase=i32(0),    # 1 = just chunked -> decode next
+                qidx=i32(0), step=i32(0),
+            )
+
+            def _startable(st):
+                # a new prefill may begin only if its worst-case step
+                # cost (chunks + interleaved decodes) fits the budget
+                ln = lens[jnp.minimum(st["qidx"], n_pad - 1)]
+                chunks = (ln + C - 1) // C
+                return ((st["qidx"] < n_real)
+                        & (st["step"] + 2 * chunks <= max_steps))
+
+            def cond(st):
+                work = (jnp.any(st["rem"] > 0) | (st["pf"] >= 0)
+                        | _startable(st))
+                return work & (st["step"] < max_steps)
+
+            def chunk(st):
+                starting = st["pf"] < 0
+                s = jnp.where(starting,
+                              jnp.argmin(st["rem"]).astype(jnp.int32),
+                              st["pf"])
+                q = jnp.where(starting, st["qidx"], st["pfq"])
+                off = jnp.where(starting, 0, st["pfo"])
+                row = jax.lax.dynamic_slice(req_tables, (q, 0),
+                                            (1, max_pages))
+                # installing the table row is idempotent across chunks
+                pt = st["pt"].at[s].set(row[0])
+                ln = lens[q]
+                pln = pre_lens[q]
+                ctok = jax.lax.dynamic_slice(prompts, (q, off), (1, C))
+                # one C-token prefill chunk at context offset pln+off —
+                # queries attend the shared prefix AND earlier chunks in
+                # place through the page table, so chunked == one-shot
+                # prefill mathematically (token-parity-tested)
+                logits, pool = llama.forward_with_pages(
+                    params, ctok, cfg, st["pool"], row,
+                    jnp.reshape(pln + off, (1,)),
+                    logit_pos=jnp.minimum(ln - 1 - off, C - 1))
+                done = off + C >= ln
+                t0 = jnp.argmax(logits, axis=-1).astype(i32).reshape(())
+                rem_new = gens[q] - 1
+                if eos is not None:
+                    rem_new = jnp.where(t0 == eos, 0, rem_new)
+                return dict(
+                    pool=pool, pt=pt,
+                    pos=jnp.where(done, st["pos"].at[s].set(pln + ln),
+                                  st["pos"]),
+                    nxt=jnp.where(done, st["nxt"].at[s].set(t0),
+                                  st["nxt"]),
+                    rem=jnp.where(done, st["rem"].at[s].set(rem_new),
+                                  st["rem"]),
+                    out=jnp.where(done,
+                                  st["out"].at[st["step"], s].set(t0),
+                                  st["out"]),
+                    aq=st["aq"].at[st["step"]].set(
+                        jnp.where(done, q, i32(n_pad + 1))),
+                    aslot=st["aslot"].at[st["step"]].set(s),
+                    pf=jnp.where(done, i32(-1), s),
+                    pfq=q, pfo=off + C, phase=i32(1),
+                    qidx=jnp.where(starting, st["qidx"] + 1, st["qidx"]),
+                    step=st["step"],
+                )
+
+            def decode(st):
+                live = st["rem"] > 0
+                logits, pool = llama.forward_with_pages(
+                    params, st["nxt"][:, None], cfg, st["pool"],
+                    st["pt"], st["pos"], live=live)
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                tok = jnp.where(live, tok, st["nxt"])
+                rem = st["rem"] - live.astype(jnp.int32)
+                if eos is not None:
+                    rem = jnp.where(live & (tok == eos), 0, rem)
+                return dict(
+                    pool=pool, pt=st["pt"],
+                    pos=st["pos"] + live.astype(jnp.int32),
+                    nxt=tok, rem=rem,
+                    out=st["out"].at[st["step"]].set(tok),
+                    aq=st["aq"], aslot=st["aslot"],
+                    pf=st["pf"], pfq=st["pfq"], pfo=st["pfo"],
+                    phase=i32(0),
+                    qidx=st["qidx"], step=st["step"],
+                )
+
+            def body(st):
+                live_any = jnp.any(st["rem"] > 0)
+                pf_active = st["pf"] >= 0
+                can_start = ((~pf_active) & jnp.any(st["rem"] == 0)
+                             & _startable(st))
+                do_chunk = ((pf_active | can_start)
+                            & ((st["phase"] == 0) | ~live_any))
+                st = jax.lax.cond(do_chunk, chunk, decode, st)
+                st["step"] = st["step"] + 1
+                return st
+
+            st = jax.lax.while_loop(cond, body, st)
+            return (st["pool"], st["pt"], st["pos"], st["nxt"], st["rem"],
+                    st["out"], st["aq"], st["aslot"], st["step"],
+                    st["qidx"])
+
+        return segment
+
     def _dispatch_segment_paged(self, max_steps: int, prefix_cache,
                                 n_pad: int, now: float) -> _PendingSegment:
         """The paged ``run_segment``: pick FCFS gated on PAGES FREE
@@ -1204,18 +1553,20 @@ class ServingEngine:
         pgr = self.pager
         psz = self.page_size
         picked: List[Request] = []
+        fulls: List[np.ndarray] = []      # admission (resume) views
         req_pages: List[List[int]] = []
         pre_lens_l: List[int] = []
         tables: List[np.ndarray] = []
         deferred = 0
         while self._queue and len(picked) < n_pad:
             r = self._queue[0]
-            rows = len(r.prompt) + r.max_new_tokens - 1
+            fp, remaining = r.resume_view()
+            rows = len(fp) + remaining - 1
             total = pgr.pages_needed(rows)
             hit_pages: List[int] = []
             hit_len = 0
             if prefix_cache is not None:
-                m = prefix_cache.match(r.prompt)
+                m = prefix_cache.match(fp)
                 if m is not None:
                     hit_pages, hit_len = list(m.pages), m.length
             need_new = total - len(hit_pages)
@@ -1253,6 +1604,7 @@ class ServingEngine:
             r.prefix_hit_len = hit_len
             r.admit_time = now
             picked.append(r)
+            fulls.append(fp)
             req_pages.append(pages)
             pre_lens_l.append(hit_len)
             tables.append(row)
@@ -1269,9 +1621,22 @@ class ServingEngine:
         if prefix_cache is None or not any(pre_lens_l):
             s_max = self.buckets[-1]
         else:
-            suf_max = max((len(r.prompt) - pre_lens_l[j]
-                           for j, r in enumerate(picked)), default=1)
+            suf_max = max((len(fulls[j]) - pre_lens_l[j]
+                           for j in range(n)), default=1)
             s_max = self._bucket_for(suf_max)
+
+        chunk_marker = None
+        if self.chunked:
+            C = self._prefill_chunk_for(s_max)
+            s_max = -(-s_max // C) * C        # chunk-aligned admit window
+            worst = 2 * (s_max // C)
+            if max_steps < worst:
+                raise ValueError(
+                    f"seg_steps {max_steps} cannot fit one chunked "
+                    f"prefill ({s_max // C} chunks x {C} interleaved = "
+                    f"{worst} steps) — raise seg_steps or shrink the "
+                    f"prompt buckets / chunk ladder")
+            chunk_marker = n_pad + 1
 
         prompts = np.zeros((n_pad, s_max), np.int32)
         lens = np.ones((n_pad,), np.int32)
@@ -1279,15 +1644,18 @@ class ServingEngine:
         pre_lens = np.zeros((n_pad,), np.int32)
         req_tables = np.zeros((n_pad, pgr.max_pages), np.int32)
         for j, r in enumerate(picked):
-            suf = r.prompt[pre_lens_l[j]:]
+            suf = fulls[j][pre_lens_l[j]:]
             prompts[j, :len(suf)] = suf
             lens[j] = len(suf)
-            gens[j] = r.max_new_tokens
+            gens[j] = r.max_new_tokens - len(r.tokens)
             pre_lens[j] = pre_lens_l[j]
             req_tables[j] = tables[j]
 
+        prog = (self._chunked_segment_prog(n_pad, s_max, C, max_steps)
+                if self.chunked
+                else self._paged_segment_prog(n_pad, s_max, max_steps))
         with _mesh_scope(self.mesh):
-            out = self._paged_segment_prog(n_pad, s_max, max_steps)(
+            out = prog(
                 self.params, pgr.pool, pgr.page_table, self._pos, self._nxt,
                 self._rem, jnp.asarray(prompts), jnp.asarray(lens),
                 jnp.asarray(gens), jnp.asarray(pre_lens),
@@ -1296,7 +1664,9 @@ class ServingEngine:
         self._pos, self._nxt, self._rem = out[2:5]
         return _PendingSegment(paged=True, picked=picked, n=n, now=now,
                                prefix_cache=prefix_cache, dev=out[5:],
-                               pre_lens=pre_lens_l, req_pages=req_pages)
+                               pre_lens=pre_lens_l, req_pages=req_pages,
+                               full_prompts=fulls,
+                               chunk_marker=chunk_marker)
 
     def _finish_segment_paged(self, p: _PendingSegment) -> dict:
         picked, n, prefix_cache = p.picked, p.n, p.prefix_cache
@@ -1326,7 +1696,13 @@ class ServingEngine:
 
         admitted, first_tokens, finished, new_tokens, eos_stops = \
             self._replay_segment(picked, toks, aq, aslot, steps, n,
-                                 on_admit, on_retire)
+                                 on_admit, on_retire,
+                                 chunk_marker=p.chunk_marker)
+        if p.chunk_marker is not None:
+            chunk_steps = int(np.sum(np.asarray(aq[:steps])
+                                     >= p.chunk_marker))
+            if chunk_steps:
+                _metrics.counter("serving.prefill_chunks").inc(chunk_steps)
         if qadm < n:
             # step budget ran out before every picked request found a
             # slot: release the reservations and requeue FCFS
@@ -1345,10 +1721,10 @@ class ServingEngine:
                 if q < n:
                     last_admit[int(aslot[st])] = q
             for s, q in last_admit.items():
-                r = picked[q]
-                plen_b = prefix_cache.round_down(len(r.prompt))
+                fp = p.full_prompts[q]     # the span actually prefilled
+                plen_b = prefix_cache.round_down(len(fp))
                 if plen_b > pre_lens_l[q]:
-                    prefix_cache.insert(r.prompt[:plen_b],
+                    prefix_cache.insert(fp[:plen_b],
                                         req_pages[q][:plen_b // psz])
         for pages in pending_frees:
             pgr.release_pages(pages)

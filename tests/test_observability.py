@@ -624,6 +624,80 @@ class TestTelemetryAudit:
         assert set(rep_on.metrics["host_syncs_allowed"]) == {
             "serving.segment_event_fetch"}
 
+    def test_slo_failover_budgets_identical_with_telemetry(self,
+                                                           tiny_llama):
+        """r13 satellite: the OVERLOAD/FAILOVER loops — chunked
+        prefill, priority preemption + shed counters, per-class
+        histograms, fleet health gauges, failover flight events — add
+        ZERO device contacts: sync metrics over an SLO serve with a
+        preemption + shed AND a fleet serve with a replica kill are
+        bit-identical with telemetry on vs off, and the only allowed
+        label stays the per-segment event fetch."""
+        import numpy as np
+
+        from paddle_tpu.analysis import auditor
+        from paddle_tpu.inference.fleet import (FaultInjector,
+                                                FleetRouter, build_fleet)
+        from paddle_tpu.inference.prefix_cache import PagedPrefixCache
+        from paddle_tpu.inference.scheduler import Arrival, SLOScheduler
+        from paddle_tpu.inference.serving import ServingEngine
+        from paddle_tpu.parallel import set_mesh
+
+        set_mesh(None)
+        cfg, params = tiny_llama
+        rng = np.random.RandomState(5)
+        slo_arr = ([Arrival(0.0, rng.randint(0, cfg.vocab_size, (8,))
+                            .astype(np.int32), 24, priority=1)
+                    for _ in range(3)]
+                   + [Arrival(0.001, rng.randint(0, cfg.vocab_size, (8,))
+                              .astype(np.int32), 4, priority=0),
+                      Arrival(0.001, rng.randint(0, cfg.vocab_size, (8,))
+                              .astype(np.int32), 4, priority=1,
+                              deadline_s=-0.001)])
+        fleet_arr = [Arrival(0.0, rng.randint(0, cfg.vocab_size, (8,))
+                             .astype(np.int32), 6) for _ in range(6)]
+        eng = ServingEngine(cfg, params, slots=2, max_len=96,
+                            prompt_buckets=(8, 16, 32), paged=True,
+                            page_size=16, chunked_prefill=True,
+                            prefill_chunks=(8,))
+        pc = PagedPrefixCache(eng.pager, capacity_pages=32)
+        sch = SLOScheduler(eng, max_queue=8, seg_steps=16,
+                           prefix_cache=pc)
+        fleet = FleetRouter(build_fleet(cfg, params, 2, slots=2,
+                                        max_len=96,
+                                        prompt_buckets=(8, 16, 32),
+                                        paged=True, page_size=16),
+                            max_queue=16, seg_steps=8,
+                            probe_after_s=60.0)
+
+        def replay():
+            sch.serve(slo_arr)
+            eng.reset_slots()
+            pc.reset()
+            sch._reqs.clear()
+            fleet.fault_injector = FaultInjector(crash={1: 1})
+            rep = fleet.serve(fleet_arr)
+            assert rep.failovers == 1
+            fleet.reset()
+            return rep
+
+        def audit(enabled):
+            prev = metrics.set_enabled(enabled)
+            try:
+                return auditor.audit_replay("slo_failover_serve", replay,
+                                            replays=2)
+            finally:
+                metrics.set_enabled(prev)
+
+        rep_on, rep_off = audit(True), audit(False)
+        for key in ("host_syncs_flagged", "host_syncs_allowed",
+                    "warm_compiles"):
+            assert rep_on.metrics[key] == rep_off.metrics[key], (
+                key, rep_on.metrics[key], rep_off.metrics[key])
+        assert rep_on.metrics["host_syncs_flagged"] == 0
+        assert set(rep_on.metrics["host_syncs_allowed"]) == {
+            "serving.segment_event_fetch"}
+
 
 class TestOverheadGate:
     def test_online_serve_overhead_within_2pct(self, tiny_serving):

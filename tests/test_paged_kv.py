@@ -488,6 +488,58 @@ class TestPagedPrefixCacheUnit:
 # ---------------------------------------------------------------------------
 
 
+class TestPreemptFailoverLeakGuard:
+    def test_randomized_preempt_resume_kill_schedule(self, tiny):
+        """r13 satellite: after ANY preempt / requeue / failover cycle
+        the pool must return to the free-list invariant. A seeded random
+        schedule interleaves admissions, serving segments, priority
+        preemptions (with and without prefix-cache parking), and
+        full-engine aborts (the failover teardown); the allocator
+        invariant holds at every step and everything drains clean."""
+        cfg, params = tiny
+        eng = ServingEngine(cfg, params, slots=2, max_len=96,
+                            prompt_buckets=(8, 16, 32), paged=True,
+                            page_size=16, chunked_prefill=True,
+                            prefill_chunks=(8,))
+        pc = PagedPrefixCache(eng.pager, capacity_pages=16)
+        rng = np.random.RandomState(3)
+        for step in range(40):
+            op = rng.randint(4)
+            if op == 0 and len(eng._queue) < 4:          # admit
+                eng.add_request(
+                    rng.randint(0, cfg.vocab_size,
+                                (int(rng.randint(4, 20)),)).astype(
+                                    np.int32),
+                    int(rng.randint(2, 10)))
+            elif op == 1 and (eng._queue
+                              or eng.free_slot_count() < eng.slots):
+                eng.run_segment(16, prefix_cache=pc)     # serve a bit
+            elif op == 2:                                # preempt+requeue
+                live = [s for s in range(eng.slots)
+                        if eng._active[s] is not None
+                        and eng.can_preempt(s)]
+                if live:
+                    s = live[int(rng.randint(len(live)))]
+                    park = pc if rng.randint(2) else None
+                    r = eng.preempt_slot(s, prefix_cache=park)
+                    eng._queue.insert(0, r)
+                else:
+                    continue
+            elif op == 3 and rng.rand() < 0.15:          # replica kill
+                orphans = eng.abort()
+                pc.reset()                               # failover path
+                for r in orphans:                        # requeue all
+                    eng._queue.append(r)
+            assert eng.pager.allocator.check() == [], \
+                f"allocator invariant broke at step {step}"
+        while eng._queue or eng.free_slot_count() < eng.slots:
+            eng.run_segment(16, prefix_cache=pc)
+        for r in eng._finished:
+            assert r.done
+        pc.clear()
+        assert eng.pager.leak_report() == []
+
+
 class TestPagedSchedulerAudit:
     def test_online_serve_loop_syncs(self, tiny):
         """The paged serve loop keeps the r7/r9 contract: exactly ONE
