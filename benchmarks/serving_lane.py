@@ -30,6 +30,13 @@ Writes ``SERVING_r<N>.json`` at the repo root:
               mid-serve — zero lost requests, token identity vs the
               no-fault run, re-admission probing...},
               (r13: SLO-aware serving under overload and failure)
+   "slo": {...llama_serving --slo json: the live ops surface on the
+              overload trace — error-budget burn-rate alerting (zero
+              alerts at 1x, a page alert before the first shed at 4x),
+              explained perf (live roofline_fraction within 10% of the
+              SCALING §3c model), cold-start→first-token for N=1 and
+              fleet N=2, one literal OpsServer scrape...},
+              (r14: SLO monitor & operator scrape endpoint)
    "telemetry_headlines": {...r10 runtime-telemetry headlines per mode —
               queue depth / slot occupancy / prefix hit rate /
               backpressure counters from paddle_tpu.observability; the
@@ -102,6 +109,9 @@ def main() -> int:
         # priorities/preemption/shedding, and the replica-kill run
         "overload": _run_json("llama_serving.py", args=("--overload",)),
         "failover": _run_json("llama_serving.py", args=("--failover",)),
+        # r14 (ISSUE 9): the live ops surface — burn-rate alerting,
+        # explained perf, cold start, one operator scrape
+        "slo": _run_json("llama_serving.py", args=("--slo",)),
     }
     result["platform"] = result["online"].get("platform", "unknown")
     # r10: lift each mode's runtime-telemetry headline (queue depth,
@@ -111,14 +121,30 @@ def main() -> int:
     result["telemetry_headlines"] = {
         k: (result[k].get("telemetry") or {}).get("headline")
         for k in ("online", "prefix", "paged", "fleet", "overload",
-                  "failover")}
+                  "failover", "slo")}
+    # r14: lift the SLO headline — the alert/explained-perf/cold-start
+    # bars an operator (or the next round's reviewer) checks first
+    slo = result["slo"]
+    result["slo_headline"] = {
+        "zero_alerts_at_1x": (slo.get("compliant_1x") or {}).get(
+            "zero_alerts"),
+        "page_fired_at_4x": (slo.get("overload_4x") or {}).get(
+            "page_fired"),
+        "page_before_first_shed": (slo.get("overload_4x") or {}).get(
+            "page_before_first_shed"),
+        "roofline_fraction_within_10pct": (slo.get("explained_perf")
+                                           or {}).get("within_10pct"),
+        "cold_start_n1_s": (slo.get("cold_start") or {}).get("n1_s"),
+        "cold_start_fleet_worst_s": (slo.get("cold_start") or {}).get(
+            "fleet_worst_s"),
+    }
     path = os.path.join(ROOT, f"SERVING_r{rnd:02d}.json")
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
     ok = all(result[k].get("rc") == 0
              for k in ("decode", "serving", "online", "prefix", "paged",
-                       "fleet", "overload", "failover"))
+                       "fleet", "overload", "failover", "slo"))
     return 0 if ok else 1
 
 

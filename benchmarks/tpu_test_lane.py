@@ -58,6 +58,11 @@ TPU_TEST_FILES = [
     # parity through the REAL unified kernel, priority preemption /
     # resume identity, deadline shedding, fleet kill/recover
     "tests/test_slo_serving.py",
+    # r14 (ISSUE 9): the SLO monitor & live ops surface — burn-rate
+    # alert rules, exporter round-trips on loopback, explained-perf
+    # ledger parity, the regression sentinel, cold-start stamping, and
+    # the monitored-serve sync audit, all against the real backend
+    "tests/test_slo_monitor.py",
 ]
 
 

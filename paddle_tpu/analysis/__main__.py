@@ -12,6 +12,14 @@ Usage::
                                                   # r10 contract: budgets
                                                   # identical with the
                                                   # observability layer on
+    python -m paddle_tpu.analysis --gate --ops on # (default) the r14
+                                                  # contract: SLO monitor +
+                                                  # perf monitor + ops
+                                                  # exporter ATTACHED
+                                                  # (segment hooks + a live
+                                                  # loopback scrape server),
+                                                  # budgets bit-identical
+                                                  # to monitor-off
 """
 
 from __future__ import annotations
@@ -19,6 +27,57 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+
+def _attach_ops():
+    """Attach the r14 live-ops surface for the duration of the audit:
+    an SLO monitor + explained-perf monitor driven by EVERY engine
+    segment (the canonical serving programs replay through run_segment
+    with no scheduler, so the ambient ``serving.SEGMENT_HOOKS`` route
+    is the attachment), plus an ``OpsServer`` live on a loopback
+    ephemeral port with one proving scrape at attach time. All of it is
+    host-side — the per-program budgets must come out bit-identical to
+    ``--ops off`` (tests/test_slo_monitor.py enforces exactly that)."""
+    from .. import observability as obs
+    from ..models import llama
+
+    monitor = obs.SLOMonitor(
+        {0: obs.Objective(ttft_target_s=1.0, e2e_target_s=30.0,
+                          compliance=0.99)})
+    obs.slo.install(monitor)
+    cfg = llama.LlamaConfig.tiny()
+    perf = obs.PerfMonitor(cfg, llama.init_params(cfg), batch=4,
+                           avg_pos=32.0)
+    obs.perf.install(perf)
+    server = obs.OpsServer(port=0, slo_monitor=monitor, perf_monitor=perf)
+    scraped = False
+    try:
+        server.start()
+        import urllib.request
+
+        with urllib.request.urlopen(f"{server.url}/healthz",
+                                    timeout=5) as r:
+            scraped = r.status == 200
+    except OSError as e:
+        # a sandbox that cannot bind loopback must not fail the gate;
+        # the monitors stay attached either way
+        print(f"ops exporter unavailable ({e}); auditing with monitors "
+              f"only")
+    print(f"ops surface attached: slo+perf monitors on SEGMENT_HOOKS"
+          + (f", exporter live at {server.url} (scrape ok={scraped})"
+             if server.running else ""))
+    return monitor, perf, server
+
+
+def _detach_ops(ops) -> None:
+    from .. import observability as obs
+
+    monitor, perf, server = ops
+    server.stop()
+    obs.slo.uninstall(monitor)
+    obs.perf.uninstall(perf)
+    print(f"ops surface detached: monitor saw {monitor.segment_no} "
+          f"segments, perf saw {perf.segments}")
 
 
 def main(argv=None) -> int:
@@ -33,12 +92,21 @@ def main(argv=None) -> int:
                     help="audit with the observability subsystem enabled "
                          "(default: on — the zero-extra-sync contract "
                          "means budgets must be identical either way)")
+    ap.add_argument("--ops", choices=("on", "off"), default="on",
+                    help="audit with the r14 live-ops surface attached: "
+                         "an SLO monitor + perf monitor fed by every "
+                         "engine segment (serving.SEGMENT_HOOKS) and an "
+                         "OpsServer scraping on loopback — budgets must "
+                         "be bit-identical to --ops off")
     args = ap.parse_args(argv)
 
     from .. import observability
     from . import audit_program, budgets, programs
 
     prev_telemetry = observability.set_enabled(args.telemetry == "on")
+    ops = None
+    if args.ops == "on":
+        ops = _attach_ops()
     targets = args.program or programs.names()
     results = []
     any_violation = False
@@ -61,6 +129,8 @@ def main(argv=None) -> int:
             print("  budget: OK")
         print()
 
+    if ops is not None:
+        _detach_ops(ops)
     observability.set_enabled(prev_telemetry)
     if args.json:
         with open(args.json, "w") as f:

@@ -163,6 +163,12 @@ class FleetReport:
     requeued: int = 0
     replica_health: Optional[Dict[int, str]] = None
     retry_after_s: Optional[float] = None
+    # r14 (ISSUE 9): worst replica cold-start→first-token this fleet
+    # paid (per-replica values ride in per_replica), plus the attached
+    # monitors' state — the fleet analogs of OnlineReport's fields
+    cold_start_s: Optional[float] = None
+    slo: Optional[dict] = None
+    perf: Optional[dict] = None
     per_replica: List[dict] = field(default_factory=list)
     telemetry: Optional[dict] = None   # merge_log_dir reduction
 
@@ -261,7 +267,8 @@ class FleetRouter:
                  segment_timeout_s: Optional[float] = None,
                  max_finish_retries: int = 1, max_requeues: int = 3,
                  fault_injector: Optional[FaultInjector] = None,
-                 probe_after_s: float = 0.05):
+                 probe_after_s: float = 0.05,
+                 slo_monitor=None, perf_monitor=None):
         if not engines:
             raise ValueError("a fleet needs at least one engine")
         if prefix_caches == "auto":
@@ -309,6 +316,13 @@ class FleetRouter:
         self.max_requeues = int(max_requeues)
         self.fault_injector = fault_injector
         self.probe_after_s = float(probe_after_s)
+        # r14 (ISSUE 9): fleet-level live-ops monitors — fed from the
+        # same host stamps ``_stamp`` already takes at each segment's
+        # audited fetch; their gauges land in the PROCESS registry (the
+        # fleet view), not the replica-scoped ones, so the hooks run
+        # outside the scoped_registry blocks
+        self.slo_monitor = slo_monitor
+        self.perf_monitor = perf_monitor
         self.failovers = 0                  # replicas declared dead
         self.requeued = 0                   # requests moved to survivors
         self.last_retry_after_s: Optional[float] = None
@@ -503,6 +517,13 @@ class FleetRouter:
             requeued=self.requeued,
             replica_health={r.idx: r.health for r in reps},
             retry_after_s=self.last_retry_after_s,
+            cold_start_s=max(
+                (round(r.engine.cold_start_s, 4) for r in reps
+                 if r.engine.cold_start_s is not None), default=None),
+            slo=(self.slo_monitor.report()
+                 if self.slo_monitor is not None else None),
+            perf=(self.perf_monitor.end_interval()
+                  if self.perf_monitor is not None else None),
             per_replica=[{
                 "replica": r.idx,
                 "requests": len(r.rids),
@@ -512,6 +533,9 @@ class FleetRouter:
                 "ticks": r.engine.last_run_ticks,
                 "health": r.health,
                 "probes": r.probes,
+                "cold_start_s": (round(r.engine.cold_start_s, 4)
+                                 if r.engine.cold_start_s is not None
+                                 else None),
                 "backpressure_events": r.backpressure_events,
                 "dispatches": dict(r.dispatches),
                 "prefix": (r.prefix_cache.stats()
@@ -556,7 +580,7 @@ class FleetRouter:
                 with _metrics.scoped_registry(rep.registry):
                     ev = rep.engine.finish_segment(h)
                     t_sync = time.perf_counter()
-                    self._stamp(rep, ev, t_sync)
+                    outcomes = self._stamp(rep, ev, t_sync)
                 break
             except ReplicaCrash as e:
                 self._kill_replica(rep, f"crash: {e}")
@@ -575,6 +599,18 @@ class FleetRouter:
                 _metrics.counter("fleet.finish_retries").inc()
         rep.segments += 1
         self._finished_count += len(ev["finished"])
+        # r14 fleet monitor feed (outside the scoped registry: the SLO/
+        # perf gauges are the FLEET view, not a replica's) — host
+        # mirrors of the fetch above plus its dispatch→fetch span
+        if self.slo_monitor is not None:
+            for kind, prio, lat in outcomes:
+                (self.slo_monitor.note_ttft if kind == "ttft"
+                 else self.slo_monitor.note_e2e)(prio, lat)
+            self.slo_monitor.end_segment()
+        if self.perf_monitor is not None:
+            self.perf_monitor.note_segment(ev["steps"],
+                                           ev.get("tokens", 0),
+                                           elapsed_s=t_sync - t_disp)
         if attempts and rep.health == "suspect":
             # a retried fetch came back: the hang was transient
             rep.set_health("healthy")
@@ -679,15 +715,19 @@ class FleetRouter:
             else:
                 rep.dead_since = time.perf_counter()
 
-    def _stamp(self, r: _Replica, ev: dict, t_sync: float) -> None:
+    def _stamp(self, r: _Replica, ev: dict, t_sync: float) -> List[tuple]:
         """Per-request lifecycle stamping at the sync that surfaced each
         event — identical rules to ``OnlineScheduler.serve``, recorded
-        into the REPLICA's registry (the scoped context is active)."""
+        into the REPLICA's registry (the scoped context is active).
+        Returns the ``(kind, priority, latency_s)`` outcomes so the
+        caller can feed the fleet-level SLO monitor OUTSIDE the scoped
+        registry (its gauges belong to the process/fleet view)."""
         by_erid = {self._reqs[rid][1].rid: self._reqs[rid][1]
                    for rid in r.rids}
         m_ttft = _metrics.histogram("serving.ttft_s")
         m_e2e = _metrics.histogram("serving.e2e_s")
         m_qw = _metrics.histogram("serving.queue_wait_s")
+        outcomes: List[tuple] = []
         for erid in ev["first_tokens"]:
             req = by_erid[erid]
             if req.first_token_time:
@@ -697,11 +737,16 @@ class FleetRouter:
             req.first_token_time = t_sync
             m_ttft.observe(t_sync - req.arrival_time)
             m_qw.observe(req.admit_time - req.arrival_time)
+            outcomes.append(("ttft", req.priority,
+                             t_sync - req.arrival_time))
         for erid in ev["finished"]:
             req = by_erid[erid]
             req.finish_time = t_sync
             m_e2e.observe(t_sync - req.arrival_time)
+            outcomes.append(("e2e", req.priority,
+                             t_sync - req.arrival_time))
         _metrics.gauge("fleet.replica_queue_depth").set(r.queue_depth)
+        return outcomes
 
     # --- results / lifecycle ---------------------------------------------
     def results(self) -> Dict[int, List[int]]:
@@ -740,6 +785,12 @@ class FleetRouter:
         self._finished_count = 0
         self._reqs.clear()
         self._next_rid = 0
+        if self.slo_monitor is not None:
+            self.slo_monitor.reset()
+        if self.perf_monitor is not None:
+            # cut (and discard) the warm interval; the self-pinned tick
+            # budget survives — the warm baseline is the reference
+            self.perf_monitor.end_interval()
 
     def leak_report(self) -> List[str]:
         """Aggregated page-leak audit across replicas: with no live

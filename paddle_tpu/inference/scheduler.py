@@ -169,6 +169,13 @@ class OnlineReport:
     shed_per_class: Optional[Dict[int, int]] = None
     displaced: int = 0             # queue spots yielded to a higher class
     per_class: Optional[Dict[int, dict]] = None  # class -> latency stats
+    # r14 (ISSUE 9): cold-start→first-token of the engine this serve
+    # drove (None until the engine emitted its first post-build token),
+    # and — when the monitors are attached — the SLO monitor's
+    # budget/burn/alert state and the explained-perf interval report
+    cold_start_s: Optional[float] = None
+    slo: Optional[dict] = None
+    perf: Optional[dict] = None
     per_request: List[dict] = field(default_factory=list)
 
     def as_dict(self, with_requests: bool = False) -> dict:
@@ -193,11 +200,18 @@ class OnlineScheduler:
 
     def __init__(self, engine: ServingEngine, max_queue: int = 64,
                  seg_steps: int = 32,
-                 prefix_cache: Optional[PrefixCache] = None):
+                 prefix_cache: Optional[PrefixCache] = None,
+                 slo_monitor=None, perf_monitor=None):
         self.engine = engine
         self.max_queue = int(max_queue)
         self.seg_steps = int(seg_steps)
         self.prefix_cache = prefix_cache
+        # r14 (ISSUE 9): optional live-ops monitors. Both consume only
+        # the host stamps this loop already takes at the per-segment
+        # allowed_sync fetch — attaching them adds zero device contacts
+        # (tests/test_slo_monitor.py pins bit-identical sync audits).
+        self.slo_monitor = slo_monitor
+        self.perf_monitor = perf_monitor
         self.backpressure_events = 0
         self._reqs: Dict[int, Request] = {}
         # r13: drain-rate bookkeeping for the retry_after_s backpressure
@@ -267,6 +281,7 @@ class OnlineScheduler:
                 # warmup must not pre-populate measured-run hits (paged
                 # caches also hand their page refs back to the pool)
                 self.prefix_cache.reset()
+            self._reset_monitors()
 
         pending = sorted(arrivals, key=lambda a: a.t)
         eng = self.engine
@@ -304,17 +319,21 @@ class OnlineScheduler:
                         time.sleep(min(gap, 0.05))
                 continue
             t_seg = _hooks.now_ns()
+            t_seg_pc = time.perf_counter()
             ev = eng.run_segment(self.seg_steps,
                                  prefix_cache=self.prefix_cache)
             t_sync = time.perf_counter()
             _hooks.emit("serving.segment", t_seg, _hooks.now_ns(),
                         kind="serving")
             segments += 1
+            mon = self.slo_monitor
             for rid in ev["first_tokens"]:
                 r = self._reqs[rid]
                 r.first_token_time = t_sync
                 m_ttft.observe(t_sync - r.arrival_time)
                 m_qwait.observe(r.admit_time - r.arrival_time)
+                if mon is not None:
+                    mon.note_ttft(r.priority, t_sync - r.arrival_time)
                 self._on_first_token(r, t_sync)
             for rid in ev["finished"]:
                 # the engine stamps finish during replay (marginally
@@ -324,10 +343,21 @@ class OnlineScheduler:
                 r.finish_time = t_sync
                 self._finished_count += 1
                 m_e2e.observe(t_sync - r.arrival_time)
+                if mon is not None:
+                    mon.note_e2e(r.priority, t_sync - r.arrival_time)
                 self._on_finish(r, t_sync)
                 _tracing.emit_request_trace(
                     rid, r.arrival_time, r.admit_time, r.first_token_time,
                     r.finish_time, prefix_hit_len=r.prefix_hit_len)
+            # r14 monitor hooks: advance the SLO burn windows and feed
+            # the explained-perf intervals — host ints from the event
+            # log just fetched, plus this segment's dispatch→fetch span
+            if mon is not None:
+                mon.end_segment()
+            if self.perf_monitor is not None:
+                self.perf_monitor.note_segment(
+                    ev["steps"], ev.get("tokens", 0),
+                    elapsed_s=t_sync - t_seg_pc)
         makespan = time.perf_counter() - t0
 
         reqs = list(self._reqs.values())
@@ -362,6 +392,12 @@ class OnlineScheduler:
             prefix=(self.prefix_cache.stats()
                     if self.prefix_cache is not None else None),
             retry_after_s=self.last_retry_after_s,
+            cold_start_s=(round(eng.cold_start_s, 4)
+                          if eng.cold_start_s is not None else None),
+            slo=(self.slo_monitor.report()
+                 if self.slo_monitor is not None else None),
+            perf=(self.perf_monitor.end_interval()
+                  if self.perf_monitor is not None else None),
             **self._report_extras(reqs),
             per_request=[{
                 "rid": r.rid,
@@ -374,6 +410,17 @@ class OnlineScheduler:
                 "e2e_s": round(r.finish_time - r.arrival_time, 4),
             } for r in reqs],
         )
+
+    def _reset_monitors(self) -> None:
+        """Warm-run isolation for the attached monitors: the warm pass
+        must not leave alerts/windows behind (the perf monitor's
+        self-pinned tick budget deliberately SURVIVES — the warm
+        baseline is exactly what the measured run should be judged
+        against)."""
+        if self.slo_monitor is not None:
+            self.slo_monitor.reset()
+        if self.perf_monitor is not None:
+            self.perf_monitor.end_interval()
 
     # --- SLO hooks (no-ops here; SLOScheduler overrides) -----------------
     def _pre_segment(self, now: float, t0: float) -> None:
@@ -431,9 +478,12 @@ class SLOScheduler(OnlineScheduler):
     def __init__(self, engine: ServingEngine, max_queue: int = 64,
                  seg_steps: int = 32,
                  prefix_cache: Optional[PrefixCache] = None,
-                 preempt: bool = True, shed_deadlines: bool = True):
+                 preempt: bool = True, shed_deadlines: bool = True,
+                 slo_monitor=None, perf_monitor=None):
         super().__init__(engine, max_queue=max_queue, seg_steps=seg_steps,
-                         prefix_cache=prefix_cache)
+                         prefix_cache=prefix_cache,
+                         slo_monitor=slo_monitor,
+                         perf_monitor=perf_monitor)
         self.preempt = bool(preempt)
         self.shed_deadlines = bool(shed_deadlines)
         self.preemptions = 0
@@ -657,5 +707,6 @@ class SLOScheduler(OnlineScheduler):
             self.shed_log = []
             self.displaced = 0
             self._arrivals.clear()
+            self._reset_monitors()
             return super().serve(arrivals, warm=False)
         return super().serve(arrivals, warm=False)

@@ -57,7 +57,18 @@ from ..models import llama
 from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 
-__all__ = ["Request", "ServingEngine"]
+__all__ = ["Request", "ServingEngine", "SEGMENT_HOOKS"]
+
+# Process-wide segment observers (r14, ISSUE 9): ``fn(steps, new_tokens,
+# n_finished)`` called from ``_segment_telemetry`` after every segment's
+# host replay — host ints only, so a hook can never add a device sync.
+# ``observability.slo.install`` / ``observability.perf.install`` use this
+# to attach the SLO monitor and the explained-perf interval accumulator
+# to ANY engine (the analysis gate's --ops mode rides it: the canonical
+# serving programs replay through run_segment with no scheduler in the
+# loop, and the monitors must still see every segment). Empty by
+# default — the common case costs one truthiness check per segment.
+SEGMENT_HOOKS: List = []
 
 
 @contextlib.contextmanager
@@ -266,6 +277,16 @@ class ServingEngine:
         self._nxt = self._slot_vec()
         self._rem = self._slot_vec()
         self._pending_seg = None  # at most ONE in-flight dispatched segment
+        # r14 cold-start metric (ISSUE 9 satellite; ROADMAP item 5's
+        # first deliverable): build→first-emitted-token wall time, the
+        # number autoscaling/rollout decisions gate on. Stamped ONCE per
+        # engine lifetime at the first host-visible token (the fetch
+        # that surfaced it), deliberately spanning the first segment's
+        # XLA compile — that compile IS the cold-start cost being
+        # measured. reset_slots does not clear it (warm resets are not
+        # rebuilds).
+        self.built_at = time.perf_counter()
+        self.cold_start_s: Optional[float] = None
         from ..jit import register_compiled_cache
 
         register_compiled_cache(self)  # analysis.recompile introspection
@@ -679,6 +700,8 @@ class ServingEngine:
                 jnp.asarray(lens), jnp.asarray(gens), jnp.int32(n))
         out, fin, steps, ndec = jax.device_get([out, fin, steps, ndec])
         wall = time.perf_counter() - t0
+        if n and self.cold_start_s is None:
+            self._note_cold_start()   # offline drain path's first tokens
         self.last_run_ticks = int(ndec)
         self.last_run_chunks = -(-int(ndec) // self.chunk)
         per_step = wall / max(int(steps), 1)
@@ -909,7 +932,21 @@ class ServingEngine:
                         finished.append(r.rid)
                         if on_retire is not None:
                             on_retire(r, s)
+        if new_tokens and self.cold_start_s is None:
+            self._note_cold_start()
         return admitted, first_tokens, finished, new_tokens, eos_stops
+
+    def _note_cold_start(self) -> None:
+        """First host-visible token since build: stamp the cold-start
+        and publish it (SERVING metric + flight event). Runs at the
+        fetch that surfaced the token, so the stamp includes program
+        build + first compile + first prefill — the full client-facing
+        cold-start window."""
+        self.cold_start_s = time.perf_counter() - self.built_at
+        _metrics.gauge("serving.cold_start_s").set(self.cold_start_s)
+        _flight.record("cold_start",
+                       seconds=round(self.cold_start_s, 4),
+                       paged=self.paged, slots=self.slots)
 
     def _segment_telemetry(self, steps, admitted, finished, eos_stops,
                            new_tokens, requeued) -> None:
@@ -928,6 +965,11 @@ class ServingEngine:
         _flight.record("segment", steps=steps, admitted=len(admitted),
                        finished=len(finished), eos=eos_stops,
                        tokens=new_tokens, requeued=requeued)
+        if SEGMENT_HOOKS:
+            # r14 ambient observers (SLO monitor / perf intervals):
+            # host ints only, same zero-extra-sync contract
+            for hook in SEGMENT_HOOKS:
+                hook(steps, new_tokens, len(finished))
 
     def free_slot_count(self) -> int:
         return sum(1 for r in self._active if r is None)
@@ -1245,7 +1287,8 @@ class ServingEngine:
         self._segment_telemetry(steps, admitted, finished, eos_stops,
                                 new_tokens, max(0, n - qadm))
         return {"steps": steps, "admitted": admitted,
-                "first_tokens": first_tokens, "finished": finished}
+                "first_tokens": first_tokens, "finished": finished,
+                "tokens": new_tokens}
 
     # --- paged segments (r11: page-table KV, inference/paged_kv.py) -------
     def _paged_segment_prog(self, n_pad: int, s_max: int, max_steps: int):
@@ -1733,7 +1776,8 @@ class ServingEngine:
         self._segment_telemetry(steps, admitted, finished, eos_stops,
                                 new_tokens, max(0, n - qadm))
         return {"steps": steps, "admitted": admitted,
-                "first_tokens": first_tokens, "finished": finished}
+                "first_tokens": first_tokens, "finished": finished,
+                "tokens": new_tokens}
 
     def collect_finished(self) -> Dict[int, List[int]]:
         """Drain the finished list (segment mode's result channel),

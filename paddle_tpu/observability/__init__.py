@@ -13,7 +13,21 @@ Three layers over signals the framework already holds on the host:
   timeline as op dispatch and serving segments.
 * :mod:`flight` — a bounded ring of recent structured events
   (admissions, backpressure, EOS, recompiles, loss-scale skips,
-  prefix-cache hits/evictions) dumpable on demand or on exception.
+  prefix-cache hits/evictions) dumpable on demand, on exception, or
+  on orderly exit/SIGTERM.
+
+Plus the r14 live ops surface (ISSUE 9) over those signals:
+
+* :mod:`slo` — per-priority-class error-budget ledgers and
+  multi-window burn-rate alerting (segment-counted windows, an
+  ok→warning→page state machine, ``slo_alert`` flight events).
+* :mod:`perf` — the analytic roofline ledger (SCALING §3c, from the
+  live param tree) joined with runtime counters: live roofline
+  fraction + MFU per program, and an EWMA tick-time regression
+  sentinel (``perf_regression`` events).
+* :mod:`exporter` — ``OpsServer``, an explicit-start stdlib HTTP
+  scrape surface: ``/metrics`` ``/snapshot.json`` ``/healthz``
+  ``/flight`` ``/slo`` ``/perf``.
 
 The hard contract: instrumentation consumes device values ONLY at the
 two sanctioned ``allowed_sync`` points (serving's per-segment event
@@ -39,20 +53,25 @@ no-op (the ≤2 % serving overhead gate compares against exactly that).
 
 from __future__ import annotations
 
-from . import flight, metrics, tracing
+from . import exporter, flight, metrics, perf, slo, tracing
+from .exporter import OpsServer
 from .flight import FLIGHT, dump_on_exception
 from .metrics import (counter, enabled, gauge, histogram, merge_log_dir,
                       merge_snapshots, percentile, registry,
                       render_prometheus, reset, set_enabled, snapshot,
                       write_snapshot)
+from .perf import PerfMonitor, serving_ledger
+from .slo import Objective, SLOMonitor
 from .tracing import emit_request_trace, span, step_span
 
 __all__ = [
-    "metrics", "tracing", "flight", "counter", "gauge", "histogram",
-    "percentile", "registry", "snapshot", "render_prometheus",
-    "merge_snapshots", "merge_log_dir", "write_snapshot", "reset",
-    "set_enabled", "enabled", "span", "step_span", "emit_request_trace",
-    "FLIGHT", "dump_on_exception", "install_compile_listener",
+    "metrics", "tracing", "flight", "slo", "perf", "exporter", "counter",
+    "gauge", "histogram", "percentile", "registry", "snapshot",
+    "render_prometheus", "merge_snapshots", "merge_log_dir",
+    "write_snapshot", "reset", "set_enabled", "enabled", "span",
+    "step_span", "emit_request_trace", "FLIGHT", "dump_on_exception",
+    "install_compile_listener", "Objective", "SLOMonitor", "PerfMonitor",
+    "serving_ledger", "OpsServer",
 ]
 
 

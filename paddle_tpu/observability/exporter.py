@@ -1,0 +1,235 @@
+"""Operator scrape endpoint — a stdlib ``http.server`` surface over the
+observability package's host-side state (ISSUE 9 tentpole, part 3).
+
+Everything the registry/monitors/recorder hold is host data, so serving
+it over HTTP is pure plumbing — the handler never touches a device
+value and a scrape can never trigger a sync (the same contract the rest
+of the package enforces at the record path). Endpoints:
+
+=================  =======================================================
+``/metrics``       Prometheus text exposition of the process registry
+                   (or an attached one) — the standard scrape target.
+``/snapshot.json`` Rank-tagged JSON snapshot; with ``log_dir`` set and
+                   ``?merged=1`` (or ``/snapshot.json?merged=1``), the
+                   ``merge_log_dir`` reduction over every
+                   ``telemetry_rank*.json`` — the fleet view.
+``/healthz``       Liveness + the r13 replica health machine: attached
+                   ``FleetRouter`` replicas (live view) or the
+                   ``fleet.replica_health`` gauge by rank from a merged
+                   log dir. 200 while any replica serves, 503 when none.
+``/flight``        Flight-recorder tail (``?n=`` bounds it, default 64).
+``/slo``           The SLO monitor's budget/burn/alert state.
+``/perf``          The explained-performance ledger + interval report.
+=================  =======================================================
+
+The server is started and stopped EXPLICITLY (``start()`` binds and
+returns the port — pass ``port=0`` for an ephemeral loopback port;
+``stop()`` joins the thread), so tier-1 never binds a port by accident:
+constructing an ``OpsServer`` costs nothing until ``start()``.
+Context-manager use closes it deterministically in tests::
+
+    with OpsServer(port=0, slo_monitor=mon) as srv:
+        urllib.request.urlopen(f"{srv.url}/metrics")
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from . import flight as _flight
+from . import metrics as _metrics
+
+__all__ = ["OpsServer"]
+
+_HEALTH_NAMES = {0.0: "healthy", 1.0: "suspect", 2.0: "dead"}
+
+
+class OpsServer:
+    """Scrape surface over the process (or an attached) registry, the
+    flight recorder, and the optional SLO/perf monitors and fleet.
+
+    ``registry``: defaults to the process-wide one at request time (so
+    ``scoped_registry`` fleets export what they recorded). ``fleet``: a
+    ``FleetRouter`` for the live ``/healthz`` replica view. ``log_dir``:
+    where rank snapshots live for the merged views. ``recorder``:
+    defaults to the process flight ring."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 registry: Optional[_metrics.Registry] = None,
+                 slo_monitor=None, perf_monitor=None, fleet=None,
+                 log_dir: Optional[str] = None, recorder=None):
+        self.host = host
+        self.port = int(port)
+        self.registry = registry
+        self.slo_monitor = slo_monitor
+        self.perf_monitor = perf_monitor
+        self.fleet = fleet
+        self.log_dir = log_dir
+        self.recorder = recorder
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # --- lifecycle --------------------------------------------------------
+    @property
+    def running(self) -> bool:
+        return self._httpd is not None
+
+    @property
+    def url(self) -> str:
+        if not self.running:
+            raise RuntimeError("OpsServer not started")
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> int:
+        """Bind and serve on a daemon thread; returns the bound port
+        (the real one when constructed with ``port=0``)."""
+        if self._httpd is not None:
+            return self.port
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"ops-server:{self.port}", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+        self._httpd = None
+        self._thread = None
+
+    def __enter__(self) -> "OpsServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # --- payload builders (host data only) --------------------------------
+    def _registry(self) -> _metrics.Registry:
+        return self.registry if self.registry is not None \
+            else _metrics.registry()
+
+    def _recorder(self):
+        return self.recorder if self.recorder is not None \
+            else _flight.FLIGHT
+
+    def payload_metrics(self) -> str:
+        return self._registry().render_prometheus()
+
+    def payload_snapshot(self, merged: bool = False) -> dict:
+        if merged:
+            if not self.log_dir:
+                raise FileNotFoundError(
+                    "merged snapshot requested but no log_dir attached")
+            return _metrics.merge_log_dir(self.log_dir)
+        return self._registry().snapshot()
+
+    def payload_healthz(self) -> tuple:
+        """(status_code, body): per-replica health from the live router
+        when attached, else from the merged log dir's
+        ``fleet.replica_health`` gauge, else plain process liveness."""
+        replicas = None
+        if self.fleet is not None:
+            replicas = {str(r.idx): r.health
+                        for r in self.fleet._replicas}
+        elif self.log_dir:
+            try:
+                merged = _metrics.merge_log_dir(self.log_dir)
+                by_rank = merged["gauges"].get(
+                    "fleet.replica_health", {}).get("by_rank", {})
+                replicas = {rank: _HEALTH_NAMES.get(code, "unknown")
+                            for rank, code in by_rank.items()} or None
+            except FileNotFoundError:
+                replicas = None
+        body = {"status": "ok"}
+        if replicas is not None:
+            healthy = sum(1 for h in replicas.values() if h == "healthy")
+            body = {"status": ("ok" if healthy == len(replicas)
+                               else "degraded" if healthy else "dead"),
+                    "replicas": replicas,
+                    "healthy": healthy, "total": len(replicas)}
+        if self.slo_monitor is not None:
+            body["slo_level"] = self.slo_monitor.worst_level()
+        code = 503 if body["status"] == "dead" else 200
+        return code, body
+
+    def payload_flight(self, n: int = 64) -> dict:
+        evs = self._recorder().events()
+        return {"capacity": self._recorder().capacity,
+                "total_buffered": len(evs),
+                "events": evs[-max(1, int(n)):]}
+
+    def payload_slo(self) -> dict:
+        if self.slo_monitor is None:
+            return {"enabled": False}
+        return {"enabled": True, **self.slo_monitor.report()}
+
+    def payload_perf(self) -> dict:
+        if self.perf_monitor is None:
+            return {"enabled": False}
+        return {"enabled": True, **self.perf_monitor.report()}
+
+
+def _make_handler(srv: OpsServer):
+    class Handler(BaseHTTPRequestHandler):
+        # ops traffic must not spam the serving process's stderr
+        def log_message(self, fmt, *args):
+            pass
+
+        def _send(self, code: int, body, content_type: str) -> None:
+            data = (body if isinstance(body, bytes)
+                    else body.encode("utf-8"))
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _send_json(self, code: int, obj) -> None:
+            self._send(code, json.dumps(obj, indent=1, default=str),
+                       "application/json")
+
+        def do_GET(self):
+            u = urlparse(self.path)
+            q = parse_qs(u.query)
+            try:
+                if u.path == "/metrics":
+                    self._send(200, srv.payload_metrics(),
+                               "text/plain; version=0.0.4")
+                elif u.path == "/snapshot.json":
+                    merged = q.get("merged", ["0"])[0] in ("1", "true")
+                    self._send_json(200, srv.payload_snapshot(merged))
+                elif u.path == "/healthz":
+                    code, body = srv.payload_healthz()
+                    self._send_json(code, body)
+                elif u.path == "/flight":
+                    n = int(q.get("n", ["64"])[0])
+                    self._send_json(200, srv.payload_flight(n))
+                elif u.path == "/slo":
+                    self._send_json(200, srv.payload_slo())
+                elif u.path == "/perf":
+                    self._send_json(200, srv.payload_perf())
+                elif u.path == "/":
+                    self._send_json(200, {
+                        "endpoints": ["/metrics", "/snapshot.json",
+                                      "/healthz", "/flight", "/slo",
+                                      "/perf"]})
+                else:
+                    self._send_json(404, {"error": f"no route {u.path}"})
+            except FileNotFoundError as e:
+                self._send_json(404, {"error": str(e)})
+            except Exception as e:   # scrape must never kill the server
+                self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+
+    return Handler

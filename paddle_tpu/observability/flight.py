@@ -19,9 +19,11 @@ recorder on.
 
 from __future__ import annotations
 
+import atexit
 import collections
 import contextlib
 import json
+import signal
 import sys
 import threading
 import time
@@ -123,11 +125,68 @@ def dump_on_exception(path: str):
 
 
 _HOOK_INSTALLED = [False]
+_EXIT_HOOKS_INSTALLED = [False]
+_EXIT_DUMPED = [False]
 
 
-def install_excepthook(path: str) -> None:
+def _exit_dump(path: str, reason: str) -> None:
+    """Write the postmortem ring once per process, whichever exit path
+    fires first (SIGTERM handler vs atexit — both can run on one
+    orderly kill; the second is a no-op)."""
+    if _EXIT_DUMPED[0]:
+        return
+    _EXIT_DUMPED[0] = True
+    try:
+        FLIGHT.record("process_exit", reason=reason)
+        FLIGHT.dump(path, reason=reason)
+    except Exception:
+        pass   # a failing postmortem must never mask the exit itself
+
+
+def _install_exit_hooks(path: str) -> None:
+    """r14 (ISSUE 9 satellite): postmortems for ORDERLY kills. The r10
+    excepthook only fires on an uncaught exception, but the deaths the
+    r13 failover machinery models — fleet failover draining a replica,
+    container preemption, an operator's ``kill`` — end with SIGTERM or
+    a clean ``sys.exit``, leaving no flight dump. Chain both:
+
+    * ``atexit``: any interpreter exit (normal return, sys.exit) dumps
+      the ring tail.
+    * ``SIGTERM``: dump first, then delegate — a previously installed
+      handler is called; the default action is re-raised (handler
+      reset + re-kill) so process semantics are preserved. Installed
+      only from the main thread (signal module's requirement); a
+      worker-thread install keeps the atexit path only.
+    """
+    if _EXIT_HOOKS_INSTALLED[0]:
+        return
+    atexit.register(_exit_dump, path, "atexit")
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def on_sigterm(signum, frame):
+            _exit_dump(path, "sigterm")
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                signal.raise_signal(signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, on_sigterm)
+    except ValueError:
+        pass   # not the main thread: atexit coverage only
+    _EXIT_HOOKS_INSTALLED[0] = True
+
+
+def install_excepthook(path: str, exit_dump: bool = True) -> None:
     """Process-level postmortem: chain onto ``sys.excepthook`` so ANY
-    uncaught exception dumps the ring before the interpreter reports."""
+    uncaught exception dumps the ring before the interpreter reports;
+    with ``exit_dump`` (default) also register the atexit/SIGTERM hooks
+    so ORDERLY kills (fleet failover, container preemption) still leave
+    a postmortem file at ``path``."""
+    if exit_dump:
+        _install_exit_hooks(path)
     if _HOOK_INSTALLED[0]:
         return
     prev = sys.excepthook
@@ -137,6 +196,7 @@ def install_excepthook(path: str) -> None:
             FLIGHT.record("exception", type=etype.__name__,
                           message=str(value))
             FLIGHT.dump(path, reason=f"uncaught: {etype.__name__}")
+            _EXIT_DUMPED[0] = True   # the crash dump IS the postmortem
         finally:
             prev(etype, value, tb)
 

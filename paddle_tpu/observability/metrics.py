@@ -429,14 +429,43 @@ def write_snapshot(log_dir: str, rank: Optional[int] = None,
 
 def merge_log_dir(log_dir: str) -> dict:
     """Merge every ``telemetry_rank*.json`` under ``log_dir`` — the
-    multi-process reduction for launcher runs (no collective needed)."""
+    multi-process reduction for launcher runs (no collective needed).
+
+    Robustness (r14, ISSUE 9 satellite): a replica killed mid-snapshot
+    — reachable since the r13 failover path writes snapshots around
+    replica deaths — leaves a truncated/empty rank file. The merge used
+    to raise on it, taking down the SURVIVORS' report exactly when an
+    operator needs it most; now a malformed file is skipped and
+    flagged: counted in ``telemetry.merge_skipped_files``, recorded as
+    a ``merge_skipped`` flight event, and listed under the merged
+    dict's ``"skipped_files"`` key so the gap is visible, not silent.
+    Only a dir with NO readable snapshot still raises."""
     import glob
 
+    from . import flight as _flight
+
     snaps = []
+    skipped: List[str] = []
     for p in sorted(glob.glob(os.path.join(log_dir,
                                            "telemetry_rank*.json"))):
-        with open(p) as f:
-            snaps.append(json.load(f))
+        try:
+            with open(p) as f:
+                snap = json.load(f)
+            if not isinstance(snap, dict):
+                raise ValueError(f"snapshot is {type(snap).__name__}, "
+                                 f"not an object")
+            snaps.append(snap)
+        except (json.JSONDecodeError, ValueError, OSError) as e:
+            skipped.append(os.path.basename(p))
+            counter("telemetry.merge_skipped_files",
+                    "rank snapshots skipped as truncated/corrupt").inc()
+            _flight.record("merge_skipped", file=os.path.basename(p),
+                           error=f"{type(e).__name__}: {e}")
     if not snaps:
-        raise FileNotFoundError(f"no telemetry_rank*.json under {log_dir}")
-    return merge_snapshots(snaps)
+        raise FileNotFoundError(
+            f"no readable telemetry_rank*.json under {log_dir}"
+            + (f" ({len(skipped)} skipped as corrupt)" if skipped else ""))
+    merged = merge_snapshots(snaps)
+    if skipped:
+        merged["skipped_files"] = skipped
+    return merged

@@ -1,0 +1,317 @@
+"""SLO monitor — error-budget ledgers and multi-window burn-rate
+alerting over the serving stack's host stamps (ISSUE 9 tentpole).
+
+r13 built per-class SLO *accounting* (TTFT/e2e histograms per priority
+class); this module answers the operator question those numbers only
+imply: **is the error budget burning, and fast enough to page?** The
+design follows the SRE error-budget arithmetic:
+
+* An :class:`Objective` declares, per priority class, the latency
+  targets (TTFT and optionally e2e) and the compliance ratio (e.g.
+  0.99: 1% of requests may miss). ``1 - compliance`` is the allowed
+  violation rate — the error budget's spend rate at exactly 1.0x burn.
+* Every request outcome the scheduler already stamps on the host (the
+  per-segment ``allowed_sync`` fetch delivered it) is classified
+  against its class objective: ``note_ttft`` at the first-token stamp,
+  ``note_e2e`` at the finish stamp. The monitor consumes host floats
+  only — the zero-extra-sync contract of the whole observability
+  package (``python -m paddle_tpu.analysis --gate --ops on`` must show
+  budgets bit-identical to monitor-off).
+* **Burn rate** over a window = observed violation rate / allowed
+  violation rate. Windows are measured in **segments**, not
+  wall-clock: ``end_segment()`` closes one bucket per serving segment,
+  so a synthetic outcome stream drives the alert rules
+  deterministically in tests (a wall-clock window would race the
+  scheduler's timing).
+* **Multi-window alert rules** (fast AND slow window must both exceed
+  the threshold — the fast window gives reaction time, the slow window
+  suppresses one-segment blips): ``warn_burn`` promotes ok→warning,
+  ``page_burn`` promotes to page. De-escalation is hysteretic: the
+  level only drops after ``clear_after`` consecutive segments below
+  the lower threshold, so an alert cannot flap segment-to-segment.
+
+Every state change emits an ``slo_alert`` flight event and the
+per-class gauges ``slo.burn_rate[class<p>]`` /
+``slo.budget_remaining[class<p>]`` update each segment — the numbers
+``exporter.OpsServer`` serves at ``/slo``.
+
+Wiring: pass ``slo_monitor=`` to ``OnlineScheduler``/``SLOScheduler``
+or ``FleetRouter`` (they call the note/end hooks at their existing
+host-stamp sites), or ``install()`` the monitor process-wide to have
+every ``ServingEngine`` segment drive ``end_segment`` through
+``serving.SEGMENT_HOOKS`` (how the analysis gate attaches it without a
+scheduler in the loop).
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from . import flight as _flight
+from . import metrics as _metrics
+
+__all__ = ["Objective", "SLOMonitor", "install", "uninstall"]
+
+_LEVELS = ("ok", "warning", "page")
+_LEVEL_RANK = {lvl: i for i, lvl in enumerate(_LEVELS)}
+
+
+@dataclass(frozen=True)
+class Objective:
+    """Per-priority-class service-level objective.
+
+    ``compliance`` is the target fraction of outcomes meeting their
+    latency bound; ``1 - compliance`` is the error budget. A ``None``
+    target skips that dimension (a batch class often has no TTFT
+    objective)."""
+    ttft_target_s: Optional[float] = None
+    e2e_target_s: Optional[float] = None
+    compliance: float = 0.99
+
+    def __post_init__(self):
+        if not 0.0 < self.compliance < 1.0:
+            raise ValueError(f"compliance must be in (0, 1), got "
+                             f"{self.compliance}")
+        if self.ttft_target_s is None and self.e2e_target_s is None:
+            raise ValueError("objective needs at least one latency target")
+
+
+class _ClassState:
+    """One priority class's ledger + window buckets + alert machine."""
+
+    __slots__ = ("objective", "window", "cur_good", "cur_bad",
+                 "outcomes", "violations", "level", "clear_streak",
+                 "burn_fast", "burn_slow")
+
+    def __init__(self, objective: Objective, slow_window: int):
+        self.objective = objective
+        # per-segment (good, bad) buckets, newest last; the slow window
+        # bounds retention
+        self.window = collections.deque(maxlen=int(slow_window))
+        self.cur_good = 0
+        self.cur_bad = 0
+        self.outcomes = 0          # cumulative, whole serve
+        self.violations = 0
+        self.level = "ok"
+        self.clear_streak = 0
+        self.burn_fast = 0.0
+        self.burn_slow = 0.0
+
+    def budget_remaining(self) -> float:
+        """Fraction of the serve-lifetime error budget left: 1.0 with
+        no violations, 0.0 when violations have consumed exactly
+        ``(1 - compliance) * outcomes``, negative when overspent."""
+        if not self.outcomes:
+            return 1.0
+        allowed = (1.0 - self.objective.compliance) * self.outcomes
+        return 1.0 - self.violations / allowed if allowed else 0.0
+
+    def _burn(self, n_segments: int) -> float:
+        """Burn rate over the newest ``n_segments`` buckets."""
+        good = bad = 0
+        for g, b in list(self.window)[-n_segments:]:
+            good += g
+            bad += b
+        total = good + bad
+        if not total:
+            return 0.0
+        rate = bad / total
+        return rate / (1.0 - self.objective.compliance)
+
+
+class SLOMonitor:
+    """Error-budget ledgers + burn-rate alerting for priority classes.
+
+    ``objectives``: {priority_class: Objective}. Outcomes for classes
+    without a declared objective are ignored (no objective, no budget).
+    ``fast_window``/``slow_window``: alert windows in SEGMENTS.
+    ``warn_burn``/``page_burn``: burn-rate thresholds (1.0 = spending
+    the budget exactly on schedule). ``clear_after``: consecutive
+    calm segments required before an alert level drops (hysteresis).
+    """
+
+    def __init__(self, objectives: Dict[int, Objective],
+                 fast_window: int = 4, slow_window: int = 16,
+                 warn_burn: float = 2.0, page_burn: float = 8.0,
+                 clear_after: int = 4):
+        if not objectives:
+            raise ValueError("SLOMonitor needs at least one objective")
+        if not 0 < fast_window <= slow_window:
+            raise ValueError(f"need 0 < fast_window <= slow_window, got "
+                             f"{fast_window}/{slow_window}")
+        if not 0 < warn_burn <= page_burn:
+            raise ValueError(f"need 0 < warn_burn <= page_burn, got "
+                             f"{warn_burn}/{page_burn}")
+        self.objectives = dict(objectives)
+        self.fast_window = int(fast_window)
+        self.slow_window = int(slow_window)
+        self.warn_burn = float(warn_burn)
+        self.page_burn = float(page_burn)
+        self.clear_after = int(clear_after)
+        self.segment_no = 0
+        self.alert_log: List[dict] = []
+        self._classes = {p: _ClassState(o, slow_window)
+                         for p, o in self.objectives.items()}
+
+    # --- outcome intake (host floats from the scheduler's stamps) --------
+    def _note(self, priority: int, value_s: float,
+              target_s: Optional[float]) -> None:
+        cs = self._classes.get(priority)
+        if cs is None or target_s is None:
+            return
+        ok = value_s <= target_s
+        if ok:
+            cs.cur_good += 1
+        else:
+            cs.cur_bad += 1
+            cs.violations += 1
+        cs.outcomes += 1
+
+    def note_ttft(self, priority: int, ttft_s: float) -> None:
+        """One first-token outcome (call at the first-token host stamp)."""
+        cs = self._classes.get(priority)
+        if cs is not None:
+            self._note(priority, float(ttft_s), cs.objective.ttft_target_s)
+
+    def note_e2e(self, priority: int, e2e_s: float) -> None:
+        """One end-to-end outcome (call at the finish host stamp)."""
+        cs = self._classes.get(priority)
+        if cs is not None:
+            self._note(priority, float(e2e_s), cs.objective.e2e_target_s)
+
+    # --- per-segment evaluation ------------------------------------------
+    def _target_level(self, cs: _ClassState) -> str:
+        if (cs.burn_fast >= self.page_burn
+                and cs.burn_slow >= self.page_burn):
+            return "page"
+        if (cs.burn_fast >= self.warn_burn
+                and cs.burn_slow >= self.warn_burn):
+            return "warning"
+        return "ok"
+
+    def end_segment(self) -> None:
+        """Close this segment's outcome bucket and run the alert rules.
+        Call once per serving segment (the schedulers do; ``install()``
+        routes every engine segment here for ambient attachment)."""
+        self.segment_no += 1
+        for p, cs in self._classes.items():
+            cs.window.append((cs.cur_good, cs.cur_bad))
+            cs.cur_good = cs.cur_bad = 0
+            cs.burn_fast = cs._burn(self.fast_window)
+            cs.burn_slow = cs._burn(self.slow_window)
+            target = self._target_level(cs)
+            if _LEVEL_RANK[target] > _LEVEL_RANK[cs.level]:
+                self._transition(p, cs, target)     # escalate immediately
+                cs.clear_streak = 0
+            elif _LEVEL_RANK[target] < _LEVEL_RANK[cs.level]:
+                cs.clear_streak += 1                # hysteretic clear
+                if cs.clear_streak >= self.clear_after:
+                    self._transition(p, cs, target)
+                    cs.clear_streak = 0
+            else:
+                cs.clear_streak = 0
+            _metrics.gauge(f"slo.burn_rate[class{p}]").set(cs.burn_fast)
+            _metrics.gauge(f"slo.budget_remaining[class{p}]").set(
+                cs.budget_remaining())
+
+    def _transition(self, priority: int, cs: _ClassState,
+                    level: str) -> None:
+        prev, cs.level = cs.level, level
+        rec = {"segment": self.segment_no, "cls": priority,
+               "level": level, "prev": prev,
+               "burn_fast": round(cs.burn_fast, 3),
+               "burn_slow": round(cs.burn_slow, 3),
+               "budget_remaining": round(cs.budget_remaining(), 4)}
+        self.alert_log.append(rec)
+        if _LEVEL_RANK[level] > _LEVEL_RANK[prev]:
+            _metrics.counter("slo.alerts").inc()
+            _metrics.counter(f"slo.alerts[{level}]").inc()
+        _flight.record("slo_alert", **rec)
+
+    # --- introspection ----------------------------------------------------
+    def state(self, priority: int) -> str:
+        return self._classes[priority].level
+
+    def budget_remaining(self, priority: int) -> float:
+        return self._classes[priority].budget_remaining()
+
+    def worst_level(self) -> str:
+        return max((cs.level for cs in self._classes.values()),
+                   key=lambda lvl: _LEVEL_RANK[lvl], default="ok")
+
+    def report(self) -> dict:
+        """The ``/slo`` endpoint's payload: per-class budget/burn state
+        plus the full alert timeline — all host data."""
+        return {
+            "segments": self.segment_no,
+            "windows": {"fast": self.fast_window,
+                        "slow": self.slow_window},
+            "thresholds": {"warn_burn": self.warn_burn,
+                           "page_burn": self.page_burn,
+                           "clear_after": self.clear_after},
+            "worst_level": self.worst_level(),
+            "classes": {
+                str(p): {
+                    "state": cs.level,
+                    "objective": {
+                        "ttft_target_s": cs.objective.ttft_target_s,
+                        "e2e_target_s": cs.objective.e2e_target_s,
+                        "compliance": cs.objective.compliance},
+                    "outcomes": cs.outcomes,
+                    "violations": cs.violations,
+                    "budget_remaining": round(cs.budget_remaining(), 4),
+                    "burn_fast": round(cs.burn_fast, 3),
+                    "burn_slow": round(cs.burn_slow, 3),
+                } for p, cs in sorted(self._classes.items())},
+            "alerts": list(self.alert_log),
+        }
+
+    def reset(self) -> None:
+        """Zero ledgers/windows/alert state (warm-run isolation)."""
+        self.segment_no = 0
+        self.alert_log = []
+        self._classes = {p: _ClassState(o, self.slow_window)
+                         for p, o in self.objectives.items()}
+
+
+# ---------------------------------------------------------------------------
+# Ambient attachment: route every ServingEngine segment's end into the
+# monitor WITHOUT a scheduler in the loop — how `python -m
+# paddle_tpu.analysis --gate --ops on` proves the monitor adds zero
+# hazards to the canonical serving programs.
+# ---------------------------------------------------------------------------
+
+_INSTALLED: List[tuple] = []
+
+
+def install(monitor: SLOMonitor) -> None:
+    """Attach ``monitor`` process-wide: every engine segment (any
+    engine, any path) advances its windows via ``serving.SEGMENT_HOOKS``.
+    Idempotent per monitor; pair with :func:`uninstall`."""
+    from ..inference import serving as _serving
+
+    for m, _ in _INSTALLED:
+        if m is monitor:
+            return
+
+    def hook(steps: int, new_tokens: int, finished: int) -> None:
+        monitor.end_segment()
+
+    _serving.SEGMENT_HOOKS.append(hook)
+    _INSTALLED.append((monitor, hook))
+
+
+def uninstall(monitor: Optional[SLOMonitor] = None) -> None:
+    """Detach ``monitor`` (or every installed monitor when ``None``)."""
+    from ..inference import serving as _serving
+
+    keep = []
+    for m, hook in _INSTALLED:
+        if monitor is None or m is monitor:
+            if hook in _serving.SEGMENT_HOOKS:
+                _serving.SEGMENT_HOOKS.remove(hook)
+        else:
+            keep.append((m, hook))
+    _INSTALLED[:] = keep
