@@ -44,6 +44,15 @@ Modes (r7 — VERDICT r5 items 3 and 9):
                      zero alerts at 1x, a page alert before the first
                      shed at 4x, roofline_fraction within 10% of the
                      SCALING model, cold-start for N=1 + fleet N=2.
+* ``--spec``         speculative decoding (r15, ISSUE 10): one seeded
+                     trace served by the non-speculative and the
+                     speculative paged engine (greedy token-identical
+                     asserted) on a predictable-workload model trained
+                     in-lane — effective tok/s ratio (tick ratio, the
+                     HBM-roofline-normalised number) at measured
+                     acceptance, acceptance histogram by prompt class
+                     + an OOD control, the acceptance-vs-K curve, and
+                     a sampled-speculative replay-determinism check.
 * ``--smoke``        tiny-config in-process invariant check (tier-1 CPU
                      suite hook; see ``smoke()``).
 
@@ -1023,6 +1032,43 @@ def run_slo(model_name, cfg, params, llama, n=32, seed=0, slots=4,
         f"(worst {rep_f.cold_start_s}s; shared program cache warm — "
         f"the post-AOT regime)")
 
+    # --- persistent compile cache: cold vs disk-warm cold start ---------
+    # (r15 satellite; ROADMAP item 5): the r14 lane measured the gap —
+    # 0.06 s with the process program cache warm vs ~2.6 s paying a
+    # fresh segment compile. The persistent cache closes it ACROSS
+    # processes: here we simulate a restart by clearing the process-
+    # wide program cache, so the first number pays real XLA compiles
+    # into an empty disk cache and the second hits the disk.
+    import tempfile
+
+    import paddle_tpu as _paddle
+    from paddle_tpu.inference import serving as _serving
+    from paddle_tpu.inference.scheduler import (OnlineScheduler,
+                                                staggered_arrivals)
+
+    cc_dir = tempfile.mkdtemp(prefix="paddle_tpu_cc_")
+    saved_progs = dict(_serving._SHARED_PROGS)
+    arr_cc = staggered_arrivals(seed + 9, 4, 0.0, cfg.vocab_size,
+                                prompt_lens=(32,), gen_lens=(8,))
+
+    def cold_start_serve():
+        eng_cc = _slo_engine(cfg, params, slots)
+        OnlineScheduler(eng_cc, seg_steps=seg_steps).serve(arr_cc)
+        return eng_cc.cold_start_s
+
+    _paddle.jit.enable_persistent_cache(cc_dir)
+    _serving._SHARED_PROGS.clear()
+    cc_cold_s = cold_start_serve()       # empty disk cache: real compile
+    _serving._SHARED_PROGS.clear()
+    cc_warm_s = cold_start_serve()       # disk hit: deserialise, no XLA
+    _serving._SHARED_PROGS.update(saved_progs)
+    jax.config.update("jax_compilation_cache_dir", None)
+    _paddle.jit._PERSISTENT_CACHE_DIR[0] = None
+    cc_entries = len(os.listdir(cc_dir))
+    log(f"persistent compile cache: cold_start {cc_cold_s:.2f}s (cold "
+        f"disk) -> {cc_warm_s:.2f}s (disk-warm restart), {cc_entries} "
+        f"cache entries in {cc_dir}")
+
     # --- one literal operator scrape -------------------------------------
     with obs.OpsServer(port=0, slo_monitor=mon4, perf_monitor=pm4) as srv:
         with urllib.request.urlopen(srv.url + "/slo", timeout=10) as r:
@@ -1084,9 +1130,244 @@ def run_slo(model_name, cfg, params, llama, n=32, seed=0, slots=4,
                      "the process-wide shared program cache is warm, so "
                      "this is the restart-with-cache regime ROADMAP "
                      "item 5's AOT work will make universal"),
+            # r15 satellite: the persistent-cache knob measured — a
+            # simulated restart (process program cache cleared) paying
+            # real XLA compiles into an empty disk cache vs the same
+            # restart hitting the populated cache
+            "persistent_cache": {
+                "cache_cold_s": round(cc_cold_s, 4),
+                "cache_warm_s": round(cc_warm_s, 4),
+                "entries": cc_entries,
+                "knob": "paddle.jit.enable_persistent_cache / "
+                        "PADDLE_TPU_PERSISTENT_CACHE",
+            },
         },
         "ops_scrape": {"slo_worst_level": slo_scrape["worst_level"],
                        "healthz": health_scrape},
+        "telemetry": _telemetry_section(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding: multi-token verified ticks (r15, ISSUE 10)
+# ---------------------------------------------------------------------------
+
+def _train_markov_tiny(llama, seed=7, steps=300, lr=1e-2):
+    """A tiny llama TRAINED (in-lane, ~12 s CPU) to near-zero loss on a
+    deterministic first-order Markov language — the PREDICTABLE serving
+    regime speculative decoding targets (chat boilerplate, extraction,
+    code: the prompt-lookup-decoding literature's workload class). The
+    model's greedy continuations then follow patterns its own context
+    already contains, so n-gram draft acceptance measures the
+    mechanism's real ceiling instead of an untrained model's noise.
+    Returns (cfg, params, roll) with ``roll(seed, n)`` sampling
+    in-distribution token sequences."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = llama.LlamaConfig.tiny(max_seq_len=512)
+    V = cfg.vocab_size
+    rng = np.random.RandomState(seed)
+    T = rng.randint(0, V, (V,)).astype(np.int32)
+
+    def roll(s, n):
+        r = np.random.RandomState(s)
+        seq = [int(r.randint(0, V))]
+        for _ in range(n - 1):
+            seq.append(int(T[seq[-1]]))
+        return np.asarray(seq, np.int32)
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    opt = llama.init_opt_state(params)
+    step = jax.jit(lambda p, o, t, l: llama.train_step(p, o, t, l, cfg,
+                                                       lr=lr))
+    t0 = time.time()
+    loss = None
+    for it in range(steps):
+        batch = np.stack([roll(1000 + it * 16 + b, 65) for b in range(16)])
+        params, opt, loss = step(params, opt, jnp.asarray(batch[:, :-1]),
+                                 jnp.asarray(batch[:, 1:]))
+    log(f"spec workload model: {steps} steps in {time.time()-t0:.1f}s, "
+        f"final loss {float(loss):.5f}")
+    return cfg, params, roll
+
+
+def run_spec(model_name, cfg_unused, params_unused, llama, n=16, seed=0,
+             slots=8, seg_steps=32, K=4, gen=128):
+    """The speculative-decoding evidence (ISSUE 10 acceptance): one
+    seeded trace served by the non-speculative paged engine and the
+    speculative engine (greedy, K drafts/tick) —
+
+    * per-request tokens IDENTICAL (greedy verification emits the
+      target argmax chain; drafts only set how many chain tokens land
+      per tick);
+    * effective tok/s ratio = tick ratio: decode ticks are HBM-bound
+      (SCALING §3c — each tick streams the full weight set), so
+      tokens-per-weight-stream is the roofline-normalised throughput;
+      the bar is >= 1.8x at measured acceptance >= 60%. Measured CPU
+      wall tok/s is also recorded (the CPU lane is compute-bound, so
+      its wall ratio understates the chip — the chip bar is
+      pre-registered below);
+    * acceptance histogram by prompt class: in-distribution "markov"
+      and longer-context "continuation" prompts (the predictable
+      regime) in the headline trace, plus an out-of-distribution
+      "random" CONTROL trace where acceptance collapses — reported,
+      not hidden: speculation must be harmless there (tokens still
+      identical, ticks ~the non-spec count);
+    * the acceptance-vs-K measured curve (SCALING §3j's model);
+    * a sampled speculative serve (temperature 0.8 top-k 32):
+      rejection sampling in-program, per-request seeds, deterministic
+      replay asserted.
+    """
+    import jax
+
+    from paddle_tpu.inference.scheduler import OnlineScheduler
+    from paddle_tpu.inference.scheduler import Arrival
+    from paddle_tpu.observability import metrics as m
+
+    cfg, params, roll = _train_markov_tiny(llama)
+    rng = np.random.RandomState(seed)
+
+    def mk_arrivals(classes):
+        arr, tags = [], []
+        for cls, prompt in classes:
+            arr.append(Arrival(0.0, prompt, gen))
+            tags.append(cls)
+        return arr, tags
+
+    headline = []
+    for i in range(n * 3 // 4):
+        headline.append(("markov", roll(5000 + i, 16)))
+    for i in range(n - len(headline)):
+        headline.append(("continuation", roll(7000 + i, 48)))
+    control = [("random",
+                rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32))
+               for _ in range(max(4, n // 4))]
+
+    def serve(classes, spec, sampling=None, warm=True):
+        from paddle_tpu.inference.serving import ServingEngine
+
+        arr, tags = mk_arrivals(classes)
+        eng = ServingEngine(cfg, params, slots=slots, max_len=256,
+                            chunk=8, prompt_buckets=(16, 32, 64),
+                            paged=True, page_size=16, speculative=spec,
+                            sampling=sampling)
+        sch = OnlineScheduler(eng, max_queue=4 * len(arr),
+                              seg_steps=seg_steps)
+        t0 = time.time()
+        rep = sch.serve(arr, warm=warm)
+        wall = time.time() - t0
+        out = sch.results()
+        reqs = sorted(sch._reqs.values(), key=lambda r: r.rid)
+        per_class = {}
+        for r, tag in zip(reqs, tags):
+            c = per_class.setdefault(tag, {"n": 0, "proposed": 0,
+                                           "accepted": 0})
+            c["n"] += 1
+            c["proposed"] += r.spec_proposed
+            c["accepted"] += r.spec_accepted
+        for c in per_class.values():
+            c["accept_rate"] = round(c["accepted"] / c["proposed"], 4) \
+                if c["proposed"] else None
+        return eng, rep, out, per_class, wall
+
+    # --- headline: predictable trace, greedy, spec off vs on ----------
+    eng_b, rep_b, out_b, _, wall_b = serve(headline, 0)
+    eng_s, rep_s, out_s, cls_s, wall_s = serve(headline, K)
+    assert out_b == out_s, "speculative greedy changed tokens"
+    proposed = sum(c["proposed"] for c in cls_s.values())
+    accepted = sum(c["accepted"] for c in cls_s.values())
+    accept = accepted / proposed
+    tick_ratio = rep_b.ticks / rep_s.ticks
+    eff_tok_per_tick = m.gauge("spec.effective_tok_per_tick").value
+    log(f"spec headline: accept={accept:.1%}, ticks {rep_b.ticks} -> "
+        f"{rep_s.ticks} (effective tok/s ratio {tick_ratio:.2f}x, "
+        f"{eff_tok_per_tick:.2f} tok/slot-tick), wall "
+        f"{rep_b.throughput_tok_s:,.0f} -> {rep_s.throughput_tok_s:,.0f} "
+        f"tok/s (CPU wall ratio "
+        f"{rep_s.throughput_tok_s / rep_b.throughput_tok_s:.2f}x)")
+
+    # --- OOD control: acceptance collapses, speculation stays safe ----
+    engc_b, repc_b, outc_b, _, _ = serve(control, 0)
+    engc_s, repc_s, outc_s, cls_c, _ = serve(control, K)
+    assert outc_b == outc_s, "control trace changed tokens"
+    ctl_prop = sum(c["proposed"] for c in cls_c.values())
+    ctl_acc = sum(c["accepted"] for c in cls_c.values())
+    log(f"spec OOD control: accept="
+        f"{ctl_acc / max(ctl_prop, 1):.1%}, ticks {repc_b.ticks} -> "
+        f"{repc_s.ticks} (token-identical)")
+
+    # --- acceptance vs K (the SCALING §3j measured curve) -------------
+    curve = []
+    sub = headline[:max(4, n // 4)]
+    for k in (2, 4, 6, 8):
+        _, rep_k, out_k, cls_k, _ = serve(sub, k)
+        p = sum(c["proposed"] for c in cls_k.values())
+        a = sum(c["accepted"] for c in cls_k.values())
+        base_ticks = serve(sub, 0)[1].ticks
+        curve.append({"K": k, "accept_rate": round(a / p, 4),
+                      "tick_ratio": round(base_ticks / rep_k.ticks, 3)})
+        log(f"  K={k}: accept {a/p:.1%}, tick ratio "
+            f"{base_ticks / rep_k.ticks:.2f}x")
+
+    # --- sampled speculative: deterministic replay --------------------
+    samp = {"temperature": 0.8, "top_k": 32}
+    _, rep_t1, out_t1, cls_t, _ = serve(headline, K, sampling=samp,
+                                        warm=False)
+    _, rep_t2, out_t2, _, _ = serve(headline, K, sampling=samp,
+                                    warm=False)
+    assert out_t1 == out_t2, "sampled speculative serve must replay"
+    samp_prop = sum(c["proposed"] for c in cls_t.values())
+    samp_acc = sum(c["accepted"] for c in cls_t.values())
+    log(f"spec sampled (T=0.8 top-k 32): accept "
+        f"{samp_acc / max(samp_prop, 1):.1%}, replay identical")
+
+    bar_ratio, bar_accept = 1.8, 0.60
+    return {
+        "metric": "serving_speculative",
+        "model": "llama_tiny (trained in-lane on first-order Markov "
+                 "text — the predictable serving regime)",
+        "platform": jax.default_backend(),
+        "K": K, "n_requests": len(headline), "gen_len": gen,
+        "seg_steps": seg_steps, "slots": slots,
+        "headline": {
+            "accept_rate": round(accept, 4),
+            "effective_tok_s_ratio": round(tick_ratio, 3),
+            "effective_tok_per_slot_tick": round(eff_tok_per_tick, 3),
+            "ticks_nonspec": rep_b.ticks, "ticks_spec": rep_s.ticks,
+            "tokens": rep_s.total_tokens,
+            "tokens_identical": True,
+            "wall_tok_s_nonspec": round(rep_b.throughput_tok_s, 1),
+            "wall_tok_s_spec": round(rep_s.throughput_tok_s, 1),
+            "bar": {"effective_ratio_min": bar_ratio,
+                    "accept_rate_min": bar_accept},
+            "pass": bool(tick_ratio >= bar_ratio and accept >= bar_accept),
+            "note": ("effective tok/s = accepted-length x tick rate: "
+                     "decode ticks are HBM-bound (SCALING §3c) so the "
+                     "tick ratio IS the roofline-normalised throughput "
+                     "ratio; the CPU wall ratio is compute-bound and "
+                     "understates the chip"),
+        },
+        "accept_by_class": {**cls_s, **cls_c},
+        "ood_control": {
+            "accept_rate": round(ctl_acc / max(ctl_prop, 1), 4),
+            "ticks_nonspec": repc_b.ticks, "ticks_spec": repc_s.ticks,
+            "tokens_identical": True,
+        },
+        "accept_vs_K": curve,
+        "sampled": {
+            "sampling": samp,
+            "accept_rate": round(samp_acc / max(samp_prop, 1), 4),
+            "replay_identical": True,
+        },
+        "chip_bar_preregistered": {
+            "wall_tok_s_ratio_min": 1.5,
+            "note": ("on-chip the verify tick streams the same weight "
+                     "set as a 1-token tick (HBM-bound at serving "
+                     "batch sizes), so measured WALL tok/s must reach "
+                     ">= 1.5x at acceptance >= 60% — recorded here "
+                     "before the chip lane runs"),
+        },
         "telemetry": _telemetry_section(),
     }
 
@@ -1280,6 +1561,7 @@ def main():
     ap.add_argument("--overload", action="store_true")
     ap.add_argument("--failover", action="store_true")
     ap.add_argument("--slo", action="store_true")
+    ap.add_argument("--spec", action="store_true")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--model", default="auto",
                     choices=("auto", "base", "small", "tiny"))
@@ -1313,6 +1595,9 @@ def main():
     elif args.slo:
         print(json.dumps(run_slo(model_name, cfg, params, llama,
                                  n=args.n)))
+    elif args.spec:
+        print(json.dumps(run_spec(model_name, cfg, params, llama,
+                                  n=min(args.n, 16))))
     elif args.failover:
         print(json.dumps(run_failover(model_name, cfg, params, llama)))
     elif args.fleet:

@@ -63,6 +63,12 @@ TPU_TEST_FILES = [
     # ledger parity, the regression sentinel, cold-start stamping, and
     # the monitored-serve sync audit, all against the real backend
     "tests/test_slo_monitor.py",
+    # r15 (ISSUE 10): speculative + sampled decoding — the multi-token
+    # verified tick's greedy token identity, in-program sampling seed
+    # isolation/replay, the speculative serve-loop sync audit and the
+    # acceptance-aware SLO estimates, all against the real backend
+    # (the verify path reuses the unified paged kernel's q_len>1 rows)
+    "tests/test_spec_sampling.py",
 ]
 
 

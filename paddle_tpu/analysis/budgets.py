@@ -124,6 +124,28 @@ BUDGETS: Dict[str, Budget] = {
         undonated_bytes_max=_MiB // 2,  # measured 0 (pool+table donated)
         notes="r13 contract: chunked prefill interleaved with decode — "
               "bounded time-between-tokens at zero extra syncs/compiles"),
+    # The SPECULATIVE paged segment (r15, ISSUE 10): multi-token
+    # verified ticks must be FREE at the hazard level — drafting is
+    # in-program (the n-gram table is segment state, zero host
+    # contact), acceptance counts ride the one allowed event fetch, and
+    # the ("sseg", n_pad, K, steps) key family pins the admit width so
+    # speculation adds zero program shapes. The relayout ledger is the
+    # paged while-body pool-carry class plus the verify tick's [K+1]-
+    # wide scatter copies (measured slightly ABOVE the unchunked paged
+    # segment: the q_len>1 write path carries K+1 rows per slot).
+    "spec_serving_segment": Budget(
+        flagged_syncs=0,
+        allowed_syncs_per_replay={"serving.segment_event_fetch": 1},
+        warm_compiles=0,
+        # measured 1,185,644 B (while-body pool carries + verify-chunk
+        # scatter copies) + ~5%
+        relayout_bytes_max=1_245_000,
+        pack_bytes_max=_MiB // 2,      # measured 0
+        undonated_bytes_max=_MiB // 2,  # measured 0 (pool+table+hist
+                                        # donated; rng rides tiny)
+        notes="r15 contract: K-token drafts verified in one paged tick "
+              "— accepted-length>1 per weight stream at zero extra "
+              "syncs/compiles/shapes"),
     # The TENSOR-PARALLEL segment (r12): the serving_segment contract,
     # GSPMD-sharded — same one fetch per segment and zero warm compiles,
     # PLUS every collective must attribute to the 'mp' axis (enforced

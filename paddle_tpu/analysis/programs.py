@@ -24,6 +24,9 @@ whose hazard ledger earlier rounds paid for by hand:
   (prefill split into ladder-width chunks interleaved with decode
   ticks; still exactly one event fetch, chunk widths declared so the
   program-key family stays finite).
+* ``spec_serving_segment``   — the r15 speculative segment (in-program
+  n-gram draft + K+1-position verified ticks through the paged
+  q_len>1 path; acceptance rides the single event fetch).
 
 Builders are deterministic (fixed seeds, fixed shapes) so the measured
 metrics are stable run to run and ``budgets.py`` can pin them as exact
@@ -331,6 +334,72 @@ def _build_chunked_serving_segment() -> ProgramHandle:
         expected_undonated=(),
         notes="chunked-prefill paged segment (8-token chunks interleaved "
               "with decode ticks) + host event replay, llama-tiny",
+        keepalive=(eng,))
+
+
+@register("spec_serving_segment")
+def _build_spec_serving_segment() -> ProgramHandle:
+    """The r15 speculative segment (ISSUE 10): the paged segment whose
+    decode steps draft K tokens from the slot's in-program n-gram table
+    and verify all K+1 positions in one batched tick through the paged
+    q_len>1 path. The contract the budget pins: speculation must be
+    free at the hazard level — still exactly ONE event fetch per
+    segment (the acceptance counts ride the same fetch; per-request
+    accepted lengths are host replay arithmetic), zero flagged syncs,
+    zero warm compiles (the ("sseg", n_pad, K, steps) key family pins
+    the admit width to the largest bucket, so prefix hits and arrival
+    jitter add no shapes), and no pack traffic beyond the while-body
+    pool carries the paged segment already pays."""
+    import numpy as np
+
+    import jax.numpy as j
+
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg)
+    eng = ServingEngine(cfg, params, slots=4, max_len=64, chunk=8,
+                        prompt_buckets=(16,), paged=True, page_size=16,
+                        speculative=3)
+    rng = np.random.RandomState(0)
+
+    def replay():
+        # end-to-end SPECULATIVE segment: two requests, drafts verified
+        # in multi-token ticks, ONE fused dispatch, the single allowed
+        # event fetch, host replay recovers acceptance — every request
+        # finishes inside the segment so slots + pages drain
+        for _ in range(2):
+            eng.add_request(rng.randint(0, cfg.vocab_size, (12,)), 6)
+        return eng.run_segment(16)
+
+    def hlo():
+        n_pad = eng._pow2(eng.slots)
+        K = eng.speculative
+        seg = eng._spec_segment_prog(n_pad, 16)
+        pgr = eng.pager
+        return seg.lower(
+            params, pgr.pool, pgr.page_table,
+            j.zeros((eng.slots,), j.int32), j.zeros((eng.slots,), j.int32),
+            j.zeros((eng.slots,), j.int32),
+            j.zeros((eng.slots, eng.max_len + 1), j.int32),
+            j.zeros((eng.slots,), j.int32),
+            j.zeros((eng.slots, 2), j.uint32),
+            j.zeros((n_pad, eng.buckets[-1]), j.int32),
+            j.ones((n_pad,), j.int32),
+            j.zeros((n_pad,), j.int32), j.zeros((n_pad,), j.int32),
+            j.zeros((n_pad, pgr.max_pages), j.int32),
+            j.zeros((n_pad,), j.int32),
+            j.int32(2)).compile().as_text()
+
+    return ProgramHandle(
+        name="spec_serving_segment",
+        hlo=_memo(hlo),
+        replay=replay,
+        donation_threshold=1 << 16,
+        expected_undonated=(),
+        notes="speculative paged segment (K=3 n-gram draft, multi-token "
+              "verified ticks) + host acceptance replay, llama-tiny",
         keepalive=(eng,))
 
 

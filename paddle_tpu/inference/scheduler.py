@@ -220,6 +220,14 @@ class OnlineScheduler:
         self.last_retry_after_s: Optional[float] = None
         self._finished_count = 0
         self._serve_t0 = 0.0
+        # r15: measured seconds per segment STEP (EWMA over segments,
+        # available from the first fetch — before any request finishes).
+        # With the engine's acceptance EWMA this prices remaining work
+        # in ticks: a speculative engine retires ~accept_ewma tokens
+        # per tick, so owed/accept ticks x per-tick seconds is the
+        # acceptance-aware service estimate (ISSUE 10 satellite: the
+        # one-token-per-tick assumption over-shed speculative serves)
+        self._per_tick_s = 0.0
 
     # --- intake ----------------------------------------------------------
     def retry_after_hint(self, now: float) -> float:
@@ -227,11 +235,16 @@ class OnlineScheduler:
         seconds until the bounded queue is expected to free one slot,
         derived from the CURRENT drain rate (requests finished this
         serve / elapsed). Before any finish the measured rate is
-        unknown and the hint falls back to one second — still a signal
-        to stop hammering the queue. Clamped to [1 ms, 60 s]."""
+        unknown and the hint falls back to one second scaled by the
+        engine's acceptance EWMA (a speculative engine drains ~accept
+        times faster than one-token-per-tick would suggest — r15) —
+        still a signal to stop hammering the queue. Clamped to
+        [1 ms, 60 s]."""
         if self._finished_count and now > 0:
             return min(max(now / self._finished_count, 1e-3), 60.0)
-        return 1.0
+        accept = max(float(getattr(self.engine, "spec_accept_ewma", 1.0)),
+                     1.0)
+        return 1.0 / accept
 
     def _note_arrival(self, r: Request, a: Arrival) -> None:
         """Per-request intake hook (the SLO subclass stamps priority /
@@ -358,6 +371,11 @@ class OnlineScheduler:
                 self.perf_monitor.note_segment(
                     ev["steps"], ev.get("tokens", 0),
                     elapsed_s=t_sync - t_seg_pc)
+            # r15: per-tick wall EWMA (host arithmetic on already-taken
+            # stamps) — the acceptance-aware service estimates' clock
+            dt = (t_sync - t_seg_pc) / max(ev["steps"], 1)
+            self._per_tick_s = (dt if not self._per_tick_s
+                                else 0.5 * self._per_tick_s + 0.5 * dt)
         makespan = time.perf_counter() - t0
 
         reqs = list(self._reqs.values())
@@ -592,8 +610,20 @@ class SLOScheduler(OnlineScheduler):
         """Lower bound on time to FINISH ``r`` from a standing start:
         tokens owed x the measured per-token EWMA (0.0 until the first
         finish — before any measurement only an already-expired
-        deadline sheds)."""
-        return (r.max_new_tokens - len(r.tokens)) * self._per_token_s
+        deadline sheds).
+
+        r15 (ISSUE 10 satellite): on a SPECULATIVE engine each verify
+        tick retires ~``spec_accept_ewma`` tokens, so remaining work is
+        owed/accept ticks priced at the measured per-tick EWMA — the
+        old one-token-per-tick arithmetic over-estimates service time
+        by the acceptance factor and sheds requests that would have
+        finished comfortably inside their deadlines."""
+        owed = r.max_new_tokens - len(r.tokens)
+        if getattr(self.engine, "speculative", 0):
+            accept = max(float(self.engine.spec_accept_ewma), 1.0)
+            per_tick = self._per_tick_s or self._per_token_s
+            return owed / accept * per_tick
+        return owed * self._per_token_s
 
     def _shed_pass(self) -> None:
         t_abs = time.perf_counter()

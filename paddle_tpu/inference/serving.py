@@ -38,6 +38,19 @@ batch stays full — that scheduling idea, TPU-native:
 Greedy decoding (temperature 0) — matching ``llama.generate``'s default —
 so engine output is bit-comparable to the dense path request-by-request.
 ``eos_token_id`` freezes a slot in-program the step EOS is emitted.
+
+r15 (ISSUE 10): **speculative + sampled decoding inside the segment
+program**. ``ServingEngine(speculative=K)`` drafts K tokens per live
+slot from the slot's page-resident token history (in-program n-gram
+lookup) and verifies all K+1 positions in ONE tick through the paged
+q_len>1 path — accepted-length > 1 tokens per weight stream, the lever
+that beats the HBM decode roofline (SCALING §3j). ``sampling=
+{"temperature", "top_k", "top_p"}`` samples in-program with per-slot
+RNG keys carried in segment state, seeded per request (deterministic
+replay); greedy stays the default and bit-identical. Both ride the
+``("sseg", n_pad, K, steps)`` program family and keep the audited
+one-dispatch/one-fetch contract — acceptance counts travel in the same
+event fetch and the host replay recovers per-request accepted lengths.
 """
 
 from __future__ import annotations
@@ -94,6 +107,37 @@ def _mesh_scope(mesh):
 _WAVE_WIDTHS = (8, 4, 2, 1)  # compiled prefill sub-batch sizes
 
 
+# --- in-program sampling primitives (r15, ISSUE 10) -----------------------
+# Per-slot RNG state rides the segment as RAW uint32 [slots, 2] key data
+# (threefry): raw keys scatter/donate like any int array, so the while-
+# body carries stay trivial. All of these run INSIDE compiled programs.
+
+def _split_rows(raw):
+    """Advance each row's key: (next_state [n,2], consume [n,2])."""
+    nk = jax.vmap(jax.random.split)(raw)
+    return nk[:, 0], nk[:, 1]
+
+
+def _subkeys_rows(raw, n: int):
+    """n consumable subkeys per row: [rows, n, 2]."""
+    return jax.vmap(lambda k: jax.random.split(k, n))(raw)
+
+
+def _categorical_rows(filt, keys):
+    """One independent categorical draw per row: ``filt`` [..., V]
+    filtered logits, ``keys`` [..., 2] raw key per row."""
+    V = filt.shape[-1]
+    toks = jax.vmap(jax.random.categorical)(
+        keys.reshape(-1, 2), filt.reshape(-1, V))
+    return toks.reshape(filt.shape[:-1]).astype(jnp.int32)
+
+
+def _uniform_rows(keys):
+    """One uniform [0,1) draw per row key: keys [..., 2] -> [...]."""
+    u = jax.vmap(lambda k: jax.random.uniform(k))(keys.reshape(-1, 2))
+    return u.reshape(keys.shape[:-1])
+
+
 @dataclass
 class _PendingSegment:
     """A dispatched-but-not-fetched segment (r12): the device futures of
@@ -119,6 +163,10 @@ class _PendingSegment:
     # replay skips those steps — no decode happened on them).
     full_prompts: Optional[List[np.ndarray]] = None
     chunk_marker: Optional[int] = None
+    # r15: True when the segment ran the speculative/sampled program —
+    # its event log carries [steps, slots, K+1] token matrices plus the
+    # per-step accepted counts the host replay distributes
+    spec: bool = False
 
 
 @dataclass
@@ -148,6 +196,17 @@ class Request:
     deadline: float = 0.0
     preemptions: int = 0
     requeues: int = 0
+    # r15 speculative + sampled decoding (ISSUE 10): per-request sampling
+    # seed (only consumed when the engine has a sampling config — the
+    # slot's in-program RNG stream is derived from it at every admission,
+    # folded with len(tokens) so a resume continues deterministically),
+    # and the speculative draft ledger the host replay recovers from the
+    # event log: drafts proposed for / accepted by this request (the
+    # per-request acceptance rate the benchmark histograms by prompt
+    # class).
+    seed: int = 0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     @property
     def done(self) -> bool:
@@ -189,7 +248,10 @@ class ServingEngine:
                  paged: bool = False, page_size: int = 16,
                  num_pages: Optional[int] = None, mesh=None,
                  chunked_prefill: bool = False,
-                 prefill_chunks: Sequence[int] = (8, 16, 32, 64)):
+                 prefill_chunks: Sequence[int] = (8, 16, 32, 64),
+                 speculative: int = 0,
+                 sampling: Optional[dict] = None,
+                 sample_seed: int = 0):
         self.cfg = cfg
         self.params = params
         self.slots = int(slots)
@@ -245,6 +307,43 @@ class ServingEngine:
         if self.chunked and not self.prefill_chunks:
             raise ValueError("chunked_prefill needs a non-empty "
                              "prefill_chunks ladder")
+        # r15 speculative + sampled decoding (ISSUE 10; ROADMAP item 3).
+        # ``speculative=K``: each decode step drafts K tokens per live
+        # slot from the slot's own resident token history (an in-program
+        # n-gram/prompt-suffix lookup — no draft model, no host contact)
+        # and the target model VERIFIES all K+1 positions in one batched
+        # tick through the paged q_len>1 path — accepted-length > 1 per
+        # weight stream is the only lever that beats the decode HBM
+        # roofline (SCALING §3c/§3j). ``sampling`` = {"temperature",
+        # "top_k", "top_p"}: per-slot threaded RNG keys carried in
+        # segment state, seeded per request so serves replay
+        # deterministically. temperature 0 normalises to None so the
+        # default greedy path compiles the EXACT argmax programs
+        # (bit-identical, budget-identical).
+        self.speculative = int(speculative)
+        if self.speculative < 0:
+            raise ValueError(f"speculative draft length must be >= 0, "
+                             f"got {speculative}")
+        samp = None
+        if sampling:
+            t = float(sampling.get("temperature", 1.0))
+            if t < 0.0:
+                raise ValueError(f"temperature must be >= 0, got {t}")
+            if t > 0.0:
+                samp = (t, int(sampling.get("top_k", 0)),
+                        float(sampling.get("top_p", 1.0)))
+        self.sampling = samp
+        self.sample_seed = int(sample_seed)
+        if (self.speculative or self.sampling) and not self.paged:
+            raise ValueError(
+                "speculative/sampled decoding requires paged=True (the "
+                "verify tick reuses the page-indirect q_len>1 path and "
+                "the RNG/history state rides the paged segment family)")
+        # acceptance EWMA (emitted tokens per verify tick, >= 1): the
+        # SLO scheduler threads this through its deadline and
+        # retry_after_s estimates so speculative serves don't over-shed
+        # (each tick retires accept_ewma tokens, not one)
+        self.spec_accept_ewma = 1.0
         if self.paged:
             # paged mode (r11, inference/paged_kv.py): ONE flat page pool
             # + per-slot page tables replace the [slots, max_len] block.
@@ -276,6 +375,7 @@ class ServingEngine:
         self._pos = self._slot_vec()
         self._nxt = self._slot_vec()
         self._rem = self._slot_vec()
+        self._init_spec_state()
         self._pending_seg = None  # at most ONE in-flight dispatched segment
         # r14 cold-start metric (ISSUE 9 satellite; ROADMAP item 5's
         # first deliverable): build→first-emitted-token wall time, the
@@ -303,13 +403,52 @@ class ServingEngine:
             v = jax.device_put(v, NamedSharding(self.mesh, P()))
         return v
 
+    def _slot_arr(self, shape, dtype):
+        """Zeroed per-slot state array, replicated over the engine's mesh
+        (same contract as ``_slot_vec`` for non-vector shapes: the
+        speculative token-history mirror and the per-slot RNG keys)."""
+        v = jnp.zeros(shape, dtype)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            v = jax.device_put(v, NamedSharding(self.mesh, P()))
+        return v
+
+    def _init_spec_state(self) -> None:
+        """(Re)build the speculative / sampling slot state (r15):
+
+        * ``_hist`` [slots, max_len+1] int32 — the slot's TOKEN history
+          mirror of its page-resident KV rows (prompt suffix + every
+          verified token): the in-program n-gram draft table. One
+          overflow column past max_len absorbs clamped writes so a
+          near-capacity slot can never corrupt valid history.
+        * ``_hstart`` [slots] — first valid history index (= the shared
+          prefix length at admission: prefix TOKENS are not re-staged,
+          so the draft scan starts where this slot's own tokens do).
+        * ``_rng`` [slots, 2] uint32 — raw per-slot PRNG key state,
+          re-seeded from the request's seed at every admission.
+        """
+        if self.paged and (self.speculative or self.sampling):
+            self._hist = self._slot_arr((self.slots, self.max_len + 1),
+                                        jnp.int32)
+            self._hstart = self._slot_vec()
+        else:
+            self._hist = self._hstart = None
+        if self.paged and self.sampling:
+            self._rng = self._slot_arr((self.slots, 2), jnp.uint32)
+        else:
+            self._rng = None
+
     def cache_info(self) -> dict:
         """Compiled-program cache keys (analysis.recompile lint): admit
         programs key on (bucket, nb), segments on ("seg", n_pad, s_max,
         pre_max, steps), paged segments on ("pseg", n_pad, s_max, steps),
         chunked paged segments on ("cseg", n_pad, s_max_c, C, steps) with
-        C drawn from the declared prefill_chunks ladder — all bucketed
-        by construction, so key-count growth here means a shape leaked
+        C drawn from the declared prefill_chunks ladder, speculative/
+        sampled segments on ("sseg", n_pad, K, steps) with the admit
+        width PINNED to the largest bucket — all bucketed by
+        construction, so key-count growth here means a shape leaked
         past the buckets (the 2.5 s mid-serve compile class this
         engine's width pinning fixed). Note the PAGED keys carry no
         pre_max: shared-prefix geometry rides the page tables as DATA,
@@ -339,7 +478,8 @@ class ServingEngine:
             self.cfg.head_dim)
 
     # --- request intake ---------------------------------------------------
-    def add_request(self, prompt, max_new_tokens: int) -> int:
+    def add_request(self, prompt, max_new_tokens: int,
+                    seed: Optional[int] = None) -> int:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) > max(self.buckets):
             raise ValueError(
@@ -357,8 +497,13 @@ class ServingEngine:
                     f"{self.pager.num_pages - 1} — it could never admit")
         rid = self._next_rid
         self._next_rid += 1
+        # per-request sampling seed: explicit, or derived from the
+        # engine's base seed + rid — either way fixed at intake, so one
+        # trace replays its sampled streams identically serve to serve
         self._queue.append(Request(rid, prompt, int(max_new_tokens),
-                                   submit_time=time.perf_counter()))
+                                   submit_time=time.perf_counter(),
+                                   seed=(self.sample_seed + rid
+                                         if seed is None else int(seed))))
         return rid
 
     def _retire(self, r: Request) -> None:
@@ -372,7 +517,8 @@ class ServingEngine:
         on all of it trace byte-identical programs."""
         return (self.cfg, self.slots, self.max_len, self.eos, self.chunk,
                 self.paged, self.pager.max_pages if self.paged else None,
-                self.mesh, key)
+                self.mesh, self.speculative, self.sampling,
+                self.chunked, self.prefill_chunks, self.buckets, key)
 
     def _memo_prog(self, key: tuple, build):
         """Two-level memo: per-engine ``_progs`` (the recompile lint's
@@ -865,7 +1011,8 @@ class ServingEngine:
 
     def _replay_segment(self, picked, toks, aq, aslot, steps: int, n: int,
                         on_admit=None, on_retire=None,
-                        chunk_marker: Optional[int] = None):
+                        chunk_marker: Optional[int] = None,
+                        acc=None, spec_stats: Optional[dict] = None):
         """Host replay of a segment's event log — ONE contract for the
         contiguous and paged engines: walk the log chronologically,
         tracking slot occupancy (admits rebind a slot; decode ticks
@@ -878,7 +1025,17 @@ class ServingEngine:
         before the new page list installs. ``chunk_marker`` (chunked
         prefill): aq values >= it mark NON-FINAL prefill-chunk steps —
         no decode ran and no token surfaced there, so the replay skips
-        the step."""
+        the step.
+
+        r15 speculative event logs: ``acc`` ([steps, slots]) makes
+        ``toks`` a [steps, slots, K+1] token matrix — a decode step is
+        a VERIFY tick that emitted ``acc[st, s]`` tokens for slot ``s``
+        (admits carry their one token at column 0). The replay walks
+        each slot's accepted prefix, recovers per-request accepted
+        lengths into the Request ledger, and accumulates the segment's
+        draft accounting into ``spec_stats`` — host arithmetic on the
+        SAME single fetched log, zero extra device contact."""
+        spec_k = self.speculative
         admitted, first_tokens, finished = [], [], []
         new_tokens = eos_stops = 0
         for st in range(steps):
@@ -891,7 +1048,8 @@ class ServingEngine:
                 assert self._active[s] is None, "admit into a live slot"
                 if on_admit is not None:
                     on_admit(q, s)
-                t = int(toks[st, s])
+                t = int(toks[st, s, 0] if acc is not None
+                        else toks[st, s])
                 r.tokens.append(t)
                 new_tokens += 1
                 admitted.append(r.rid)
@@ -913,7 +1071,7 @@ class ServingEngine:
                     # remaining = owed minus everything generated so far
                     # (fresh: max_new - 1; resumed: the true tail)
                     self._rem_host[s] = r.max_new_tokens - len(r.tokens)
-            else:                          # decode tick
+            elif acc is None:              # decode tick
                 for s, r in enumerate(self._active):
                     if r is None or self._rem_host[s] <= 0:
                         continue
@@ -932,6 +1090,43 @@ class ServingEngine:
                         finished.append(r.rid)
                         if on_retire is not None:
                             on_retire(r, s)
+            else:                          # spec VERIFY tick
+                any_live = False
+                for s, r in enumerate(self._active):
+                    if r is None or self._rem_host[s] <= 0:
+                        continue
+                    any_live = True
+                    k_emit = int(acc[st, s])
+                    if spec_stats is not None:
+                        spec_stats["slot_ticks"] += 1
+                    if spec_k:
+                        r.spec_proposed += spec_k
+                        r.spec_accepted += max(k_emit - 1, 0)
+                        if spec_stats is not None:
+                            spec_stats["proposed"] += spec_k
+                            spec_stats["accepted"] += max(k_emit - 1, 0)
+                    for i in range(k_emit):
+                        if self._rem_host[s] <= 0:
+                            break
+                        t = int(toks[st, s, i])
+                        r.tokens.append(t)
+                        new_tokens += 1
+                        if spec_stats is not None:
+                            spec_stats["emitted"] += 1
+                        if len(r.tokens) == 1:
+                            first_tokens.append(r.rid)
+                        self._rem_host[s] -= 1
+                        if self.eos is not None and t == self.eos:
+                            self._rem_host[s] = 0
+                            eos_stops += 1
+                    if self._rem_host[s] == 0:
+                        self._retire(r)
+                        self._active[s] = None
+                        finished.append(r.rid)
+                        if on_retire is not None:
+                            on_retire(r, s)
+                if any_live and spec_stats is not None:
+                    spec_stats["verify_steps"] += 1
         if new_tokens and self.cold_start_s is None:
             self._note_cold_start()
         return admitted, first_tokens, finished, new_tokens, eos_stops
@@ -971,6 +1166,34 @@ class ServingEngine:
             for hook in SEGMENT_HOOKS:
                 hook(steps, new_tokens, len(finished))
 
+    def _spec_telemetry(self, stats: dict) -> None:
+        """Per-segment speculative accounting (r15 satellite): counters
+        for drafts proposed/accepted/rejected, the live accept-rate and
+        effective-tokens-per-tick gauges, a ``spec_accept`` flight
+        event, and the acceptance EWMA the SLO scheduler threads into
+        its deadline/retry estimates. Host arithmetic on the replayed
+        event log — the zero-extra-sync telemetry contract holds."""
+        prop, accepted = stats["proposed"], stats["accepted"]
+        if prop:
+            _metrics.counter("spec.proposed").inc(prop)
+            _metrics.counter("spec.accepted").inc(accepted)
+            _metrics.counter("spec.rejected").inc(prop - accepted)
+            _metrics.gauge("spec.accept_rate").set(accepted / prop)
+        if stats["slot_ticks"]:
+            # PER-SLOT accepted length: tokens one slot retires per
+            # verify tick (not batch tokens/tick — a full batch already
+            # amortises the weight stream over slots; this gauge is the
+            # roofline-beating factor on TOP of that, SCALING §3j)
+            eff = stats["emitted"] / stats["slot_ticks"]
+            _metrics.gauge("spec.effective_tok_per_tick").set(eff)
+            # EWMA over segments: each slot retires ~eff tokens per
+            # tick, the factor the SLO deadline/shed estimates divide by
+            self.spec_accept_ewma = 0.5 * self.spec_accept_ewma + 0.5 * eff
+            _flight.record("spec_accept", proposed=prop,
+                           accepted=accepted,
+                           rate=round(accepted / prop, 4) if prop else 0.0,
+                           tok_per_tick=round(eff, 4))
+
     def free_slot_count(self) -> int:
         return sum(1 for r in self._active if r is None)
 
@@ -984,6 +1207,8 @@ class ServingEngine:
         self._pos = self._slot_vec()
         self._nxt = self._slot_vec()
         self._rem = self._slot_vec()
+        self._init_spec_state()
+        self.spec_accept_ewma = 1.0
         self._rem_host = [0] * self.slots
         self._queue = []
         self._finished = []
@@ -1084,6 +1309,7 @@ class ServingEngine:
         self._pos = self._slot_vec()
         self._nxt = self._slot_vec()
         self._rem = self._slot_vec()
+        self._init_spec_state()
         if self.paged:
             self.pager.reset()
         return orphans
@@ -1581,6 +1807,289 @@ class ServingEngine:
 
         return segment
 
+    # --- speculative + sampled segments (r15: ISSUE 10, ROADMAP item 3) ---
+    def _spec_segment_prog(self, n_pad: int, max_steps: int):
+        """The paged segment with MULTI-TOKEN VERIFIED TICKS: every
+        decode step drafts ``K = self.speculative`` tokens per live slot
+        from the slot's page-resident token history (an in-program
+        n-gram/prompt-suffix lookup — the draft table is built from
+        segment state, zero host contact) and scores all K+1 positions
+        in ONE forward pass through the unified paged q_len>1 path
+        (``llama.forward_with_pages`` at the slot's context offset —
+        exactly the chunked-prefill machinery, so verification adds no
+        new kernel). Decode is HBM-bound (SCALING §3c: each tick streams
+        the full weight set), so emitting accepted-length > 1 tokens per
+        weight stream is the one lever that BEATS the roofline instead
+        of approaching it (SCALING §3j).
+
+        Acceptance is computed in-program and rolled into the event log
+        (``out`` [steps, slots, K+1] + ``acc`` [steps, slots]): the host
+        replay recovers per-request accepted lengths from the SAME
+        single fetch — the audited one-dispatch/one-fetch contract is
+        untouched. Greedy verification emits the target's argmax chain,
+        so the speculative greedy stream is token-identical to the
+        non-speculative engine by construction (the draft only decides
+        how MANY chain tokens emit per tick, never their values). With a
+        sampling config, rejection sampling against the deterministic
+        (delta) draft keeps the emitted stream distributed exactly as
+        non-speculative sampling; per-slot RNG keys ride segment state,
+        re-seeded from the request's seed at admission.
+
+        Admission reuses the r13 chunk branch with the chunk width C
+        pinned by config (the declared ladder when ``chunked_prefill``,
+        else the full admit window = one-step prefill), and the admit
+        window itself is PINNED to the largest bucket — the memo key
+        ("sseg", n_pad, K, steps) carries no width, so prefix hits and
+        arrival jitter add zero program shapes. K = 0 with a sampling
+        config is the plain SAMPLED paged segment (a verify tick over
+        one position is exactly a sampled decode tick), which keeps the
+        canonical paged/chunked greedy programs byte-identical."""
+        K = self.speculative
+        key = ("sseg", n_pad, K, max_steps)
+        return self._memo_prog(key, lambda: self._build_spec_segment_prog(
+            n_pad, K, max_steps))
+
+    def _build_spec_segment_prog(self, n_pad: int, K: int, max_steps: int):
+        cfg, slots, eos = self.cfg, self.slots, self.eos
+        max_pages = self.pager.max_pages
+        max_len = self.max_len
+        sampling = self.sampling
+        s_max = self.buckets[-1]
+        if self.chunked:
+            C = self._prefill_chunk_for(s_max)
+            s_max = -(-s_max // C) * C
+        else:
+            C = s_max
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 6))
+        def segment(params, pool, ptab, pos, nxt, rem, hist, hstart, rng,
+                    prompts, lens, gens, pre_lens, req_tables, seeds,
+                    n_real):
+            i32 = jnp.int32
+            sl = jnp.arange(slots)
+            st = dict(
+                pool=pool, pt=ptab, pos=pos, nxt=nxt, rem=rem,
+                hist=hist, hstart=hstart, rng=rng,
+                out=jnp.zeros((max_steps, slots, K + 1), i32),
+                acc=jnp.zeros((max_steps, slots), i32),
+                aq=jnp.full((max_steps,), n_pad, i32),    # n_pad = verify
+                aslot=jnp.zeros((max_steps,), i32),
+                pf=i32(-1), pfq=i32(0), pfo=i32(0), phase=i32(0),
+                qidx=i32(0), step=i32(0),
+            )
+
+            def _startable(st):
+                ln = lens[jnp.minimum(st["qidx"], n_pad - 1)]
+                chunks = (ln + C - 1) // C
+                return ((st["qidx"] < n_real)
+                        & (st["step"] + 2 * chunks <= max_steps))
+
+            def cond(st):
+                work = (jnp.any(st["rem"] > 0) | (st["pf"] >= 0)
+                        | _startable(st))
+                return work & (st["step"] < max_steps)
+
+            def chunk(st):
+                # the admit path — the r13 chunk branch plus the spec
+                # state writes: the chunk's tokens land in the slot's
+                # history mirror, hstart pins the draft-scan floor at
+                # the shared-prefix boundary, and a sampling engine
+                # re-seeds the slot's RNG from the request seed
+                starting = st["pf"] < 0
+                s = jnp.where(starting,
+                              jnp.argmin(st["rem"]).astype(jnp.int32),
+                              st["pf"])
+                q = jnp.where(starting, st["qidx"], st["pfq"])
+                off = jnp.where(starting, 0, st["pfo"])
+                row = jax.lax.dynamic_slice(req_tables, (q, 0),
+                                            (1, max_pages))
+                pt = st["pt"].at[s].set(row[0])
+                ln = lens[q]
+                pln = pre_lens[q]
+                ctok = jax.lax.dynamic_slice(prompts, (q, off), (1, C))
+                logits, pool = llama.forward_with_pages(
+                    params, ctok, cfg, st["pool"], row,
+                    jnp.reshape(pln + off, (1,)),
+                    logit_pos=jnp.minimum(ln - 1 - off, C - 1))
+                done = off + C >= ln
+                if sampling is None:
+                    t0 = jnp.argmax(logits, axis=-1).astype(i32).reshape(())
+                    rng_new = st["rng"]
+                else:
+                    k0, kuse = jax.random.split(
+                        jax.random.PRNGKey(seeds[q]))
+                    filt = llama.sample_filter_logits(logits, *sampling)
+                    t0 = jax.random.categorical(
+                        kuse, filt, axis=-1).astype(i32).reshape(())
+                    rng_new = st["rng"].at[s].set(k0)
+                rem_new = gens[q] - 1
+                if eos is not None:
+                    rem_new = jnp.where(t0 == eos, 0, rem_new)
+                # token history: the chunk's suffix tokens at absolute
+                # positions pln+off.. (clamped into the overflow column
+                # so a near-capacity admit cannot corrupt valid rows)
+                hidx = jnp.minimum(pln + off + jnp.arange(C), max_len)
+                hist_new = st["hist"].at[s, hidx].set(ctok[0])
+                return dict(
+                    pool=pool, pt=pt,
+                    pos=jnp.where(done, st["pos"].at[s].set(pln + ln),
+                                  st["pos"]),
+                    nxt=jnp.where(done, st["nxt"].at[s].set(t0),
+                                  st["nxt"]),
+                    rem=jnp.where(done, st["rem"].at[s].set(rem_new),
+                                  st["rem"]),
+                    hist=hist_new,
+                    hstart=st["hstart"].at[s].set(pln),
+                    rng=jnp.where(done, rng_new, st["rng"]),
+                    out=jnp.where(done,
+                                  st["out"].at[st["step"], s, 0].set(t0),
+                                  st["out"]),
+                    acc=jnp.where(done,
+                                  st["acc"].at[st["step"], s].set(1),
+                                  st["acc"]),
+                    aq=st["aq"].at[st["step"]].set(
+                        jnp.where(done, q, i32(n_pad + 1))),
+                    aslot=st["aslot"].at[st["step"]].set(s),
+                    pf=jnp.where(done, i32(-1), s),
+                    pfq=q, pfo=off + C, phase=i32(1),
+                    qidx=jnp.where(starting, st["qidx"] + 1, st["qidx"]),
+                    step=st["step"],
+                )
+
+            def verify(st):
+                live = st["rem"] > 0
+                pos, nxt = st["pos"], st["nxt"]
+                hist, hstart = st["hist"], st["hstart"]
+                if K:
+                    # n-gram draft (host-free): match the running bigram
+                    # (hist[pos-1], nxt) against the slot's own history;
+                    # on a hit the K tokens after the LATEST match are
+                    # this tick's draft, else repeat-last (acceptance 0
+                    # costs nothing but the already-paid tick)
+                    hcols = jnp.arange(max_len + 1)
+                    prev = jnp.take_along_axis(
+                        hist, jnp.maximum(pos - 1, 0)[:, None],
+                        axis=1)[:, 0]
+                    hprev = jnp.concatenate(
+                        [jnp.zeros((slots, 1), i32), hist[:, :-1]],
+                        axis=1)
+                    match = ((hist == nxt[:, None])
+                             & (hprev == prev[:, None])
+                             & (hcols[None] >= hstart[:, None] + 1)
+                             & (hcols[None] < pos[:, None]))
+                    found = jnp.any(match, axis=1)
+                    j = jnp.argmax(jnp.where(match, hcols[None], -1),
+                                   axis=1)
+                    didx = jnp.minimum(
+                        j[:, None] + 1 + jnp.arange(K)[None],
+                        jnp.maximum(pos - 1, 0)[:, None])
+                    drafts = jnp.take_along_axis(hist, didx, axis=1)
+                    drafts = jnp.where(found[:, None], drafts,
+                                       nxt[:, None])
+                else:
+                    drafts = jnp.zeros((slots, 0), i32)
+                # ONE verify tick over all K+1 positions per slot: the
+                # paged q_len>1 path at each slot's context offset —
+                # the same single weight stream a 1-token tick pays
+                x = jnp.concatenate([nxt[:, None], drafts], axis=1)
+                logits, pool = llama.forward_with_pages(
+                    params, x, cfg, st["pool"], st["pt"], pos,
+                    live=live, logits_all=True)       # [slots, K+1, V]
+                if sampling is None:
+                    # greedy: the target argmax chain IS the emitted
+                    # stream; drafts only gate how much of it lands
+                    e = jnp.argmax(logits, axis=-1).astype(i32)
+                    ok = drafts == e[:, :K]
+                    rng_new = st["rng"]
+                else:
+                    # rejection sampling for a deterministic (delta)
+                    # draft: accept d_i with prob p_i(d_i); at the
+                    # first rejection resample from p_i with d_i
+                    # removed; full acceptance earns the bonus token
+                    # from the K+1-th distribution — emitted tokens
+                    # are distributed exactly as one-at-a-time sampling
+                    filt = llama.sample_filter_logits(logits, *sampling)
+                    probs = jax.nn.softmax(filt, axis=-1)
+                    rng_new, kuse = _split_rows(st["rng"])
+                    sub = _subkeys_rows(kuse, 2 * K + 1)
+                    pad_d = jnp.concatenate(
+                        [drafts, nxt[:, None]], axis=1)   # ii<a never
+                    if K:                                 # hits col K
+                        u = _uniform_rows(sub[:, :K])
+                        pd = jnp.take_along_axis(
+                            probs[:, :K], drafts[..., None],
+                            axis=-1)[..., 0]
+                        ok = u < pd
+                        onehot = jax.nn.one_hot(
+                            drafts, filt.shape[-1], dtype=jnp.bool_)
+                        res = _categorical_rows(
+                            jnp.where(onehot, -jnp.inf, filt[:, :K]),
+                            sub[:, K:2 * K])
+                    else:
+                        ok = jnp.zeros((slots, 0), jnp.bool_)
+                        res = jnp.zeros((slots, 0), i32)
+                    bonus = _categorical_rows(filt[:, K], sub[:, 2 * K])
+                    a0 = jnp.cumprod(ok.astype(i32), axis=1).sum(axis=1)
+                    res_all = jnp.concatenate([res, bonus[:, None]],
+                                              axis=1)
+                    ii = jnp.arange(K + 1)
+                    e = jnp.where(ii[None] < a0[:, None], pad_d, res_all)
+                a = jnp.cumprod(ok.astype(i32), axis=1).sum(axis=1)
+                m = jnp.minimum(a + 1, st["rem"])  # never emit past owed
+                m = jnp.where(live, m, 0)
+                if eos is not None:
+                    ii2 = jnp.arange(K + 1)
+                    eosm = (e == eos) & (ii2[None] < m[:, None])
+                    has_eos = jnp.any(eosm, axis=1)
+                    m = jnp.where(
+                        has_eos,
+                        jnp.argmax(eosm, axis=1).astype(i32) + 1, m)
+                mi = jnp.maximum(m - 1, 0)
+                nxt_new = jnp.where(
+                    m > 0,
+                    jnp.take_along_axis(e, mi[:, None], axis=1)[:, 0],
+                    nxt)
+                rem_new = st["rem"] - m
+                if eos is not None:
+                    rem_new = jnp.where(live & has_eos, 0, rem_new)
+                # history: the K+1 INPUT tokens now page-resident at
+                # pos..pos+K; entries past pos+m are stale, invisible
+                # to the draft scan (< pos) and overwritten before the
+                # next tick's attention can see them
+                hwidx = jnp.minimum(
+                    pos[:, None] + jnp.arange(K + 1)[None], max_len)
+                hist_new = hist.at[sl[:, None], hwidx].set(x)
+                return dict(
+                    pool=pool, pt=st["pt"],
+                    pos=pos + m, nxt=nxt_new, rem=rem_new,
+                    hist=hist_new, hstart=hstart, rng=rng_new,
+                    out=st["out"].at[st["step"]].set(e),
+                    acc=st["acc"].at[st["step"]].set(m),
+                    aq=st["aq"], aslot=st["aslot"],
+                    pf=st["pf"], pfq=st["pfq"], pfo=st["pfo"],
+                    phase=i32(0),
+                    qidx=st["qidx"], step=st["step"],
+                )
+
+            def body(st):
+                live_any = jnp.any(st["rem"] > 0)
+                pf_active = st["pf"] >= 0
+                can_start = ((~pf_active) & jnp.any(st["rem"] == 0)
+                             & _startable(st))
+                do_chunk = ((pf_active | can_start)
+                            & ((st["phase"] == 0) | ~live_any))
+                st = jax.lax.cond(do_chunk, chunk, verify, st)
+                st["step"] = st["step"] + 1
+                return st
+
+            st = jax.lax.while_loop(cond, body, st)
+            return (st["pool"], st["pt"], st["pos"], st["nxt"], st["rem"],
+                    st["hist"], st["hstart"], st["rng"],
+                    st["out"], st["aq"], st["aslot"], st["acc"],
+                    st["step"], st["qidx"])
+
+        return segment
+
     def _dispatch_segment_paged(self, max_steps: int, prefix_cache,
                                 n_pad: int, now: float) -> _PendingSegment:
         """The paged ``run_segment``: pick FCFS gated on PAGES FREE
@@ -1658,10 +2167,14 @@ class ServingEngine:
                            deferred=deferred, pages_free=pgr.pages_free)
         n = len(picked)
 
+        spec = bool(self.speculative or self.sampling)
         # suffix width: same pinning rule as the contiguous segment —
         # largest bucket when nothing was reused, the suffix bucket when
-        # prefix hits shorten the prefill
-        if prefix_cache is None or not any(pre_lens_l):
+        # prefix hits shorten the prefill. SPEC segments always pin to
+        # the largest bucket: the ("sseg", n_pad, K, steps) key family
+        # deliberately carries no width, so prefix hits stay page DATA
+        # and add zero program shapes.
+        if spec or prefix_cache is None or not any(pre_lens_l):
             s_max = self.buckets[-1]
         else:
             suf_max = max((len(fulls[j]) - pre_lens_l[j]
@@ -1680,12 +2193,23 @@ class ServingEngine:
                     f"{worst} steps) — raise seg_steps or shrink the "
                     f"prompt buckets / chunk ladder")
             chunk_marker = n_pad + 1
+        if spec:
+            # the spec program admits through the chunk branch (one
+            # full-width chunk when unchunked), so non-final chunk
+            # steps log the same marker and a start needs 2*chunks of
+            # step budget
+            chunk_marker = n_pad + 1
+            if max_steps < 2:
+                raise ValueError("speculative segments need seg_steps "
+                                 ">= 2 (a prefill start reserves one "
+                                 "chunk + one verify step)")
 
         prompts = np.zeros((n_pad, s_max), np.int32)
         lens = np.ones((n_pad,), np.int32)
         gens = np.zeros((n_pad,), np.int32)   # gen 0 -> never admitted
         pre_lens = np.zeros((n_pad,), np.int32)
         req_tables = np.zeros((n_pad, pgr.max_pages), np.int32)
+        seeds = np.zeros((n_pad,), np.int32)
         for j, r in enumerate(picked):
             suf = fulls[j][pre_lens_l[j]:]
             prompts[j, :len(suf)] = suf
@@ -1693,6 +2217,34 @@ class ServingEngine:
             gens[j] = r.max_new_tokens - len(r.tokens)
             pre_lens[j] = pre_lens_l[j]
             req_tables[j] = tables[j]
+            # the slot's RNG stream derives from (request seed, tokens
+            # already delivered): a fresh serve replays identically, a
+            # preempt/failover resume continues from a deterministic
+            # fold instead of re-playing consumed draws
+            seeds[j] = (r.seed + 0x9E3779B1 * len(r.tokens)) & 0x7FFFFFFF
+
+        if spec:
+            rng = (self._rng if self._rng is not None
+                   else jnp.zeros((self.slots, 2), jnp.uint32))
+            with _mesh_scope(self.mesh):
+                out = self._spec_segment_prog(n_pad, max_steps)(
+                    self.params, pgr.pool, pgr.page_table, self._pos,
+                    self._nxt, self._rem, self._hist, self._hstart, rng,
+                    jnp.asarray(prompts), jnp.asarray(lens),
+                    jnp.asarray(gens), jnp.asarray(pre_lens),
+                    jnp.asarray(req_tables), jnp.asarray(seeds),
+                    jnp.int32(n))
+            pgr.pool, pgr.page_table = out[0], out[1]
+            self._pos, self._nxt, self._rem = out[2:5]
+            self._hist, self._hstart = out[5], out[6]
+            if self._rng is not None:
+                self._rng = out[7]
+            return _PendingSegment(paged=True, picked=picked, n=n,
+                                   now=now, prefix_cache=prefix_cache,
+                                   dev=out[8:], pre_lens=pre_lens_l,
+                                   req_pages=req_pages,
+                                   full_prompts=fulls,
+                                   chunk_marker=chunk_marker, spec=True)
 
         prog = (self._chunked_segment_prog(n_pad, s_max, C, max_steps)
                 if self.chunked
@@ -1717,12 +2269,20 @@ class ServingEngine:
         pgr = self.pager
         psz = self.page_size
         # THE per-segment sync (same audited label + budget as the
-        # contiguous engine: exactly one device contact per segment)
+        # contiguous engine: exactly one device contact per segment —
+        # the spec program's acceptance counts ride the same fetch)
+        acc = spec_stats = None
         with allowed_sync("serving.segment_event_fetch"):
-            toks, aq, aslot, steps, qadm = jax.device_get(p.dev)
+            if p.spec:
+                toks, aq, aslot, acc, steps, qadm = jax.device_get(p.dev)
+            else:
+                toks, aq, aslot, steps, qadm = jax.device_get(p.dev)
         steps, qadm = int(steps), int(qadm)
         self.last_run_ticks += steps
         self.last_run_chunks += 1
+        if p.spec:
+            spec_stats = {"proposed": 0, "accepted": 0, "emitted": 0,
+                          "verify_steps": 0, "slot_ticks": 0}
 
         # page bookkeeping rides the SHARED replay via hooks; retired
         # slots' releases are DEFERRED past the prefix-cache inserts so
@@ -1740,7 +2300,8 @@ class ServingEngine:
         admitted, first_tokens, finished, new_tokens, eos_stops = \
             self._replay_segment(picked, toks, aq, aslot, steps, n,
                                  on_admit, on_retire,
-                                 chunk_marker=p.chunk_marker)
+                                 chunk_marker=p.chunk_marker,
+                                 acc=acc, spec_stats=spec_stats)
         if p.chunk_marker is not None:
             chunk_steps = int(np.sum(np.asarray(aq[:steps])
                                      >= p.chunk_marker))
@@ -1773,11 +2334,16 @@ class ServingEngine:
             pgr.release_pages(pages)
         pgr._gauges()
 
+        if spec_stats is not None:
+            self._spec_telemetry(spec_stats)
         self._segment_telemetry(steps, admitted, finished, eos_stops,
                                 new_tokens, max(0, n - qadm))
-        return {"steps": steps, "admitted": admitted,
-                "first_tokens": first_tokens, "finished": finished,
-                "tokens": new_tokens}
+        out = {"steps": steps, "admitted": admitted,
+               "first_tokens": first_tokens, "finished": finished,
+               "tokens": new_tokens}
+        if spec_stats is not None:
+            out["spec"] = spec_stats
+        return out
 
     def collect_finished(self) -> Dict[int, List[int]]:
         """Drain the finished list (segment mode's result channel),

@@ -1019,7 +1019,7 @@ def _paged_attention(cfg: LlamaConfig, q, kc, vc, page_table, positions):
 
 
 def forward_with_pages(params, tokens, cfg: LlamaConfig, pool, page_table,
-                       pos, live=None, logit_pos=None):
+                       pos, live=None, logit_pos=None, logits_all=False):
     """``forward_with_cache`` over a PAGED KV pool (inference/paged_kv).
 
     tokens [B, T] run at absolute positions ``pos[b] .. pos[b]+T-1``
@@ -1032,7 +1032,11 @@ def forward_with_pages(params, tokens, cfg: LlamaConfig, pool, page_table,
     ([B] bool, optional) routes retired slots' writes to the reserved
     trash page 0 instead (a frozen slot must never write a page the
     allocator may have handed to someone else), as do positions past
-    the table. Returns (logits [B, V], updated pool)."""
+    the table. Returns (logits [B, V], updated pool) — or, with
+    ``logits_all=True``, logits at EVERY query position ([B, T, V]): the
+    speculative verify tick scores all K+1 drafted positions from the
+    same single weight stream (SCALING §3j), so the lm_head matmul runs
+    over the whole chunk instead of one gathered row."""
     dt = cfg.dtype
     B, T = tokens.shape
     psz = pool["k"].shape[2]
@@ -1090,6 +1094,9 @@ def forward_with_pages(params, tokens, cfg: LlamaConfig, pool, page_table,
         x = fused_rms_norm(x[:, 0], params["ln_f"], cfg.rms_eps)[:, None]
     else:
         x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
+    if logits_all:
+        logits = x @ params["lm_head"].astype(dt)     # [B, T, V]
+        return logits.astype(jnp.float32), {"k": kps, "v": vps}
     if logit_pos is None:
         last = x[:, -1]
     elif getattr(logit_pos, "ndim", 0) == 1:
@@ -1129,24 +1136,38 @@ def prompt_kv(params, prompt, cfg: LlamaConfig,
     return cache, logits
 
 
-def _sample(logits, temperature, top_k, key, top_p=1.0):
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def sample_filter_logits(logits, temperature, top_k=0, top_p=1.0):
+    """Temperature/top-k/top-p filtered logits over the LAST dim (any
+    leading dims): tokens outside the kept support are -inf, so the
+    sampling distribution is exactly ``softmax(result)``. Shared by
+    ``generate``'s per-step sampler, the serving engine's in-program
+    samplers (including the speculative verify tick's [slots, K+1, V]
+    batch), and the numpy-reference property tests. ``temperature`` must
+    be > 0 — greedy (temperature 0) is the caller's static argmax
+    branch."""
     logits = logits / temperature
     if top_k:
         k = min(int(top_k), logits.shape[-1])
-        kth = jax.lax.top_k(logits, k)[0][:, -1][:, None]
+        kth = jax.lax.top_k(logits, k)[0][..., k - 1:k]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p < 1.0:
         # nucleus sampling: keep the smallest prefix of the sorted probs
         # whose mass reaches top_p (the first token always survives)
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         keep = cum - probs < top_p              # mass BEFORE this token
-        keep = keep.at[:, 0].set(True)          # the top token always survives
-        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1)
-        logits = jnp.where(logits < cutoff[:, None], -jnp.inf, logits)
+        keep = keep.at[..., 0].set(True)        # the top token always survives
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
+def _sample(logits, temperature, top_k, key, top_p=1.0):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = sample_filter_logits(logits, temperature, top_k, top_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 

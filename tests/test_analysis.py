@@ -280,12 +280,12 @@ class TestCollectiveAudit:
 
 class TestBudgetGate:
     def test_gate_canonical_programs_within_budget(self):
-        """THE tier-1 smoke gate: every canonical program (seven as of
-        r13, incl. the mp-sharded tp_serving_segment and the chunked-
-        prefill chunked_serving_segment) audits clean against its
-        pinned budget — a reintroduced host sync, stray shape compile,
-        new relayout, dropped donation, or off-axis collective fails
-        here."""
+        """THE tier-1 smoke gate: every canonical program (eight as of
+        r15, incl. the mp-sharded tp_serving_segment, the chunked-
+        prefill chunked_serving_segment and the speculative
+        spec_serving_segment) audits clean against its pinned budget —
+        a reintroduced host sync, stray shape compile, new relayout,
+        dropped donation, or off-axis collective fails here."""
         from paddle_tpu.analysis.__main__ import main
 
         assert main(["--gate"]) == 0

@@ -698,6 +698,68 @@ class TestTelemetryAudit:
         assert set(rep_on.metrics["host_syncs_allowed"]) == {
             "serving.segment_event_fetch"}
 
+    def test_spec_serve_budgets_identical_with_telemetry(self,
+                                                         tiny_llama):
+        """r15 satellite (ISSUE 10): the SPECULATIVE serve loop — draft
+        accounting counters, accept-rate / effective-tok-per-tick
+        gauges, spec_accept flight events, the per-request accepted-
+        length ledger — adds ZERO device contacts: sync metrics over a
+        speculative serve are bit-identical with telemetry on vs off,
+        the only allowed label is the per-segment event fetch (the
+        acceptance log rides it), and the emitted TOKENS are identical
+        either way (the spec-on/off bit-identity audit)."""
+        import numpy as np
+
+        from paddle_tpu.analysis import auditor
+        from paddle_tpu.inference.scheduler import (OnlineScheduler,
+                                                    staggered_arrivals)
+        from paddle_tpu.inference.serving import ServingEngine
+        from paddle_tpu.parallel import set_mesh
+
+        set_mesh(None)
+        cfg, params = tiny_llama
+        arrivals = staggered_arrivals(9, 4, 0.01, cfg.vocab_size,
+                                      prompt_lens=(8, 12),
+                                      gen_lens=(4, 6))
+
+        def mk(spec):
+            eng = ServingEngine(cfg, params, slots=2, max_len=64,
+                                chunk=4, prompt_buckets=(16,),
+                                paged=True, page_size=16,
+                                speculative=spec)
+            return eng, OnlineScheduler(eng, seg_steps=16)
+
+        # spec-on/off token bit-identity (greedy): the speculative
+        # engine must emit exactly the non-speculative stream
+        eng_off, sch_off = mk(0)
+        sch_off.serve(arrivals)
+        base = sch_off.results()
+        eng, sch = mk(3)
+        sch.serve(arrivals)            # warm pass: compiles + fetches
+        assert sch.results() == base, "speculative serve changed tokens"
+
+        def replay():
+            eng.reset_slots()
+            sch._reqs.clear()
+            return sch.serve(arrivals)
+
+        def audit(enabled):
+            prev = metrics.set_enabled(enabled)
+            try:
+                return auditor.audit_replay("spec_serve", replay,
+                                            replays=2)
+            finally:
+                metrics.set_enabled(prev)
+
+        rep_on, rep_off = audit(True), audit(False)
+        for key in ("host_syncs_flagged", "host_syncs_allowed",
+                    "warm_compiles"):
+            assert rep_on.metrics[key] == rep_off.metrics[key], (
+                key, rep_on.metrics[key], rep_off.metrics[key])
+        assert rep_on.metrics["host_syncs_flagged"] == 0
+        assert set(rep_on.metrics["host_syncs_allowed"]) == {
+            "serving.segment_event_fetch"}
+
 
 class TestOverheadGate:
     def test_online_serve_overhead_within_2pct(self, tiny_serving):
