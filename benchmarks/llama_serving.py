@@ -860,6 +860,67 @@ def run_overload(model_name, cfg, params, llama, n=32, seed=0, slots=4,
     ok = base and all(hi99[r] <= 1.5 * base for r in ratios[1:])
     log(f"high-class ttft p99 vs 1x: {bounded} -> "
         f"{'BOUNDED (<=1.5x)' if ok else 'MISS'}")
+
+    # --- r16 (ISSUE 11): black-box journal + bit-exact in-lane replay ---
+    # The 4x serve — the one an operator would actually need to
+    # reconstruct — recorded to a journal, replayed offline, and the
+    # decision+token streams diffed; plus the journal-write overhead
+    # (min-of-2 interleaved on/off, the r10 telemetry-overhead method)
+    # and one shed request's journey joined from the records.
+    import tempfile
+
+    from paddle_tpu.observability import journal as jmod
+    from paddle_tpu.observability import replay as rmod
+
+    rate4 = ratios[-1] * svc_req_s
+    arr4 = poisson_arrivals(seed + 1, n, rate4, cfg.vocab_size,
+                            _ONLINE_PLENS, _ONLINE_GLENS)
+    for i, a in enumerate(arr4):
+        if i % int(1 / high_frac) == 0:
+            a.priority = 0
+        else:
+            a.priority = 1
+            a.deadline_s = lo_deadline_s
+
+    def mk_sched():
+        return SLOScheduler(_slo_engine(cfg, params, slots),
+                            max_queue=3 * slots, seg_steps=seg_steps)
+
+    walls = {"on": [], "off": []}
+    for _ in range(3):
+        for mode in ("off", "on"):
+            sch_o = mk_sched()
+            if mode == "on":
+                jt = jmod.Journal(tempfile.mkdtemp(prefix="jrnl_ovh_"))
+                with jmod.attach(jt):
+                    r_o = sch_o.serve(arr4)
+                jt.close()
+            else:
+                r_o = sch_o.serve(arr4)
+            sch_o.results()
+            walls[mode].append(r_o.makespan_s)
+    overhead_pct = (min(walls["on"]) / min(walls["off"]) - 1.0) * 100
+
+    sch_j = mk_sched()
+    jdir = tempfile.mkdtemp(prefix="journal_overload_")
+    jq = jmod.Journal(jdir)
+    jq.params_info = {"prng_seed": seed}
+    with jmod.attach(jq):
+        rep_j = sch_j.serve(arr4)
+    sch_j.results()
+    jq.close()
+    res = rmod.replay_serve(jdir, params=params)
+    recs = jmod.read_journal(jdir)["records"]
+    shed_rid = next((r["rid"] for r in recs
+                     if r["kind"] == "shed_decision"), None)
+    shed_journey = (jmod.journey_summary(
+        jmod.request_journey(recs, shed_rid)["events"])
+        if shed_rid is not None else None)
+    log(f"journal: {jq.total_records} records, replay_identical="
+        f"{res.identical} ({res.n_decisions} decisions), write overhead "
+        f"{overhead_pct:+.2f}% (min-of-3), shed journey "
+        f"{shed_journey and shed_journey['kinds']}")
+
     return {
         "metric": "serving_overload_slo",
         "model": model_name,
@@ -872,6 +933,19 @@ def run_overload(model_name, cfg, params, llama, n=32, seed=0, slots=4,
         "per_rate": per_rate,
         "high_ttft_p99_ratio_vs_1x": bounded,
         "high_ttft_p99_bounded_1p5x": bool(ok),
+        "journal": {
+            "records": jq.total_records,
+            "decisions": res.n_decisions,
+            "replay_identical": bool(res.identical),
+            "first_divergence": res.divergence,
+            "recorded": {"preemptions": rep_j.preemptions,
+                         "shed": rep_j.shed},
+            "replayed": {"preemptions": res.report.preemptions,
+                         "shed": res.report.shed},
+            "overhead_pct_min_of_3": round(overhead_pct, 2),
+            "overhead_within_2pct": bool(overhead_pct <= 2.0),
+            "shed_journey": shed_journey,
+        },
         "telemetry": _telemetry_section(),
     }
 
@@ -1436,6 +1510,42 @@ def run_failover(model_name, cfg, params, llama, n=24, seed=0, slots=4,
         f"{[p['probes'] for p in rep_r.per_replica]}, tokens identical "
         f"{out_r == out0}")
 
+    # --- r16 (ISSUE 11): journal the replica-kill serve, replay it ------
+    # The black-box bar: the SAME crash schedule recorded to a journal
+    # replays offline to an identical decision + token stream — the
+    # injected fault, the failover requeue and the cross-replica
+    # re-admission reproduced record for record; one failover-requeued
+    # request's journey joined across both replicas rides the artifact.
+    import tempfile
+
+    from paddle_tpu.observability import journal as jmod
+    from paddle_tpu.observability import replay as rmod
+
+    inj_j = FaultInjector(crash={1: 2})
+    engines_j = build_fleet(cfg, params, replicas, slots=slots,
+                            max_len=256, prompt_buckets=(32, 64, 128),
+                            paged=True, page_size=16)
+    router_j = FleetRouter(engines_j, max_queue=4 * slots,
+                           seg_steps=seg_steps, fault_injector=inj_j,
+                           probe_after_s=600.0)
+    jdir = tempfile.mkdtemp(prefix="journal_failover_")
+    jq = jmod.Journal(jdir)
+    jq.params_info = {"prng_seed": seed}
+    with jmod.attach(jq):
+        rep_jf = router_j.serve(arr)
+    router_j.results()
+    jq.close()
+    res = rmod.replay_serve(jdir, params=params)
+    recs = jmod.read_journal(jdir)["records"]
+    rq = next((r for r in recs if r["kind"] == "failover_requeue"), None)
+    fo_journey = (jmod.journey_summary(
+        jmod.request_journey(recs, rq["rid"])["events"])
+        if rq is not None else None)
+    log(f"journal: {jq.total_records} records, replay_identical="
+        f"{res.identical} ({res.n_decisions} decisions), failover "
+        f"journey {fo_journey and fo_journey['kinds']} across replicas "
+        f"{fo_journey and fo_journey['replicas']}")
+
     return {
         "metric": "serving_fleet_failover",
         "model": model_name,
@@ -1461,6 +1571,19 @@ def run_failover(model_name, cfg, params, llama, n=24, seed=0, slots=4,
             "tokens_identical": bool(out_r == out0),
         },
         "injector_events": [list(e) for e in inj.events],
+        "journal": {
+            "records": jq.total_records,
+            "decisions": res.n_decisions,
+            "replay_identical": bool(res.identical),
+            "first_divergence": res.divergence,
+            "recorded": {"failovers": rep_jf.failovers,
+                         "requeued": rep_jf.requeued,
+                         "served": rep_jf.n_requests},
+            "replayed": {"failovers": res.report.failovers,
+                         "requeued": res.report.requeued,
+                         "served": res.report.n_requests},
+            "failover_journey": fo_journey,
+        },
         "telemetry": _telemetry_section(),
     }
 

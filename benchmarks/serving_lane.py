@@ -50,7 +50,13 @@ Writes ``SERVING_r<N>.json`` at the repo root:
               queue depth / slot occupancy / prefix hit rate /
               backpressure counters from paddle_tpu.observability; the
               full rank-tagged snapshots ride inside each mode's
-              "telemetry" section...}}
+              "telemetry" section...},
+   "journal_headline": {...r16 deterministic-journal bars — the 4x
+              overload serve and the replica-kill fleet serve each
+              journaled and replayed in-lane (replay_identical:
+              tokens + decision stream bit-exact), journal write
+              overhead vs the 2% contract, and the shed / cross-replica
+              failover journeys a postmortem reads first...}}
 
 Usage: python benchmarks/serving_lane.py [round_number]
 (no args: derives the round from the highest existing BENCH_r*.json,
@@ -162,6 +168,21 @@ def main() -> int:
         "cold_start_n1_s": (slo.get("cold_start") or {}).get("n1_s"),
         "cold_start_fleet_worst_s": (slo.get("cold_start") or {}).get(
             "fleet_worst_s"),
+    }
+    # r16 (ISSUE 11): lift the deterministic-journal headline — the
+    # black-box bars (bit-exact replay of the overload + replica-kill
+    # serves, journal write overhead vs the 2% contract, and the two
+    # journeys a postmortem reads first)
+    jo = result["overload"].get("journal") or {}
+    jf = result["failover"].get("journal") or {}
+    result["journal_headline"] = {
+        "overload_replay_identical": jo.get("replay_identical"),
+        "failover_replay_identical": jf.get("replay_identical"),
+        "overhead_pct_min_of_3": jo.get("overhead_pct_min_of_3"),
+        "overhead_within_2pct": jo.get("overhead_within_2pct"),
+        "shed_journey_kinds": (jo.get("shed_journey") or {}).get("kinds"),
+        "failover_journey_replicas": (jf.get("failover_journey")
+                                      or {}).get("replicas"),
     }
     path = os.path.join(ROOT, f"SERVING_r{rnd:02d}.json")
     with open(path, "w") as f:

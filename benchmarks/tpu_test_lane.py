@@ -69,6 +69,12 @@ TPU_TEST_FILES = [
     # acceptance-aware SLO estimates, all against the real backend
     # (the verify path reuses the unified paged kernel's q_len>1 rows)
     "tests/test_spec_sampling.py",
+    # r16 (ISSUE 11): the deterministic serving journal — replay
+    # identity of journaled overload + fleet-failover serves on the
+    # real backend (the fed decision clock makes replay timing-immune,
+    # so chip compiles must not perturb a single decision), journey
+    # joins, and the journaled-serve sync audit
+    "tests/test_journal.py",
 ]
 
 

@@ -20,6 +20,15 @@ Usage::
                                                   # loopback scrape server),
                                                   # budgets bit-identical
                                                   # to monitor-off
+    python -m paddle_tpu.analysis --gate --journal on  # (default) the r16
+                                                  # contract: the
+                                                  # deterministic serving
+                                                  # journal ATTACHED (every
+                                                  # flight event + decision
+                                                  # clock read journaled to
+                                                  # JSONL), budgets
+                                                  # bit-identical to
+                                                  # --journal off
 """
 
 from __future__ import annotations
@@ -98,12 +107,25 @@ def main(argv=None) -> int:
                          "engine segment (serving.SEGMENT_HOOKS) and an "
                          "OpsServer scraping on loopback — budgets must "
                          "be bit-identical to --ops off")
+    ap.add_argument("--journal", choices=("on", "off"), default="on",
+                    help="audit with the r16 deterministic serving "
+                         "journal attached (flight superset + decision-"
+                         "clock JSONL recording) — budgets must be "
+                         "bit-identical to --journal off")
     args = ap.parse_args(argv)
 
     from .. import observability
     from . import audit_program, budgets, programs
 
     prev_telemetry = observability.set_enabled(args.telemetry == "on")
+    jrnl = None
+    if args.journal == "on":
+        import tempfile
+
+        jdir = tempfile.mkdtemp(prefix="paddle_tpu_gate_journal_")
+        jrnl = observability.Journal(jdir)
+        observability.journal.install(jrnl)
+        print(f"journal attached: {jdir}")
     ops = None
     if args.ops == "on":
         ops = _attach_ops()
@@ -131,6 +153,11 @@ def main(argv=None) -> int:
 
     if ops is not None:
         _detach_ops(ops)
+    if jrnl is not None:
+        observability.journal.uninstall(jrnl)
+        jrnl.close()
+        print(f"journal detached: {jrnl.total_records} records "
+              f"({jrnl.dir})")
     observability.set_enabled(prev_telemetry)
     if args.json:
         with open(args.json, "w") as f:

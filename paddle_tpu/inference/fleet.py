@@ -62,6 +62,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..observability import flight as _flight
+from ..observability import journal as _journal
 from ..observability import metrics as _metrics
 from ..observability.metrics import percentile as _pctl
 from .prefix_cache import make_prefix_cache
@@ -112,23 +113,45 @@ class FaultInjector:
         self.hang = {k: [int(v[0]), int(v[1])]
                      for k, v in (hang or {}).items()}
         self.recover_after = int(recover_after)
+        self.seed = int(seed)
         self.crash_p = float(crash_p)
         self._rng = np.random.RandomState(seed)
+        self._draws = 0                    # seeded rand() calls consumed
         self.events: List[tuple] = []      # (kind, replica, detail) log
+
+    def describe(self) -> dict:
+        """Rebuildable snapshot for the journal header (r16): the
+        CURRENT schedule (fired crashes already popped) plus how many
+        seeded draws were consumed, so a replay's injector fires the
+        exact same faults from the exact same stream position."""
+        return {"crash": dict(self.crash),
+                "hang": {k: list(v) for k, v in self.hang.items()},
+                "recover_after": self.recover_after, "seed": self.seed,
+                "crash_p": self.crash_p, "draws": self._draws}
 
     def on_finish(self, idx: int, seg_no: int) -> None:
         """Called right before replica ``idx`` fetches its ``seg_no``-th
         segment; raises to inject the fault."""
-        if self.crash.get(idx) == seg_no or (
-                self.crash_p and self._rng.rand() < self.crash_p):
+        fire = self.crash.get(idx) == seg_no
+        if not fire and self.crash_p:
+            self._draws += 1
+            draw = float(self._rng.rand())
+            fire = draw < self.crash_p
+            _journal.record("fault", fault="draw", replica=idx,
+                            segment=seg_no, draw=draw, fired=fire)
+        if fire:
             self.crash.pop(idx, None)
             self.events.append(("crash", idx, seg_no))
+            _journal.record("fault", fault="crash", replica=idx,
+                            segment=seg_no)
             raise ReplicaCrash(f"replica {idx} crashed at its segment "
                                f"{seg_no}")
         h = self.hang.get(idx)
         if h is not None and h[0] == seg_no and h[1] > 0:
             h[1] -= 1
             self.events.append(("hang", idx, seg_no))
+            _journal.record("fault", fault="hang", replica=idx,
+                            segment=seg_no, remaining=h[1])
             raise ReplicaHang(f"replica {idx} hung at its segment "
                               f"{seg_no}")
 
@@ -385,9 +408,23 @@ class FleetRouter:
     # --- intake ----------------------------------------------------------
     def _ingest(self, pending: List[Arrival], now: float, t0: float) -> int:
         refused = 0
+        _j = _journal.active()
         while pending and pending[0].t <= now:
             a = pending[0]
             rep, reason = self._route(a)
+            cands = None
+            if _j is not None:
+                # the dispatch decision WITH its candidate ranking: the
+                # per-replica load/health/page state the router compared
+                # — the "why replica 2" answer a postmortem needs
+                # (snapshotted BEFORE intake mutates the queues)
+                cands = [{"idx": x.idx, "health": x.health,
+                          "queue": x.queue_depth, "live": x.live,
+                          "page_ready": self._page_ready(x, a)}
+                         for x in self._replicas]
+                if reason is None:          # refusal: no rid assigned
+                    _j.record("dispatch", rid=None, replica=rep.idx,
+                              reason="backpressure", candidates=cands)
             if reason is None:
                 refused += 1
                 rep.backpressure_events += 1
@@ -411,6 +448,12 @@ class FleetRouter:
             req.arrival_time = t0 + a.t
             self._reqs[rid] = (rep.idx, req)
             rep.rids.append(rid)
+            _journal.record("arrival", rid=rid, at=a.t, replica=rep.idx,
+                            erid=erid, prompt_len=len(req.prompt),
+                            gen=req.max_new_tokens)
+            if _j is not None:
+                _j.record("dispatch", rid=rid, replica=rep.idx,
+                          reason=reason, candidates=cands)
             rep.dispatches[reason] += 1
             _metrics.counter(f"fleet.dispatches.{reason}").inc()
             with _metrics.scoped_registry(rep.registry):
@@ -431,6 +474,13 @@ class FleetRouter:
             self.serve(arrivals, warm=False)
             self.reset()
 
+        # r16 (ISSUE 11): header + decision-clock recording — see
+        # OnlineScheduler.serve; the fleet's header additionally carries
+        # every replica's geometry, the per-replica prefix caches and
+        # the fault injector's live schedule/draw position
+        _j = _journal.active()
+        if _j is not None:
+            _j.begin_serve(self._journal_header(arrivals))
         pending = sorted(arrivals, key=lambda a: a.t)
         reps = self._replicas
         for r in reps:
@@ -447,12 +497,12 @@ class FleetRouter:
         # replicas contend for one host/core; on real parallel devices
         # it additionally keeps every chip busy continuously.
         inflight: List[tuple] = []          # (replica, handle, t_disp) FIFO
-        t0 = time.perf_counter()
+        t0 = _journal.now()
         self._serve_t0 = t0
         self._finished_count = 0
         self.last_retry_after_s = None
         while pending or inflight or any(r.busy for r in reps):
-            now = time.perf_counter() - t0
+            now = _journal.now() - t0
             self._probe_dead()
             self._ingest(pending, now, t0)
             # r13: dead replicas are out of rotation entirely (abort
@@ -462,17 +512,18 @@ class FleetRouter:
                          if r.health != "dead" and r.busy
                          and r.engine._pending_seg is None]
             for r in busy_idle:
-                with _metrics.scoped_registry(r.registry):
+                with _metrics.scoped_registry(r.registry), \
+                        _journal.rank_scope(r.idx):
                     h = r.engine.dispatch_segment(
                         self.seg_steps, prefix_cache=r.prefix_cache)
-                inflight.append((r, h, time.perf_counter()))
+                inflight.append((r, h, _journal.now()))
             if not inflight:
                 if pending:
-                    gap = pending[0].t - (time.perf_counter() - t0)
+                    gap = pending[0].t - (_journal.now() - t0)
                     if gap > 0:
-                        time.sleep(min(gap, 0.05))
+                        _journal.sleep(min(gap, 0.05))
                 elif any(r.health == "dead" for r in reps):
-                    time.sleep(0.001)       # wait out the probe window
+                    _journal.sleep(0.001)   # wait out the probe window
                 continue
             # finish the oldest in-flight segment (its event fetch is
             # the one audited allowed_sync for that segment) under the
@@ -481,7 +532,7 @@ class FleetRouter:
             r, h, t_disp = inflight.pop(0)
             if self._finish_one(r, h, t_disp):
                 segments += 1
-        makespan = time.perf_counter() - t0
+        makespan = _journal.now() - t0
 
         reqs = [req for _, req in self._reqs.values()]
         assert all(
@@ -577,9 +628,10 @@ class FleetRouter:
             try:
                 if self.fault_injector is not None:
                     self.fault_injector.on_finish(rep.idx, rep.segments)
-                with _metrics.scoped_registry(rep.registry):
+                with _metrics.scoped_registry(rep.registry), \
+                        _journal.rank_scope(rep.idx):
                     ev = rep.engine.finish_segment(h)
-                    t_sync = time.perf_counter()
+                    t_sync = _journal.now()
                     outcomes = self._stamp(rep, ev, t_sync)
                 break
             except ReplicaCrash as e:
@@ -648,7 +700,7 @@ class FleetRouter:
         rep.set_health("dead")
         rep.timeouts = 0
         rep.probes = 0
-        rep.dead_since = time.perf_counter()
+        rep.dead_since = _journal.now()
         self.failovers += 1
         _metrics.counter("fleet.replica_deaths").inc()
         _flight.record("replica_dead", replica=rep.idx, reason=reason)
@@ -701,19 +753,21 @@ class FleetRouter:
         for rep in self._replicas:
             if rep.health != "dead":
                 continue
-            if time.perf_counter() - rep.dead_since < self.probe_after_s:
+            if _journal.now() - rep.dead_since < self.probe_after_s:
                 continue
             rep.probes += 1
             ok = (self.fault_injector.on_probe(rep.idx, rep.probes)
                   if self.fault_injector is not None else True)
             _metrics.counter("fleet.probes").inc()
+            _journal.record("probe", replica=rep.idx,
+                            probe_no=rep.probes, recovered=ok)
             if ok:
                 rep.timeouts = 0
                 rep.set_health("healthy")
                 _flight.record("replica_recovered", replica=rep.idx,
                                via="probe", probes=rep.probes)
             else:
-                rep.dead_since = time.perf_counter()
+                rep.dead_since = _journal.now()
 
     def _stamp(self, r: _Replica, ev: dict, t_sync: float) -> List[tuple]:
         """Per-request lifecycle stamping at the sync that surfaced each
@@ -722,14 +776,20 @@ class FleetRouter:
         Returns the ``(kind, priority, latency_s)`` outcomes so the
         caller can feed the fleet-level SLO monitor OUTSIDE the scoped
         registry (its gauges belong to the process/fleet view)."""
-        by_erid = {self._reqs[rid][1].rid: self._reqs[rid][1]
+        by_erid = {self._reqs[rid][1].rid: (rid, self._reqs[rid][1])
                    for rid in r.rids}
         m_ttft = _metrics.histogram("serving.ttft_s")
         m_e2e = _metrics.histogram("serving.e2e_s")
         m_qw = _metrics.histogram("serving.queue_wait_s")
         outcomes: List[tuple] = []
+        for erid in ev["admitted"]:
+            frid, req = by_erid[erid]
+            _journal.record("admit", rid=frid, replica=r.idx, erid=erid,
+                            prefix_hit_len=req.prefix_hit_len,
+                            resumed=bool(req.preemptions or req.requeues),
+                            tokens_done=len(req.tokens))
         for erid in ev["first_tokens"]:
-            req = by_erid[erid]
+            frid, req = by_erid[erid]
             if req.first_token_time:
                 # a rewound failover request re-emits its first token;
                 # the client saw the original — the TTFT clock stands
@@ -739,14 +799,53 @@ class FleetRouter:
             m_qw.observe(req.admit_time - req.arrival_time)
             outcomes.append(("ttft", req.priority,
                              t_sync - req.arrival_time))
+            _journal.record("first_token", rid=frid, replica=r.idx,
+                            ttft_s=t_sync - req.arrival_time)
         for erid in ev["finished"]:
-            req = by_erid[erid]
+            frid, req = by_erid[erid]
             req.finish_time = t_sync
             m_e2e.observe(t_sync - req.arrival_time)
             outcomes.append(("e2e", req.priority,
                              t_sync - req.arrival_time))
+            # full emitted token stream = the replay's identity oracle
+            _journal.record("finish", rid=frid, replica=r.idx,
+                            tokens=req.tokens, n_tokens=len(req.tokens),
+                            e2e_s=t_sync - req.arrival_time,
+                            requeues=req.requeues,
+                            spec_proposed=req.spec_proposed,
+                            spec_accepted=req.spec_accepted)
         _metrics.gauge("fleet.replica_queue_depth").set(r.queue_depth)
         return outcomes
+
+    def _journal_header(self, arrivals) -> dict:
+        """The fleet serve's replay contract (r16, ISSUE 11): router
+        knobs, every replica's rebuildable geometry + rid offset,
+        per-replica prefix-cache shapes, the fault injector's LIVE
+        schedule (fired crashes popped, seeded draws positioned), and
+        the full trace."""
+        return {
+            "driver": "fleet",
+            "fleet": {"max_queue": self.max_queue,
+                      "seg_steps": self.seg_steps,
+                      "affinity_block": self.affinity_block,
+                      "segment_timeout_s": self.segment_timeout_s,
+                      "max_finish_retries": self.max_finish_retries,
+                      "max_requeues": self.max_requeues,
+                      "probe_after_s": self.probe_after_s,
+                      "next_rid": self._next_rid},
+            "engines": [_journal.describe_engine(r.engine)
+                        for r in self._replicas],
+            "prefix_caches": [_journal.describe_prefix_cache(
+                r.prefix_cache) for r in self._replicas],
+            "fault": (self.fault_injector.describe()
+                      if self.fault_injector is not None else None),
+            "llama": _journal.describe_config(
+                self._replicas[0].engine.cfg),
+            "monitors": {"slo": self.slo_monitor is not None,
+                         "perf": self.perf_monitor is not None},
+            "telemetry_enabled": _metrics.enabled(),
+            "trace": _journal.describe_arrivals(arrivals),
+        }
 
     # --- results / lifecycle ---------------------------------------------
     def results(self) -> Dict[int, List[int]]:

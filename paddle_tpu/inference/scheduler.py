@@ -58,6 +58,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..observability import flight as _flight
+from ..observability import journal as _journal
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
 from ..observability.metrics import percentile as _pctl
@@ -265,6 +266,11 @@ class OnlineScheduler:
             r.arrival_time = t0 + a.t   # client-side timestamp
             self._reqs[rid] = r
             self._note_arrival(r, a)
+            _journal.record("arrival", rid=rid, at=a.t,
+                            priority=r.priority,
+                            deadline_s=getattr(a, "deadline_s", None),
+                            prompt_len=len(r.prompt),
+                            gen=r.max_new_tokens)
         if refused:
             hint = self.retry_after_hint(now)
             self.last_retry_after_s = hint
@@ -296,6 +302,15 @@ class OnlineScheduler:
                 self.prefix_cache.reset()
             self._reset_monitors()
 
+        # r16 (ISSUE 11): with a journal attached, this serve records
+        # its header (rebuildable topology + the full trace) and every
+        # decision-relevant clock read routes through ``journal.now()``
+        # — the black-box recording an offline replay feeds back to
+        # reproduce the decision stream bit-exactly. With no journal,
+        # ``journal.now()`` is a plain perf_counter behind one check.
+        _j = _journal.active()
+        if _j is not None:
+            _j.begin_serve(self._journal_header(arrivals))
         pending = sorted(arrivals, key=lambda a: a.t)
         eng = self.engine
         eng.last_run_ticks = 0
@@ -311,10 +326,10 @@ class OnlineScheduler:
         m_ttft = _metrics.histogram("serving.ttft_s")
         m_e2e = _metrics.histogram("serving.e2e_s")
         m_qwait = _metrics.histogram("serving.queue_wait_s")
-        t0 = time.perf_counter()
+        t0 = _journal.now()
         self._serve_t0 = t0
         while pending or eng._queue or eng.free_slot_count() < eng.slots:
-            now = time.perf_counter() - t0
+            now = _journal.now() - t0
             self._ingest(pending, now, t0)
             m_queue.set(len(eng._queue))
             # r13 SLO hook: the subclass sheds unmeetable-deadline
@@ -327,19 +342,26 @@ class OnlineScheduler:
                 # nothing admitted and nothing decoding: sleep to the
                 # next arrival instead of spinning
                 if pending:
-                    gap = pending[0].t - (time.perf_counter() - t0)
+                    gap = pending[0].t - (_journal.now() - t0)
                     if gap > 0:
-                        time.sleep(min(gap, 0.05))
+                        _journal.sleep(min(gap, 0.05))
                 continue
             t_seg = _hooks.now_ns()
-            t_seg_pc = time.perf_counter()
+            t_seg_pc = _journal.now()
             ev = eng.run_segment(self.seg_steps,
                                  prefix_cache=self.prefix_cache)
-            t_sync = time.perf_counter()
+            t_sync = _journal.now()
             _hooks.emit("serving.segment", t_seg, _hooks.now_ns(),
                         kind="serving")
             segments += 1
             mon = self.slo_monitor
+            for rid in ev["admitted"]:
+                r = self._reqs[rid]
+                _journal.record("admit", rid=rid,
+                                prefix_hit_len=r.prefix_hit_len,
+                                priority=r.priority,
+                                resumed=bool(r.preemptions or r.requeues),
+                                tokens_done=len(r.tokens))
             for rid in ev["first_tokens"]:
                 r = self._reqs[rid]
                 r.first_token_time = t_sync
@@ -348,6 +370,8 @@ class OnlineScheduler:
                 if mon is not None:
                     mon.note_ttft(r.priority, t_sync - r.arrival_time)
                 self._on_first_token(r, t_sync)
+                _journal.record("first_token", rid=rid,
+                                ttft_s=t_sync - r.arrival_time)
             for rid in ev["finished"]:
                 # the engine stamps finish during replay (marginally
                 # earlier); the sync is when the client can SEE the
@@ -362,6 +386,17 @@ class OnlineScheduler:
                 _tracing.emit_request_trace(
                     rid, r.arrival_time, r.admit_time, r.first_token_time,
                     r.finish_time, prefix_hit_len=r.prefix_hit_len)
+                # the token-identity ground truth: the FULL emitted
+                # stream rides the finish record (host mirrors of the
+                # segment fetch — nothing extra was synced for this)
+                _journal.record("finish", rid=rid, tokens=r.tokens,
+                                n_tokens=len(r.tokens),
+                                e2e_s=t_sync - r.arrival_time,
+                                priority=r.priority,
+                                preemptions=r.preemptions,
+                                requeues=r.requeues,
+                                spec_proposed=r.spec_proposed,
+                                spec_accepted=r.spec_accepted)
             # r14 monitor hooks: advance the SLO burn windows and feed
             # the explained-perf intervals — host ints from the event
             # log just fetched, plus this segment's dispatch→fetch span
@@ -376,7 +411,7 @@ class OnlineScheduler:
             dt = (t_sync - t_seg_pc) / max(ev["steps"], 1)
             self._per_tick_s = (dt if not self._per_tick_s
                                 else 0.5 * self._per_tick_s + 0.5 * dt)
-        makespan = time.perf_counter() - t0
+        makespan = _journal.now() - t0
 
         reqs = list(self._reqs.values())
         assert all(r.done or (self.engine.eos is not None
@@ -452,6 +487,27 @@ class OnlineScheduler:
 
     def _report_extras(self, reqs) -> dict:
         return {}
+
+    def _journal_header(self, arrivals) -> dict:
+        """The r16 replay contract's root: everything an offline
+        ``observability.replay`` needs to rebuild THIS serve — driver
+        kind + knobs, engine geometry/seeds, the prefix-cache shape,
+        the full arrival trace, and the mutable state decisions start
+        from (the per-tick EWMA, the engine's rid offset)."""
+        return {
+            "driver": "online",
+            "scheduler": {"max_queue": self.max_queue,
+                          "seg_steps": self.seg_steps,
+                          "per_tick_s": self._per_tick_s},
+            "engines": [_journal.describe_engine(self.engine)],
+            "llama": _journal.describe_config(self.engine.cfg),
+            "prefix_cache": _journal.describe_prefix_cache(
+                self.prefix_cache),
+            "monitors": {"slo": self.slo_monitor is not None,
+                         "perf": self.perf_monitor is not None},
+            "telemetry_enabled": _metrics.enabled(),
+            "trace": _journal.describe_arrivals(arrivals),
+        }
 
     def results(self) -> Dict[int, List[int]]:
         """rid -> generated tokens for every served request (truncated
@@ -588,6 +644,11 @@ class SLOScheduler(OnlineScheduler):
             r.arrival_time = t0 + a.t
             self._reqs[rid] = r
             self._note_arrival(r, a)
+            _journal.record("arrival", rid=rid, at=a.t,
+                            priority=r.priority,
+                            deadline_s=getattr(a, "deadline_s", None),
+                            prompt_len=len(r.prompt),
+                            gen=r.max_new_tokens)
         if refused:
             hint = self.retry_after_hint(now)
             self.last_retry_after_s = hint
@@ -626,10 +687,11 @@ class SLOScheduler(OnlineScheduler):
         return owed * self._per_token_s
 
     def _shed_pass(self) -> None:
-        t_abs = time.perf_counter()
+        t_abs = _journal.now()
         eng = self.engine
         for r in [q for q in eng._queue if q.deadline]:
-            if t_abs + self._min_service_s(r) <= r.deadline:
+            min_s = self._min_service_s(r)
+            if t_abs + min_s <= r.deadline:
                 continue
             eng._queue.remove(r)
             del self._reqs[r.rid]
@@ -638,11 +700,25 @@ class SLOScheduler(OnlineScheduler):
                 self.shed_per_class.get(r.priority, 0) + 1
             self.shed_log.append({
                 "rid": r.rid, "priority": r.priority,
-                "late_by_s": round(
-                    t_abs + self._min_service_s(r) - r.deadline, 4),
+                "late_by_s": round(t_abs + min_s - r.deadline, 4),
                 "tokens_done": len(r.tokens)})
             _metrics.counter("scheduler.shed").inc()
             _metrics.counter(f"scheduler.shed[class{r.priority}]").inc()
+            # r16: the decision WITH its arithmetic inputs — a
+            # postmortem can re-derive exactly why this request died
+            # (measured EWMAs x owed tokens vs the deadline), and the
+            # replay must reproduce every term bit-for-bit
+            _journal.record("shed_decision", rid=r.rid,
+                            priority=r.priority, now_abs=t_abs,
+                            deadline_abs=r.deadline,
+                            min_service_s=min_s,
+                            late_by_s=t_abs + min_s - r.deadline,
+                            owed=r.max_new_tokens - len(r.tokens),
+                            per_token_s=self._per_token_s,
+                            per_tick_s=self._per_tick_s,
+                            accept_ewma=float(getattr(
+                                self.engine, "spec_accept_ewma", 1.0)),
+                            tokens_done=len(r.tokens))
             _flight.record("shed", rid=r.rid, cls=r.priority,
                            queue=len(eng._queue))
 
@@ -677,6 +753,18 @@ class SLOScheduler(OnlineScheduler):
                 return
             if not eng.can_preempt(s):
                 continue
+            # r16: victim selection with its inputs — who was blocked,
+            # who was considered (class/progress ranking), who lost
+            _journal.record(
+                "preempt_decision", rid=eng._active[s].rid,
+                victim_slot=s, victim_priority=eng._active[s].priority,
+                victim_tokens_done=len(eng._active[s].tokens),
+                head_rid=head.rid, head_priority=head.priority,
+                considered=[(v, eng._active[v].rid,
+                             eng._active[v].priority,
+                             len(eng._active[v].tokens))
+                            for v in victims
+                            if eng._active[v] is not None])
             victim = eng.preempt_slot(s, prefix_cache=self.prefix_cache)
             self._insert_by_class(victim)
             self.preemptions += 1
@@ -718,6 +806,17 @@ class SLOScheduler(OnlineScheduler):
                 "shed_per_class": dict(self.shed_per_class) or None,
                 "displaced": self.displaced,
                 "per_class": per_class or None}
+
+    def _journal_header(self, arrivals) -> dict:
+        d = super()._journal_header(arrivals)
+        d["driver"] = "slo"
+        # the shed estimator's measured state: decisions in the first
+        # segments depend on what a warm pass (or earlier traffic)
+        # taught the EWMAs — a replay must start from the same numbers
+        d["scheduler"].update(preempt=self.preempt,
+                              shed_deadlines=self.shed_deadlines,
+                              per_token_s=self._per_token_s)
+        return d
 
     def serve(self, arrivals: Sequence[Arrival],
               warm: bool = False) -> OnlineReport:

@@ -68,6 +68,7 @@ import numpy as np
 from ..analysis.syncs import allowed_sync
 from ..models import llama
 from ..observability import flight as _flight
+from ..observability import journal as _journal
 from ..observability import metrics as _metrics
 
 __all__ = ["Request", "ServingEngine", "SEGMENT_HOOKS"]
@@ -1351,7 +1352,10 @@ class ServingEngine:
                 "finish_segment must run first (one outstanding segment "
                 "per engine)")
         if now is None:
-            now = time.perf_counter()
+            # the admit_time stamp feeds the SLO EWMAs (decision
+            # inputs), so it reads the r16 DECISION clock — recorded
+            # with a journal attached, fed back during replay
+            now = _journal.now()
         n_pad = n_pad or self._pow2(self.slots)
         if self.paged:
             pending = self._dispatch_segment_paged(max_steps, prefix_cache,

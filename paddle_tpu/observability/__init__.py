@@ -27,7 +27,20 @@ Plus the r14 live ops surface (ISSUE 9) over those signals:
   sentinel (``perf_regression`` events).
 * :mod:`exporter` — ``OpsServer``, an explicit-start stdlib HTTP
   scrape surface: ``/metrics`` ``/snapshot.json`` ``/healthz``
-  ``/flight`` ``/slo`` ``/perf``.
+  ``/flight`` ``/slo`` ``/perf`` (r16: + ``/journal`` and
+  ``/request/<rid>``).
+
+And the r16 black box (ISSUE 11) over everything above:
+
+* :mod:`journal` — the deterministic serving journal: append-only,
+  schema-versioned JSONL of every serving decision + its inputs (a
+  lossless superset of flight events), per-rank files with monotonic
+  seqs, size rotation, cross-replica merge, request journeys, and the
+  recorded decision clock (``journal.now()``) that makes replay exact.
+* :mod:`replay` — bit-exact incident replay: rebuild the serve from
+  the journal header, re-run it on the recorded clock, and diff the
+  decision + token stream (identity certified, or the first divergence
+  named as seq/kind/field).
 
 The hard contract: instrumentation consumes device values ONLY at the
 two sanctioned ``allowed_sync`` points (serving's per-segment event
@@ -53,25 +66,30 @@ no-op (the ≤2 % serving overhead gate compares against exactly that).
 
 from __future__ import annotations
 
-from . import exporter, flight, metrics, perf, slo, tracing
+from . import exporter, flight, journal, metrics, perf, replay, slo, tracing
 from .exporter import OpsServer
 from .flight import FLIGHT, dump_on_exception
+from .journal import Journal, read_journal, request_journey
 from .metrics import (counter, enabled, gauge, histogram, merge_log_dir,
                       merge_snapshots, percentile, registry,
                       render_prometheus, reset, set_enabled, snapshot,
                       write_snapshot)
 from .perf import PerfMonitor, serving_ledger
+from .replay import replay_serve
 from .slo import Objective, SLOMonitor
-from .tracing import emit_request_trace, span, step_span
+from .tracing import emit_journey_trace, emit_request_trace, span, step_span
 
 __all__ = [
-    "metrics", "tracing", "flight", "slo", "perf", "exporter", "counter",
+    "metrics", "tracing", "flight", "slo", "perf", "exporter", "journal",
+    "replay", "counter",
     "gauge", "histogram", "percentile", "registry", "snapshot",
     "render_prometheus", "merge_snapshots", "merge_log_dir",
     "write_snapshot", "reset", "set_enabled", "enabled", "span",
-    "step_span", "emit_request_trace", "FLIGHT", "dump_on_exception",
+    "step_span", "emit_request_trace", "emit_journey_trace", "FLIGHT",
+    "dump_on_exception",
     "install_compile_listener", "Objective", "SLOMonitor", "PerfMonitor",
-    "serving_ledger", "OpsServer",
+    "serving_ledger", "OpsServer", "Journal", "read_journal",
+    "request_journey", "replay_serve",
 ]
 
 

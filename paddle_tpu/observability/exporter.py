@@ -17,9 +17,18 @@ of the package enforces at the record path). Endpoints:
                    ``FleetRouter`` replicas (live view) or the
                    ``fleet.replica_health`` gauge by rank from a merged
                    log dir. 200 while any replica serves, 503 when none.
-``/flight``        Flight-recorder tail (``?n=`` bounds it, default 64).
+``/flight``        Flight-recorder tail (``?n=`` bounds it, default 64;
+                   r16: ``?kind=`` / ``?rid=`` filter by event kind /
+                   request id).
 ``/slo``           The SLO monitor's budget/burn/alert state.
 ``/perf``          The explained-performance ledger + interval report.
+``/journal``       Deterministic-journal tail (r16, ISSUE 11): the
+                   lossless decision stream's newest records, filtered
+                   by ``?n=`` / ``?kind=`` / ``?rid=`` — reads the
+                   attached journal (or the process-wide one).
+``/request/<rid>`` One request's cross-replica journey: the causal
+                   record timeline (arrival → dispatch → admit →
+                   preempt/failover → finish) joined from the journal.
 =================  =======================================================
 
 The server is started and stopped EXPLICITLY (``start()`` binds and
@@ -61,7 +70,8 @@ class OpsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  registry: Optional[_metrics.Registry] = None,
                  slo_monitor=None, perf_monitor=None, fleet=None,
-                 log_dir: Optional[str] = None, recorder=None):
+                 log_dir: Optional[str] = None, recorder=None,
+                 journal=None):
         self.host = host
         self.port = int(port)
         self.registry = registry
@@ -70,6 +80,7 @@ class OpsServer:
         self.fleet = fleet
         self.log_dir = log_dir
         self.recorder = recorder
+        self.journal = journal         # r16: explicit > process-attached
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -164,11 +175,36 @@ class OpsServer:
         code = 503 if body["status"] == "dead" else 200
         return code, body
 
-    def payload_flight(self, n: int = 64) -> dict:
-        evs = self._recorder().events()
-        return {"capacity": self._recorder().capacity,
-                "total_buffered": len(evs),
+    def payload_flight(self, n: int = 64, kind: Optional[str] = None,
+                       rid: Optional[int] = None) -> dict:
+        rec = self._recorder()
+        evs = rec.events(kind, rid=rid)
+        return {"capacity": rec.capacity,
+                "total_buffered": len(rec),
+                "dropped_events": rec.dropped_events,
+                "matched": len(evs),
                 "events": evs[-max(1, int(n)):]}
+
+    def _journal(self):
+        from . import journal as _jrnl
+
+        j = self.journal if self.journal is not None else _jrnl.active()
+        if j is None:
+            raise FileNotFoundError(
+                "no journal attached (pass journal= or journal.install)")
+        return j
+
+    def payload_journal(self, n: int = 64, kind: Optional[str] = None,
+                        rid: Optional[int] = None) -> dict:
+        j = self._journal()
+        evs = j.tail(n, kind=kind, rid=rid)
+        return {"total_records": j.total_records, "serves": j.serves,
+                "dir": j.dir, "matched": len(evs), "records": evs}
+
+    def payload_request(self, rid: int) -> dict:
+        """The cross-replica journey join — reads the journal's full
+        record stream (files when file-backed), not just the tail."""
+        return self._journal().request_journey(rid)
 
     def payload_slo(self) -> dict:
         if self.slo_monitor is None:
@@ -215,16 +251,31 @@ def _make_handler(srv: OpsServer):
                     self._send_json(code, body)
                 elif u.path == "/flight":
                     n = int(q.get("n", ["64"])[0])
-                    self._send_json(200, srv.payload_flight(n))
+                    kind = q.get("kind", [None])[0]
+                    rid = q.get("rid", [None])[0]
+                    self._send_json(200, srv.payload_flight(
+                        n, kind=kind,
+                        rid=int(rid) if rid is not None else None))
                 elif u.path == "/slo":
                     self._send_json(200, srv.payload_slo())
                 elif u.path == "/perf":
                     self._send_json(200, srv.payload_perf())
+                elif u.path == "/journal":
+                    n = int(q.get("n", ["64"])[0])
+                    kind = q.get("kind", [None])[0]
+                    rid = q.get("rid", [None])[0]
+                    self._send_json(200, srv.payload_journal(
+                        n, kind=kind,
+                        rid=int(rid) if rid is not None else None))
+                elif u.path.startswith("/request/"):
+                    rid = int(u.path[len("/request/"):])
+                    self._send_json(200, srv.payload_request(rid))
                 elif u.path == "/":
                     self._send_json(200, {
                         "endpoints": ["/metrics", "/snapshot.json",
                                       "/healthz", "/flight", "/slo",
-                                      "/perf"]})
+                                      "/perf", "/journal",
+                                      "/request/<rid>"]})
                 else:
                     self._send_json(404, {"error": f"no route {u.path}"})
             except FileNotFoundError as e:

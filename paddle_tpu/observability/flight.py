@@ -29,9 +29,16 @@ import threading
 import time
 from typing import List, Optional
 
-__all__ = ["FlightRecorder", "FLIGHT", "record", "events", "dump",
-           "dump_on_exception", "install_excepthook", "set_capacity",
-           "clear"]
+__all__ = ["FlightRecorder", "FLIGHT", "LISTENERS", "record", "events",
+           "dump", "dump_on_exception", "install_excepthook",
+           "set_capacity", "clear"]
+
+# r16 (ISSUE 11): process-wide flight-event observers — ``fn(kind,
+# data)`` called after every ring append. The deterministic serving
+# journal subscribes here so the lossless journal is a SUPERSET of the
+# lossy ring by construction (one truthiness check per event when
+# nothing listens — the SEGMENT_HOOKS pattern).
+LISTENERS: List = []
 
 
 class FlightRecorder:
@@ -42,6 +49,7 @@ class FlightRecorder:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self._buf = collections.deque(maxlen=int(capacity))
         self._seq = 0
+        self.dropped_events = 0        # ring-wrap evictions (r16)
         self._lock = threading.Lock()  # resize only; appends are GIL-safe
 
     @property
@@ -56,19 +64,36 @@ class FlightRecorder:
             self._buf = collections.deque(self._buf, maxlen=int(capacity))
 
     def record(self, kind: str, **data) -> None:
-        from .metrics import _STATE
+        from .metrics import _STATE, counter
 
         if not _STATE.enabled:
             return
+        if len(self._buf) == self._buf.maxlen:
+            # r16 small fix (ISSUE 11): ring wrap used to be SILENT
+            # seq-gap eviction — an operator reading /flight could not
+            # tell "quiet run" from "ring 10x too small for this storm".
+            # Count every overwritten event; the counter rides
+            # /snapshot.json like any metric.
+            self.dropped_events += 1
+            counter("flight.dropped_events",
+                    "flight-ring events evicted by wrap").inc()
         self._seq += 1
         self._buf.append((self._seq, time.time(), kind, data))
+        if LISTENERS:
+            for fn in LISTENERS:
+                fn(kind, data)
 
-    def events(self, kind: Optional[str] = None) -> List[dict]:
-        """Oldest-first structured view of the ring (optionally one
-        kind). ``seq`` is a monotonic id — gaps mean the ring evicted."""
+    def events(self, kind: Optional[str] = None,
+               rid: Optional[int] = None) -> List[dict]:
+        """Oldest-first structured view of the ring, optionally filtered
+        by ``kind`` and/or the event's ``rid`` field (r16: the /flight
+        endpoint's query filters). ``seq`` is a monotonic id — gaps mean
+        the ring evicted (now also counted in
+        ``flight.dropped_events``)."""
         return [{"seq": s, "t": t, "kind": k, **d}
                 for s, t, k, d in list(self._buf)
-                if kind is None or k == kind]
+                if (kind is None or k == kind)
+                and (rid is None or d.get("rid") == rid)]
 
     def __len__(self) -> int:
         return len(self._buf)
@@ -96,8 +121,9 @@ def record(kind: str, **data) -> None:
     FLIGHT.record(kind, **data)
 
 
-def events(kind: Optional[str] = None) -> List[dict]:
-    return FLIGHT.events(kind)
+def events(kind: Optional[str] = None,
+           rid: Optional[int] = None) -> List[dict]:
+    return FLIGHT.events(kind, rid=rid)
 
 
 def dump(path: Optional[str] = None, reason: str = "on_demand"):

@@ -23,7 +23,8 @@ from __future__ import annotations
 
 from ..profiler import _hooks
 
-__all__ = ["span", "step_span", "emit_request_trace", "active"]
+__all__ = ["span", "step_span", "emit_request_trace",
+           "emit_journey_trace", "active"]
 
 span = _hooks.span          # re-export: the RAII host span
 active = _hooks.active
@@ -65,3 +66,24 @@ def emit_request_trace(rid: int, arrival_s: float, admit_s: float,
     if finish_s > arrival_s > 0:
         _hooks.emit(f"request.e2e[{tag}]", _ns(arrival_s), _ns(finish_s),
                     kind=kind)
+
+
+def emit_journey_trace(journey: dict) -> None:
+    """Emit one journal-reconstructed request journey (r16, ISSUE 11:
+    ``journal.request_journey``) as chrome-trace spans: one span per
+    causal hop (arrival→dispatch, dispatch→admit, admit→first_token,
+    …→finish), named ``journey.<to_kind>[req<rid>@r<rank>]`` so a
+    cross-replica failover shows up as the rank changing mid-lane in
+    the same viewer that shows segments and op dispatch. Wall stamps
+    come from the journal records' write times — the journey is a
+    postmortem reconstruction, so journal-write wall time IS the
+    decision time. Free when no profiler collects."""
+    if not _hooks.COLLECTORS:
+        return
+    evs = journey.get("events") or []
+    rid = journey.get("rid")
+    for a, b in zip(evs, evs[1:]):
+        if b["t"] <= a["t"]:
+            continue
+        _hooks.emit(f"journey.{b['kind']}[req{rid}@r{b['rank']}]",
+                    _ns(a["t"]), _ns(b["t"]), kind="serving.journey")

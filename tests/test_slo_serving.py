@@ -141,13 +141,15 @@ class TestPriorityPreemption:
         the preempted one — matches its dense reference stream."""
         cfg, params = tiny
         rng = np.random.RandomState(23)
-        # lows: 8-token prompts, 48 generations — prompt + full stream
-        # (56) always fits the 64 bucket, so the victim is preemptible
+        # lows: 8-token prompts, 32 generations — prompt + full stream
+        # (40) always fits the 64 bucket, so the victim is preemptible
         # whenever the high arrival lands; the high arrives one ms in,
         # i.e. during the first (multi-ms) segment, while both slots
-        # are pinned by class-1 work
+        # are pinned by class-1 work (r16 suite-time: 48 -> 32 gens —
+        # the preempt still lands mid-stream at seg_steps=16, a third
+        # less decode + dense-reference work)
         arr = ([Arrival(0.0, rng.randint(0, cfg.vocab_size, (8,))
-                        .astype(np.int32), 48, priority=1)
+                        .astype(np.int32), 32, priority=1)
                 for _ in range(4)]
                + [Arrival(0.001, rng.randint(0, cfg.vocab_size, (8,))
                           .astype(np.int32), 4, priority=0)])
