@@ -53,6 +53,16 @@ Modes (r7 — VERDICT r5 items 3 and 9):
                      acceptance, acceptance histogram by prompt class
                      + an OOD control, the acceptance-vs-K curve, and
                      a sampled-speculative replay-determinism check.
+* ``--shadow``       shadow & canary quality observability (r17,
+                     ISSUE 12): a bf16-vs-bf16-style control certifies
+                     100% token match through the shadow pair; a
+                     seeded logit-perturbation variant is caught with
+                     exact first-divergence positions and a quality
+                     page that fires before any per-class SLO
+                     violation; the shadowed serve journals and
+                     replays bit-exactly; shadow-attachment overhead
+                     gated <= 2%; a seeded canary split gets a
+                     journaled verdict + auto-hold demo.
 * ``--smoke``        tiny-config in-process invariant check (tier-1 CPU
                      suite hook; see ``smoke()``).
 
@@ -951,6 +961,213 @@ def run_overload(model_name, cfg, params, llama, n=32, seed=0, slots=4,
 
 
 # ---------------------------------------------------------------------------
+# shadow: online quality observability (r17, ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def run_shadow(model_name, cfg, params, llama, n=16, seed=0, slots=4,
+               seg_steps=16):
+    """Shadow & canary quality evidence (ISSUE 12 acceptance):
+
+    * CONTROL — primary and shadow run the SAME weights/config (the
+      bf16-vs-bf16 certification shape): 100% token match, zero logit
+      error, zero quality alerts.
+    * PERTURBED — the shadow runs seeded logit-noised weights (the
+      variant class quantization error belongs to): every divergence
+      caught with its EXACT first-divergence position, and the quality
+      PAGE fires while the per-class SLO ledger holds zero violations
+      (quality observability leads the latency surface). The serve is
+      journaled and replayed in-lane — the primary decision stream is
+      bit-exact with the shadow attached.
+    * OVERHEAD — a shadow ATTACHED but sampling nothing costs <= 2%
+      primary wall-clock (min-of-3 interleaved); mirrored traffic
+      itself costs sample_p x the variant's compute by design
+      (SCALING §3l's arithmetic — on real fleets the shadow owns its
+      own chip and the primary cost is the mirror bookkeeping alone).
+    * CANARY — a seeded 25% split to a second replica: per-class
+      p50/p90 ratios judged against control with a journaled verdict,
+      plus an auto-hold demonstration (a tightened ratio budget drives
+      the routing weight to 0 mid-serve).
+    """
+    import tempfile
+
+    import jax
+
+    from paddle_tpu.inference.fleet import (FleetRouter, Shadow,
+                                            build_fleet)
+    from paddle_tpu.inference.scheduler import Arrival
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.observability import journal as jmod
+    from paddle_tpu.observability import replay as rmod
+    from paddle_tpu.observability.quality import (CanaryController,
+                                                  QualityMonitor)
+    from paddle_tpu.observability.slo import Objective, SLOMonitor
+
+    rng = np.random.RandomState(seed)
+    arr = [Arrival(0.0, rng.randint(
+        0, cfg.vocab_size, (int(rng.choice(_ONLINE_PLENS)),)
+    ).astype(np.int32), int(rng.choice(_ONLINE_GLENS)))
+        for _ in range(n)]
+    digest_k = 4
+
+    def mk_engine(p):
+        return ServingEngine(cfg, p, slots=slots, max_len=256,
+                             prompt_buckets=(32, 64, 128), paged=True,
+                             page_size=16, quality_digest=True,
+                             digest_top_k=digest_k)
+
+    # --- control: same weights both sides -> certify 100% match -------
+    _telemetry_section(reset=True)
+    router_c = FleetRouter([mk_engine(params)],
+                           shadow=Shadow(mk_engine(params), sample_p=1.0),
+                           seg_steps=seg_steps)
+    rep_c = router_c.serve(arr, warm=True)
+    qc = rep_c.quality
+    control_ok = (qc["token_match_rate"] == 1.0
+                  and qc["pairs_mismatched"] == 0
+                  and qc["alerts"] == []
+                  and rep_c.shadow["compared"] == rep_c.n_requests)
+    log(f"control (same weights): {rep_c.shadow['compared']} pairs, "
+        f"token match {qc['token_match_rate']:.4f}, logit max |d| "
+        f"{qc['logit_max_abs_err']}, alerts {len(qc['alerts'])} -> "
+        f"{'CERTIFIED' if control_ok else 'MISS'}")
+
+    # --- perturbed variant: detection + page-before-SLO + replay ------
+    noise = jax.random.normal(jax.random.PRNGKey(seed + 99),
+                              params["lm_head"].shape,
+                              params["lm_head"].dtype)
+    pert = dict(params)
+    pert["lm_head"] = params["lm_head"] + 0.05 * noise
+    slo_mon = SLOMonitor({0: Objective(
+        ttft_target_s=max(5.0 * rep_c.ttft_p99_s, 1.0),
+        e2e_target_s=max(5.0 * rep_c.e2e_p99_s, 2.0), compliance=0.99)})
+    qmon = QualityMonitor()
+    router_p = FleetRouter([mk_engine(params)],
+                           shadow=Shadow(mk_engine(pert), sample_p=1.0,
+                                         monitor=qmon),
+                           seg_steps=seg_steps, slo_monitor=slo_mon)
+    router_p.serve(arr)                   # warm (compiles)
+    router_p.reset()
+    jdir = tempfile.mkdtemp(prefix="journal_shadow_")
+    jq = jmod.Journal(jdir)
+    jq.params_info = {"prng_seed": 0}
+    with jmod.attach(jq):
+        rep_p = router_p.serve(arr)
+    jq.close()
+    qp = rep_p.quality
+    page_fired = any(a["level"] == "page" for a in qp["alerts"])
+    slo_clean = (rep_p.slo["alerts"] == []
+                 and all(c["violations"] == 0
+                         for c in rep_p.slo["classes"].values()))
+    divs = qp["first_divergence_positions"]
+    res = rmod.replay_serve(jdir, params=params)
+    log(f"perturbed variant: {qp['pairs_mismatched']}/{qp['pairs']} "
+        f"pairs diverged, match rate {qp['token_match_rate']:.4f}, "
+        f"first-divergence p50 {_pctl(divs, 0.5) if divs else None}, "
+        f"logit max |d| {qp['logit_max_abs_err']:.4f}, page_fired="
+        f"{page_fired} with slo_violations=0 {slo_clean}, "
+        f"replay_identical={res.identical} ({res.n_decisions} decisions)")
+
+    # --- overhead: shadow attached, sampling nothing ------------------
+    def serve_once(with_shadow):
+        sh = (Shadow(mk_engine(params), sample_p=0.0)
+              if with_shadow else None)
+        r = FleetRouter([mk_engine(params)], seg_steps=seg_steps,
+                        shadow=sh)
+        return r.serve(arr).makespan_s
+
+    serve_once(True)
+    walls = {True: [], False: []}
+    for _ in range(3):
+        for mode in (False, True):
+            walls[mode].append(serve_once(mode))
+    overhead_pct = (min(walls[True]) / min(walls[False]) - 1.0) * 100
+    log(f"shadow-attachment overhead (sample_p=0, min-of-3 "
+        f"interleaved): {overhead_pct:+.2f}%")
+
+    # --- canary: seeded split + verdict + auto-hold demo --------------
+    def mk_fleet():
+        return build_fleet(cfg, params, 2, slots=slots, max_len=256,
+                           prompt_buckets=(32, 64, 128), paged=True,
+                           page_size=16)
+
+    can = CanaryController(replica=1, weight=0.25, seed=seed,
+                           min_outcomes=3, verdict_every=8)
+    rep_can = FleetRouter(mk_fleet(), seg_steps=seg_steps,
+                          canary=can).serve(arr, warm=True)
+    tight = CanaryController(replica=1, weight=0.25, seed=seed,
+                             min_outcomes=3, verdict_every=4,
+                             latency_ratio_max=0.5)
+    rep_hold = FleetRouter(mk_fleet(), seg_steps=seg_steps,
+                           canary=tight).serve(arr, warm=True)
+    log(f"canary: {rep_can.dispatches_canary}/{rep_can.n_requests} "
+        f"requests on the canary, verdict "
+        f"{rep_can.canary['verdicts'][-1]['verdict']}; hold demo "
+        f"(ratio budget 0.5x): held={rep_hold.canary['held']} after "
+        f"{rep_hold.dispatches_canary} canary dispatches")
+
+    ok = (control_ok and qp["pairs_mismatched"] >= 1 and page_fired
+          and slo_clean and bool(res.identical)
+          and overhead_pct <= 2.0 and rep_can.dispatches_canary > 0
+          and rep_hold.canary["held"])
+    return {
+        "metric": "serving_shadow_quality",
+        "model": model_name,
+        "platform": jax.default_backend(),
+        "seed": seed,
+        "n_requests": n,
+        "digest_top_k": digest_k,
+        "digest_bytes_per_tick": slots * (1 + 2 * digest_k) * 4,
+        "control": {
+            "pairs": rep_c.shadow["compared"],
+            "token_match_rate": qc["token_match_rate"],
+            "logit_max_abs_err": qc["logit_max_abs_err"],
+            "alerts": len(qc["alerts"]),
+            "certified_identical": bool(control_ok)},
+        "perturbed": {
+            "pairs_mismatched": qp["pairs_mismatched"],
+            "pairs": qp["pairs"],
+            "token_match_rate": qp["token_match_rate"],
+            "first_divergence_positions": divs,
+            "first_divergence_p50": _pctl(divs, 0.5) if divs else None,
+            "logit_max_abs_err": round(qp["logit_max_abs_err"], 4),
+            "kl_sampled_max": (round(qp["kl_sampled_max"], 6)
+                               if qp["kl_sampled_max"] is not None
+                               else None),
+            "quality_page_fired": bool(page_fired),
+            "slo_violations": 0 if slo_clean else "nonzero",
+            "page_before_slo_violation": bool(page_fired and slo_clean),
+            "alert_log": qp["alerts"]},
+        "journal": {
+            "records": jq.total_records,
+            "decisions": res.n_decisions,
+            "replay_identical": bool(res.identical),
+            "first_divergence": res.divergence},
+        "overhead_pct_min_of_3": round(overhead_pct, 2),
+        "overhead_within_2pct": bool(overhead_pct <= 2.0),
+        "canary": {
+            "dispatches_canary": rep_can.dispatches_canary,
+            "dispatches_control": (rep_can.dispatches_affinity
+                                   + rep_can.dispatches_least_loaded),
+            "verdict": rep_can.canary["verdicts"][-1],
+            "hold_demo": {
+                "latency_ratio_max": 0.5,
+                "held": bool(rep_hold.canary["held"]),
+                "hold_reason": rep_hold.canary["hold_reason"],
+                "canary_dispatches": rep_hold.dispatches_canary}},
+        "headline": {
+            "control_match_rate": qc["token_match_rate"],
+            "perturb_detected": qp["pairs_mismatched"] >= 1,
+            "first_divergence_p50": _pctl(divs, 0.5) if divs else None,
+            "page_before_slo_violation": bool(page_fired and slo_clean),
+            "replay_identical": bool(res.identical),
+            "overhead_pct_min_of_3": round(overhead_pct, 2),
+            "canary_held_on_breach": bool(rep_hold.canary["held"]),
+            "pass": bool(ok)},
+        "telemetry": _telemetry_section(),
+    }
+
+
+# ---------------------------------------------------------------------------
 # slo: the live ops surface on the overload trace (r14, ISSUE 9)
 # ---------------------------------------------------------------------------
 
@@ -1685,6 +1902,7 @@ def main():
     ap.add_argument("--failover", action="store_true")
     ap.add_argument("--slo", action="store_true")
     ap.add_argument("--spec", action="store_true")
+    ap.add_argument("--shadow", action="store_true")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--model", default="auto",
                     choices=("auto", "base", "small", "tiny"))
@@ -1721,6 +1939,9 @@ def main():
     elif args.spec:
         print(json.dumps(run_spec(model_name, cfg, params, llama,
                                   n=min(args.n, 16))))
+    elif args.shadow:
+        print(json.dumps(run_shadow(model_name, cfg, params, llama,
+                                    n=min(args.n, 16))))
     elif args.failover:
         print(json.dumps(run_failover(model_name, cfg, params, llama)))
     elif args.fleet:

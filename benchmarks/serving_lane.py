@@ -46,6 +46,16 @@ Writes ``SERVING_r<N>.json`` at the repo root:
               acceptance-vs-K curve, sampled-speculative replay
               determinism...},
               (r15: speculative + sampled decoding in-program)
+   "quality": {...llama_serving --shadow json: shadow & canary quality
+              observability — a same-weights control certifying 100%
+              token match through the shadow pair, a seeded
+              logit-perturbation variant caught with exact
+              first-divergence positions and a quality page firing
+              before any per-class SLO violation, bit-exact journal
+              replay with the shadow attached, the <=2%
+              shadow-attachment overhead gate, and a seeded canary
+              split with a journaled verdict + auto-hold demo...},
+              (r17: shadow & canary serving, ISSUE 12)
    "telemetry_headlines": {...r10 runtime-telemetry headlines per mode —
               queue depth / slot occupancy / prefix hit rate /
               backpressure counters from paddle_tpu.observability; the
@@ -132,6 +142,8 @@ def main() -> int:
         # acceptance histogram by prompt class, acceptance-vs-K curve,
         # sampled-speculative replay determinism
         "spec": _run_json("llama_serving.py", args=("--spec",)),
+        # r17 (ISSUE 12): shadow & canary quality observability
+        "quality": _run_json("llama_serving.py", args=("--shadow",)),
     }
     result["platform"] = result["online"].get("platform", "unknown")
     # r10: lift each mode's runtime-telemetry headline (queue depth,
@@ -141,7 +153,7 @@ def main() -> int:
     result["telemetry_headlines"] = {
         k: (result[k].get("telemetry") or {}).get("headline")
         for k in ("online", "prefix", "paged", "fleet", "overload",
-                  "failover", "slo", "spec")}
+                  "failover", "slo", "spec", "quality")}
     # r15: lift the speculative headline — the roofline-beating ratio
     # an operator (or the next round's reviewer) checks first
     spec = result["spec"].get("headline") or {}
@@ -169,6 +181,11 @@ def main() -> int:
         "cold_start_fleet_worst_s": (slo.get("cold_start") or {}).get(
             "fleet_worst_s"),
     }
+    # r17 (ISSUE 12): lift the quality headline — the shadow/canary
+    # bars (control identity, perturbation caught with position, page
+    # leads the SLO surface, replay survives the shadow, overhead,
+    # auto-hold) a reviewer checks first
+    result["quality_headline"] = result["quality"].get("headline")
     # r16 (ISSUE 11): lift the deterministic-journal headline — the
     # black-box bars (bit-exact replay of the overload + replica-kill
     # serves, journal write overhead vs the 2% contract, and the two
@@ -190,7 +207,8 @@ def main() -> int:
     print(json.dumps(result))
     ok = all(result[k].get("rc") == 0
              for k in ("decode", "serving", "online", "prefix", "paged",
-                       "fleet", "overload", "failover", "slo", "spec"))
+                       "fleet", "overload", "failover", "slo", "spec",
+                       "quality"))
     return 0 if ok else 1
 
 

@@ -75,6 +75,13 @@ TPU_TEST_FILES = [
     # so chip compiles must not perturb a single decision), journey
     # joins, and the journaled-serve sync audit
     "tests/test_journal.py",
+    # r17 (ISSUE 12): shadow & canary quality observability — the
+    # in-program logit-digest segment on the real backend (digests
+    # ride the real kernel's logits through the single fetch),
+    # shadow-diff control identity, perturbation detection with exact
+    # first-divergence positions, canary verdicts + auto-hold, and the
+    # shadowed-fleet-loop sync audit
+    "tests/test_quality.py",
 ]
 
 

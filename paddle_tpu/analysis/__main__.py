@@ -20,6 +20,13 @@ Usage::
                                                   # loopback scrape server),
                                                   # budgets bit-identical
                                                   # to monitor-off
+    python -m paddle_tpu.analysis --gate --quality on  # (default) the r17
+                                                  # contract: the shadow-diff
+                                                  # QualityMonitor attached
+                                                  # via SEGMENT_HOOKS across
+                                                  # all 9 canonical programs,
+                                                  # budgets bit-identical to
+                                                  # --quality off
     python -m paddle_tpu.analysis --gate --journal on  # (default) the r16
                                                   # contract: the
                                                   # deterministic serving
@@ -107,6 +114,11 @@ def main(argv=None) -> int:
                          "engine segment (serving.SEGMENT_HOOKS) and an "
                          "OpsServer scraping on loopback — budgets must "
                          "be bit-identical to --ops off")
+    ap.add_argument("--quality", choices=("on", "off"), default="on",
+                    help="audit with the r17 quality layer attached: a "
+                         "shadow-diff QualityMonitor fed by every engine "
+                         "segment (serving.SEGMENT_HOOKS) — budgets must "
+                         "be bit-identical to --quality off")
     ap.add_argument("--journal", choices=("on", "off"), default="on",
                     help="audit with the r16 deterministic serving "
                          "journal attached (flight superset + decision-"
@@ -129,6 +141,11 @@ def main(argv=None) -> int:
     ops = None
     if args.ops == "on":
         ops = _attach_ops()
+    qmon = None
+    if args.quality == "on":
+        qmon = observability.QualityMonitor()
+        observability.quality.install(qmon)
+        print("quality monitor attached on SEGMENT_HOOKS")
     targets = args.program or programs.names()
     results = []
     any_violation = False
@@ -151,6 +168,9 @@ def main(argv=None) -> int:
             print("  budget: OK")
         print()
 
+    if qmon is not None:
+        observability.quality.uninstall(qmon)
+        print(f"quality monitor detached: saw {qmon.segments} segments")
     if ops is not None:
         _detach_ops(ops)
     if jrnl is not None:

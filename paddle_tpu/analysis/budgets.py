@@ -146,6 +146,30 @@ BUDGETS: Dict[str, Budget] = {
         notes="r15 contract: K-token drafts verified in one paged tick "
               "— accepted-length>1 per weight stream at zero extra "
               "syncs/compiles/shapes"),
+    # The QUALITY-DIGEST paged segment (r17, ISSUE 12): the
+    # paged_serving_segment contract with per-emitted-token logit
+    # digests (emitted logit + top-k ids/values) rolled into the event
+    # log. Quality evidence must be FREE at the hazard level: still
+    # exactly ONE event fetch per segment (digest columns ride the same
+    # fetch — the shadow-diff comparison is host arithmetic on the
+    # replayed log), zero warm compiles (the ("qseg", ...) family is
+    # bucketed like the plain paged family), zero pack bytes, and the
+    # relayout ledger is the paged while-body pool-carry class plus the
+    # digest columns' tiny carries (measured ~0.3% above the unchunked
+    # paged segment — the digest arrays are [steps, slots, k] fp32,
+    # invisible next to the pool).
+    "quality_serving_segment": Budget(
+        flagged_syncs=0,
+        allowed_syncs_per_replay={"serving.segment_event_fetch": 1},
+        warm_compiles=0,
+        # measured 1,044,420 B (while-body pool carries + admit page-
+        # scatter copies + digest-column carries) + ~5%
+        relayout_bytes_max=1_097_000,
+        pack_bytes_max=_MiB // 2,      # measured 0
+        undonated_bytes_max=_MiB // 2,  # measured 0 (pool+table donated)
+        notes="r17 contract: in-program logit digests ride the single "
+              "event fetch — quality evidence at zero extra syncs/"
+              "compiles/shapes"),
     # The TENSOR-PARALLEL segment (r12): the serving_segment contract,
     # GSPMD-sharded — same one fetch per segment and zero warm compiles,
     # PLUS every collective must attribute to the 'mp' axis (enforced

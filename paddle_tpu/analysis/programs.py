@@ -27,6 +27,10 @@ whose hazard ledger earlier rounds paid for by hand:
 * ``spec_serving_segment``   — the r15 speculative segment (in-program
   n-gram draft + K+1-position verified ticks through the paged
   q_len>1 path; acceptance rides the single event fetch).
+* ``quality_serving_segment`` — the r17 quality-digest paged segment
+  (per-emitted-token logit + top-k ids/values computed in-program and
+  rolled into the event log; the shadow-diff evidence stream must ride
+  the SAME single fetch at zero extra syncs/compiles).
 
 Builders are deterministic (fixed seeds, fixed shapes) so the measured
 metrics are stable run to run and ``budgets.py`` can pin them as exact
@@ -400,6 +404,67 @@ def _build_spec_serving_segment() -> ProgramHandle:
         expected_undonated=(),
         notes="speculative paged segment (K=3 n-gram draft, multi-token "
               "verified ticks) + host acceptance replay, llama-tiny",
+        keepalive=(eng,))
+
+
+@register("quality_serving_segment")
+def _build_quality_serving_segment() -> ProgramHandle:
+    """The r17 quality-digest segment (ISSUE 12): the paged segment
+    whose event log additionally carries per-step per-slot logit
+    digests — the emitted token's logit plus the tick's top-k ids and
+    values, computed in-program from logits the tick already produced.
+    The contract the budget pins: quality evidence must be FREE at the
+    hazard level — still exactly ONE event fetch per segment (the
+    digest columns ride the same fetch; the shadow-diff comparison is
+    host arithmetic on the replayed log), zero flagged syncs, zero warm
+    compiles (the ("qseg", n_pad, s_max, steps) family is bucketed
+    exactly like the plain paged family), and the relayout ledger is
+    the paged while-body pool-carry class plus the digest columns'
+    tiny carries."""
+    import numpy as np
+
+    import jax.numpy as j
+
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg)
+    eng = ServingEngine(cfg, params, slots=4, max_len=64, chunk=8,
+                        prompt_buckets=(16,), paged=True, page_size=16,
+                        quality_digest=True, digest_top_k=4)
+    rng = np.random.RandomState(0)
+
+    def replay():
+        # end-to-end DIGEST segment: two requests decode to completion
+        # inside the segment, the single allowed event fetch returns
+        # tokens AND digests, the host replay distributes both
+        for _ in range(2):
+            eng.add_request(rng.randint(0, cfg.vocab_size, (12,)), 4)
+        return eng.run_segment(12)
+
+    def hlo():
+        n_pad = eng._pow2(eng.slots)
+        s_max = eng.buckets[-1]
+        seg = eng._paged_segment_prog(n_pad, s_max, 12)
+        pgr = eng.pager
+        return seg.lower(
+            params, pgr.pool, pgr.page_table,
+            j.zeros((eng.slots,), j.int32), j.zeros((eng.slots,), j.int32),
+            j.zeros((eng.slots,), j.int32),
+            j.zeros((n_pad, s_max), j.int32), j.ones((n_pad,), j.int32),
+            j.zeros((n_pad,), j.int32), j.zeros((n_pad,), j.int32),
+            j.zeros((n_pad, pgr.max_pages), j.int32),
+            j.int32(2)).compile().as_text()
+
+    return ProgramHandle(
+        name="quality_serving_segment",
+        hlo=_memo(hlo),
+        replay=replay,
+        donation_threshold=1 << 16,
+        expected_undonated=(),
+        notes="quality-digest paged segment (k=4 top-k logit digests "
+              "in the event log) + host digest replay, llama-tiny",
         keepalive=(eng,))
 
 

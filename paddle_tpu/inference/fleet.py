@@ -32,6 +32,15 @@ device) with its OWN prefix cache and its OWN telemetry registry:
   replica i's event-fetch wait. The audited sync contract is unchanged
   — every segment still costs exactly one ``allowed_sync`` event fetch
   (``ServingEngine.dispatch_segment``/``finish_segment``).
+* **Shadow & canary serving (r17, ISSUE 12).** ``shadow=Shadow(...)``
+  mirrors a seeded sampled fraction of admitted requests to a variant
+  engine strictly off the primary path (own segments, own sanctioned
+  fetch, own registry, journal-marked records) and diffs the pairs
+  through a ``QualityMonitor`` (token divergence, logit-error
+  budgets); ``canary=CanaryController(...)`` routes a seeded weight of
+  traffic to a variant replica, compares per-class latency vs the
+  control population, and auto-holds (weight → 0) on a failing
+  journaled verdict.
 * **Rank-tagged telemetry.** Replica i's segment work records into its
   own ``metrics.Registry`` (``scoped_registry``), exactly as if it were
   launcher rank i; ``merged_telemetry()`` writes one
@@ -64,13 +73,14 @@ import numpy as np
 from ..observability import flight as _flight
 from ..observability import journal as _journal
 from ..observability import metrics as _metrics
+from ..observability import quality as _quality
 from ..observability.metrics import percentile as _pctl
 from .prefix_cache import make_prefix_cache
 from .scheduler import Arrival
-from .serving import ServingEngine
+from .serving import Request, ServingEngine
 
-__all__ = ["FleetRouter", "FleetReport", "build_fleet", "FaultInjector",
-           "ReplicaCrash", "ReplicaHang"]
+__all__ = ["FleetRouter", "FleetReport", "Shadow", "build_fleet",
+           "FaultInjector", "ReplicaCrash", "ReplicaHang"]
 
 
 # ---------------------------------------------------------------------------
@@ -161,6 +171,94 @@ class FaultInjector:
         return probe_no >= self.recover_after
 
 
+# ---------------------------------------------------------------------------
+# shadow serving (r17 tentpole, ISSUE 12): mirror a sampled fraction of
+# live traffic to a variant engine, strictly off the primary path
+# ---------------------------------------------------------------------------
+
+
+class Shadow:
+    """Shadow-serving attachment for :class:`FleetRouter`.
+
+    ``engine`` runs the VARIANT config (different kernels, chunking,
+    spec-K — later quantized weights) and receives a seeded, sampled
+    fraction of live requests as mirrors. The contract:
+
+    * **Off the critical path.** The shadow runs its OWN segments with
+      its OWN sanctioned per-segment event fetch (the same audited
+      ``allowed_sync`` label — the fleet-loop sync audit counts
+      primary + shadow segment fetches exactly, zero flagged). The
+      primary's one-fetch/zero-extra-sync contract is untouched: shadow
+      work is stepped strictly after each loop turn's primary work, its
+      telemetry lands in its own registry, and every journal record it
+      produces (clock reads included) carries the shadow mark so the
+      primary decision stream replays bit-identically with or without
+      the shadow attached.
+    * **Seeded sampling.** ``wants(rid)`` is a pure crc32 draw on
+      (seed, fleet rid) — deterministic, replayable, and stable across
+      fleet sizes.
+    * **Quality diffing.** When both sides of a mirrored pair finish,
+      the attached :class:`~paddle_tpu.observability.quality
+      .QualityMonitor` diffs token streams (exact first-divergence
+      position) and — when both engines carry ``quality_digest`` — the
+      per-token logit digests (max |Δ|, sampled KL), feeding the
+      ok→warning→page rules and the ``/quality`` endpoint.
+    """
+
+    def __init__(self, engine: ServingEngine, sample_p: float = 1.0,
+                 seed: int = 0, monitor=None,
+                 seg_steps: Optional[int] = None):
+        if not 0.0 <= float(sample_p) <= 1.0:
+            raise ValueError(f"sample_p must be in [0, 1], got {sample_p}")
+        self.engine = engine
+        self.sample_p = float(sample_p)
+        self.seed = int(seed)
+        self.monitor = (monitor if monitor is not None
+                        else _quality.QualityMonitor())
+        self.seg_steps = seg_steps
+        self.registry = _metrics.Registry()
+        self.mirrored = 0
+        self.dropped = 0           # mirrors skipped (doesn't fit shadow)
+        self.compared = 0
+        self.segments = 0
+        self._map: Dict[int, int] = {}       # shadow erid -> fleet rid
+        self._awaiting: set = set()          # fleet rids mid-pair
+        self._primary: Dict[int, tuple] = {}  # rid -> (toks, digs, cls)
+        self._shadow: Dict[int, tuple] = {}   # rid -> (toks, digs)
+
+    def wants(self, rid: int) -> bool:
+        """Seeded mirror draw for fleet rid ``rid`` (pure function)."""
+        if self.sample_p <= 0.0:
+            return False
+        if self.sample_p >= 1.0:
+            return True
+        h = zlib.crc32(f"{self.seed}:{rid}".encode()) % 1_000_000
+        return h < int(self.sample_p * 1_000_000)
+
+    @property
+    def busy(self) -> bool:
+        e = self.engine
+        return (bool(e._queue) or e.free_slot_count() < e.slots
+                or e._pending_seg is not None)
+
+    def stats(self) -> dict:
+        return {"mirrored": self.mirrored, "dropped": self.dropped,
+                "compared": self.compared, "segments": self.segments,
+                "sample_p": self.sample_p,
+                "pending_pairs": len(self._awaiting),
+                "ticks": self.engine.last_run_ticks}
+
+    def reset(self) -> None:
+        self.engine.reset_slots()
+        self.monitor.reset()
+        self.registry.reset()
+        self.mirrored = self.dropped = self.compared = self.segments = 0
+        self._map.clear()
+        self._awaiting.clear()
+        self._primary.clear()
+        self._shadow.clear()
+
+
 @dataclass
 class FleetReport:
     """Measured outcome of one fleet serve() (all times in seconds)."""
@@ -192,6 +290,13 @@ class FleetReport:
     cold_start_s: Optional[float] = None
     slo: Optional[dict] = None
     perf: Optional[dict] = None
+    # r17 (ISSUE 12): online quality observability — the shadow pair's
+    # QualityMonitor report, the shadow attachment's own accounting,
+    # canary dispatch count and the canary controller's verdicts/hold
+    dispatches_canary: int = 0
+    quality: Optional[dict] = None
+    shadow: Optional[dict] = None
+    canary: Optional[dict] = None
     per_replica: List[dict] = field(default_factory=list)
     telemetry: Optional[dict] = None   # merge_log_dir reduction
 
@@ -214,7 +319,7 @@ class _Replica:
         self.prefix_cache = prefix_cache
         self.registry = _metrics.Registry()
         self.backpressure_events = 0
-        self.dispatches = {"affinity": 0, "least_loaded": 0}
+        self.dispatches = {"affinity": 0, "least_loaded": 0, "canary": 0}
         self.segments = 0
         self.rids: List[int] = []          # fleet rids, assignment order
         # r13 failover: health state machine (healthy -> suspect on a
@@ -291,7 +396,8 @@ class FleetRouter:
                  max_finish_retries: int = 1, max_requeues: int = 3,
                  fault_injector: Optional[FaultInjector] = None,
                  probe_after_s: float = 0.05,
-                 slo_monitor=None, perf_monitor=None):
+                 slo_monitor=None, perf_monitor=None,
+                 shadow: Optional[Shadow] = None, canary=None):
         if not engines:
             raise ValueError("a fleet needs at least one engine")
         if prefix_caches == "auto":
@@ -346,6 +452,29 @@ class FleetRouter:
         # outside the scoped_registry blocks
         self.slo_monitor = slo_monitor
         self.perf_monitor = perf_monitor
+        # r17 (ISSUE 12): shadow + canary attachments. The shadow is an
+        # OBSERVER (mirrored traffic, own engine, own fetch, own
+        # registry, journal-marked records — never a routing input);
+        # the canary is a DECIDER (a seeded weight of live traffic
+        # routes to its replica, control traffic never does), so its
+        # config rides the journal header and replay rebuilds it.
+        self.shadow = shadow
+        if shadow is not None:
+            if any(r.engine is shadow.engine for r in self._replicas):
+                raise ValueError(
+                    "shadow engine must not be a fleet replica — it "
+                    "runs the variant config off the primary path")
+        self.canary = canary
+        if canary is not None:
+            if not 0 <= canary.replica < len(self._replicas):
+                raise ValueError(
+                    f"canary replica {canary.replica} out of range for "
+                    f"a {len(self._replicas)}-replica fleet")
+            if len(self._replicas) < 2:
+                raise ValueError(
+                    "a canary needs >= 2 replicas: the canary replica "
+                    "is excluded from control traffic, so a 1-replica "
+                    "fleet would have no control population")
         self.failovers = 0                  # replicas declared dead
         self.requeued = 0                   # requests moved to survivors
         self.last_retry_after_s: Optional[float] = None
@@ -379,19 +508,37 @@ class FleetRouter:
         unhealthy replica falls through to least-loaded over the healthy
         set (the prefix re-prefills on the survivor; correctness over
         cache warmth), and only if NO healthy replica exists do suspects
-        take traffic as a last resort (dead never)."""
+        take traffic as a last resort (dead never).
+
+        r17 canary split (ISSUE 12): with a canary attached, a seeded
+        pure draw on the rid this arrival WILL take routes ``weight`` of
+        traffic to the canary replica (healthy + queue/page room
+        required — a degraded canary falls back to control rather than
+        adding backpressure), and control traffic NEVER lands on the
+        canary replica: the comparison populations stay disjoint, and
+        an auto-hold (weight → 0) takes the variant out of the path
+        while it drains its backlog."""
+        can = self.canary
+        ctl = self._replicas
+        if can is not None:
+            crep = self._replicas[can.replica]
+            if (can.assign(self._next_rid) and crep.health == "healthy"
+                    and crep.queue_depth < self.max_queue
+                    and self._page_ready(crep, a)):
+                return crep, "canary"
+            ctl = [r for r in self._replicas if r.idx != can.replica]
         key = (self._affinity_key(a.prompt)
                if self._use_affinity else None)
-        pref = (self._replicas[zlib.crc32(key) % len(self._replicas)]
+        pref = (ctl[zlib.crc32(key) % len(ctl)]
                 if key is not None else None)
         if (pref is not None and pref.health == "healthy"
                 and pref.queue_depth < self.max_queue):
             return pref, "affinity"
-        cands = [r for r in self._replicas
+        cands = [r for r in ctl
                  if r.queue_depth < self.max_queue
                  and r.health == "healthy"]
         if not cands:
-            cands = [r for r in self._replicas
+            cands = [r for r in ctl
                      if r.queue_depth < self.max_queue
                      and r.health == "suspect"]
         if not cands:
@@ -399,7 +546,7 @@ class FleetRouter:
             # WOULD have gone to, so fleet backpressure == sum(replica
             # counters)
             bill = pref if pref is not None else \
-                min(self._replicas, key=lambda r: (r.load, r.idx))
+                min(ctl, key=lambda r: (r.load, r.idx))
             return bill, None
         best = min(cands, key=lambda r: (not self._page_ready(r, a),
                                          r.load, r.idx))
@@ -461,7 +608,129 @@ class FleetRouter:
                     rep.queue_depth)
             _flight.record("fleet_dispatch", rid=rid, replica=rep.idx,
                            reason=reason, queue=rep.queue_depth)
+            if self.shadow is not None and self.shadow.wants(rid):
+                self._mirror_to_shadow(rid, req)
         return refused
+
+    # --- shadow serving (r17 tentpole, ISSUE 12) --------------------------
+    def _mirror_to_shadow(self, rid: int, req: Request) -> None:
+        """Mirror one admitted request into the shadow engine's queue.
+        Runs inside the shadow scope + the shadow's registry: the
+        primary's metrics and journal decision stream are untouched."""
+        sh = self.shadow
+        eng = sh.engine
+        if (len(req.prompt) > max(eng.buckets)
+                or len(req.prompt) + req.max_new_tokens - 1 > eng.max_len):
+            sh.dropped += 1     # variant geometry can't hold the mirror
+            return
+        with _journal.shadow_scope(), \
+                _metrics.scoped_registry(sh.registry):
+            serid = eng.add_request(np.asarray(req.prompt, np.int32),
+                                    req.max_new_tokens)
+            sh._map[serid] = rid
+            sh._awaiting.add(rid)
+            sh.mirrored += 1
+            _journal.record("shadow_mirror", rid=rid, shadow_rid=serid)
+
+    def _shadow_step(self, now_abs: float) -> None:
+        """Advance the shadow by at most one finish + one dispatch,
+        strictly AFTER this loop turn's primary work. The shadow's
+        segment fetch is its own sanctioned ``allowed_sync`` (the
+        fleet-loop audit counts primary + shadow fetches exactly);
+        ``now_abs`` is the loop's already-read decision clock, so the
+        shadow adds ZERO clock reads to the primary stream."""
+        sh = self.shadow
+        if sh is None:
+            return
+        eng = sh.engine
+        with _journal.shadow_scope():
+            finished = False
+            with _metrics.scoped_registry(sh.registry):
+                if eng._pending_seg is not None:
+                    eng.finish_segment()
+                    sh.segments += 1
+                    finished = True
+            if finished:
+                # pair collection runs OUTSIDE the shadow's scoped
+                # registry: the quality gauges/counters are the
+                # process (fleet-view) surface an operator scrapes
+                self._collect_shadow()
+            with _metrics.scoped_registry(sh.registry):
+                if ((eng._queue or eng.free_slot_count() < eng.slots)
+                        and eng._pending_seg is None):
+                    eng.dispatch_segment(
+                        sh.seg_steps if sh.seg_steps else self.seg_steps,
+                        now=now_abs)
+
+    def _collect_shadow(self) -> None:
+        """Harvest finished shadow requests (tokens + digests) and diff
+        any completed pairs. Caller holds the shadow scope but NOT the
+        shadow's scoped registry — quality metrics are the process
+        view."""
+        sh = self.shadow
+        eng = sh.engine
+        if not eng._finished:
+            return
+        digs = {r.rid: r.digests for r in eng._finished}
+        done = eng.collect_finished()
+        for serid, toks in done.items():
+            rid = sh._map.pop(serid, None)
+            if rid is None:
+                continue
+            d = digs.get(serid)
+            sh._shadow[rid] = (toks, d[:len(toks)] if d else None)
+            self._compare_pair(rid)
+
+    def _collect_primary(self, rep: _Replica, ev: dict) -> None:
+        """Primary side of the pair: at a mirrored request's finish,
+        snapshot its final token stream (and digests) — host mirrors of
+        the fetch that just completed. Runs OUTSIDE the replica's
+        scoped registry so the quality metrics land in the process
+        (fleet-view) registry."""
+        sh = self.shadow
+        by_erid = {self._reqs[rid][1].rid: rid for rid in rep.rids}
+        for erid in ev["finished"]:
+            frid = by_erid[erid]
+            if frid not in sh._awaiting:
+                continue
+            req = self._reqs[frid][1]
+            toks = _quality.final_tokens(req.tokens, req.max_new_tokens,
+                                         rep.engine.eos)
+            digs = (req.digests[:len(toks)] if req.digests else None)
+            with _journal.shadow_scope():
+                sh._primary[frid] = (toks, digs, req.priority)
+                self._compare_pair(frid)
+
+    def _compare_pair(self, rid: int) -> None:
+        """Diff a mirrored pair once BOTH sides finished. Caller holds
+        the shadow scope (the quality_alert / quality_divergence /
+        shadow_finish records are journaled but marked off the primary
+        decision stream)."""
+        sh = self.shadow
+        if rid not in sh._primary or rid not in sh._shadow:
+            return
+        p_toks, p_digs, prio = sh._primary.pop(rid)
+        s_toks, s_digs = sh._shadow.pop(rid)
+        sh._awaiting.discard(rid)
+        res = sh.monitor.note_pair(rid, p_toks, s_toks, p_digs, s_digs,
+                                   cls=prio)
+        sh.compared += 1
+        _journal.record("shadow_finish", rid=rid, match=res["match"],
+                        first_divergence=res["first_divergence"],
+                        compared=res["compared"])
+
+    def _drain_shadow(self) -> None:
+        """Finish the shadow's remaining mirrored work after the
+        primary trace completed — off the critical path by construction
+        (primary makespan is already stamped). Entirely inside the
+        shadow scope: its clock reads never enter the primary decision
+        stream."""
+        sh = self.shadow
+        if sh is None:
+            return
+        with _journal.shadow_scope():
+            while sh.busy:
+                self._shadow_step(_journal.now())
 
     # --- the serve loop --------------------------------------------------
     def serve(self, arrivals: Sequence[Arrival], warm: bool = False
@@ -517,6 +786,9 @@ class FleetRouter:
                     h = r.engine.dispatch_segment(
                         self.seg_steps, prefix_cache=r.prefix_cache)
                 inflight.append((r, h, _journal.now()))
+            # r17: shadow work rides strictly AFTER the primary
+            # dispatches of this turn, on the already-read clock
+            self._shadow_step(now + t0)
             if not inflight:
                 if pending:
                     gap = pending[0].t - (_journal.now() - t0)
@@ -533,6 +805,11 @@ class FleetRouter:
             if self._finish_one(r, h, t_disp):
                 segments += 1
         makespan = _journal.now() - t0
+        # r17: the shadow drains AFTER the primary makespan stamp (off
+        # the critical path), and the canary issues its final verdict
+        self._drain_shadow()
+        if self.canary is not None:
+            self.canary.evaluate(final=True)
 
         reqs = [req for _, req in self._reqs.values()]
         assert all(
@@ -564,6 +841,14 @@ class FleetRouter:
                                     for r in reps),
             dispatches_least_loaded=sum(r.dispatches["least_loaded"]
                                         for r in reps),
+            dispatches_canary=sum(r.dispatches.get("canary", 0)
+                                  for r in reps),
+            quality=(self.shadow.monitor.report()
+                     if self.shadow is not None else None),
+            shadow=(self.shadow.stats()
+                    if self.shadow is not None else None),
+            canary=(self.canary.report()
+                    if self.canary is not None else None),
             failovers=self.failovers,
             requeued=self.requeued,
             replica_health={r.idx: r.health for r in reps},
@@ -651,6 +936,16 @@ class FleetRouter:
                 _metrics.counter("fleet.finish_retries").inc()
         rep.segments += 1
         self._finished_count += len(ev["finished"])
+        # r17 (ISSUE 12): shadow pair collection + canary outcome feed
+        # — host mirrors of the fetch above, outside the replica's
+        # scoped registry (quality/canary metrics are the fleet view)
+        if self.shadow is not None and ev["finished"]:
+            self._collect_primary(rep, ev)
+        if self.canary is not None and outcomes:
+            grp = ("canary" if rep.idx == self.canary.replica
+                   else "control")
+            for kind, prio, lat in outcomes:
+                self.canary.note_outcome(grp, kind, prio, lat)
         # r14 fleet monitor feed (outside the scoped registry: the SLO/
         # perf gauges are the FLEET view, not a replica's) — host
         # mirrors of the fetch above plus its dispatch→fetch span
@@ -658,6 +953,11 @@ class FleetRouter:
             for kind, prio, lat in outcomes:
                 (self.slo_monitor.note_ttft if kind == "ttft"
                  else self.slo_monitor.note_e2e)(prio, lat)
+            sp = ev.get("spec")
+            if sp and sp.get("proposed"):
+                # r17 accept-drift feed (ISSUE 12 satellite)
+                self.slo_monitor.note_accept_rate(
+                    sp["accepted"] / sp["proposed"])
             self.slo_monitor.end_segment()
         if self.perf_monitor is not None:
             self.perf_monitor.note_segment(ev["steps"],
@@ -839,6 +1139,15 @@ class FleetRouter:
                 r.prefix_cache) for r in self._replicas],
             "fault": (self.fault_injector.describe()
                       if self.fault_injector is not None else None),
+            # r17: the canary is a DECIDER (routing input) and rides the
+            # header for replay rebuild; the shadow is an OBSERVER —
+            # described for the record, never rebuilt by replay
+            "canary": (self.canary.describe()
+                       if self.canary is not None else None),
+            "shadow": (None if self.shadow is None else {
+                "sample_p": self.shadow.sample_p,
+                "seed": self.shadow.seed,
+                "engine": _journal.describe_engine(self.shadow.engine)}),
             "llama": _journal.describe_config(
                 self._replicas[0].engine.cfg),
             "monitors": {"slo": self.slo_monitor is not None,
@@ -870,7 +1179,7 @@ class FleetRouter:
                 r.prefix_cache.reset()
             r.registry.reset()
             r.backpressure_events = 0
-            r.dispatches = {"affinity": 0, "least_loaded": 0}
+            r.dispatches = {"affinity": 0, "least_loaded": 0, "canary": 0}
             r.segments = 0
             r.rids = []
             r.health = "healthy"
@@ -890,6 +1199,10 @@ class FleetRouter:
             # cut (and discard) the warm interval; the self-pinned tick
             # budget survives — the warm baseline is the reference
             self.perf_monitor.end_interval()
+        if self.shadow is not None:
+            self.shadow.reset()
+        if self.canary is not None:
+            self.canary.reset()
 
     def leak_report(self) -> List[str]:
         """Aggregated page-leak audit across replicas: with no live

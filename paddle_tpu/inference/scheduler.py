@@ -401,6 +401,12 @@ class OnlineScheduler:
             # the explained-perf intervals — host ints from the event
             # log just fetched, plus this segment's dispatch→fetch span
             if mon is not None:
+                # r17 accept-drift feed (ISSUE 12 satellite): this
+                # segment's speculative acceptance rate, from the spec
+                # stats the replay already recovered
+                sp = ev.get("spec")
+                if sp and sp.get("proposed"):
+                    mon.note_accept_rate(sp["accepted"] / sp["proposed"])
                 mon.end_segment()
             if self.perf_monitor is not None:
                 self.perf_monitor.note_segment(

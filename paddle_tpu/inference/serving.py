@@ -168,6 +168,10 @@ class _PendingSegment:
     # its event log carries [steps, slots, K+1] token matrices plus the
     # per-step accepted counts the host replay distributes
     spec: bool = False
+    # r17: True when the segment ran the quality-digest program — its
+    # event log additionally carries per-step per-slot logit digests
+    # (emitted logit + top-k ids/values) in the same fetch
+    digest: bool = False
 
 
 @dataclass
@@ -208,6 +212,12 @@ class Request:
     seed: int = 0
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # r17 quality digests (ISSUE 12): one (emitted_logit, top-k ids,
+    # top-k values) triple per emitted token, recovered by the host
+    # replay from the same single audited event fetch — None unless the
+    # engine runs with quality_digest=True. The shadow-diff monitor
+    # compares these across a primary/shadow pair.
+    digests: Optional[List[tuple]] = None
 
     @property
     def done(self) -> bool:
@@ -252,7 +262,9 @@ class ServingEngine:
                  prefill_chunks: Sequence[int] = (8, 16, 32, 64),
                  speculative: int = 0,
                  sampling: Optional[dict] = None,
-                 sample_seed: int = 0):
+                 sample_seed: int = 0,
+                 quality_digest: bool = False,
+                 digest_top_k: int = 4):
         self.cfg = cfg
         self.params = params
         self.slots = int(slots)
@@ -340,6 +352,37 @@ class ServingEngine:
                 "speculative/sampled decoding requires paged=True (the "
                 "verify tick reuses the page-indirect q_len>1 path and "
                 "the RNG/history state rides the paged segment family)")
+        # r17 quality digests (ISSUE 12): per-emitted-token logit
+        # evidence computed IN-PROGRAM and rolled into the segment event
+        # log — the emitted token's logit plus the top-k (ids, values)
+        # of the tick's distribution — riding the SAME single audited
+        # per-segment fetch. This is the raw material shadow-diff
+        # quality monitoring (observability/quality.py) compares across
+        # a primary/shadow engine pair: token divergence localises to an
+        # exact position, and logit-error budgets (max |Δ|, sampled KL)
+        # quantify "how different" below the token-flip threshold.
+        # Digests ride the plain paged segment family only — chunked /
+        # speculative / sampled variants diff at TOKEN level (their
+        # event logs already carry the emitted stream); a digest there
+        # would multiply the log width for no extra diff power on the
+        # greedy chains they emit.
+        self.quality_digest = bool(quality_digest)
+        self.digest_top_k = int(digest_top_k)
+        if self.quality_digest:
+            if not self.paged:
+                raise ValueError(
+                    "quality_digest requires paged=True (digests extend "
+                    "the paged segment event log; the contiguous "
+                    "engine's windowed path has no single event fetch "
+                    "to ride)")
+            if self.chunked or self.speculative or self.sampling:
+                raise ValueError(
+                    "quality_digest composes with the plain paged "
+                    "segment only — chunked/speculative/sampled "
+                    "variants are shadow-diffed at token level")
+            if self.digest_top_k < 1:
+                raise ValueError(f"digest_top_k must be >= 1, got "
+                                 f"{digest_top_k}")
         # acceptance EWMA (emitted tokens per verify tick, >= 1): the
         # SLO scheduler threads this through its deadline and
         # retry_after_s estimates so speculative serves don't over-shed
@@ -448,7 +491,8 @@ class ServingEngine:
         chunked paged segments on ("cseg", n_pad, s_max_c, C, steps) with
         C drawn from the declared prefill_chunks ladder, speculative/
         sampled segments on ("sseg", n_pad, K, steps) with the admit
-        width PINNED to the largest bucket — all bucketed by
+        width PINNED to the largest bucket, quality-digest paged
+        segments on ("qseg", n_pad, s_max, steps) — all bucketed by
         construction, so key-count growth here means a shape leaked
         past the buckets (the 2.5 s mid-serve compile class this
         engine's width pinning fixed). Note the PAGED keys carry no
@@ -519,7 +563,8 @@ class ServingEngine:
         return (self.cfg, self.slots, self.max_len, self.eos, self.chunk,
                 self.paged, self.pager.max_pages if self.paged else None,
                 self.mesh, self.speculative, self.sampling,
-                self.chunked, self.prefill_chunks, self.buckets, key)
+                self.chunked, self.prefill_chunks, self.buckets,
+                self.digest_top_k if self.quality_digest else None, key)
 
     def _memo_prog(self, key: tuple, build):
         """Two-level memo: per-engine ``_progs`` (the recompile lint's
@@ -1013,7 +1058,8 @@ class ServingEngine:
     def _replay_segment(self, picked, toks, aq, aslot, steps: int, n: int,
                         on_admit=None, on_retire=None,
                         chunk_marker: Optional[int] = None,
-                        acc=None, spec_stats: Optional[dict] = None):
+                        acc=None, spec_stats: Optional[dict] = None,
+                        dig=None):
         """Host replay of a segment's event log — ONE contract for the
         contiguous and paged engines: walk the log chronologically,
         tracking slot occupancy (admits rebind a slot; decode ticks
@@ -1052,6 +1098,8 @@ class ServingEngine:
                 t = int(toks[st, s, 0] if acc is not None
                         else toks[st, s])
                 r.tokens.append(t)
+                if dig is not None:
+                    self._append_digest(r, dig, st, s)
                 new_tokens += 1
                 admitted.append(r.rid)
                 if len(r.tokens) == 1:
@@ -1078,6 +1126,8 @@ class ServingEngine:
                         continue
                     t = int(toks[st, s])
                     r.tokens.append(t)
+                    if dig is not None:
+                        self._append_digest(r, dig, st, s)
                     new_tokens += 1
                     if len(r.tokens) == 1:
                         first_tokens.append(r.rid)
@@ -1131,6 +1181,19 @@ class ServingEngine:
         if new_tokens and self.cold_start_s is None:
             self._note_cold_start()
         return admitted, first_tokens, finished, new_tokens, eos_stops
+
+    @staticmethod
+    def _append_digest(r: Request, dig, st: int, s: int) -> None:
+        """Distribute one event-log digest row to its request (r17):
+        host arithmetic on the already-fetched arrays — (emitted-token
+        logit, top-k ids, top-k values), index-aligned with
+        ``r.tokens``."""
+        dlg, dti, dtv = dig
+        if r.digests is None:
+            r.digests = []
+        r.digests.append((float(dlg[st, s]),
+                          [int(i) for i in dti[st, s]],
+                          [float(v) for v in dtv[st, s]]))
 
     def _note_cold_start(self) -> None:
         """First host-visible token since build: stamp the cold-start
@@ -1540,13 +1603,30 @@ class ServingEngine:
         The memo key carries NO prefix width: prefix geometry is page
         DATA (pre_lens + tables), not shape — a shared-prefix workload
         adds zero program shapes (one fewer recompile hazard than the
-        contiguous engine's ("seg", ..., pre_max, ...) family)."""
+        contiguous engine's ("seg", ..., pre_max, ...) family).
+
+        r17 (ISSUE 12): with ``quality_digest`` the program family is
+        ("qseg", n_pad, s_max, steps) — same loop, same single fetch,
+        but the event log additionally carries per-step per-slot logit
+        digests (the emitted token's logit + the tick's top-k ids and
+        values, fp32) computed in-program from logits the tick already
+        produced. Digest arrays are [steps, slots(, k)] — bytes per
+        tick are (1 + 2k) * 4 * slots, invisible next to the weight
+        stream (SCALING §3l) — and ride the SAME audited fetch, so the
+        one-dispatch/one-fetch contract is untouched (the
+        quality_serving_segment gate program pins it)."""
+        if self.quality_digest:
+            key = ("qseg", n_pad, s_max, max_steps)
+            return self._memo_prog(
+                key, lambda: self._build_paged_segment_prog(
+                    n_pad, s_max, max_steps,
+                    digest_k=self.digest_top_k))
         key = ("pseg", n_pad, s_max, max_steps)
         return self._memo_prog(key, lambda: self._build_paged_segment_prog(
             n_pad, s_max, max_steps))
 
     def _build_paged_segment_prog(self, n_pad: int, s_max: int,
-                                  max_steps: int):
+                                  max_steps: int, digest_k: int = 0):
         cfg, slots, eos = self.cfg, self.slots, self.eos
         max_pages = self.pager.max_pages
 
@@ -1561,6 +1641,16 @@ class ServingEngine:
                 aslot=jnp.zeros((max_steps,), i32),
                 qidx=i32(0), step=i32(0),
             )
+            if digest_k:
+                # r17 logit digests: emitted-token logit + top-k
+                # (ids, values) per step/slot — fp32 event-log columns
+                # the host replay distributes per request
+                st.update(
+                    dlg=jnp.zeros((max_steps, slots), jnp.float32),
+                    dti=jnp.zeros((max_steps, slots, digest_k), i32),
+                    dtv=jnp.zeros((max_steps, slots, digest_k),
+                                  jnp.float32),
+                )
 
             def cond(st):
                 work = jnp.any(st["rem"] > 0) | (st["qidx"] < n_real)
@@ -1586,7 +1676,7 @@ class ServingEngine:
                 rem_new = gens[q] - 1
                 if eos is not None:
                     rem_new = jnp.where(t0 == eos, 0, rem_new)
-                return dict(
+                new = dict(
                     pool=pool,
                     pt=st["pt"].at[s].set(row[0]),
                     pos=st["pos"].at[s].set(pln + ln),
@@ -1597,6 +1687,15 @@ class ServingEngine:
                     aslot=st["aslot"].at[st["step"]].set(s),
                     qidx=q + 1, step=st["step"],
                 )
+                if digest_k:
+                    lg = logits.astype(jnp.float32)       # [1, V]
+                    tv, ti = jax.lax.top_k(lg, digest_k)
+                    el = jnp.take_along_axis(
+                        lg, t0.reshape(1, 1), axis=-1)[0, 0]
+                    new["dlg"] = st["dlg"].at[st["step"], s].set(el)
+                    new["dti"] = st["dti"].at[st["step"], s].set(ti[0])
+                    new["dtv"] = st["dtv"].at[st["step"], s].set(tv[0])
+                return new
 
             def decode(st):
                 live = st["rem"] > 0
@@ -1608,7 +1707,7 @@ class ServingEngine:
                 rem = st["rem"] - live.astype(jnp.int32)
                 if eos is not None:
                     rem = jnp.where(live & (tok == eos), 0, rem)
-                return dict(
+                new = dict(
                     pool=pool, pt=st["pt"],
                     pos=st["pos"] + live.astype(jnp.int32),
                     nxt=tok, rem=rem,
@@ -1616,6 +1715,15 @@ class ServingEngine:
                     aq=st["aq"], aslot=st["aslot"],
                     qidx=st["qidx"], step=st["step"],
                 )
+                if digest_k:
+                    lg = logits.astype(jnp.float32)       # [slots, V]
+                    tv, ti = jax.lax.top_k(lg, digest_k)
+                    el = jnp.take_along_axis(lg, tok[:, None],
+                                             axis=-1)[:, 0]
+                    new["dlg"] = st["dlg"].at[st["step"]].set(el)
+                    new["dti"] = st["dti"].at[st["step"]].set(ti)
+                    new["dtv"] = st["dtv"].at[st["step"]].set(tv)
+                return new
 
             def body(st):
                 can_admit = (st["qidx"] < n_real) & jnp.any(st["rem"] == 0)
@@ -1624,9 +1732,11 @@ class ServingEngine:
                 return st
 
             st = jax.lax.while_loop(cond, body, st)
-            return (st["pool"], st["pt"], st["pos"], st["nxt"], st["rem"],
-                    st["out"], st["aq"], st["aslot"], st["step"],
-                    st["qidx"])
+            outs = (st["pool"], st["pt"], st["pos"], st["nxt"], st["rem"],
+                    st["out"], st["aq"], st["aslot"])
+            if digest_k:
+                outs += (st["dlg"], st["dti"], st["dtv"])
+            return outs + (st["step"], st["qidx"])
 
         return segment
 
@@ -2265,7 +2375,8 @@ class ServingEngine:
                                prefix_cache=prefix_cache, dev=out[5:],
                                pre_lens=pre_lens_l, req_pages=req_pages,
                                full_prompts=fulls,
-                               chunk_marker=chunk_marker)
+                               chunk_marker=chunk_marker,
+                               digest=self.quality_digest)
 
     def _finish_segment_paged(self, p: _PendingSegment) -> dict:
         picked, n, prefix_cache = p.picked, p.n, p.prefix_cache
@@ -2275,10 +2386,16 @@ class ServingEngine:
         # THE per-segment sync (same audited label + budget as the
         # contiguous engine: exactly one device contact per segment —
         # the spec program's acceptance counts ride the same fetch)
-        acc = spec_stats = None
+        acc = spec_stats = dig = None
         with allowed_sync("serving.segment_event_fetch"):
             if p.spec:
                 toks, aq, aslot, acc, steps, qadm = jax.device_get(p.dev)
+            elif p.digest:
+                # r17: digest columns ride the SAME single fetch — the
+                # per-segment sync count is unchanged (audited)
+                (toks, aq, aslot, dlg, dti, dtv, steps,
+                 qadm) = jax.device_get(p.dev)
+                dig = (dlg, dti, dtv)
             else:
                 toks, aq, aslot, steps, qadm = jax.device_get(p.dev)
         steps, qadm = int(steps), int(qadm)
@@ -2305,7 +2422,7 @@ class ServingEngine:
             self._replay_segment(picked, toks, aq, aslot, steps, n,
                                  on_admit, on_retire,
                                  chunk_marker=p.chunk_marker,
-                                 acc=acc, spec_stats=spec_stats)
+                                 acc=acc, spec_stats=spec_stats, dig=dig)
         if p.chunk_marker is not None:
             chunk_steps = int(np.sum(np.asarray(aq[:steps])
                                      >= p.chunk_marker))
@@ -2358,6 +2475,8 @@ class ServingEngine:
             if self.eos is not None and self.eos in toks:
                 toks = toks[:toks.index(self.eos) + 1]
             r.tokens = toks
+            if r.digests is not None:
+                r.digests = r.digests[:len(toks)]  # stay index-aligned
             done[r.rid] = toks
             self.last_latencies[r.rid] = r.finish_time - r.submit_time
         self._finished = []

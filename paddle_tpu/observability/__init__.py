@@ -28,7 +28,14 @@ Plus the r14 live ops surface (ISSUE 9) over those signals:
 * :mod:`exporter` — ``OpsServer``, an explicit-start stdlib HTTP
   scrape surface: ``/metrics`` ``/snapshot.json`` ``/healthz``
   ``/flight`` ``/slo`` ``/perf`` (r16: + ``/journal`` and
-  ``/request/<rid>``).
+  ``/request/<rid>``; r17: + ``/quality``).
+* :mod:`quality` — r17 (ISSUE 12) online quality observability:
+  shadow-pair diffing (token-match-rate, exact first-divergence
+  position, logit-error budgets over the r17 in-program digests),
+  ok→warning→page alert rules, and the canary controller's
+  per-class verdicts with auto-hold — the quality bar every engine
+  variant (quantized weights, new kernels, spec ladders) ships
+  behind.
 
 And the r16 black box (ISSUE 11) over everything above:
 
@@ -66,10 +73,12 @@ no-op (the ≤2 % serving overhead gate compares against exactly that).
 
 from __future__ import annotations
 
-from . import exporter, flight, journal, metrics, perf, replay, slo, tracing
+from . import (exporter, flight, journal, metrics, perf, quality, replay,
+               slo, tracing)
 from .exporter import OpsServer
 from .flight import FLIGHT, dump_on_exception
 from .journal import Journal, read_journal, request_journey
+from .quality import CanaryController, QualityMonitor, compare_pair
 from .metrics import (counter, enabled, gauge, histogram, merge_log_dir,
                       merge_snapshots, percentile, registry,
                       render_prometheus, reset, set_enabled, snapshot,
@@ -81,7 +90,8 @@ from .tracing import emit_journey_trace, emit_request_trace, span, step_span
 
 __all__ = [
     "metrics", "tracing", "flight", "slo", "perf", "exporter", "journal",
-    "replay", "counter",
+    "replay", "quality", "QualityMonitor", "CanaryController",
+    "compare_pair", "counter",
     "gauge", "histogram", "percentile", "registry", "snapshot",
     "render_prometheus", "merge_snapshots", "merge_log_dir",
     "write_snapshot", "reset", "set_enabled", "enabled", "span",

@@ -21,6 +21,11 @@ of the package enforces at the record path). Endpoints:
                    r16: ``?kind=`` / ``?rid=`` filter by event kind /
                    request id).
 ``/slo``           The SLO monitor's budget/burn/alert state.
+``/quality``       The shadow-diff quality monitor's state (r17,
+                   ISSUE 12): token-match-rate, first-divergence
+                   positions, logit-error stats, alert level/timeline
+                   — plus the canary controller's verdicts when one is
+                   attached.
 ``/perf``          The explained-performance ledger + interval report.
 ``/journal``       Deterministic-journal tail (r16, ISSUE 11): the
                    lossless decision stream's newest records, filtered
@@ -71,7 +76,7 @@ class OpsServer:
                  registry: Optional[_metrics.Registry] = None,
                  slo_monitor=None, perf_monitor=None, fleet=None,
                  log_dir: Optional[str] = None, recorder=None,
-                 journal=None):
+                 journal=None, quality_monitor=None, canary=None):
         self.host = host
         self.port = int(port)
         self.registry = registry
@@ -81,6 +86,11 @@ class OpsServer:
         self.log_dir = log_dir
         self.recorder = recorder
         self.journal = journal         # r16: explicit > process-attached
+        # r17 (ISSUE 12): explicit quality monitor / canary controller;
+        # with a fleet attached, its shadow's monitor and canary are
+        # the fallbacks (the live wiring an operator actually has)
+        self.quality_monitor = quality_monitor
+        self.canary = canary
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -206,6 +216,33 @@ class OpsServer:
         record stream (files when file-backed), not just the tail."""
         return self._journal().request_journey(rid)
 
+    def _quality_monitor(self):
+        if self.quality_monitor is not None:
+            return self.quality_monitor
+        if self.fleet is not None and getattr(self.fleet, "shadow",
+                                              None) is not None:
+            return self.fleet.shadow.monitor
+        return None
+
+    def _canary(self):
+        if self.canary is not None:
+            return self.canary
+        if self.fleet is not None:
+            return getattr(self.fleet, "canary", None)
+        return None
+
+    def payload_quality(self) -> dict:
+        mon = self._quality_monitor()
+        can = self._canary()
+        if mon is None and can is None:
+            return {"enabled": False}
+        out = {"enabled": True}
+        if mon is not None:
+            out.update(mon.report())
+        if can is not None:
+            out["canary"] = can.report()
+        return out
+
     def payload_slo(self) -> dict:
         if self.slo_monitor is None:
             return {"enabled": False}
@@ -258,6 +295,8 @@ def _make_handler(srv: OpsServer):
                         rid=int(rid) if rid is not None else None))
                 elif u.path == "/slo":
                     self._send_json(200, srv.payload_slo())
+                elif u.path == "/quality":
+                    self._send_json(200, srv.payload_quality())
                 elif u.path == "/perf":
                     self._send_json(200, srv.payload_perf())
                 elif u.path == "/journal":
@@ -274,7 +313,7 @@ def _make_handler(srv: OpsServer):
                     self._send_json(200, {
                         "endpoints": ["/metrics", "/snapshot.json",
                                       "/healthz", "/flight", "/slo",
-                                      "/perf", "/journal",
+                                      "/quality", "/perf", "/journal",
                                       "/request/<rid>"]})
                 else:
                     self._send_json(404, {"error": f"no route {u.path}"})

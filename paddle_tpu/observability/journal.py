@@ -68,10 +68,11 @@ import numpy as np
 
 __all__ = ["SCHEMA_VERSION", "DECISION_KINDS", "Journal", "JournalError",
            "install", "uninstall", "attach", "active", "record", "now",
-           "sleep", "rank_scope", "feed_clock", "read_journal",
-           "merge_journal_dir", "sections", "request_journey",
-           "journey_summary", "describe_engine", "describe_config",
-           "describe_arrivals", "describe_prefix_cache"]
+           "sleep", "rank_scope", "shadow_scope", "feed_clock",
+           "read_journal", "merge_journal_dir", "sections",
+           "request_journey", "journey_summary", "describe_engine",
+           "describe_config", "describe_arrivals",
+           "describe_prefix_cache"]
 
 SCHEMA_VERSION = 1
 
@@ -186,6 +187,15 @@ class Journal:
             rec = {"v": SCHEMA_VERSION, "gseq": self._gseq, "rank": r,
                    "seq": seq, "t": time.time(), "kind": kind,
                    **{k: _jsonable(v) for k, v in data.items()}}
+            if _SHADOW[0]:
+                # r17 (ISSUE 12): records written from the SHADOW path
+                # (mirrored segments, quality compares, drain clock
+                # reads) are journaled losslessly but marked — the
+                # replay diff excludes them, because the primary
+                # decision stream must certify identical whether or
+                # not a shadow happened to be attached (the shadow is
+                # an observer, never a decider)
+                rec["shadow"] = True
             self.total_records += 1
             self._tail.append(rec)
             if self._memory is not None:
@@ -332,6 +342,32 @@ def rank_scope(rank: int):
         return
     with j.rank_scope(rank):
         yield j
+
+
+# r17 (ISSUE 12): depth-counted shadow marker. The fleet router wraps
+# ALL shadow-path work (mirror intake, shadow segment dispatch/finish,
+# quality compares, the post-serve shadow drain) in this scope so every
+# record it produces — including ``clock`` reads — carries
+# ``shadow: true``. Replay then diffs the primary decision stream
+# alone: a serve with a shadow attached certifies bit-identical to its
+# own replay WITHOUT the replay having to rebuild and re-run the
+# shadow (the shadow is off the decision path by contract).
+_SHADOW = [0]
+
+
+@contextlib.contextmanager
+def shadow_scope():
+    """Mark every journal record (and decision-clock read) inside the
+    scope as shadow-path — excluded from the replay diff. Re-entrant."""
+    _SHADOW[0] += 1
+    try:
+        yield
+    finally:
+        _SHADOW[0] -= 1
+
+
+def in_shadow_scope() -> bool:
+    return bool(_SHADOW[0])
 
 
 # --- the decision clock ----------------------------------------------------
@@ -529,9 +565,15 @@ def journey_summary(evs: Sequence[dict]) -> dict:
                              "failover_requeue", "admit"):
                 replicas.append(tgt)
     fin = next((e for e in evs if e["kind"] == "finish"), None)
+    shadow = next((e for e in evs if e["kind"] == "shadow_finish"), None)
     return {
         "kinds": kinds,
         "replicas": replicas,
+        # r17: the shadow pair — whether this request was mirrored to a
+        # shadow engine and, if the pair completed, its diff outcome
+        "shadow_pair": any(e["kind"] in ("shadow_mirror", "shadow_finish")
+                           for e in evs),
+        "shadow_match": (shadow or {}).get("match"),
         "dispatch_reason": next((e.get("reason") for e in evs
                                  if e["kind"] in ("dispatch",
                                                   "fleet_dispatch")), None),
@@ -576,6 +618,8 @@ def describe_engine(engine) -> dict:
         "prefill_chunks": list(engine.prefill_chunks),
         "speculative": engine.speculative, "sampling": samp,
         "sample_seed": engine.sample_seed, "mesh": mesh,
+        "quality_digest": getattr(engine, "quality_digest", False),
+        "digest_top_k": getattr(engine, "digest_top_k", 4),
         "next_rid": engine._next_rid,
         "spec_accept_ewma": engine.spec_accept_ewma,
     }

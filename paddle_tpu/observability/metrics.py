@@ -267,33 +267,105 @@ class Registry:
         return snap
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition (the scrape format)."""
-        lines: List[str] = []
+        """Prometheus text exposition (the scrape format).
+
+        r17 conformance (ISSUE 12 satellite): metric names carrying a
+        bracket tag — the ``request.ttft[class0]`` / ``[req12]`` /
+        ``slo.burn_rate[class1]`` per-entity convention — used to leak
+        the brackets into the exposition name, which real collectors
+        REJECT (``[`` is not a legal name character). The tag now
+        renders as a proper label (``request_ttft_bucket{class="0",
+        le="0.001"}``), label VALUES are escaped per the spec
+        (backslash, double-quote, newline), remaining illegal name
+        characters sanitise to ``_``, series sharing a family emit ONE
+        ``# TYPE`` line, and histogram ``_bucket`` counts stay
+        cumulative with the ``+Inf`` terminator. A parity test against
+        a hand-written exposition sample pins the format
+        (tests/test_observability.py)."""
+        families: Dict[str, dict] = {}
+        order: List[str] = []
         for name, m in sorted(self._metrics.items()):
-            pname = name.replace(".", "_").replace("-", "_")
-            if m.help:
-                lines.append(f"# HELP {pname} {m.help}")
-            if isinstance(m, Counter):
-                lines.append(f"# TYPE {pname} counter")
-                lines.append(f"{pname}_total {_fmt(m.value)}")
-            elif isinstance(m, Gauge):
-                lines.append(f"# TYPE {pname} gauge")
-                lines.append(f"{pname} {_fmt(m.value)}")
-            else:
-                lines.append(f"# TYPE {pname} histogram")
-                cum = 0
-                for b, c in zip(m.buckets, m.counts):
-                    cum += c
-                    lines.append(f'{pname}_bucket{{le="{_fmt(b)}"}} {cum}')
-                cum += m.counts[-1]
-                lines.append(f'{pname}_bucket{{le="+Inf"}} {cum}')
-                lines.append(f"{pname}_sum {_fmt(m.sum)}")
-                lines.append(f"{pname}_count {m.count}")
+            pname, labels = _prom_name(name)
+            kind = ("counter" if isinstance(m, Counter) else
+                    "gauge" if isinstance(m, Gauge) else "histogram")
+            fam = families.get(pname)
+            if fam is None:
+                fam = families[pname] = {"kind": kind, "help": m.help,
+                                         "series": []}
+                order.append(pname)
+            fam["help"] = fam["help"] or m.help
+            fam["series"].append((labels, m))
+        lines: List[str] = []
+        for pname in order:
+            fam = families[pname]
+            if fam["help"]:
+                lines.append(f"# HELP {pname} {fam['help']}")
+            lines.append(f"# TYPE {pname} {fam['kind']}")
+            for labels, m in fam["series"]:
+                lab = _prom_labels(labels)
+                if isinstance(m, Counter):
+                    lines.append(f"{pname}_total{lab} {_fmt(m.value)}")
+                elif isinstance(m, Gauge):
+                    lines.append(f"{pname}{lab} {_fmt(m.value)}")
+                else:
+                    cum = 0
+                    for b, c in zip(m.buckets, m.counts):
+                        cum += c
+                        lines.append(f"{pname}_bucket" + _prom_labels(
+                            labels + [("le", _fmt(b))]) + f" {cum}")
+                    cum += m.counts[-1]
+                    lines.append(f"{pname}_bucket" + _prom_labels(
+                        labels + [("le", "+Inf")]) + f" {cum}")
+                    lines.append(f"{pname}_sum{lab} {_fmt(m.sum)}")
+                    lines.append(f"{pname}_count{lab} {m.count}")
         return "\n".join(lines) + ("\n" if lines else "")
 
 
 def _fmt(v: float) -> str:
     return repr(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+# --- Prometheus name/label conformance (r17, ISSUE 12 satellite) ----------
+
+import re as _re
+
+_PROM_BAD = _re.compile(r"[^a-zA-Z0-9_:]")
+_PROM_TAG = _re.compile(r"^(.*)\[([^\[\]]+)\]$")
+# `class0` / `req12` / `cls3` — an alpha key fused to a numeric value
+_PROM_KEYVAL = _re.compile(r"^([A-Za-z_]+?)(\d+)$")
+
+
+def _prom_name(name: str):
+    """Split a registry metric name into (exposition_name, labels).
+
+    The registry convention suffixes per-entity series with a bracket
+    tag (``request.ttft[class0]``, ``perf.mfu[decode_tick]``). The tag
+    becomes a label: alpha+digits tags split into key/value
+    (``class0`` → ``class="0"``), anything else lands under the
+    generic ``tag`` key. Dots map to underscores and any remaining
+    illegal character sanitises to ``_``."""
+    labels: List[tuple] = []
+    m = _PROM_TAG.match(name)
+    if m:
+        name, tag = m.group(1), m.group(2)
+        kv = _PROM_KEYVAL.match(tag)
+        if kv:
+            labels.append((kv.group(1), kv.group(2)))
+        else:
+            labels.append(("tag", tag))
+    return _PROM_BAD.sub("_", name.replace(".", "_")), labels
+
+
+def _prom_escape(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_labels(labels) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_prom_escape(v)}"' for k, v in labels)
+    return "{" + inner + "}"
 
 
 def _default_rank() -> int:

@@ -127,7 +127,9 @@ def _engine_from(d: dict, cfg, params):
         chunked_prefill=d["chunked_prefill"],
         prefill_chunks=tuple(d["prefill_chunks"]),
         speculative=d["speculative"], sampling=d["sampling"],
-        sample_seed=d["sample_seed"])
+        sample_seed=d["sample_seed"],
+        quality_digest=d.get("quality_digest", False),
+        digest_top_k=d.get("digest_top_k", 4))
     if d["paged"]:
         kw["page_size"] = d["page_size"]
         kw["num_pages"] = d["num_pages"]
@@ -191,6 +193,28 @@ def rebuild(header: dict, params):
         fk = header["fleet"]
         pcs = [_prefix_cache_from(d, e)
                for d, e in zip(header["prefix_caches"], engines)]
+        # r17: a canary is a routing DECIDER — rebuild it from its
+        # recorded config (assign() is a pure seeded draw and the
+        # latency verdicts re-derive from the fed clock, so holds
+        # replay bit-exactly). A quality-linked canary's holds depend
+        # on shadow-diff state replay does not rebuild: refuse loudly.
+        canary = None
+        ck = header.get("canary")
+        if ck is not None:
+            if ck.get("quality_linked"):
+                raise JournalError(
+                    "recorded canary was linked to a live quality "
+                    "monitor — its hold decisions depend on shadow-"
+                    "diff state the replay does not rebuild; replay "
+                    "latency-only canaries, or drive rebuild() "
+                    "yourself with the shadow re-attached")
+            from .quality import CanaryController
+
+            canary = CanaryController(
+                ck["replica"], weight=ck["weight"], seed=ck["seed"],
+                latency_ratio_max=ck["latency_ratio_max"],
+                min_outcomes=ck["min_outcomes"],
+                verdict_every=ck["verdict_every"])
         router = FleetRouter(
             engines, max_queue=fk["max_queue"], seg_steps=fk["seg_steps"],
             prefix_caches=(pcs if any(p is not None for p in pcs)
@@ -200,7 +224,8 @@ def rebuild(header: dict, params):
             max_finish_retries=fk["max_finish_retries"],
             max_requeues=fk["max_requeues"],
             fault_injector=_injector_from(header.get("fault")),
-            probe_after_s=fk["probe_after_s"])
+            probe_after_s=fk["probe_after_s"],
+            canary=canary)
         router._next_rid = int(fk.get("next_rid", 0))
         return router, trace
     sk = header["scheduler"]
@@ -224,7 +249,13 @@ def rebuild(header: dict, params):
 # --- the diff --------------------------------------------------------------
 
 def _decision_stream(records: Sequence[dict]) -> List[dict]:
-    return [r for r in records if r["kind"] in DECISION_KINDS]
+    # r17: shadow-marked records (mirrored segments, quality compares,
+    # shadow drain clock reads) are journaled losslessly but sit OFF
+    # the decision stream — the shadow is an observer, and a serve must
+    # replay identically whether or not one was attached (the replay
+    # does not rebuild the shadow; see fleet.Shadow)
+    return [r for r in records
+            if r["kind"] in DECISION_KINDS and not r.get("shadow")]
 
 
 def diff_decisions(recorded: Sequence[dict],

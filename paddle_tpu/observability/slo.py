@@ -132,10 +132,21 @@ class SLOMonitor:
     calm segments required before an alert level drops (hysteresis).
     """
 
+    # r17 (ISSUE 12 satellite) accept-drift defaults: a sustained fast-
+    # EWMA drop of >= `drop` below the slow baseline over `sustain`
+    # consecutive segments is a warning — the r14 two-signal shape
+    # (fast reacts, sustained-streak suppresses blips) applied to the
+    # speculative acceptance rate, the one serving signal that degrades
+    # SILENTLY (tokens stay correct, throughput quietly halves).
+    _ACCEPT_DRIFT_DEFAULTS = {"drop": 0.25, "sustain": 4,
+                              "min_segments": 8, "fast_alpha": 0.5,
+                              "slow_alpha": 0.05}
+
     def __init__(self, objectives: Dict[int, Objective],
                  fast_window: int = 4, slow_window: int = 16,
                  warn_burn: float = 2.0, page_burn: float = 8.0,
-                 clear_after: int = 4):
+                 clear_after: int = 4,
+                 accept_drift: Optional[dict] = None):
         if not objectives:
             raise ValueError("SLOMonitor needs at least one objective")
         if not 0 < fast_window <= slow_window:
@@ -150,10 +161,27 @@ class SLOMonitor:
         self.warn_burn = float(warn_burn)
         self.page_burn = float(page_burn)
         self.clear_after = int(clear_after)
+        self.accept_drift = (dict(self._ACCEPT_DRIFT_DEFAULTS,
+                                  **accept_drift)
+                             if accept_drift is not None else None)
+        if self.accept_drift is not None:
+            if not 0.0 < self.accept_drift["drop"] < 1.0:
+                raise ValueError(f"accept_drift drop must be in (0, 1), "
+                                 f"got {self.accept_drift['drop']}")
         self.segment_no = 0
         self.alert_log: List[dict] = []
         self._classes = {p: _ClassState(o, slow_window)
                          for p, o in self.objectives.items()}
+        self._reset_drift()
+
+    def _reset_drift(self) -> None:
+        self._acc_fast: Optional[float] = None
+        self._acc_base: Optional[float] = None
+        self._acc_streak = 0
+        self._acc_clear = 0
+        self._acc_n = 0
+        self.drift_level = "ok"
+        self.drift_log: List[dict] = []
 
     # --- outcome intake (host floats from the scheduler's stamps) --------
     def _note(self, priority: int, value_s: float,
@@ -180,6 +208,57 @@ class SLOMonitor:
         cs = self._classes.get(priority)
         if cs is not None:
             self._note(priority, float(e2e_s), cs.objective.e2e_target_s)
+
+    def note_accept_rate(self, rate: float) -> None:
+        """One segment's speculative acceptance rate (accepted/proposed
+        — the schedulers feed it from the segment result's spec stats,
+        host arithmetic on the already-fetched event log). r17 drift
+        rule (ISSUE 12 satellite): a fast EWMA that stays >= ``drop``
+        below the slow baseline for ``sustain`` consecutive segments
+        raises a WARNING-level ``accept_drift`` alert (flight +
+        journal); the hysteretic clear mirrors the burn-rate rules.
+        No-op unless ``accept_drift=`` was configured."""
+        cfg = self.accept_drift
+        if cfg is None:
+            return
+        r = float(rate)
+        fa, sa = cfg["fast_alpha"], cfg["slow_alpha"]
+        self._acc_fast = (r if self._acc_fast is None
+                          else fa * r + (1.0 - fa) * self._acc_fast)
+        self._acc_base = (r if self._acc_base is None
+                          else sa * r + (1.0 - sa) * self._acc_base)
+        self._acc_n += 1
+        _metrics.gauge("slo.accept_rate_ewma").set(self._acc_fast)
+        _metrics.gauge("slo.accept_rate_baseline").set(self._acc_base)
+        if self._acc_n < cfg["min_segments"]:
+            return
+        dropped = self._acc_fast < (1.0 - cfg["drop"]) * self._acc_base
+        if dropped:
+            self._acc_streak += 1
+            self._acc_clear = 0
+        else:
+            self._acc_streak = 0
+        if dropped and self._acc_streak >= cfg["sustain"] \
+                and self.drift_level == "ok":
+            self.drift_level = "warning"
+            rec = {"segment": self.segment_no, "level": "warning",
+                   "prev": "ok", "fast": round(self._acc_fast, 4),
+                   "baseline": round(self._acc_base, 4),
+                   "streak": self._acc_streak}
+            self.drift_log.append(rec)
+            _metrics.counter("slo.accept_drift_alerts").inc()
+            _flight.record("accept_drift", **rec)
+        elif not dropped and self.drift_level == "warning":
+            self._acc_clear += 1
+            if self._acc_clear >= self.clear_after:
+                self.drift_level = "ok"
+                rec = {"segment": self.segment_no, "level": "ok",
+                       "prev": "warning",
+                       "fast": round(self._acc_fast, 4),
+                       "baseline": round(self._acc_base, 4)}
+                self.drift_log.append(rec)
+                _flight.record("accept_drift", **rec)
+                self._acc_clear = 0
 
     # --- per-segment evaluation ------------------------------------------
     def _target_level(self, cs: _ClassState) -> str:
@@ -266,6 +345,12 @@ class SLOMonitor:
                     "burn_slow": round(cs.burn_slow, 3),
                 } for p, cs in sorted(self._classes.items())},
             "alerts": list(self.alert_log),
+            "accept_drift": (None if self.accept_drift is None else {
+                "level": self.drift_level,
+                "fast": self._acc_fast, "baseline": self._acc_base,
+                "segments_seen": self._acc_n,
+                "config": dict(self.accept_drift),
+                "alerts": list(self.drift_log)}),
         }
 
     def reset(self) -> None:
@@ -274,6 +359,7 @@ class SLOMonitor:
         self.alert_log = []
         self._classes = {p: _ClassState(o, self.slow_window)
                          for p, o in self.objectives.items()}
+        self._reset_drift()
 
 
 # ---------------------------------------------------------------------------

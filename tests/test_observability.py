@@ -211,6 +211,39 @@ class TestSnapshotMerge:
         assert 'lat_bucket{le="+Inf"} 2' in text
         assert "lat_count 2" in text
 
+    def test_prometheus_conformance_parity(self):
+        """r17 conformance (ISSUE 12 satellite): bracket-tagged series
+        (``[class<p>]`` / ``[req<rid>]`` / free-form tags) render as
+        proper LABELS with escaped values, one # TYPE line per family,
+        and cumulative ``_bucket`` counts terminated by +Inf — pinned
+        against a hand-written exposition sample so a drift from the
+        scrape format (what real collectors parse) fails loudly."""
+        reg = metrics.Registry()
+        reg.gauge("slo.burn_rate[class0]").set(1.5)
+        reg.gauge("slo.burn_rate[class1]").set(0.5)
+        reg.counter("slo.alerts[warning]").inc(2)
+        reg.gauge('odd.tag[a"b\\c]').set(1)
+        h = reg.histogram("request.ttft[class0]", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        expected = "\n".join([
+            '# TYPE odd_tag gauge',
+            'odd_tag{tag="a\\"b\\\\c"} 1',
+            '# TYPE request_ttft histogram',
+            'request_ttft_bucket{class="0",le="0.1"} 1',
+            'request_ttft_bucket{class="0",le="1"} 2',
+            'request_ttft_bucket{class="0",le="+Inf"} 3',
+            'request_ttft_sum{class="0"} 5.55',
+            'request_ttft_count{class="0"} 3',
+            '# TYPE slo_alerts counter',
+            'slo_alerts_total{tag="warning"} 2',
+            '# TYPE slo_burn_rate gauge',
+            'slo_burn_rate{class="0"} 1.5',
+            'slo_burn_rate{class="1"} 0.5',
+        ]) + "\n"
+        assert reg.render_prometheus() == expected
+
     def test_reset_keeps_handles_registered(self):
         c = metrics.counter("keep.me")
         metrics.reset()

@@ -141,16 +141,18 @@ class TestPriorityPreemption:
         the preempted one — matches its dense reference stream."""
         cfg, params = tiny
         rng = np.random.RandomState(23)
-        # lows: 8-token prompts, 32 generations — prompt + full stream
-        # (40) always fits the 64 bucket, so the victim is preemptible
+        # lows: 8-token prompts, 24 generations — prompt + full stream
+        # (32) always fits the 64 bucket, so the victim is preemptible
         # whenever the high arrival lands; the high arrives one ms in,
         # i.e. during the first (multi-ms) segment, while both slots
-        # are pinned by class-1 work (r16 suite-time: 48 -> 32 gens —
-        # the preempt still lands mid-stream at seg_steps=16, a third
-        # less decode + dense-reference work)
+        # are pinned by class-1 work (suite-time: r16 cut 48 -> 32
+        # gens; r17 cuts 4 lows -> 3 and 32 -> 24 gens — two lows
+        # still pin both slots with one queued, the preempt still
+        # lands mid-stream at seg_steps=16, and the dense-reference
+        # bill drops by another ~40%)
         arr = ([Arrival(0.0, rng.randint(0, cfg.vocab_size, (8,))
-                        .astype(np.int32), 32, priority=1)
-                for _ in range(4)]
+                        .astype(np.int32), 24, priority=1)
+                for _ in range(3)]
                + [Arrival(0.001, rng.randint(0, cfg.vocab_size, (8,))
                           .astype(np.int32), 4, priority=0)])
         eng = _mk_engine(cfg, params, prompt_buckets=(8, 16, 64))
@@ -159,7 +161,7 @@ class TestPriorityPreemption:
                            prefix_cache=pc)
         rep = sch.serve(arr)
         out = sch.results()
-        assert rep.n_requests == 5
+        assert rep.n_requests == 4
         assert rep.preemptions >= 1
         preempted = [r for r in sch._reqs.values() if r.preemptions]
         assert preempted and preempted[0].prefix_hit_len > 0, \
