@@ -63,6 +63,14 @@ Modes (r7 — VERDICT r5 items 3 and 9):
                      replays bit-exactly; shadow-attachment overhead
                      gated <= 2%; a seeded canary split gets a
                      journaled verdict + auto-hold demo.
+* ``--capacity``     capacity & memory observability (r18, ISSUE 13): a
+                     metered saturated probe (pool timeline, COW/
+                     breakdown, fair-share stream identity), the §3f×§3g
+                     capacity planner validated ±10% against a second
+                     measured serve plus 1x/4x what-if answers, the 4x
+                     tight-pool overload where the capacity page fires
+                     before the first pages-backpressure deferral, and
+                     one /capacity (+?audit=1) scrape.
 * ``--smoke``        tiny-config in-process invariant check (tier-1 CPU
                      suite hook; see ``smoke()``).
 
@@ -1440,6 +1448,211 @@ def run_slo(model_name, cfg, params, llama, n=32, seed=0, slots=4,
 
 
 # ---------------------------------------------------------------------------
+# capacity & memory observability (r18, ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def _cap_engine(cfg, params, slots, num_pages=None):
+    from paddle_tpu.inference.serving import ServingEngine
+
+    return ServingEngine(cfg, params, slots=slots, max_len=256,
+                         prompt_buckets=(32, 64, 128), paged=True,
+                         page_size=16, num_pages=num_pages)
+
+
+def run_capacity(model_name, cfg, params, llama, n=32, seed=0, slots=4,
+                 seg_steps=16):
+    """The capacity-observability evidence (ISSUE 13 acceptance):
+
+    * **metered serve**: a saturated probe with the full capacity plane
+      attached (PoolMonitor on POOL_HOOKS + CapacityMonitor fed by the
+      scheduler) — pool occupancy timeline, free/live/reclaimable
+      breakdown, COW ratio, and the per-request meter whose fair-share
+      stream identity (Σ streams == segment steps) is asserted in-lane;
+    * **planner check**: ``capacity_plan`` fed the PROBE serve's
+      measured characteristics predicts a SECOND measured serve's pool
+      high-water and tok/s within ±10% (§3f pages-free arithmetic ×
+      §3g replica scaling, cross-serve so the arithmetic is validated,
+      not echoed), plus the what-if answers for the 1x and 4x Poisson
+      traces (pool pages + replicas — the item-4 autoscaler's surface);
+    * **alert leads the valve**: the r13-shape 4x Poisson overload on a
+      TIGHT pool (exactly worst-case-live pages, nothing spare) — the
+      capacity page fires BEFORE the first pages-backpressure deferral
+      (flight-seq ordered), with the declared-fraction
+      ``pool_high_water`` event on the way up;
+    * one literal ``/capacity`` scrape (+ the ``?audit=1`` leak view).
+    """
+    import urllib.request
+
+    import jax
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.scheduler import (Arrival, OnlineScheduler,
+                                                poisson_arrivals)
+
+    ledger = obs.serving_ledger(cfg, params, batch=slots, avg_pos=80.0,
+                                program="paged_serving_segment")
+
+    # --- saturated probe + validation pair (deterministic geometry) ----
+    # n == slots and arrival at t=0 ⇒ concurrency == slots exactly and
+    # zero reservation overlap — the pool-high-water prediction is pure
+    # §3f arithmetic. gen 64 stretches the serve past the host-jitter
+    # floor, and each side takes the MEDIAN of 3 measured passes (the
+    # repo's interleaved-min method, median because the planner must
+    # predict a typical serve, not the luckiest one).
+    rng = np.random.RandomState(seed + 2)
+    sat = [Arrival(0.0, rng.randint(0, cfg.vocab_size, (64,))
+                   .astype(np.int32), 64) for _ in range(slots)]
+
+    def monitored_serve(trace):
+        _telemetry_section(reset=True)
+        eng = _cap_engine(cfg, params, slots)
+        cap = obs.CapacityMonitor(ledger=ledger)
+        pool = obs.PoolMonitor(eng.pager).attach()
+        sch = OnlineScheduler(eng, max_queue=10 ** 6, seg_steps=seg_steps,
+                              capacity_monitor=cap)
+        rep = sch.serve(trace, warm=True)
+        sch.results()
+        pool.detach()
+        return eng, cap, pool, rep
+
+    def median_serve(trace, k=3):
+        runs = [monitored_serve(trace) for _ in range(k)]
+        runs.sort(key=lambda r: r[3].throughput_tok_s)
+        return runs[k // 2]
+
+    eng_a, cap_a, pool_a, rep_a = median_serve(sat)
+    measured_a = {"per_tick_s": rep_a.makespan_s / rep_a.ticks,
+                  "slot_occupancy": rep_a.slot_occupancy}
+    streams = sum(r["streams"] for r in rep_a.per_request)
+    streams_identity = abs(streams - rep_a.ticks) < 1e-6
+    log(f"probe: {rep_a.total_tokens} tokens, {rep_a.ticks} ticks, "
+        f"occupancy {rep_a.slot_occupancy:.3f}, meter streams {streams} "
+        f"(identity {'OK' if streams_identity else 'MISS'}), high-water "
+        f"{pool_a.high_water_pages} pages")
+
+    plan = obs.capacity_plan(
+        {"mean_prompt_tokens": 64, "mean_new_tokens": 64,
+         "rate_req_s": None},
+        ledger, page_size=16, slots=slots, measured=measured_a)
+    eng_b, cap_b, pool_b, rep_b = median_serve(sat)
+    hw_ratio = plan["predicted_high_water_pages"] / pool_b.high_water_pages
+    tok_ratio = plan["predicted_tok_s"] / rep_b.throughput_tok_s
+    hw_ok = abs(hw_ratio - 1.0) <= 0.10
+    tok_ok = abs(tok_ratio - 1.0) <= 0.10
+    log(f"planner: high-water {plan['predicted_high_water_pages']} vs "
+        f"measured {pool_b.high_water_pages} (ratio {hw_ratio:.3f} -> "
+        f"{'OK' if hw_ok else 'MISS'}), tok/s {plan['predicted_tok_s']} "
+        f"vs {rep_b.throughput_tok_s:.1f} (ratio {tok_ratio:.3f} -> "
+        f"{'OK' if tok_ok else 'MISS'})")
+
+    # what-if surface: the 1x / 4x Poisson traces' pool + replica answer
+    svc_req_s = rep_a.n_requests / rep_a.makespan_s
+    whatif = {
+        str(r): obs.capacity_plan(
+            {"mean_prompt_tokens": float(np.mean(_ONLINE_PLENS)),
+             "mean_new_tokens": float(np.mean(_ONLINE_GLENS)),
+             "rate_req_s": r * svc_req_s,
+             "mean_service_s": float(np.mean(
+                 [q["e2e_s"] for q in rep_a.per_request]))},
+            ledger, page_size=16, slots=slots, measured=measured_a,
+            headroom=0.1)
+        for r in (1.0, 4.0)}
+
+    # --- 4x overload on a TIGHT pool: the page leads the valve ----------
+    max_span = -(-(max(_ONLINE_PLENS) + max(_ONLINE_GLENS) - 1) // 16)
+    tight_pages = slots * max_span + 1        # worst-case live, no spare
+    _telemetry_section(reset=True)
+    obs.flight.clear()
+    eng_o = _cap_engine(cfg, params, slots, num_pages=tight_pages)
+    cap_o = obs.CapacityMonitor()
+    pool_o = obs.PoolMonitor(eng_o.pager, high_water_frac=0.8).attach()
+    arr4 = poisson_arrivals(seed + 1, n, 4.0 * svc_req_s, cfg.vocab_size,
+                            _ONLINE_PLENS, _ONLINE_GLENS)
+    sch_o = OnlineScheduler(eng_o, max_queue=10 ** 6, seg_steps=seg_steps,
+                            capacity_monitor=cap_o)
+    rep_o = sch_o.serve(arr4)
+    sch_o.results()
+    pool_o.detach()
+    evs = obs.flight.events()
+    page_seqs = [e["seq"] for e in evs if e["kind"] == "capacity_alert"
+                 and e["level"] == "page"]
+    defer_seqs = [e["seq"] for e in evs if e["kind"] == "backpressure"
+                  and e.get("reason") == "pages"]
+    hw_events = [e for e in evs if e["kind"] == "pool_high_water"]
+    page_fired = bool(page_seqs)
+    page_leads = bool(page_seqs and (not defer_seqs
+                                     or page_seqs[0] < defer_seqs[0]))
+    log(f"4x tight-pool: {rep_o.backpressure_pages} pages-backpressure "
+        f"events, page fired {page_fired}, page before first deferral "
+        f"{page_leads} (page seq {page_seqs[:1]} vs defer seq "
+        f"{defer_seqs[:1]}), pool_high_water events {len(hw_events)}")
+
+    # --- one literal operator scrape ------------------------------------
+    with obs.OpsServer(port=0, capacity_monitor=cap_o,
+                       pool_monitor=pool_o) as srv:
+        with urllib.request.urlopen(srv.url + "/capacity",
+                                    timeout=10) as r:
+            cap_scrape = json.loads(r.read())
+        with urllib.request.urlopen(srv.url + "/capacity?audit=1",
+                                    timeout=10) as r:
+            audit_scrape = json.loads(r.read())
+    log(f"ops scrape: /capacity level "
+        f"{cap_scrape['monitor']['level']}, audit_clean "
+        f"{audit_scrape['audit_clean']}")
+
+    def _sec(rep):
+        d = rep.as_dict()
+        return {k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in d.items() if k not in ("prefix", "pages")}
+
+    return {
+        "metric": "serving_capacity",
+        "model": model_name,
+        "platform": jax.default_backend(),
+        "seed": seed,
+        "n_requests": n,
+        "probe": {
+            "report": _sec(rep_a),
+            "pool": pool_a.snapshot(),
+            "meter_streams_sum": round(streams, 4),
+            "meter_streams_identity": streams_identity,
+        },
+        "planner": {
+            "plan": plan,
+            "measured_high_water_pages": pool_b.high_water_pages,
+            "measured_tok_s": round(rep_b.throughput_tok_s, 2),
+            "high_water_ratio": round(hw_ratio, 4),
+            "tok_s_ratio": round(tok_ratio, 4),
+            "high_water_within_10pct": hw_ok,
+            "tok_s_within_10pct": tok_ok,
+            "whatif": whatif,
+        },
+        "overload_4x": {
+            "tight_pool_pages": tight_pages - 1,
+            "report": _sec(rep_o),
+            "pool": pool_o.snapshot(),
+            "page_fired": page_fired,
+            "page_before_first_backpressure": page_leads,
+            "first_page_seq": page_seqs[0] if page_seqs else None,
+            "first_backpressure_seq": (defer_seqs[0] if defer_seqs
+                                       else None),
+            "alert_timeline": rep_o.capacity["alerts"],
+            "pool_high_water_events": len(hw_events),
+        },
+        "ops_scrape": {
+            "capacity_level": cap_scrape["monitor"]["level"],
+            "audit_clean": audit_scrape["audit_clean"],
+            "pool_breakdown": {
+                k: cap_scrape["pool"][k]
+                for k in ("pages_free", "pages_used", "live_pages",
+                          "reclaimable_pages", "high_water_pages",
+                          "cow_ratio")},
+        },
+        "telemetry": _telemetry_section(),
+    }
+
+
+# ---------------------------------------------------------------------------
 # speculative decoding: multi-token verified ticks (r15, ISSUE 10)
 # ---------------------------------------------------------------------------
 
@@ -1903,6 +2116,7 @@ def main():
     ap.add_argument("--slo", action="store_true")
     ap.add_argument("--spec", action="store_true")
     ap.add_argument("--shadow", action="store_true")
+    ap.add_argument("--capacity", action="store_true")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--model", default="auto",
                     choices=("auto", "base", "small", "tiny"))
@@ -1942,6 +2156,9 @@ def main():
     elif args.shadow:
         print(json.dumps(run_shadow(model_name, cfg, params, llama,
                                     n=min(args.n, 16))))
+    elif args.capacity:
+        print(json.dumps(run_capacity(model_name, cfg, params, llama,
+                                      n=args.n)))
     elif args.failover:
         print(json.dumps(run_failover(model_name, cfg, params, llama)))
     elif args.fleet:

@@ -144,6 +144,12 @@ def main() -> int:
         "spec": _run_json("llama_serving.py", args=("--spec",)),
         # r17 (ISSUE 12): shadow & canary quality observability
         "quality": _run_json("llama_serving.py", args=("--shadow",)),
+        # r18 (ISSUE 13): capacity & memory observability — pool
+        # timeline + breakdown, the capacity page firing before the
+        # first pages-backpressure deferral on the tight-pool 4x
+        # overload, the §3f×§3g planner validated ±10% cross-serve,
+        # and the /capacity (+audit) scrape
+        "capacity": _run_json("llama_serving.py", args=("--capacity",)),
     }
     result["platform"] = result["online"].get("platform", "unknown")
     # r10: lift each mode's runtime-telemetry headline (queue depth,
@@ -153,7 +159,7 @@ def main() -> int:
     result["telemetry_headlines"] = {
         k: (result[k].get("telemetry") or {}).get("headline")
         for k in ("online", "prefix", "paged", "fleet", "overload",
-                  "failover", "slo", "spec", "quality")}
+                  "failover", "slo", "spec", "quality", "capacity")}
     # r15: lift the speculative headline — the roofline-beating ratio
     # an operator (or the next round's reviewer) checks first
     spec = result["spec"].get("headline") or {}
@@ -201,6 +207,24 @@ def main() -> int:
         "failover_journey_replicas": (jf.get("failover_journey")
                                       or {}).get("replicas"),
     }
+    # r18 (ISSUE 13): lift the capacity headline — the alert-leads-
+    # valve ordering, the planner's ±10% cross-serve validation and
+    # the meter identity a reviewer checks first
+    capd = result["capacity"]
+    result["capacity_headline"] = {
+        "page_fired_at_4x": (capd.get("overload_4x") or {}).get(
+            "page_fired"),
+        "page_before_first_backpressure": (
+            capd.get("overload_4x") or {}).get(
+            "page_before_first_backpressure"),
+        "planner_high_water_within_10pct": (
+            capd.get("planner") or {}).get("high_water_within_10pct"),
+        "planner_tok_s_within_10pct": (capd.get("planner") or {}).get(
+            "tok_s_within_10pct"),
+        "meter_streams_identity": (capd.get("probe") or {}).get(
+            "meter_streams_identity"),
+        "audit_clean": (capd.get("ops_scrape") or {}).get("audit_clean"),
+    }
     path = os.path.join(ROOT, f"SERVING_r{rnd:02d}.json")
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
@@ -208,7 +232,7 @@ def main() -> int:
     ok = all(result[k].get("rc") == 0
              for k in ("decode", "serving", "online", "prefix", "paged",
                        "fleet", "overload", "failover", "slo", "spec",
-                       "quality"))
+                       "quality", "capacity"))
     return 0 if ok else 1
 
 

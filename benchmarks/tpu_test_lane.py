@@ -82,6 +82,12 @@ TPU_TEST_FILES = [
     # first-divergence positions, canary verdicts + auto-hold, and the
     # shadowed-fleet-loop sync audit
     "tests/test_quality.py",
+    # r18 (ISSUE 13): capacity & memory observability — the page-level
+    # metering identities, exhaustion-alert-leads-backpressure ordering
+    # on a tight pool, the §3f×§3g planner validation, the /capacity
+    # (+audit) endpoint and the monitored-serve sync audit, all against
+    # the real backend's paged allocator traffic
+    "tests/test_capacity.py",
 ]
 
 

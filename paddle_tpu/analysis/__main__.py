@@ -27,6 +27,14 @@ Usage::
                                                   # all 9 canonical programs,
                                                   # budgets bit-identical to
                                                   # --quality off
+    python -m paddle_tpu.analysis --gate --capacity on # (default) the r18
+                                                  # contract: the capacity
+                                                  # plane ATTACHED (a
+                                                  # CapacityMonitor on
+                                                  # POOL_HOOKS +
+                                                  # SEGMENT_HOOKS), budgets
+                                                  # bit-identical to
+                                                  # --capacity off
     python -m paddle_tpu.analysis --gate --journal on  # (default) the r16
                                                   # contract: the
                                                   # deterministic serving
@@ -119,6 +127,12 @@ def main(argv=None) -> int:
                          "shadow-diff QualityMonitor fed by every engine "
                          "segment (serving.SEGMENT_HOOKS) — budgets must "
                          "be bit-identical to --quality off")
+    ap.add_argument("--capacity", choices=("on", "off"), default="on",
+                    help="audit with the r18 capacity plane attached: a "
+                         "CapacityMonitor fed by every allocator event "
+                         "(paged_kv.POOL_HOOKS) and every engine segment "
+                         "(serving.SEGMENT_HOOKS) — budgets must be "
+                         "bit-identical to --capacity off")
     ap.add_argument("--journal", choices=("on", "off"), default="on",
                     help="audit with the r16 deterministic serving "
                          "journal attached (flight superset + decision-"
@@ -146,6 +160,11 @@ def main(argv=None) -> int:
         qmon = observability.QualityMonitor()
         observability.quality.install(qmon)
         print("quality monitor attached on SEGMENT_HOOKS")
+    cmon = None
+    if args.capacity == "on":
+        cmon = observability.CapacityMonitor()
+        observability.capacity.install(cmon)
+        print("capacity monitor attached on POOL_HOOKS + SEGMENT_HOOKS")
     targets = args.program or programs.names()
     results = []
     any_violation = False
@@ -168,6 +187,11 @@ def main(argv=None) -> int:
             print("  budget: OK")
         print()
 
+    if cmon is not None:
+        observability.capacity.uninstall(cmon)
+        print(f"capacity monitor detached: saw {cmon.segment_no} "
+              f"segments, {cmon.pool_events} pool events, "
+              f"{cmon.pages_admitted_total} pages admitted")
     if qmon is not None:
         observability.quality.uninstall(qmon)
         print(f"quality monitor detached: saw {qmon.segments} segments")

@@ -565,9 +565,23 @@ class FleetRouter:
                 # per-replica load/health/page state the router compared
                 # — the "why replica 2" answer a postmortem needs
                 # (snapshotted BEFORE intake mutates the queues)
+                # r18 (ISSUE 13): the ranking gains the page-capacity
+                # numbers it was implicitly comparing — pages_free /
+                # reclaimable per candidate, so the item-4 autoscaler
+                # reads its scale-up signal straight off the dispatch
+                # record (and /healthz mirrors the same pair live)
                 cands = [{"idx": x.idx, "health": x.health,
                           "queue": x.queue_depth, "live": x.live,
-                          "page_ready": self._page_ready(x, a)}
+                          "page_ready": self._page_ready(x, a),
+                          "pages_free": (x.engine.pager.pages_free
+                                         if x.engine.paged else None),
+                          "reclaimable": (
+                              x.prefix_cache.reclaimable_pages()
+                              if x.engine.paged
+                              and x.prefix_cache is not None
+                              and hasattr(x.prefix_cache,
+                                          "reclaimable_pages") else
+                              (0 if x.engine.paged else None))}
                          for x in self._replicas]
                 if reason is None:          # refusal: no rid assigned
                     _j.record("dispatch", rid=None, replica=rep.idx,

@@ -48,7 +48,23 @@ import numpy as np
 from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 
-__all__ = ["PageAllocator", "PagedKVCache"]
+__all__ = ["PageAllocator", "PagedKVCache", "POOL_HOOKS"]
+
+# Process-wide pool observers (r18, ISSUE 13): ``fn(event, n,
+# allocator)`` called after every allocator state change ("alloc" /
+# "retain" / "release" from PageAllocator; "cache_retain" /
+# "cache_release" forwarded by PagedPrefixCache) — host ints + the
+# allocator object only, so a hook can never add a device sync.
+# ``observability.capacity.PoolMonitor`` subscribes here (filtering by
+# allocator identity — fleet isolation holds for observers too). Empty
+# by default: the common case costs one truthiness check per event.
+POOL_HOOKS: List = []
+
+
+def _notify(event: str, n: int, alloc) -> None:
+    if POOL_HOOKS:
+        for fn in POOL_HOOKS:
+            fn(event, n, alloc)
 
 
 class PageAllocator:
@@ -96,6 +112,7 @@ class PageAllocator:
         if n:
             self.total_allocated += n
             _metrics.counter("serving.pages.allocated").inc(n)
+            _notify("alloc", n, self)
         return pages
 
     def retain(self, pages: Sequence[int]) -> None:
@@ -107,6 +124,7 @@ class PageAllocator:
             self._ref[p] += 1
         if len(pages):
             _metrics.counter("serving.pages.cow_shares").inc(len(pages))
+            _notify("retain", len(pages), self)
 
     def release(self, pages: Sequence[int]) -> int:
         """Drop one reference per page; pages reaching refcount 0 return
@@ -122,6 +140,8 @@ class PageAllocator:
                 freed += 1
         if freed:
             _metrics.counter("serving.pages.freed").inc(freed)
+        if len(pages):
+            _notify("release", len(pages), self)
         return freed
 
     def check(self) -> List[str]:

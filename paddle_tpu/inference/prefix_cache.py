@@ -42,6 +42,7 @@ import numpy as np
 
 from ..observability import flight as _flight
 from ..observability import metrics as _metrics
+from .paged_kv import _notify as _pool_notify
 
 __all__ = ["PrefixCache", "PrefixMatch", "PagedPrefixCache",
            "PagedPrefixMatch", "make_prefix_cache"]
@@ -331,6 +332,7 @@ class PagedPrefixCache:
         for key in stale:
             self._evict(key)
         self.pager.allocator.retain(pages)
+        _pool_notify("cache_retain", len(pages), self.pager.allocator)
         self._entries[tokens.tobytes()] = _PagedEntry(tokens, list(pages))
         self._pages_held += len(pages)
         while self._pages_held > self.capacity_pages and \
@@ -342,6 +344,7 @@ class PagedPrefixCache:
     def _evict(self, key: bytes, count: bool = False) -> None:
         ent = self._entries.pop(key)
         self.pager.release_pages(ent.pages)
+        _pool_notify("cache_release", len(ent.pages), self.pager.allocator)
         self._pages_held -= len(ent.pages)
         if count:
             self.evictions += 1
@@ -375,6 +378,18 @@ class PagedPrefixCache:
     @property
     def pages_held(self) -> int:
         return self._pages_held
+
+    def reclaimable_pages(self) -> int:
+        """Pages eviction would actually return to the free list RIGHT
+        NOW: cache-held pages not also referenced by a live slot (a
+        shared page only frees when its last reference dies, so the
+        slot-shared subset is pinned regardless of what the cache
+        does). The r18 capacity plane's 'free + reclaimable'
+        availability term — host set arithmetic over the pager's
+        mirrors."""
+        held = {p for ent in self._entries.values() for p in ent.pages}
+        live = {p for pages in self.pager.slot_pages for p in pages}
+        return len(held - live)
 
     def stats(self) -> dict:
         return {"hits": self.hits, "misses": self.misses,

@@ -57,6 +57,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..observability import capacity as _capacity
 from ..observability import flight as _flight
 from ..observability import journal as _journal
 from ..observability import metrics as _metrics
@@ -177,6 +178,13 @@ class OnlineReport:
     cold_start_s: Optional[float] = None
     slo: Optional[dict] = None
     perf: Optional[dict] = None
+    # r18 (ISSUE 13): the capacity monitor's exhaustion-alert state and
+    # the per-priority-class resource-attribution aggregate (page-
+    # seconds, weight streams, ledger-joined HBM bytes/FLOPs) — the
+    # meter section is always present on paged serves (the stamps are
+    # free host arithmetic); capacity needs the monitor attached
+    capacity: Optional[dict] = None
+    meter: Optional[dict] = None
     per_request: List[dict] = field(default_factory=list)
 
     def as_dict(self, with_requests: bool = False) -> dict:
@@ -202,7 +210,8 @@ class OnlineScheduler:
     def __init__(self, engine: ServingEngine, max_queue: int = 64,
                  seg_steps: int = 32,
                  prefix_cache: Optional[PrefixCache] = None,
-                 slo_monitor=None, perf_monitor=None):
+                 slo_monitor=None, perf_monitor=None,
+                 capacity_monitor=None):
         self.engine = engine
         self.max_queue = int(max_queue)
         self.seg_steps = int(seg_steps)
@@ -213,6 +222,12 @@ class OnlineScheduler:
         # (tests/test_slo_monitor.py pins bit-identical sync audits).
         self.slo_monitor = slo_monitor
         self.perf_monitor = perf_monitor
+        # r18 (ISSUE 13): predictive exhaustion alerting. The monitor
+        # is evaluated BEFORE each paged dispatch (begin_segment) so a
+        # capacity page can LEAD the first pages-backpressure deferral,
+        # and fed after each fetch with the segment's fresh-page
+        # admissions — host mirrors only, same zero-sync contract.
+        self.capacity_monitor = capacity_monitor
         self.backpressure_events = 0
         self._reqs: Dict[int, Request] = {}
         # r13: drain-rate bookkeeping for the retry_after_s backpressure
@@ -346,6 +361,17 @@ class OnlineScheduler:
                     if gap > 0:
                         _journal.sleep(min(gap, 0.05))
                 continue
+            cap = self.capacity_monitor
+            if cap is not None and eng.paged:
+                # r18: evaluate time-to-exhaustion BEFORE the dispatch
+                # that could hit pages-backpressure — the alert must
+                # lead the valve (ISSUE 13 acceptance bar)
+                cap.begin_segment(
+                    eng.pager.pages_free,
+                    (self.prefix_cache.reclaimable_pages()
+                     if self.prefix_cache is not None
+                     and hasattr(self.prefix_cache, "reclaimable_pages")
+                     else 0))
             t_seg = _hooks.now_ns()
             t_seg_pc = _journal.now()
             ev = eng.run_segment(self.seg_steps,
@@ -412,6 +438,12 @@ class OnlineScheduler:
                 self.perf_monitor.note_segment(
                     ev["steps"], ev.get("tokens", 0),
                     elapsed_s=t_sync - t_seg_pc)
+            if cap is not None and eng.paged:
+                cap.note_admission(
+                    sum(self._reqs[rid].pages_fresh
+                        for rid in ev["admitted"]),
+                    admitted=len(ev["admitted"]))
+                cap.close_segment()
             # r15: per-tick wall EWMA (host arithmetic on already-taken
             # stamps) — the acceptance-aware service estimates' clock
             dt = (t_sync - t_seg_pc) / max(ev["steps"], 1)
@@ -457,6 +489,14 @@ class OnlineScheduler:
                  if self.slo_monitor is not None else None),
             perf=(self.perf_monitor.end_interval()
                   if self.perf_monitor is not None else None),
+            capacity=(self.capacity_monitor.report()
+                      if self.capacity_monitor is not None else None),
+            meter=(_capacity.aggregate_meters(
+                reqs,
+                ledger=(self.capacity_monitor.ledger
+                        if self.capacity_monitor is not None else None),
+                page_size=eng.page_size if eng.paged else None)
+                if eng.paged else None),
             **self._report_extras(reqs),
             per_request=[{
                 "rid": r.rid,
@@ -467,6 +507,11 @@ class OnlineScheduler:
                 "preemptions": r.preemptions,
                 "ttft_s": round(r.first_token_time - r.arrival_time, 4),
                 "e2e_s": round(r.finish_time - r.arrival_time, 4),
+                # r18 meter: the request's own resource bill
+                "pages": r.pages_reserved,
+                "page_seconds": round(r.page_seconds, 4),
+                "ticks": r.meter_ticks,
+                "streams": round(r.meter_streams, 4),
             } for r in reqs],
         )
 
@@ -480,6 +525,8 @@ class OnlineScheduler:
             self.slo_monitor.reset()
         if self.perf_monitor is not None:
             self.perf_monitor.end_interval()
+        if self.capacity_monitor is not None:
+            self.capacity_monitor.reset()
 
     # --- SLO hooks (no-ops here; SLOScheduler overrides) -----------------
     def _pre_segment(self, now: float, t0: float) -> None:
@@ -510,7 +557,8 @@ class OnlineScheduler:
             "prefix_cache": _journal.describe_prefix_cache(
                 self.prefix_cache),
             "monitors": {"slo": self.slo_monitor is not None,
-                         "perf": self.perf_monitor is not None},
+                         "perf": self.perf_monitor is not None,
+                         "capacity": self.capacity_monitor is not None},
             "telemetry_enabled": _metrics.enabled(),
             "trace": _journal.describe_arrivals(arrivals),
         }
@@ -559,11 +607,13 @@ class SLOScheduler(OnlineScheduler):
                  seg_steps: int = 32,
                  prefix_cache: Optional[PrefixCache] = None,
                  preempt: bool = True, shed_deadlines: bool = True,
-                 slo_monitor=None, perf_monitor=None):
+                 slo_monitor=None, perf_monitor=None,
+                 capacity_monitor=None):
         super().__init__(engine, max_queue=max_queue, seg_steps=seg_steps,
                          prefix_cache=prefix_cache,
                          slo_monitor=slo_monitor,
-                         perf_monitor=perf_monitor)
+                         perf_monitor=perf_monitor,
+                         capacity_monitor=capacity_monitor)
         self.preempt = bool(preempt)
         self.shed_deadlines = bool(shed_deadlines)
         self.preemptions = 0

@@ -218,6 +218,40 @@ class Request:
     # engine runs with quality_digest=True. The shadow-diff monitor
     # compares these across a primary/shadow pair.
     digests: Optional[List[tuple]] = None
+    # r18 capacity meter (ISSUE 13): host-stamped resource attribution,
+    # always on (a perf_counter read + int arithmetic per event — the
+    # stamps are telemetry, never decision inputs, so they stay off the
+    # journal clock). pages_reserved = span of the latest reservation
+    # (persists after release — the request's §3f page footprint);
+    # pages_fresh = the non-shared subset (what admission drew from the
+    # free list); page_seconds accumulates held-pages x wall at every
+    # release point (retire / requeue / preempt / abort), across
+    # resume cycles. meter_ticks counts the weight streams the request
+    # was live for (admit prefill + decode/verify ticks);
+    # meter_streams its FAIR share (1/live per tick) — summed over a
+    # serve the shares tile the segment steps exactly, the identity
+    # tests/test_capacity.py pins. capacity.attribute_request joins
+    # these with the §3c ledger into bytes/FLOPs.
+    pages_reserved: int = 0
+    pages_fresh: int = 0
+    page_seconds: float = 0.0
+    meter_ticks: int = 0
+    meter_streams: float = 0.0
+    _pages_live: int = 0          # currently-held pages (meter internal)
+    _pages_t0: float = 0.0        # holding-interval open stamp
+
+    def _meter_reserve(self, pages: int, fresh: int) -> None:
+        self.pages_reserved = pages
+        self.pages_fresh = fresh
+        self._pages_live = pages
+        self._pages_t0 = time.perf_counter()
+
+    def _meter_release(self) -> None:
+        """Close the open page-holding interval (idempotent)."""
+        if self._pages_live:
+            self.page_seconds += self._pages_live * (
+                time.perf_counter() - self._pages_t0)
+            self._pages_live = 0
 
     @property
     def done(self) -> bool:
@@ -1098,6 +1132,10 @@ class ServingEngine:
                 t = int(toks[st, s, 0] if acc is not None
                         else toks[st, s])
                 r.tokens.append(t)
+                # r18 meter: the admit's prefill streamed the weight
+                # set once, solo (the admit branch runs alone)
+                r.meter_ticks += 1
+                r.meter_streams += 1.0
                 if dig is not None:
                     self._append_digest(r, dig, st, s)
                 new_tokens += 1
@@ -1121,9 +1159,14 @@ class ServingEngine:
                     # (fresh: max_new - 1; resumed: the true tail)
                     self._rem_host[s] = r.max_new_tokens - len(r.tokens)
             elif acc is None:              # decode tick
-                for s, r in enumerate(self._active):
-                    if r is None or self._rem_host[s] <= 0:
-                        continue
+                live_now = [(s, r) for s, r in enumerate(self._active)
+                            if r is not None and self._rem_host[s] > 0]
+                share = 1.0 / len(live_now) if live_now else 0.0
+                for s, r in live_now:
+                    # r18 meter: every live slot consumed this tick's
+                    # one weight stream; the share splits it fairly
+                    r.meter_ticks += 1
+                    r.meter_streams += share
                     t = int(toks[st, s])
                     r.tokens.append(t)
                     if dig is not None:
@@ -1142,11 +1185,17 @@ class ServingEngine:
                         if on_retire is not None:
                             on_retire(r, s)
             else:                          # spec VERIFY tick
+                live_now = [(s, r) for s, r in enumerate(self._active)
+                            if r is not None and self._rem_host[s] > 0]
+                share = 1.0 / len(live_now) if live_now else 0.0
                 any_live = False
-                for s, r in enumerate(self._active):
-                    if r is None or self._rem_host[s] <= 0:
-                        continue
+                for s, r in live_now:
                     any_live = True
+                    # r18 meter: a verify tick is still ONE weight
+                    # stream however many tokens it retires — the
+                    # spec-adjusted effective-ticks denominator
+                    r.meter_ticks += 1
+                    r.meter_streams += share
                     k_emit = int(acc[st, s])
                     if spec_stats is not None:
                         spec_stats["slot_ticks"] += 1
@@ -1326,6 +1375,7 @@ class ServingEngine:
         r.preemptions += 1
         fp, _ = r.resume_view()
         if self.paged:
+            r._meter_release()
             pgr = self.pager
             if prefix_cache is not None:
                 plen_b = prefix_cache.round_down(len(fp))
@@ -1364,7 +1414,11 @@ class ServingEngine:
                     self.pager.release_pages(pages)
             for r in p.picked:
                 r.admit_time = 0.0
+                r._meter_release()
             orphans += p.picked
+        for r in self._active:
+            if r is not None:
+                r._meter_release()
         orphans += [r for r in self._active if r is not None]
         orphans += self._queue
         self._queue = []
@@ -2269,6 +2323,7 @@ class ServingEngine:
             self._queue.pop(0)
             r.prefix_hit_len = hit_len
             r.admit_time = now
+            r._meter_reserve(len(pages), len(pages) - len(hit_pages))
             picked.append(r)
             fulls.append(fp)
             req_pages.append(pages)
@@ -2415,6 +2470,7 @@ class ServingEngine:
             pgr.install(s, req_pages[q])
 
         def on_retire(r, s):
+            r._meter_release()
             pending_frees.append(pgr.slot_pages[s])
             pgr.slot_pages[s] = []
 
@@ -2433,6 +2489,7 @@ class ServingEngine:
             # slot: release the reservations and requeue FCFS
             for j in range(qadm, n):
                 picked[j].admit_time = 0.0
+                picked[j]._meter_release()
                 pgr.release_pages(req_pages[j])
             self._queue[:0] = picked[qadm:]
 

@@ -73,8 +73,10 @@ no-op (the ≤2 % serving overhead gate compares against exactly that).
 
 from __future__ import annotations
 
-from . import (exporter, flight, journal, metrics, perf, quality, replay,
-               slo, tracing)
+from . import (capacity, exporter, flight, journal, metrics, perf,
+               quality, replay, slo, tracing)
+from .capacity import (CapacityMonitor, PoolMonitor, aggregate_meters,
+                       attribute_request, capacity_plan)
 from .exporter import OpsServer
 from .flight import FLIGHT, dump_on_exception
 from .journal import Journal, read_journal, request_journey
@@ -90,7 +92,9 @@ from .tracing import emit_journey_trace, emit_request_trace, span, step_span
 
 __all__ = [
     "metrics", "tracing", "flight", "slo", "perf", "exporter", "journal",
-    "replay", "quality", "QualityMonitor", "CanaryController",
+    "replay", "quality", "capacity", "QualityMonitor", "CanaryController",
+    "CapacityMonitor", "PoolMonitor", "capacity_plan",
+    "attribute_request", "aggregate_meters",
     "compare_pair", "counter",
     "gauge", "histogram", "percentile", "registry", "snapshot",
     "render_prometheus", "merge_snapshots", "merge_log_dir",

@@ -27,6 +27,13 @@ of the package enforces at the record path). Endpoints:
                    — plus the canary controller's verdicts when one is
                    attached.
 ``/perf``          The explained-performance ledger + interval report.
+``/capacity``      The r18 capacity plane (ISSUE 13): exhaustion-alert
+                   state (time-to-exhaustion, ok→warning→page),
+                   per-pool breakdown (free / live / cache-held with
+                   the reclaimable subset, COW ratio, high-water,
+                   occupancy timeline) and per-replica page capacity;
+                   ``?audit=1`` additionally runs the leak audit
+                   (``leak_report``) and reports ``audit_clean``.
 ``/journal``       Deterministic-journal tail (r16, ISSUE 11): the
                    lossless decision stream's newest records, filtered
                    by ``?n=`` / ``?kind=`` / ``?rid=`` — reads the
@@ -76,7 +83,8 @@ class OpsServer:
                  registry: Optional[_metrics.Registry] = None,
                  slo_monitor=None, perf_monitor=None, fleet=None,
                  log_dir: Optional[str] = None, recorder=None,
-                 journal=None, quality_monitor=None, canary=None):
+                 journal=None, quality_monitor=None, canary=None,
+                 capacity_monitor=None, pool_monitor=None):
         self.host = host
         self.port = int(port)
         self.registry = registry
@@ -91,6 +99,11 @@ class OpsServer:
         # the fallbacks (the live wiring an operator actually has)
         self.quality_monitor = quality_monitor
         self.canary = canary
+        # r18 (ISSUE 13): the capacity signal plane — exhaustion-alert
+        # monitor + per-pool breakdown, served at /capacity (with
+        # ?audit=1 wiring the leak audit into the scrape surface)
+        self.capacity_monitor = capacity_monitor
+        self.pool_monitor = pool_monitor
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -180,8 +193,29 @@ class OpsServer:
                                else "degraded" if healthy else "dead"),
                     "replicas": replicas,
                     "healthy": healthy, "total": len(replicas)}
+        if self.fleet is not None:
+            # r18 (ISSUE 13 satellite): per-replica page capacity next
+            # to health — the scrape-visible form of the pages-aware
+            # candidate ranking (r12) and the item-4 autoscaler's
+            # scale-up signal, read off the same host mirrors the
+            # router ranks on
+            pages = {}
+            for r in self.fleet._replicas:
+                if not r.engine.paged:
+                    continue
+                pc = r.prefix_cache
+                pages[str(r.idx)] = {
+                    "pages_free": r.engine.pager.pages_free,
+                    "reclaimable": (pc.reclaimable_pages()
+                                    if pc is not None and hasattr(
+                                        pc, "reclaimable_pages") else 0),
+                }
+            if pages:
+                body["pages"] = pages
         if self.slo_monitor is not None:
             body["slo_level"] = self.slo_monitor.worst_level()
+        if self.capacity_monitor is not None:
+            body["capacity_level"] = self.capacity_monitor.level
         code = 503 if body["status"] == "dead" else 200
         return code, body
 
@@ -243,6 +277,49 @@ class OpsServer:
             out["canary"] = can.report()
         return out
 
+    def payload_capacity(self, audit: bool = False) -> dict:
+        """The r18 capacity view: monitor alert state + per-pool
+        breakdown (attached ``PoolMonitor``, or the fleet's paged
+        replicas), with ``audit=True`` additionally running the
+        operational leak audit (``FleetRouter.leak_report`` /
+        ``PagedKVCache.leak_report``) — the programmatic-only audit
+        made scrape-visible (ISSUE 13 satellite). All host data."""
+        mon = self.capacity_monitor
+        pm = self.pool_monitor
+        if mon is None and pm is None and self.fleet is None:
+            return {"enabled": False}
+        out = {"enabled": True}
+        if mon is not None:
+            out["monitor"] = mon.report()
+        if pm is not None:
+            out["pool"] = pm.snapshot()
+        if self.fleet is not None:
+            reps = {}
+            for r in self.fleet._replicas:
+                if not r.engine.paged:
+                    continue
+                pc = r.prefix_cache
+                reps[str(r.idx)] = {
+                    "health": r.health,
+                    **r.engine.pager.stats(),
+                    "reclaimable": (pc.reclaimable_pages()
+                                    if pc is not None and hasattr(
+                                        pc, "reclaimable_pages") else 0),
+                }
+            if reps:
+                out["replicas"] = reps
+        if audit:
+            if self.fleet is not None:
+                out["audit"] = self.fleet.leak_report()
+            elif pm is not None:
+                held = (pm.prefix_cache.pages_held
+                        if pm.prefix_cache is not None else 0)
+                out["audit"] = pm.pager.leak_report(expected_held=held)
+            else:
+                out["audit"] = []
+            out["audit_clean"] = not out["audit"]
+        return out
+
     def payload_slo(self) -> dict:
         if self.slo_monitor is None:
             return {"enabled": False}
@@ -295,6 +372,9 @@ def _make_handler(srv: OpsServer):
                         rid=int(rid) if rid is not None else None))
                 elif u.path == "/slo":
                     self._send_json(200, srv.payload_slo())
+                elif u.path == "/capacity":
+                    audit = q.get("audit", ["0"])[0] in ("1", "true")
+                    self._send_json(200, srv.payload_capacity(audit))
                 elif u.path == "/quality":
                     self._send_json(200, srv.payload_quality())
                 elif u.path == "/perf":
@@ -313,8 +393,8 @@ def _make_handler(srv: OpsServer):
                     self._send_json(200, {
                         "endpoints": ["/metrics", "/snapshot.json",
                                       "/healthz", "/flight", "/slo",
-                                      "/quality", "/perf", "/journal",
-                                      "/request/<rid>"]})
+                                      "/quality", "/perf", "/capacity",
+                                      "/journal", "/request/<rid>"]})
                 else:
                     self._send_json(404, {"error": f"no route {u.path}"})
             except FileNotFoundError as e:
