@@ -150,6 +150,13 @@ def main() -> int:
         # overload, the §3f×§3g planner validated ±10% cross-serve,
         # and the /capacity (+audit) scrape
         "capacity": _run_json("llama_serving.py", args=("--capacity",)),
+        # r19 (ISSUE 14): tiered KV memory — the many-tenant
+        # working-set-3x-pool trace served HBM-only vs tiered
+        # (hit-rate + TTFT p99 vs the §3n model, token identity),
+        # tier-transfer budget audit, SyncAudit over the tiered loop,
+        # bit-exact journal replay, and the 2-replica directory
+        # steering + migration-on-miss sub-run
+        "tiered": _run_json("llama_serving.py", args=("--tiered",)),
     }
     result["platform"] = result["online"].get("platform", "unknown")
     # r10: lift each mode's runtime-telemetry headline (queue depth,
@@ -159,7 +166,8 @@ def main() -> int:
     result["telemetry_headlines"] = {
         k: (result[k].get("telemetry") or {}).get("headline")
         for k in ("online", "prefix", "paged", "fleet", "overload",
-                  "failover", "slo", "spec", "quality", "capacity")}
+                  "failover", "slo", "spec", "quality", "capacity",
+                  "tiered")}
     # r15: lift the speculative headline — the roofline-beating ratio
     # an operator (or the next round's reviewer) checks first
     spec = result["spec"].get("headline") or {}
@@ -225,6 +233,10 @@ def main() -> int:
             "meter_streams_identity"),
         "audit_clean": (capd.get("ops_scrape") or {}).get("audit_clean"),
     }
+    # r19 (ISSUE 14): lift the tiered-KV headline — token identity,
+    # hit-rate + TTFT vs the §3n model, the tier-transfer budget, the
+    # one-fetch audit, replay identity and directory steering
+    result["tiered_headline"] = result["tiered"].get("headline")
     path = os.path.join(ROOT, f"SERVING_r{rnd:02d}.json")
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
@@ -232,7 +244,7 @@ def main() -> int:
     ok = all(result[k].get("rc") == 0
              for k in ("decode", "serving", "online", "prefix", "paged",
                        "fleet", "overload", "failover", "slo", "spec",
-                       "quality", "capacity"))
+                       "quality", "capacity", "tiered"))
     return 0 if ok else 1
 
 

@@ -88,6 +88,12 @@ TPU_TEST_FILES = [
     # (+audit) endpoint and the monitored-serve sync audit, all against
     # the real backend's paged allocator traffic
     "tests/test_capacity.py",
+    # r19 (ISSUE 14): tiered KV memory — spill->restore token identity
+    # (host staging riding the real backend's single segment fetch),
+    # the one-fetch audit over the tiered loop, directory steering +
+    # migration-on-miss, the tier-transfer budget pass, and journal
+    # replay of a spill-heavy serve, all against real D2H/H2D copies
+    "tests/test_kv_tiers.py",
 ]
 
 

@@ -39,16 +39,18 @@ Quick use::
 
 from __future__ import annotations
 
-from . import budgets, hlo, programs, recompile, syncs
+from . import budgets, hlo, programs, recompile, syncs, tiers
 from .auditor import AuditReport, Finding, audit_fn, audit_replay, audit_static
 from .recompile import CompileWatch, lint_cache_keys, live_cache_report
 from .syncs import SyncAudit, allowed_sync
+from .tiers import tier_transfer_audit, tiered_serve_audit
 
 __all__ = [
     "AuditReport", "Finding", "SyncAudit", "allowed_sync", "CompileWatch",
     "lint_cache_keys", "live_cache_report", "audit_fn", "audit_replay",
     "audit_static", "audit_program", "budgets", "hlo", "programs",
-    "recompile", "syncs",
+    "recompile", "syncs", "tiers", "tier_transfer_audit",
+    "tiered_serve_audit",
 ]
 
 

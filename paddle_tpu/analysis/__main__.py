@@ -35,6 +35,14 @@ Usage::
                                                   # SEGMENT_HOOKS), budgets
                                                   # bit-identical to
                                                   # --capacity off
+    python -m paddle_tpu.analysis --gate --tiers on  # (default) the r19
+                                                  # contract: the tiered-KV
+                                                  # accounting plane ATTACHED
+                                                  # (a TierMeter on
+                                                  # POOL_HOOKS +
+                                                  # SEGMENT_HOOKS), budgets
+                                                  # bit-identical to
+                                                  # --tiers off
     python -m paddle_tpu.analysis --gate --journal on  # (default) the r16
                                                   # contract: the
                                                   # deterministic serving
@@ -133,6 +141,13 @@ def main(argv=None) -> int:
                          "(paged_kv.POOL_HOOKS) and every engine segment "
                          "(serving.SEGMENT_HOOKS) — budgets must be "
                          "bit-identical to --capacity off")
+    ap.add_argument("--tiers", choices=("on", "off"), default="on",
+                    help="audit with the r19 tiered-KV accounting plane "
+                         "attached: a TierMeter observing tier transfers "
+                         "on every allocator event (paged_kv.POOL_HOOKS) "
+                         "and every engine segment "
+                         "(serving.SEGMENT_HOOKS) — budgets must be "
+                         "bit-identical to --tiers off")
     ap.add_argument("--journal", choices=("on", "off"), default="on",
                     help="audit with the r16 deterministic serving "
                          "journal attached (flight superset + decision-"
@@ -165,6 +180,13 @@ def main(argv=None) -> int:
         cmon = observability.CapacityMonitor()
         observability.capacity.install(cmon)
         print("capacity monitor attached on POOL_HOOKS + SEGMENT_HOOKS")
+    tmeter = None
+    if args.tiers == "on":
+        from ..inference import kv_tiers
+
+        tmeter = kv_tiers.TierMeter()
+        kv_tiers.install(tmeter)
+        print("tier meter attached on POOL_HOOKS + SEGMENT_HOOKS")
     targets = args.program or programs.names()
     results = []
     any_violation = False
@@ -187,6 +209,12 @@ def main(argv=None) -> int:
             print("  budget: OK")
         print()
 
+    if tmeter is not None:
+        from ..inference import kv_tiers
+
+        kv_tiers.uninstall(tmeter)
+        print(f"tier meter detached: saw {tmeter.segments} segments, "
+              f"tier events {tmeter.events or '{}'}")
     if cmon is not None:
         observability.capacity.uninstall(cmon)
         print(f"capacity monitor detached: saw {cmon.segment_no} "

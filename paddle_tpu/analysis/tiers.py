@@ -1,0 +1,88 @@
+"""Tier-transfer budget pass (r19, ISSUE 14 tentpole part c).
+
+A memory tier is only a win while it moves LESS than it saves: a
+restore that uploads more bytes than the request's own KV footprint, or
+an import that copies a prefix bigger than the prefill it replaced,
+would be a regression wearing a cache's clothes. This pass makes that
+arithmetic enforceable, the budgets.py way:
+
+* **per-request budget** — every request's billed tier traffic
+  (``Request.tier_pages`` / ``tier_bytes``: restores + cross-replica
+  imports stamped at admission) must satisfy ``tier_bytes <=
+  pages_reserved x page_bytes`` (the request's own KV size — the §3n
+  cost-model ceiling). ``tier_transfer_audit`` returns one violation
+  string per offender.
+* **conservation identities** — the tier's byte counters must agree
+  with its page counters at exactly ``page_bytes`` per page (a drifted
+  counter means a transfer went unmetered), and restores can never
+  outnumber spills + imports (you cannot promote an entry that never
+  left HBM; an entry stages once but may spill/restore many times).
+
+The zero-extra-sync half of the tiered contract is enforced where sync
+contracts live: ``SyncAudit`` over the tiered serve loop (the staging
+D2H rides the per-segment event fetch, restores are dispatches), pinned
+in tests/test_kv_tiers.py with allowed == segment fetches exactly.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = ["tier_transfer_audit", "tier_conservation_audit",
+           "tiered_serve_audit"]
+
+
+def tier_transfer_audit(requests, page_bytes: int) -> List[str]:
+    """Per-request tier-budget check: bytes migrated for a request must
+    not exceed the KV bytes the request itself spans. Empty list =
+    within budget."""
+    v: List[str] = []
+    if page_bytes <= 0:
+        return [f"page_bytes must be positive, got {page_bytes}"]
+    for r in requests:
+        kv_bytes = r.pages_reserved * page_bytes
+        if r.tier_bytes > kv_bytes:
+            v.append(f"request {r.rid}: tier bytes {r.tier_bytes} > "
+                     f"KV size {kv_bytes} "
+                     f"({r.pages_reserved} pages x {page_bytes} B)")
+        if r.tier_pages > r.pages_reserved:
+            v.append(f"request {r.rid}: {r.tier_pages} tier pages > "
+                     f"{r.pages_reserved} reserved")
+    return v
+
+
+def tier_conservation_audit(tier_stats: dict) -> List[str]:
+    """Counter-consistency check over a ``HostTier.stats()`` snapshot:
+    bytes and pages must agree at page_bytes per page, and the host
+    store can never hold more than its bound."""
+    v: List[str] = []
+    pb = tier_stats.get("page_bytes", 0)
+    if pb <= 0:
+        return ["tier stats carry no page_bytes"]
+    for bkey, ckey in (("bytes_to_host", "stages"),
+                       ("bytes_to_hbm", "restores"),
+                       ("bytes_imported", "imports")):
+        if tier_stats[bkey] % pb:
+            v.append(f"{bkey} {tier_stats[bkey]} is not a multiple of "
+                     f"page_bytes {pb} — an unmetered partial transfer")
+    if tier_stats["pages_host"] > tier_stats["capacity_pages"]:
+        v.append(f"host store holds {tier_stats['pages_host']} pages > "
+                 f"capacity {tier_stats['capacity_pages']}")
+    # an entry stages ONCE and may spill/restore many times, but every
+    # restore promotes an entry a spill (or import) previously demoted
+    if tier_stats["restores"] > (tier_stats["spills"]
+                                 + tier_stats["imports"]):
+        v.append(f"{tier_stats['restores']} restores > "
+                 f"{tier_stats['spills']} spills + "
+                 f"{tier_stats['imports']} imports — a promotion of an "
+                 f"entry that never left HBM")
+    return v
+
+
+def tiered_serve_audit(requests, host_tier,
+                       page_bytes: Optional[int] = None) -> List[str]:
+    """The combined pass a lane/test runs after a tiered serve: the
+    per-request budget + the tier's conservation identities."""
+    pb = page_bytes if page_bytes is not None else host_tier.page_bytes()
+    return (tier_transfer_audit(requests, pb)
+            + tier_conservation_audit(host_tier.stats()))

@@ -40,8 +40,11 @@ __all__ = ["Config", "Predictor", "InferTensor", "create_predictor",
 # ``from paddle_tpu.inference.prefix_cache import PrefixCache /
 # PagedPrefixCache / make_prefix_cache``, ``from
 # paddle_tpu.inference.paged_kv import PagedKVCache``, ``from
+# paddle_tpu.inference.kv_tiers import HostTier`` (r19: the host-RAM
+# spill tier + tier-transfer accounting), ``from
 # paddle_tpu.inference.fleet import FleetRouter / build_fleet /
-# FaultInjector`` (r13: health states + failover) — kept
+# CacheDirectory / FaultInjector`` (r13: health states + failover;
+# r19: directed cache-hit steering) — kept
 # out of this namespace so importing the Predictor surface doesn't pull
 # jax model code.
 

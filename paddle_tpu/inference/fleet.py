@@ -75,12 +75,108 @@ from ..observability import journal as _journal
 from ..observability import metrics as _metrics
 from ..observability import quality as _quality
 from ..observability.metrics import percentile as _pctl
-from .prefix_cache import make_prefix_cache
+from .prefix_cache import _common_prefix, make_prefix_cache
 from .scheduler import Arrival
 from .serving import Request, ServingEngine
 
-__all__ = ["FleetRouter", "FleetReport", "Shadow", "build_fleet",
-           "FaultInjector", "ReplicaCrash", "ReplicaHang"]
+__all__ = ["FleetRouter", "FleetReport", "Shadow", "CacheDirectory",
+           "build_fleet", "FaultInjector", "ReplicaCrash", "ReplicaHang"]
+
+
+# ---------------------------------------------------------------------------
+# fleet-global prefix-cache directory (r19 tentpole, ISSUE 14 part b):
+# crc32 affinity routed requests to a replica that MIGHT hold the prefix;
+# the directory routes them to the replica that DOES
+# ---------------------------------------------------------------------------
+
+
+class CacheDirectory:
+    """prefix -> {replica: tier, pages, last_touch}, maintained from the
+    per-replica ``PagedPrefixCache`` listener hooks (insert / evict /
+    spill / restore — the cache's own state transitions ARE the
+    directory's write stream, so it can never drift from the caches).
+
+    Lookup mirrors the caches' matching rule exactly (longest
+    block-aligned STRICT common prefix), so a directory hit means the
+    steered replica's own ``match()`` will hit too — directed cache-hit
+    steering instead of a blind hash pin. All state is host bytes/ints;
+    updates and lookups are deterministic functions of the event
+    stream, so steering decisions replay bit-exactly (the journaled
+    dispatch candidates carry each replica's hit length + tier)."""
+
+    def __init__(self, block: int):
+        if block < 1:
+            raise ValueError(f"block must be >= 1, got {block}")
+        self.block = int(block)
+        self._tokens: Dict[bytes, np.ndarray] = {}
+        # key -> replica idx -> {"tier", "pages", "touch"}
+        self._owners: Dict[bytes, Dict[int, dict]] = {}
+        self._seq = 0
+        self.lookups = 0
+        self.hits = 0
+        self.updates = 0
+
+    def attach(self, idx: int, cache) -> None:
+        """Subscribe to one replica's cache transitions."""
+        if cache is None or not hasattr(cache, "listeners"):
+            return
+
+        def on_event(event, key, tokens, tier, pages, _idx=idx):
+            self._note(_idx, event, key, tokens, tier, pages)
+
+        cache.listeners.append(on_event)
+
+    def _note(self, idx: int, event: str, key: bytes, tokens,
+              tier: str, pages: int) -> None:
+        self.updates += 1
+        self._seq += 1
+        if event == "evict":
+            owners = self._owners.get(key)
+            if owners is not None:
+                owners.pop(idx, None)
+                if not owners:
+                    self._owners.pop(key, None)
+                    self._tokens.pop(key, None)
+            return
+        self._tokens[key] = np.asarray(tokens, np.int32)
+        self._owners.setdefault(key, {})[idx] = {
+            "tier": tier, "pages": int(pages), "touch": self._seq}
+
+    def lookup(self, prompt) -> Optional[dict]:
+        """Longest block-aligned strict common prefix across the whole
+        fleet's cached entries, or None. Returns ``{"key", "rows",
+        "owners": {idx: {tier, pages, touch}}}``."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        b = self.block
+        cap = (len(prompt) // b) * b
+        if cap == len(prompt):
+            cap -= b
+        self.lookups += 1
+        if cap <= 0 or not self._owners:
+            return None
+        best_l, best_key = 0, None
+        for key, toks in self._tokens.items():
+            m = (min(_common_prefix(prompt, toks), cap) // b) * b
+            if m > best_l:
+                best_l, best_key = m, key
+        if best_key is None:
+            return None
+        self.hits += 1
+        return {"key": best_key, "rows": best_l,
+                "owners": {i: dict(info)
+                           for i, info in self._owners[best_key].items()}}
+
+    def reset(self) -> None:
+        self._tokens.clear()
+        self._owners.clear()
+        self._seq = 0
+        self.lookups = self.hits = self.updates = 0
+
+    def stats(self) -> dict:
+        return {"entries": len(self._owners),
+                "placements": sum(len(o) for o in self._owners.values()),
+                "lookups": self.lookups, "hits": self.hits,
+                "updates": self.updates}
 
 
 # ---------------------------------------------------------------------------
@@ -297,6 +393,12 @@ class FleetReport:
     quality: Optional[dict] = None
     shadow: Optional[dict] = None
     canary: Optional[dict] = None
+    # r19 (ISSUE 14): directed steering + tier accounting — directory
+    # dispatches, cross-replica host-tier imports, and the directory's
+    # own hit/entry stats (None when no directory is attached)
+    dispatches_directory: int = 0
+    tier_migrations: int = 0
+    directory: Optional[dict] = None
     per_replica: List[dict] = field(default_factory=list)
     telemetry: Optional[dict] = None   # merge_log_dir reduction
 
@@ -319,7 +421,8 @@ class _Replica:
         self.prefix_cache = prefix_cache
         self.registry = _metrics.Registry()
         self.backpressure_events = 0
-        self.dispatches = {"affinity": 0, "least_loaded": 0, "canary": 0}
+        self.dispatches = {"affinity": 0, "least_loaded": 0,
+                           "canary": 0, "directory": 0}
         self.segments = 0
         self.rids: List[int] = []          # fleet rids, assignment order
         # r13 failover: health state machine (healthy -> suspect on a
@@ -397,7 +500,8 @@ class FleetRouter:
                  fault_injector: Optional[FaultInjector] = None,
                  probe_after_s: float = 0.05,
                  slo_monitor=None, perf_monitor=None,
-                 shadow: Optional[Shadow] = None, canary=None):
+                 shadow: Optional[Shadow] = None, canary=None,
+                 directory: bool = False):
         if not engines:
             raise ValueError("a fleet needs at least one engine")
         if prefix_caches == "auto":
@@ -475,6 +579,22 @@ class FleetRouter:
                     "a canary needs >= 2 replicas: the canary replica "
                     "is excluded from control traffic, so a 1-replica "
                     "fleet would have no control population")
+        # r19 tiered KV (ISSUE 14): the fleet cache directory — directed
+        # cache-hit steering over the per-replica caches' live state,
+        # with migration-on-miss through the replica-portable host tier.
+        # Opt-in: blind affinity stays the default routing contract.
+        self.directory: Optional[CacheDirectory] = None
+        if directory:
+            paged_pcs = [(i, pc) for i, pc in enumerate(prefix_caches)
+                         if pc is not None and hasattr(pc, "pager")]
+            if not paged_pcs:
+                raise ValueError(
+                    "directory steering needs paged prefix caches — it "
+                    "routes on the caches' live entry state")
+            self.directory = CacheDirectory(paged_pcs[0][1].block)
+            for i, pc in paged_pcs:
+                self.directory.attach(i, pc)
+        self.tier_migrations = 0            # cross-replica imports
         self.failovers = 0                  # replicas declared dead
         self.requeued = 0                   # requests moved to survivors
         self.last_retry_after_s: Optional[float] = None
@@ -501,7 +621,7 @@ class FleetRouter:
         need = eng.pager.pages_needed(len(a.prompt) + a.max_new_tokens - 1)
         return eng.pager.pages_free >= need
 
-    def _route(self, a: Arrival):
+    def _route(self, a: Arrival, dirinfo: Optional[dict] = None):
         """(replica, reason) for a due arrival, or (bill_target, None)
         when every queue is full (fleet backpressure). r13: suspect and
         dead replicas are EXCLUDED from dispatch — an affinity pin to an
@@ -509,6 +629,14 @@ class FleetRouter:
         set (the prefix re-prefills on the survivor; correctness over
         cache warmth), and only if NO healthy replica exists do suspects
         take traffic as a last resort (dead never).
+
+        r19 directed steering (ISSUE 14): ``dirinfo`` (a
+        ``CacheDirectory.lookup`` hit) outranks the blind affinity
+        hash — the request goes to a replica that FACTUALLY holds its
+        prefix (resident tiers before host tier: a restore costs an
+        upload), provided that replica can take it right now; an
+        untakeable owner set falls through to affinity/least-loaded,
+        and the miss becomes a migration opportunity (``_migrate``).
 
         r17 canary split (ISSUE 12): with a canary attached, a seeded
         pure draw on the rid this arrival WILL take routes ``weight`` of
@@ -527,6 +655,17 @@ class FleetRouter:
                     and self._page_ready(crep, a)):
                 return crep, "canary"
             ctl = [r for r in self._replicas if r.idx != can.replica]
+        if dirinfo is not None:
+            owners = dirinfo["owners"]
+            dcands = [r for r in ctl
+                      if r.idx in owners and r.health == "healthy"
+                      and r.queue_depth < self.max_queue
+                      and self._page_ready(r, a)]
+            if dcands:
+                best = min(dcands,
+                           key=lambda r: (owners[r.idx]["tier"] == "host",
+                                          r.load, r.idx))
+                return best, "directory"
         key = (self._affinity_key(a.prompt)
                if self._use_affinity else None)
         pref = (ctl[zlib.crc32(key) % len(ctl)]
@@ -552,13 +691,48 @@ class FleetRouter:
                                          r.load, r.idx))
         return best, "least_loaded"
 
+    def _migrate(self, dirinfo: dict, dst: _Replica,
+                 rid: int) -> Optional[tuple]:
+        """Import ``dirinfo``'s prefix from an owning replica's HOST
+        tier into ``dst``'s cache (r19, ISSUE 14): host-tier pages are
+        replica-portable bytes, so a steering miss costs one host-to-
+        host copy instead of a full prefill recompute. Freshest staged
+        owner wins; an owner whose entry never finished staging cannot
+        export (moving HBM pages would need a sync) and is skipped.
+        Returns (pages, bytes) imported, or None."""
+        pc = dst.prefix_cache
+        if pc is None or getattr(pc, "host_tier", None) is None:
+            return None
+        owners = sorted(dirinfo["owners"].items(),
+                        key=lambda kv: -kv[1]["touch"])
+        for idx, _info in owners:
+            src = self._replicas[idx].prefix_cache
+            if src is None or not hasattr(src, "export_host"):
+                continue
+            exp = src.export_host(dirinfo["key"])
+            if exp is None:
+                continue
+            if not pc.import_host(exp["tokens"], exp["k"], exp["v"]):
+                continue
+            n = int(exp["pages"])
+            nbytes = n * pc.host_tier.page_bytes()
+            self.tier_migrations += 1
+            _metrics.counter("fleet.tier_migrations").inc()
+            _flight.record("tier_migrate", rid=rid, src=idx,
+                           dst=dst.idx, pages=n, bytes=nbytes,
+                           rows=int(len(exp["tokens"])))
+            return n, nbytes
+        return None
+
     # --- intake ----------------------------------------------------------
     def _ingest(self, pending: List[Arrival], now: float, t0: float) -> int:
         refused = 0
         _j = _journal.active()
         while pending and pending[0].t <= now:
             a = pending[0]
-            rep, reason = self._route(a)
+            dirinfo = (self.directory.lookup(a.prompt)
+                       if self.directory is not None else None)
+            rep, reason = self._route(a, dirinfo)
             cands = None
             if _j is not None:
                 # the dispatch decision WITH its candidate ranking: the
@@ -570,6 +744,11 @@ class FleetRouter:
                 # reclaimable per candidate, so the item-4 autoscaler
                 # reads its scale-up signal straight off the dispatch
                 # record (and /healthz mirrors the same pair live)
+                # r19 (ISSUE 14): the ranking gains per-replica
+                # directory-hit info (matched rows + tier) so a
+                # steering decision's "why replica 2" replays
+                # bit-exactly off the journal record alone
+                owners = dirinfo["owners"] if dirinfo is not None else {}
                 cands = [{"idx": x.idx, "health": x.health,
                           "queue": x.queue_depth, "live": x.live,
                           "page_ready": self._page_ready(x, a),
@@ -581,7 +760,11 @@ class FleetRouter:
                               and x.prefix_cache is not None
                               and hasattr(x.prefix_cache,
                                           "reclaimable_pages") else
-                              (0 if x.engine.paged else None))}
+                              (0 if x.engine.paged else None)),
+                          "dir_hit": (dirinfo["rows"]
+                                      if x.idx in owners else 0),
+                          "dir_tier": (owners[x.idx]["tier"]
+                                       if x.idx in owners else None)}
                          for x in self._replicas]
                 if reason is None:          # refusal: no rid assigned
                     _j.record("dispatch", rid=None, replica=rep.idx,
@@ -603,10 +786,22 @@ class FleetRouter:
             pending.pop(0)
             rid = self._next_rid
             self._next_rid += 1
+            # r19 migration-on-miss (ISSUE 14): the steered owner could
+            # not take this arrival and the chosen replica does not hold
+            # the prefix — import the owner's replica-portable HOST
+            # bytes into the destination cache so admission restores
+            # instead of recomputing the prefill
+            imported = None
+            if (dirinfo is not None and reason != "directory"
+                    and rep.idx not in dirinfo["owners"]):
+                imported = self._migrate(dirinfo, rep, rid)
             erid = rep.engine.add_request(a.prompt, a.max_new_tokens)
             req = rep.engine._queue[-1]
             assert req.rid == erid
             req.arrival_time = t0 + a.t
+            if imported is not None:
+                req.tier_pages += imported[0]
+                req.tier_bytes += imported[1]
             self._reqs[rid] = (rep.idx, req)
             rep.rids.append(rid)
             _journal.record("arrival", rid=rid, at=a.t, replica=rep.idx,
@@ -857,6 +1052,11 @@ class FleetRouter:
                                         for r in reps),
             dispatches_canary=sum(r.dispatches.get("canary", 0)
                                   for r in reps),
+            dispatches_directory=sum(r.dispatches.get("directory", 0)
+                                     for r in reps),
+            tier_migrations=self.tier_migrations,
+            directory=(self.directory.stats()
+                       if self.directory is not None else None),
             quality=(self.shadow.monitor.report()
                      if self.shadow is not None else None),
             shadow=(self.shadow.stats()
@@ -1146,6 +1346,7 @@ class FleetRouter:
                       "max_finish_retries": self.max_finish_retries,
                       "max_requeues": self.max_requeues,
                       "probe_after_s": self.probe_after_s,
+                      "directory": self.directory is not None,
                       "next_rid": self._next_rid},
             "engines": [_journal.describe_engine(r.engine)
                         for r in self._replicas],
@@ -1193,7 +1394,8 @@ class FleetRouter:
                 r.prefix_cache.reset()
             r.registry.reset()
             r.backpressure_events = 0
-            r.dispatches = {"affinity": 0, "least_loaded": 0, "canary": 0}
+            r.dispatches = {"affinity": 0, "least_loaded": 0,
+                            "canary": 0, "directory": 0}
             r.segments = 0
             r.rids = []
             r.health = "healthy"
@@ -1203,6 +1405,11 @@ class FleetRouter:
         self.backpressure_events = 0
         self.failovers = 0
         self.requeued = 0
+        self.tier_migrations = 0
+        if self.directory is not None:
+            # the cache resets above already drained it through the
+            # evict listeners; zero the counters too
+            self.directory.reset()
         self.last_retry_after_s = None
         self._finished_count = 0
         self._reqs.clear()
@@ -1227,9 +1434,15 @@ class FleetRouter:
         for r in self._replicas:
             if not r.engine.paged:
                 continue
-            held = (r.prefix_cache.pages_held
-                    if r.prefix_cache is not None
-                    and hasattr(r.prefix_cache, "pages_held") else 0)
+            pc = r.prefix_cache
+            if pc is not None and hasattr(pc, "physical_pages_held"):
+                # distinct pages, not ref counts: entries sharing a
+                # prefix hold its pages once physically (r19 fix)
+                held = pc.physical_pages_held()
+            elif pc is not None and hasattr(pc, "pages_held"):
+                held = pc.pages_held
+            else:
+                held = 0
             for msg in r.engine.pager.leak_report(expected_held=held):
                 bad.append(f"replica {r.idx}: {msg}")
         return bad

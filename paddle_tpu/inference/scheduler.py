@@ -185,6 +185,10 @@ class OnlineReport:
     # free host arithmetic); capacity needs the monitor attached
     capacity: Optional[dict] = None
     meter: Optional[dict] = None
+    # r19 (ISSUE 14): the host-tier breakdown when the prefix cache has
+    # a spill tier attached — pages staged/spilled/restored + the byte
+    # counters the tier-transfer budget audits (None otherwise)
+    tiers: Optional[dict] = None
     per_request: List[dict] = field(default_factory=list)
 
     def as_dict(self, with_requests: bool = False) -> dict:
@@ -365,13 +369,19 @@ class OnlineScheduler:
             if cap is not None and eng.paged:
                 # r18: evaluate time-to-exhaustion BEFORE the dispatch
                 # that could hit pages-backpressure — the alert must
-                # lead the valve (ISSUE 13 acceptance bar)
+                # lead the valve (ISSUE 13 acceptance bar). r19: the
+                # availability term gains the tier dimension — host-
+                # tier pages ride the same evaluation as a separate
+                # (reclaimable-at-restore-cost) pool.
+                pc = self.prefix_cache
+                has_rec = (pc is not None
+                           and hasattr(pc, "reclaimable_pages"))
                 cap.begin_segment(
                     eng.pager.pages_free,
-                    (self.prefix_cache.reclaimable_pages()
-                     if self.prefix_cache is not None
-                     and hasattr(self.prefix_cache, "reclaimable_pages")
-                     else 0))
+                    pc.reclaimable_pages() if has_rec else 0,
+                    host_pages=(pc.host_pages if has_rec
+                                and getattr(pc, "host_tier", None)
+                                is not None else None))
             t_seg = _hooks.now_ns()
             t_seg_pc = _journal.now()
             ev = eng.run_segment(self.seg_steps,
@@ -497,6 +507,10 @@ class OnlineScheduler:
                         if self.capacity_monitor is not None else None),
                 page_size=eng.page_size if eng.paged else None)
                 if eng.paged else None),
+            tiers=(self.prefix_cache.host_tier.stats()
+                   if self.prefix_cache is not None
+                   and getattr(self.prefix_cache, "host_tier", None)
+                   is not None else None),
             **self._report_extras(reqs),
             per_request=[{
                 "rid": r.rid,
@@ -512,6 +526,9 @@ class OnlineScheduler:
                 "page_seconds": round(r.page_seconds, 4),
                 "ticks": r.meter_ticks,
                 "streams": round(r.meter_streams, 4),
+                # r19: the request's tier-transfer bill (0 untiered)
+                "tier_pages": r.tier_pages,
+                "tier_bytes": r.tier_bytes,
             } for r in reqs],
         )
 

@@ -237,6 +237,13 @@ class Request:
     page_seconds: float = 0.0
     meter_ticks: int = 0
     meter_streams: float = 0.0
+    # r19 tiered KV (ISSUE 14): tier traffic billed to THIS request —
+    # pages/bytes promoted from the host tier (restore-on-hit) or
+    # imported cross-replica for its admission. analysis.tiers enforces
+    # tier_bytes <= the request's own KV size (pages_reserved x page
+    # bytes): a memory tier must never move more than it saves.
+    tier_pages: int = 0
+    tier_bytes: int = 0
     _pages_live: int = 0          # currently-held pages (meter internal)
     _pages_t0: float = 0.0        # holding-interval open stamp
 
@@ -2285,10 +2292,26 @@ class ServingEngine:
             total = pgr.pages_needed(rows)
             hit_pages: List[int] = []
             hit_len = 0
+            restored = 0
             if prefix_cache is not None:
                 m = prefix_cache.match(fp)
-                if m is not None:
+                if m is not None and getattr(m, "tier", "hbm") != "host":
                     hit_pages, hit_len = list(m.pages), m.length
+                elif m is not None:
+                    # r19 tiered KV (ISSUE 14): host-tier hit —
+                    # restore-on-hit is reserve + async staged upload +
+                    # the normal ref-bump share. Restoring consumes free
+                    # pages itself, so the WHOLE request span must fit;
+                    # the pressure valve may spill colder entries first.
+                    # A failed restore degrades to a plain miss (full
+                    # prefill) — never an error.
+                    if total > pgr.pages_free:
+                        prefix_cache.evict_until(total)
+                    if total <= pgr.pages_free:
+                        rp = prefix_cache.restore(m.key, m.length)
+                        if rp:
+                            hit_pages, hit_len = rp, len(rp) * psz
+                            restored = len(rp)
             need_new = total - len(hit_pages)
             if need_new > pgr.pages_free:
                 if prefix_cache is not None:
@@ -2324,6 +2347,11 @@ class ServingEngine:
             r.prefix_hit_len = hit_len
             r.admit_time = now
             r._meter_reserve(len(pages), len(pages) - len(hit_pages))
+            if restored:
+                # r19: bill the promotion to the request it admitted
+                r.tier_pages += restored
+                r.tier_bytes += (restored
+                                 * prefix_cache.host_tier.page_bytes())
             picked.append(r)
             fulls.append(fp)
             req_pages.append(pages)
@@ -2440,19 +2468,30 @@ class ServingEngine:
         psz = self.page_size
         # THE per-segment sync (same audited label + budget as the
         # contiguous engine: exactly one device contact per segment —
-        # the spec program's acceptance counts ride the same fetch)
+        # the spec program's acceptance counts ride the same fetch).
+        # r19 tiered KV (ISSUE 14): queued host-tier stage gathers fold
+        # into the SAME single device_get — the D2H spill staging costs
+        # zero additional sync events by construction.
         acc = spec_stats = dig = None
+        tier = getattr(prefix_cache, "host_tier", None) \
+            if prefix_cache is not None else None
+        staged = tier.take_pending() if tier is not None else []
         with allowed_sync("serving.segment_event_fetch"):
+            payload = (p.dev if not staged
+                       else (p.dev, [s[2:] for s in staged]))
+            got = jax.device_get(payload)
+            dev = got if not staged else got[0]
             if p.spec:
-                toks, aq, aslot, acc, steps, qadm = jax.device_get(p.dev)
+                toks, aq, aslot, acc, steps, qadm = dev
             elif p.digest:
                 # r17: digest columns ride the SAME single fetch — the
                 # per-segment sync count is unchanged (audited)
-                (toks, aq, aslot, dlg, dti, dtv, steps,
-                 qadm) = jax.device_get(p.dev)
+                toks, aq, aslot, dlg, dti, dtv, steps, qadm = dev
                 dig = (dlg, dti, dtv)
             else:
-                toks, aq, aslot, steps, qadm = jax.device_get(p.dev)
+                toks, aq, aslot, steps, qadm = dev
+        if staged:
+            tier.complete(staged, got[1])
         steps, qadm = int(steps), int(qadm)
         self.last_run_ticks += steps
         self.last_run_chunks += 1

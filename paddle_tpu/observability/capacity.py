@@ -106,6 +106,11 @@ class PoolMonitor:
         self.events = 0
         self.cache_retains = 0            # PagedPrefixCache inserts
         self.cache_releases = 0           # PagedPrefixCache evictions
+        # r19 tiered KV (ISSUE 14): tier traffic observed through the
+        # same POOL_HOOKS broadcast — event counts and page totals per
+        # direction (stage / spill / restore / import)
+        self.tier_events: Dict[str, int] = {}
+        self.tier_pages: Dict[str, int] = {}
         self.high_water_pages = 0
         self.high_water_events = 0
         self._hw_armed = True
@@ -166,6 +171,10 @@ class PoolMonitor:
             self.cache_retains += 1
         elif event == "cache_release":
             self.cache_releases += 1
+        elif event.startswith("tier_"):
+            d = event[len("tier_"):]
+            self.tier_events[d] = self.tier_events.get(d, 0) + 1
+            self.tier_pages[d] = self.tier_pages.get(d, 0) + int(n)
         if used > self.high_water_pages:
             self.high_water_pages = used
             _metrics.gauge("capacity.high_water_pages").set(used)
@@ -233,7 +242,23 @@ class PoolMonitor:
             "page_seconds_integral": round(self.page_seconds_integral, 6),
             "timeline_stride": self._stride,
             "timeline": list(self.timeline),
+            **self._tier_section(),
         }
+
+    def _tier_section(self) -> dict:
+        """The r19 tier breakdown, when the attached cache has a host
+        tier: host-resident pages + observed transfer traffic (empty
+        dict otherwise, so the r18 snapshot shape is unchanged)."""
+        tier = (getattr(self.prefix_cache, "host_tier", None)
+                if self.prefix_cache is not None else None)
+        if tier is None and not self.tier_events:
+            return {}
+        out = {"events": dict(self.tier_events),
+               "pages": dict(self.tier_pages)}
+        if tier is not None:
+            out.update(tier.stats())
+            out["spillable_pages"] = self.prefix_cache.spillable_pages()
+        return {"tiers": out}
 
     def reclaimable(self) -> int:
         if self.prefix_cache is None:
@@ -371,6 +396,9 @@ class CapacityMonitor:
         self.pool_events = 0
         self._free = 0
         self._reclaimable = 0
+        # r19 (ISSUE 14): the tier dimension of the availability term —
+        # host-resident staged pages (None until a tiered feed reports)
+        self._host_pages: Optional[int] = None
         self.tte_fast = math.inf
         self.tte_slow = math.inf
         self.demand_fast = 0.0
@@ -384,9 +412,12 @@ class CapacityMonitor:
         self.admitted_total += int(admitted)
         self.pool_events += 1
 
-    def observe_pool(self, pages_free: int, reclaimable: int = 0) -> None:
+    def observe_pool(self, pages_free: int, reclaimable: int = 0,
+                     host_pages: Optional[int] = None) -> None:
         self._free = int(pages_free)
         self._reclaimable = int(reclaimable)
+        if host_pages is not None:
+            self._host_pages = int(host_pages)
         self.pool_events += 1
 
     # --- evaluation -------------------------------------------------------
@@ -395,13 +426,24 @@ class CapacityMonitor:
         return sum(buckets) / len(buckets) if buckets else 0.0
 
     def begin_segment(self, pages_free: Optional[int] = None,
-                      reclaimable: Optional[int] = None) -> str:
+                      reclaimable: Optional[int] = None,
+                      host_pages: Optional[int] = None) -> str:
         """Run the alert rules against the CURRENT availability —
-        call before dispatching the segment. Returns the level."""
+        call before dispatching the segment. Returns the level.
+
+        r19 tier dimension: the HBM time-to-exhaustion keeps its r18
+        meaning (free + reclaimable — with a spill tier, 'reclaimable'
+        pages demote instead of dying, so the term is unchanged while
+        its COST changed); ``host_pages`` rides the report/gauge as the
+        second availability axis the autoscaler and the /capacity
+        scrape read."""
         if pages_free is not None:
             self._free = int(pages_free)
         if reclaimable is not None:
             self._reclaimable = int(reclaimable)
+        if host_pages is not None:
+            self._host_pages = int(host_pages)
+            _metrics.gauge("capacity.host_pages").set(self._host_pages)
         avail = self._free + self._reclaimable
         self.demand_fast = self._demand(self.fast_window)
         self.demand_slow = self._demand(self.slow_window)
@@ -483,6 +525,13 @@ class CapacityMonitor:
             "avail_pages": self._free + self._reclaimable,
             "pages_free": self._free,
             "reclaimable_pages": self._reclaimable,
+            # r19 (ISSUE 14): the per-tier availability view — host
+            # pages are reclaimable AT RESTORE COST, so they report as
+            # their own axis instead of inflating the HBM horizon
+            "avail_by_tier": {
+                "hbm": self._free + self._reclaimable,
+                "host": self._host_pages,
+            },
             "demand_fast": round(self.demand_fast, 3),
             "demand_slow": round(self.demand_slow, 3),
             "tte_fast_segments": (round(self.tte_fast, 3)

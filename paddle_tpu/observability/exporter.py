@@ -204,12 +204,26 @@ class OpsServer:
                 if not r.engine.paged:
                     continue
                 pc = r.prefix_cache
-                pages[str(r.idx)] = {
+                row = {
                     "pages_free": r.engine.pager.pages_free,
                     "reclaimable": (pc.reclaimable_pages()
                                     if pc is not None and hasattr(
                                         pc, "reclaimable_pages") else 0),
                 }
+                tier = getattr(pc, "host_tier", None)
+                if tier is not None:
+                    # r19 (ISSUE 14): the tier dimension next to health
+                    # — hbm/host page split + transfer counters, read
+                    # off the same host mirrors the router ranks on
+                    row["tiers"] = {
+                        "host_pages": tier.pages_host,
+                        "spills": tier.spills,
+                        "restores": tier.restores,
+                        "imports": tier.imports,
+                        "bytes_staged": tier.bytes_to_host,
+                        "bytes_restored": tier.bytes_to_hbm,
+                    }
+                pages[str(r.idx)] = row
             if pages:
                 body["pages"] = pages
         if self.slo_monitor is not None:
@@ -299,21 +313,31 @@ class OpsServer:
                 if not r.engine.paged:
                     continue
                 pc = r.prefix_cache
-                reps[str(r.idx)] = {
+                row = {
                     "health": r.health,
                     **r.engine.pager.stats(),
                     "reclaimable": (pc.reclaimable_pages()
                                     if pc is not None and hasattr(
                                         pc, "reclaimable_pages") else 0),
                 }
+                tier = getattr(pc, "host_tier", None)
+                if tier is not None:
+                    row["tiers"] = tier.stats()
+                reps[str(r.idx)] = row
             if reps:
                 out["replicas"] = reps
+            if getattr(self.fleet, "directory", None) is not None:
+                out["directory"] = self.fleet.directory.stats()
         if audit:
             if self.fleet is not None:
                 out["audit"] = self.fleet.leak_report()
             elif pm is not None:
-                held = (pm.prefix_cache.pages_held
-                        if pm.prefix_cache is not None else 0)
+                pc = pm.prefix_cache
+                held = 0
+                if pc is not None:
+                    held = (pc.physical_pages_held()
+                            if hasattr(pc, "physical_pages_held")
+                            else pc.pages_held)
                 out["audit"] = pm.pager.leak_report(expected_held=held)
             else:
                 out["audit"] = []

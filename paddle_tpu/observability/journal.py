@@ -92,6 +92,11 @@ DECISION_KINDS = frozenset({
     "spec_accept", "fault", "probe",
     "replica_dead", "replica_suspect", "replica_recovered",
     "failover_requeue", "prefix_hit", "prefix_evict",
+    # r19 tiered KV (ISSUE 14): tier movement is deterministic host
+    # bookkeeping over the event stream (stage completion is pinned to
+    # segment boundaries), so spill/restore/import decisions and the
+    # fleet's migration choices replay bit-exactly and are DIFFED
+    "tier_transfer", "tier_migrate",
 })
 
 
@@ -629,8 +634,15 @@ def describe_prefix_cache(pc) -> Optional[dict]:
     if pc is None:
         return None
     if hasattr(pc, "pager"):                    # PagedPrefixCache
-        return {"kind": "paged", "block": pc.block,
-                "capacity_pages": pc.capacity_pages}
+        d = {"kind": "paged", "block": pc.block,
+             "capacity_pages": pc.capacity_pages}
+        tier = getattr(pc, "host_tier", None)
+        if tier is not None:
+            # r19: the host spill tier is a routing/admission DECIDER
+            # (restore-on-hit, spill-instead-of-drop), so replay must
+            # rebuild it at the recorded capacity
+            d["host_tier_pages"] = tier.capacity_pages
+        return d
     return {"kind": "rows", "block": pc.block,
             "capacity_tokens": pc.capacity_tokens}
 

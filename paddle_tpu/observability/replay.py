@@ -147,8 +147,17 @@ def _prefix_cache_from(d: Optional[dict], engine):
     from ..inference.prefix_cache import PagedPrefixCache, PrefixCache
 
     if d["kind"] == "paged":
+        host_tier = None
+        if d.get("host_tier_pages"):
+            # r19: the spill tier decides restores/spills — rebuild it
+            # at the recorded capacity so tier_transfer records replay
+            from ..inference.kv_tiers import HostTier
+
+            host_tier = HostTier(engine.pager,
+                                 capacity_pages=d["host_tier_pages"])
         return PagedPrefixCache(engine.pager,
-                                capacity_pages=d["capacity_pages"])
+                                capacity_pages=d["capacity_pages"],
+                                host_tier=host_tier)
     return PrefixCache(block=d["block"],
                        capacity_tokens=d["capacity_tokens"])
 
@@ -225,7 +234,8 @@ def rebuild(header: dict, params):
             max_requeues=fk["max_requeues"],
             fault_injector=_injector_from(header.get("fault")),
             probe_after_s=fk["probe_after_s"],
-            canary=canary)
+            canary=canary,
+            directory=bool(fk.get("directory", False)))
         router._next_rid = int(fk.get("next_rid", 0))
         return router, trace
     sk = header["scheduler"]

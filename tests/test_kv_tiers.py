@@ -1,0 +1,497 @@
+"""Tiered KV memory (r19 tentpole, ISSUE 14): host-RAM page spill
+behind the paged prefix cache + the fleet-global cache directory.
+
+Pins the subsystem's contracts:
+
+* spill→restore token identity vs an un-spilled reference serve, with
+  the unified ``prefix_evict``-with-reason eviction path;
+* the staging contract — D2H stage rides the per-segment event fetch
+  (SyncAudit over the tiered loop: flagged == [], allowed == segment
+  fetches EXACTLY) and restore is a dispatch;
+* host-tier pages as the capacity plane's second availability axis
+  (``reclaimable_pages(tier=...)`` + CapacityMonitor ``avail_by_tier``);
+* directory steering (a hot prefix's owner takes repeat traffic;
+  migration-on-miss imports host bytes instead of recomputing) and the
+  journaled dispatch candidates' directory-hit info;
+* journal replay identity of a spill-heavy serve (tier_transfer is a
+  diffed decision kind);
+* the analysis.tiers budget pass (bytes/request <= KV size).
+
+Suite-time contract: everything rides the session ``tiny_llama``
+fixture, one module-scoped spill-heavy recorded serve, and the same
+engine geometries as tests/test_capacity.py so ``serving._SHARED_PROGS``
+serves the compiles.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.kv_tiers import HostTier, TierMeter, page_bytes
+from paddle_tpu.inference.prefix_cache import PagedPrefixCache
+from paddle_tpu.inference.scheduler import Arrival, OnlineScheduler
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.observability import flight, journal, replay_serve
+from paddle_tpu.parallel import set_mesh
+
+
+@pytest.fixture(scope="module")
+def tiny(tiny_llama):
+    set_mesh(None)
+    return tiny_llama
+
+
+def _mk(cfg, params, tiered=True, num_pages=11, host_pages=64, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("prompt_buckets", (8, 16, 32, 64))
+    eng = ServingEngine(cfg, params, paged=True, page_size=16,
+                        num_pages=num_pages, **kw)
+    tier = HostTier(eng.pager, capacity_pages=host_pages) if tiered \
+        else None
+    pc = PagedPrefixCache(eng.pager, capacity_pages=8, host_tier=tier)
+    return eng, pc
+
+
+def _tenant_trace(cfg, seed=7, tenants=4, rounds=2, gen=24):
+    """Round-robin multi-tenant trace whose 2-page prefixes working set
+    (tenants x 2 pages + live spans) overflows the tight 10-page pool —
+    the spill-heavy shape."""
+    rng = np.random.RandomState(seed)
+    prefs = [rng.randint(0, cfg.vocab_size, (32,)).astype(np.int32)
+             for _ in range(tenants)]
+    out = []
+    for r in range(rounds):
+        for t in range(tenants):
+            tail = rng.randint(0, cfg.vocab_size, (8,)).astype(np.int32)
+            out.append(Arrival(0.0, np.concatenate([prefs[t], tail]), gen))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# module-scoped spill-heavy recorded serve (single compile+serve cost,
+# read by the identity / replay / audit / report tests)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def spill_serve(tiny):
+    cfg, params = tiny
+    arr = _tenant_trace(cfg)
+    flight.clear()
+    eng, pc = _mk(cfg, params)
+    sch = OnlineScheduler(eng, seg_steps=12, prefix_cache=pc)
+    j = journal.Journal()                 # in-memory
+    with journal.attach(j):
+        rep = sch.serve(arr)
+    results = sch.results()
+    reqs = list(sch._reqs.values())
+    events = flight.events()
+    # un-spilled reference: same trace, same geometry, NO cache at all
+    # (prefix reuse off — the token-identity oracle)
+    eng_ref = ServingEngine(cfg, params, slots=2, max_len=96,
+                            prompt_buckets=(8, 16, 32, 64), paged=True,
+                            page_size=16, num_pages=11)
+    sch_ref = OnlineScheduler(eng_ref, seg_steps=12)
+    sch_ref.serve(arr)
+    return {"arr": arr, "eng": eng, "pc": pc, "sch": sch, "rep": rep,
+            "results": results, "reqs": reqs, "events": events,
+            "journal": j, "ref_results": sch_ref.results(),
+            "params": params}
+
+
+class TestSpillRestore:
+    def test_spill_heavy_and_token_identical(self, spill_serve):
+        """The tentpole identity: the tiered serve actually spilled and
+        restored (working set 3x the pool forces the tier to carry the
+        prefixes), and every request's tokens are identical to the
+        un-spilled no-cache reference serve."""
+        pc = spill_serve["pc"]
+        assert pc.spills > 0, "trace never spilled — pool not tight"
+        assert pc.restores > 0, "no restore-on-hit happened"
+        assert pc.hits > 0
+        assert spill_serve["results"] == spill_serve["ref_results"]
+
+    def test_tiered_beats_hbm_only_hit_rate(self, spill_serve, tiny):
+        """The capacity lever: on the same trace the HBM-only cache
+        (entries die on pressure) reuses NOTHING, the tiered cache
+        serves every repeat round from spilled prefixes."""
+        cfg, params = tiny
+        eng, pc = _mk(cfg, params, tiered=False)
+        sch = OnlineScheduler(eng, seg_steps=12, prefix_cache=pc)
+        sch.serve(spill_serve["arr"])
+        assert sch.results() == spill_serve["ref_results"]
+        assert spill_serve["pc"].hit_tokens > pc.hit_tokens
+
+    def test_eviction_reasons_unified(self, spill_serve):
+        """The r19 small fix: every eviction emits ``prefix_evict``
+        with a reason; the spill-heavy serve demotes (reason=spill)
+        instead of dropping, and stage/spill/restore all leave
+        tier_transfer events with byte counts."""
+        evs = spill_serve["events"]
+        reasons = {e.get("reason") for e in evs
+                   if e["kind"] == "prefix_evict"}
+        assert reasons and reasons <= {"capacity", "pressure", "spill",
+                                       "subsumed", "reset"}
+        assert "spill" in reasons
+        tt = [e for e in evs if e["kind"] == "tier_transfer"]
+        dirs = {e["direction"] for e in tt}
+        assert {"stage", "spill", "restore"} <= dirs
+        assert all(e["bytes"] % 1 == 0 and e["pages"] >= 0 for e in tt)
+        pb = spill_serve["pc"].host_tier.page_bytes()
+        for e in tt:
+            if e["direction"] in ("stage", "restore", "import"):
+                assert e["bytes"] == e["pages"] * pb
+
+    def test_tier_budget_audit(self, spill_serve):
+        """analysis.tiers: bytes-migrated/request <= the request's own
+        KV size, and the tier's conservation identities hold."""
+        from paddle_tpu.analysis import tiered_serve_audit
+
+        tier = spill_serve["pc"].host_tier
+        assert tiered_serve_audit(spill_serve["reqs"], tier) == []
+        billed = [r for r in spill_serve["reqs"] if r.tier_bytes]
+        assert billed, "no request was billed a restore"
+        pb = tier.page_bytes()
+        for r in billed:
+            assert r.tier_bytes <= r.pages_reserved * pb
+
+    def test_report_sections(self, spill_serve):
+        rep = spill_serve["rep"]
+        assert rep.tiers is not None
+        assert rep.tiers["spills"] == spill_serve["pc"].host_tier.spills
+        assert rep.prefix["spills"] == spill_serve["pc"].spills
+        rows = rep.per_request
+        assert any(row["tier_bytes"] > 0 for row in rows)
+
+    def test_journal_replay_identity_spill_heavy(self, spill_serve):
+        """The black-box bar: the spill-heavy serve's decision stream
+        — tier_transfer records included — replays bit-exactly."""
+        recs = spill_serve["journal"].records()
+        assert any(r["kind"] == "tier_transfer" for r in recs)
+        res = replay_serve(recs, params=spill_serve["params"])
+        assert res.identical, (res.divergence, res.error)
+
+    def test_pool_drains_clean_after_cycles(self, spill_serve):
+        """Leak audit after spill/restore cycles: host pages are not
+        pool pages; clearing the cache returns everything."""
+        pc, eng = spill_serve["pc"], spill_serve["eng"]
+        pc.clear()
+        assert eng.pager.leak_report() == []
+        assert pc.pages_held == 0
+
+
+# ---------------------------------------------------------------------------
+# the audited sync contract over the tiered loop
+# ---------------------------------------------------------------------------
+
+
+class TestTieredSyncAudit:
+    def test_tiered_serve_one_fetch_per_segment(self, tiny):
+        """flagged == [], allowed == segment fetches EXACTLY: the D2H
+        staging rides the per-segment event fetch (no extra allowed
+        label, no extra count) and restores are dispatches."""
+        from paddle_tpu.analysis import SyncAudit
+
+        cfg, params = tiny
+        arr = _tenant_trace(cfg, seed=13)
+        eng, pc = _mk(cfg, params)
+        sch = OnlineScheduler(eng, seg_steps=12, prefix_cache=pc)
+        sch.serve(arr)                   # warm (compiles outside audit)
+        sch.results()
+        eng.reset_slots()
+        pc.reset()
+        sch._reqs.clear()
+        with SyncAudit() as audit:
+            audit.phase = "serve"
+            rep = sch.serve(arr)
+        assert audit.flagged("serve") == [], audit.flagged("serve")
+        assert audit.allowed("serve") == {
+            "serving.segment_event_fetch": rep.segments}
+        assert pc.spills > 0 and pc.restores > 0  # the loop WAS tiered
+
+
+# ---------------------------------------------------------------------------
+# capacity plane: the tier dimension
+# ---------------------------------------------------------------------------
+
+
+class TestTierCapacity:
+    def test_reclaimable_tier_dimension(self, tiny):
+        cfg, params = tiny
+        eng, pc = _mk(cfg, params, num_pages=21)
+        rng = np.random.RandomState(5)
+        p = rng.randint(0, cfg.vocab_size, (32,)).astype(np.int32)
+        eng.add_request(p, 4)
+        while eng._queue or eng.free_slot_count() < eng.slots:
+            eng.run_segment(16, prefix_cache=pc)
+        eng.collect_finished()
+        held = pc.pages_held
+        assert held > 0
+        assert pc.reclaimable_pages() == held
+        assert pc.reclaimable_pages(tier="host") == 0   # not yet staged
+        # one more segment boundary materialises the stage; spill all
+        eng.add_request(p[:8], 2)
+        while eng._queue or eng.free_slot_count() < eng.slots:
+            eng.run_segment(16, prefix_cache=pc)
+        eng.collect_finished()
+        assert pc.spillable_pages() > 0                 # clean now
+        pc.evict_until(eng.pager.num_pages)             # spill everything
+        assert pc.pages_held < held or pc.spills > 0
+        assert pc.reclaimable_pages(tier="host") == pc.host_pages > 0
+        assert pc.reclaimable_pages(tier="all") == \
+            pc.reclaimable_pages() + pc.host_pages
+        pc.clear()
+        assert eng.pager.leak_report() == []
+
+    def test_capacity_monitor_avail_by_tier(self):
+        from paddle_tpu.observability import CapacityMonitor
+
+        cap = CapacityMonitor()
+        cap.begin_segment(10, 4, host_pages=20)
+        rec = cap.report()
+        assert rec["avail_pages"] == 14                 # hbm term unchanged
+        assert rec["avail_by_tier"] == {"hbm": 14, "host": 20}
+        cap.begin_segment(8, 2)                         # host term sticky
+        assert cap.report()["avail_by_tier"]["host"] == 20
+        cap.reset()
+        assert cap.report()["avail_by_tier"]["host"] is None
+
+    def test_scheduler_feeds_host_dimension(self, spill_serve):
+        """The monitored tiered serve reports the host axis (wired in
+        OnlineScheduler.begin_segment)."""
+        from paddle_tpu.observability import CapacityMonitor
+
+        cfg_rep = spill_serve["rep"]
+        assert cfg_rep.tiers["pages_host"] >= 0
+        # direct wiring check on a short serve
+        eng, pc = _mk(spill_serve["eng"].cfg, spill_serve["params"])
+        cap = CapacityMonitor()
+        sch = OnlineScheduler(eng, seg_steps=12, prefix_cache=pc,
+                              capacity_monitor=cap)
+        sch.serve(_tenant_trace(eng.cfg, seed=23, tenants=2, rounds=2))
+        assert cap.report()["avail_by_tier"]["host"] is not None
+
+
+# ---------------------------------------------------------------------------
+# fleet directory: steering + migration-on-miss
+# ---------------------------------------------------------------------------
+
+
+def _fleet(cfg, params, n=2):
+    from paddle_tpu.inference.fleet import FleetRouter, build_fleet
+
+    engines = build_fleet(cfg, params, n, slots=2, max_len=96,
+                          prompt_buckets=(8, 16, 32), paged=True,
+                          page_size=16)
+    pcs = [PagedPrefixCache(e.pager, capacity_pages=16,
+                            host_tier=HostTier(e.pager,
+                                               capacity_pages=64))
+           for e in engines]
+    return FleetRouter(engines, seg_steps=16, prefix_caches=pcs,
+                       directory=True)
+
+
+def _hot_trace(cfg, pref, n, seed=3, gen=6):
+    rng = np.random.RandomState(seed)
+    return [Arrival(0.0, np.concatenate(
+        [pref, rng.randint(0, cfg.vocab_size, (8,)).astype(np.int32)]),
+        gen) for _ in range(n)]
+
+
+class TestCacheDirectory:
+    def test_steering_routes_to_owner(self, tiny, tmp_path):
+        """A hot prefix's owner takes the repeat wave as 'directory'
+        dispatches — never a silent least-loaded miss to the other
+        replica — and the journaled candidate ranking carries each
+        replica's directory-hit rows + tier."""
+        cfg, params = tiny
+        router = _fleet(cfg, params)
+        rng = np.random.RandomState(11)
+        pref = rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+        router.serve(_hot_trace(cfg, pref, 4))     # wave 1: populate
+        owner = next(r for r in router._replicas
+                     if r.prefix_cache.stats()["entries"] > 0)
+        j = journal.Journal(str(tmp_path))
+        with journal.attach(j):
+            rep = router.serve(_hot_trace(cfg, pref, 4, seed=5))
+        j.close()
+        assert rep.dispatches_directory > 0
+        assert rep.directory["hits"] > 0
+        # every steered request landed on the factual owner
+        for r in router._replicas:
+            if r.idx != owner.idx:
+                assert r.dispatches["directory"] == 0
+        recs = journal.read_journal(str(tmp_path))["records"]
+        cands = [r["candidates"] for r in recs if r["kind"] == "dispatch"
+                 and r.get("candidates")]
+        assert cands
+        steered = [c for cl in cands for c in cl if c["dir_hit"] > 0]
+        assert steered and all(c["dir_tier"] in ("hbm", "clean", "host")
+                               for c in steered)
+        assert router.leak_report() == []
+
+    def test_migration_on_miss_imports(self, tiny):
+        """Owner unhealthy -> the fallback replica IMPORTS the host
+        bytes and serves the prefix from its own restored pages instead
+        of recomputing the prefill."""
+        cfg, params = tiny
+        router = _fleet(cfg, params)
+        rng = np.random.RandomState(17)
+        pref = rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+        router.serve(_hot_trace(cfg, pref, 4, seed=19))
+        owner = next(r for r in router._replicas
+                     if r.prefix_cache.stats()["entries"] > 0)
+        assert owner.prefix_cache.host_tier.stages > 0  # staged = portable
+        owner.set_health("suspect")
+        rep = router.serve(_hot_trace(cfg, pref, 3, seed=29))
+        other = router._replicas[1 - owner.idx]
+        assert rep.tier_migrations > 0
+        assert other.prefix_cache.host_tier.imports > 0
+        assert other.prefix_cache.restores > 0          # import then restore
+        assert other.prefix_cache.hits > 0              # NOT recomputed
+        owner.set_health("healthy")
+        assert router.leak_report() == []
+
+    def test_fleet_loop_sync_audit_with_tiers(self, tiny):
+        """The tiered FLEET loop: flagged == [], allowed == primary
+        segment fetches exactly (stage gathers ride them)."""
+        from paddle_tpu.analysis import SyncAudit
+
+        cfg, params = tiny
+        router = _fleet(cfg, params)
+        rng = np.random.RandomState(31)
+        pref = rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+        arr = _hot_trace(cfg, pref, 6, seed=37)
+        router.serve(arr)                     # warm
+        router.reset()
+        with SyncAudit() as audit:
+            audit.phase = "serve"
+            rep = router.serve(arr)
+        assert audit.flagged("serve") == [], audit.flagged("serve")
+        assert audit.allowed("serve") == {
+            "serving.segment_event_fetch": rep.segments}
+
+    def test_healthz_and_capacity_tiers_breakdown(self, tiny):
+        """The operator satellite: /healthz pages gain the tier split
+        and /capacity per-replica sections carry tier stats + the
+        directory's state."""
+        import json as _json
+        import urllib.request
+
+        from paddle_tpu.observability import OpsServer
+
+        cfg, params = tiny
+        router = _fleet(cfg, params)
+        rng = np.random.RandomState(41)
+        pref = rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+        router.serve(_hot_trace(cfg, pref, 4, seed=43))
+        with OpsServer(port=0, fleet=router) as srv:
+            with urllib.request.urlopen(srv.url + "/healthz",
+                                        timeout=5) as r:
+                health = _json.loads(r.read())
+            with urllib.request.urlopen(srv.url + "/capacity",
+                                        timeout=5) as r:
+                capacity = _json.loads(r.read())
+        for idx in ("0", "1"):
+            t = health["pages"][idx]["tiers"]
+            assert set(t) >= {"host_pages", "spills", "restores",
+                              "imports", "bytes_staged", "bytes_restored"}
+            assert "tiers" in capacity["replicas"][idx]
+        assert capacity["directory"]["entries"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# unit mechanics: HostTier + the ambient TierMeter
+# ---------------------------------------------------------------------------
+
+
+class TestHostTierUnit:
+    def test_stage_flush_restore_mechanics(self, tiny):
+        cfg, params = tiny
+        eng, pc = _mk(cfg, params, num_pages=21)
+        tier = pc.host_tier
+        pgr = eng.pager
+        rng = np.random.RandomState(47)
+        toks = rng.randint(0, cfg.vocab_size, (32,)).astype(np.int32)
+        pages, _ = pgr.reserve(32)            # a fake live span
+        pc.insert(toks, pages)                # queues the stage
+        assert tier.stats()["pending_stages"] == 1
+        tier.flush()                          # out-of-loop materialise
+        assert tier.has(toks.tobytes()) and tier.pages_host == 2
+        assert tier.bytes_to_host == 2 * page_bytes(pgr)
+        pgr.release_pages(pages)              # span retires
+        pc.evict_until(pgr.num_pages)         # -> spill (clean)
+        assert pc.spills == 1 and pc.pages_held == 0
+        m = pc.match(np.concatenate(
+            [toks, rng.randint(0, cfg.vocab_size, (8,)).astype(np.int32)]))
+        assert m is not None and m.tier == "host" and m.pages == []
+        restored = pc.restore(m.key, m.length)
+        assert restored and len(restored) == m.length // 16
+        assert pc.restores == 1 and tier.bytes_to_hbm > 0
+        pc.clear()
+        assert pgr.leak_report() == []
+
+    def test_host_capacity_bounds_and_validation(self, tiny):
+        cfg, params = tiny
+        eng, _ = _mk(cfg, params, tiered=False, num_pages=21)
+        with pytest.raises(ValueError, match="capacity_pages"):
+            HostTier(eng.pager, capacity_pages=0)
+        tier = HostTier(eng.pager, capacity_pages=3)
+        rng = np.random.RandomState(53)
+        for i in range(3):
+            k = np.zeros((cfg.num_layers, 2, 16, cfg.num_kv_heads,
+                          cfg.head_dim), np.float32)
+            tier.note_import(f"k{i}".encode(), k, k, 2)
+        assert tier.pages_host <= 3 + 2       # LRU dropped the oldest
+        assert tier.host_evictions >= 1
+
+    def test_tier_meter_ambient_install(self, tiny):
+        """--tiers on|off substrate: the meter observes segments + tier
+        pool events ambiently and detaches clean."""
+        from paddle_tpu.inference import kv_tiers, paged_kv, serving
+
+        cfg, params = tiny
+        meter = TierMeter()
+        kv_tiers.install(meter)
+        kv_tiers.install(meter)               # idempotent
+        try:
+            eng, pc = _mk(cfg, params)
+            sch = OnlineScheduler(eng, seg_steps=12, prefix_cache=pc)
+            sch.serve(_tenant_trace(cfg, seed=59, tenants=2, rounds=2))
+        finally:
+            kv_tiers.uninstall(meter)
+        assert meter.segments >= 1
+        assert meter.events.get("tier_stage", 0) >= 1
+        assert meter.on_pool not in paged_kv.POOL_HOOKS
+        assert meter.on_segment not in serving.SEGMENT_HOOKS
+
+    def test_gate_bit_identity_tiers_on_off(self):
+        """Budgets bit-identical with the tier meter ambient-attached
+        (--tiers on|off), pinned on the paged canonical program."""
+        from paddle_tpu.analysis import auditor, budgets, programs
+        from paddle_tpu.inference import kv_tiers
+
+        handle = programs.build("paged_serving_segment")
+
+        def audit(attach):
+            meter = TierMeter() if attach else None
+            if meter is not None:
+                kv_tiers.install(meter)
+            try:
+                return auditor.audit_replay("paged_serving_segment",
+                                            handle.replay, replays=2)
+            finally:
+                if meter is not None:
+                    kv_tiers.uninstall(meter)
+
+        rep_on = audit(True)
+        rep_off = audit(False)
+        rep_on.merge(auditor.audit_static(
+            "paged_serving_segment", handle.hlo(),
+            donation_threshold=handle.donation_threshold,
+            expected_undonated=handle.expected_undonated))
+        assert budgets.check(rep_on) == [], rep_on.format()
+        for key in ("host_syncs_flagged", "host_syncs_allowed",
+                    "warm_compiles"):
+            assert rep_on.metrics[key] == rep_off.metrics[key], (
+                key, rep_on.metrics[key], rep_off.metrics[key])

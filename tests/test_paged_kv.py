@@ -495,16 +495,27 @@ class TestPreemptFailoverLeakGuard:
         schedule interleaves admissions, serving segments, priority
         preemptions (with and without prefix-cache parking), and
         full-engine aborts (the failover teardown); the allocator
-        invariant holds at every step and everything drains clean."""
+        invariant holds at every step and everything drains clean.
+
+        r19 (ISSUE 14 satellite): the cache carries a HOST TIER, and
+        the schedule gains forced spill passes (``evict_until`` over
+        the whole pool) — staging rides the segments the schedule
+        already runs, restores happen on whatever hits follow, and the
+        leak audit must stay clean through arbitrary interleavings of
+        spill/restore with preempt/abort."""
+        from paddle_tpu.inference.kv_tiers import HostTier
+
         cfg, params = tiny
         eng = ServingEngine(cfg, params, slots=2, max_len=96,
                             prompt_buckets=(8, 16, 32), paged=True,
                             page_size=16, chunked_prefill=True,
                             prefill_chunks=(8,))
-        pc = PagedPrefixCache(eng.pager, capacity_pages=16)
+        pc = PagedPrefixCache(eng.pager, capacity_pages=16,
+                              host_tier=HostTier(eng.pager,
+                                                 capacity_pages=32))
         rng = np.random.RandomState(3)
         for step in range(40):
-            op = rng.randint(4)
+            op = rng.randint(5)
             if op == 0 and len(eng._queue) < 4:          # admit
                 eng.add_request(
                     rng.randint(0, cfg.vocab_size,
@@ -530,14 +541,19 @@ class TestPreemptFailoverLeakGuard:
                 pc.reset()                               # failover path
                 for r in orphans:                        # requeue all
                     eng._queue.append(r)
+            elif op == 4:                                # forced spill
+                pc.evict_until(eng.pager.num_pages)
             assert eng.pager.allocator.check() == [], \
                 f"allocator invariant broke at step {step}"
         while eng._queue or eng.free_slot_count() < eng.slots:
             eng.run_segment(16, prefix_cache=pc)
         for r in eng._finished:
             assert r.done
+        # r19: the spill/restore cycles above must leave the pool
+        # accountable — cache-held pages reconcile and clear drains all
         pc.clear()
         assert eng.pager.leak_report() == []
+        assert pc.host_tier.stats()["pending_stages"] == 0
 
 
 class TestPagedSchedulerAudit:
