@@ -2330,6 +2330,139 @@ def run_tiered(model_name, cfg, params, llama, n=42, seed=0, slots=2,
     }
 
 
+# ---------------------------------------------------------------------------
+# program-space coverage + AOT warmup (r20, ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def run_aot(model_name, cfg, params, llama, n=20, seed=0, slots=4,
+            seg_steps=16, page_size=16):
+    """The scale-up latency certificate (ISSUE 15c; ROADMAP item 4's
+    unblock): a fresh replica either pays its XLA compiles at first
+    traffic (the no-AOT baseline — cold_start spans the first segment
+    compile) or compiles the FULL statically enumerated program space
+    at build (``aot_warmup``) and then serves a mixed trace — chunked
+    prefill + prefix cache + preemption + failover abort/resume — with
+    ZERO backend compiles, enforced by the hard
+    ``recompile.enforce_zero_compiles`` budget. The cold-start gauge
+    splits into ``aot_warmup_s + first_token_s``; tokens are identical
+    AOT on|off; the coverage differential (enumerated vs used) comes
+    out clean."""
+    import jax
+
+    from paddle_tpu.analysis import coverage, recompile
+    from paddle_tpu.inference import serving as _serving
+    from paddle_tpu.inference.prefix_cache import make_prefix_cache
+    from paddle_tpu.inference.scheduler import (OnlineScheduler,
+                                                staggered_arrivals)
+    from paddle_tpu.inference.serving import (ServingEngine,
+                                              WorkloadEnvelope)
+
+    arr = staggered_arrivals(seed + 1, n, 0.01, cfg.vocab_size,
+                             prompt_lens=_ONLINE_PLENS,
+                             gen_lens=_ONLINE_GLENS)
+    env = WorkloadEnvelope(max_prompt=max(_ONLINE_PLENS),
+                           max_new_tokens=max(_ONLINE_GLENS),
+                           seg_steps=(seg_steps,),
+                           prefix_block=page_size)
+
+    def build():
+        eng = ServingEngine(cfg, params, slots=slots, max_len=256,
+                            prompt_buckets=(32, 64, 128), paged=True,
+                            page_size=page_size, chunked_prefill=True,
+                            prefill_chunks=(16, 32))
+        return eng, make_prefix_cache(eng)
+
+    def mixed_drill(eng, pc):
+        """Preempt + failover on top of the scheduler trace — the mixed
+        tail every certificate run exercises inside the compile watch."""
+        rng = np.random.RandomState(seed + 2)
+        for _ in range(3):
+            eng.add_request(rng.randint(0, cfg.vocab_size, (64,)), 8)
+        eng.run_segment(seg_steps, prefix_cache=pc)
+        for s in range(eng.slots):
+            if eng._active[s] is not None and eng.can_preempt(s):
+                eng._queue.insert(0, eng.preempt_slot(s, pc))
+                break
+        eng.dispatch_segment(seg_steps, prefix_cache=pc)
+        orphans = eng.abort()                  # replica failure
+        eng._queue.extend(orphans)             # ...resumed in place
+        while eng._queue or eng.free_slot_count() < eng.slots:
+            eng.run_segment(seg_steps, prefix_cache=pc)
+
+    saved = dict(_serving._SHARED_PROGS)
+    try:
+        # --- no-AOT baseline: a fresh replica pays compiles at traffic
+        _serving._SHARED_PROGS.clear()
+        eng0, pc0 = build()
+        sch0 = OnlineScheduler(eng0, seg_steps=seg_steps,
+                               prefix_cache=pc0)
+        rep0 = sch0.serve(arr)
+        out0 = sch0.results()
+        cold_no_aot = eng0.cold_start_s
+        log(f"no-AOT replica: cold_start {cold_no_aot:.2f}s (first "
+            f"token paid the mid-serve compiles)")
+
+        # --- AOT replica: full ladder at build, zero compiles after
+        _serving._SHARED_PROGS.clear()
+        eng1, pc1 = build()
+        fam_report = eng1.aot_warmup(env, prefix_cache=pc1)
+        sch1 = OnlineScheduler(eng1, seg_steps=seg_steps,
+                               prefix_cache=pc1)
+        with recompile.enforce_zero_compiles(
+                "AOT-warmed mixed serve") as cw:
+            rep1 = sch1.serve(arr)
+            mixed_drill(eng1, pc1)
+        out1 = sch1.results()
+        crep = coverage.coverage_report(eng1, env)
+        tokens_identical = all(out1[r] == out0[r] for r in out0)
+        log(f"AOT replica: warmup {eng1.aot_warmup_s:.2f}s over "
+            f"{crep.program_space_size} enumerated keys, first_token "
+            f"{eng1.first_token_s:.3f}s, post-warmup compiles "
+            f"{cw.compiles}, coverage "
+            f"{'clean' if crep.ok else 'VIOLATED'}")
+    finally:
+        _serving._SHARED_PROGS.clear()
+        _serving._SHARED_PROGS.update(saved)
+
+    headline = {
+        "program_space_keys": crep.program_space_size,
+        "aot_warmup_s": round(eng1.aot_warmup_s, 4),
+        "first_token_s": round(eng1.first_token_s, 4),
+        "cold_start_no_aot_s": round(cold_no_aot, 4),
+        "post_warmup_compiles": cw.compiles,
+        "zero_mid_serve_compiles": cw.compiles == 0,
+        "coverage_clean": crep.ok,
+        "tokens_identical": tokens_identical,
+        "pass": (cw.compiles == 0 and crep.ok and tokens_identical),
+    }
+    return {
+        "metric": "serving_aot_coverage",
+        "model": model_name,
+        "platform": jax.default_backend(),
+        "seed": seed,
+        "n_requests": n,
+        "envelope": {"max_prompt": env.max_prompt,
+                     "max_new_tokens": env.max_new_tokens,
+                     "seg_steps": list(env.seg_steps),
+                     "prefix_block": env.prefix_block,
+                     "resume": env.resume},
+        "families": {f: {"keys": d["keys"],
+                         "seconds": round(d["seconds"], 4)}
+                     for f, d in fam_report.items()},
+        "dead_ladder_entries": [
+            {"key": repr(k), "compile_s": round(s, 4)}
+            for k, s in crep.unreached],
+        "no_aot": {"cold_start_s": round(cold_no_aot, 4),
+                   "throughput_tok_s": round(rep0.throughput_tok_s, 1)},
+        "aot": {"aot_warmup_s": round(eng1.aot_warmup_s, 4),
+                "first_token_s": round(eng1.first_token_s, 4),
+                "cold_start_s": round(eng1.cold_start_s, 4),
+                "throughput_tok_s": round(rep1.throughput_tok_s, 1)},
+        "headline": headline,
+        "telemetry": _telemetry_section(),
+    }
+
+
 def smoke():
     """Tier-1 scheduler gate: serve a deterministic staggered trace on the
     tiny config and return an evidence dict the test asserts on — engine
@@ -2426,6 +2559,7 @@ def main():
     ap.add_argument("--shadow", action="store_true")
     ap.add_argument("--capacity", action="store_true")
     ap.add_argument("--tiered", action="store_true")
+    ap.add_argument("--aot", action="store_true")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--model", default="auto",
                     choices=("auto", "base", "small", "tiny"))
@@ -2471,6 +2605,9 @@ def main():
     elif args.tiered:
         print(json.dumps(run_tiered(model_name, cfg, params, llama,
                                     n=args.n)))
+    elif args.aot:
+        print(json.dumps(run_aot(model_name, cfg, params, llama,
+                                 n=min(args.n, 20))))
     elif args.failover:
         print(json.dumps(run_failover(model_name, cfg, params, llama)))
     elif args.fleet:

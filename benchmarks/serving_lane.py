@@ -157,6 +157,13 @@ def main() -> int:
         # bit-exact journal replay, and the 2-replica directory
         # steering + migration-on-miss sub-run
         "tiered": _run_json("llama_serving.py", args=("--tiered",)),
+        # r20 (ISSUE 15): program-space coverage + AOT warmup — the
+        # fresh-replica scale-up certificate: full enumerated ladder
+        # compiled at build, zero backend compiles over the mixed
+        # serve (chunked + prefix + preempt + failover), cold-start
+        # split into aot_warmup_s + first_token_s, tokens identical
+        # AOT on|off, enumerated-vs-used differential clean
+        "aot": _run_json("llama_serving.py", args=("--aot",)),
     }
     result["platform"] = result["online"].get("platform", "unknown")
     # r10: lift each mode's runtime-telemetry headline (queue depth,
@@ -237,6 +244,11 @@ def main() -> int:
     # hit-rate + TTFT vs the §3n model, the tier-transfer budget, the
     # one-fetch audit, replay identity and directory steering
     result["tiered_headline"] = result["tiered"].get("headline")
+    # r20 (ISSUE 15): lift the AOT/coverage headline — the
+    # zero-mid-serve-compile certificate + the measured scale-up split
+    # (aot_warmup_s + first_token_s vs the no-AOT cold start) a
+    # reviewer (and the item-4 autoscaler) checks first
+    result["aot_headline"] = result["aot"].get("headline")
     path = os.path.join(ROOT, f"SERVING_r{rnd:02d}.json")
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
@@ -244,7 +256,7 @@ def main() -> int:
     ok = all(result[k].get("rc") == 0
              for k in ("decode", "serving", "online", "prefix", "paged",
                        "fleet", "overload", "failover", "slo", "spec",
-                       "quality", "capacity", "tiered"))
+                       "quality", "capacity", "tiered", "aot"))
     return 0 if ok else 1
 
 

@@ -94,6 +94,12 @@ TPU_TEST_FILES = [
     # migration-on-miss, the tier-transfer budget pass, and journal
     # replay of a spill-heavy serve, all against real D2H/H2D copies
     "tests/test_kv_tiers.py",
+    # r20 (ISSUE 15): program-space coverage — registry-only key
+    # construction, the envelope reachability proof, AOT warmup with
+    # the zero-post-warmup-compile budget over the mixed workload, and
+    # the persistent-cache warm-restart interplay, against REAL XLA:TPU
+    # compiles (the 2.5 s class this whole subsystem exists to bound)
+    "tests/test_program_coverage.py",
 ]
 
 

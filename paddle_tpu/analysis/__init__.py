@@ -39,27 +39,60 @@ Quick use::
 
 from __future__ import annotations
 
-from . import budgets, hlo, programs, recompile, syncs, tiers
+from . import budgets, coverage, hlo, programs, recompile, syncs, tiers
 from .auditor import AuditReport, Finding, audit_fn, audit_replay, audit_static
-from .recompile import CompileWatch, lint_cache_keys, live_cache_report
+from .coverage import coverage_report, lint_registry_only
+from .recompile import (CompileBudgetError, CompileWatch,
+                        enforce_zero_compiles, lint_cache_keys,
+                        live_cache_report)
 from .syncs import SyncAudit, allowed_sync
 from .tiers import tier_transfer_audit, tiered_serve_audit
 
 __all__ = [
     "AuditReport", "Finding", "SyncAudit", "allowed_sync", "CompileWatch",
-    "lint_cache_keys", "live_cache_report", "audit_fn", "audit_replay",
-    "audit_static", "audit_program", "budgets", "hlo", "programs",
-    "recompile", "syncs", "tiers", "tier_transfer_audit",
-    "tiered_serve_audit",
+    "CompileBudgetError", "enforce_zero_compiles", "lint_cache_keys",
+    "live_cache_report", "audit_fn", "audit_replay", "audit_static",
+    "audit_program", "budgets", "coverage", "coverage_report",
+    "lint_registry_only", "hlo", "programs", "recompile", "syncs",
+    "tiers", "tier_transfer_audit", "tiered_serve_audit",
 ]
 
 
-def audit_program(name: str, replays: int = 2) -> AuditReport:
-    """Build + audit one canonical program (static + dynamic passes)."""
+def audit_program(name: str, replays: int = 2,
+                  aot: bool = False) -> AuditReport:
+    """Build + audit one canonical program (static + dynamic passes).
+
+    ``aot=True`` (the gate's ``--aot on``, r20): for serving programs,
+    lint registry-only key construction, prove the envelope
+    enumeration, and compile the FULL program space before the audit —
+    then diff enumerated-vs-used after it. An unenumerated compile is a
+    coverage hazard (a budget violation); an unused ladder entry is an
+    info finding with its compile-seconds attributed. Budget metrics
+    must come out bit-identical either way: warmup only moves compiles
+    ahead of the audit's own warm phase."""
     handle = programs.build(name)
+    aot_info = None
+    if aot and handle.aot_engine is not None:
+        aot_info = coverage.aot_audit(handle.aot_engine,
+                                      handle.aot_envelope)
     rep = audit_static(name, handle.hlo(), mesh=handle.mesh,
                        donation_threshold=handle.donation_threshold,
                        expected_undonated=handle.expected_undonated,
                        allowed_axes=handle.allowed_axes)
     rep.merge(audit_replay(name, handle.replay, replays=replays))
+    if aot_info is not None:
+        rep.metrics["program_space_keys"] = aot_info["program_space_keys"]
+        rep.metrics["aot_warmup_s"] = aot_info["aot_warmup_s"]
+        rep.metrics["aot_families"] = aot_info["families"]
+        crep = coverage.coverage_report(handle.aot_engine,
+                                        handle.aot_envelope)
+        for k in crep.unenumerated:
+            rep.add("coverage", "hazard",
+                    f"unenumerated compile {k} — a program key escaped "
+                    f"the declared envelope (the mid-serve-compile "
+                    f"class)", k)
+        for k, s in crep.unreached:
+            rep.add("coverage", "info",
+                    f"dead ladder weight: {k} unused after warmup "
+                    f"(aot compile cost {s:.3f}s)", k)
     return rep

@@ -52,6 +52,21 @@ Usage::
                                                   # JSONL), budgets
                                                   # bit-identical to
                                                   # --journal off
+    python -m paddle_tpu.analysis --gate --aot on # (default) the r20
+                                                  # contract: program-space
+                                                  # coverage + AOT warmup —
+                                                  # registry-only key lint,
+                                                  # envelope reachability
+                                                  # proof, the FULL
+                                                  # enumerated ladder
+                                                  # compiled before each
+                                                  # serving audit, and the
+                                                  # enumerated-vs-used
+                                                  # differential after it
+                                                  # (unenumerated compile =
+                                                  # violation); budgets
+                                                  # bit-identical to
+                                                  # --aot off
 """
 
 from __future__ import annotations
@@ -153,6 +168,13 @@ def main(argv=None) -> int:
                          "journal attached (flight superset + decision-"
                          "clock JSONL recording) — budgets must be "
                          "bit-identical to --journal off")
+    ap.add_argument("--aot", choices=("on", "off"), default="on",
+                    help="r20 program-space coverage: lint registry-only "
+                         "key construction, prove the envelope "
+                         "enumeration, AOT-compile the full ladder "
+                         "before each serving audit and diff "
+                         "enumerated-vs-used after — budgets must be "
+                         "bit-identical to --aot off")
     args = ap.parse_args(argv)
 
     from .. import observability
@@ -187,12 +209,30 @@ def main(argv=None) -> int:
         tmeter = kv_tiers.TierMeter()
         kv_tiers.install(tmeter)
         print("tier meter attached on POOL_HOOKS + SEGMENT_HOOKS")
+    lint = []
+    if args.aot == "on":
+        from . import coverage as _coverage
+
+        lint = _coverage.lint_registry_only()
+        if lint:
+            for v in lint:
+                print(f"  !! {v}")
+        else:
+            print("coverage lint: registry-only key construction clean "
+                  "(serving/scheduler/fleet)")
     targets = args.program or programs.names()
     results = []
     any_violation = False
+    aot_total_keys = 0
+    aot_total_s = 0.0
     for name in targets:
-        rep = audit_program(name, replays=args.replays)
+        rep = audit_program(name, replays=args.replays,
+                            aot=args.aot == "on")
         violations = budgets.check(rep)
+        if args.aot == "on" and lint:
+            violations = violations + [
+                f"program-key construction outside the registry "
+                f"({len(lint)} sites)"]
         any_violation |= bool(violations)
         results.append({
             "program": name,
@@ -201,6 +241,15 @@ def main(argv=None) -> int:
             "violations": violations,
         })
         print(rep.format())
+        if "program_space_keys" in rep.metrics:
+            fams = rep.metrics["aot_families"]
+            aot_total_keys += rep.metrics["program_space_keys"]
+            aot_total_s += rep.metrics["aot_warmup_s"]
+            print("  aot: program space "
+                  f"{rep.metrics['program_space_keys']} keys, warmup "
+                  f"{rep.metrics['aot_warmup_s']:.3f}s ("
+                  + ", ".join(f"{f}: {d['keys']} keys {d['seconds']:.3f}s"
+                              for f, d in sorted(fams.items())) + ")")
         if violations:
             print("  BUDGET VIOLATIONS:")
             for v in violations:
@@ -208,6 +257,11 @@ def main(argv=None) -> int:
         else:
             print("  budget: OK")
         print()
+    if args.aot == "on" and aot_total_keys:
+        print(f"aot summary: {aot_total_keys} enumerated program keys "
+              f"compiled ahead of time in {aot_total_s:.3f}s across "
+              f"{sum(1 for r in results if 'program_space_keys' in r['metrics'])} "
+              f"serving programs")
 
     if tmeter is not None:
         from ..inference import kv_tiers

@@ -252,4 +252,12 @@ def check(report, budget: Optional[Budget] = None) -> List[str]:
                if f.pass_name == "collective" and f.severity == "hazard"]
         if bad:
             v.append(f"{len(bad)} collective hazards: {bad[0].message}")
+
+    # r20 (ISSUE 15): an unenumerated compile is unconditionally a
+    # violation — a program key outside the declared envelope IS the
+    # 2.5 s mid-serve-compile class, whatever the other budgets say
+    cov = [f for f in report.findings
+           if f.pass_name == "coverage" and f.severity == "hazard"]
+    if cov:
+        v.append(f"{len(cov)} coverage hazards: {cov[0].message}")
     return v

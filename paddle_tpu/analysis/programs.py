@@ -56,6 +56,14 @@ class ProgramHandle:
     allowed_axes: Optional[Tuple[str, ...]] = None
     notes: str = ""
     keepalive: tuple = ()           # pins models/engines for the handle's life
+    # r20 (ISSUE 15): the serving programs carry their engine + the
+    # workload envelope their replay stays inside, so the gate's --aot
+    # mode can lint/enumerate/warm the full program space before the
+    # audit and diff enumerated-vs-used after it (budgets must come out
+    # bit-identical --aot on|off — warmup only moves WHEN compiles
+    # happen, never what the warm replay does)
+    aot_engine: Any = None
+    aot_envelope: Any = None
 
 
 CANONICAL: Dict[str, Callable[[], ProgramHandle]] = {}
@@ -87,6 +95,20 @@ def _memo(fn):
             box.append(fn())
         return box[0]
     return wrapped
+
+
+def _gate_envelope(seg_steps, max_prompt: int = 12,
+                   max_new_tokens: int = 4):
+    """The workload envelope the gate's canonical serving replays stay
+    inside (12-token prompts, short generations, one seg_steps value —
+    exactly what each ``replay()`` enqueues). ``--aot on`` enumerates +
+    compiles this space up front and diffs it against what the audit
+    replays actually use."""
+    from paddle_tpu.inference.program_space import WorkloadEnvelope
+
+    return WorkloadEnvelope(max_prompt=max_prompt,
+                            max_new_tokens=max_new_tokens,
+                            seg_steps=tuple(seg_steps))
 
 
 # ---------------------------------------------------------------------------
@@ -186,6 +208,8 @@ def _build_decode_tick() -> ProgramHandle:
         donation_threshold=1 << 16,
         expected_undonated=(),
         notes="fused decode chunk (8 ticks), llama-tiny, 4 slots",
+        aot_engine=eng,
+        aot_envelope=_gate_envelope(seg_steps=(12,)),
         keepalive=(eng,))
 
 
@@ -231,6 +255,8 @@ def _build_serving_segment() -> ProgramHandle:
         donation_threshold=1 << 16,
         expected_undonated=(),
         notes="re-entrant fused segment + host event replay, llama-tiny",
+        aot_engine=eng,
+        aot_envelope=_gate_envelope(seg_steps=(12,)),
         keepalive=(eng,))
 
 
@@ -280,6 +306,8 @@ def _build_paged_serving_segment() -> ProgramHandle:
         expected_undonated=(),
         notes="paged re-entrant segment (page-table pool, COW-ready) + "
               "host event replay with page bookkeeping, llama-tiny",
+        aot_engine=eng,
+        aot_envelope=_gate_envelope(seg_steps=(12,)),
         keepalive=(eng,))
 
 
@@ -338,6 +366,8 @@ def _build_chunked_serving_segment() -> ProgramHandle:
         expected_undonated=(),
         notes="chunked-prefill paged segment (8-token chunks interleaved "
               "with decode ticks) + host event replay, llama-tiny",
+        aot_engine=eng,
+        aot_envelope=_gate_envelope(seg_steps=(16,)),
         keepalive=(eng,))
 
 
@@ -404,6 +434,8 @@ def _build_spec_serving_segment() -> ProgramHandle:
         expected_undonated=(),
         notes="speculative paged segment (K=3 n-gram draft, multi-token "
               "verified ticks) + host acceptance replay, llama-tiny",
+        aot_engine=eng,
+        aot_envelope=_gate_envelope(seg_steps=(16,), max_new_tokens=6),
         keepalive=(eng,))
 
 
@@ -465,6 +497,8 @@ def _build_quality_serving_segment() -> ProgramHandle:
         expected_undonated=(),
         notes="quality-digest paged segment (k=4 top-k logit digests "
               "in the event log) + host digest replay, llama-tiny",
+        aot_engine=eng,
+        aot_envelope=_gate_envelope(seg_steps=(12,)),
         keepalive=(eng,))
 
 
@@ -541,6 +575,8 @@ def _build_tp_serving_segment() -> ProgramHandle:
         allowed_axes=("mp",),
         notes=f"mp={mp} GSPMD-sharded re-entrant segment (column/row-"
               f"parallel weights, head-sharded KV cache), llama-tiny",
+        aot_engine=eng,
+        aot_envelope=_gate_envelope(seg_steps=(12,)),
         keepalive=(eng,))
 
 

@@ -22,10 +22,12 @@ bug visible before it costs a latency cliff:
 
 from __future__ import annotations
 
+import contextlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["CompileWatch", "lint_cache_keys", "live_cache_report"]
+__all__ = ["CompileWatch", "lint_cache_keys", "live_cache_report",
+           "CompileBudgetError", "enforce_zero_compiles"]
 
 
 class CompileWatch:
@@ -70,6 +72,38 @@ class CompileWatch:
     @property
     def since_mark(self) -> int:
         return self.compiles - self._baseline
+
+
+class CompileBudgetError(AssertionError):
+    """A backend compile happened inside a region pinned to zero."""
+
+
+@contextlib.contextmanager
+def enforce_zero_compiles(label: str = "post-warmup serve"):
+    """The hard zero-post-warmup-backend-compiles budget (r20,
+    ISSUE 15): after ``ServingEngine.aot_warmup`` has compiled the full
+    enumerated program space, a serve that stays inside its declared
+    :class:`~paddle_tpu.inference.program_space.WorkloadEnvelope` must
+    perform ZERO backend compiles over the whole mixed workload —
+    speculation, chunked prefill, preempt/resume, shedding, failover,
+    tiers and shadow included. Any compile inside the region raises
+    :class:`CompileBudgetError` (it IS the 2.5 s mid-serve latency
+    cliff, caught at test time instead of at p99)::
+
+        eng.aot_warmup(envelope)
+        with analysis.recompile.enforce_zero_compiles("mixed serve"):
+            scheduler.serve(trace)
+
+    Yields the underlying :class:`CompileWatch` so callers can inspect
+    the count mid-region."""
+    with CompileWatch() as cw:
+        yield cw
+        if cw.compiles:
+            raise CompileBudgetError(
+                f"{cw.compiles} backend compile(s) during {label} — the "
+                f"zero-post-AOT-warmup budget is 0 (a program shape "
+                f"escaped the declared envelope, or warmup missed an "
+                f"enumerated key)")
 
 
 @dataclass

@@ -44,7 +44,11 @@ __all__ = ["Config", "Predictor", "InferTensor", "create_predictor",
 # spill tier + tier-transfer accounting), ``from
 # paddle_tpu.inference.fleet import FleetRouter / build_fleet /
 # CacheDirectory / FaultInjector`` (r13: health states + failover;
-# r19: directed cache-hit steering) — kept
+# r19: directed cache-hit steering), ``from
+# paddle_tpu.inference.program_space import PROGRAM_SPACE /
+# WorkloadEnvelope`` (r20: the declared program-key registry behind
+# ``ServingEngine.program_space``/``aot_warmup`` and the
+# analysis.coverage gate pass) — kept
 # out of this namespace so importing the Predictor surface doesn't pull
 # jax model code.
 

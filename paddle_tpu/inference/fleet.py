@@ -601,6 +601,25 @@ class FleetRouter:
         self._finished_count = 0
         self._serve_t0 = 0.0
 
+    # --- AOT warmup (r20: ISSUE 15) --------------------------------------
+    def aot_warmup(self, envelope=None) -> Dict[int, dict]:
+        """Compile every replica's enumerated program space at build.
+        Identical-geometry replicas share one XLA compile per key
+        through ``serving._SHARED_PROGS`` — replica 0 pays the ladder,
+        the rest execute the already-compiled programs on empty state
+        (microseconds per key) — so a fleet scale-out's warmup cost is
+        per BINARY, not per replica (SCALING §3o). Each replica's
+        warmup runs under its scoped registry/rank so the
+        ``aot_warmup_s`` gauges land per rank like every other serving
+        metric."""
+        out: Dict[int, dict] = {}
+        for r in self._replicas:
+            with _metrics.scoped_registry(r.registry), \
+                    _journal.rank_scope(r.idx):
+                out[r.idx] = r.engine.aot_warmup(
+                    envelope, prefix_cache=r.prefix_cache)
+        return out
+
     # --- routing ---------------------------------------------------------
     def _affinity_key(self, prompt: np.ndarray) -> Optional[bytes]:
         """Block-aligned STRICT prefix bytes (the prefix caches' rule:

@@ -111,6 +111,27 @@ class HostTier:
     def has(self, key: bytes) -> bool:
         return key in self._host
 
+    def prewarm_transfers(self, max_pages: int) -> None:
+        """Compile the tier-transfer eager programs for every reachable
+        page count (r20, ISSUE 15): the stage gather and the restore
+        scatter are shape-keyed on the transferred page COUNT, which is
+        bounded by the envelope's longest cacheable prefix — executing
+        each count once here keeps the zero-post-warmup-compile budget
+        intact through spills and restores. State-neutral: the gather
+        reads page 0's rows, the scatter writes them back to a copy
+        that is immediately dropped."""
+        import jax.numpy as jnp
+
+        pool = self.pager.pool
+        for n in range(1, max(1, int(max_pages)) + 1):
+            idx = jnp.asarray([0] * n, jnp.int32)   # stage()'s exact aval
+            k = pool["k"][:, idx]
+            v = pool["v"][:, idx]
+            # upload()'s scatter: host rows arrive as numpy, transferred
+            # by jnp.asarray — replicate the aval chain then discard
+            _ = pool["k"].at[:, idx].set(jnp.asarray(np.asarray(k)))
+            _ = pool["v"].at[:, idx].set(jnp.asarray(np.asarray(v)))
+
     # --- D2H staging (write-through; materialises at the segment fetch) ---
     def stage(self, key: bytes, pages: List[int]) -> None:
         """Queue an async D2H copy of ``pages``'s pool rows. Dispatch

@@ -1,0 +1,465 @@
+"""Program-space registry: the serving bucket ladder as a declared,
+statically enumerable object (ISSUE 15 tentpole).
+
+Every compiled serving program is memoised under a small tuple key —
+``("pseg", n_pad, s_max, steps)`` and friends. Until r20 those tuples
+were constructed by hand at each jit call site in ``serving.py``, which
+made the program space *implicit*: the only way to know what a config
+could compile was to read the dispatch arithmetic, and the only way to
+catch a width that escaped the ladder (the 2.5 s mid-serve XLA compile
+class) was after it had already compiled (``analysis/recompile.py``'s
+after-the-fact lint). This module makes the space explicit:
+
+* each segment family registers its **key schema** (tag + axis names)
+  and an **enumerator** — the closed-form arithmetic mapping an engine
+  config + a declared :class:`WorkloadEnvelope` to the EXACT finite set
+  of keys that config can reach;
+* ``PROGRAM_SPACE.key(family, **axes)`` is the ONLY sanctioned key
+  constructor — ``analysis/coverage.py`` lints the serving/scheduler/
+  fleet ASTs for hand-built tagged tuples, so a new call site that
+  bypasses the registry fails tier-1 before it can float a width;
+* ``ServingEngine.program_space(envelope)`` returns the enumeration and
+  ``ServingEngine.aot_warmup(envelope)`` compiles all of it at build,
+  which is what turns the autoscaler's scale-up latency into a measured
+  ``aot_warmup_s + first_token_s`` pair instead of an XLA lottery.
+
+Key tuple formats are IDENTICAL to the hand-built r7–r17 tuples (tests
+pin exact keys; ``_SHARED_PROGS`` entries stay byte-compatible) — the
+registry changes who constructs them, never what they are.
+
+The chunk-cap arithmetic (``chunk_for``) lives here too: the runtime
+(``ServingEngine._prefill_chunk_for``) and the enumerator must agree on
+the ladder-to-chunk mapping or coverage would diverge from dispatch —
+one copy, imported by both.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, Iterable, List, Optional, \
+    Sequence, Tuple
+
+__all__ = ["WorkloadEnvelope", "ProgramFamily", "ProgramSpace",
+           "PROGRAM_SPACE", "FAMILY_TAGS", "chunk_for"]
+
+
+# how many chunk steps a full-width prefill may take (the admission-
+# throughput cap documented at ServingEngine._prefill_chunk_for — the
+# runtime delegates here so dispatch and enumeration share one copy)
+MAX_PREFILL_CHUNKS = 4
+
+
+def chunk_for(prefill_chunks: Sequence[int], s_max: int) -> int:
+    """Chunk width for an ``s_max``-wide admit window: the smallest
+    declared ladder entry that bounds a full-width prefill at
+    ``MAX_PREFILL_CHUNKS`` chunk steps (see the serving docstring for
+    why the cap exists). The single copy of the cap arithmetic — the
+    engine's ``_prefill_chunk_for`` and the ``cseg`` enumerator both
+    call this."""
+    for c in prefill_chunks:
+        if c * MAX_PREFILL_CHUNKS >= s_max:
+            return int(c)
+    return int(prefill_chunks[-1])
+
+
+def _pow2(n: int, lo: int = 1) -> int:
+    p = lo
+    while p < n:
+        p *= 2
+    return p
+
+
+@dataclass(frozen=True)
+class WorkloadEnvelope:
+    """The declared workload a serving deployment admits — the finite
+    input domain the program-space enumeration closes over.
+
+    * ``max_prompt`` — longest prompt a client may submit (must fit the
+      engine's largest bucket; ``add_request`` enforces the same bound
+      at intake, so the envelope is a declaration, not a hope).
+    * ``max_new_tokens`` — largest generation a client may request.
+    * ``seg_steps`` — every ``max_steps`` value the serve loop passes to
+      ``run_segment``/``dispatch_segment`` (the scheduler's control-
+      latency knob; ``ServingEngine.run()``'s drain loop uses
+      ``4 * chunk``).
+    * ``n_pads`` — the dispatch ``n_pad`` values; empty means the
+      engine default (``pow2(slots)``), which every shipped caller
+      uses.
+    * ``resume`` — whether preempt-resume / failover-requeue admissions
+      occur (they re-prefill prompt + generated-so-far, widening the
+      reachable admission-length range to ``max_prompt +
+      max_new_tokens - 1``; ``can_preempt`` caps it at the largest
+      bucket).
+    * ``prefix_block`` — the prefix cache's block size when one is
+      attached (hit lengths are block multiples; None = no cache, so
+      no suffix-bucketed widths are reachable).
+    * ``offline_batch`` — largest ``run(fused=True)`` offline drain
+      batch, or None when the deployment serves online-only (the
+      ``drain`` family is then unreachable and not enumerated).
+    """
+    max_prompt: int
+    max_new_tokens: int
+    seg_steps: Tuple[int, ...]
+    n_pads: Tuple[int, ...] = ()
+    resume: bool = True
+    prefix_block: Optional[int] = None
+    offline_batch: Optional[int] = None
+
+    def __post_init__(self):
+        if self.max_prompt < 1:
+            raise ValueError(f"max_prompt must be >= 1, got "
+                             f"{self.max_prompt}")
+        if self.max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{self.max_new_tokens}")
+        if not self.seg_steps:
+            raise ValueError("envelope needs at least one seg_steps value")
+        object.__setattr__(self, "seg_steps",
+                           tuple(sorted({int(s) for s in self.seg_steps})))
+        object.__setattr__(self, "n_pads",
+                           tuple(sorted({int(n) for n in self.n_pads})))
+
+    def admit_lengths(self, buckets: Sequence[int]) -> Tuple[int, int]:
+        """(min, max) tokens one admission can prefill. Fresh requests
+        prefill up to ``max_prompt``; a resume re-prefills prompt +
+        generated-so-far, capped at the largest bucket (``can_preempt``
+        refuses to preempt what could not re-admit; a fleet failover of
+        an un-preemptable request re-prefills through the same bucketed
+        window and would fail intake the same way a fresh overlong
+        prompt does)."""
+        hi = self.max_prompt
+        if self.resume:
+            hi = self.max_prompt + self.max_new_tokens - 1
+        return 1, min(hi, max(buckets))
+
+
+@dataclass(frozen=True)
+class ProgramFamily:
+    """One segment program-key family: schema + enumerator.
+
+    ``tag`` is the leading string of the key tuple (None for the r5
+    admit family, whose historical ``(bucket, nb)`` format carries no
+    tag). ``axes`` name the remaining positions. ``enumerate_fn(engine,
+    envelope)`` yields every key the family can reach from that config
+    under that envelope; ``applies(engine)`` gates which families an
+    engine config routes dispatches to."""
+    name: str
+    tag: Optional[str]
+    axes: Tuple[str, ...]
+    doc: str
+    enumerate_fn: Callable
+    applies: Callable
+
+    def key(self, **kw) -> tuple:
+        missing = [a for a in self.axes if a not in kw]
+        extra = [k for k in kw if k not in self.axes]
+        if missing or extra:
+            raise TypeError(
+                f"program family {self.name!r} takes axes {self.axes}; "
+                f"missing {missing}, unexpected {extra}")
+        vals = tuple(int(kw[a]) for a in self.axes)
+        return vals if self.tag is None else (self.tag,) + vals
+
+
+class ProgramSpace:
+    """The registry: families by name, the sanctioned key constructor,
+    and the whole-config enumeration."""
+
+    def __init__(self):
+        self._families: Dict[str, ProgramFamily] = {}
+
+    def register(self, family: ProgramFamily) -> ProgramFamily:
+        if family.name in self._families:
+            raise ValueError(f"program family {family.name!r} already "
+                             f"registered")
+        self._families[family.name] = family
+        return family
+
+    def family(self, name: str) -> ProgramFamily:
+        if name not in self._families:
+            raise KeyError(f"unknown program family {name!r}; registered: "
+                           f"{sorted(self._families)}")
+        return self._families[name]
+
+    def families(self) -> List[str]:
+        return sorted(self._families)
+
+    def tags(self) -> FrozenSet[str]:
+        return frozenset(f.tag for f in self._families.values()
+                         if f.tag is not None)
+
+    def key(self, name: str, **axes) -> tuple:
+        """THE key constructor — every jit memo key in serving.py
+        routes through here (enforced by ``analysis.coverage``'s AST
+        lint: a hand-built tagged tuple anywhere in serving/scheduler/
+        fleet fails tier-1)."""
+        return self.family(name).key(**axes)
+
+    def family_of(self, key: tuple) -> Optional[str]:
+        """Which registered family a key tuple belongs to (None when
+        the tuple matches no schema — the coverage differential treats
+        that as an unenumerated compile)."""
+        if not isinstance(key, tuple) or not key:
+            return None
+        if isinstance(key[0], str):
+            for f in self._families.values():
+                if f.tag == key[0] and len(key) == 1 + len(f.axes):
+                    return f.name
+            return None
+        for f in self._families.values():
+            if f.tag is None and len(key) == len(f.axes) \
+                    and all(isinstance(v, int) for v in key):
+                return f.name
+        return None
+
+    def enumerate(self, engine, envelope: WorkloadEnvelope
+                  ) -> FrozenSet[tuple]:
+        """The EXACT finite key set ``engine``'s config can compile
+        under ``envelope`` — the union of every applicable family's
+        closed-form enumeration."""
+        keys: set = set()
+        for f in self._families.values():
+            if f.applies(engine):
+                keys.update(f.enumerate_fn(engine, envelope))
+        return frozenset(keys)
+
+    def enumerate_by_family(self, engine, envelope: WorkloadEnvelope
+                            ) -> Dict[str, FrozenSet[tuple]]:
+        return {f.name: frozenset(f.enumerate_fn(engine, envelope))
+                for f in self._families.values() if f.applies(engine)}
+
+
+PROGRAM_SPACE = ProgramSpace()
+
+
+# --- shared enumeration arithmetic -----------------------------------------
+# These mirror the dispatch-time width arithmetic in serving.py EXACTLY;
+# analysis/coverage.py re-derives the same sets by brute-force replay of
+# the admission arithmetic over the envelope's integer domain and
+# asserts the two agree (the closed forms below are the fast path, the
+# replay is the proof).
+
+
+def _bucket_for(buckets: Sequence[int], n: int) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"no bucket for prompt length {n}")
+
+
+def _n_pads(engine, env: WorkloadEnvelope) -> Tuple[int, ...]:
+    return env.n_pads or (_pow2(engine.slots),)
+
+
+def _reachable_widths(engine, env: WorkloadEnvelope,
+                      spec_pinned: bool) -> FrozenSet[int]:
+    """Admit-window widths (s_max) a dispatch can produce.
+
+    Without a prefix cache (or for the width-pinned spec family) every
+    dispatch pins to the largest bucket. With one, a group containing
+    at least one hit buckets by its longest SUFFIX — suffix lengths
+    range over [1, L_adm] (a hit can shave any block multiple off any
+    admissible length, and hit-less rows in the same group contribute
+    their full length), so the reachable set is every bucket that
+    covers some length ≤ L_adm, plus the always-reachable top bucket."""
+    buckets = engine.buckets
+    top = buckets[-1]
+    if spec_pinned or env.prefix_block is None:
+        return frozenset((top,))
+    lo, hi = env.admit_lengths(buckets)
+    if hi <= env.prefix_block:
+        # no admissible length can carry a block-aligned hit AND a
+        # nonempty suffix — suffix bucketing never engages
+        return frozenset((top,))
+    widths = {top}
+    for b in buckets:
+        if b >= lo:                     # covers some suffix length <= hi
+            widths.add(b)
+        if b >= hi:
+            break
+    return frozenset(widths)
+
+
+def _dense_pre_widths(engine, env: WorkloadEnvelope
+                      ) -> FrozenSet[Tuple[int, int]]:
+    """(pre_max, s_max) pairs the DENSE (contiguous) segment can reach.
+
+    pre_max = 0 always pins s_max to the top bucket (dispatch rule).
+    pre_max > 0 is the block-rounded longest hit: hits are block
+    multiples strictly shorter than the admission length, so pre ranges
+    over {block, 2*block, ...} up to round_down(L_adm - 1); the paired
+    s_max buckets any suffix in the group (1..L_adm). Pairs whose
+    prefix + suffix window exceeds max_len are DROPPED by dispatch
+    (falls back to (0, top), already present)."""
+    buckets = engine.buckets
+    top = buckets[-1]
+    pairs = {(0, top)}
+    blk = env.prefix_block
+    if blk is None:
+        return frozenset(pairs)
+    lo, hi = env.admit_lengths(buckets)
+    max_pre = ((hi - 1) // blk) * blk
+    widths = _reachable_widths(engine, env, spec_pinned=False)
+    pre = blk
+    while pre <= max_pre:
+        for w in widths:
+            if pre + w <= engine.max_len:
+                pairs.add((pre, w))
+        pre += blk
+    return frozenset(pairs)
+
+
+# --- family registrations ---------------------------------------------------
+
+
+def _is_dense(engine) -> bool:
+    return not engine.paged
+
+
+def _is_paged_plain(engine) -> bool:
+    return (engine.paged and not engine.chunked and not engine.speculative
+            and not engine.sampling and not engine.quality_digest)
+
+
+def _is_paged_quality(engine) -> bool:
+    return engine.paged and engine.quality_digest
+
+
+def _is_paged_chunked(engine) -> bool:
+    return (engine.paged and engine.chunked
+            and not (engine.speculative or engine.sampling))
+
+
+def _is_paged_spec(engine) -> bool:
+    return engine.paged and bool(engine.speculative or engine.sampling)
+
+
+def _enum_admit(engine, env: WorkloadEnvelope) -> Iterable[tuple]:
+    # windowed-path fused prefill waves: every bucket x wave width that
+    # fits the slot count (exactly the set warmup() has always compiled)
+    from .serving import _WAVE_WIDTHS
+
+    fam = PROGRAM_SPACE.family("admit")
+    for b in engine.buckets:
+        for nb in _WAVE_WIDTHS:
+            if nb <= engine.slots:
+                yield fam.key(bucket=b, nb=nb)
+
+
+def _enum_decode(engine, env: WorkloadEnvelope) -> Iterable[tuple]:
+    yield PROGRAM_SPACE.family("decode").key(chunk=engine.chunk)
+
+
+def _enum_drain(engine, env: WorkloadEnvelope) -> Iterable[tuple]:
+    # offline whole-queue drain (run(fused=True)): n_pad = pow2(batch),
+    # p_max buckets the batch's longest prompt, g_max = pow2(longest
+    # generation, floor 16) — enumerated only when the envelope declares
+    # an offline batch bound
+    if not env.offline_batch:
+        return
+    fam = PROGRAM_SPACE.family("drain")
+    n_pads = sorted({_pow2(n) for n in range(1, env.offline_batch + 1)})
+    p_maxes = sorted({_bucket_for(engine.buckets, l)
+                      for l in range(1, env.max_prompt + 1)})
+    g_maxes = sorted({_pow2(g, lo=16)
+                      for g in range(1, env.max_new_tokens + 1)})
+    for n_pad in n_pads:
+        for p_max in p_maxes:
+            for g_max in g_maxes:
+                yield fam.key(n_pad=n_pad, p_max=p_max, g_max=g_max)
+
+
+def _enum_seg(engine, env: WorkloadEnvelope) -> Iterable[tuple]:
+    fam = PROGRAM_SPACE.family("seg")
+    for n_pad in _n_pads(engine, env):
+        for steps in env.seg_steps:
+            for pre, w in _dense_pre_widths(engine, env):
+                yield fam.key(n_pad=n_pad, s_max=w, pre_max=pre,
+                              steps=steps)
+
+
+def _enum_pseg(engine, env: WorkloadEnvelope) -> Iterable[tuple]:
+    fam = PROGRAM_SPACE.family("pseg")
+    for n_pad in _n_pads(engine, env):
+        for steps in env.seg_steps:
+            for w in _reachable_widths(engine, env, spec_pinned=False):
+                yield fam.key(n_pad=n_pad, s_max=w, steps=steps)
+
+
+def _enum_qseg(engine, env: WorkloadEnvelope) -> Iterable[tuple]:
+    fam = PROGRAM_SPACE.family("qseg")
+    for n_pad in _n_pads(engine, env):
+        for steps in env.seg_steps:
+            for w in _reachable_widths(engine, env, spec_pinned=False):
+                yield fam.key(n_pad=n_pad, s_max=w, steps=steps)
+
+
+def _enum_cseg(engine, env: WorkloadEnvelope) -> Iterable[tuple]:
+    fam = PROGRAM_SPACE.family("cseg")
+    for n_pad in _n_pads(engine, env):
+        for steps in env.seg_steps:
+            for w in _reachable_widths(engine, env, spec_pinned=False):
+                C = chunk_for(engine.prefill_chunks, w)
+                s_max_c = -(-w // C) * C
+                if steps < 2 * (s_max_c // C):
+                    continue    # dispatch raises before building this key
+                yield fam.key(n_pad=n_pad, s_max=s_max_c, c=C, steps=steps)
+
+
+def _enum_sseg(engine, env: WorkloadEnvelope) -> Iterable[tuple]:
+    fam = PROGRAM_SPACE.family("sseg")
+    for n_pad in _n_pads(engine, env):
+        for steps in env.seg_steps:
+            if steps < 2:
+                continue        # dispatch raises before building this key
+            yield fam.key(n_pad=n_pad, k=engine.speculative, steps=steps)
+
+
+PROGRAM_SPACE.register(ProgramFamily(
+    name="admit", tag=None, axes=("bucket", "nb"),
+    doc="r5 windowed fused prefill+insert wave: (bucket, nb)",
+    enumerate_fn=_enum_admit,
+    applies=lambda e: _is_dense(e) and e.mesh is None))
+
+PROGRAM_SPACE.register(ProgramFamily(
+    name="decode", tag="decode", axes=("chunk",),
+    doc="r5 windowed decode chunk: ('decode', chunk)",
+    enumerate_fn=_enum_decode,
+    applies=lambda e: _is_dense(e) and e.mesh is None))
+
+PROGRAM_SPACE.register(ProgramFamily(
+    name="drain", tag="drain", axes=("n_pad", "p_max", "g_max"),
+    doc="r5 offline whole-queue drain: ('drain', n_pad, p_max, g_max)",
+    enumerate_fn=_enum_drain,
+    applies=lambda e: _is_dense(e) and e.mesh is None))
+
+PROGRAM_SPACE.register(ProgramFamily(
+    name="seg", tag="seg", axes=("n_pad", "s_max", "pre_max", "steps"),
+    doc="r7 dense re-entrant segment: ('seg', n_pad, s_max, pre_max, "
+        "steps)",
+    enumerate_fn=_enum_seg, applies=_is_dense))
+
+PROGRAM_SPACE.register(ProgramFamily(
+    name="pseg", tag="pseg", axes=("n_pad", "s_max", "steps"),
+    doc="r11 paged segment: ('pseg', n_pad, s_max, steps)",
+    enumerate_fn=_enum_pseg, applies=_is_paged_plain))
+
+PROGRAM_SPACE.register(ProgramFamily(
+    name="qseg", tag="qseg", axes=("n_pad", "s_max", "steps"),
+    doc="r17 quality-digest paged segment: ('qseg', n_pad, s_max, steps)",
+    enumerate_fn=_enum_qseg, applies=_is_paged_quality))
+
+PROGRAM_SPACE.register(ProgramFamily(
+    name="cseg", tag="cseg", axes=("n_pad", "s_max", "c", "steps"),
+    doc="r13 chunked-prefill paged segment: ('cseg', n_pad, s_max_c, C, "
+        "steps)",
+    enumerate_fn=_enum_cseg, applies=_is_paged_chunked))
+
+PROGRAM_SPACE.register(ProgramFamily(
+    name="sseg", tag="sseg", axes=("n_pad", "k", "steps"),
+    doc="r15 speculative/sampled paged segment: ('sseg', n_pad, K, "
+        "steps) — width pinned to the largest bucket by design",
+    enumerate_fn=_enum_sseg, applies=_is_paged_spec))
+
+
+FAMILY_TAGS: FrozenSet[str] = PROGRAM_SPACE.tags()

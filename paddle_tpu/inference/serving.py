@@ -70,8 +70,10 @@ from ..models import llama
 from ..observability import flight as _flight
 from ..observability import journal as _journal
 from ..observability import metrics as _metrics
+from .program_space import PROGRAM_SPACE, WorkloadEnvelope, chunk_for
 
-__all__ = ["Request", "ServingEngine", "SEGMENT_HOOKS"]
+__all__ = ["Request", "ServingEngine", "SEGMENT_HOOKS", "PROGRAM_SPACE",
+           "WorkloadEnvelope"]
 
 # Process-wide segment observers (r14, ISSUE 9): ``fn(steps, new_tokens,
 # n_finished)`` called from ``_segment_telemetry`` after every segment's
@@ -472,6 +474,20 @@ class ServingEngine:
         # rebuilds).
         self.built_at = time.perf_counter()
         self.cold_start_s: Optional[float] = None
+        # r20 (ISSUE 15): AOT bucket-ladder warmup bookkeeping. When
+        # ``aot_warmup`` ran, the cold-start gauge splits into the
+        # warmup cost (``aot_warmup_s`` — every enumerated program
+        # compiled at build) and ``first_token_s`` (cold_start minus
+        # warmup: queue/admit/prefill only, no XLA), the pair the
+        # autoscaler's scale-up model sums. ``aot_key_seconds`` holds
+        # per-key build+compile seconds (the coverage pass attributes
+        # dead ladder entries' cost from it); ``prog_key_hits`` counts
+        # post-warmup program-cache accesses (the enumerated-vs-used
+        # differential's usage side).
+        self.aot_warmup_s: Optional[float] = None
+        self.first_token_s: Optional[float] = None
+        self.aot_key_seconds: Dict[tuple, float] = {}
+        self.prog_key_hits: Dict[tuple, int] = {}
         from ..jit import register_compiled_cache
 
         register_compiled_cache(self)  # analysis.recompile introspection
@@ -610,7 +626,12 @@ class ServingEngine:
     def _memo_prog(self, key: tuple, build):
         """Two-level memo: per-engine ``_progs`` (the recompile lint's
         introspection surface — ``cache_info`` keys stay per engine) in
-        front of the process-wide ``_SHARED_PROGS`` store."""
+        front of the process-wide ``_SHARED_PROGS`` store. Every access
+        counts into ``prog_key_hits`` (r20: ``aot_warmup`` zeroes the
+        counts after compiling the ladder, so what remains is the
+        post-warmup usage side of the enumerated-vs-used coverage
+        differential)."""
+        self.prog_key_hits[key] = self.prog_key_hits.get(key, 0) + 1
         cached = self._progs.get(key)
         if cached is not None:
             return cached
@@ -628,7 +649,8 @@ class ServingEngine:
         Memoised per geometry in the process-wide program cache (the
         closure captures config scalars only — never the engine's params
         or KV cache, which would pin them forever)."""
-        return self._memo_prog((bucket, nb),
+        key = PROGRAM_SPACE.key("admit", bucket=bucket, nb=nb)
+        return self._memo_prog(key,
                                lambda: self._build_admit_prog(bucket, nb))
 
     def _build_admit_prog(self, bucket: int, nb: int):
@@ -661,7 +683,7 @@ class ServingEngine:
 
     @property
     def _decode_prog(self):
-        return self._memo_prog(("decode", self.chunk),
+        return self._memo_prog(PROGRAM_SPACE.key("decode", chunk=self.chunk),
                                self._build_decode_prog)
 
     def _build_decode_prog(self):
@@ -787,6 +809,190 @@ class ServingEngine:
         self._nxt = jnp.zeros((self.slots,), jnp.int32)
         self._rem = jnp.zeros((self.slots,), jnp.int32)
 
+    # --- program-space coverage + AOT warmup (r20: ISSUE 15) --------------
+    def default_envelope(self, seg_steps: Sequence[int] = (),
+                         prefix_block: Optional[int] = None,
+                         resume: bool = True,
+                         offline_batch: Optional[int] = None
+                         ) -> WorkloadEnvelope:
+        """The widest envelope this engine's INTAKE admits: prompts up
+        to the largest bucket, generations filling the cache, segments
+        at ``run()``'s drain budget unless the caller declares its
+        scheduler's ``seg_steps``. Deployments should declare tighter
+        envelopes (every reachable key gets compiled at warmup — a
+        loose envelope is dead ladder weight the coverage pass will
+        name, not an error)."""
+        max_prompt = self.buckets[-1]
+        return WorkloadEnvelope(
+            max_prompt=max_prompt,
+            max_new_tokens=max(1, self.max_len + 1 - max_prompt),
+            seg_steps=tuple(seg_steps) or (4 * self.chunk,),
+            resume=resume, prefix_block=prefix_block,
+            offline_batch=offline_batch)
+
+    def program_space(self, envelope: Optional[WorkloadEnvelope] = None
+                      ) -> Dict[str, frozenset]:
+        """Statically enumerate the EXACT finite program-key set this
+        config can reach under ``envelope`` (default: the widest intake
+        envelope), grouped by registered family. Every jit memo key the
+        dispatch paths can construct is in here by construction — the
+        keys and the dispatch arithmetic both live in
+        ``program_space.PROGRAM_SPACE`` (the coverage pass replays the
+        admission arithmetic over the envelope and diffs against this
+        set; ``analysis.coverage`` is the enforcement)."""
+        env = envelope or self.default_envelope()
+        return PROGRAM_SPACE.enumerate_by_family(self, env)
+
+    def aot_warmup(self, envelope: Optional[WorkloadEnvelope] = None,
+                   prefix_cache=None) -> Dict[str, dict]:
+        """Compile the FULL enumerated program space at build (the
+        remaining third of old ROADMAP item 5): every key the envelope
+        can reach is built through ``_memo_prog`` (fleet replicas share
+        the compile via ``_SHARED_PROGS``; restarts share it via the
+        r15 persistent cache) and executed once on empty state —
+        ``n_real = 0`` with no live slots makes every segment's
+        while_loop exit before its first iteration, so the execution
+        costs microseconds and the XLA compile is the whole bill. After
+        this, a serve that stays inside the envelope performs ZERO
+        backend compiles (``analysis.recompile.enforce_zero_compiles``
+        is the budget; ``analysis.coverage`` diffs enumerated vs used).
+
+        Returns {family: {"keys": n, "seconds": s}} and stamps
+        ``aot_warmup_s`` (the cold-start split's first half). Requires
+        an idle engine (no live slots, queue, or in-flight segment).
+
+        Pass the serve loop's ``prefix_cache`` when one will be
+        attached: a tiered cache's D2H-stage/H2D-restore transfer
+        programs are shape-keyed on the transferred page count and get
+        prewarmed for every count the envelope's prefix lengths can
+        reach."""
+        assert all(r is None for r in self._active) and not self._queue, \
+            "aot_warmup on a non-idle engine"
+        assert self._pending_seg is None, \
+            "aot_warmup with a dispatched segment in flight"
+        env = envelope or self.default_envelope()
+        t0 = time.perf_counter()
+        by_family = PROGRAM_SPACE.enumerate_by_family(self, env)
+        report: Dict[str, dict] = {}
+        for fam in sorted(by_family):
+            tf = time.perf_counter()
+            for key in sorted(by_family[fam]):
+                tk = time.perf_counter()
+                self._aot_run_key(fam, key)
+                self.aot_key_seconds[key] = time.perf_counter() - tk
+            report[fam] = {"keys": len(by_family[fam]),
+                           "seconds": time.perf_counter() - tf}
+        # prewarm the between-segment eager singletons so the first
+        # preempt / slot reset after warmup compiles nothing: the
+        # preempt freeze scatter (device-operand index — one program
+        # for all slots) and the slot-vector fill reset_slots rebuilds
+        self._rem = self._rem.at[jnp.asarray(0, jnp.int32)].set(0)
+        tier = getattr(prefix_cache, "host_tier", None) \
+            if prefix_cache is not None else None
+        if tier is not None and self.paged:
+            _, hi = env.admit_lengths(self.buckets)
+            tier.prewarm_transfers(hi // self.page_size)
+        # windowed-path dummy admits wrote device slot state (pos/nxt);
+        # segments and drains ran empty (n_real=0). Either way the
+        # engine returns to idle zeros — it was asserted idle at entry,
+        # so nothing is lost (the same reset warmup() performs)
+        self._pos = self._slot_vec()
+        self._nxt = self._slot_vec()
+        self._rem = self._slot_vec()
+        # post-warmup usage starts clean: what accumulates in
+        # prog_key_hits from here on is the serve's ACTUAL key traffic
+        # (the coverage differential's used-vs-enumerated side)
+        self.prog_key_hits = {}
+        self.aot_warmup_s = (self.aot_warmup_s or 0.0) + (
+            time.perf_counter() - t0)
+        n_keys = sum(r["keys"] for r in report.values())
+        _metrics.gauge("serving.aot_warmup_s").set(self.aot_warmup_s)
+        _metrics.gauge("serving.program_space_keys").set(n_keys)
+        _flight.record("aot_warmup", seconds=round(self.aot_warmup_s, 4),
+                       keys=n_keys, families=sorted(report))
+        return report
+
+    def _aot_run_key(self, family: str, key: tuple) -> None:
+        """Build + compile + once-execute ONE enumerated program key on
+        empty dummy state. The dummy calls mirror the dispatch paths'
+        real argument shapes exactly (that is what makes the jit cache
+        hit later); donated state arrays thread through so the engine
+        stays consistent."""
+        i32 = jnp.int32
+        cfg = self.cfg
+        L, Hkv, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+        with _mesh_scope(self.mesh):
+            if family == "admit":
+                bucket, nb = key
+                out = self._admit_prog(bucket, nb)(
+                    self.params, self._cache,
+                    jnp.zeros((nb, bucket), i32), jnp.ones((nb,), i32),
+                    jnp.arange(nb, dtype=i32), self._pos, self._nxt,
+                    self._rem, jnp.zeros((nb,), i32))
+                self._cache = out[0]
+            elif family == "decode":
+                out = self._decode_prog(self.params, self._cache,
+                                        self._pos, self._nxt, self._rem)
+                (self._cache, self._pos, self._nxt, self._rem) = out[:4]
+            elif family == "drain":
+                _, n_pad, p_max, g_max = key
+                out = self._drain_prog(n_pad, p_max, g_max)(
+                    self.params, self._cache,
+                    jnp.zeros((n_pad, p_max), i32),
+                    jnp.ones((n_pad,), i32), jnp.zeros((n_pad,), i32),
+                    i32(0))
+                self._cache = out[0]
+            elif family == "seg":
+                _, n_pad, s_max, pre_max, steps = key
+                kdt = self._cache["k"].dtype
+                out = self._segment_prog(n_pad, s_max, pre_max, steps)(
+                    self.params, self._cache, self._pos, self._nxt,
+                    self._rem, jnp.zeros((n_pad, s_max), i32),
+                    jnp.ones((n_pad,), i32), jnp.zeros((n_pad,), i32),
+                    jnp.zeros((n_pad, L, pre_max, Hkv, D), kdt),
+                    jnp.zeros((n_pad, L, pre_max, Hkv, D), kdt),
+                    jnp.zeros((n_pad,), i32), i32(0))
+                (self._cache, self._pos, self._nxt, self._rem) = out[:4]
+            elif family in {"pseg", "qseg", "cseg"}:
+                n_pad, s_max, steps = key[1], key[2], key[-1]
+                prog = (self._chunked_segment_prog(n_pad, s_max, key[3],
+                                                   steps)
+                        if family == "cseg"
+                        else self._paged_segment_prog(n_pad, s_max, steps))
+                pgr = self.pager
+                out = prog(
+                    self.params, pgr.pool, pgr.page_table, self._pos,
+                    self._nxt, self._rem, jnp.zeros((n_pad, s_max), i32),
+                    jnp.ones((n_pad,), i32), jnp.zeros((n_pad,), i32),
+                    jnp.zeros((n_pad,), i32),
+                    jnp.zeros((n_pad, pgr.max_pages), i32), i32(0))
+                pgr.pool, pgr.page_table = out[0], out[1]
+                (self._pos, self._nxt, self._rem) = out[2:5]
+            elif family == "sseg":
+                _, n_pad, _k, steps = key
+                pgr = self.pager
+                rng = (self._rng if self._rng is not None
+                       else jnp.zeros((self.slots, 2), jnp.uint32))
+                s_max = self.buckets[-1]
+                if self.chunked:
+                    C = self._prefill_chunk_for(s_max)
+                    s_max = -(-s_max // C) * C
+                out = self._spec_segment_prog(n_pad, steps)(
+                    self.params, pgr.pool, pgr.page_table, self._pos,
+                    self._nxt, self._rem, self._hist, self._hstart, rng,
+                    jnp.zeros((n_pad, s_max), i32),
+                    jnp.ones((n_pad,), i32), jnp.zeros((n_pad,), i32),
+                    jnp.zeros((n_pad,), i32),
+                    jnp.zeros((n_pad, pgr.max_pages), i32),
+                    jnp.zeros((n_pad,), i32), i32(0))
+                pgr.pool, pgr.page_table = out[0], out[1]
+                (self._pos, self._nxt, self._rem) = out[2:5]
+                self._hist, self._hstart = out[5], out[6]
+                if self._rng is not None:
+                    self._rng = out[7]
+            else:
+                raise KeyError(f"unknown program family {family!r}")
+
     # --- fused whole-drain program (r5) -----------------------------------
     def _drain_prog(self, n_pad: int, p_max: int, g_max: int):
         """The WHOLE queue drain as ONE compiled program (the decode
@@ -806,7 +1012,8 @@ class ServingEngine:
         whole drain: ONE dispatch + ONE result fetch, making the engine
         dispatch-latency-robust by construction. Memoised per
         (n_pad, p_max, g_max) padded workload shape."""
-        key = ("drain", n_pad, p_max, g_max)
+        key = PROGRAM_SPACE.key("drain", n_pad=n_pad, p_max=p_max,
+                                g_max=g_max)
         return self._memo_prog(key, lambda: self._build_drain_prog(
             n_pad, p_max, g_max))
 
@@ -989,7 +1196,8 @@ class ServingEngine:
         pre_len..pre_len+s_max-1 — the quadratic attention and the
         per-token matmul work of the shared prefix are not re-done.
         Memoised per (n_pad, s_max, pre_max, max_steps) shape."""
-        key = ("seg", n_pad, s_max, pre_max, max_steps)
+        key = PROGRAM_SPACE.key("seg", n_pad=n_pad, s_max=s_max,
+                                pre_max=pre_max, steps=max_steps)
         if pre_max + s_max > self.max_len:
             raise ValueError(
                 f"segment admit window {pre_max}+{s_max} exceeds cache "
@@ -1256,11 +1464,25 @@ class ServingEngine:
         and publish it (SERVING metric + flight event). Runs at the
         fetch that surfaced the token, so the stamp includes program
         build + first compile + first prefill — the full client-facing
-        cold-start window."""
+        cold-start window.
+
+        r20 (ISSUE 15): with ``aot_warmup`` the gauge SPLITS —
+        ``aot_warmup_s`` (the whole enumerated ladder compiled at
+        build) + ``first_token_s`` (cold_start minus warmup: queue,
+        admit, prefill — no XLA left to pay). The split is what makes
+        the autoscaler's scale-up latency a measured, bounded number:
+        warmup cost amortises across the persistent cache / fleet
+        shared programs, first_token_s is the irreducible tail."""
         self.cold_start_s = time.perf_counter() - self.built_at
+        self.first_token_s = self.cold_start_s - (self.aot_warmup_s or 0.0)
         _metrics.gauge("serving.cold_start_s").set(self.cold_start_s)
+        _metrics.gauge("serving.first_token_s").set(self.first_token_s)
         _flight.record("cold_start",
                        seconds=round(self.cold_start_s, 4),
+                       aot_warmup_s=(round(self.aot_warmup_s, 4)
+                                     if self.aot_warmup_s is not None
+                                     else None),
+                       first_token_s=round(self.first_token_s, 4),
                        paged=self.paged, slots=self.slots)
 
     def _segment_telemetry(self, steps, admitted, finished, eos_stops,
@@ -1375,8 +1597,12 @@ class ServingEngine:
         r = self._active[slot]
         assert r is not None, f"preempt of empty slot {slot}"
         # freeze on device: a dispatch, not a sync (the audit contract
-        # of the serve loop — one fetch per segment — is untouched)
-        self._rem = self._rem.at[slot].set(0)
+        # of the serve loop — one fetch per segment — is untouched).
+        # The index rides as a DEVICE operand, not a baked constant, so
+        # one compiled scatter covers every slot — aot_warmup prewarms
+        # it and the zero-post-warmup-compile budget holds across
+        # preemptions of any slot (r20)
+        self._rem = self._rem.at[jnp.asarray(slot, jnp.int32)].set(0)
         self._rem_host[slot] = 0
         self._active[slot] = None
         r.preemptions += 1
@@ -1677,12 +1903,14 @@ class ServingEngine:
         one-dispatch/one-fetch contract is untouched (the
         quality_serving_segment gate program pins it)."""
         if self.quality_digest:
-            key = ("qseg", n_pad, s_max, max_steps)
+            key = PROGRAM_SPACE.key("qseg", n_pad=n_pad, s_max=s_max,
+                                    steps=max_steps)
             return self._memo_prog(
                 key, lambda: self._build_paged_segment_prog(
                     n_pad, s_max, max_steps,
                     digest_k=self.digest_top_k))
-        key = ("pseg", n_pad, s_max, max_steps)
+        key = PROGRAM_SPACE.key("pseg", n_pad=n_pad, s_max=s_max,
+                                steps=max_steps)
         return self._memo_prog(key, lambda: self._build_paged_segment_prog(
             n_pad, s_max, max_steps))
 
@@ -1802,26 +2030,25 @@ class ServingEngine:
         return segment
 
     # --- chunked prefill (r13: bounded time-between-tokens) ----------------
-    _MAX_PREFILL_CHUNKS = 4
 
     def _prefill_chunk_for(self, s_max: int) -> int:
         """Chunk width for a segment whose admit window is ``s_max``
         wide: the smallest ladder entry that bounds a full-width prefill
-        at ``_MAX_PREFILL_CHUNKS`` chunk steps — short windows get tight
-        time-between-tokens, long ones a bounded step count, and every
-        width is DECLARED (a finite ("cseg", ..) program-key family;
-        a floating chunk width would re-open the mid-serve-compile
-        hazard the bucket pinning closed). The cap matters for
-        ADMISSION throughput too: a prefill may only start while
-        2 x chunks steps remain in the segment budget, so a finer
+        at ``program_space.MAX_PREFILL_CHUNKS`` chunk steps — short
+        windows get tight time-between-tokens, long ones a bounded step
+        count, and every width is DECLARED (a finite ("cseg", ..)
+        program-key family; a floating chunk width would re-open the
+        mid-serve-compile hazard the bucket pinning closed). The cap
+        matters for ADMISSION throughput too: a prefill may only start
+        while 2 x chunks steps remain in the segment budget, so a finer
         ladder narrows the start window and long prompts begin to
         monopolize segment heads (measured on the overload lane —
-        8-chunk prefills throttled admission to one start per
-        segment)."""
-        for c in self.prefill_chunks:
-            if c * self._MAX_PREFILL_CHUNKS >= s_max:
-                return c
-        return self.prefill_chunks[-1]
+        8-chunk prefills throttled admission to one start per segment).
+
+        r20: the arithmetic lives in ``program_space.chunk_for`` — ONE
+        copy shared by dispatch and the ``cseg`` family's static
+        enumerator, so coverage can never drift from the runtime."""
+        return chunk_for(self.prefill_chunks, s_max)
 
     def _chunked_segment_prog(self, n_pad: int, s_max_c: int, C: int,
                               max_steps: int):
@@ -1858,7 +2085,8 @@ class ServingEngine:
         if s_max_c % C:
             raise ValueError(f"admit window {s_max_c} is not a multiple "
                              f"of the prefill chunk {C}")
-        key = ("cseg", n_pad, s_max_c, C, max_steps)
+        key = PROGRAM_SPACE.key("cseg", n_pad=n_pad, s_max=s_max_c, c=C,
+                                steps=max_steps)
         return self._memo_prog(key, lambda: self._build_chunked_segment_prog(
             n_pad, s_max_c, C, max_steps))
 
@@ -2020,7 +2248,7 @@ class ServingEngine:
         one position is exactly a sampled decode tick), which keeps the
         canonical paged/chunked greedy programs byte-identical."""
         K = self.speculative
-        key = ("sseg", n_pad, K, max_steps)
+        key = PROGRAM_SPACE.key("sseg", n_pad=n_pad, k=K, steps=max_steps)
         return self._memo_prog(key, lambda: self._build_spec_segment_prog(
             n_pad, K, max_steps))
 

@@ -2,6 +2,19 @@
 
 Reference: ``test/legacy_test/test_vision_models.py`` pattern — construct,
 forward, check logits shape.
+
+Suite-time (r20, the ROADMAP maintenance note's named win): zoo
+forwards are XLA-compile-bound on the CPU lane (~95% of a first forward
+is per-op compilation; a second forward of the same arch costs <1 s),
+so every smoke forward routes through a SESSION-SCOPED forward cache —
+one construct+forward per (arch, size, classes) for the whole pytest
+session, shared by any test or module that only needs "this zoo arch
+forwards finitely to the right shape". The two heaviest remaining
+redundant entries follow the r19 precedent: ``googlenet`` (~17 s; the
+inception cell family stays tier-1-covered by ``inception_v3``) and the
+zoo-scale train-mode BN test (~14 s of backward compiles; a dedicated
+small-stack BN test keeps the train-mode semantics in tier-1) run as
+``slow`` — the chip lane (tpu_test_lane) still runs them.
 """
 
 import numpy as np
@@ -10,14 +23,27 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.vision import models
 
+# session-scoped forward cache: (factory name, size, classes) -> logits
+# numpy. One construct+forward per arch per session — repeat consumers
+# assert off the cached result instead of re-paying the compile set.
+_FWD_CACHE = {}
 
-def _run(model, size=64, classes=10):
-    x = paddle.to_tensor(
-        np.random.RandomState(0).rand(2, 3, size, size).astype(np.float32))
-    model.eval()
-    out = model(x)
-    assert tuple(out.shape) == (2, classes)
-    assert np.all(np.isfinite(out.numpy()))
+
+def _zoo_forward(factory, size=64, classes=10):
+    key = (factory.__name__, size, classes)
+    if key not in _FWD_CACHE:
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .rand(2, 3, size, size).astype(np.float32))
+        model = factory(num_classes=classes)
+        model.eval()
+        _FWD_CACHE[key] = model(x).numpy()
+    return _FWD_CACHE[key]
+
+
+def _run(factory, size=64, classes=10):
+    out = _zoo_forward(factory, size=size, classes=classes)
+    assert out.shape == (2, classes)
+    assert np.all(np.isfinite(out))
 
 
 @pytest.mark.parametrize("factory,size", [
@@ -25,27 +51,53 @@ def _run(model, size=64, classes=10):
     (models.squeezenet1_0, 64),
     (models.squeezenet1_1, 64),
     (models.mobilenet_v1, 64),
-    # the two fattest zoo forwards (~25 s + ~18 s measured r19) run in
-    # the chip lane / -m slow only — the remaining zoo keeps tier-1's
-    # construct+forward coverage of every block type they use
+    # the fattest zoo forwards run in the chip lane / -m slow only —
+    # densenet121 + mobilenet_v3_small (~25 s + ~18 s, r19) and
+    # googlenet (~17 s, r20; inception_v3 keeps the inception cell
+    # family covered in tier-1). The remaining zoo keeps tier-1's
+    # construct+forward coverage of every block type they use.
     pytest.param(models.mobilenet_v3_small, 64,
                  marks=pytest.mark.slow),
     (models.mobilenet_v3_large, 64),
     (models.shufflenet_v2_x0_5, 64),
     pytest.param(models.densenet121, 64, marks=pytest.mark.slow),
-    (models.googlenet, 64),
+    pytest.param(models.googlenet, 64, marks=pytest.mark.slow),
 ])
 def test_model_forward(factory, size):
-    _run(factory(num_classes=10), size=size)
+    _run(factory, size=size)
 
 
 def test_inception_v3():
     # inception needs a larger minimum input (stem has three stride-2 stages)
-    _run(models.inception_v3(num_classes=10), size=128)
+    _run(models.inception_v3, size=128)
 
 
+def test_batchnorm_train_mode_updates():
+    """BatchNorm statistics update in train mode and gradients flow —
+    the train-mode semantics the zoo-scale test (below, slow) covers at
+    full depth, on a small conv+BN stack cheap enough for tier-1."""
+    from paddle_tpu import nn
+
+    m = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8), nn.ReLU(),
+        nn.MaxPool2D(2), nn.Flatten(), nn.Linear(8 * 16 * 16, 4))
+    m.train()
+    bn = m[1]
+    before = np.array(bn._variance.numpy(), copy=True)
+    x = paddle.to_tensor(
+        np.random.RandomState(1).rand(4, 3, 32, 32).astype(np.float32))
+    loss = paddle.mean(m(x))
+    loss.backward()
+    grads = [p.grad for p in m.parameters() if p.grad is not None]
+    assert len(grads) > 0
+    assert not np.allclose(bn._variance.numpy(), before)
+
+
+@pytest.mark.slow
 def test_model_zoo_train_mode_batchnorm():
-    """BatchNorm statistics update in train mode without error."""
+    """BatchNorm statistics update in train mode without error, at zoo
+    scale (chip lane / -m slow; tier-1 covers the semantics via
+    test_batchnorm_train_mode_updates)."""
     m = models.mobilenet_v1(num_classes=4, scale=0.25)
     m.train()
     x = paddle.to_tensor(
@@ -58,20 +110,12 @@ def test_model_zoo_train_mode_batchnorm():
 
 
 def test_resnext_forward():
-    from paddle_tpu.vision import models
-
-    m = models.resnext50_32x4d(num_classes=10)
-    _run(m, size=64)
+    _run(models.resnext50_32x4d, size=64)
 
 
 def test_resnet_nhwc_matches_nchw():
     """data_format="NHWC" (reference PaddleClas option): channel-last
     network must match the channel-first one numerically."""
-    import numpy as np
-
-    import paddle_tpu as paddle
-    from paddle_tpu.vision import models
-
     paddle.seed(0)
     m1 = models.resnet18(num_classes=10)
     paddle.seed(0)
