@@ -56,6 +56,14 @@ Writes ``SERVING_r<N>.json`` at the repo root:
               shadow-attachment overhead gate, and a seeded canary
               split with a journaled verdict + auto-hold demo...},
               (r17: shadow & canary serving, ISSUE 12)
+   "quant": {...llama_serving --quant json: quantized serving — the
+              analytic bytes/tick ledger (int8 weights+KV+scales vs
+              bf16, >= 1.7x), the int8 shadow pair certified against
+              the QualityMonitor token-match/logit/KL bar, a 25% int8
+              canary split, within-dtype determinism + bit-exact
+              journal replay, the qpseg AOT ladder's zero-compile
+              certificate, and the fp8 determinism check...},
+              (r21: quantized serving, ISSUE 16)
    "telemetry_headlines": {...r10 runtime-telemetry headlines per mode —
               queue depth / slot occupancy / prefix hit rate /
               backpressure counters from paddle_tpu.observability; the
@@ -164,6 +172,14 @@ def main() -> int:
         # split into aot_warmup_s + first_token_s, tokens identical
         # AOT on|off, enumerated-vs-used differential clean
         "aot": _run_json("llama_serving.py", args=("--aot",)),
+        # r21 (ISSUE 16): quantized serving — the analytic bytes/tick
+        # ledger (int8+scales vs bf16 >= 1.7x on the HBM-bound tick),
+        # the int8 shadow pair certified by the QualityMonitor bar
+        # (token-match floor + logit/KL budgets, never paging), a 25%
+        # int8 canary split, within-dtype determinism + bit-exact
+        # journal replay, and the qpseg AOT ladder serving with zero
+        # post-warmup compiles
+        "quant": _run_json("llama_serving.py", args=("--quant",)),
     }
     result["platform"] = result["online"].get("platform", "unknown")
     # r10: lift each mode's runtime-telemetry headline (queue depth,
@@ -174,7 +190,7 @@ def main() -> int:
         k: (result[k].get("telemetry") or {}).get("headline")
         for k in ("online", "prefix", "paged", "fleet", "overload",
                   "failover", "slo", "spec", "quality", "capacity",
-                  "tiered")}
+                  "tiered", "quant")}
     # r15: lift the speculative headline — the roofline-beating ratio
     # an operator (or the next round's reviewer) checks first
     spec = result["spec"].get("headline") or {}
@@ -249,6 +265,10 @@ def main() -> int:
     # (aot_warmup_s + first_token_s vs the no-AOT cold start) a
     # reviewer (and the item-4 autoscaler) checks first
     result["aot_headline"] = result["aot"].get("headline")
+    # r21 (ISSUE 16): lift the quantized-serving headline — the
+    # bytes/tick ratio, the shadow certification verdict, determinism/
+    # replay identity and the quant path's zero-compile certificate
+    result["quant_headline"] = result["quant"].get("headline")
     path = os.path.join(ROOT, f"SERVING_r{rnd:02d}.json")
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
@@ -256,7 +276,7 @@ def main() -> int:
     ok = all(result[k].get("rc") == 0
              for k in ("decode", "serving", "online", "prefix", "paged",
                        "fleet", "overload", "failover", "slo", "spec",
-                       "quality", "capacity", "tiered", "aot"))
+                       "quality", "capacity", "tiered", "aot", "quant"))
     return 0 if ok else 1
 
 

@@ -100,6 +100,13 @@ TPU_TEST_FILES = [
     # the persistent-cache warm-restart interplay, against REAL XLA:TPU
     # compiles (the 2.5 s class this whole subsystem exists to bound)
     "tests/test_program_coverage.py",
+    # r21 (ISSUE 16): quantized serving — on chip the engine's
+    # projection matmuls route through the REAL in-kernel-dequant
+    # Mosaic path (quant_matmul) and the scale-fed decode-attention
+    # kernel, so HBM genuinely carries int8/fp8; the parity, page-
+    # machinery, sync-audit, qpseg-coverage and replay tests all gain
+    # their hardware half here
+    "tests/test_quantized_serving.py",
 ]
 
 

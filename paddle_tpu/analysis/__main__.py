@@ -52,6 +52,15 @@ Usage::
                                                   # JSONL), budgets
                                                   # bit-identical to
                                                   # --journal off
+    python -m paddle_tpu.analysis --gate --quant on  # (default) the r21
+                                                  # contract: the int8
+                                                  # quantized paged segment
+                                                  # audited as the 10th
+                                                  # canonical program;
+                                                  # --quant off drops ONLY
+                                                  # it — every other
+                                                  # program's budget is
+                                                  # bit-identical either way
     python -m paddle_tpu.analysis --gate --aot on # (default) the r20
                                                   # contract: program-space
                                                   # coverage + AOT warmup —
@@ -168,6 +177,14 @@ def main(argv=None) -> int:
                          "journal attached (flight superset + decision-"
                          "clock JSONL recording) — budgets must be "
                          "bit-identical to --journal off")
+    ap.add_argument("--quant", choices=("on", "off"), default="on",
+                    help="audit the r21 quantized serving segment "
+                         "(quant_serving_segment) alongside the other "
+                         "canonical programs (default: on). --quant off "
+                         "drops only that program — the remaining "
+                         "programs' budgets must be bit-identical "
+                         "either way (the quantized path shares no "
+                         "state with them)")
     ap.add_argument("--aot", choices=("on", "off"), default="on",
                     help="r20 program-space coverage: lint registry-only "
                          "key construction, prove the envelope "
@@ -221,6 +238,8 @@ def main(argv=None) -> int:
             print("coverage lint: registry-only key construction clean "
                   "(serving/scheduler/fleet)")
     targets = args.program or programs.names()
+    if args.quant == "off":
+        targets = [n for n in targets if n != "quant_serving_segment"]
     results = []
     any_violation = False
     aot_total_keys = 0

@@ -170,6 +170,30 @@ BUDGETS: Dict[str, Budget] = {
         notes="r17 contract: in-program logit digests ride the single "
               "event fetch — quality evidence at zero extra syncs/"
               "compiles/shapes"),
+    # The QUANTIZED paged segment (r21, ISSUE 16): the
+    # paged_serving_segment contract with int8 weight streaming
+    # (per-output-channel scale companions in the param tree, dequant
+    # in-kernel / adjacent-to-dot) and an int8 KV pool carrying
+    # per-page scale planes. Quantization must be FREE at the hazard
+    # level: still exactly ONE event fetch per segment, zero warm
+    # compiles (the ("qpseg", ..., dtype) family is a declared dtype
+    # axis on the bucketed paged ladder), zero pack bytes, and the
+    # relayout ledger is BELOW the bf16 paged segment's — the
+    # while-body pool carries are int8 quarter-width; what remains is
+    # mostly the dequantized-weight transposes the CPU lowering
+    # materialises next to the dots.
+    "quant_serving_segment": Budget(
+        flagged_syncs=0,
+        allowed_syncs_per_replay={"serving.segment_event_fetch": 1},
+        warm_compiles=0,
+        # measured 631,908 B (int8 pool carries + dense-fallback dequant
+        # transposes) + ~5%
+        relayout_bytes_max=663_000,
+        pack_bytes_max=_MiB // 2,      # measured 0
+        undonated_bytes_max=_MiB // 2,  # measured 0 (pool+table donated)
+        notes="r21 contract: narrow weight/KV streams at zero extra "
+              "syncs/compiles/shapes — the quantized roofline win is "
+              "pure bytes, not a hazard trade"),
     # The TENSOR-PARALLEL segment (r12): the serving_segment contract,
     # GSPMD-sharded — same one fetch per segment and zero warm compiles,
     # PLUS every collective must attribute to the 'mp' axis (enforced

@@ -165,6 +165,13 @@ def reachable_keys_replay(engine, envelope) -> FrozenSet[tuple]:
                             keys.add(space.key("cseg", n_pad=n_pad,
                                                s_max=s_max_c, c=C,
                                                steps=steps))
+                elif getattr(engine, "quant", None):
+                    from ..quantization.serving import QUANT_CODES
+
+                    code = QUANT_CODES[engine.quant]
+                    for w in widths:
+                        keys.add(space.key("qpseg", n_pad=n_pad, s_max=w,
+                                           steps=steps, dtype=code))
                 else:
                     fam = "qseg" if engine.quality_digest else "pseg"
                     for w in widths:

@@ -31,6 +31,11 @@ whose hazard ledger earlier rounds paid for by hand:
   (per-emitted-token logit + top-k ids/values computed in-program and
   rolled into the event log; the shadow-diff evidence stream must ride
   the SAME single fetch at zero extra syncs/compiles).
+* ``quant_serving_segment``  — the r21 int8-quantized paged segment
+  (narrow weight/KV streams with in-kernel or adjacent-to-dot dequant,
+  per-page KV scale planes riding the pool; same one-dispatch/one-fetch
+  loop on the qpseg dtype axis — zero extra syncs/compiles is the
+  contract that makes the quantized rollout a pure bytes win).
 
 Builders are deterministic (fixed seeds, fixed shapes) so the measured
 metrics are stable run to run and ``budgets.py`` can pin them as exact
@@ -497,6 +502,68 @@ def _build_quality_serving_segment() -> ProgramHandle:
         expected_undonated=(),
         notes="quality-digest paged segment (k=4 top-k logit digests "
               "in the event log) + host digest replay, llama-tiny",
+        aot_engine=eng,
+        aot_envelope=_gate_envelope(seg_steps=(12,)),
+        keepalive=(eng,))
+
+
+@register("quant_serving_segment")
+def _build_quant_serving_segment() -> ProgramHandle:
+    """The r21 quantized paged segment (ISSUE 16): the paged segment
+    with int8 weight streaming (per-output-channel scales, dequant
+    in-kernel on TPU / adjacent-to-dot on the dense fallback) and an
+    int8 KV pool carrying per-page scale planes. The contract the
+    budget pins: quantization must be FREE at the hazard level — the
+    ("qpseg", n_pad, s_max, steps, dtype) family is bucketed exactly
+    like the plain paged family, still exactly ONE event fetch per
+    segment, zero flagged syncs, zero warm compiles — so the narrow
+    HBM stream is a pure bytes win the roofline model (SCALING §3p)
+    can bank without hazard caveats."""
+    import numpy as np
+
+    import jax.numpy as j
+
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg)
+    eng = ServingEngine(cfg, params, slots=4, max_len=64, chunk=8,
+                        prompt_buckets=(16,), paged=True, page_size=16,
+                        quant="int8")
+    rng = np.random.RandomState(0)
+
+    def replay():
+        # end-to-end QUANTIZED segment: two requests decode to
+        # completion inside the segment — narrow weight/KV streams,
+        # ONE fused dispatch, the single allowed event fetch
+        for _ in range(2):
+            eng.add_request(rng.randint(0, cfg.vocab_size, (12,)), 4)
+        return eng.run_segment(12)
+
+    def hlo():
+        n_pad = eng._pow2(eng.slots)
+        s_max = eng.buckets[-1]
+        seg = eng._paged_segment_prog(n_pad, s_max, 12)
+        pgr = eng.pager
+        return seg.lower(
+            eng.params, pgr.pool, pgr.page_table,
+            j.zeros((eng.slots,), j.int32), j.zeros((eng.slots,), j.int32),
+            j.zeros((eng.slots,), j.int32),
+            j.zeros((n_pad, s_max), j.int32), j.ones((n_pad,), j.int32),
+            j.zeros((n_pad,), j.int32), j.zeros((n_pad,), j.int32),
+            j.zeros((n_pad, pgr.max_pages), j.int32),
+            j.int32(2)).compile().as_text()
+
+    return ProgramHandle(
+        name="quant_serving_segment",
+        hlo=_memo(hlo),
+        replay=replay,
+        donation_threshold=1 << 16,
+        expected_undonated=(),
+        notes="int8-quantized paged segment (narrow weight/KV streams, "
+              "per-page KV scales, in-kernel dequant) — qpseg dtype "
+              "axis, llama-tiny",
         aot_engine=eng,
         aot_envelope=_gate_envelope(seg_steps=(12,)),
         keepalive=(eng,))

@@ -104,4 +104,5 @@ define_flag("use_ragged_decode", True, "Decode attention reads only KV rows [0, 
 define_flag("use_tick_fusion", True, "Fuse the decode tick's between-matmul small-op chains (rms/rope/residual) into single Pallas ops.", bool)
 define_flag("use_paged_attention", True, "Attention over the paged KV pool runs as the unified page-indirect Pallas kernel (scalar-prefetched page tables) instead of a gather + dense einsum.", bool)
 define_flag("use_pallas_fused_update", True, "Multi-tensor optimizer updates run as one Pallas kernel per group over flat buffers (in-place aliased) instead of XLA stack/concat packing.", bool)
+define_flag("use_quant_matmul", True, "Quantized-serving projection matmuls stream int8/fp8 weights and dequantize in VMEM (Pallas kernel) instead of the dense XLA dequantize-then-dot.", bool)
 define_flag("log_level", "WARNING", "Python logging level for paddle_tpu.", str)

@@ -731,7 +731,9 @@ class FleetRouter:
             exp = src.export_host(dirinfo["key"])
             if exp is None:
                 continue
-            if not pc.import_host(exp["tokens"], exp["k"], exp["v"]):
+            planes = {p: exp[p] for p in exp
+                      if p not in ("tokens", "pages")}
+            if not pc.import_host(exp["tokens"], planes):
                 continue
             n = int(exp["pages"])
             nbytes = n * pc.host_tier.page_bytes()

@@ -62,13 +62,15 @@ __all__ = ["HostTier", "TierMeter", "page_bytes", "install", "uninstall"]
 
 
 def page_bytes(pager) -> int:
-    """Bytes one pool page holds (K + V): the tier-transfer unit cost.
-    Computed from the live pool arrays so dtype/sharding changes are
-    automatically priced."""
-    k = pager.pool["k"]
-    # [L, num_pages, page_size, Hkv, D] -> per-page rows for k and v
-    per = int(np.prod([k.shape[0], *k.shape[2:]])) * k.dtype.itemsize
-    return 2 * per
+    """Bytes one pool page holds across EVERY pool plane (K + V, plus
+    the per-page scale planes of a quantized pool): the tier-transfer
+    unit cost. Computed from the live pool arrays — axis 1 is the page
+    axis in all planes — so dtype changes are automatically priced: an
+    int8 pool's spill/restore bills the true (¼-ish) bytes instead of
+    assuming bf16 (r21 satellite; the SCALING §3n arithmetic reads this
+    number)."""
+    return sum(int(np.prod([a.shape[0], *a.shape[2:]])) * a.dtype.itemsize
+               for a in pager.pool.values())
 
 
 class HostTier:
@@ -89,10 +91,11 @@ class HostTier:
                              f"{capacity_pages}")
         self.pager = pager
         self.capacity_pages = int(capacity_pages)
-        # key -> {"k": np [L, n, psz, Hkv, D], "v": np, "pages": n,
+        # key -> {<plane>: np [L, n, psz, ...] per pool plane ("k"/"v",
+        #         plus "ks"/"vs" for quantized pools), "pages": n,
         #         "at": perf_counter} — LRU by insertion/touch order
         self._host: "OrderedDict[bytes, dict]" = OrderedDict()
-        # queued D2H stages: [key, n_pages, k_future, v_future]
+        # queued D2H stages: [key, n_pages, *per-plane futures]
         self._pending: List[list] = []
         self.pages_host = 0           # host-resident staged pages
         self.stages = 0               # D2H copies completed
@@ -107,6 +110,13 @@ class HostTier:
     # --- sizing -----------------------------------------------------------
     def page_bytes(self) -> int:
         return page_bytes(self.pager)
+
+    def planes(self) -> tuple:
+        """Pool plane names, in pool order — ("k", "v") for an fp pool,
+        plus ("ks", "vs") per-page scale planes for a quantized pool
+        (r21). Every tier movement carries ALL planes: a restored
+        quantized page arrives with its scales or not at all."""
+        return tuple(self.pager.pool)
 
     def has(self, key: bytes) -> bool:
         return key in self._host
@@ -125,12 +135,12 @@ class HostTier:
         pool = self.pager.pool
         for n in range(1, max(1, int(max_pages)) + 1):
             idx = jnp.asarray([0] * n, jnp.int32)   # stage()'s exact aval
-            k = pool["k"][:, idx]
-            v = pool["v"][:, idx]
-            # upload()'s scatter: host rows arrive as numpy, transferred
-            # by jnp.asarray — replicate the aval chain then discard
-            _ = pool["k"].at[:, idx].set(jnp.asarray(np.asarray(k)))
-            _ = pool["v"].at[:, idx].set(jnp.asarray(np.asarray(v)))
+            for arr in pool.values():
+                g = arr[:, idx]
+                # upload()'s scatter: host rows arrive as numpy,
+                # transferred by jnp.asarray — replicate the aval chain
+                # then discard
+                _ = arr.at[:, idx].set(jnp.asarray(np.asarray(g)))
 
     # --- D2H staging (write-through; materialises at the segment fetch) ---
     def stage(self, key: bytes, pages: List[int]) -> None:
@@ -142,9 +152,8 @@ class HostTier:
         import jax.numpy as jnp
 
         idx = jnp.asarray(pages, jnp.int32)
-        self._pending.append([key, len(pages),
-                              self.pager.pool["k"][:, idx],
-                              self.pager.pool["v"][:, idx]])
+        self._pending.append([key, len(pages)] +
+                             [a[:, idx] for a in self.pager.pool.values()])
 
     def cancel(self, key: bytes) -> None:
         """Forget a queued stage (its entry was dropped before the copy
@@ -159,11 +168,14 @@ class HostTier:
 
     def complete(self, staged: List[list], host_vals) -> None:
         """Land fetched stage bytes in the host store. ``host_vals`` is
-        the materialised ``[(k, v), ...]`` matching ``staged`` — plain
-        numpy from the segment fetch that carried them."""
+        the materialised per-entry plane tuples matching ``staged`` —
+        plain numpy from the segment fetch that carried them."""
         pb = self.page_bytes()
-        for (key, n, _, _), (k, v) in zip(staged, host_vals):
-            self._put(key, np.asarray(k), np.asarray(v), n)
+        names = self.planes()
+        for st, vals in zip(staged, host_vals):
+            key, n = st[0], st[1]
+            self._put(key, {p: np.asarray(a) for p, a in zip(names, vals)},
+                      n)
             self.stages += 1
             self.bytes_to_host += n * pb
             _metrics.counter("serving.tier.stages").inc()
@@ -190,12 +202,12 @@ class HostTier:
         self.complete(staged, vals)
 
     # --- host store -------------------------------------------------------
-    def _put(self, key: bytes, k: np.ndarray, v: np.ndarray,
+    def _put(self, key: bytes, planes: Dict[str, np.ndarray],
              n: int) -> None:
         old = self._host.pop(key, None)
         if old is not None:
             self.pages_host -= old["pages"]
-        self._host[key] = {"k": k, "v": v, "pages": int(n),
+        self._host[key] = {**planes, "pages": int(n),
                            "at": time.perf_counter()}
         self.pages_host += int(n)
         while self.pages_host > self.capacity_pages and len(self._host) > 1:
@@ -231,18 +243,19 @@ class HostTier:
         _flight.record("tier_transfer", direction="spill", pages=n_pages,
                        bytes=0)
 
-    def upload(self, pages: List[int], k: np.ndarray,
-               v: np.ndarray) -> None:
+    def upload(self, pages: List[int],
+               planes: Dict[str, np.ndarray]) -> None:
         """Scatter host rows into freshly reserved pool pages — async
         dispatch (the H2D restore), issued BEFORE the segment that reads
-        them. No host sync."""
+        them. No host sync. ``planes`` carries every pool plane (scale
+        planes included for a quantized pool)."""
         import jax.numpy as jnp
 
         idx = jnp.asarray(pages, jnp.int32)
         pool = self.pager.pool
         self.pager.pool = {
-            "k": pool["k"].at[:, idx].set(jnp.asarray(k)),
-            "v": pool["v"].at[:, idx].set(jnp.asarray(v)),
+            p: pool[p].at[:, idx].set(jnp.asarray(planes[p]))
+            for p in pool
         }
         n = len(pages)
         pb = self.page_bytes()
@@ -263,12 +276,13 @@ class HostTier:
         returns None and the importer recomputes."""
         return self.get(key)
 
-    def note_import(self, key: bytes, k: np.ndarray, v: np.ndarray,
+    def note_import(self, key: bytes, planes: Dict[str, np.ndarray],
                     n: int) -> None:
         """Land an entry imported from ANOTHER replica's tier (a host-
         to-host copy — the arrays are copied so the source replica's
         reset can never invalidate them)."""
-        self._put(key, np.array(k, copy=True), np.array(v, copy=True), n)
+        self._put(key, {p: np.array(a, copy=True)
+                        for p, a in planes.items()}, n)
         pb = self.page_bytes()
         self.imports += 1
         self.bytes_imported += n * pb

@@ -180,7 +180,7 @@ class PagedKVCache:
     worst case)."""
 
     def __init__(self, cfg, slots: int, page_size: int, num_pages: int,
-                 max_pages: int, dtype=None, mesh=None):
+                 max_pages: int, dtype=None, mesh=None, quant=None):
         from ..models import llama
 
         self.cfg = cfg
@@ -190,8 +190,15 @@ class PagedKVCache:
         self.max_pages = int(max_pages)
         self.allocator = PageAllocator(self.num_pages)
         self.mesh = mesh
+        # quant ('int8' | 'fp8', r21): pages store the narrow dtype and
+        # the pool gains per-page fp32 scale planes ("ks"/"vs"). All
+        # page BOOKKEEPING here is dtype-oblivious — only the plane set
+        # changes, and every page-granular copy below iterates the pool
+        # dict instead of naming k/v
+        self.quant = quant
         self.pool = llama.init_paged_pool(cfg, self.num_pages,
-                                          self.page_size, dtype=dtype)
+                                          self.page_size, dtype=dtype,
+                                          quant=quant)
         self.page_table = jnp.zeros((self.slots, self.max_pages),
                                     jnp.int32)
         if mesh is not None:
@@ -303,10 +310,10 @@ class PagedKVCache:
         if self.allocator.ref(page) <= 1:
             return page
         new = self.allocator.alloc(1)[0]
-        self.pool = {
-            "k": self.pool["k"].at[:, new].set(self.pool["k"][:, page]),
-            "v": self.pool["v"].at[:, new].set(self.pool["v"][:, page]),
-        }
+        # every pool plane copies at page granularity (K/V rows AND any
+        # quantization scale rows — axis 1 is the page axis in all of them)
+        self.pool = {n: a.at[:, new].set(a[:, page])
+                     for n, a in self.pool.items()}
         self.allocator.release([page])
         self.slot_pages[slot][vpage] = new
         self.page_table = self.page_table.at[slot, vpage].set(new)

@@ -517,7 +517,8 @@ class PagedPrefixCache:
             return None
         pages = self.pager.allocator.alloc(n)
         _pool_notify("cache_retain", n, self.pager.allocator)
-        self.host_tier.upload(pages, host["k"][:, :n], host["v"][:, :n])
+        names = self.host_tier.planes()
+        self.host_tier.upload(pages, {p: host[p][:, :n] for p in names})
         if n < len(ent.tokens) // self.block:
             # partial restore truncates the entry (the hitting
             # request's own post-segment insert re-grows it); the host
@@ -530,8 +531,8 @@ class PagedPrefixCache:
             if key in self._entries:
                 self._evict(key, reason="subsumed")
             self._entries[key] = ent
-            self.host_tier._put(key, np.asarray(host["k"][:, :n]),
-                                np.asarray(host["v"][:, :n]), n)
+            self.host_tier._put(key, {p: np.asarray(host[p][:, :n])
+                                      for p in names}, n)
         ent.pages = list(pages)
         self._entries.move_to_end(key)
         self._pages_held += n
@@ -552,14 +553,18 @@ class PagedPrefixCache:
         if host is None:
             return None
         n = host["pages"]
-        return {"tokens": ent.tokens[:n * self.block], "k": host["k"],
-                "v": host["v"], "pages": n}
+        out = {"tokens": ent.tokens[:n * self.block], "pages": n}
+        out.update({p: host[p] for p in self.host_tier.planes()})
+        return out
 
-    def import_host(self, tokens, k, v) -> bool:
+    def import_host(self, tokens, planes) -> bool:
         """Land an entry exported from another replica's tier as a
         HOST-tier entry of THIS cache (no HBM pages yet — the next hit
         restores through the normal path). The fleet's migration-on-
-        miss: importing host bytes replaces recomputing the prefill."""
+        miss: importing host bytes replaces recomputing the prefill.
+        ``planes`` maps pool plane name -> host array (every plane of
+        the exporter's pool — both replicas of a fleet run the same
+        pool dtype, so the plane sets match)."""
         if self.host_tier is None:
             return False
         tokens = np.asarray(tokens, np.int32).reshape(-1)
@@ -572,8 +577,8 @@ class PagedPrefixCache:
             return False                  # already present locally
         ent = _PagedEntry(tokens, [])
         self._entries[key] = ent
-        self.host_tier.note_import(key, np.asarray(k)[:, :n],
-                                   np.asarray(v)[:, :n], n)
+        self.host_tier.note_import(
+            key, {p: np.asarray(a)[:, :n] for p, a in planes.items()}, n)
         self._notify_listeners("insert", key, ent)
         return True
 

@@ -316,13 +316,28 @@ def _is_dense(engine) -> bool:
     return not engine.paged
 
 
+def _quant(engine) -> Optional[str]:
+    # getattr: coverage's replay probes run against lightweight engine
+    # stand-ins in some tests; absent attr means not quantized
+    return getattr(engine, "quant", None)
+
+
 def _is_paged_plain(engine) -> bool:
     return (engine.paged and not engine.chunked and not engine.speculative
-            and not engine.sampling and not engine.quality_digest)
+            and not engine.sampling and not engine.quality_digest
+            and not _quant(engine))
 
 
 def _is_paged_quality(engine) -> bool:
-    return engine.paged and engine.quality_digest
+    return engine.paged and engine.quality_digest and not _quant(engine)
+
+
+def _is_paged_quant(engine) -> bool:
+    # r21: quant subsumes the plain/quality split — a quantized engine's
+    # every paged segment (digests included) lives on the qpseg dtype
+    # axis, because the compiled programs differ (narrow pool dtype +
+    # scale planes) even where the loop structure is identical
+    return engine.paged and bool(_quant(engine))
 
 
 def _is_paged_chunked(engine) -> bool:
@@ -394,6 +409,18 @@ def _enum_qseg(engine, env: WorkloadEnvelope) -> Iterable[tuple]:
                 yield fam.key(n_pad=n_pad, s_max=w, steps=steps)
 
 
+def _enum_qpseg(engine, env: WorkloadEnvelope) -> Iterable[tuple]:
+    from ..quantization.serving import QUANT_CODES
+
+    fam = PROGRAM_SPACE.family("qpseg")
+    code = QUANT_CODES[_quant(engine)]
+    for n_pad in _n_pads(engine, env):
+        for steps in env.seg_steps:
+            for w in _reachable_widths(engine, env, spec_pinned=False):
+                yield fam.key(n_pad=n_pad, s_max=w, steps=steps,
+                              dtype=code)
+
+
 def _enum_cseg(engine, env: WorkloadEnvelope) -> Iterable[tuple]:
     fam = PROGRAM_SPACE.family("cseg")
     for n_pad in _n_pads(engine, env):
@@ -448,6 +475,14 @@ PROGRAM_SPACE.register(ProgramFamily(
     name="qseg", tag="qseg", axes=("n_pad", "s_max", "steps"),
     doc="r17 quality-digest paged segment: ('qseg', n_pad, s_max, steps)",
     enumerate_fn=_enum_qseg, applies=_is_paged_quality))
+
+PROGRAM_SPACE.register(ProgramFamily(
+    name="qpseg", tag="qpseg", axes=("n_pad", "s_max", "steps", "dtype"),
+    doc="r21 quantized paged segment: ('qpseg', n_pad, s_max, steps, "
+        "dtype) — dtype is the declared QUANT_CODES code (int8=1, "
+        "fp8=2); quality digests compose without a new axis (coverage "
+        "is per-engine, and an engine fixes its digest setting)",
+    enumerate_fn=_enum_qpseg, applies=_is_paged_quant))
 
 PROGRAM_SPACE.register(ProgramFamily(
     name="cseg", tag="cseg", axes=("n_pad", "s_max", "c", "steps"),

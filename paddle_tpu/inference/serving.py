@@ -307,7 +307,8 @@ class ServingEngine:
                  sampling: Optional[dict] = None,
                  sample_seed: int = 0,
                  quality_digest: bool = False,
-                 digest_top_k: int = 4):
+                 digest_top_k: int = 4,
+                 quant: Optional[str] = None):
         self.cfg = cfg
         self.params = params
         self.slots = int(slots)
@@ -426,6 +427,47 @@ class ServingEngine:
             if self.digest_top_k < 1:
                 raise ValueError(f"digest_top_k must be >= 1, got "
                                  f"{digest_top_k}")
+        # r21 quantized serving (ISSUE 16): ``quant`` = "int8" | "fp8"
+        # shrinks the decode tick's HBM stream — the LAST roofline lever
+        # after r15 speculation multiplied tokens per stream. Weights
+        # re-quantize at build (per-output-channel scales ride the param
+        # tree as ``<name>_scale`` companions; dequant happens in-kernel
+        # on the TPU path, adjacent-to-dot on the dense fallback) and
+        # the KV pool carries the narrow dtype with per-page scale
+        # planes ("ks"/"vs") keyed by physical page id — COW, refcounts
+        # and the host tier treat pages dtype-obliviously, so prefix
+        # sharing and r19 spill survive unchanged. Paged-only: the
+        # quantized programs are a new DTYPE AXIS on the paged segment
+        # family ("qpseg"), enumerated and AOT-warmed like every other
+        # rung. Composes with quality_digest (the shadow-diff quality
+        # bar that certifies the rollout); mesh / chunked / speculative
+        # / sampled combos are rejected until they earn their own
+        # certification.
+        self.quant = str(quant) if quant else None
+        if self.quant:
+            from ..quantization.serving import (QUANT_MODES,
+                                                quantize_llama_params)
+
+            if self.quant not in QUANT_MODES:
+                raise ValueError(f"quant must be one of {QUANT_MODES}, "
+                                 f"got {quant!r}")
+            if not self.paged:
+                raise ValueError(
+                    "quant requires paged=True (per-page KV scales ride "
+                    "the paged pool's fixed tiles; the contiguous cache "
+                    "has no page axis to key them on)")
+            if mesh is not None:
+                raise ValueError(
+                    "quant under a mesh is not supported — the scale "
+                    "companions would need their own param_specs entry "
+                    "before the sharded dequant is certified")
+            if self.chunked or self.speculative or self.sampling:
+                raise ValueError(
+                    "quant composes with the plain paged segment (and "
+                    "quality_digest) only — chunked/speculative/sampled "
+                    "variants need their own shadow certification")
+            self.params = quantize_llama_params(self.params, cfg,
+                                                self.quant)
         # acceptance EWMA (emitted tokens per verify tick, >= 1): the
         # SLO scheduler threads this through its deadline and
         # retry_after_s estimates so speculative serves don't over-shed
@@ -448,7 +490,7 @@ class ServingEngine:
             self.pager = PagedKVCache(
                 cfg, self.slots, self.page_size,
                 num_pages=int(num_pages or self.slots * max_pages + 1),
-                max_pages=max_pages, mesh=mesh)
+                max_pages=max_pages, mesh=mesh, quant=self.quant)
             self._cache = None  # no contiguous block exists in paged mode
         else:
             self.pager = None
@@ -549,7 +591,9 @@ class ServingEngine:
         C drawn from the declared prefill_chunks ladder, speculative/
         sampled segments on ("sseg", n_pad, K, steps) with the admit
         width PINNED to the largest bucket, quality-digest paged
-        segments on ("qseg", n_pad, s_max, steps) — all bucketed by
+        segments on ("qseg", n_pad, s_max, steps), quantized paged
+        segments on ("qpseg", n_pad, s_max, steps, dtype) with dtype
+        drawn from the declared QUANT_CODES — all bucketed by
         construction, so key-count growth here means a shape leaked
         past the buckets (the 2.5 s mid-serve compile class this
         engine's width pinning fixed). Note the PAGED keys carry no
@@ -575,9 +619,22 @@ class ServingEngine:
         paged serving lane asserts it like ``decode_kernel_active``)."""
         from ..ops.pallas.paged_attention import paged_attention_active
 
-        return self.paged and paged_attention_active(
+        # a quantized pool takes the dequantizing gather path instead of
+        # the page-indirect kernel (its per-page scales need the
+        # gather); the weight stream is where the quant bytes win
+        return self.paged and not self.quant and paged_attention_active(
             self.page_size, self.cfg.num_heads, self.cfg.num_kv_heads,
             self.cfg.head_dim)
+
+    def quant_kernel_active(self) -> bool:
+        """True when this engine's quantized projection matmuls route to
+        the in-kernel-dequant Pallas path (trace-time dispatch — the
+        quant serving lane asserts it like ``decode_kernel_active``;
+        CPU tier-1 exercises the same kernel through FORCE_INTERPRET)."""
+        from ..ops.pallas.tick_fusion import quant_matmul_active
+
+        H = self.cfg.hidden_size
+        return bool(self.quant) and quant_matmul_active(H, H)
 
     # --- request intake ---------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int,
@@ -621,7 +678,8 @@ class ServingEngine:
                 self.paged, self.pager.max_pages if self.paged else None,
                 self.mesh, self.speculative, self.sampling,
                 self.chunked, self.prefill_chunks, self.buckets,
-                self.digest_top_k if self.quality_digest else None, key)
+                self.digest_top_k if self.quality_digest else None,
+                self.quant, key)
 
     def _memo_prog(self, key: tuple, build):
         """Two-level memo: per-engine ``_progs`` (the recompile lint's
@@ -953,8 +1011,11 @@ class ServingEngine:
                     jnp.zeros((n_pad, L, pre_max, Hkv, D), kdt),
                     jnp.zeros((n_pad,), i32), i32(0))
                 (self._cache, self._pos, self._nxt, self._rem) = out[:4]
-            elif family in {"pseg", "qseg", "cseg"}:
-                n_pad, s_max, steps = key[1], key[2], key[-1]
+            elif family in {"pseg", "qseg", "cseg", "qpseg"}:
+                # qpseg keys carry a trailing dtype code; steps sits at
+                # a fixed index there, key[-1] everywhere else
+                n_pad, s_max = key[1], key[2]
+                steps = key[3] if family == "qpseg" else key[-1]
                 prog = (self._chunked_segment_prog(n_pad, s_max, key[3],
                                                    steps)
                         if family == "cseg"
@@ -1902,6 +1963,25 @@ class ServingEngine:
         stream (SCALING §3l) — and ride the SAME audited fetch, so the
         one-dispatch/one-fetch contract is untouched (the
         quality_serving_segment gate program pins it)."""
+        if self.quant:
+            # r21: the quantized engine's segments are a DTYPE AXIS on
+            # the paged family — the program BODY is identical (the
+            # narrow pool dtype + scale planes flow through
+            # llama.forward_with_pages from the donated pool operand);
+            # the axis exists so the coverage auditor enumerates and
+            # warms the quantized rungs separately (their compiled
+            # programs differ, so their keys must too). quality_digest
+            # composes: the digest columns certify the rollout.
+            from ..quantization.serving import QUANT_CODES
+
+            key = PROGRAM_SPACE.key("qpseg", n_pad=n_pad, s_max=s_max,
+                                    steps=max_steps,
+                                    dtype=QUANT_CODES[self.quant])
+            return self._memo_prog(
+                key, lambda: self._build_paged_segment_prog(
+                    n_pad, s_max, max_steps,
+                    digest_k=(self.digest_top_k if self.quality_digest
+                              else 0)))
         if self.quality_digest:
             key = PROGRAM_SPACE.key("qseg", n_pad=n_pad, s_max=s_max,
                                     steps=max_steps)

@@ -315,6 +315,54 @@ def _act_spec(cfg: LlamaConfig) -> P:
     return P(("dp", "sharding"), seq, None)
 
 
+def _w(p, name, dt):
+    """Weight ``name`` from a param/layer dict at compute dtype ``dt``.
+
+    Quantized serving trees (quantization/serving.py) store matmul
+    weights narrow (int8/fp8) with a companion per-output-channel
+    ``<name>_scale`` fp32 plane; the dense dequantize here sits
+    adjacent to the consuming dot so XLA fuses convert+scale into the
+    operand read — the CPU/mesh fallback of the in-kernel-dequant
+    Pallas path (see ``_mm``). fp trees pass straight through."""
+    sc = p.get(name + "_scale")
+    if sc is None:
+        return p[name].astype(dt)
+    return (p[name].astype(jnp.float32)
+            * sc.astype(jnp.float32)[..., None, :]).astype(dt)
+
+
+def _mm(h, p, name, dt):
+    """``h @ weight[name]`` — the one projection-matmul site shared by
+    fp and quantized param trees. On the 2D decode tick with a narrow
+    weight, dispatch to the Pallas quant matmul (HBM streams the
+    narrow dtype; dequant and fp32 accumulation happen in VMEM —
+    ops/pallas/tick_fusion.py); everywhere else the dense
+    dequantize-then-dot is the same math."""
+    sc = p.get(name + "_scale")
+    if sc is None:
+        return h @ p[name].astype(dt)
+    w = p[name]
+    if h.ndim == 2 and w.ndim == 2:
+        from ..ops.pallas.tick_fusion import (quant_matmul,
+                                              quant_matmul_active)
+
+        if quant_matmul_active(w.shape[0], w.shape[1]):
+            return quant_matmul(h, w, sc).astype(dt)
+    return h @ _w(p, name, dt)
+
+
+def layer_params(params, cfg: "LlamaConfig"):
+    """Per-layer stacked weights for the forward paths: ``layer_keys``
+    plus any companion quantization ``_scale`` planes (stacked on the
+    same leading [L] axis, so they scan/slice identically)."""
+    out = {}
+    for kk in layer_keys(cfg):
+        out[kk] = params[kk]
+        if kk + "_scale" in params:
+            out[kk + "_scale"] = params[kk + "_scale"]
+    return out
+
+
 def _qkv_proj(cfg: LlamaConfig, x, lp, positions=None):
     """rms → q/k/v projections → rope at ``positions`` (default 0..S-1).
     Returns q [B,S,nH,D] and UNREPEATED k/v [B,S,Hkv,D] — the single
@@ -328,12 +376,12 @@ def _qkv_proj(cfg: LlamaConfig, x, lp, positions=None):
     Hq = cfg.num_heads * cfg.head_dim
     Hkv = cfg.num_kv_heads * cfg.head_dim
     if cfg.fused_weights:
-        z = h @ lp["wqkv"].astype(dt)
+        z = _mm(h, lp, "wqkv", dt)
         zq, zk, zv = (z[..., :Hq], z[..., Hq:Hq + Hkv], z[..., Hq + Hkv:])
     else:
-        zq = h @ lp["wq"].astype(dt)
-        zk = h @ lp["wk"].astype(dt)
-        zv = h @ lp["wv"].astype(dt)
+        zq = _mm(h, lp, "wq", dt)
+        zk = _mm(h, lp, "wk", dt)
+        zv = _mm(h, lp, "wv", dt)
     if "qkv" in cfg.bwd_barriers:
         zq, zk, zv = map(_barrier_grad, (zq, zk, zv))
     q = zq.reshape(B, S, cfg.num_heads, cfg.head_dim)
@@ -364,20 +412,20 @@ def _layer_post(cfg: LlamaConfig, x, attn, lp):
     B, S, H = x.shape
     dt = x.dtype
     attn = attn.reshape(B, S, H)
-    x = x + wsc(attn @ lp["wo"].astype(dt), _act_spec(cfg))
+    x = x + wsc(_mm(attn, lp, "wo", dt), _act_spec(cfg))
     h = _rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
     if cfg.fused_weights:
         F_ = cfg.intermediate_size
-        zz = h @ lp["w_gate_up"].astype(dt)
+        zz = _mm(h, lp, "w_gate_up", dt)
         zg, up = zz[..., :F_], zz[..., F_:]
     else:
-        zg = h @ lp["w_gate"].astype(dt)
-        up = h @ lp["w_up"].astype(dt)
+        zg = _mm(h, lp, "w_gate", dt)
+        up = _mm(h, lp, "w_up", dt)
     if "mlp" in cfg.bwd_barriers:
         zg = _barrier_grad(zg)
         up = _barrier_grad(up)
     gate = jax.nn.silu(zg)
-    x = x + wsc((gate * up) @ lp["w_down"].astype(dt), _act_spec(cfg))
+    x = x + wsc(_mm(gate * up, lp, "w_down", dt), _act_spec(cfg))
     return x
 
 
@@ -862,12 +910,12 @@ def _decode_qkv(cfg: LlamaConfig, x, lp, pos_b):
     Hq = cfg.num_heads * cfg.head_dim
     Hkv = cfg.num_kv_heads * cfg.head_dim
     if cfg.fused_weights:
-        z = h @ lp["wqkv"].astype(dt)
+        z = _mm(h, lp, "wqkv", dt)
         zq, zk, zv = (z[..., :Hq], z[..., Hq:Hq + Hkv], z[..., Hq + Hkv:])
     else:
-        zq = h @ lp["wq"].astype(dt)
-        zk = h @ lp["wk"].astype(dt)
-        zv = h @ lp["wv"].astype(dt)
+        zq = _mm(h, lp, "wq", dt)
+        zk = _mm(h, lp, "wk", dt)
+        zv = _mm(h, lp, "wv", dt)
     zq, zk = fused_rope_qk(zq, zk, pos_b, cfg.head_dim, cfg.rope_theta)
     q = zq.reshape(B, 1, cfg.num_heads, cfg.head_dim)
     k = zk.reshape(B, 1, cfg.num_kv_heads, cfg.head_dim)
@@ -883,16 +931,16 @@ def _decode_post(cfg: LlamaConfig, x, attn, lp):
 
     B, _, H = x.shape
     dt = x.dtype
-    o = attn.reshape(B, H) @ lp["wo"].astype(dt)
+    o = _mm(attn.reshape(B, H), lp, "wo", dt)
     x2, h = fused_add_rms_norm(x[:, 0], o, lp["ln_mlp"], cfg.rms_eps)
     if cfg.fused_weights:
         F_ = cfg.intermediate_size
-        zz = h @ lp["w_gate_up"].astype(dt)
+        zz = _mm(h, lp, "w_gate_up", dt)
         zg, up = zz[..., :F_], zz[..., F_:]
     else:
-        zg = h @ lp["w_gate"].astype(dt)
-        up = h @ lp["w_up"].astype(dt)
-    x3 = x2 + (jax.nn.silu(zg) * up) @ lp["w_down"].astype(dt)
+        zg = _mm(h, lp, "w_gate", dt)
+        up = _mm(h, lp, "w_up", dt)
+    x3 = x2 + _mm(jax.nn.silu(zg) * up, lp, "w_down", dt)
     return x3[:, None]
 
 
@@ -914,7 +962,7 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos,
     if ragged and T != 1:
         raise ValueError("per-slot pos requires single-token decode (T=1)")
     positions = pos[:, None] if ragged else pos + jnp.arange(T)
-    layer_weights = {kk: params[kk] for kk in layer_keys(cfg)}
+    layer_weights = layer_params(params, cfg)
 
     # fused tick epilogue: single-token decode collapses each
     # between-matmul small-op chain into one Pallas op (dispatch-gated;
@@ -990,11 +1038,12 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache, pos,
     else:
         last = jax.lax.dynamic_index_in_dim(x, logit_pos, axis=1,
                                             keepdims=False)
-    logits = last @ params["lm_head"].astype(dt)  # [B, V]
+    logits = _mm(last, params, "lm_head", dt)  # [B, V]
     return logits.astype(jnp.float32), {"k": kcs, "v": vcs}
 
 
-def _paged_attention(cfg: LlamaConfig, q, kc, vc, page_table, positions):
+def _paged_attention(cfg: LlamaConfig, q, kc, vc, page_table, positions,
+                     ks=None, vs=None):
     """Attention over a paged KV pool. q [B,T,nH,D]; kc/vc
     [P, page_size, Hkv, D] (the flat pool); page_table [B, max_pages];
     ``positions`` [B, T] absolute query positions (row t of slot b at
@@ -1002,19 +1051,32 @@ def _paged_attention(cfg: LlamaConfig, q, kc, vc, page_table, positions):
     to the unified page-indirect Pallas kernel when the shape tiles
     (per-slot KV reads scale with position); the fallback gathers the
     slot's pages into a contiguous window and reuses the dense
-    formulation — identical math, CPU/tier-1's path."""
+    formulation — identical math, CPU/tier-1's path.
+
+    ``ks``/``vs`` ([P, page_size] fp32, optional): a QUANTIZED pool's
+    per-page scale planes — the gather fetches the scale rows with
+    their pages and dequantizes the [B, W] window before the dense
+    contraction (so HBM→gather traffic carried the narrow dtype; the
+    slot-contiguous kernel analog dequantizes in VMEM —
+    ops/pallas/decode_attention.py)."""
     from ..ops.pallas.paged_attention import (paged_attention_active,
                                               ragged_paged_attention)
 
     B, T = q.shape[:2]
     psz = kc.shape[1]
-    if paged_attention_active(psz, cfg.num_heads, cfg.num_kv_heads,
-                              cfg.head_dim):
+    if ks is None and paged_attention_active(psz, cfg.num_heads,
+                                             cfg.num_kv_heads, cfg.head_dim):
         return ragged_paged_attention(q, kc, vc, page_table,
                                       positions[:, 0])
+    dt = q.dtype
     W = page_table.shape[1] * psz
-    gk = kc[page_table].reshape(B, W, kc.shape[2], kc.shape[3])
-    gv = vc[page_table].reshape(B, W, vc.shape[2], vc.shape[3])
+    gk = kc[page_table]
+    gv = vc[page_table]
+    if ks is not None:
+        gk = gk.astype(dt) * ks[page_table][..., None, None].astype(dt)
+        gv = gv.astype(dt) * vs[page_table][..., None, None].astype(dt)
+    gk = gk.reshape(B, W, kc.shape[2], kc.shape[3])
+    gv = gv.reshape(B, W, vc.shape[2], vc.shape[3])
     return _dense_cache_attention(cfg, q, gk, gv, positions)
 
 
@@ -1055,7 +1117,16 @@ def forward_with_pages(params, tokens, cfg: LlamaConfig, pool, page_table,
     if live is not None:
         writable = writable & live[:, None]
     phys = jnp.where(writable, phys, 0)
-    layer_weights = {kk: params[kk] for kk in layer_keys(cfg)}
+    layer_weights = layer_params(params, cfg)
+
+    # quantized pool: K/V pages carry a narrow dtype plus per-page fp32
+    # scale planes (one scale per cache row — see init_paged_pool); new
+    # rows quantize at write time and their scales land at the SAME
+    # [phys, prow] coordinates, so trash-page routing, COW and spill
+    # stay dtype-oblivious
+    quant = "ks" in pool
+    if quant:
+        from ..quantization.serving import quantize_kv_rows
 
     fused_tick = T == 1 and _tick_fused_active(cfg)
 
@@ -1068,26 +1139,50 @@ def forward_with_pages(params, tokens, cfg: LlamaConfig, pool, page_table,
                 else _layer_post(cfg, x, attn, lp))
 
     def body(x, per_layer):
-        lp, kc, vc = per_layer
+        if quant:
+            lp, kc, vc, ks, vs = per_layer
+        else:
+            (lp, kc, vc), ks, vs = per_layer, None, None
         q, k_new, v_new = _qkv(x, lp)
+        if quant:
+            k_new, k_sc = quantize_kv_rows(k_new, kc.dtype)
+            v_new, v_sc = quantize_kv_rows(v_new, vc.dtype)
+            ks = ks.at[phys, prow].set(k_sc)
+            vs = vs.at[phys, prow].set(v_sc)
         kc = kc.at[phys, prow].set(k_new.astype(kc.dtype))
         vc = vc.at[phys, prow].set(v_new.astype(vc.dtype))
-        attn = _paged_attention(cfg, q, kc, vc, page_table, positions)
-        return _post(x, attn, lp), (kc, vc)
+        attn = _paged_attention(cfg, q, kc, vc, page_table, positions,
+                                ks=ks, vs=vs)
+        planes = (kc, vc, ks, vs) if quant else (kc, vc)
+        return _post(x, attn, lp), planes
 
+    plane_names = ("k", "v", "ks", "vs") if quant else ("k", "v")
     if cfg.scan_layers:
-        x, (kps, vps) = jax.lax.scan(body, x,
-                                     (layer_weights, pool["k"], pool["v"]))
+        x, planes = jax.lax.scan(
+            body, x,
+            (layer_weights,) + tuple(pool[n] for n in plane_names))
+        new_pool = dict(zip(plane_names, planes))
     else:
-        kps, vps = pool["k"], pool["v"]
+        planes = {n: pool[n] for n in plane_names}
         for i in range(cfg.num_layers):
             lp = {kk: layer_weights[kk][i] for kk in layer_weights}
             q, k_new, v_new = _qkv(x, lp)
-            kps = kps.at[i, phys, prow].set(k_new.astype(kps.dtype))
-            vps = vps.at[i, phys, prow].set(v_new.astype(vps.dtype))
-            attn = _paged_attention(cfg, q, kps[i], vps[i], page_table,
-                                    positions)
+            if quant:
+                k_new, k_sc = quantize_kv_rows(k_new, planes["k"].dtype)
+                v_new, v_sc = quantize_kv_rows(v_new, planes["v"].dtype)
+                planes["ks"] = planes["ks"].at[i, phys, prow].set(k_sc)
+                planes["vs"] = planes["vs"].at[i, phys, prow].set(v_sc)
+            planes["k"] = planes["k"].at[i, phys, prow].set(
+                k_new.astype(planes["k"].dtype))
+            planes["v"] = planes["v"].at[i, phys, prow].set(
+                v_new.astype(planes["v"].dtype))
+            attn = _paged_attention(
+                cfg, q, planes["k"][i], planes["v"][i], page_table,
+                positions,
+                ks=planes["ks"][i] if quant else None,
+                vs=planes["vs"][i] if quant else None)
             x = _post(x, attn, lp)
+        new_pool = planes
     if fused_tick:
         from ..ops.pallas.tick_fusion import fused_rms_norm
 
@@ -1095,8 +1190,8 @@ def forward_with_pages(params, tokens, cfg: LlamaConfig, pool, page_table,
     else:
         x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
     if logits_all:
-        logits = x @ params["lm_head"].astype(dt)     # [B, T, V]
-        return logits.astype(jnp.float32), {"k": kps, "v": vps}
+        logits = _mm(x, params, "lm_head", dt)        # [B, T, V]
+        return logits.astype(jnp.float32), new_pool
     if logit_pos is None:
         last = x[:, -1]
     elif getattr(logit_pos, "ndim", 0) == 1:
@@ -1104,18 +1199,35 @@ def forward_with_pages(params, tokens, cfg: LlamaConfig, pool, page_table,
     else:
         last = jax.lax.dynamic_index_in_dim(x, logit_pos, axis=1,
                                             keepdims=False)
-    logits = last @ params["lm_head"].astype(dt)  # [B, V]
-    return logits.astype(jnp.float32), {"k": kps, "v": vps}
+    logits = _mm(last, params, "lm_head", dt)  # [B, V]
+    return logits.astype(jnp.float32), new_pool
 
 
 def init_paged_pool(cfg: LlamaConfig, num_pages: int, page_size: int,
-                    dtype=None) -> Dict[str, jax.Array]:
+                    dtype=None, quant=None) -> Dict[str, jax.Array]:
     """Flat paged K/V pool: [L, num_pages, page_size, Hkv, D]. Page 0 is
-    the allocator's reserved trash page (see inference/paged_kv.py)."""
-    dtype = dtype or cfg.dtype
+    the allocator's reserved trash page (see inference/paged_kv.py).
+
+    ``quant`` ('int8' | 'fp8'): K/V pages store the narrow dtype and the
+    pool carries per-page fp32 scale planes ``ks``/``vs``
+    [L, num_pages, page_size] — one scale per cache row, keyed by
+    physical page id so every page-granular mechanism (COW copies,
+    refcounts, host-tier spill, fleet migration) moves scales with
+    their pages without knowing the dtype."""
+    if quant is not None:
+        from ..quantization.serving import quant_dtype
+
+        dtype = quant_dtype(quant)
+    else:
+        dtype = dtype or cfg.dtype
     shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
              cfg.head_dim)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    pool = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if quant is not None:
+        sshape = (cfg.num_layers, num_pages, page_size)
+        pool["ks"] = jnp.zeros(sshape, jnp.float32)
+        pool["vs"] = jnp.zeros(sshape, jnp.float32)
+    return pool
 
 
 def prompt_kv(params, prompt, cfg: LlamaConfig,

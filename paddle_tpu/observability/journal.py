@@ -625,6 +625,7 @@ def describe_engine(engine) -> dict:
         "sample_seed": engine.sample_seed, "mesh": mesh,
         "quality_digest": getattr(engine, "quality_digest", False),
         "digest_top_k": getattr(engine, "digest_top_k", 4),
+        "quant": getattr(engine, "quant", None),
         "next_rid": engine._next_rid,
         "spec_accept_ewma": engine.spec_accept_ewma,
     }

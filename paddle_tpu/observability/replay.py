@@ -129,7 +129,10 @@ def _engine_from(d: dict, cfg, params):
         speculative=d["speculative"], sampling=d["sampling"],
         sample_seed=d["sample_seed"],
         quality_digest=d.get("quality_digest", False),
-        digest_top_k=d.get("digest_top_k", 4))
+        digest_top_k=d.get("digest_top_k", 4),
+        # r21: the engine re-quantizes the fp params in __init__, so a
+        # recorded quantized serve rebuilds from the SAME fp tree
+        quant=d.get("quant"))
     if d["paged"]:
         kw["page_size"] = d["page_size"]
         kw["num_pages"] = d["num_pages"]

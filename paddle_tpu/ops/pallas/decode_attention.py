@@ -74,10 +74,19 @@ def kv_blocks_read(pos, block_k: int):
     return pos // block_k + 1
 
 
-def _make_kernel(nH: int, Hkv: int, D: int, block_k: int, n_blocks: int):
+def _make_kernel(nH: int, Hkv: int, D: int, block_k: int, n_blocks: int,
+                 quant: bool = False):
     rep = nH // Hkv
 
-    def kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+    def kernel(pos_ref, q_ref, k_ref, v_ref, *rest):
+        if quant:
+            # per-row KV scales ride along as [1, block_k] blocks under
+            # the SAME clamped index map as their K/V rows — the HBM
+            # stream carried the narrow dtype; dequant happens here, on
+            # VMEM-resident tiles (r21 quantized serving)
+            sk_ref, sv_ref, o_ref, acc_ref, m_ref, l_ref = rest
+        else:
+            o_ref, acc_ref, m_ref, l_ref = rest
         b = pl.program_id(0)
         j = pl.program_id(1)
         pos = pos_ref[b]
@@ -97,6 +106,9 @@ def _make_kernel(nH: int, Hkv: int, D: int, block_k: int, n_blocks: int):
             for h in range(Hkv):
                 kh = k_ref[0, :, h * D:(h + 1) * D]       # [block_k, D]
                 qh = q[h * rep:(h + 1) * rep]             # [rep, D]
+                if quant:
+                    kh = kh.astype(jnp.float32) * sk_ref[0][:, None]
+                    qh = qh.astype(jnp.float32)
                 parts.append(jax.lax.dot_general(
                     qh, kh, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32))
@@ -109,10 +121,12 @@ def _make_kernel(nH: int, Hkv: int, D: int, block_k: int, n_blocks: int):
             p = jnp.exp(s - m_new)
             alpha = jnp.exp(m_prev - m_new)  # block 0: exp(-inf - m) = 0
             l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-            pb = p.astype(v_ref.dtype)
+            pb = p if quant else p.astype(v_ref.dtype)
             pv_parts = []
             for h in range(Hkv):
                 vh = v_ref[0, :, h * D:(h + 1) * D]       # [block_k, D]
+                if quant:
+                    vh = vh.astype(jnp.float32) * sv_ref[0][:, None]
                 ph = pb[h * rep:(h + 1) * rep]            # [rep, block_k]
                 pv_parts.append(jax.lax.dot_general(
                     ph, vh, (((1,), (0,)), ((), ())),
@@ -131,7 +145,8 @@ def _make_kernel(nH: int, Hkv: int, D: int, block_k: int, n_blocks: int):
 
 
 def ragged_decode_attention(q, kc, vc, pos, scale=None, block_k: int = 0,
-                            interpret: bool = False):
+                            interpret: bool = False, k_scale=None,
+                            v_scale=None):
     """Single-token decode attention with per-slot ragged KV reads.
 
     q: [B, nH, D]; kc/vc: [B, max_len, Hkv, D] (the slot-contiguous
@@ -139,9 +154,16 @@ def ragged_decode_attention(q, kc, vc, pos, scale=None, block_k: int = 0,
     ``pos`` holds the token being decoded, already scattered by the
     caller). Returns [B, nH, D] in q.dtype. Falls back to raising on
     untileable shapes — callers gate with ``decode_attention_active``.
+
+    ``k_scale``/``v_scale`` ([B, max_len] fp32, optional): a QUANTIZED
+    cache's per-row scales (r21). Their [1, block_k] blocks ride the
+    same clamped index maps as the K/V blocks, so the per-slot
+    bytes-read property holds for them too, and the kernel dequantizes
+    narrow K/V tiles in VMEM — HBM carried int8/fp8.
     """
     B, nH, D = q.shape
     Smax, Hkv = kc.shape[1], kc.shape[2]
+    quant = k_scale is not None
     _selected["count"] += 1  # trace-time: once per compiled program
     block_k = block_k or pick_kv_block(Smax)
     if not block_k or Smax % block_k:
@@ -161,14 +183,24 @@ def ragged_decode_attention(q, kc, vc, pos, scale=None, block_k: int = 0,
         # is the entire "read only [0, pos)" property
         return (b, jnp.minimum(j, pos_ref[b] // block_k), 0)
 
+    def sc_map(b, j, pos_ref):
+        return (b, jnp.minimum(j, pos_ref[b] // block_k))
+
+    in_specs = [
+        pl.BlockSpec((1, nH, D), lambda b, j, pos_ref: (b, 0, 0)),
+        pl.BlockSpec((1, block_k, Hkv * D), kv_map),
+        pl.BlockSpec((1, block_k, Hkv * D), kv_map),
+    ]
+    operands = [qs, kf, vf]
+    if quant:
+        in_specs += [pl.BlockSpec((1, block_k), sc_map),
+                     pl.BlockSpec((1, block_k), sc_map)]
+        operands += [jnp.asarray(k_scale, jnp.float32),
+                     jnp.asarray(v_scale, jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, n_blocks),
-        in_specs=[
-            pl.BlockSpec((1, nH, D), lambda b, j, pos_ref: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, Hkv * D), kv_map),
-            pl.BlockSpec((1, block_k, Hkv * D), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, nH, D), lambda b, j, pos_ref: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((nH, D), jnp.float32),    # fp32 accumulator
@@ -177,11 +209,11 @@ def ragged_decode_attention(q, kc, vc, pos, scale=None, block_k: int = 0,
         ],
     )
     return pl.pallas_call(
-        _make_kernel(nH, Hkv, D, block_k, n_blocks),
+        _make_kernel(nH, Hkv, D, block_k, n_blocks, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, nH, D), q.dtype),
         interpret=interpret or (FORCE_INTERPRET and not _on_tpu()),
-    )(jnp.asarray(pos, jnp.int32), qs, kf, vf)
+    )(jnp.asarray(pos, jnp.int32), *operands)
 
 
 # trace-time selection counter: incremented when the dispatch actually

@@ -37,7 +37,7 @@ from jax.experimental import pallas as pl
 from ... import flags
 
 __all__ = ["tick_fusion_active", "fused_rms_norm", "fused_add_rms_norm",
-           "fused_rope_qk"]
+           "fused_rope_qk", "quant_matmul", "quant_matmul_active"]
 
 # tests set this True to force the kernels (pallas interpret mode) on CPU
 FORCE_INTERPRET = False
@@ -163,3 +163,80 @@ def fused_rope_qk(zq, zk, pos, head_dim: int, theta: float):
                    jax.ShapeDtypeStruct((B, Hk), zk.dtype)],
         interpret=_interp(),
     )(jnp.asarray(pos, jnp.int32).reshape(B, 1), zq, zk)
+
+
+# ---------------------------------------------------------------------------
+# quantized weight matmul — the tick's weight stream carries int8/fp8;
+# dequantization happens in VMEM (r21, SCALING §3p)
+# ---------------------------------------------------------------------------
+
+
+def pick_n_block(N: int, prefer: int = 512) -> int:
+    """Largest lane-aligned output block that tiles ``N`` (0 = none).
+    Bigger blocks amortise the per-step overhead; the VMEM bound is the
+    [K, block_n] weight tile (int8: K*block_n bytes — 4 MB at
+    K=8192/block=512, comfortably pipelined)."""
+    for b in (prefer, 256, 128):
+        if b <= N and N % b == 0:
+            return b
+    return 0
+
+
+def _quant_matmul_kernel(x_ref, w_ref, s_ref, o_ref):
+    # the weight tile arrived in VMEM in its NARROW dtype (that was the
+    # whole HBM stream); dequantize here and accumulate in fp32
+    wf = w_ref[...].astype(jnp.float32) * s_ref[...].astype(jnp.float32)
+    o_ref[...] = jax.lax.dot_general(
+        x_ref[...].astype(jnp.float32), wf,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def quant_matmul(x, w, scale, block_n: int = 0, interpret: bool = False):
+    """``x @ (w * scale)`` with the dequantize INSIDE the kernel.
+
+    x: [B, K] fp activations; w: [K, N] int8 (or fp8/e4m3) weights;
+    scale: [N] fp32 per-output-channel scales. HBM→VMEM traffic for the
+    weight stream is the narrow dtype — the point of the whole exercise
+    (SCALING §3c bills the decode tick at weight-bytes/tick over HBM
+    bandwidth); the per-tile dequant multiply runs on VMEM-resident
+    data and the dot accumulates fp32. Grid tiles the output dim; x and
+    the [K, block] weight tiles are single-cell blocks. Returns [B, N]
+    fp32 (callers cast to the compute dtype). Gate call sites with
+    ``quant_matmul_active``."""
+    B, K = x.shape
+    N = w.shape[1]
+    block_n = block_n or pick_n_block(N)
+    if not block_n:
+        raise ValueError(f"N {N} has no lane-aligned block — gate callers "
+                         f"with quant_matmul_active")
+    return pl.pallas_call(
+        _quant_matmul_kernel,
+        grid=(N // block_n,),
+        in_specs=[
+            pl.BlockSpec((B, K), lambda j: (0, 0)),
+            pl.BlockSpec((K, block_n), lambda j: (0, j)),
+            pl.BlockSpec((1, block_n), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((B, block_n), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((B, N), jnp.float32),
+        interpret=interpret or _interp(),
+    )(x, w, jnp.asarray(scale, jnp.float32).reshape(1, N))
+
+
+def quant_matmul_active(K: int, N: int) -> bool:
+    """True when the quantized projection matmul should take the Pallas
+    in-kernel-dequant path: TPU (or the test force), kernels + flag
+    enabled, single device, sublane-aligned contraction dim and a
+    lane-aligned output block (tiny test configs and mesh paths fall
+    back to the dense XLA dequantize-then-dot — same math)."""
+    from .flash_attention import _multi_device_mesh_active, _on_tpu
+
+    f = flags.get_flags(["use_pallas_kernels", "use_quant_matmul"])
+    if not (f["use_pallas_kernels"] and f["use_quant_matmul"]):
+        return False
+    if not (_on_tpu() or FORCE_INTERPRET):
+        return False
+    if _multi_device_mesh_active():
+        return False
+    return K % 32 == 0 and bool(pick_n_block(N))

@@ -1,0 +1,168 @@
+"""Serving-time quantization: int8 / fp8 weight + KV-page narrowing (r21).
+
+SCALING §3c pins the decode tick as HBM-bound: every tick streams the
+full weight set plus the live KV window, so tok/s is bytes/tick over
+HBM bandwidth and the last multiplicative lever (after r15's
+speculation multiplied tokens per stream) is shrinking the stream
+itself. This module owns the NUMERIC side of that lever:
+
+* **Weight quantization** — every projection matrix (wq/wk/wv/wo,
+  w_gate/w_up/w_down, their fused forms, and lm_head) stored as int8
+  (or an fp8-shaped e4m3 emulation) with PER-OUTPUT-CHANNEL fp32
+  scales under companion ``<name>_scale`` keys. Same absmax recipe as
+  ``quantization._convert`` (the PTQ deploy path): per-out-channel
+  absmax over the contraction dim, symmetric round-to-nearest for
+  int8, direct e4m3 cast after scaling to the fp8 representable range.
+  Norms and the embedding stay fp — they are O(H) streams, not the
+  O(H²) matmul traffic the roofline bills.
+* **KV row quantization** — K/V rows narrowed to int8 with one fp32
+  scale per cache row, laid out as per-page scale planes
+  ``[L, num_pages, page_size]`` riding the paged pool's fixed page
+  tiles (``models.llama.init_paged_pool(quant=...)``): scales are
+  keyed by physical page id, so COW page copies, refcounts, host-tier
+  spill, and fleet migration move them with the page bytes while
+  staying dtype-oblivious.
+
+Dequantization placement is the consumers' business: the Pallas
+kernels (``ops.pallas.tick_fusion.quant_matmul``,
+``ops.pallas.decode_attention``) dequantize in VMEM so HBM traffic
+carries the narrow dtype; the dense XLA fallback
+(``models.llama._mm`` / the paged gather) dequantizes adjacent to the
+consuming dot, which XLA fuses into the operand read — identical math
+on CPU/mesh paths.
+
+Bit-identity across dtypes is explicitly NOT the bar (SCALING §3p):
+the quantized engine ships behind the r17 shadow/canary quality
+harness with token-match-rate + logit budgets as the certification.
+Within one dtype, everything here is deterministic — same params in,
+same quantized params out, every serve replays bit-exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+__all__ = [
+    "QUANT_MODES", "QUANT_CODES", "quant_dtype", "fp8_supported",
+    "quantized_weight_keys", "quantize_weight", "quantize_llama_params",
+    "dequantize_weight", "quantize_kv_rows", "kv_scale_floor",
+]
+
+# mode -> the int code ProgramFamily axes carry (program keys int-cast
+# their axis values; 0 is reserved for "not quantized")
+QUANT_MODES = ("int8", "fp8")
+QUANT_CODES = {"int8": 1, "fp8": 2}
+
+_INT8_QMAX = 127.0
+_E4M3_MAX = 448.0  # largest finite e4m3 magnitude
+# scale floor: a fully-zero channel/row must still produce a finite
+# scale (0/0-free dequant); matches quantization._convert's 1e-9 floor
+_SCALE_FLOOR = 1e-9
+
+
+def fp8_supported() -> bool:
+    """Does this jax build ship float8_e4m3fn? (The container's does;
+    the guard keeps the fp8 mode a clean ValueError elsewhere.)"""
+    return hasattr(jnp, "float8_e4m3fn")
+
+
+def quant_dtype(mode: str):
+    """Storage dtype for ``mode`` ('int8' | 'fp8')."""
+    if mode == "int8":
+        return jnp.int8
+    if mode == "fp8":
+        if not fp8_supported():
+            raise ValueError("fp8 quantization needs jnp.float8_e4m3fn, "
+                             "which this jax build does not provide")
+        return jnp.float8_e4m3fn
+    raise ValueError(f"unknown quant mode {mode!r}; expected one of "
+                     f"{QUANT_MODES}")
+
+
+def quantized_weight_keys(cfg) -> Tuple[str, ...]:
+    """The param keys weight quantization narrows: every per-layer
+    matmul weight (fused or split layout) plus lm_head. Norm gains and
+    the embedding stay fp."""
+    if cfg.fused_weights:
+        layer = ("wqkv", "wo", "w_gate_up", "w_down")
+    else:
+        layer = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+    return layer + ("lm_head",)
+
+
+def quantize_weight(w, mode: str):
+    """Quantize one weight to (narrow, per-output-channel fp32 scale).
+
+    ``w``: [..., in, out] (stacked [L, in, out] layer weights or the
+    plain [in, out] lm_head). The contraction (in) dim is reduced for
+    the absmax, so the scale is per-output-channel: shape [..., out].
+    int8: symmetric round-to-nearest into [-127, 127] (same recipe as
+    ``quantization._convert``). fp8: scale maps the channel absmax to
+    e4m3's finite range, then a direct cast — e4m3's own mantissa does
+    the rounding."""
+    wf = jnp.asarray(w, jnp.float32)
+    amax = jnp.maximum(jnp.max(jnp.abs(wf), axis=-2), _SCALE_FLOOR)
+    if mode == "int8":
+        scale = amax / _INT8_QMAX
+        q = jnp.clip(jnp.round(wf / scale[..., None, :]),
+                     -_INT8_QMAX, _INT8_QMAX).astype(jnp.int8)
+    else:
+        scale = amax / _E4M3_MAX
+        q = (wf / scale[..., None, :]).astype(quant_dtype(mode))
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_weight(q, scale, dt=jnp.float32):
+    """Dense dequantize (the XLA fallback's reference form): narrow
+    storage × per-output-channel scale → ``dt``. XLA fuses this
+    convert+multiply into the consuming dot's operand read."""
+    return (q.astype(jnp.float32) * scale.astype(jnp.float32)[..., None, :]
+            ).astype(dt)
+
+
+def quantize_llama_params(params: Dict[str, Any], cfg,
+                          mode: str = "int8") -> Dict[str, Any]:
+    """Quantize a llama param tree for serving: every key from
+    ``quantized_weight_keys`` becomes narrow storage plus a companion
+    ``<name>_scale`` fp32 plane ([L, out] for stacked layer weights,
+    [out] for lm_head); all other leaves pass through unchanged.
+    Idempotent-hostile on purpose: re-quantizing an already-quantized
+    tree is a ValueError, not silent double-scaling."""
+    quant_dtype(mode)  # validate mode early
+    out = dict(params)
+    for name in quantized_weight_keys(cfg):
+        if name + "_scale" in params:
+            raise ValueError(f"params already carry {name}_scale — "
+                             "refusing to double-quantize")
+        q, scale = quantize_weight(params[name], mode)
+        out[name] = q
+        out[name + "_scale"] = scale
+    return out
+
+
+def kv_scale_floor() -> float:
+    return _SCALE_FLOOR
+
+
+def quantize_kv_rows(x, pool_dtype):
+    """Quantize fresh K/V rows for a narrow paged pool.
+
+    ``x``: [B, T, Hkv, D] fp rows from the projection. Returns
+    (narrow rows same shape, fp32 scales [B, T]) — ONE scale per cache
+    row, matching the pool's per-page scale planes
+    ``[L, num_pages, page_size]`` (the row lands at [phys, prow], its
+    scale at the same coordinates). absmax over the row's (Hkv, D)
+    tile; int8 rounds symmetrically, fp8 casts after scaling into
+    e4m3's range."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.maximum(jnp.max(jnp.abs(xf), axis=(-2, -1)), _SCALE_FLOOR)
+    if pool_dtype == jnp.int8:
+        scale = amax / _INT8_QMAX
+        q = jnp.clip(jnp.round(xf / scale[..., None, None]),
+                     -_INT8_QMAX, _INT8_QMAX).astype(jnp.int8)
+    else:
+        scale = amax / _E4M3_MAX
+        q = (xf / scale[..., None, None]).astype(pool_dtype)
+    return q, scale.astype(jnp.float32)

@@ -441,7 +441,7 @@ class TestHostTierUnit:
         for i in range(3):
             k = np.zeros((cfg.num_layers, 2, 16, cfg.num_kv_heads,
                           cfg.head_dim), np.float32)
-            tier.note_import(f"k{i}".encode(), k, k, 2)
+            tier.note_import(f"k{i}".encode(), {"k": k, "v": k}, 2)
         assert tier.pages_host <= 3 + 2       # LRU dropped the oldest
         assert tier.host_evictions >= 1
 

@@ -121,12 +121,18 @@ print("SPMD4-WORKER-OK", rank)
 
 
 class TestFourProcessSPMD:
+    @pytest.mark.slow
     def test_launch_four_process_collectives_and_dp_parity(self, tmp_path):
         """Launcher-driven FOUR-process pod (2 virtual devices each -> 8
         global): eager collectives whose ring is a true 4-cycle, 4-rank
         bucketed DataParallel parity vs the full batch, and one sharded
         train step on a dp=4 x mp=2 mesh matching the single-process
-        loss."""
+        loss.
+
+        slow-marked (r21 suite-time claw-back): the 2-process launcher
+        path stays tier-1 via test_native_launch.py's
+        test_launch_two_process_collectives_and_train_step; this run
+        only scales the same code path to 4 subprocesses."""
         script = tmp_path / "spmd4_worker.py"
         script.write_text(_SPMD4_WORKER)
         env = dict(os.environ)
@@ -215,11 +221,16 @@ c.close()
 """
 
 
+@pytest.mark.slow
 def test_launcher_ps_two_servers_four_trainers(tmp_path):
     """--run_mode ps at fleet scale: 2 servers x 4 trainers; the dense
     table row-partitions across both servers, all four trainers push
     grads concurrently, sparse rows fan out one per trainer, and the
-    launcher tears both servers down at the end."""
+    launcher tears both servers down at the end.
+
+    slow-marked (r21 suite-time claw-back): PS push/pull/partition
+    logic is covered by test_ps.py and the launcher plumbing by the
+    2-process tier-1 runs; this is the same path at 6 subprocesses."""
     script = tmp_path / "ps_worker.py"
     script.write_text(_PS_2S4T_WORKER)
     env = dict(os.environ)
