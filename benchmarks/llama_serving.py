@@ -2760,6 +2760,282 @@ def run_quant(model_name, cfg, params, llama, n=16, seed=0, slots=4,
     }
 
 
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode pools + audited KV page-set handoff
+# (r22, ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def run_disagg(model_name, cfg, params, llama, n=10, seed=0, slots=2,
+               overload=3):
+    """The disaggregated-serving evidence (ISSUE 17 acceptance):
+
+    * **long-prompt-heavy trace at 1x and ~2.5x slot oversubscription**
+      served two ways on identical arrivals: the r13 co-resident
+      FleetRouter (2 replicas, chunked prefill interleaving with
+      decode on BOTH) and the DisaggRouter (1 prefill + 1 decode
+      replica — same total engines). Per-request tokens must be
+      identical across all four serves (greedy decode is
+      placement-independent).
+    * **TBT flatness ordering**: on the co-resident fleet every queued
+      long prompt injects its chunk steps into the SAME segment loop
+      that ticks running decodes; the decode pool's segment stream
+      carries no full-prompt prefills (only block-aligned suffix
+      re-prefills after a handoff). The curve is gated on the
+      deterministic form of that tax — prefill rows of OTHER requests
+      admitted into each request's decode window, per token (§3n
+      rows): the co-resident curve must bend up with overload while
+      the decode pool's stays flat and below it. Wall-clock TBT p99s
+      ride along as evidence (this container's tiny-model step time
+      is dispatch-bound, so the wall clock cannot resolve the tax).
+    * **handoff budget**: every inter-pool crossing within bytes <=
+      the request's reserved KV footprint (`analysis.tiers`
+      `disagg_serve_audit` — per-handoff AND per-request) and the
+      sync audit over a warmed serve flags nothing: one fetch per
+      segment plus exactly one labelled tier_transfer per handoff
+      flush.
+    * **zero post-warmup compiles in either pool** under per-pool
+      envelopes (`recompile.enforce_zero_compiles`), with the per-pool
+      warmup bill split vs the co-resident union ladder reported
+      (SCALING §3q vs §3o).
+    * **cross-pool replay**: the overload disagg serve journals and
+      replays bit-exactly (prefill@A -> handoff -> decode@B is a
+      decision-stream identity).
+    """
+    import jax
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.analysis import (SyncAudit, disagg_serve_audit,
+                                     recompile)
+    from paddle_tpu.inference import serving as _serving
+    from paddle_tpu.inference.disagg import DisaggRouter
+    from paddle_tpu.inference.fleet import FleetRouter, build_fleet
+    from paddle_tpu.inference.scheduler import Arrival
+
+    psz = 16
+    # long-prompt-heavy: prompts fill the top buckets, generations are
+    # short — the co-resident worst case (prefill work dominates the
+    # shared segment loop). Overload is expressed as SLOT
+    # oversubscription, not an arrival-rate multiplier (wall-clock
+    # rates mean different things on a CPU container vs a chip): the
+    # 1x trace spaces arrivals far enough apart that any platform
+    # keeps up (every request decodes alone), the overload trace
+    # lands all n at once, n / (2 engines x slots) deep — n=10 over 4
+    # slots is the 2.5x point of the 2-4x acceptance window, and
+    # every co-resident segment then mixes queued full-prompt chunk
+    # prefills into the decode tick stream.
+    plens, gen = (96, 128, 112, 80), 12
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, cfg.vocab_size, (plens[i % len(plens)],))
+               .astype(np.int32) for i in range(n)]
+
+    def trace(mult):
+        gap = 0.2 if mult == 1 else 1e-3
+        return [Arrival(i * gap, p, gen)
+                for i, p in enumerate(prompts)]
+
+    def engines():
+        return build_fleet(cfg, params, 2, slots=slots, max_len=256,
+                           prompt_buckets=(32, 64, 128), paged=True,
+                           page_size=psz, num_pages=64,
+                           chunked_prefill=True, prefill_chunks=(32,))
+
+    def co_serve(arr):
+        _telemetry_section(reset=True)
+        router = FleetRouter(engines(), max_queue=10 ** 6, seg_steps=8,
+                             prefix_caches="auto")
+        rep = router.serve(arr, warm=True)
+        return router, rep
+
+    def dis_serve(arr, journaled=False):
+        _telemetry_section(reset=True)
+        es = engines()
+        router = DisaggRouter(es[:1], es[1:], max_queue=10 ** 6,
+                              prefill_seg_steps=8, decode_seg_steps=12)
+        j = obs.Journal() if journaled else None
+        if j is not None:
+            from paddle_tpu.observability import journal as _j
+
+            with _j.attach(j):
+                router.serve(arr, warm=True)
+                rep = None
+        else:
+            rep = router.serve(arr, warm=True)
+        return router, rep, j
+
+    def tbt_p99(router):
+        vals = []
+        for _idx, r in router._reqs.values():
+            if r.finish_time and r.first_token_time \
+                    and len(r.tokens) > 1:
+                vals.append((r.finish_time - r.first_token_time)
+                            / (len(r.tokens) - 1))
+        return float(np.percentile(vals, 99)) if vals else 0.0
+
+    def interference(router, decode_only=False):
+        """The §3n/§3q arithmetic read off the decision stamps:
+        rows of OTHER requests' prefill admitted into a request's
+        decode window on its own engine, per generated token. This is
+        the deterministic form of the co-residency TBT tax — on chips
+        each interfering prefill row inflates the shared step's wall
+        time (the §3n rows model), while this container's tiny-model
+        wall clock is dispatch-overhead-bound and cannot resolve it —
+        so the flatness CURVE is gated on the row arithmetic and the
+        measured wall-clock p99s ride along as evidence."""
+        by_eng = {}
+        for idx, r in router._reqs.values():
+            by_eng.setdefault(idx, []).append(r)
+        vals = []
+        for idx, group in by_eng.items():
+            pool = getattr(router._replicas[idx], "pool", None)
+            if decode_only and pool != "decode":
+                continue
+            for r in group:
+                if not r.finish_time or not r.first_token_time \
+                        or len(r.tokens) < 2:
+                    continue
+                rows = sum(
+                    max(0, len(q.prompt) - q.prefix_hit_len)
+                    for q in group
+                    if q is not r and q.first_token_time
+                    and r.first_token_time < q.first_token_time
+                    <= r.finish_time)
+                vals.append(rows / (len(r.tokens) - 1))
+        return float(np.mean(vals)) if vals else 0.0
+
+    co1, _ = co_serve(trace(1))
+    dis1, _, _ = dis_serve(trace(1))
+    com, _ = co_serve(trace(overload))
+    dism, _, jrnl = dis_serve(trace(overload), journaled=True)
+
+    tokens_identical = (dis1.results() == co1.results()
+                        and dism.results() == com.results())
+    co_if = [interference(co1), interference(com)]
+    dis_if = [interference(dis1, True), interference(dism, True)]
+    # the ordering bar: the co-resident interference curve bends up
+    # with overload, the decode pool's stays flat (block-aligned
+    # suffix re-prefills only) and below the co-resident one
+    flat_ok = (co_if[1] > co_if[0]
+               and dis_if[1] <= dis_if[0] + 1.0
+               and dis_if[1] < co_if[1])
+    log(f"decode interference (prefill rows/token in the decode "
+        f"window): co-resident {co_if[0]:.2f} -> {co_if[1]:.2f} at "
+        f"{overload}x; disagg decode pool {dis_if[0]:.2f} -> "
+        f"{dis_if[1]:.2f} -> {'OK' if flat_ok else 'MISS'}; "
+        f"wall tbt p99 co {tbt_p99(co1):.4f}s/{tbt_p99(com):.4f}s "
+        f"dis {tbt_p99(dis1):.4f}s/{tbt_p99(dism):.4f}s; tokens "
+        f"identical {tokens_identical}")
+
+    audit = disagg_serve_audit(dism)
+    hrep = dism.handoff_report()
+    log(f"handoffs: {hrep['handoffs']} crossings, {hrep['pages']} "
+        f"pages, {hrep['bytes']} B in {hrep['flushes']} flushes, "
+        f"{hrep['fallbacks']} in-place fallbacks; budget audit "
+        f"{'CLEAN' if not audit else audit}")
+
+    # journal replay of the overload cross-pool serve
+    res = obs.replay_serve(jrnl.records(), params=params)
+    log(f"cross-pool replay identical: {res.identical} "
+        f"({res.n_decisions} decisions)")
+
+    # per-pool warmup bill + zero post-warmup compiles in either pool
+    saved = dict(_serving._SHARED_PROGS)
+    try:
+        _serving._SHARED_PROGS.clear()
+        es = engines()
+        dr = DisaggRouter(es[:1], es[1:], max_queue=10 ** 6,
+                          prefill_seg_steps=8, decode_seg_steps=12)
+        wrep = dr.aot_warmup()
+        bill = {("prefill" if i < dr.n_prefill else "decode"): {
+            f: {"keys": d["keys"], "seconds": round(d["seconds"], 3)}
+            for f, d in fams.items()} for i, fams in wrep.items()}
+        pool_keys = {p: sum(d["keys"] for d in fams.values())
+                     for p, fams in bill.items()}
+        # the co-resident union ladder both replicas would compile
+        union_keys = sum(
+            d["keys"] for d in es[0].aot_warmup(
+                es[0].default_envelope(
+                    seg_steps=(8, 12),
+                    prefix_block=dr._replicas[0].prefix_cache.block),
+                prefix_cache=dr._replicas[0].prefix_cache).values())
+        with recompile.enforce_zero_compiles(
+                "disagg post-warmup serve") as cw:
+            dr.serve(trace(1))
+        bill_shrinks = all(k < union_keys for k in pool_keys.values())
+        log(f"warmup bill: prefill pool {pool_keys.get('prefill')} "
+            f"keys + decode pool {pool_keys.get('decode')} keys vs "
+            f"co-resident union {union_keys} keys/replica "
+            f"({'OK' if bill_shrinks else 'MISS'}); post-warmup "
+            f"compiles {cw.compiles}")
+    finally:
+        _serving._SHARED_PROGS.clear()
+        _serving._SHARED_PROGS.update(saved)
+
+    # sync audit over the warmed pools: one fetch per segment + one
+    # labelled tier_transfer per handoff flush, nothing else
+    dr.reset()
+    with SyncAudit() as sa:
+        sa.phase = "serve"
+        rep_a = dr.serve(trace(1))
+    flagged = [str(e) for e in sa.flagged("serve")]
+    allowed = sa.allowed("serve")
+    audit_ok = (not flagged and allowed == {
+        "serving.segment_event_fetch": rep_a.segments,
+        "serving.tier_transfer": dr.handoff_flushes})
+    log(f"sync audit: flagged {flagged or '[]'}, allowed {allowed} "
+        f"over {rep_a.segments} segments + {dr.handoff_flushes} "
+        f"handoff flushes -> {'OK' if audit_ok else 'MISS'}")
+
+    headline = {
+        "tokens_identical": tokens_identical,
+        "tbt_flatness_ok": flat_ok,
+        "co_interference_rows_per_token": [round(v, 3) for v in co_if],
+        "disagg_interference_rows_per_token": [round(v, 3)
+                                               for v in dis_if],
+        "handoffs": hrep["handoffs"],
+        "handoff_budget_clean": not audit,
+        "post_warmup_compiles": cw.compiles,
+        "zero_mid_serve_compiles": cw.compiles == 0,
+        "warmup_bill_shrinks": bill_shrinks,
+        "replay_identical": res.identical,
+        "sync_audit_ok": audit_ok,
+        "pass": bool(tokens_identical and flat_ok and not audit
+                     and cw.compiles == 0 and bill_shrinks
+                     and res.identical and audit_ok
+                     and hrep["handoffs"] > 0),
+    }
+    return {
+        "metric": "serving_disagg",
+        "model": model_name,
+        "platform": jax.default_backend(),
+        "seed": seed,
+        "trace": {"n_base": n, "overload_slot_oversubscription": round(
+            n / (2 * slots), 2),
+                  "prompt_lens": list(plens), "gen": gen},
+        "tbt": {"co_resident_p99_s": [round(tbt_p99(co1), 4),
+                                      round(tbt_p99(com), 4)],
+                "disagg_decode_p99_s": [round(tbt_p99(dis1), 4),
+                                        round(tbt_p99(dism), 4)],
+                "interference_rows_per_token": {
+                    "co_resident": [round(v, 3) for v in co_if],
+                    "disagg_decode": [round(v, 3) for v in dis_if]},
+                "flatness_ok": flat_ok},
+        "handoff": {k: v for k, v in hrep.items() if k != "log"},
+        "budget_audit": audit,
+        "warmup_bill": {"per_pool_keys": pool_keys,
+                        "co_resident_union_keys": union_keys,
+                        "families": bill},
+        "sync_audit": {"flagged": flagged, "allowed": allowed,
+                       "segments": rep_a.segments,
+                       "handoff_flushes": dr.handoff_flushes,
+                       "ok": audit_ok},
+        "journal_replay": {"identical": res.identical,
+                           "n_decisions": res.n_decisions},
+        "pools": dism.pool_stats(),
+        "headline": headline,
+        "telemetry": _telemetry_section(),
+    }
+
+
 def smoke():
     """Tier-1 scheduler gate: serve a deterministic staggered trace on the
     tiny config and return an evidence dict the test asserts on — engine
@@ -2858,6 +3134,7 @@ def main():
     ap.add_argument("--tiered", action="store_true")
     ap.add_argument("--aot", action="store_true")
     ap.add_argument("--quant", action="store_true")
+    ap.add_argument("--disagg", action="store_true")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--model", default="auto",
                     choices=("auto", "base", "small", "tiny"))
@@ -2909,6 +3186,9 @@ def main():
     elif args.quant:
         print(json.dumps(run_quant(model_name, cfg, params, llama,
                                    n=min(args.n, 16))))
+    elif args.disagg:
+        print(json.dumps(run_disagg(model_name, cfg, params, llama,
+                                    n=min(args.n, 10))))
     elif args.failover:
         print(json.dumps(run_failover(model_name, cfg, params, llama)))
     elif args.fleet:

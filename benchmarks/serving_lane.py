@@ -64,6 +64,15 @@ Writes ``SERVING_r<N>.json`` at the repo root:
               journal replay, the qpseg AOT ladder's zero-compile
               certificate, and the fp8 determinism check...},
               (r21: quantized serving, ISSUE 16)
+   "disagg": {...llama_serving --disagg json: disaggregated
+              prefill/decode pools — the long-prompt overload trace
+              served co-resident vs split pools (token identity,
+              decode-pool TBT p99 flatness ordering), every KV
+              page-set handoff within the bytes <= KV-size budget,
+              zero post-warmup compiles under per-pool envelopes with
+              the warmup bill split vs the co-resident union ladder,
+              and the bit-exact cross-pool journal replay...},
+              (r22: disaggregated serving, ISSUE 17)
    "telemetry_headlines": {...r10 runtime-telemetry headlines per mode —
               queue depth / slot occupancy / prefix hit rate /
               backpressure counters from paddle_tpu.observability; the
@@ -180,6 +189,15 @@ def main() -> int:
         # journal replay, and the qpseg AOT ladder serving with zero
         # post-warmup compiles
         "quant": _run_json("llama_serving.py", args=("--quant",)),
+        # r22 (ISSUE 17): disaggregated prefill/decode serving — the
+        # long-prompt overload trace served co-resident vs split pools
+        # (token identity, decode-pool TBT p99 flatness ordering),
+        # every KV page-set handoff within the bytes <= KV-size
+        # budget, zero post-warmup compiles under per-pool envelopes
+        # with the warmup bill split vs the co-resident union ladder,
+        # the one-fetch + one-flush sync audit, and the bit-exact
+        # cross-pool journal replay
+        "disagg": _run_json("llama_serving.py", args=("--disagg",)),
     }
     result["platform"] = result["online"].get("platform", "unknown")
     # r10: lift each mode's runtime-telemetry headline (queue depth,
@@ -190,7 +208,7 @@ def main() -> int:
         k: (result[k].get("telemetry") or {}).get("headline")
         for k in ("online", "prefix", "paged", "fleet", "overload",
                   "failover", "slo", "spec", "quality", "capacity",
-                  "tiered", "quant")}
+                  "tiered", "quant", "disagg")}
     # r15: lift the speculative headline — the roofline-beating ratio
     # an operator (or the next round's reviewer) checks first
     spec = result["spec"].get("headline") or {}
@@ -269,6 +287,11 @@ def main() -> int:
     # bytes/tick ratio, the shadow certification verdict, determinism/
     # replay identity and the quant path's zero-compile certificate
     result["quant_headline"] = result["quant"].get("headline")
+    # r22 (ISSUE 17): lift the disaggregated-serving headline — token
+    # identity vs co-resident, the TBT flatness ordering, the
+    # per-crossing handoff budget, the per-pool zero-compile + warmup
+    # bill split, and the cross-pool replay identity
+    result["disagg_headline"] = result["disagg"].get("headline")
     path = os.path.join(ROOT, f"SERVING_r{rnd:02d}.json")
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
@@ -276,7 +299,8 @@ def main() -> int:
     ok = all(result[k].get("rc") == 0
              for k in ("decode", "serving", "online", "prefix", "paged",
                        "fleet", "overload", "failover", "slo", "spec",
-                       "quality", "capacity", "tiered", "aot", "quant"))
+                       "quality", "capacity", "tiered", "aot", "quant",
+                       "disagg"))
     return 0 if ok else 1
 
 

@@ -107,6 +107,12 @@ TPU_TEST_FILES = [
     # machinery, sync-audit, qpseg-coverage and replay tests all gain
     # their hardware half here
     "tests/test_quantized_serving.py",
+    # r22 (ISSUE 17): disaggregated prefill/decode serving — on chip
+    # the handoff's host-bytes seam becomes the device-to-device
+    # device_put path, so token identity across the pool split, the
+    # per-crossing budget audit, per-pool AOT coverage and the
+    # cross-pool replay all gain their hardware half here
+    "tests/test_disagg.py",
 ]
 
 

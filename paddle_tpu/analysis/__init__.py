@@ -46,7 +46,8 @@ from .recompile import (CompileBudgetError, CompileWatch,
                         enforce_zero_compiles, lint_cache_keys,
                         live_cache_report)
 from .syncs import SyncAudit, allowed_sync
-from .tiers import tier_transfer_audit, tiered_serve_audit
+from .tiers import (disagg_serve_audit, handoff_audit,
+                    tier_transfer_audit, tiered_serve_audit)
 
 __all__ = [
     "AuditReport", "Finding", "SyncAudit", "allowed_sync", "CompileWatch",
@@ -55,6 +56,7 @@ __all__ = [
     "audit_program", "budgets", "coverage", "coverage_report",
     "lint_registry_only", "hlo", "programs", "recompile", "syncs",
     "tiers", "tier_transfer_audit", "tiered_serve_audit",
+    "handoff_audit", "disagg_serve_audit",
 ]
 
 

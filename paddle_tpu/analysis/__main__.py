@@ -61,6 +61,16 @@ Usage::
                                                   # it — every other
                                                   # program's budget is
                                                   # bit-identical either way
+    python -m paddle_tpu.analysis --gate --disagg on # (default) the r22
+                                                  # contract: the handoff
+                                                  # auditor ATTACHED (a
+                                                  # flight listener live-
+                                                  # checking every inter-
+                                                  # pool handoff against
+                                                  # the per-crossing
+                                                  # budget), budgets
+                                                  # bit-identical to
+                                                  # --disagg off
     python -m paddle_tpu.analysis --gate --aot on # (default) the r20
                                                   # contract: program-space
                                                   # coverage + AOT warmup —
@@ -185,6 +195,13 @@ def main(argv=None) -> int:
                          "programs' budgets must be bit-identical "
                          "either way (the quantized path shares no "
                          "state with them)")
+    ap.add_argument("--disagg", choices=("on", "off"), default="on",
+                    help="audit with the r22 disaggregated-serving "
+                         "handoff auditor attached: a flight listener "
+                         "live-checking every inter-pool handoff event "
+                         "against the per-crossing bytes-migrated <= "
+                         "KV-size budget — budgets must be "
+                         "bit-identical to --disagg off")
     ap.add_argument("--aot", choices=("on", "off"), default="on",
                     help="r20 program-space coverage: lint registry-only "
                          "key construction, prove the envelope "
@@ -226,6 +243,13 @@ def main(argv=None) -> int:
         tmeter = kv_tiers.TierMeter()
         kv_tiers.install(tmeter)
         print("tier meter attached on POOL_HOOKS + SEGMENT_HOOKS")
+    hauditor = None
+    if args.disagg == "on":
+        from .tiers import HandoffAuditor
+
+        hauditor = HandoffAuditor()
+        hauditor.install()
+        print("handoff auditor attached on the flight stream")
     lint = []
     if args.aot == "on":
         from . import coverage as _coverage
@@ -282,6 +306,14 @@ def main(argv=None) -> int:
               f"{sum(1 for r in results if 'program_space_keys' in r['metrics'])} "
               f"serving programs")
 
+    if hauditor is not None:
+        hauditor.uninstall()
+        print(f"handoff auditor detached: saw {hauditor.handoffs} "
+              f"handoffs ({hauditor.pages} pages, {hauditor.bytes} B), "
+              f"{len(hauditor.violations)} over budget")
+        for v in hauditor.violations:
+            print(f"  !! {v}")
+        any_violation |= bool(hauditor.violations)
     if tmeter is not None:
         from ..inference import kv_tiers
 

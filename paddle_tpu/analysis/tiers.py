@@ -22,6 +22,13 @@ The zero-extra-sync half of the tiered contract is enforced where sync
 contracts live: ``SyncAudit`` over the tiered serve loop (the staging
 D2H rides the per-segment event fetch, restores are dispatches), pinned
 in tests/test_kv_tiers.py with allowed == segment fetches exactly.
+
+r22 (ISSUE 17) generalizes the same arithmetic to the inter-pool
+transfer: ``handoff_audit`` walks a ``DisaggRouter``'s handoff ledger
+and holds EVERY individual crossing to the bytes-migrated ≤ KV-size
+budget — per handoff, not just per request, because a request that
+bounced (failover after handoff) may legally cross twice and each
+crossing must independently fit its reserved footprint.
 """
 
 from __future__ import annotations
@@ -29,7 +36,50 @@ from __future__ import annotations
 from typing import List, Optional
 
 __all__ = ["tier_transfer_audit", "tier_conservation_audit",
-           "tiered_serve_audit"]
+           "tiered_serve_audit", "handoff_audit", "disagg_serve_audit",
+           "HandoffAuditor"]
+
+
+class HandoffAuditor:
+    """Ambient handoff observer for the gate's ``--disagg on`` mode
+    (r22): a flight listener that live-checks every ``handoff`` event
+    against the per-crossing budget as it lands — pure observation on
+    the existing flight stream, so the audited programs' budgets must
+    be bit-identical with it attached or not (the --tiers TierMeter
+    contract, applied to the inter-pool plane). Install/uninstall
+    around the audit loop; ``violations`` holds one string per
+    over-budget crossing."""
+
+    def __init__(self, page_bytes: int = 0):
+        self.page_bytes = int(page_bytes)   # 0 = pages-only checks
+        self.handoffs = 0
+        self.pages = 0
+        self.bytes = 0
+        self.violations: List[str] = []
+
+    def __call__(self, kind: str, data: dict) -> None:
+        if kind != "handoff":
+            return
+        self.handoffs += 1
+        self.pages += data.get("pages", 0)
+        self.bytes += data.get("bytes", 0)
+        if self.page_bytes:
+            self.violations += handoff_audit([data], self.page_bytes)
+        elif data.get("pages", 0) > data.get("pages_reserved", 0):
+            self.violations.append(
+                f"handoff rid {data['rid']}: moved {data['pages']} "
+                f"pages > {data['pages_reserved']} reserved")
+
+    def install(self) -> None:
+        from ..observability import flight
+
+        flight.LISTENERS.append(self)
+
+    def uninstall(self) -> None:
+        from ..observability import flight
+
+        if self in flight.LISTENERS:
+            flight.LISTENERS.remove(self)
 
 
 def tier_transfer_audit(requests, page_bytes: int) -> List[str]:
@@ -86,3 +136,48 @@ def tiered_serve_audit(requests, host_tier,
     pb = page_bytes if page_bytes is not None else host_tier.page_bytes()
     return (tier_transfer_audit(requests, pb)
             + tier_conservation_audit(host_tier.stats()))
+
+
+def handoff_audit(handoff_log, page_bytes: int) -> List[str]:
+    """Per-handoff budget check over a ``DisaggRouter.handoff_log``
+    ledger (r22): every inter-pool crossing must move at most the
+    request's own reserved KV footprint — ``pages <= pages_reserved``
+    and ``bytes <= pages_reserved x page_bytes`` — and its byte count
+    must be exactly ``pages x page_bytes`` (whole pages cross, never a
+    partial plane). Empty list = every handoff within budget."""
+    v: List[str] = []
+    if page_bytes <= 0:
+        return [f"page_bytes must be positive, got {page_bytes}"]
+    for h in handoff_log:
+        who = (f"handoff rid {h['rid']} "
+               f"({h['src']}->{h['dst']})")
+        if h["pages"] > h["pages_reserved"]:
+            v.append(f"{who}: moved {h['pages']} pages > "
+                     f"{h['pages_reserved']} reserved")
+        if h["bytes"] > h["pages_reserved"] * page_bytes:
+            v.append(f"{who}: moved {h['bytes']} B > KV size "
+                     f"{h['pages_reserved'] * page_bytes} B "
+                     f"({h['pages_reserved']} pages x {page_bytes} B)")
+        if h["bytes"] != h["pages"] * page_bytes:
+            v.append(f"{who}: {h['bytes']} B is not {h['pages']} pages "
+                     f"x {page_bytes} B — a partial-plane transfer "
+                     f"went unmetered")
+    return v
+
+
+def disagg_serve_audit(router, page_bytes: Optional[int] = None
+                       ) -> List[str]:
+    """The combined pass after a disaggregated serve: every handoff
+    within its budget, every request's total tier traffic within ITS
+    budget (handoffs bill ``tier_pages``/``tier_bytes`` exactly like
+    r19 migrations), and each replica tier's conservation identities."""
+    reps = router._replicas
+    pb = (page_bytes if page_bytes is not None
+          else reps[0].prefix_cache.host_tier.page_bytes())
+    reqs = [req for _idx, req in router._reqs.values()]
+    v = handoff_audit(router.handoff_log, pb)
+    v += tier_transfer_audit(reqs, pb)
+    for r in reps:
+        v += [f"replica {r.idx} ({r.pool}): {s}" for s in
+              tier_conservation_audit(r.prefix_cache.host_tier.stats())]
+    return v

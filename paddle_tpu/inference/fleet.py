@@ -419,6 +419,9 @@ class _Replica:
         self.idx = idx
         self.engine = engine
         self.prefix_cache = prefix_cache
+        # r22 (ISSUE 17): pool role — None in a homogeneous fleet;
+        # "prefill"/"decode" when a DisaggRouter owns this replica
+        self.pool: Optional[str] = None
         self.registry = _metrics.Registry()
         self.backpressure_events = 0
         self.dispatches = {"affinity": 0, "least_loaded": 0,
@@ -640,6 +643,14 @@ class FleetRouter:
         need = eng.pager.pages_needed(len(a.prompt) + a.max_new_tokens - 1)
         return eng.pager.pages_free >= need
 
+    def _dispatch_candidates(self) -> List[_Replica]:
+        """The replicas fresh arrivals may route to. The homogeneous
+        fleet offers everyone; a pool-aware subclass (r22 DisaggRouter)
+        narrows this to its prefill pool so prompts always start on
+        prefill replicas and decode replicas take work only through the
+        journaled handoff path."""
+        return self._replicas
+
     def _route(self, a: Arrival, dirinfo: Optional[dict] = None):
         """(replica, reason) for a due arrival, or (bill_target, None)
         when every queue is full (fleet backpressure). r13: suspect and
@@ -666,14 +677,14 @@ class FleetRouter:
         an auto-hold (weight → 0) takes the variant out of the path
         while it drains its backlog."""
         can = self.canary
-        ctl = self._replicas
+        ctl = self._dispatch_candidates()
         if can is not None:
             crep = self._replicas[can.replica]
             if (can.assign(self._next_rid) and crep.health == "healthy"
                     and crep.queue_depth < self.max_queue
                     and self._page_ready(crep, a)):
                 return crep, "canary"
-            ctl = [r for r in self._replicas if r.idx != can.replica]
+            ctl = [r for r in ctl if r.idx != can.replica]
         if dirinfo is not None:
             owners = dirinfo["owners"]
             dcands = [r for r in ctl
@@ -769,8 +780,12 @@ class FleetRouter:
                 # directory-hit info (matched rows + tier) so a
                 # steering decision's "why replica 2" replays
                 # bit-exactly off the journal record alone
+                # r22 (ISSUE 17): the ranking carries the pool tag —
+                # a disaggregated dispatch record shows decode replicas
+                # present-but-ineligible for fresh prompts
                 owners = dirinfo["owners"] if dirinfo is not None else {}
                 cands = [{"idx": x.idx, "health": x.health,
+                          "pool": x.pool,
                           "queue": x.queue_depth, "live": x.live,
                           "page_ready": self._page_ready(x, a),
                           "pages_free": (x.engine.pager.pages_free
@@ -1014,7 +1029,8 @@ class FleetRouter:
                 with _metrics.scoped_registry(r.registry), \
                         _journal.rank_scope(r.idx):
                     h = r.engine.dispatch_segment(
-                        self.seg_steps, prefix_cache=r.prefix_cache)
+                        self._seg_steps_for(r),
+                        prefix_cache=r.prefix_cache)
                 inflight.append((r, h, _journal.now()))
             # r17: shadow work rides strictly AFTER the primary
             # dispatches of this turn, on the already-read clock
@@ -1198,6 +1214,12 @@ class FleetRouter:
             self.perf_monitor.note_segment(ev["steps"],
                                            ev.get("tokens", 0),
                                            elapsed_s=t_sync - t_disp)
+        # r22 (ISSUE 17): post-segment hook — a no-op here; the
+        # DisaggRouter's handoff sweep (prefill slots whose first token
+        # just landed move to the decode pool) runs at exactly this
+        # point, when the replica's engine is idle and the segment's
+        # event log has been applied
+        self._post_segment(rep, ev)
         if attempts and rep.health == "suspect":
             # a retried fetch came back: the hang was transient
             rep.set_health("healthy")
@@ -1222,6 +1244,30 @@ class FleetRouter:
                 _flight.record("replica_recovered", replica=rep.idx,
                                via="fast_segment")
         return True
+
+    def _post_segment(self, rep: _Replica, ev: dict) -> None:
+        """Hook invoked after a fetched segment's results are applied
+        and the monitors are fed, while ``rep``'s engine is idle. The
+        homogeneous fleet does nothing; the r22 ``DisaggRouter``
+        overrides this with the prefill→decode handoff sweep."""
+
+    def _seg_steps_for(self, rep: _Replica) -> int:
+        """Per-replica segment budget. Homogeneous fleets use one knob;
+        the r22 DisaggRouter gives each pool its own (short prefill
+        segments so first tokens hand off promptly, long decode
+        segments so steady generation amortises the fetch) — which is
+        also what keeps each pool's enumerated ladder to ITS OWN steps
+        axis."""
+        return self.seg_steps
+
+    def _failover_target(self, survivors: List[_Replica],
+                         req: Request) -> _Replica:
+        """Which survivor a failed-over request requeues onto. The
+        homogeneous fleet takes the least-loaded; the r22 DisaggRouter
+        keeps pool discipline (token-bearing requests resume on the
+        decode pool, untouched ones restart on prefill) so a failover
+        never admits a program outside the target pool's envelope."""
+        return min(survivors, key=lambda x: (x.load, x.idx))
 
     def _kill_replica(self, rep: _Replica, reason: str) -> None:
         """Declare ``rep`` dead and fail its whole in-flight world over
@@ -1263,7 +1309,7 @@ class FleetRouter:
                     f"request {frid} exceeded {self.max_requeues} "
                     f"failover requeues — replicas are dying faster "
                     f"than the fleet can serve")
-            tgt = min(survivors, key=lambda x: (x.load, x.idx))
+            tgt = self._failover_target(survivors, req)
             if len(req.prompt) + len(req.tokens) > max(tgt.engine.buckets):
                 # the grown resume prompt no longer fits an admit
                 # window: rewind and regenerate — greedy decode

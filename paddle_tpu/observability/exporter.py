@@ -223,9 +223,16 @@ class OpsServer:
                         "bytes_staged": tier.bytes_to_host,
                         "bytes_restored": tier.bytes_to_hbm,
                     }
+                if getattr(r, "pool", None) is not None:
+                    # r22 (ISSUE 17): pool role next to health — which
+                    # side of the disaggregated split this replica is
+                    row["pool"] = r.pool
                 pages[str(r.idx)] = row
             if pages:
                 body["pages"] = pages
+            pools = _pool_rollup(self.fleet)
+            if pools:
+                body["pools"] = pools
         if self.slo_monitor is not None:
             body["slo_level"] = self.slo_monitor.worst_level()
         if self.capacity_monitor is not None:
@@ -323,9 +330,14 @@ class OpsServer:
                 tier = getattr(pc, "host_tier", None)
                 if tier is not None:
                     row["tiers"] = tier.stats()
+                if getattr(r, "pool", None) is not None:
+                    row["pool"] = r.pool      # r22: disagg pool role
                 reps[str(r.idx)] = row
             if reps:
                 out["replicas"] = reps
+            pools = _pool_rollup(self.fleet)
+            if pools:
+                out["pools"] = pools
             if getattr(self.fleet, "directory", None) is not None:
                 out["directory"] = self.fleet.directory.stats()
         if audit:
@@ -353,6 +365,30 @@ class OpsServer:
         if self.perf_monitor is None:
             return {"enabled": False}
         return {"enabled": True, **self.perf_monitor.report()}
+
+
+def _pool_rollup(fleet) -> dict:
+    """Per-pool aggregates for a pool-aware fleet (r22 DisaggRouter):
+    replica membership, healthy count, and the summed ``pages_free`` /
+    ``reclaimable`` availability axes — the scrape-visible form the
+    item-3 autoscaler sizes pools from. Empty dict for a homogeneous
+    fleet (no replica carries a pool role). All host mirrors."""
+    pools: dict = {}
+    for r in fleet._replicas:
+        pool = getattr(r, "pool", None)
+        if pool is None:
+            continue
+        row = pools.setdefault(pool, {
+            "replicas": [], "healthy": 0,
+            "pages_free": 0, "reclaimable": 0})
+        row["replicas"].append(r.idx)
+        row["healthy"] += 1 if r.health == "healthy" else 0
+        if r.engine.paged:
+            row["pages_free"] += r.engine.pager.pages_free
+            pc = r.prefix_cache
+            if pc is not None and hasattr(pc, "reclaimable_pages"):
+                row["reclaimable"] += pc.reclaimable_pages()
+    return pools
 
 
 def _make_handler(srv: OpsServer):

@@ -72,7 +72,7 @@ __all__ = ["SCHEMA_VERSION", "DECISION_KINDS", "Journal", "JournalError",
            "read_journal", "merge_journal_dir", "sections",
            "request_journey", "journey_summary", "describe_engine",
            "describe_config", "describe_arrivals",
-           "describe_prefix_cache"]
+           "describe_prefix_cache", "describe_envelope"]
 
 SCHEMA_VERSION = 1
 
@@ -97,6 +97,11 @@ DECISION_KINDS = frozenset({
     # segment boundaries), so spill/restore/import decisions and the
     # fleet's migration choices replay bit-exactly and are DIFFED
     "tier_transfer", "tier_migrate",
+    # r22 disaggregated serving (ISSUE 17): the prefill->decode page-set
+    # handoff is a routing DECISION (which decode replica, how many
+    # pages, how many bytes) made from journaled state only, so the
+    # cross-pool journey replays bit-exactly and the handoff is DIFFED
+    "handoff",
 })
 
 
@@ -567,7 +572,7 @@ def journey_summary(evs: Sequence[dict]) -> dict:
         tgt = e.get("replica", e.get("dst", e["rank"]))
         if not replicas or replicas[-1] != tgt:
             if e["kind"] in ("dispatch", "fleet_dispatch",
-                             "failover_requeue", "admit"):
+                             "failover_requeue", "admit", "handoff"):
                 replicas.append(tgt)
     fin = next((e for e in evs if e["kind"] == "finish"), None)
     shadow = next((e for e in evs if e["kind"] == "shadow_finish"), None)
@@ -646,6 +651,22 @@ def describe_prefix_cache(pc) -> Optional[dict]:
         return d
     return {"kind": "rows", "block": pc.block,
             "capacity_tokens": pc.capacity_tokens}
+
+
+def describe_envelope(env) -> Optional[dict]:
+    """WorkloadEnvelope -> JSON (r22, ISSUE 17): the per-pool envelope
+    is a LADDER decider — it fixes which programs each pool AOT-compiles
+    — so the disaggregated header records one per pool and replay
+    rebuilds the exact same (smaller) per-pool ladders."""
+    if env is None:
+        return None
+    return {"max_prompt": env.max_prompt,
+            "max_new_tokens": env.max_new_tokens,
+            "seg_steps": list(env.seg_steps),
+            "n_pads": list(env.n_pads),
+            "resume": env.resume,
+            "prefix_block": env.prefix_block,
+            "offline_batch": env.offline_batch}
 
 
 def describe_arrivals(arrivals) -> List[dict]:

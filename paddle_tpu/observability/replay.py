@@ -201,7 +201,7 @@ def rebuild(header: dict, params):
     trace = _trace_from(header)
     driver = header["driver"]
     engines = [_engine_from(d, cfg, params) for d in header["engines"]]
-    if driver == "fleet":
+    if driver in ("fleet", "disagg"):
         fk = header["fleet"]
         pcs = [_prefix_cache_from(d, e)
                for d, e in zip(header["prefix_caches"], engines)]
@@ -227,18 +227,42 @@ def rebuild(header: dict, params):
                 latency_ratio_max=ck["latency_ratio_max"],
                 min_outcomes=ck["min_outcomes"],
                 verdict_every=ck["verdict_every"])
-        router = FleetRouter(
-            engines, max_queue=fk["max_queue"], seg_steps=fk["seg_steps"],
-            prefix_caches=(pcs if any(p is not None for p in pcs)
-                           else None),
+        kw = dict(
+            max_queue=fk["max_queue"], seg_steps=fk["seg_steps"],
             affinity_block=fk["affinity_block"],
             segment_timeout_s=fk["segment_timeout_s"],
             max_finish_retries=fk["max_finish_retries"],
             max_requeues=fk["max_requeues"],
             fault_injector=_injector_from(header.get("fault")),
             probe_after_s=fk["probe_after_s"],
-            canary=canary,
             directory=bool(fk.get("directory", False)))
+        if driver == "disagg":
+            # r22: the disaggregated fleet rebuilds from the header
+            # alone — pool role per replica (index order is
+            # prefill-first, the DisaggRouter construction order) plus
+            # each pool's segment budget; the per-pool envelopes
+            # re-derive from the rebuilt engines' geometry
+            from ..inference.disagg import DisaggRouter
+
+            pools = header["pools"]
+            dk = header["disagg"]
+            pre = [i for i, p in enumerate(pools) if p == "prefill"]
+            dec = [i for i, p in enumerate(pools) if p == "decode"]
+            if pre + dec != list(range(len(pools))):
+                raise JournalError(
+                    f"disagg header pools not prefill-first: {pools}")
+            router = DisaggRouter(
+                [engines[i] for i in pre], [engines[i] for i in dec],
+                prefill_caches=[pcs[i] for i in pre],
+                decode_caches=[pcs[i] for i in dec],
+                prefill_seg_steps=dk["prefill_seg_steps"],
+                decode_seg_steps=dk["decode_seg_steps"], **kw)
+        else:
+            router = FleetRouter(
+                engines,
+                prefix_caches=(pcs if any(p is not None for p in pcs)
+                               else None),
+                canary=canary, **kw)
         router._next_rid = int(fk.get("next_rid", 0))
         return router, trace
     sk = header["scheduler"]

@@ -60,21 +60,26 @@ _LEVEL_RANK = {lvl: i for i, lvl in enumerate(_LEVELS)}
 
 @dataclass(frozen=True)
 class Objective:
-    """Per-priority-class service-level objective.
+    """Per-priority-class (or, r22, per-pool) service-level objective.
 
     ``compliance`` is the target fraction of outcomes meeting their
     latency bound; ``1 - compliance`` is the error budget. A ``None``
     target skips that dimension (a batch class often has no TTFT
-    objective)."""
+    objective). ``tbt_target_s`` (r22, ISSUE 17) bounds the mean
+    time-between-tokens of a finished request — the decode pool's
+    owned objective in a disaggregated fleet, where TTFT belongs to
+    the prefill pool."""
     ttft_target_s: Optional[float] = None
     e2e_target_s: Optional[float] = None
+    tbt_target_s: Optional[float] = None
     compliance: float = 0.99
 
     def __post_init__(self):
         if not 0.0 < self.compliance < 1.0:
             raise ValueError(f"compliance must be in (0, 1), got "
                              f"{self.compliance}")
-        if self.ttft_target_s is None and self.e2e_target_s is None:
+        if (self.ttft_target_s is None and self.e2e_target_s is None
+                and self.tbt_target_s is None):
             raise ValueError("objective needs at least one latency target")
 
 
@@ -146,8 +151,9 @@ class SLOMonitor:
                  fast_window: int = 4, slow_window: int = 16,
                  warn_burn: float = 2.0, page_burn: float = 8.0,
                  clear_after: int = 4,
-                 accept_drift: Optional[dict] = None):
-        if not objectives:
+                 accept_drift: Optional[dict] = None,
+                 pool_objectives: Optional[Dict[str, Objective]] = None):
+        if not objectives and not pool_objectives:
             raise ValueError("SLOMonitor needs at least one objective")
         if not 0 < fast_window <= slow_window:
             raise ValueError(f"need 0 < fast_window <= slow_window, got "
@@ -168,10 +174,18 @@ class SLOMonitor:
             if not 0.0 < self.accept_drift["drop"] < 1.0:
                 raise ValueError(f"accept_drift drop must be in (0, 1), "
                                  f"got {self.accept_drift['drop']}")
+        # r22 (ISSUE 17): per-pool objectives — in a disaggregated
+        # fleet TTFT is the prefill pool's objective and TBT the decode
+        # pool's; each pool gets its own ledger/windows/alert machine,
+        # fed by the pool-tagged note hooks below and evaluated by the
+        # same multi-window burn rules as the priority classes
+        self.pool_objectives = dict(pool_objectives or {})
         self.segment_no = 0
         self.alert_log: List[dict] = []
         self._classes = {p: _ClassState(o, slow_window)
                          for p, o in self.objectives.items()}
+        self._pools = {n: _ClassState(o, slow_window)
+                       for n, o in self.pool_objectives.items()}
         self._reset_drift()
 
     def _reset_drift(self) -> None:
@@ -184,9 +198,9 @@ class SLOMonitor:
         self.drift_log: List[dict] = []
 
     # --- outcome intake (host floats from the scheduler's stamps) --------
-    def _note(self, priority: int, value_s: float,
+    @staticmethod
+    def _note(cs: Optional[_ClassState], value_s: float,
               target_s: Optional[float]) -> None:
-        cs = self._classes.get(priority)
         if cs is None or target_s is None:
             return
         ok = value_s <= target_s
@@ -201,13 +215,31 @@ class SLOMonitor:
         """One first-token outcome (call at the first-token host stamp)."""
         cs = self._classes.get(priority)
         if cs is not None:
-            self._note(priority, float(ttft_s), cs.objective.ttft_target_s)
+            self._note(cs, float(ttft_s), cs.objective.ttft_target_s)
 
     def note_e2e(self, priority: int, e2e_s: float) -> None:
         """One end-to-end outcome (call at the finish host stamp)."""
         cs = self._classes.get(priority)
         if cs is not None:
-            self._note(priority, float(e2e_s), cs.objective.e2e_target_s)
+            self._note(cs, float(e2e_s), cs.objective.e2e_target_s)
+
+    def note_pool_ttft(self, pool: Optional[str], ttft_s: float) -> None:
+        """One first-token outcome attributed to ``pool`` (r22: the
+        DisaggRouter feeds this at the same host stamp as ``note_ttft``
+        — a first token can only land on a prefill replica, so the
+        prefill pool owns the TTFT budget). No-op for untagged pools."""
+        cs = self._pools.get(pool)
+        if cs is not None:
+            self._note(cs, float(ttft_s), cs.objective.ttft_target_s)
+
+    def note_pool_tbt(self, pool: Optional[str], tbt_s: float) -> None:
+        """One finished request's mean time-between-tokens attributed
+        to ``pool`` ((finish - first_token) / (n_tokens - 1), host
+        arithmetic on stamps already taken) — the decode pool's owned
+        objective. No-op for untagged pools."""
+        cs = self._pools.get(pool)
+        if cs is not None:
+            self._note(cs, float(tbt_s), cs.objective.tbt_target_s)
 
     def note_accept_rate(self, rate: float) -> None:
         """One segment's speculative acceptance rate (accepted/proposed
@@ -276,29 +308,36 @@ class SLOMonitor:
         routes every engine segment here for ambient attachment)."""
         self.segment_no += 1
         for p, cs in self._classes.items():
-            cs.window.append((cs.cur_good, cs.cur_bad))
-            cs.cur_good = cs.cur_bad = 0
-            cs.burn_fast = cs._burn(self.fast_window)
-            cs.burn_slow = cs._burn(self.slow_window)
-            target = self._target_level(cs)
-            if _LEVEL_RANK[target] > _LEVEL_RANK[cs.level]:
-                self._transition(p, cs, target)     # escalate immediately
-                cs.clear_streak = 0
-            elif _LEVEL_RANK[target] < _LEVEL_RANK[cs.level]:
-                cs.clear_streak += 1                # hysteretic clear
-                if cs.clear_streak >= self.clear_after:
-                    self._transition(p, cs, target)
-                    cs.clear_streak = 0
-            else:
-                cs.clear_streak = 0
-            _metrics.gauge(f"slo.burn_rate[class{p}]").set(cs.burn_fast)
-            _metrics.gauge(f"slo.budget_remaining[class{p}]").set(
-                cs.budget_remaining())
+            self._eval_one(p, f"class{p}", cs)
+        # r22: pool ledgers advance on the same segment clock — the
+        # disaggregated fleet's prefill-TTFT / decode-TBT budgets burn
+        # and page under the identical multi-window rules
+        for n, cs in self._pools.items():
+            self._eval_one(f"pool:{n}", f"pool_{n}", cs)
 
-    def _transition(self, priority: int, cs: _ClassState,
-                    level: str) -> None:
+    def _eval_one(self, key, label: str, cs: _ClassState) -> None:
+        cs.window.append((cs.cur_good, cs.cur_bad))
+        cs.cur_good = cs.cur_bad = 0
+        cs.burn_fast = cs._burn(self.fast_window)
+        cs.burn_slow = cs._burn(self.slow_window)
+        target = self._target_level(cs)
+        if _LEVEL_RANK[target] > _LEVEL_RANK[cs.level]:
+            self._transition(key, cs, target)       # escalate immediately
+            cs.clear_streak = 0
+        elif _LEVEL_RANK[target] < _LEVEL_RANK[cs.level]:
+            cs.clear_streak += 1                    # hysteretic clear
+            if cs.clear_streak >= self.clear_after:
+                self._transition(key, cs, target)
+                cs.clear_streak = 0
+        else:
+            cs.clear_streak = 0
+        _metrics.gauge(f"slo.burn_rate[{label}]").set(cs.burn_fast)
+        _metrics.gauge(f"slo.budget_remaining[{label}]").set(
+            cs.budget_remaining())
+
+    def _transition(self, key, cs: _ClassState, level: str) -> None:
         prev, cs.level = cs.level, level
-        rec = {"segment": self.segment_no, "cls": priority,
+        rec = {"segment": self.segment_no, "cls": key,
                "level": level, "prev": prev,
                "burn_fast": round(cs.burn_fast, 3),
                "burn_slow": round(cs.burn_slow, 3),
@@ -316,8 +355,15 @@ class SLOMonitor:
     def budget_remaining(self, priority: int) -> float:
         return self._classes[priority].budget_remaining()
 
+    def pool_state(self, pool: str) -> str:
+        return self._pools[pool].level
+
+    def pool_budget_remaining(self, pool: str) -> float:
+        return self._pools[pool].budget_remaining()
+
     def worst_level(self) -> str:
-        return max((cs.level for cs in self._classes.values()),
+        return max((cs.level for cs in list(self._classes.values())
+                    + list(self._pools.values())),
                    key=lambda lvl: _LEVEL_RANK[lvl], default="ok")
 
     def report(self) -> dict:
@@ -344,6 +390,21 @@ class SLOMonitor:
                     "burn_fast": round(cs.burn_fast, 3),
                     "burn_slow": round(cs.burn_slow, 3),
                 } for p, cs in sorted(self._classes.items())},
+            # r22: the per-pool ledgers (empty for homogeneous fleets)
+            "pools": {
+                n: {
+                    "state": cs.level,
+                    "objective": {
+                        "ttft_target_s": cs.objective.ttft_target_s,
+                        "e2e_target_s": cs.objective.e2e_target_s,
+                        "tbt_target_s": cs.objective.tbt_target_s,
+                        "compliance": cs.objective.compliance},
+                    "outcomes": cs.outcomes,
+                    "violations": cs.violations,
+                    "budget_remaining": round(cs.budget_remaining(), 4),
+                    "burn_fast": round(cs.burn_fast, 3),
+                    "burn_slow": round(cs.burn_slow, 3),
+                } for n, cs in sorted(self._pools.items())},
             "alerts": list(self.alert_log),
             "accept_drift": (None if self.accept_drift is None else {
                 "level": self.drift_level,
@@ -359,6 +420,8 @@ class SLOMonitor:
         self.alert_log = []
         self._classes = {p: _ClassState(o, self.slow_window)
                          for p, o in self.objectives.items()}
+        self._pools = {n: _ClassState(o, self.slow_window)
+                       for n, o in self.pool_objectives.items()}
         self._reset_drift()
 
 

@@ -555,6 +555,98 @@ class TestPreemptFailoverLeakGuard:
         assert eng.pager.leak_report() == []
         assert pc.host_tier.stats()["pending_stages"] == 0
 
+    def test_randomized_cross_pool_handoff_schedule(self, tiny):
+        """r22 (ISSUE 17 satellite): the randomized schedule gains the
+        disaggregation ops — export-after-prefill on a source pool and
+        import-before-decode on a destination pool, interleaved with
+        the existing admit / serve / preempt / spill churn. TWO
+        engines stand in for the prefill and decode pools, each with
+        its own allocator and host-tiered cache; requests that have
+        emitted a first token get preempted, their prefix staged,
+        exported as host bytes, imported into the other pool's cache
+        and requeued there (the DisaggRouter's handoff path, driven
+        adversarially). The free-list/refcount invariant must hold on
+        BOTH pools at every step, and both pools drain clean."""
+        from paddle_tpu.inference.kv_tiers import HostTier
+
+        cfg, params = tiny
+
+        def mk():
+            eng = ServingEngine(cfg, params, slots=2, max_len=96,
+                                prompt_buckets=(8, 16, 32), paged=True,
+                                page_size=16, chunked_prefill=True,
+                                prefill_chunks=(8,))
+            pc = PagedPrefixCache(eng.pager, capacity_pages=16,
+                                  host_tier=HostTier(eng.pager,
+                                                     capacity_pages=32))
+            return eng, pc
+
+        src, pc_src = mk()          # the prefill pool
+        dst, pc_dst = mk()          # the decode pool
+        rng = np.random.RandomState(17)
+        handoffs = 0
+        for step in range(48):
+            op = rng.randint(6)
+            if op == 0 and len(src._queue) < 4:          # admit @ prefill
+                # generations long enough to SURVIVE a segment — a
+                # request must be mid-decode for a handoff to exist
+                src.add_request(
+                    rng.randint(0, cfg.vocab_size,
+                                (int(rng.randint(4, 20)),)).astype(
+                                    np.int32),
+                    int(rng.randint(12, 24)))
+            elif op == 1 and (src._queue
+                              or src.free_slot_count() < src.slots):
+                src.run_segment(8, prefix_cache=pc_src)
+            elif op == 2 and (dst._queue
+                              or dst.free_slot_count() < dst.slots):
+                dst.run_segment(8, prefix_cache=pc_dst)
+            elif op == 3:                                # handoff
+                live = [s for s in range(src.slots)
+                        if src._active[s] is not None
+                        and src.can_preempt(s)
+                        and src._active[s].tokens
+                        and not src._active[s].done]
+                if not live:
+                    continue
+                s = live[int(rng.randint(len(live)))]
+                r = src.preempt_slot(s, prefix_cache=pc_src)
+                if pc_src.host_tier.stats()["pending_stages"]:
+                    pc_src.host_tier.flush()             # export side
+                fp, _ = r.resume_view()
+                plen_b = pc_src.round_down(len(fp))
+                if plen_b:
+                    key = np.asarray(fp[:plen_b], np.int32).tobytes()
+                    exp = pc_src.export_host(key)
+                    if exp is not None:                  # import side
+                        planes = {p: exp[p] for p in exp
+                                  if p not in ("tokens", "pages")}
+                        pc_dst.import_host(exp["tokens"], planes)
+                r.rid = dst._next_rid                    # requeue @ decode
+                dst._next_rid += 1
+                dst._queue.append(r)
+                handoffs += 1
+            elif op == 4 and rng.rand() < 0.3:           # forced spill
+                (pc_src if rng.randint(2) else pc_dst).evict_until(
+                    src.pager.num_pages)
+            elif op == 5 and rng.rand() < 0.1:           # decode-pool kill
+                for r in dst.abort():
+                    dst._queue.append(r)
+                pc_dst.reset()
+            for eng, who in ((src, "prefill"), (dst, "decode")):
+                assert eng.pager.allocator.check() == [], \
+                    f"{who} allocator invariant broke at step {step}"
+        assert handoffs > 0, "schedule never exercised a handoff"
+        # clean drain of BOTH pools
+        for eng, pc in ((src, pc_src), (dst, pc_dst)):
+            while eng._queue or eng.free_slot_count() < eng.slots:
+                eng.run_segment(16, prefix_cache=pc)
+            for r in eng._finished:
+                assert r.done
+            pc.clear()
+            assert eng.pager.leak_report() == []
+            assert pc.host_tier.stats()["pending_stages"] == 0
+
 
 class TestPagedSchedulerAudit:
     def test_online_serve_loop_syncs(self, tiny):
