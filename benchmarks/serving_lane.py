@@ -73,6 +73,16 @@ Writes ``SERVING_r<N>.json`` at the repo root:
               the warmup bill split vs the co-resident union ladder,
               and the bit-exact cross-pool journal replay...},
               (r22: disaggregated serving, ISSUE 17)
+   "longctx": {...llama_serving --longctx json: long-context serving —
+              one 256-token prompt sequence-parallel-prefilled at
+              sp=1/2/4 (the slab-step ledger exactly 1/sp, wall TTFT
+              evidence), tokens bit-identical across sp and vs the
+              unsharded reference, co-resident short-request TBT p99
+              per sp, the sp=1 multi-segment spanning reservation,
+              the spseg AOT ladder's zero-compile certificate, the
+              one-fetch sync audit, and the bit-exact sp=2 journal
+              replay...},
+              (r23: long-context serving, ISSUE 18)
    "telemetry_headlines": {...r10 runtime-telemetry headlines per mode —
               queue depth / slot occupancy / prefix hit rate /
               backpressure counters from paddle_tpu.observability; the
@@ -198,6 +208,15 @@ def main() -> int:
         # the one-fetch + one-flush sync audit, and the bit-exact
         # cross-pool journal replay
         "disagg": _run_json("llama_serving.py", args=("--disagg",)),
+        # r23 (ISSUE 18): long-context serving — the 256-token prompt
+        # sequence-parallel-prefilled at sp=1/2/4 (slab-step ledger
+        # exactly 1/sp, wall TTFT evidence alongside), tokens
+        # bit-identical across sp AND vs the unsharded reference,
+        # co-resident short-request TBT p99 per sp, the sp=1
+        # multi-segment spanning reservation, the spseg AOT ladder's
+        # zero-compile certificate, the one-fetch-per-segment sync
+        # audit, and the bit-exact sp=2 journal replay
+        "longctx": _run_json("llama_serving.py", args=("--longctx",)),
     }
     result["platform"] = result["online"].get("platform", "unknown")
     # r10: lift each mode's runtime-telemetry headline (queue depth,
@@ -208,7 +227,7 @@ def main() -> int:
         k: (result[k].get("telemetry") or {}).get("headline")
         for k in ("online", "prefix", "paged", "fleet", "overload",
                   "failover", "slo", "spec", "quality", "capacity",
-                  "tiered", "quant", "disagg")}
+                  "tiered", "quant", "disagg", "longctx")}
     # r15: lift the speculative headline — the roofline-beating ratio
     # an operator (or the next round's reviewer) checks first
     spec = result["spec"].get("headline") or {}
@@ -292,6 +311,11 @@ def main() -> int:
     # per-crossing handoff budget, the per-pool zero-compile + warmup
     # bill split, and the cross-pool replay identity
     result["disagg_headline"] = result["disagg"].get("headline")
+    # r23 (ISSUE 18): lift the long-context headline — the 1/sp
+    # slab-step law, token identity across sp and vs the unsharded
+    # reference, the spanning reservation, the spseg zero-compile
+    # certificate and the sp=2 replay identity
+    result["longctx_headline"] = result["longctx"].get("headline")
     path = os.path.join(ROOT, f"SERVING_r{rnd:02d}.json")
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
@@ -300,7 +324,7 @@ def main() -> int:
              for k in ("decode", "serving", "online", "prefix", "paged",
                        "fleet", "overload", "failover", "slo", "spec",
                        "quality", "capacity", "tiered", "aot", "quant",
-                       "disagg"))
+                       "disagg", "longctx"))
     return 0 if ok else 1
 
 

@@ -113,6 +113,13 @@ TPU_TEST_FILES = [
     # per-crossing budget audit, per-pool AOT coverage and the
     # cross-pool replay all gain their hardware half here
     "tests/test_disagg.py",
+    # r23 (ISSUE 18): long-context serving — on chip the spseg slab's
+    # batch axis rides a REAL 'sp' mesh (each chunk's rows on their own
+    # devices, ring attention via ppermute), so the sp=1 pseg
+    # degeneracy, sp=2 pool page parity, slab-vs-dense identity, the
+    # spanning-reservation continuation and the spseg AOT/zero-compile
+    # certificate all gain their hardware half here
+    "tests/test_longctx_serving.py",
 ]
 
 

@@ -61,6 +61,15 @@ Usage::
                                                   # it — every other
                                                   # program's budget is
                                                   # bit-identical either way
+    python -m paddle_tpu.analysis --gate --longctx on # (default) the r23
+                                                  # contract: the sequence-
+                                                  # parallel long-context
+                                                  # segment audited as the
+                                                  # 11th canonical program;
+                                                  # --longctx off drops ONLY
+                                                  # it — every other
+                                                  # program's budget is
+                                                  # bit-identical either way
     python -m paddle_tpu.analysis --gate --disagg on # (default) the r22
                                                   # contract: the handoff
                                                   # auditor ATTACHED (a
@@ -195,6 +204,14 @@ def main(argv=None) -> int:
                          "programs' budgets must be bit-identical "
                          "either way (the quantized path shares no "
                          "state with them)")
+    ap.add_argument("--longctx", choices=("on", "off"), default="on",
+                    help="audit the r23 sequence-parallel long-context "
+                         "segment (longctx_serving_segment) alongside "
+                         "the other canonical programs (default: on). "
+                         "--longctx off drops only that program — the "
+                         "remaining programs' budgets must be "
+                         "bit-identical either way (the sp-slab path "
+                         "shares no state with them)")
     ap.add_argument("--disagg", choices=("on", "off"), default="on",
                     help="audit with the r22 disaggregated-serving "
                          "handoff auditor attached: a flight listener "
@@ -264,6 +281,8 @@ def main(argv=None) -> int:
     targets = args.program or programs.names()
     if args.quant == "off":
         targets = [n for n in targets if n != "quant_serving_segment"]
+    if args.longctx == "off":
+        targets = [n for n in targets if n != "longctx_serving_segment"]
     results = []
     any_violation = False
     aot_total_keys = 0

@@ -194,6 +194,32 @@ BUDGETS: Dict[str, Budget] = {
         notes="r21 contract: narrow weight/KV streams at zero extra "
               "syncs/compiles/shapes — the quantized roofline win is "
               "pure bytes, not a hazard trade"),
+    # The LONG-CONTEXT sequence-parallel segment (r23, ISSUE 18): the
+    # paged_serving_segment contract for prompts PAST the regular
+    # bucket ladder — prefill runs as [sp, C] slab steps whose rows
+    # scatter page-indirectly into the shared pool, so decode picks up
+    # on the ordinary page-indirect path with ZERO relayout at the
+    # prefill→decode boundary. Long context must be FREE at the hazard
+    # level: still exactly one event fetch per segment, zero warm
+    # compiles (the ("spseg", n_pad, s_max, C, sp, steps) family closes
+    # over the declared long-bucket ladder — sp_rungs is statically
+    # enumerated and AOT-warmed), zero pack bytes, and the relayout
+    # ledger is the while-body pool-carry class plus the slab steps'
+    # [sp, C]-window scatter copies (measured between the chunked and
+    # plain paged segments: slabs carry sp*C-token windows where cseg
+    # carries C and pseg carries s_max).
+    "longctx_serving_segment": Budget(
+        flagged_syncs=0,
+        allowed_syncs_per_replay={"serving.segment_event_fetch": 1},
+        warm_compiles=0,
+        # measured 1,106,668 B (while-body pool carries + slab-window
+        # scatter copies) + ~5%
+        relayout_bytes_max=1_162_000,
+        pack_bytes_max=_MiB // 2,      # measured 0
+        undonated_bytes_max=_MiB // 2,  # measured 0 (pool+table donated)
+        notes="r23 contract: sp-slab prefill scattering into the paged "
+              "pool — long context at zero extra syncs/compiles and "
+              "zero boundary relayout"),
     # The TENSOR-PARALLEL segment (r12): the serving_segment contract,
     # GSPMD-sharded — same one fetch per segment and zero warm compiles,
     # PLUS every collective must attribute to the 'mp' axis (enforced

@@ -149,8 +149,32 @@ def reachable_keys_replay(engine, envelope) -> FrozenSet[tuple]:
                 if h + w <= engine.max_len:
                     pre_widths.add((h, w))
 
+    # r23 sequence-parallel long-context (spseg): replay the long-rung
+    # arithmetic by brute force — for every ENGAGING first-admission
+    # suffix (past the largest regular bucket, up to the envelope /
+    # long-ladder cap) walk the continuation chain down one slab
+    # (sp * C rows) at a time, mapping each surviving suffix through
+    # the engine's own rung helper. The closed-form enumerator derives
+    # the same set via residues; check_envelope asserts they agree.
+    sp = int(getattr(engine, "seq_parallel", 0) or 0)
+    sp_widths: set = set()
+    if engine.paged and sp:
+        C = engine.prefill_chunks[-1]
+        Cs = sp * C
+        cap = min(env.max_prompt, engine.long_buckets[-1])
+        for L in range(top + 1, cap + 1):
+            s = L
+            while s > 0:
+                lb = engine._long_rung(s)
+                sp_widths.add((-(-lb // Cs) * Cs, C))
+                s -= Cs
+
     for n_pad in n_pads:
         for steps in env.seg_steps:
+            if engine.paged and sp:
+                for w, c in sp_widths:
+                    keys.add(space.key("spseg", n_pad=n_pad, s_max=w,
+                                       c=c, sp=sp, steps=steps))
             if engine.paged:
                 if spec:
                     if steps >= 2:

@@ -36,6 +36,11 @@ whose hazard ledger earlier rounds paid for by hand:
   per-page KV scale planes riding the pool; same one-dispatch/one-fetch
   loop on the qpseg dtype axis — zero extra syncs/compiles is the
   contract that makes the quantized rollout a pure bytes win).
+* ``longctx_serving_segment`` — the r23 sequence-parallel long-context
+  segment (a past-the-buckets prompt prefills as [sp, C] slabs whose
+  rows scatter straight into the paged pool; decode proceeds on the
+  ordinary page-indirect path with zero relayout at the boundary;
+  still exactly one event fetch, spseg keys statically enumerated).
 
 Builders are deterministic (fixed seeds, fixed shapes) so the measured
 metrics are stable run to run and ``budgets.py`` can pin them as exact
@@ -373,6 +378,75 @@ def _build_chunked_serving_segment() -> ProgramHandle:
               "with decode ticks) + host event replay, llama-tiny",
         aot_engine=eng,
         aot_envelope=_gate_envelope(seg_steps=(16,)),
+        keepalive=(eng,))
+
+
+@register("longctx_serving_segment")
+def _build_longctx_serving_segment() -> ProgramHandle:
+    """The r23 sequence-parallel long-context segment (ISSUE 18): a
+    prompt PAST the regular bucket ladder prefills as sp-row slabs —
+    each slab step covers ``sp * C`` prompt tokens reshaped to [sp, C]
+    rows at absolute offsets ``base + r*C``, every row scattering its
+    K/V straight into the shared paged pool — interleaved with ordinary
+    decode ticks for co-resident slots. The contract the budget pins:
+    long-context must be free at the hazard level — still exactly ONE
+    event fetch per segment, zero warm compiles (the ("spseg", n_pad,
+    s_max, C, sp, steps) family is closed over the declared long-bucket
+    ladder, so sp_rungs is statically enumerable), no pack traffic, and
+    the relayout ledger stays in the while-body pool-carry class: the
+    prefill→decode boundary costs ZERO relayout because decode reads
+    the very pages the slab rows scattered."""
+    import numpy as np
+
+    import jax.numpy as j
+
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg)
+    eng = ServingEngine(cfg, params, slots=4, max_len=64, chunk=8,
+                        prompt_buckets=(16,), paged=True, page_size=16,
+                        prefill_chunks=(8,), seq_parallel=2,
+                        long_buckets=(32,))
+    rng = np.random.RandomState(0)
+
+    def replay():
+        # end-to-end LONG-CONTEXT segment: one 24-token prompt (past
+        # the 16 bucket — slab-prefills as 2 steps of [2, 8] rows) plus
+        # one co-resident 12-token prompt, decode to completion inside
+        # the segment (slots + pages drain), one allowed event fetch
+        eng.add_request(rng.randint(0, cfg.vocab_size, (24,)), 4)
+        eng.add_request(rng.randint(0, cfg.vocab_size, (12,)), 4)
+        return eng.run_segment(16)
+
+    def hlo():
+        n_pad = eng._pow2(eng.slots)
+        C = eng.prefill_chunks[-1]
+        Cs = eng.seq_parallel * C
+        s_max = -(-eng.long_buckets[-1] // Cs) * Cs
+        seg = eng._sp_segment_prog(n_pad, s_max, C, 16)
+        pgr = eng.pager
+        return seg.lower(
+            params, pgr.pool, pgr.page_table,
+            j.zeros((eng.slots,), j.int32), j.zeros((eng.slots,), j.int32),
+            j.zeros((eng.slots,), j.int32),
+            j.zeros((n_pad, s_max), j.int32), j.ones((n_pad,), j.int32),
+            j.zeros((n_pad,), j.int32), j.zeros((n_pad,), j.int32),
+            j.zeros((n_pad, pgr.max_pages), j.int32),
+            j.int32(2)).compile().as_text()
+
+    return ProgramHandle(
+        name="longctx_serving_segment",
+        hlo=_memo(hlo),
+        replay=replay,
+        donation_threshold=1 << 16,
+        expected_undonated=(),
+        notes="sequence-parallel long-context segment (sp=2 slab prefill "
+              "scattering into the paged pool, page-indirect decode) + "
+              "host event replay, llama-tiny",
+        aot_engine=eng,
+        aot_envelope=_gate_envelope(seg_steps=(16,), max_prompt=24),
         keepalive=(eng,))
 
 

@@ -140,9 +140,11 @@ class HybridCommunicateGroup:
         self.nranks = topology.world_size()
 
         mesh = get_mesh()
-        # trailing 1 = the ep axis (fleet's topology doesn't route expert
-        # parallelism; MoE meshes are built via create_hybrid_mesh(ep=...))
-        want = (self._dp, self._pp, self._sharding, self._mp, self._sep, 1)
+        # trailing 1s = the sp and ep axes (fleet's topology routes neither
+        # serving sequence-parallelism nor expert parallelism; those meshes
+        # are built via create_hybrid_mesh(sp=... / ep=...))
+        want = (self._dp, self._pp, self._sharding, self._mp, self._sep,
+                1, 1)
         if mesh is None or tuple(mesh.shape[a] for a in HYBRID_AXES) != want:
             import jax
 

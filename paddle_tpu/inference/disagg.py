@@ -17,9 +17,11 @@ decode pool instead:
   segment fetch lands, every live slot whose first token is out is
   preempted (``preempt_slot`` parks the page-aligned prefix in the
   replica's cache BY REFERENCE and queues the write-through host
-  stage), the pool's staged bytes are materialised with ONE labelled
-  ``serving.tier_transfer`` sync for the whole sweep
-  (``HostTier.flush``), and each request's page set crosses pools via
+  stage) and the crossing is PARKED; the drain at the next dispatch
+  (``_pre_dispatch`` — r23) materialises every parked crossing's
+  staged bytes with ONE labelled ``serving.tier_transfer`` sync, so
+  several boundaries crossing in the same loop turn share a single
+  sync, and each request's page set crosses pools via
   r19's replica-portable ``export_host`` → ``import_host`` bytes. The
   request requeues on the chosen decode replica (the ``_kill_replica``
   requeue pattern: fresh engine-local rid, stable fleet rid), whose
@@ -162,6 +164,12 @@ class DisaggRouter(FleetRouter):
         self.handoff_fallbacks = 0          # finished in place instead
         self.handoff_flushes = 0            # labelled tier_transfer syncs
         self.handoff_log: List[dict] = []
+        # r23 (ISSUE 18 satellite): boundary sweeps PLAN crossings and
+        # park them here; the drain at the next dispatch (or idle turn)
+        # materialises every parked crossing under ONE labelled tier
+        # sync — several boundaries crossing in the same loop turn
+        # share it. Entries: (src replica, request, fleet rid).
+        self._pending_handoffs: List[tuple] = []
 
     # --- pools ------------------------------------------------------------
     def pool_replicas(self, pool: str) -> List[_Replica]:
@@ -243,13 +251,14 @@ class DisaggRouter(FleetRouter):
             → preempt (park page-aligned prefix by reference, queue
               write-through stage) — else finish in place (fallback)
         sweep end
-          → ONE ``HostTier.flush`` materialises every queued stage
-            (the single labelled ``serving.tier_transfer`` sync this
-            sweep costs; a sweep that staged nothing costs none)
-          → per request: export_host → import_host into the decode
-            replica's cache (the device_put seam), bill pages/bytes,
-            journal the ``handoff`` decision, requeue on the decode
-            engine."""
+          → PARK the planned crossings on ``_pending_handoffs``; no
+            sync happens here (r23). The fleet's ``_pre_dispatch``
+            hook drains the parked batch right before the next
+            dispatch (or from the idle branch), so several boundaries
+            crossing in the same loop turn share ONE labelled
+            ``serving.tier_transfer`` sync instead of one each — the
+            per-crossing ledger (journal decisions, byte billing,
+            counters) is untouched, only the sync count collapses."""
         if rep.pool != "prefill":
             return
         eng = rep.engine
@@ -263,23 +272,106 @@ class DisaggRouter(FleetRouter):
             if not eng.can_preempt(slot):
                 self.handoff_fallbacks += 1     # finishes in place
                 continue
-            dst = self._handoff_target(req)
-            if dst is None:
+            if self._handoff_target(req) is None:
                 self.handoff_fallbacks += 1
                 continue
-            planned.append((slot, req, dst))
+            planned.append((slot, req))
         if not planned:
             return
         with _metrics.scoped_registry(rep.registry), \
                 _journal.rank_scope(rep.idx):
-            for slot, req, _dst in planned:
+            for slot, req in planned:
                 out = eng.preempt_slot(slot, pc)
                 assert out is req
-            if pc.host_tier.stats()["pending_stages"]:
-                pc.host_tier.flush()
-                self.handoff_flushes += 1
-        for _slot, req, dst in planned:
-            self._do_handoff(rep, dst, req, frid_of[id(req)])
+        # the target is re-resolved at drain time — loads (and health)
+        # can shift while the crossing is parked
+        self._pending_handoffs.extend(
+            (rep, req, frid_of[id(req)]) for _slot, req in planned)
+
+    # --- the coalesced drain (r23) ----------------------------------------
+    def _has_deferred_work(self) -> bool:
+        return bool(self._pending_handoffs)
+
+    def _pre_dispatch(self, rep) -> None:
+        self._drain_handoffs()
+
+    def _drain_handoffs(self) -> None:
+        """Materialise every parked crossing. ONE labelled
+        ``serving.tier_transfer`` sync covers ALL source tiers that
+        staged since the last drain (the coalescing point — this is
+        the multi-tier twin of ``kv_tiers.flush_tiers``, inlined so
+        each tier's ``complete`` lands under its own replica's metric
+        registry and journal rank scope); then each crossing runs the
+        unchanged r22 export → import → bill → journal → requeue
+        sequence."""
+        if not self._pending_handoffs:
+            return
+        entries, self._pending_handoffs = self._pending_handoffs, []
+        srcs = list({id(e[0]): e[0] for e in entries}.values())
+        work = []
+        for src in srcs:
+            staged = src.prefix_cache.host_tier.take_pending()
+            if staged:
+                work.append((src, staged))
+        if work:
+            import jax
+
+            from ..analysis.syncs import allowed_sync
+
+            with allowed_sync("serving.tier_transfer"):
+                vals = jax.device_get([[s[2:] for s in staged]
+                                       for _, staged in work])
+            for (src, staged), v in zip(work, vals):
+                with _metrics.scoped_registry(src.registry), \
+                        _journal.rank_scope(src.idx):
+                    src.prefix_cache.host_tier.complete(staged, v)
+            self.handoff_flushes += 1
+        for src, req, frid in entries:
+            dst = self._handoff_target(req)
+            if dst is None:
+                # every decode replica died while the crossing was
+                # parked: pool discipline yields to liveness — requeue
+                # by the failover rule among whatever is healthy
+                survivors = [x for x in self._replicas
+                             if x.health == "healthy"]
+                if not survivors:
+                    raise RuntimeError(
+                        f"request {frid} was preempted for handoff but "
+                        "no healthy replica remains to receive it")
+                dst = self._failover_target(survivors, req)
+                self.handoff_fallbacks += 1
+            self._do_handoff(src, dst, req, frid)
+
+    def _kill_replica(self, rep: _Replica, reason: str) -> None:
+        # parked crossings sourced at the dying replica cannot wait for
+        # the next drain: their requests live NOWHERE the base failover
+        # can see (preempt_slot already removed them from the engine).
+        # Their staged-but-unflushed futures die with the tier, so they
+        # requeue WITHOUT import (export misses → bytes=0 journaled) —
+        # the decode replica re-prefills from the resume view: correct,
+        # just costs compute.
+        mine = [e for e in self._pending_handoffs if e[0] is rep]
+        if mine:
+            self._pending_handoffs = [e for e in self._pending_handoffs
+                                      if e[0] is not rep]
+            rep.prefix_cache.host_tier.take_pending()   # discard futures
+            for src, req, frid in mine:
+                dst = self._handoff_target(req)
+                if dst is not None:
+                    self._do_handoff(src, dst, req, frid)
+                    continue
+                survivors = [x for x in self._replicas
+                             if x.health == "healthy" and x is not rep]
+                if not survivors:
+                    raise RuntimeError(
+                        f"request {frid} was preempted for handoff and "
+                        f"its source replica {rep.idx} died with no "
+                        "healthy survivor to receive it")
+                self._do_handoff(src, self._failover_target(survivors,
+                                                            req),
+                                 req, frid)
+                self.handoff_fallbacks += 1
+        super()._kill_replica(rep, reason)
 
     def _do_handoff(self, src: _Replica, dst: _Replica, req: Request,
                     frid: int) -> None:
@@ -368,6 +460,7 @@ class DisaggRouter(FleetRouter):
         self.handoff_fallbacks = 0
         self.handoff_flushes = 0
         self.handoff_log = []
+        self._pending_handoffs = []
 
     def handoff_report(self) -> dict:
         return {"handoffs": self.handoffs,

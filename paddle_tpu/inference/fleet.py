@@ -1015,7 +1015,8 @@ class FleetRouter:
         self._serve_t0 = t0
         self._finished_count = 0
         self.last_retry_after_s = None
-        while pending or inflight or any(r.busy for r in reps):
+        while (pending or inflight or any(r.busy for r in reps)
+               or self._has_deferred_work()):
             now = _journal.now() - t0
             self._probe_dead()
             self._ingest(pending, now, t0)
@@ -1026,6 +1027,11 @@ class FleetRouter:
                          if r.health != "dead" and r.busy
                          and r.engine._pending_seg is None]
             for r in busy_idle:
+                # r23: deferred cross-pool work (the DisaggRouter's
+                # coalesced handoff drain) materialises BEFORE any
+                # dispatch, so a handed-off request is page-resident on
+                # its target before the target's next segment can admit
+                self._pre_dispatch(r)
                 with _metrics.scoped_registry(r.registry), \
                         _journal.rank_scope(r.idx):
                     h = r.engine.dispatch_segment(
@@ -1036,7 +1042,12 @@ class FleetRouter:
             # dispatches of this turn, on the already-read clock
             self._shadow_step(now + t0)
             if not inflight:
-                if pending:
+                if self._has_deferred_work():
+                    # r23: nothing in flight to coalesce behind — drain
+                    # the deferred handoffs now (requeues make their
+                    # targets busy, so the next turn dispatches them)
+                    self._pre_dispatch(None)
+                elif pending:
                     gap = pending[0].t - (_journal.now() - t0)
                     if gap > 0:
                         _journal.sleep(min(gap, 0.05))
@@ -1250,6 +1261,21 @@ class FleetRouter:
         and the monitors are fed, while ``rep``'s engine is idle. The
         homogeneous fleet does nothing; the r22 ``DisaggRouter``
         overrides this with the prefill→decode handoff sweep."""
+
+    def _pre_dispatch(self, rep: Optional[_Replica]) -> None:
+        """Hook invoked immediately before each segment dispatch (and
+        from the idle branch with ``rep=None``): the point where work
+        deferred across loop turns must land on its target replicas.
+        No-op here; the r23 ``DisaggRouter`` drains its coalesced
+        handoff batch — one labelled tier sync covering every boundary
+        crossed since the previous dispatch."""
+
+    def _has_deferred_work(self) -> bool:
+        """True while cross-replica work is parked awaiting the next
+        ``_pre_dispatch`` (keeps the serve loop alive when every engine
+        is momentarily idle but a deferred handoff still owes tokens).
+        The homogeneous fleet defers nothing."""
+        return False
 
     def _seg_steps_for(self, rep: _Replica) -> int:
         """Per-replica segment budget. Homogeneous fleets use one knob;

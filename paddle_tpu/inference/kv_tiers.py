@@ -58,7 +58,8 @@ import numpy as np
 from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 
-__all__ = ["HostTier", "TierMeter", "page_bytes", "install", "uninstall"]
+__all__ = ["HostTier", "TierMeter", "page_bytes", "flush_tiers",
+           "install", "uninstall"]
 
 
 def page_bytes(pager) -> int:
@@ -202,6 +203,8 @@ class HostTier:
         self.complete(staged, vals)
 
     # --- host store -------------------------------------------------------
+    # (module-level flush_tiers below batches SEVERAL tiers' pending
+    # stages under one labelled sync — the r23 disagg-coalescing path)
     def _put(self, key: bytes, planes: Dict[str, np.ndarray],
              n: int) -> None:
         old = self._host.pop(key, None)
@@ -319,6 +322,38 @@ class HostTier:
                 "bytes_to_hbm": self.bytes_to_hbm,
                 "bytes_imported": self.bytes_imported,
                 "page_bytes": self.page_bytes()}
+
+
+def flush_tiers(tiers) -> int:
+    """Materialise the queued stages of SEVERAL tiers under ONE labelled
+    ``serving.tier_transfer`` sync (r23 disagg satellite): when multiple
+    requests cross the prefill→decode boundary in the same fleet loop
+    turn, each crossing stages its handoff pages on its source replica's
+    tier, and this coalesces all of those D2H copies into a single
+    ``device_get`` instead of one sync per crossing. Per-tier
+    ``complete()`` still lands each tier's bytes in its own host store
+    (the per-crossing ledger — counters, journal events, byte billing —
+    is untouched; only the SYNC count collapses).
+
+    Returns the number of tiers that actually had pending stages (0 means
+    no sync was issued at all)."""
+    work = []
+    for t in tiers:
+        staged = t.take_pending()
+        if staged:
+            work.append((t, staged))
+    if not work:
+        return 0
+    import jax
+
+    from ..analysis.syncs import allowed_sync
+
+    with allowed_sync("serving.tier_transfer"):
+        flat = jax.device_get(
+            [[s[2:] for s in staged] for _, staged in work])
+    for (t, staged), vals in zip(work, flat):
+        t.complete(staged, vals)
+    return len(work)
 
 
 # ---------------------------------------------------------------------------

@@ -349,6 +349,45 @@ def _is_paged_spec(engine) -> bool:
     return engine.paged and bool(engine.speculative or engine.sampling)
 
 
+def _seq_parallel(engine) -> int:
+    # getattr for the same lightweight stand-in reason as _quant
+    return int(getattr(engine, "seq_parallel", 0) or 0)
+
+
+def _is_paged_sp(engine) -> bool:
+    # r23: the spseg family ADDS to an sp engine's space (regular
+    # traffic still rides pseg/cseg — those predicates are untouched)
+    return engine.paged and _seq_parallel(engine) > 0
+
+
+def sp_rungs(engine, env: WorkloadEnvelope) -> Tuple[int, ...]:
+    """The ``long_buckets`` rungs a sequence-parallel engine can reach
+    under ``env`` (r23). Engagement needs a first-admission suffix past
+    the largest REGULAR bucket; continuations then shrink the suffix by
+    whole slabs (``sp * C`` rows per landed slab), so reachable
+    suffixes are every value congruent mod the slab width to some
+    engaging length. Closed form over residues — the coverage replay
+    re-derives the same set by brute-force (first-length x slab-count)
+    walk and asserts equality."""
+    lbs = engine.long_buckets
+    top_b = engine.buckets[-1]
+    cap = min(env.max_prompt, lbs[-1])
+    if cap <= top_b:
+        return ()
+    Cs = _seq_parallel(engine) * engine.prefill_chunks[-1]
+    residues = {f % Cs for f in range(top_b + 1,
+                                      min(cap, top_b + Cs) + 1)}
+    rungs = set()
+    for s in range(1, cap + 1):
+        if s % Cs not in residues:
+            continue
+        for b in lbs:
+            if s <= b:
+                rungs.add(b)
+                break
+    return tuple(sorted(rungs))
+
+
 def _enum_admit(engine, env: WorkloadEnvelope) -> Iterable[tuple]:
     # windowed-path fused prefill waves: every bucket x wave width that
     # fits the slot count (exactly the set warmup() has always compiled)
@@ -433,6 +472,18 @@ def _enum_cseg(engine, env: WorkloadEnvelope) -> Iterable[tuple]:
                 yield fam.key(n_pad=n_pad, s_max=s_max_c, c=C, steps=steps)
 
 
+def _enum_spseg(engine, env: WorkloadEnvelope) -> Iterable[tuple]:
+    fam = PROGRAM_SPACE.family("spseg")
+    sp = _seq_parallel(engine)
+    C = engine.prefill_chunks[-1]
+    Cs = sp * C
+    for n_pad in _n_pads(engine, env):
+        for steps in env.seg_steps:
+            for lb in sp_rungs(engine, env):
+                yield fam.key(n_pad=n_pad, s_max=-(-lb // Cs) * Cs,
+                              c=C, sp=sp, steps=steps)
+
+
 def _enum_sseg(engine, env: WorkloadEnvelope) -> Iterable[tuple]:
     fam = PROGRAM_SPACE.family("sseg")
     for n_pad in _n_pads(engine, env):
@@ -495,6 +546,16 @@ PROGRAM_SPACE.register(ProgramFamily(
     doc="r15 speculative/sampled paged segment: ('sseg', n_pad, K, "
         "steps) — width pinned to the largest bucket by design",
     enumerate_fn=_enum_sseg, applies=_is_paged_spec))
+
+PROGRAM_SPACE.register(ProgramFamily(
+    name="spseg", tag="spseg", axes=("n_pad", "s_max", "c", "sp", "steps"),
+    doc="r23 sequence-parallel long-context segment: ('spseg', n_pad, "
+        "s_max, C, sp, steps) — s_max is a slab-rounded long_buckets "
+        "rung, C the largest declared prefill chunk, sp the shard "
+        "count (the slab's batch rows; the 'sp' mesh axis when one is "
+        "set). Adds to (never replaces) the engine's pseg/cseg space: "
+        "only prompts past the largest regular bucket engage it",
+    enumerate_fn=_enum_spseg, applies=_is_paged_sp))
 
 
 FAMILY_TAGS: FrozenSet[str] = PROGRAM_SPACE.tags()

@@ -174,6 +174,11 @@ class _PendingSegment:
     # event log additionally carries per-step per-slot logit digests
     # (emitted logit + top-k ids/values) in the same fetch
     digest: bool = False
+    # r23: True when the segment ran the sequence-parallel long-context
+    # program — its event log additionally carries the pf/pfq/pfo
+    # prefill-progress state (a long prefill may span segments; the
+    # host keeps its page reservation and resumes it next dispatch)
+    sp: bool = False
 
 
 @dataclass
@@ -308,7 +313,9 @@ class ServingEngine:
                  sample_seed: int = 0,
                  quality_digest: bool = False,
                  digest_top_k: int = 4,
-                 quant: Optional[str] = None):
+                 quant: Optional[str] = None,
+                 seq_parallel: int = 0,
+                 long_buckets: Sequence[int] = ()):
         self.cfg = cfg
         self.params = params
         self.slots = int(slots)
@@ -468,6 +475,65 @@ class ServingEngine:
                     "variants need their own shadow certification")
             self.params = quantize_llama_params(self.params, cfg,
                                                 self.quant)
+        # r23 long-context serving (ISSUE 18): ``seq_parallel=sp`` adds
+        # the sequence-parallel prefill family ("spseg") — prompts past
+        # the largest REGULAR bucket admit through sp-wide prefill
+        # SLABS (sp chunks of C tokens, the batch axis carrying the
+        # shard axis: under an 'sp' mesh each chunk runs on its own
+        # devices; without one the slab is a plain batched call with
+        # bit-identical math). Every slab row scatters its KV slice
+        # straight into the SHARED paged pool through the request's own
+        # page-table row, so decode proceeds on the ordinary
+        # page-indirect path with zero relayout at the prefill->decode
+        # boundary. ``long_buckets`` is the declared LONG prompt rung
+        # ladder (all rungs >= the largest regular bucket): intake for
+        # long prompts caps at its top, and the spseg key family
+        # enumerates over its rungs so the AOT warmup covers every
+        # reachable slab width. A long prefill may SPAN segments (the
+        # in-program pf/pfq/pfo progress state rides the single event
+        # fetch out and back); its page reservation is taken ONCE at
+        # first admission and HELD across the spanned segments (the
+        # SCALING §3f multi-segment reservation extension — the r19
+        # host tier is the pressure valve when one prompt's KV rivals
+        # the pool). sp=1 degenerates exactly: regular traffic never
+        # engages the family, so program keys and journal streams match
+        # the plain paged engine byte for byte.
+        self.seq_parallel = int(seq_parallel or 0)
+        self.long_buckets = tuple(sorted(int(b) for b in long_buckets))
+        if self.seq_parallel < 0:
+            raise ValueError(f"seq_parallel must be >= 0, got "
+                             f"{seq_parallel}")
+        if self.seq_parallel:
+            if not self.paged:
+                raise ValueError(
+                    "seq_parallel requires paged=True (prefill shards "
+                    "scatter into the shared paged pool; the contiguous "
+                    "cache has no page indirection to land them in)")
+            if self.speculative or self.sampling or self.quality_digest \
+                    or self.quant:
+                raise ValueError(
+                    "seq_parallel composes with the plain/chunked paged "
+                    "segment only — speculative/sampled/digest/quant "
+                    "variants need their own certification")
+            if not self.long_buckets:
+                raise ValueError("seq_parallel needs a non-empty "
+                                 "long_buckets rung ladder")
+            if self.long_buckets[0] < max(self.buckets):
+                raise ValueError(
+                    f"every long bucket must be >= the largest regular "
+                    f"bucket {max(self.buckets)} (got "
+                    f"{self.long_buckets[0]} — regular traffic rides "
+                    f"the ordinary pseg/cseg families)")
+            if self.long_buckets[-1] > self.max_len:
+                raise ValueError(
+                    f"long bucket {self.long_buckets[-1]} exceeds "
+                    f"max_len {self.max_len}")
+        # rid -> {"pages", "resident"} for long prefills spanning
+        # segments: the reservation taken at first admission plus how
+        # many KV rows (prefix hit + slabs landed so far) are already
+        # resident in the pool — the next dispatch resumes the prefill
+        # at that offset with the SAME pages
+        self._sp_inflight: Dict[int, dict] = {}
         # acceptance EWMA (emitted tokens per verify tick, >= 1): the
         # SLO scheduler threads this through its deadline and
         # retry_after_s estimates so speculative serves don't over-shed
@@ -593,7 +659,9 @@ class ServingEngine:
         width PINNED to the largest bucket, quality-digest paged
         segments on ("qseg", n_pad, s_max, steps), quantized paged
         segments on ("qpseg", n_pad, s_max, steps, dtype) with dtype
-        drawn from the declared QUANT_CODES — all bucketed by
+        drawn from the declared QUANT_CODES, sequence-parallel
+        long-context segments on ("spseg", n_pad, s_max, C, sp, steps)
+        with s_max a slab-rounded long_buckets rung — all bucketed by
         construction, so key-count growth here means a shape leaked
         past the buckets (the 2.5 s mid-serve compile class this
         engine's width pinning fixed). Note the PAGED keys carry no
@@ -640,10 +708,13 @@ class ServingEngine:
     def add_request(self, prompt, max_new_tokens: int,
                     seed: Optional[int] = None) -> int:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        if len(prompt) > max(self.buckets):
+        intake_cap = (max(self.long_buckets) if self.seq_parallel
+                      else max(self.buckets))
+        if len(prompt) > intake_cap:
             raise ValueError(
-                f"prompt length {len(prompt)} exceeds the largest bucket "
-                f"{max(self.buckets)}")
+                f"prompt length {len(prompt)} exceeds the largest "
+                f"{'long ' if self.seq_parallel else ''}bucket "
+                f"{intake_cap}")
         if len(prompt) + max_new_tokens - 1 > self.max_len:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
@@ -679,7 +750,10 @@ class ServingEngine:
                 self.mesh, self.speculative, self.sampling,
                 self.chunked, self.prefill_chunks, self.buckets,
                 self.digest_top_k if self.quality_digest else None,
-                self.quant, key)
+                self.quant,
+                ((self.seq_parallel, self.long_buckets)
+                 if self.seq_parallel else None),
+                key)
 
     def _memo_prog(self, key: tuple, build):
         """Two-level memo: per-engine ``_progs`` (the recompile lint's
@@ -774,6 +848,16 @@ class ServingEngine:
             if n <= b:
                 return b
         raise ValueError(f"no bucket for prompt length {n}")
+
+    def _long_rung(self, n: int) -> int:
+        """Smallest declared long bucket covering an ``n``-token
+        suffix (r23): the spseg admit-window rung. A continuation's
+        shrinking suffix walks DOWN the ladder — every rung at or below
+        the first admission's is statically enumerated."""
+        for b in self.long_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"no long bucket for suffix length {n}")
 
     def _fill_slots(self, admits: List[tuple]) -> None:
         """Admission wave: take as many queued requests as there are free
@@ -880,7 +964,8 @@ class ServingEngine:
         envelopes (every reachable key gets compiled at warmup — a
         loose envelope is dead ladder weight the coverage pass will
         name, not an error)."""
-        max_prompt = self.buckets[-1]
+        max_prompt = (self.long_buckets[-1] if self.seq_parallel
+                      else self.buckets[-1])
         return WorkloadEnvelope(
             max_prompt=max_prompt,
             max_new_tokens=max(1, self.max_len + 1 - max_prompt),
@@ -949,6 +1034,12 @@ class ServingEngine:
             if prefix_cache is not None else None
         if tier is not None and self.paged:
             _, hi = env.admit_lengths(self.buckets)
+            if self.seq_parallel:
+                # long-context harvests can park whole long prompts in
+                # the cache, so spill/restore transfer shapes reach the
+                # full long-prompt page span
+                hi = max(hi, min(env.max_prompt + env.max_new_tokens - 1,
+                                 self.max_len))
             tier.prewarm_transfers(hi // self.page_size)
         # windowed-path dummy admits wrote device slot state (pos/nxt);
         # segments and drains ran empty (n_real=0). Either way the
@@ -1022,6 +1113,17 @@ class ServingEngine:
                         else self._paged_segment_prog(n_pad, s_max, steps))
                 pgr = self.pager
                 out = prog(
+                    self.params, pgr.pool, pgr.page_table, self._pos,
+                    self._nxt, self._rem, jnp.zeros((n_pad, s_max), i32),
+                    jnp.ones((n_pad,), i32), jnp.zeros((n_pad,), i32),
+                    jnp.zeros((n_pad,), i32),
+                    jnp.zeros((n_pad, pgr.max_pages), i32), i32(0))
+                pgr.pool, pgr.page_table = out[0], out[1]
+                (self._pos, self._nxt, self._rem) = out[2:5]
+            elif family == "spseg":
+                _, n_pad, s_max, C, _sp, steps = key
+                pgr = self.pager
+                out = self._sp_segment_prog(n_pad, s_max, C, steps)(
                     self.params, pgr.pool, pgr.page_table, self._pos,
                     self._nxt, self._rem, jnp.zeros((n_pad, s_max), i32),
                     jnp.ones((n_pad,), i32), jnp.zeros((n_pad,), i32),
@@ -1613,6 +1715,12 @@ class ServingEngine:
         self._init_spec_state()
         self.spec_accept_ewma = 1.0
         self._rem_host = [0] * self.slots
+        for r in self._queue:
+            info = self._sp_inflight.pop(r.rid, None)
+            if info is not None:
+                r._meter_release()
+                self.pager.release_pages(info["pages"])
+        self._sp_inflight = {}
         self._queue = []
         self._finished = []
         self.last_run_ticks = 0
@@ -1702,6 +1810,7 @@ class ServingEngine:
         recovered replica re-enters service empty."""
         orphans: List[Request] = []
         p, self._pending_seg = self._pending_seg, None
+        released_rids = set()
         if p is not None:
             if p.paged:
                 for pages in p.req_pages:
@@ -1709,10 +1818,20 @@ class ServingEngine:
             for r in p.picked:
                 r.admit_time = 0.0
                 r._meter_release()
+                released_rids.add(r.rid)
             orphans += p.picked
+        # r23: held multi-segment prefill reservations die with the
+        # replica (their landed KV rows are lost) — the request resumes
+        # elsewhere with a fresh full prefill
+        for rid, info in self._sp_inflight.items():
+            if rid not in released_rids:
+                self.pager.release_pages(info["pages"])
+        self._sp_inflight = {}
         for r in self._active:
             if r is not None:
                 r._meter_release()
+        for r in self._queue:
+            r._meter_release()   # held sp reservations just released
         orphans += [r for r in self._active if r is not None]
         orphans += self._queue
         self._queue = []
@@ -2290,6 +2409,181 @@ class ServingEngine:
 
         return segment
 
+    # --- sequence-parallel long-context prefill (r23: ISSUE 18) -----------
+
+    def _sp_segment_prog(self, n_pad: int, s_max_c: int, C: int,
+                         max_steps: int):
+        """``_chunked_segment_prog`` with the prefill chunk widened into
+        an sp-row SLAB: each chunk step prefills ``sp`` consecutive
+        C-token chunks as ``sp`` BATCH rows of one
+        ``forward_with_pages`` call, every row writing its KV slice
+        straight into the request's pages at its own absolute offset.
+        The batch axis IS the sequence-parallel shard axis — under an
+        'sp' mesh GSPMD runs each row on its own devices (ring/Ulysses
+        attention across shards, ``ops/pallas/ring_attention.py``);
+        without one it is a plain batched call. Either way the math is
+        BIT-IDENTICAL to the unsharded chunked prefill: all slab rows
+        scatter before any row attends (per layer), the paged gather
+        window and its absolute-position masks are unchanged, so each
+        query reduces over exactly the same values (the page-parity and
+        token-parity tests pin this). Decode is untouched — the slab
+        lands pool pages the ordinary page-indirect decode path reads,
+        zero relayout at the prefill->decode boundary.
+
+        Differences from the cseg program:
+
+        * a prefill may SPAN segments: ``_startable`` drops the
+          2*chunks budget gate (a 128k prefill never fits one segment
+          by design) and the final ``pf``/``pfq``/``pfo`` progress
+          state returns in the SAME single fetch — the host keeps the
+          page reservation and re-dispatches the remainder as a
+          continuation with ``pre_len`` advanced past the landed rows;
+        * slab coverage rounds the suffix up to ``sp*C``; overrun rows
+          land in reserved tail pages or the trash page and are never
+          read (position-masked), and the emitted first token comes
+          from the WINNER row — the one holding the suffix's true last
+          token.
+
+        Memo key ("spseg", n_pad, s_max, C, sp, steps): s_max is a
+        slab-rounded ``long_buckets`` rung, C the largest declared
+        prefill chunk (TBT for co-resident decodes is bounded by ONE
+        slab's cost — sp*C tokens through the model, which the 'sp'
+        mesh runs as C per shard)."""
+        sp = self.seq_parallel
+        if s_max_c % (sp * C):
+            raise ValueError(f"admit window {s_max_c} is not a multiple "
+                             f"of the sp slab {sp}*{C}")
+        key = PROGRAM_SPACE.key("spseg", n_pad=n_pad, s_max=s_max_c, c=C,
+                                sp=sp, steps=max_steps)
+        return self._memo_prog(key, lambda: self._build_sp_segment_prog(
+            n_pad, s_max_c, C, sp, max_steps))
+
+    def _build_sp_segment_prog(self, n_pad: int, s_max_c: int, C: int,
+                               sp: int, max_steps: int):
+        cfg, slots, eos = self.cfg, self.slots, self.eos
+        max_pages = self.pager.max_pages
+        Cs = sp * C
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def segment(params, pool, ptab, pos, nxt, rem, prompts, lens,
+                    gens, pre_lens, req_tables, n_real):
+            i32 = jnp.int32
+            st = dict(
+                pool=pool, pt=ptab, pos=pos, nxt=nxt, rem=rem,
+                out=jnp.zeros((max_steps, slots), i32),
+                aq=jnp.full((max_steps,), n_pad, i32),    # n_pad = decode
+                aslot=jnp.zeros((max_steps,), i32),
+                pf=i32(-1),      # slot mid-prefill (-1 = none)
+                pfq=i32(0),      # its queue row
+                pfo=i32(0),      # suffix tokens already prefilled
+                phase=i32(0),    # 1 = just chunked -> decode next
+                qidx=i32(0), step=i32(0),
+            )
+
+            def _startable(st):
+                # unlike cseg there is NO worst-case budget gate: a
+                # long prefill is EXPECTED to span segments — progress
+                # carries over through pf/pfq/pfo
+                return st["qidx"] < n_real
+
+            def cond(st):
+                work = (jnp.any(st["rem"] > 0) | (st["pf"] >= 0)
+                        | _startable(st))
+                return work & (st["step"] < max_steps)
+
+            def chunk(st):
+                starting = st["pf"] < 0
+                s = jnp.where(starting,
+                              jnp.argmin(st["rem"]).astype(jnp.int32),
+                              st["pf"])
+                q = jnp.where(starting, st["qidx"], st["pfq"])
+                off = jnp.where(starting, 0, st["pfo"])
+                row = jax.lax.dynamic_slice(req_tables, (q, 0),
+                                            (1, max_pages))
+                # installing the table row is idempotent across chunks
+                pt = st["pt"].at[s].set(row[0])
+                ln = lens[q]
+                pln = pre_lens[q]
+                ar = jnp.arange(sp, dtype=i32)
+                # one sp-row slab: row i prefills suffix tokens
+                # [off+i*C, off+(i+1)*C) at absolute offset
+                # pln+off+i*C through the SAME page-table row — every
+                # row scatters before any row attends, so the slab is
+                # bit-identical to sp sequential chunks
+                slab = jax.lax.dynamic_slice(
+                    prompts, (q, off), (1, Cs)).reshape(sp, C)
+                logits, pool = llama.forward_with_pages(
+                    params, slab, cfg, st["pool"],
+                    jnp.broadcast_to(row, (sp, max_pages)),
+                    pln + off + ar * C,
+                    logit_pos=jnp.clip(ln - 1 - off - ar * C, 0, C - 1))
+                done = off + Cs >= ln
+                # the winner row holds the suffix's true last token;
+                # rows past it see garbage their clamp masks out
+                r_star = jnp.clip((ln - 1 - off) // C, 0, sp - 1)
+                t0 = jnp.argmax(logits, axis=-1).astype(i32)[r_star]
+                rem_new = gens[q] - 1
+                if eos is not None:
+                    rem_new = jnp.where(t0 == eos, 0, rem_new)
+                return dict(
+                    pool=pool, pt=pt,
+                    pos=jnp.where(done, st["pos"].at[s].set(pln + ln),
+                                  st["pos"]),
+                    nxt=jnp.where(done, st["nxt"].at[s].set(t0),
+                                  st["nxt"]),
+                    rem=jnp.where(done, st["rem"].at[s].set(rem_new),
+                                  st["rem"]),
+                    out=jnp.where(done,
+                                  st["out"].at[st["step"], s].set(t0),
+                                  st["out"]),
+                    aq=st["aq"].at[st["step"]].set(
+                        jnp.where(done, q, i32(n_pad + 1))),
+                    aslot=st["aslot"].at[st["step"]].set(s),
+                    pf=jnp.where(done, i32(-1), s),
+                    pfq=q, pfo=off + Cs, phase=i32(1),
+                    qidx=jnp.where(starting, st["qidx"] + 1, st["qidx"]),
+                    step=st["step"],
+                )
+
+            def decode(st):
+                live = st["rem"] > 0
+                logits, pool = llama.forward_with_pages(
+                    params, st["nxt"][:, None], cfg, st["pool"],
+                    st["pt"], st["pos"], live=live)
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                tok = jnp.where(live, tok, st["nxt"])
+                rem = st["rem"] - live.astype(jnp.int32)
+                if eos is not None:
+                    rem = jnp.where(live & (tok == eos), 0, rem)
+                return dict(
+                    pool=pool, pt=st["pt"],
+                    pos=st["pos"] + live.astype(jnp.int32),
+                    nxt=tok, rem=rem,
+                    out=st["out"].at[st["step"]].set(tok),
+                    aq=st["aq"], aslot=st["aslot"],
+                    pf=st["pf"], pfq=st["pfq"], pfo=st["pfo"],
+                    phase=i32(0),
+                    qidx=st["qidx"], step=st["step"],
+                )
+
+            def body(st):
+                live_any = jnp.any(st["rem"] > 0)
+                pf_active = st["pf"] >= 0
+                can_start = ((~pf_active) & jnp.any(st["rem"] == 0)
+                             & _startable(st))
+                do_chunk = ((pf_active | can_start)
+                            & ((st["phase"] == 0) | ~live_any))
+                st = jax.lax.cond(do_chunk, chunk, decode, st)
+                st["step"] = st["step"] + 1
+                return st
+
+            st = jax.lax.while_loop(cond, body, st)
+            return (st["pool"], st["pt"], st["pos"], st["nxt"], st["rem"],
+                    st["out"], st["aq"], st["aslot"], st["pf"],
+                    st["pfq"], st["pfo"], st["step"], st["qidx"])
+
+        return segment
+
     # --- speculative + sampled segments (r15: ISSUE 10, ROADMAP item 3) ---
     def _spec_segment_prog(self, n_pad: int, max_steps: int):
         """The paged segment with MULTI-TOKEN VERIFIED TICKS: every
@@ -2595,6 +2889,26 @@ class ServingEngine:
         deferred = 0
         while self._queue and len(picked) < n_pad:
             r = self._queue[0]
+            sp_info = (self._sp_inflight.get(r.rid)
+                       if self.seq_parallel else None)
+            if sp_info is not None:
+                # r23 long-prefill continuation: the pages were
+                # reserved at first admission and the first
+                # ``resident`` rows already landed in the pool — reuse
+                # both (zero allocator / prefix-cache / meter traffic;
+                # the reservation is HELD across the spanned segments)
+                fp, _ = r.resume_view()
+                row = np.zeros((pgr.max_pages,), np.int32)
+                row[:len(sp_info["pages"])] = sp_info["pages"]
+                self._queue.pop(0)
+                if not r.admit_time:
+                    r.admit_time = now
+                picked.append(r)
+                fulls.append(fp)
+                req_pages.append(sp_info["pages"])
+                pre_lens_l.append(sp_info["resident"])
+                tables.append(row)
+                continue
             fp, remaining = r.resume_view()
             rows = len(fp) + remaining - 1
             total = pgr.pages_needed(rows)
@@ -2679,15 +2993,36 @@ class ServingEngine:
         # the largest bucket: the ("sseg", n_pad, K, steps) key family
         # deliberately carries no width, so prefix hits stay page DATA
         # and add zero program shapes.
-        if spec or prefix_cache is None or not any(pre_lens_l):
+        # r23: the segment runs the sequence-parallel slab family when
+        # any picked request is a long prefill — a fresh suffix past
+        # the largest regular bucket, or a continuation mid-flight.
+        # Everything else (sp engines included) rides pseg/cseg
+        # unchanged: sp=1 or short-only traffic degenerates exactly.
+        sp_engaged = [j for j in range(n) if self.seq_parallel and (
+            picked[j].rid in self._sp_inflight
+            or len(fulls[j]) - pre_lens_l[j] > self.buckets[-1])]
+        sp_mode = bool(sp_engaged)
+
+        chunk_marker = None
+        if sp_mode:
+            # slab width: the largest declared prefill chunk per shard;
+            # admit window: the largest engaged rung, slab-rounded.
+            # Rungs shrink as continuations land rows, and every rung
+            # at or below the first admission's is enumerated.
+            C = self.prefill_chunks[-1]
+            Cs = self.seq_parallel * C
+            lb = max(self._long_rung(max(1, len(fulls[j]) - pre_lens_l[j]))
+                     for j in sp_engaged)
+            s_max = -(-lb // Cs) * Cs
+            chunk_marker = n_pad + 1
+        elif spec or prefix_cache is None or not any(pre_lens_l):
             s_max = self.buckets[-1]
         else:
             suf_max = max((len(fulls[j]) - pre_lens_l[j]
                            for j in range(n)), default=1)
             s_max = self._bucket_for(suf_max)
 
-        chunk_marker = None
-        if self.chunked:
+        if self.chunked and not sp_mode:
             C = self._prefill_chunk_for(s_max)
             s_max = -(-s_max // C) * C        # chunk-aligned admit window
             worst = 2 * (s_max // C)
@@ -2751,7 +3086,9 @@ class ServingEngine:
                                    full_prompts=fulls,
                                    chunk_marker=chunk_marker, spec=True)
 
-        prog = (self._chunked_segment_prog(n_pad, s_max, C, max_steps)
+        prog = (self._sp_segment_prog(n_pad, s_max, C, max_steps)
+                if sp_mode
+                else self._chunked_segment_prog(n_pad, s_max, C, max_steps)
                 if self.chunked
                 else self._paged_segment_prog(n_pad, s_max, max_steps))
         with _mesh_scope(self.mesh):
@@ -2767,7 +3104,7 @@ class ServingEngine:
                                pre_lens=pre_lens_l, req_pages=req_pages,
                                full_prompts=fulls,
                                chunk_marker=chunk_marker,
-                               digest=self.quality_digest)
+                               digest=self.quality_digest, sp=sp_mode)
 
     def _finish_segment_paged(self, p: _PendingSegment) -> dict:
         picked, n, prefix_cache = p.picked, p.n, p.prefix_cache
@@ -2796,6 +3133,11 @@ class ServingEngine:
                 # per-segment sync count is unchanged (audited)
                 toks, aq, aslot, dlg, dti, dtv, steps, qadm = dev
                 dig = (dlg, dti, dtv)
+            elif p.sp:
+                # r23: the prefill-progress triple rides the SAME
+                # single fetch — a long prefill the step budget cut
+                # mid-flight resumes next dispatch at row pfo
+                toks, aq, aslot, sp_pf, sp_pfq, sp_pfo, steps, qadm = dev
             else:
                 toks, aq, aslot, steps, qadm = dev
         if staged:
@@ -2831,7 +3173,32 @@ class ServingEngine:
                                      >= p.chunk_marker))
             if chunk_steps:
                 _metrics.counter("serving.prefill_chunks").inc(chunk_steps)
-        if qadm < n:
+        if p.sp:
+            # completed admissions retire their carry-over entries; a
+            # prefill the budget cut mid-flight re-registers below
+            for r in picked:
+                self._sp_inflight.pop(r.rid, None)
+        if p.sp and int(sp_pf) >= 0:
+            # r23 multi-segment prefill: keep the mid-flight request's
+            # reservation AND meter open (its pages hold landed KV
+            # rows), record the resident row count, and requeue it at
+            # the head so the next dispatch continues the slab stream;
+            # everything behind it releases and requeues as usual
+            j = int(sp_pfq)
+            assert qadm == j + 1, (
+                f"sp prefill progress desynced: pf row {j}, qadm {qadm}")
+            self._sp_inflight[picked[j].rid] = {
+                "pages": req_pages[j],
+                "resident": pre_lens_l[j] + int(sp_pfo)}
+            for k in range(qadm, n):
+                picked[k].admit_time = 0.0
+                picked[k]._meter_release()
+                pgr.release_pages(req_pages[k])
+            _flight.record("sp_carryover", rid=picked[j].rid,
+                           resident=pre_lens_l[j] + int(sp_pfo),
+                           total=len(p.full_prompts[j]))
+            self._queue[:0] = picked[j:]
+        elif qadm < n:
             # step budget ran out before every picked request found a
             # slot: release the reservations and requeue FCFS
             for j in range(qadm, n):

@@ -1104,6 +1104,25 @@ def forward_with_pages(params, tokens, cfg: LlamaConfig, pool, page_table,
     psz = pool["k"].shape[2]
     max_pages = page_table.shape[1]
     x = params["embed"].astype(dt)[tokens]
+    # r23 (ISSUE 18): sequence-parallel prefill slabs arrive with the
+    # slab's ROW axis as the batch axis ([sp, C] — one C-token chunk of
+    # the same prompt per row). When the live mesh carries an 'sp' axis
+    # that divides B, hint GSPMD to shard the batch dim over it so the
+    # per-layer QKV/MLP matmuls of an sp-slab run 1/sp-sized per device;
+    # the paged gather in _paged_attention then reads cross-shard rows
+    # through the (replicated) pool, which GSPMD serves with the same
+    # neighbour exchanges the ring formulation hand-codes (see
+    # ops/pallas/ring_attention.sp_slab_ring_attention for the manual
+    # twin). On CPU/no-mesh (every test) this is a literal no-op, keeping
+    # the bit-exact gather path.
+    from ..parallel.mesh import get_mesh, with_sharding_constraint
+    from jax.sharding import PartitionSpec as _P
+
+    _mesh = get_mesh()
+    if (_mesh is not None and "sp" in _mesh.axis_names
+            and int(_mesh.shape["sp"]) > 1
+            and B % int(_mesh.shape["sp"]) == 0):
+        x = with_sharding_constraint(x, _P("sp", None, None), _mesh)
     pos = jnp.asarray(pos, jnp.int32).reshape(B)
     positions = pos[:, None] + jnp.arange(T)            # [B, T]
     # destination coordinates for the chunk's K/V rows — shared by all

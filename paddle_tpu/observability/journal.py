@@ -631,6 +631,8 @@ def describe_engine(engine) -> dict:
         "quality_digest": getattr(engine, "quality_digest", False),
         "digest_top_k": getattr(engine, "digest_top_k", 4),
         "quant": getattr(engine, "quant", None),
+        "seq_parallel": getattr(engine, "seq_parallel", 0),
+        "long_buckets": list(getattr(engine, "long_buckets", ())),
         "next_rid": engine._next_rid,
         "spec_accept_ewma": engine.spec_accept_ewma,
     }

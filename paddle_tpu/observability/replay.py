@@ -132,7 +132,10 @@ def _engine_from(d: dict, cfg, params):
         digest_top_k=d.get("digest_top_k", 4),
         # r21: the engine re-quantizes the fp params in __init__, so a
         # recorded quantized serve rebuilds from the SAME fp tree
-        quant=d.get("quant"))
+        quant=d.get("quant"),
+        # r23: long-context geometry (absent in pre-r23 journals)
+        seq_parallel=d.get("seq_parallel", 0),
+        long_buckets=tuple(d.get("long_buckets") or ()))
     if d["paged"]:
         kw["page_size"] = d["page_size"]
         kw["num_pages"] = d["num_pages"]

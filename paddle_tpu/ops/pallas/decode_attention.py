@@ -59,8 +59,17 @@ def pick_kv_block(max_len: int, prefer: int = 128) -> int:
 
     128 preferred: smaller blocks track ``pos`` tighter (less tail waste)
     but add grid steps; 128 rows x (Hkv*D) lanes keeps the per-step DMA
-    large enough to pipeline while bounding overshoot to <1 block."""
-    for b in (prefer, 256, 128, 64):
+    large enough to pipeline while bounding overshoot to <1 block.
+
+    r23 long-context refinement (ISSUE 18): once the window reaches 8K+
+    the grid-step count dominates the tail-waste argument — a decode tick
+    over a 32K window at block 128 runs 256 grid steps of mostly-DMA
+    latency, while 512-row blocks cut that 4x and the <1-block overshoot
+    is still noise against the window. 512 leads the candidate list only
+    in that regime, so every existing (short) shape keeps its block
+    choice bit-for-bit."""
+    longctx = (512,) if (max_len >= 8192 and max_len % 512 == 0) else ()
+    for b in longctx + (prefer, 256, 128, 64):
         if b <= max_len and max_len % b == 0:
             return b
     return 0

@@ -36,7 +36,8 @@ def _axis_size(axis_name):
 
 __all__ = ["ring_attention", "RingFlashAttention",
            "context_parallel_attention", "ulysses_attention",
-           "ulysses_parallel_attention"]
+           "ulysses_parallel_attention", "sp_slab_ring_attention",
+           "sp_slab_prefill_attention"]
 
 
 def _chunk_attention(q, k, v, scale, q_offset, k_offset, is_causal):
@@ -202,6 +203,123 @@ def context_parallel_attention(q, k, v, mesh=None, axis_name: str = "sep",
     ring."""
     return _sp_gspmd_entry(ring_attention, q, k, v, mesh, axis_name,
                            is_causal, batch_axes, head_axes, fallback)
+
+
+def _slab_dense_attention(q, k, v, offsets, scale=None):
+    """Dense reference for the sequence-parallel prefill slab (r23): each
+    batch row holds one C-token chunk of the SAME prompt at global offset
+    ``offsets[r]``; every row attends every row's chunk under an absolute-
+    position causal mask. This is exactly what the serving path's paged
+    gather computes, and what ``sp_slab_ring_attention`` must match."""
+    b, c, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    qf = q.reshape(1, b * c, h, d)
+    kf = k.reshape(1, b * c, h, d)
+    vf = v.reshape(1, b * c, h, d)
+    pos = (offsets[:, None] + jnp.arange(c, dtype=offsets.dtype)).reshape(-1)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf,
+                   preferred_element_type=jnp.float32) * scale
+    mask = pos[:, None] >= pos[None, :]
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    # every query position attends at least itself, so the softmax row max
+    # is finite — no masked-row NaN hazard
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / jnp.sum(p, axis=-1,
+                                                   keepdims=True),
+                     vf.astype(jnp.float32))
+    return out.reshape(b, c, h, d).astype(q.dtype)
+
+
+def sp_slab_ring_attention(q, k, v, q_offset, axis_name: str = "sp",
+                           scale: Optional[float] = None):
+    """Ring attention for the sequence-parallel prefill SLAB (r23, ISSUE
+    18): the serving engine reshapes a long-prompt chunk of ``sp * C``
+    tokens into an [sp, C] slab whose row r sits at global offset
+    ``base + r*C``. Call inside shard_map with the slab's ROW axis (the
+    batch dim) sharded over ``axis_name`` — one row per rank, so each
+    rank holds q/k/v of shape [1, C, H, D] plus its row's global offset
+    ``q_offset`` (shape [1], int32).
+
+    K/V chunks and their offsets ring-pass via ``ppermute`` exactly like
+    ``ring_attention``; the only delta is that causal masking uses the
+    carried ABSOLUTE offsets rather than ``rank * s_local``, because slab
+    rows are chunks of one prompt, not contiguous shards of a padded
+    sequence. Exact: matches ``_slab_dense_attention`` bit-for-bit in
+    fp32 accumulation terms (same online-softmax algebra)."""
+    n = _axis_size(axis_name)
+    b, c, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    my_off = q_offset[0]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        acc, m, l, k_cur, v_cur, off_cur = carry
+
+        def do_chunk(_):
+            return _chunk_attention(q, k_cur, v_cur, scale, my_off,
+                                    off_cur[0], True)
+
+        def skip(_):
+            # chunk lies entirely in this row's causal future — fully
+            # masked, skip the matmuls (merge of the -inf state is a no-op)
+            return (jnp.zeros((b, h, c, d), jnp.float32),
+                    jnp.full((b, h, c), -jnp.inf, jnp.float32),
+                    jnp.zeros((b, h, c), jnp.float32))
+
+        acc2, m2, l2 = jax.lax.cond(off_cur[0] <= my_off + (c - 1),
+                                    do_chunk, skip, None)
+        acc, m, l = _merge(acc, m, l, acc2, m2, l2)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        off_nxt = jax.lax.ppermute(off_cur, axis_name, perm)
+        return (acc, m, l, k_nxt, v_nxt, off_nxt), None
+
+    acc0 = jnp.zeros((b, h, c, d), jnp.float32)
+    m0 = jnp.full((b, h, c), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, c), jnp.float32)
+    (acc, m, l, _, _, _), _ = jax.lax.scan(
+        step, (acc0, m0, l0, k, v, q_offset), jnp.arange(n))
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l[..., None]  # [B, H, C, D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def sp_slab_prefill_attention(q, k, v, offsets, mesh=None,
+                              axis_name: str = "sp", fallback=None,
+                              scale: Optional[float] = None):
+    """GSPMD-level entry for slab ring attention: q/k/v are the GLOBAL
+    [sp, C, H, D] slab tensors and ``offsets`` the [sp] global row
+    offsets. Shards the row (batch) dim over ``axis_name`` and runs
+    ``sp_slab_ring_attention`` under shard_map; falls back to the dense
+    absolute-position formulation (``fallback()`` if given) when the mesh
+    lacks a usable ``axis_name`` axis or the row count doesn't equal the
+    axis size — which is exactly the CPU/test regime, where the serving
+    engine's paged gather path is already the bit-exact reference."""
+    from jax.sharding import PartitionSpec as P
+
+    from ...parallel.mesh import get_mesh, shard_map_compat
+
+    def fall_back():
+        if fallback is not None:
+            return fallback()
+        return _slab_dense_attention(q, k, v, offsets, scale=scale)
+
+    mesh = mesh or get_mesh()
+    if mesh is None or axis_name not in mesh.axis_names or \
+            mesh.shape[axis_name] <= 1 or \
+            q.shape[0] != int(mesh.shape[axis_name]):
+        return fall_back()
+
+    spec = P(axis_name, None, None, None)
+    fn = shard_map_compat(
+        functools.partial(sp_slab_ring_attention, axis_name=axis_name,
+                          scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec, P(axis_name)),
+        out_specs=spec,
+    )
+    return fn(q, k, v, offsets)
 
 
 def ulysses_attention(q, k, v, axis_name: str = "sep",

@@ -22,8 +22,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 # the reference's hybrid-parallel axis order (outermost → innermost):
 # data, pipeline, zero-sharding, tensor(model), sequence(sep),
 # expert(ep — r7: innermost so MoE's all-to-all dispatch rides the
-# fastest ICI neighbours, the same argument that puts mp inside)
-HYBRID_AXES: Tuple[str, ...] = ("dp", "pp", "sharding", "mp", "sep", "ep")
+# fastest ICI neighbours, the same argument that puts mp inside).
+# r23 adds 'sp' — the SERVING sequence-parallel prefill axis (ISSUE
+# 18): prefill slabs shard their batch/chunk rows over it while decode
+# stays replicated. It sits between sep and ep (inner enough for fast
+# ICI on the ring/all-to-all attention exchanges); degree 1 everywhere
+# it is unused, so existing mesh shapes and rank math are unchanged.
+HYBRID_AXES: Tuple[str, ...] = ("dp", "pp", "sharding", "mp", "sep",
+                                "sp", "ep")
 
 _GLOBAL_MESH: Optional[Mesh] = None
 
@@ -35,6 +41,7 @@ def create_hybrid_mesh(
     mp: int = 1,
     sep: int = 1,
     ep: int = 1,
+    sp: int = 1,
     devices: Optional[Sequence] = None,
     set_as_global: bool = True,
 ) -> Mesh:
@@ -47,7 +54,7 @@ def create_hybrid_mesh(
     if devices is None:
         devices = jax.devices()
     degrees = {"dp": dp, "pp": pp, "sharding": sharding, "mp": mp,
-               "sep": sep, "ep": ep}
+               "sep": sep, "sp": sp, "ep": ep}
     total = int(np.prod(list(degrees.values())))
     if total != len(devices):
         raise ValueError(

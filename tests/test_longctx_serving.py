@@ -1,0 +1,416 @@
+"""Long-context serving (r23 tentpole, ISSUE 18): sequence-parallel
+prefill over an 'sp' axis, scattering into the paged pool for ordinary
+page-indirect decode.
+
+Pins the subsystem's contracts:
+
+* the spseg slab family's token identity — sp=2/4 serves produce tokens
+  bit-identical to the unsharded reference engine that buckets the long
+  prompt the ordinary way;
+* sp=1 degeneracy — regular traffic on an sp=1 engine compiles the SAME
+  pseg program keys and journals the SAME decision stream (byte-for-byte
+  after clock-stamp normalisation) as the plain paged engine;
+* pool page parity — the seeded sp=2 prefill lands its KV in the shared
+  paged pool page-for-page equal to the unsharded prefill (the
+  zero-relayout prefill→decode boundary);
+* multi-segment spanning — a long prefill that cannot fit one segment's
+  step budget carries its page reservation across segments
+  (``_sp_inflight`` + ``sp_carryover`` flight events) and still decodes
+  identically;
+* static enumeration + AOT — ``coverage.check_envelope`` proves the
+  spseg rung ladder, ``aot_warmup`` compiles it, and the warmed serve
+  runs with ZERO backend compiles and ONE audited fetch per segment;
+* the gate contract — ``longctx_serving_segment`` passes its pinned
+  budget and auditing it leaves the paged canonical program's budget
+  metrics bit-identical (the ``--longctx on|off`` CLI filter);
+* the ring-attention kernel — the sp slab entry matches dense attention
+  on a REAL sp=4 mesh and falls back to dense bit-exactly without one;
+* satellites — the long-context ``pick_kv_block`` 512 candidate and the
+  multi-tier single-sync ``flush_tiers`` coalescing.
+
+Suite-time contract: everything rides the session ``tiny_llama``
+fixture, one module-scoped journaled sp=2 serve, and program keys shared
+through ``serving._SHARED_PROGS`` across the module's engines.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.inference.scheduler import Arrival, OnlineScheduler
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.observability import flight, journal, replay_serve
+from paddle_tpu.parallel import set_mesh
+
+
+@pytest.fixture(scope="module")
+def tiny(tiny_llama):
+    set_mesh(None)
+    return tiny_llama
+
+
+def _mk(cfg, params, sp, **over):
+    """sp=0 builds the unsharded reference (the long length is just the
+    top regular bucket); sp>=1 engages the long-bucket intake."""
+    kw = dict(slots=4, max_len=96, paged=True, page_size=8,
+              num_pages=48, prefill_chunks=(8,))
+    if sp:
+        kw.update(prompt_buckets=(8, 16, 32), seq_parallel=sp,
+                  long_buckets=(64,))
+    else:
+        kw.update(prompt_buckets=(8, 16, 32, 64))
+    kw.update(over)
+    return ServingEngine(cfg, params, **kw)
+
+
+def _prompts(cfg, lens=(56, 12, 40, 9), seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+            for n in lens]
+
+
+def _trace(prompts, gen=6):
+    return [Arrival(0.002 * i, p, gen) for i, p in enumerate(prompts)]
+
+
+def _drain(eng, seg_steps):
+    while eng._queue or any(r is not None for r in eng._active):
+        eng.run_segment(seg_steps)
+    return eng.collect_finished()
+
+
+# ---------------------------------------------------------------------------
+# module-scoped journaled sp=2 serve + the unsharded reference
+# (single compile+serve cost; read by identity / replay / audit tests)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def sp_serve(tiny):
+    cfg, params = tiny
+    arr = _trace(_prompts(cfg))
+    flight.clear()
+    eng = _mk(cfg, params, sp=2)
+    sch = OnlineScheduler(eng, seg_steps=4, max_queue=100)
+    j = journal.Journal()
+    with journal.attach(j):
+        rep = sch.serve(arr)
+    results = sch.results()
+    events = flight.events()
+    eng_ref = _mk(cfg, params, sp=0)
+    sch_ref = OnlineScheduler(eng_ref, seg_steps=4, max_queue=100)
+    sch_ref.serve(arr)
+    return {"arr": arr, "eng": eng, "sch": sch, "rep": rep,
+            "results": results, "events": events, "journal": j,
+            "ref_results": sch_ref.results(), "params": params}
+
+
+class TestTokenIdentity:
+    def test_sp2_tokens_identical_to_unsharded(self, sp_serve):
+        """The tentpole identity: the 56-token prompt prefilled as sp=2
+        slabs (plus co-resident regular traffic) decodes bit-identically
+        to the unsharded reference — every slab row scattered its KV
+        through the request's own page-table row before decode ever
+        gathered it."""
+        assert sp_serve["results"] == sp_serve["ref_results"]
+        assert any(k[0] == "spseg" for k in sp_serve["eng"]._progs), \
+            "the long prompt never engaged the spseg family"
+
+    def test_journal_header_carries_sp_descriptor(self, sp_serve):
+        hdr = sp_serve["journal"].records()[0]["header"]
+        desc = hdr["engines"][0]
+        assert desc["seq_parallel"] == 2
+        assert desc["long_buckets"] == [64]
+
+    def test_journal_replay_identity(self, sp_serve):
+        """The black-box bar: the sp=2 serve's decision stream — slab
+        dispatch + spanning decisions included — replays bit-exactly."""
+        res = replay_serve(sp_serve["journal"].records(),
+                           params=sp_serve["params"])
+        assert res.identical, (res.divergence, res.error)
+
+    def test_sync_audit_one_fetch_per_segment(self, sp_serve):
+        """flagged == [], allowed == segment fetches EXACTLY: the spseg
+        family adds no device contact beyond the one audited per-segment
+        event fetch (slab progress rides the same fetch out and back)."""
+        from paddle_tpu.analysis import SyncAudit
+
+        eng, sch = sp_serve["eng"], sp_serve["sch"]
+        eng.reset_slots()
+        sch._reqs.clear()
+        with SyncAudit() as audit:
+            audit.phase = "serve"
+            rep = sch.serve(sp_serve["arr"])
+        assert audit.flagged("serve") == [], audit.flagged("serve")
+        assert audit.allowed("serve") == {
+            "serving.segment_event_fetch": rep.segments}
+
+
+# ---------------------------------------------------------------------------
+# sp=1 degeneracy: byte-identical to the plain paged engine
+# ---------------------------------------------------------------------------
+
+
+def _normalize(records):
+    """Strip the wall-clock stamps a byte-identity compare must ignore —
+    the record time, the journal's clock reads, and every measured
+    ``*_s`` latency field (ttft/e2e/compile durations) — and neutralise
+    the engine descriptor's sp fields. Every DECISION field (kinds,
+    rids, tokens, pages, steps, admit orders) must match exactly."""
+    out = []
+    for r in records:
+        r = {k: v for k, v in r.items()
+             if k not in ("t", "c", "seconds")
+             and not k.endswith("_s")}
+        if r.get("kind") == "header":
+            import copy
+
+            r = copy.deepcopy(r)
+            for e in r["header"].get("engines", []):
+                e["seq_parallel"] = 0
+                e["long_buckets"] = []
+        out.append(r)
+    return out
+
+
+class TestSp1Degeneracy:
+    def test_sp1_program_keys_and_journal_stream_identical(self, tiny):
+        """sp=1 with regular-bucket traffic degenerates EXACTLY: same
+        pseg program keys, same journal decision stream (clock stamps
+        normalised, the header's sp descriptor aside) as the plain
+        paged engine — the family is invisible until a prompt actually
+        exceeds the regular ladder."""
+        cfg, params = tiny
+        arr = _trace(_prompts(cfg, lens=(12, 9, 20), seed=1))
+
+        def serve(sp):
+            eng = _mk(cfg, params, sp=sp,
+                      prompt_buckets=(8, 16, 32))
+            sch = OnlineScheduler(eng, seg_steps=4, max_queue=100)
+            j = journal.Journal()
+            with journal.attach(j):
+                sch.serve(arr)
+            return eng, sch.results(), j.records()
+
+        eng1, out1, recs1 = serve(1)
+        eng0, out0, recs0 = serve(0)
+        assert out1 == out0
+        assert sorted(map(repr, eng1._progs)) == \
+            sorted(map(repr, eng0._progs))
+        assert all(k[0] != "spseg" for k in eng1._progs)
+        assert _normalize(recs1) == _normalize(recs0)
+
+
+# ---------------------------------------------------------------------------
+# pool page parity: the zero-relayout prefill->decode boundary
+# ---------------------------------------------------------------------------
+
+
+class TestPoolParity:
+    def test_sp2_prefill_pages_match_unsharded(self, tiny):
+        """The seeded sp=2 prefill lands its KV page-for-page equal to
+        the unsharded prefill: same allocator order, same page contents
+        — decode needs NO relayout to gather what the slabs scattered.
+        (Page 0 is the slab's overrun dump row and is excluded.)"""
+        cfg, params = tiny
+        long_p = _prompts(cfg, lens=(56,), seed=0)[0]
+
+        def pool_after(sp):
+            e = _mk(cfg, params, sp=sp)
+            e.add_request(long_p, max_new_tokens=1)
+            _drain(e, 4)
+            return (np.asarray(e.pager.pool["k"]),
+                    np.asarray(e.pager.pool["v"]))
+
+        k0, v0 = pool_after(0)
+        k2, v2 = pool_after(2)
+        n_pages = -(-len(long_p) // 8)
+        assert n_pages == 7
+        assert np.array_equal(k0[:, 1:1 + n_pages], k2[:, 1:1 + n_pages])
+        assert np.array_equal(v0[:, 1:1 + n_pages], v2[:, 1:1 + n_pages])
+
+
+# ---------------------------------------------------------------------------
+# multi-segment spanning: the held reservation (SCALING §3f extension)
+# ---------------------------------------------------------------------------
+
+
+class TestSpanningReservation:
+    def test_sp4_prefill_spans_segments_and_matches(self, sp_serve,
+                                                    tiny):
+        """seg_steps below the slab-step count forces the prefill to
+        SPAN segments: the reservation + meter are taken once and held
+        (``_sp_inflight`` non-empty between segments, drained to empty
+        at finish), ``sp_carryover`` events record the resumed offsets,
+        and the tokens still match the unsharded reference. sp=4 rides
+        here so the widest slab gets its identity pinned too."""
+        cfg, params = tiny
+        long_p = sp_serve["arr"][0].prompt
+        eng = _mk(cfg, params, sp=4)
+        flight.clear()
+        eng.add_request(long_p, max_new_tokens=6)
+        spanned = False
+        while eng._queue or any(r is not None for r in eng._active):
+            eng.run_segment(1)
+            spanned = spanned or bool(eng._sp_inflight)
+        out = eng.collect_finished()
+        assert spanned, "seg_steps=1 never left the prefill in flight"
+        assert not eng._sp_inflight
+        assert flight.events("sp_carryover")
+        assert list(out.values()) == [sp_serve["ref_results"][0]]
+        assert eng.pager.leak_report() == []
+
+
+# ---------------------------------------------------------------------------
+# static enumeration + AOT: zero compiles after warmup
+# ---------------------------------------------------------------------------
+
+
+class TestProgramSpace:
+    def test_envelope_enumeration_and_zero_compiles(self, tiny):
+        """``check_envelope`` proves the spseg rung ladder (closed-form
+        enumeration == replayed reachability), ``aot_warmup`` compiles
+        it (the bill names the family), and the warmed engine serves a
+        long + short mix with ZERO backend compiles."""
+        from paddle_tpu.analysis import coverage, recompile
+
+        cfg, params = tiny
+        eng = _mk(cfg, params, sp=2)
+        env = eng.default_envelope(seg_steps=(4,))
+        assert coverage.check_envelope(eng, env) == []
+        bill = eng.aot_warmup(env)
+        assert bill["spseg"]["keys"] >= 1
+        long_p, short_p = _prompts(cfg, lens=(56, 12), seed=2)
+        with recompile.enforce_zero_compiles(
+                "longctx post-warmup serve") as cw:
+            eng.add_request(long_p, max_new_tokens=6)
+            eng.add_request(short_p, max_new_tokens=6)
+            _drain(eng, 4)
+        assert cw.compiles == 0
+
+
+# ---------------------------------------------------------------------------
+# the gate contract: --longctx on|off
+# ---------------------------------------------------------------------------
+
+
+class TestGate:
+    def test_gate_budget_and_bit_identity_longctx_on_off(self):
+        """``longctx_serving_segment`` passes its pinned budget, and
+        running it leaves the paged canonical program's audited metrics
+        bit-identical — the ``--longctx on|off`` CLI filter only adds or
+        removes the target, it must never bend another program's
+        budget."""
+        from paddle_tpu.analysis import auditor, budgets, programs
+
+        handle_p = programs.build("paged_serving_segment")
+        rep_off = auditor.audit_replay("paged_serving_segment",
+                                       handle_p.replay, replays=2)
+        handle_l = programs.build("longctx_serving_segment")
+        rep_l = auditor.audit_replay("longctx_serving_segment",
+                                     handle_l.replay, replays=2)
+        rep_l.merge(auditor.audit_static(
+            "longctx_serving_segment", handle_l.hlo(),
+            donation_threshold=handle_l.donation_threshold,
+            expected_undonated=handle_l.expected_undonated))
+        assert budgets.check(rep_l) == [], rep_l.format()
+        rep_on = auditor.audit_replay("paged_serving_segment",
+                                      handle_p.replay, replays=2)
+        for key in ("host_syncs_flagged", "host_syncs_allowed",
+                    "warm_compiles"):
+            assert rep_on.metrics[key] == rep_off.metrics[key], (
+                key, rep_on.metrics[key], rep_off.metrics[key])
+
+    def test_cli_filter_removes_exactly_the_longctx_target(self):
+        from paddle_tpu.analysis import programs
+
+        names = programs.names()
+        assert "longctx_serving_segment" in names
+        off = [n for n in names if n != "longctx_serving_segment"]
+        assert set(names) - set(off) == {"longctx_serving_segment"}
+
+
+# ---------------------------------------------------------------------------
+# the slab ring-attention kernel: mesh vs dense identity
+# ---------------------------------------------------------------------------
+
+
+class TestSlabRingAttention:
+    def test_ring_matches_dense_on_sp4_mesh(self):
+        """On a REAL sp=4 mesh (8 virtual devices) the ring-passed slab
+        attention matches the dense absolute-position reference; with no
+        mesh the GSPMD entry falls back to dense bit-exactly."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.ring_attention import (
+            _slab_dense_attention, sp_slab_prefill_attention)
+        from paddle_tpu.parallel.mesh import create_hybrid_mesh
+
+        rng = np.random.RandomState(3)
+        sp, C, H, D = 4, 8, 2, 16
+        q, k, v = (jnp.asarray(rng.randn(sp, C, H, D), jnp.float32)
+                   for _ in range(3))
+        offsets = jnp.asarray([5 + r * C for r in range(sp)], jnp.int32)
+        dense = _slab_dense_attention(q, k, v, offsets)
+        set_mesh(None)
+        fb = sp_slab_prefill_attention(q, k, v, offsets)
+        assert np.array_equal(np.asarray(dense), np.asarray(fb))
+        mesh = create_hybrid_mesh(sp=4, dp=2, set_as_global=False)
+        out = sp_slab_prefill_attention(q, k, v, offsets, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                                   atol=1e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# satellites: the long-context decode block + coalesced tier flush
+# ---------------------------------------------------------------------------
+
+
+class TestSatellites:
+    def test_pick_kv_block_long_context_candidate(self):
+        """>=8k windows take the 512 block when it tiles exactly; every
+        below-8k shape keeps its r21 candidate (no kernel-shape churn
+        for existing serves)."""
+        from paddle_tpu.ops.pallas.decode_attention import pick_kv_block
+
+        assert pick_kv_block(8192) == 512
+        assert pick_kv_block(16384) == 512
+        assert pick_kv_block(32768) == 512
+        assert pick_kv_block(8320) == 128    # 8k+ but 512 doesn't tile
+        assert pick_kv_block(4096) == 128    # below 8k: unchanged
+        assert pick_kv_block(96) == 0        # unchanged small-shape path
+
+    def test_flush_tiers_multi_tier_single_sync(self, tiny):
+        """Several tiers' queued stages materialise under ONE labelled
+        tier_transfer sync (the disagg same-turn handoff coalescing),
+        with each tier's bytes landed in its own store and the
+        per-crossing ledger intact."""
+        from paddle_tpu.analysis import SyncAudit
+        from paddle_tpu.inference.kv_tiers import HostTier, flush_tiers
+        from paddle_tpu.inference.prefix_cache import PagedPrefixCache
+
+        cfg, params = tiny
+        assert flush_tiers([]) == 0          # no work -> no sync at all
+        rng = np.random.RandomState(11)
+        engs, tiers, toks = [], [], []
+        for i in range(2):
+            eng = _mk(cfg, params, sp=0, num_pages=24)
+            tier = HostTier(eng.pager, capacity_pages=32)
+            pc = PagedPrefixCache(eng.pager, capacity_pages=8,
+                                  host_tier=tier)
+            t = rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+            pages, _ = eng.pager.reserve(16)
+            pc.insert(t, pages)
+            assert tier.stats()["pending_stages"] == 1
+            engs.append(eng)
+            tiers.append(tier)
+            toks.append(t)
+        with SyncAudit() as audit:
+            audit.phase = "flush"
+            n = flush_tiers(tiers)
+        assert n == 2
+        assert audit.flagged("flush") == []
+        assert audit.allowed("flush") == {"serving.tier_transfer": 1}
+        for tier, t in zip(tiers, toks):
+            assert tier.has(t.tobytes())
+            assert tier.stages == 1 and tier.pages_host == 2

@@ -26,6 +26,11 @@ stacks), ``squeezenet``/``alexnet`` (plain conv), the small-stack BN
 train test, and a small-stack NHWC parity test below (the layout
 semantics the resnet18 pair exercised at zoo scale); every zoo arch
 still runs in the chip lane.
+
+r23 claw-back (ISSUE 18 satellite): ``squeezenet1_0`` (~36 s) joins the
+``slow`` set — ``squeezenet1_1`` is the same fire-module family at a
+strictly smaller budget (~21 s) and keeps it tier-1-covered; the
+long-context serve tests ride inside the recovered time.
 """
 
 import numpy as np
@@ -59,7 +64,9 @@ def _run(factory, size=64, classes=10):
 
 @pytest.mark.parametrize("factory,size", [
     (models.alexnet, 96),
-    (models.squeezenet1_0, 64),
+    # squeezenet1_0 → slow (r23): squeezenet1_1 below is the same fire-
+    # module family at a strictly smaller compile budget
+    pytest.param(models.squeezenet1_0, 64, marks=pytest.mark.slow),
     (models.squeezenet1_1, 64),
     (models.mobilenet_v1, 64),
     # the fattest zoo forwards run in the chip lane / -m slow only —
