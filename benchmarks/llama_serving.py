@@ -1539,10 +1539,13 @@ def run_capacity(model_name, cfg, params, llama, n=32, seed=0, slots=4,
         f"(identity {'OK' if streams_identity else 'MISS'}), high-water "
         f"{pool_a.high_water_pages} pages")
 
+    from paddle_tpu.analysis import memory as mem_pass
+
     plan = obs.capacity_plan(
         {"mean_prompt_tokens": 64, "mean_new_tokens": 64,
          "rate_req_s": None},
-        ledger, page_size=16, slots=slots, measured=measured_a)
+        ledger, page_size=16, slots=slots, measured=measured_a,
+        cfg=cfg, params=params, hbm_bytes=mem_pass.V5E_HBM_BYTES)
     eng_b, cap_b, pool_b, rep_b = median_serve(sat)
     hw_ratio = plan["predicted_high_water_pages"] / pool_b.high_water_pages
     tok_ratio = plan["predicted_tok_s"] / rep_b.throughput_tok_s
@@ -1635,6 +1638,24 @@ def run_capacity(model_name, cfg, params, llama, n=32, seed=0, slots=4,
             "high_water_within_10pct": hw_ok,
             "tok_s_within_10pct": tok_ok,
             "whatif": whatif,
+            # r24 §3s: the static HBM envelope for this serve, its
+            # KV-live term cross-validated against the r18 PoolMonitor
+            # high-water of the SECOND measured serve (same ±10% bar
+            # as the pages prediction: identical span arithmetic,
+            # priced in bytes)
+            "static_envelope": {
+                "chip_fit": plan["chip_fit"],
+                "measured_kv_live_bytes":
+                    pool_b.high_water_pages * plan["chip_fit"]["page_bytes"],
+                "kv_live_ratio": round(
+                    plan["chip_fit"]["kv_live_bytes"]
+                    / (pool_b.high_water_pages
+                       * plan["chip_fit"]["page_bytes"]), 4),
+                "kv_live_within_10pct": abs(
+                    plan["chip_fit"]["kv_live_bytes"]
+                    / (pool_b.high_water_pages
+                       * plan["chip_fit"]["page_bytes"]) - 1.0) <= 0.10,
+            },
         },
         "overload_4x": {
             "tight_pool_pages": tight_pages - 1,

@@ -293,6 +293,23 @@ def main() -> int:
             "meter_streams_identity"),
         "audit_clean": (capd.get("ops_scrape") or {}).get("audit_clean"),
     }
+    # r24 (ISSUE 19): lift the memory headline — the §3s static HBM
+    # envelope the capacity planner now carries (weights + pool + peak
+    # transient vs chip HBM) and its ±10% KV-live cross-validation
+    # against the r18 PoolMonitor high-water
+    env = (capd.get("planner") or {}).get("static_envelope") or {}
+    fit = env.get("chip_fit") or {}
+    result["memory_headline"] = {
+        "envelope_bytes": fit.get("envelope_bytes"),
+        "weights_bytes": fit.get("weights_bytes"),
+        "pool_bytes": fit.get("pool_bytes"),
+        "transient_bytes": fit.get("transient_bytes"),
+        "hbm_bytes": fit.get("hbm_bytes"),
+        "fits": fit.get("fits"),
+        "utilization": fit.get("utilization"),
+        "kv_live_within_10pct": env.get("kv_live_within_10pct"),
+        "kv_live_ratio": env.get("kv_live_ratio"),
+    }
     # r19 (ISSUE 14): lift the tiered-KV headline — token identity,
     # hit-rate + TTFT vs the §3n model, the tier-transfer budget, the
     # one-fetch audit, replay identity and directory steering

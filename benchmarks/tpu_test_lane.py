@@ -124,7 +124,7 @@ TPU_TEST_FILES = [
 
 
 def _run_budget_gate(env) -> dict:
-    """r9: certify the four canonical programs' hazard budgets on the
+    """r9: certify the canonical programs' hazard budgets on the
     real chip (``python -m paddle_tpu.analysis --gate``) and record the
     per-program metrics next to the test outcomes. On TPU the relayout
     ledger counts the REAL tiled-layout copies, so a chip-only
@@ -143,6 +143,15 @@ def _run_budget_gate(env) -> dict:
         with open(out_json) as f:
             gate["programs"] = json.load(f)
         os.remove(out_json)
+    # r24: the per-program liveness peak ON CHIP — the XLA:TPU schedule
+    # fuses/tiles differently from the CPU lowering, so these are the
+    # measurements a "tpu"-scoped peak_bytes_max budget gets pinned
+    # from (the chip cells of the budget registry)
+    gate["peak_hbm_bytes"] = {
+        p["program"]: p["metrics"].get("peak_bytes")
+        for p in gate["programs"]
+        if isinstance(p, dict) and "metrics" in p
+        and not p.get("program", "").startswith("_")}
     if proc.returncode != 0:
         gate["tail"] = proc.stdout[-1500:]
     return gate

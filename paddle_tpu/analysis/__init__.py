@@ -1,7 +1,7 @@
 """``paddle_tpu.analysis`` — static analysis of traced programs with
 enforced TPU-hazard budgets (ISSUE 4 tentpole).
 
-Five passes over any jit-compiled callable or registered canonical
+Six passes over any jit-compiled callable or registered canonical
 program:
 
 1. **host-sync detector** (``syncs``) — instruments the ``Tensor`` /
@@ -19,6 +19,12 @@ program:
 5. **collective/mesh audit** (``hlo.collective_check``) — every
    collective must attribute to a declared mesh-axis subset (the
    promoted ``benchmarks/collective_audit`` pass).
+6. **HBM liveness** (``memory.peak_live``, r24) — def→last-use buffer
+   intervals over the scheduled HLO; per-program ``peak_bytes`` with
+   peak-point attribution, ``input_output_alias``-aware (donated
+   carries count once) and per-device under a mesh. ``memory.chip_fit``
+   joins it with the §3c/§3f arithmetic into the §3s static HBM
+   envelope for ``capacity_plan`` and the autoscaler.
 
 ``budgets`` pins per-program ceilings; ``python -m paddle_tpu.analysis
 --gate`` audits the registered canonical programs (``programs`` — six
@@ -39,9 +45,11 @@ Quick use::
 
 from __future__ import annotations
 
-from . import budgets, coverage, hlo, programs, recompile, syncs, tiers
+from . import budgets, coverage, hlo, memory, programs, recompile, \
+    syncs, tiers
 from .auditor import AuditReport, Finding, audit_fn, audit_replay, audit_static
-from .coverage import coverage_report, lint_registry_only
+from .coverage import (coverage_report, lint_budget_coverage,
+                       lint_registry_only)
 from .recompile import (CompileBudgetError, CompileWatch,
                         enforce_zero_compiles, lint_cache_keys,
                         live_cache_report)
@@ -54,14 +62,14 @@ __all__ = [
     "CompileBudgetError", "enforce_zero_compiles", "lint_cache_keys",
     "live_cache_report", "audit_fn", "audit_replay", "audit_static",
     "audit_program", "budgets", "coverage", "coverage_report",
-    "lint_registry_only", "hlo", "programs", "recompile", "syncs",
-    "tiers", "tier_transfer_audit", "tiered_serve_audit",
-    "handoff_audit", "disagg_serve_audit",
+    "lint_budget_coverage", "lint_registry_only", "hlo", "memory",
+    "programs", "recompile", "syncs", "tiers", "tier_transfer_audit",
+    "tiered_serve_audit", "handoff_audit", "disagg_serve_audit",
 ]
 
 
 def audit_program(name: str, replays: int = 2,
-                  aot: bool = False) -> AuditReport:
+                  aot: bool = False, memory: bool = True) -> AuditReport:
     """Build + audit one canonical program (static + dynamic passes).
 
     ``aot=True`` (the gate's ``--aot on``, r20): for serving programs,
@@ -80,7 +88,8 @@ def audit_program(name: str, replays: int = 2,
     rep = audit_static(name, handle.hlo(), mesh=handle.mesh,
                        donation_threshold=handle.donation_threshold,
                        expected_undonated=handle.expected_undonated,
-                       allowed_axes=handle.allowed_axes)
+                       allowed_axes=handle.allowed_axes,
+                       memory=memory)
     rep.merge(audit_replay(name, handle.replay, replays=replays))
     if aot_info is not None:
         rep.metrics["program_space_keys"] = aot_info["program_space_keys"]

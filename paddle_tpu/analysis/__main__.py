@@ -80,6 +80,22 @@ Usage::
                                                   # budget), budgets
                                                   # bit-identical to
                                                   # --disagg off
+    python -m paddle_tpu.analysis --gate --memory on # (default) the r24
+                                                  # contract: the static HBM
+                                                  # liveness pass runs over
+                                                  # every program's scheduled
+                                                  # HLO, per-program
+                                                  # peak_bytes is checked
+                                                  # against the pinned
+                                                  # budget, and the budget-
+                                                  # registry completeness
+                                                  # lint fails the gate on
+                                                  # any program or family
+                                                  # without a pinned peak;
+                                                  # --memory off skips ONLY
+                                                  # the liveness metric —
+                                                  # every other budget is
+                                                  # bit-identical
     python -m paddle_tpu.analysis --gate --aot on # (default) the r20
                                                   # contract: program-space
                                                   # coverage + AOT warmup —
@@ -219,6 +235,16 @@ def main(argv=None) -> int:
                          "against the per-crossing bytes-migrated <= "
                          "KV-size budget — budgets must be "
                          "bit-identical to --disagg off")
+    ap.add_argument("--memory", choices=("on", "off"), default="on",
+                    help="r24 static HBM liveness: def→last-use buffer "
+                         "intervals over each program's scheduled HLO — "
+                         "peak_bytes checked against the pinned budget, "
+                         "plus the budget-registry completeness lint "
+                         "(every canonical program and PROGRAM_SPACE "
+                         "family must carry a pinned peak). --memory off "
+                         "skips only the liveness metric; every other "
+                         "budget is bit-identical either way (the pass "
+                         "is pure text analysis)")
     ap.add_argument("--aot", choices=("on", "off"), default="on",
                     help="r20 program-space coverage: lint registry-only "
                          "key construction, prove the envelope "
@@ -278,6 +304,19 @@ def main(argv=None) -> int:
         else:
             print("coverage lint: registry-only key construction clean "
                   "(serving/scheduler/fleet)")
+    budget_lint = []
+    if args.memory == "on":
+        from . import coverage as _coverage
+
+        budget_lint = _coverage.lint_budget_coverage()
+        if budget_lint:
+            print("budget-registry completeness lint:")
+            for v in budget_lint:
+                print(f"  !! {v}")
+        else:
+            print("budget-registry completeness lint: every canonical "
+                  "program and PROGRAM_SPACE family carries a pinned "
+                  "peak_bytes_max")
     targets = args.program or programs.names()
     if args.quant == "off":
         targets = [n for n in targets if n != "quant_serving_segment"]
@@ -289,7 +328,8 @@ def main(argv=None) -> int:
     aot_total_s = 0.0
     for name in targets:
         rep = audit_program(name, replays=args.replays,
-                            aot=args.aot == "on")
+                            aot=args.aot == "on",
+                            memory=args.memory == "on")
         violations = budgets.check(rep)
         if args.aot == "on" and lint:
             violations = violations + [
@@ -303,6 +343,16 @@ def main(argv=None) -> int:
             "violations": violations,
         })
         print(rep.format())
+        if "peak_bytes" in rep.metrics:
+            b = budgets.budget_for(name)
+            cap = b.peak_bytes_max if b else None
+            print(f"  bytes: peak {rep.metrics['peak_bytes'] / 2**20:.2f}"
+                  f" MiB (transient "
+                  f"{rep.metrics['peak_transient_bytes'] / 2**20:.2f} "
+                  f"MiB) | relayout "
+                  f"{rep.metrics['relayout_bytes'] / 2**20:.2f} MiB"
+                  + (f" | peak budget {cap / 2**20:.2f} MiB"
+                     if cap is not None else ""))
         if "program_space_keys" in rep.metrics:
             fams = rep.metrics["aot_families"]
             aot_total_keys += rep.metrics["program_space_keys"]
@@ -355,6 +405,14 @@ def main(argv=None) -> int:
         print(f"journal detached: {jrnl.total_records} records "
               f"({jrnl.dir})")
     observability.set_enabled(prev_telemetry)
+    if budget_lint:
+        results.append({
+            "program": "_budget_registry",
+            "metrics": {},
+            "hazards": [],
+            "violations": budget_lint,
+        })
+        any_violation = True
     if args.json:
         with open(args.json, "w") as f:
             json.dump(results, f, indent=1, default=str)

@@ -1,7 +1,7 @@
 """Program auditor: run every static + dynamic pass over one program.
 
 The auditor consumes either a raw jit-compiled callable (``audit_fn``)
-or a registered canonical program (``programs.build``), runs the five
+or a registered canonical program (``programs.build``), runs the six
 passes, and returns an ``AuditReport`` of findings + metrics that
 ``budgets.check`` judges:
 
@@ -10,6 +10,7 @@ passes, and returns an ``AuditReport`` of findings + metrics that
 3. relayout accounting   (static;  ``hlo.relayout_inventory``)
 4. donation/aliasing     (static;  ``hlo.donation_report``)
 5. collective/mesh audit (static;  ``hlo.collective_check``)
+6. HBM liveness          (static;  ``memory.peak_live`` — r24)
 """
 
 from __future__ import annotations
@@ -18,6 +19,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from . import hlo as hlo_passes
+from . import memory as memory_pass
 from . import recompile as recompile_pass
 from . import syncs as sync_pass
 
@@ -69,9 +71,13 @@ class AuditReport:
 def audit_static(program: str, hlo_text: str, mesh=None,
                  donation_threshold: int = 1 << 20,
                  expected_undonated: Sequence[str] = (),
-                 allowed_axes: Optional[Sequence[str]] = None
-                 ) -> AuditReport:
-    """Passes 3-5 over one program's optimized HLO text."""
+                 allowed_axes: Optional[Sequence[str]] = None,
+                 memory: bool = True) -> AuditReport:
+    """Passes 3-6 over one program's optimized HLO text.
+
+    ``memory=False`` skips the liveness pass (the ``--memory off``
+    contract: no ``peak_bytes`` metric is emitted, so ``budgets.check``
+    skips the peak ceiling — every other budget is bit-identical)."""
     rep = AuditReport(program=program)
 
     inv = hlo_passes.relayout_inventory(hlo_text)
@@ -114,6 +120,32 @@ def audit_static(program: str, hlo_text: str, mesh=None,
         rep.add("collective", "hazard",
                 f"{e['op']} rides axes {e['axes']} outside the program's "
                 f"declared set {sorted(allowed_axes)}", e)
+
+    if memory:
+        mem = memory_pass.peak_live(hlo_text, program=program)
+        rep.metrics["peak_bytes"] = mem.peak_bytes
+        rep.metrics["peak_transient_bytes"] = mem.transient_bytes
+        rep.add("memory", "info",
+                f"peak {mem.peak_bytes / 2**20:.2f} MiB at "
+                f"#{mem.peak_index}/{mem.schedule_len} "
+                f"{mem.peak_instruction} (params "
+                f"{mem.param_bytes / 2**20:.2f} MiB + transient "
+                f"{mem.transient_bytes / 2**20:.2f} MiB)", mem)
+        for b in mem.live_at_peak[:3]:
+            if not b.bytes:
+                continue
+            tag = "param" if b.param else "live"
+            rep.add("memory", "info",
+                    f"at peak [{tag}] {b.bytes / 2**20:.2f} MiB "
+                    f"{b.name} {b.op} {b.shape}"
+                    + (f" [{b.metadata}]" if b.metadata else ""), b)
+        for b in memory_pass.hot_transients(mem):
+            rep.add("memory", "info",
+                    f"liveness hotspot: {b.name} {b.op} "
+                    f"({b.bytes / 2**20:.2f} MiB {b.shape}) live "
+                    f"[{b.start}, {b.end}] of {mem.schedule_len} — a "
+                    f"whole-schedule transient dominating the peak "
+                    f"(the stacked-across-steps class)", b)
     return rep
 
 

@@ -38,6 +38,12 @@ class Budget:
     relayout_bytes_max: Optional[int] = None
     pack_bytes_max: Optional[int] = None
     undonated_bytes_max: Optional[int] = None
+    # r24: ceiling on the liveness pass's peak live HBM (memory.peak_live
+    # — the number that actually OOMs a chip). Platform-scoped like the
+    # other byte ledgers: XLA:CPU and XLA:TPU schedule and fuse
+    # differently, so the chip cell gets pinned from the lane's
+    # TPU_TESTS peak_hbm_bytes artifact, not from this CPU value.
+    peak_bytes_max: Optional[int] = None
     bytes_platform: str = "cpu"
     require_collectives_clean: bool = True
     notes: str = ""
@@ -61,6 +67,11 @@ BUDGETS: Dict[str, Budget] = {
         relayout_bytes_max=15_900_000,
         pack_bytes_max=1 * _MiB,       # measured 0
         undonated_bytes_max=_MiB // 2,  # measured 0 (batch rides < thresh)
+        # liveness peak measured 10,076,748 B on the 8-virtual-device
+        # CPU lowering the gate runs under (bf16 master/model param
+        # copies + the fused backward's conv activation window; the
+        # single-device lowering schedules ~1 MiB tighter) + ~5%
+        peak_bytes_max=10_580_000,
         notes="r8 class: GradScaler-free bf16 path; params+state alias"),
     # The fused decode chunk is a pure device loop: no syncs, no
     # compiles, and the KV cache must ride donated (an undonated cache
@@ -73,6 +84,9 @@ BUDGETS: Dict[str, Budget] = {
         relayout_bytes_max=700_000,
         pack_bytes_max=_MiB // 2,      # measured 0
         undonated_bytes_max=_MiB // 2,  # measured 0 (tiny weights)
+        # liveness peak measured 1,315,880 B (weights live whole-
+        # program + the decode while carry) + ~5%
+        peak_bytes_max=1_380_000,
         notes="pure device loop; cache donated, weights live by design"),
     # One fused segment = ONE dispatch + ONE event fetch (the measured
     # r7 contract). The fetch is the allowed per-segment sync; anything
@@ -86,6 +100,9 @@ BUDGETS: Dict[str, Budget] = {
         relayout_bytes_max=1_050_000,
         pack_bytes_max=_MiB // 2,      # measured 0
         undonated_bytes_max=_MiB // 2,  # measured 0
+        # liveness peak measured 1,578,828 B (weights + donated dense
+        # cache counted once + segment while carry) + ~5%
+        peak_bytes_max=1_657_000,
         notes="r7 contract: one dispatch + one fetch per segment"),
     # The PAGED segment (r11): same one-dispatch/one-fetch contract as
     # serving_segment, with page tables as DATA (no prefix-width shape
@@ -101,6 +118,9 @@ BUDGETS: Dict[str, Budget] = {
         relayout_bytes_max=1_095_000,
         pack_bytes_max=_MiB // 2,      # measured 0
         undonated_bytes_max=_MiB // 2,  # measured 0 (pool+table donated)
+        # liveness peak measured 1,659,516 B (weights + donated pool
+        # counted once + segment while carry) + ~5%
+        peak_bytes_max=1_742_000,
         notes="r11 contract: paged pool + page tables, one fetch/segment, "
               "prefix reuse is refcount data not program shape"),
     # The CHUNKED-PREFILL paged segment (r13, ISSUE 8a): the
@@ -122,6 +142,9 @@ BUDGETS: Dict[str, Budget] = {
         relayout_bytes_max=1_015_000,
         pack_bytes_max=_MiB // 2,      # measured 0
         undonated_bytes_max=_MiB // 2,  # measured 0 (pool+table donated)
+        # liveness peak measured 1,652,516 B (pool counted once; chunk
+        # windows carry less than the full admit) + ~5%
+        peak_bytes_max=1_735_000,
         notes="r13 contract: chunked prefill interleaved with decode — "
               "bounded time-between-tokens at zero extra syncs/compiles"),
     # The SPECULATIVE paged segment (r15, ISSUE 10): multi-token
@@ -143,6 +166,9 @@ BUDGETS: Dict[str, Budget] = {
         pack_bytes_max=_MiB // 2,      # measured 0
         undonated_bytes_max=_MiB // 2,  # measured 0 (pool+table+hist
                                         # donated; rng rides tiny)
+        # liveness peak measured 1,664,136 B (pool counted once + the
+        # verify tick's [K+1]-wide windows) + ~5%
+        peak_bytes_max=1_747_000,
         notes="r15 contract: K-token drafts verified in one paged tick "
               "— accepted-length>1 per weight stream at zero extra "
               "syncs/compiles/shapes"),
@@ -167,6 +193,9 @@ BUDGETS: Dict[str, Budget] = {
         relayout_bytes_max=1_097_000,
         pack_bytes_max=_MiB // 2,      # measured 0
         undonated_bytes_max=_MiB // 2,  # measured 0 (pool+table donated)
+        # liveness peak measured 1,662,972 B (pool counted once + the
+        # [steps, slots, k] digest carries) + ~5%
+        peak_bytes_max=1_746_000,
         notes="r17 contract: in-program logit digests ride the single "
               "event fetch — quality evidence at zero extra syncs/"
               "compiles/shapes"),
@@ -191,6 +220,9 @@ BUDGETS: Dict[str, Budget] = {
         relayout_bytes_max=663_000,
         pack_bytes_max=_MiB // 2,      # measured 0
         undonated_bytes_max=_MiB // 2,  # measured 0 (pool+table donated)
+        # liveness peak measured 503,804 B — int8 weights + quarter-
+        # width pool put the whole envelope under a third of bf16 + ~5%
+        peak_bytes_max=528_000,
         notes="r21 contract: narrow weight/KV streams at zero extra "
               "syncs/compiles/shapes — the quantized roofline win is "
               "pure bytes, not a hazard trade"),
@@ -217,6 +249,9 @@ BUDGETS: Dict[str, Budget] = {
         relayout_bytes_max=1_162_000,
         pack_bytes_max=_MiB // 2,      # measured 0
         undonated_bytes_max=_MiB // 2,  # measured 0 (pool+table donated)
+        # liveness peak measured 1,660,016 B (pool counted once + the
+        # [sp, C] slab windows) + ~5%
+        peak_bytes_max=1_743_000,
         notes="r23 contract: sp-slab prefill scattering into the paged "
               "pool — long context at zero extra syncs/compiles and "
               "zero boundary relayout"),
@@ -234,6 +269,12 @@ BUDGETS: Dict[str, Budget] = {
         relayout_bytes_max=1_050_000,
         pack_bytes_max=_MiB // 2,      # measured 0 at both degrees
         undonated_bytes_max=_MiB // 2,  # measured 0 (sharded cache donates)
+        # liveness peak: the gate env (8 virtual devices) partitions
+        # mp=2, so the per-device text halves the sharded weights and
+        # carries — measured 791,888 B + ~5%. The mp=1 degenerate
+        # lowering (single-device hosts) peaks at 1,578,828 B
+        # (== serving_segment) and rides under the same ceiling.
+        peak_bytes_max=1_657_000,
         notes="r12 contract: mp-sharded segment — one fetch/segment, "
               "all collectives ride the declared 'mp' axis"),
     # The donated multi-tensor update: the r8 ledger program. The pack
@@ -250,6 +291,9 @@ BUDGETS: Dict[str, Budget] = {
         # measured 262,144 B: exactly the two (128,256) f32 gradient
         # inputs — grads are inputs, never donated; params+velocity alias
         undonated_bytes_max=300_000,
+        # liveness peak measured 2,019,844 B: params+velocity (donated,
+        # once) + the two undonated gradient inputs + ~5%
+        peak_bytes_max=2_120_000,
         notes="r8 ledger program: 255.5->153.3 MB/step class, miniature"),
 }
 
@@ -291,7 +335,8 @@ def check(report, budget: Optional[Budget] = None) -> List[str]:
     if jax.default_backend() == budget.bytes_platform:
         for key, cap in (("relayout_bytes", budget.relayout_bytes_max),
                          ("pack_bytes", budget.pack_bytes_max),
-                         ("undonated_bytes", budget.undonated_bytes_max)):
+                         ("undonated_bytes", budget.undonated_bytes_max),
+                         ("peak_bytes", budget.peak_bytes_max)):
             val = m.get(key)
             if cap is not None and val is not None and val > cap:
                 v.append(f"{key} {val / _MiB:.2f} MiB > "

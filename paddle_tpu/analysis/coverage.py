@@ -39,9 +39,9 @@ import inspect
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
-__all__ = ["lint_registry_only", "lint_source", "reachable_keys_replay",
-           "check_envelope", "coverage_report", "aot_audit",
-           "CoverageReport"]
+__all__ = ["lint_registry_only", "lint_source", "lint_budget_coverage",
+           "reachable_keys_replay", "check_envelope", "coverage_report",
+           "aot_audit", "CoverageReport"]
 
 
 def _registry():
@@ -332,3 +332,57 @@ def aot_audit(engine, envelope=None) -> dict:
                          "seconds": round(r["seconds"], 4)}
                      for f, r in fam_report.items()},
     }
+
+
+# --- 4. budget-registry completeness lint (r24) -----------------------------
+
+def lint_budget_coverage(program_names: Optional[Sequence[str]] = None,
+                         families: Optional[Sequence[str]] = None
+                         ) -> List[str]:
+    """Budget completeness is machine-checked, not convention: every
+    registered canonical program AND every ``PROGRAM_SPACE`` family's
+    declared ``budget_program`` must carry a budget entry with the r24
+    ``peak_bytes_max`` ceiling pinned. The gate runs this alongside the
+    per-program audits and FAILS on any gap — a new program or family
+    cannot land without a statically bounded HBM peak. Empty list =
+    complete. ``program_names``/``families`` default to the live
+    registries (overridable so tests can prove the lint fires on a
+    deliberately unregistered program)."""
+    from . import budgets, programs
+
+    if program_names is None:
+        program_names = programs.names()
+    reg = _registry()
+    if families is None:
+        families = reg.families()
+    out: List[str] = []
+    for name in program_names:
+        b = budgets.BUDGETS.get(name)
+        if b is None:
+            out.append(f"canonical program {name!r} has no budget entry "
+                       f"in analysis/budgets.py")
+        elif b.peak_bytes_max is None:
+            out.append(f"canonical program {name!r} has no peak_bytes_max "
+                       f"— pin the measured HBM liveness peak (+<=5%)")
+    for fam_name in families:
+        try:
+            fam = reg.family(fam_name)
+        except KeyError:
+            out.append(f"program family {fam_name!r} is not registered "
+                       f"in PROGRAM_SPACE")
+            continue
+        prog = fam.budget_program
+        if prog is None:
+            out.append(f"program family {fam_name!r} declares no "
+                       f"budget_program — name the canonical gate "
+                       f"program that stands in for it")
+            continue
+        if prog not in programs.names():
+            out.append(f"program family {fam_name!r} maps to unknown "
+                       f"canonical program {prog!r}")
+            continue
+        b = budgets.BUDGETS.get(prog)
+        if b is None or b.peak_bytes_max is None:
+            out.append(f"program family {fam_name!r} maps to {prog!r} "
+                       f"which lacks a pinned peak_bytes_max")
+    return out

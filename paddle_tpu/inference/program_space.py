@@ -142,13 +142,19 @@ class ProgramFamily:
     tag). ``axes`` name the remaining positions. ``enumerate_fn(engine,
     envelope)`` yields every key the family can reach from that config
     under that envelope; ``applies(engine)`` gates which families an
-    engine config routes dispatches to."""
+    engine config routes dispatches to. ``budget_program`` names the
+    canonical gate program (``analysis/programs.py``) that stands in
+    for this family in the budget registry — ``analysis.coverage``'s
+    budget-completeness lint (r24) fails the gate if that program lacks
+    a pinned ``peak_bytes_max``, so every reachable family has a
+    statically bounded HBM peak."""
     name: str
     tag: Optional[str]
     axes: Tuple[str, ...]
     doc: str
     enumerate_fn: Callable
     applies: Callable
+    budget_program: Optional[str] = None
 
     def key(self, **kw) -> tuple:
         missing = [a for a in self.axes if a not in kw]
@@ -497,35 +503,41 @@ PROGRAM_SPACE.register(ProgramFamily(
     name="admit", tag=None, axes=("bucket", "nb"),
     doc="r5 windowed fused prefill+insert wave: (bucket, nb)",
     enumerate_fn=_enum_admit,
-    applies=lambda e: _is_dense(e) and e.mesh is None))
+    applies=lambda e: _is_dense(e) and e.mesh is None,
+    budget_program="serving_segment"))
 
 PROGRAM_SPACE.register(ProgramFamily(
     name="decode", tag="decode", axes=("chunk",),
     doc="r5 windowed decode chunk: ('decode', chunk)",
     enumerate_fn=_enum_decode,
-    applies=lambda e: _is_dense(e) and e.mesh is None))
+    applies=lambda e: _is_dense(e) and e.mesh is None,
+    budget_program="decode_tick"))
 
 PROGRAM_SPACE.register(ProgramFamily(
     name="drain", tag="drain", axes=("n_pad", "p_max", "g_max"),
     doc="r5 offline whole-queue drain: ('drain', n_pad, p_max, g_max)",
     enumerate_fn=_enum_drain,
-    applies=lambda e: _is_dense(e) and e.mesh is None))
+    applies=lambda e: _is_dense(e) and e.mesh is None,
+    budget_program="serving_segment"))
 
 PROGRAM_SPACE.register(ProgramFamily(
     name="seg", tag="seg", axes=("n_pad", "s_max", "pre_max", "steps"),
     doc="r7 dense re-entrant segment: ('seg', n_pad, s_max, pre_max, "
         "steps)",
-    enumerate_fn=_enum_seg, applies=_is_dense))
+    enumerate_fn=_enum_seg, applies=_is_dense,
+    budget_program="serving_segment"))
 
 PROGRAM_SPACE.register(ProgramFamily(
     name="pseg", tag="pseg", axes=("n_pad", "s_max", "steps"),
     doc="r11 paged segment: ('pseg', n_pad, s_max, steps)",
-    enumerate_fn=_enum_pseg, applies=_is_paged_plain))
+    enumerate_fn=_enum_pseg, applies=_is_paged_plain,
+    budget_program="paged_serving_segment"))
 
 PROGRAM_SPACE.register(ProgramFamily(
     name="qseg", tag="qseg", axes=("n_pad", "s_max", "steps"),
     doc="r17 quality-digest paged segment: ('qseg', n_pad, s_max, steps)",
-    enumerate_fn=_enum_qseg, applies=_is_paged_quality))
+    enumerate_fn=_enum_qseg, applies=_is_paged_quality,
+    budget_program="quality_serving_segment"))
 
 PROGRAM_SPACE.register(ProgramFamily(
     name="qpseg", tag="qpseg", axes=("n_pad", "s_max", "steps", "dtype"),
@@ -533,19 +545,22 @@ PROGRAM_SPACE.register(ProgramFamily(
         "dtype) — dtype is the declared QUANT_CODES code (int8=1, "
         "fp8=2); quality digests compose without a new axis (coverage "
         "is per-engine, and an engine fixes its digest setting)",
-    enumerate_fn=_enum_qpseg, applies=_is_paged_quant))
+    enumerate_fn=_enum_qpseg, applies=_is_paged_quant,
+    budget_program="quant_serving_segment"))
 
 PROGRAM_SPACE.register(ProgramFamily(
     name="cseg", tag="cseg", axes=("n_pad", "s_max", "c", "steps"),
     doc="r13 chunked-prefill paged segment: ('cseg', n_pad, s_max_c, C, "
         "steps)",
-    enumerate_fn=_enum_cseg, applies=_is_paged_chunked))
+    enumerate_fn=_enum_cseg, applies=_is_paged_chunked,
+    budget_program="chunked_serving_segment"))
 
 PROGRAM_SPACE.register(ProgramFamily(
     name="sseg", tag="sseg", axes=("n_pad", "k", "steps"),
     doc="r15 speculative/sampled paged segment: ('sseg', n_pad, K, "
         "steps) — width pinned to the largest bucket by design",
-    enumerate_fn=_enum_sseg, applies=_is_paged_spec))
+    enumerate_fn=_enum_sseg, applies=_is_paged_spec,
+    budget_program="spec_serving_segment"))
 
 PROGRAM_SPACE.register(ProgramFamily(
     name="spseg", tag="spseg", axes=("n_pad", "s_max", "c", "sp", "steps"),
@@ -555,7 +570,8 @@ PROGRAM_SPACE.register(ProgramFamily(
         "count (the slab's batch rows; the 'sp' mesh axis when one is "
         "set). Adds to (never replaces) the engine's pseg/cseg space: "
         "only prompts past the largest regular bucket engage it",
-    enumerate_fn=_enum_spseg, applies=_is_paged_sp))
+    enumerate_fn=_enum_spseg, applies=_is_paged_sp,
+    budget_program="longctx_serving_segment"))
 
 
 FAMILY_TAGS: FrozenSet[str] = PROGRAM_SPACE.tags()

@@ -558,7 +558,11 @@ class CapacityMonitor:
 def capacity_plan(trace_stats: dict, ledger: Optional[dict] = None, *,
                   page_size: int, slots: int,
                   measured: Optional[dict] = None,
-                  headroom: float = 0.0) -> dict:
+                  headroom: float = 0.0,
+                  cfg=None, params=None, quant: Optional[str] = None,
+                  hbm_bytes: Optional[int] = None,
+                  mesh_devices: int = 1,
+                  transient_bytes: Optional[int] = None) -> dict:
     """Answer "what pool size / how many replicas for this trace".
 
     ``trace_stats``: ``mean_prompt_tokens``, ``mean_new_tokens``, and
@@ -582,6 +586,14 @@ def capacity_plan(trace_stats: dict, ledger: Optional[dict] = None, *,
     * **§3g replica scaling** — offered tok/s = λ·E[G] against one
       replica's capacity ``occupancy × slots / per_tick_s`` gives the
       replica count at ``headroom`` utilisation margin.
+
+    r24: pass ``cfg`` (+ ``params``/``quant``) and ``hbm_bytes`` and
+    the plan gains a ``chip_fit`` section — the §3s static HBM
+    envelope (weights + recommended pool + peak transient, via
+    ``analysis.memory.chip_fit``) priced for the recommended
+    ``pool_pages``, answering will-this-replica-fit BEFORE a pool is
+    ever allocated. ``transient_bytes`` overrides the analytic
+    estimate with a measured liveness peak.
     """
     S = float(trace_stats["mean_prompt_tokens"])
     G = float(trace_stats["mean_new_tokens"])
@@ -614,6 +626,15 @@ def capacity_plan(trace_stats: dict, ledger: Optional[dict] = None, *,
     predicted_tok_s = (min(offered_tok_s, replicas * tok_s_replica)
                        if offered_tok_s is not None and tok_s_replica
                        else tok_s_replica)
+    chip_fit = None
+    if hbm_bytes is not None and cfg is not None:
+        from ..analysis import memory as _memory
+
+        chip_fit = _memory.chip_fit(
+            cfg, params, page_size=int(page_size), num_pages=pool_pages,
+            quant=quant, mesh_devices=mesh_devices, hbm_bytes=hbm_bytes,
+            transient_bytes=transient_bytes, n_pad=int(slots),
+            s_max=int(math.ceil(S + G)), live_pages=high_water_pages)
     return {
         "arithmetic": "SCALING §3f pages-free x §3g replica scaling",
         "span_pages": span_pages,
@@ -633,6 +654,7 @@ def capacity_plan(trace_stats: dict, ledger: Optional[dict] = None, *,
         "replicas": replicas,
         "predicted_tok_s": (round(predicted_tok_s, 2)
                             if predicted_tok_s is not None else None),
+        "chip_fit": chip_fit,
     }
 
 

@@ -1,0 +1,350 @@
+"""Static HBM liveness auditor (r24 tentpole, ISSUE 19): the liveness
+ledger on hand-computable synthetic modules (peak value AND peak index
+are asserted exactly), donation counted once on both synthetic and real
+donated jits, the per-device division for sharded meshes, the seeded
+known-bad fixture (a scan that stacks full per-step logits instead of
+reducing them — the logits_all-across-steps blowup) flagged with a
+clean twin, the ``--memory on|off`` bit-identity contract, the
+budget-registry completeness lint, and the §3s chip-fit surface: exact
+pool arithmetic vs ``init_paged_pool``, the envelope fit decision both
+ways, the ±10% cross-validation against the r18 PoolMonitor high-water
+on a recorded serve, the ``capacity_plan`` join and the per-family
+envelope table.
+
+Serving-engine tests ride the session ``tiny_llama`` fixture and the
+shared ``_mk`` geometry (suite-time contract, see test_capacity.py).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.analysis import auditor, budgets, coverage, memory, programs
+from paddle_tpu.inference.scheduler import Arrival, OnlineScheduler
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models import llama
+from paddle_tpu.observability import PoolMonitor, capacity_plan
+from paddle_tpu.parallel import set_mesh
+
+
+@pytest.fixture(scope="module")
+def tiny(tiny_llama):
+    set_mesh(None)
+    return tiny_llama
+
+
+def _mk(cfg, params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("prompt_buckets", (8, 16, 32))
+    kw.setdefault("paged", True)
+    kw.setdefault("page_size", 16)
+    return ServingEngine(cfg, params, **kw)
+
+
+def _opt_hlo(jitted, *args):
+    return jitted.lower(*args).compile().as_text()
+
+
+# ---------------------------------------------------------------------------
+# the liveness ledger on hand-computable synthetic modules
+# ---------------------------------------------------------------------------
+
+# f32[64,64] = 16 KiB per buffer. Schedule: p0 p1 add mul out.
+# add dies at its use in mul (#3); at #3 four buffers are live
+# (p0, p1 whole-program; add [2,3]; mul [3,4]) = 64 KiB, the peak.
+_SYNTH = """HloModule synth, is_scheduled=true
+
+ENTRY %main (p0: f32[64,64], p1: f32[64,64]) -> f32[64,64] {
+  %p0 = f32[64,64]{1,0} parameter(0)
+  %p1 = f32[64,64]{1,0} parameter(1)
+  %add = f32[64,64]{1,0} add(%p0, %p1)
+  %mul = f32[64,64]{1,0} multiply(%add, %p1)
+  ROOT %out = f32[64,64]{1,0} negate(%mul)
+}
+"""
+
+_KB16 = 64 * 64 * 4
+
+_SYNTH_DONATED = """HloModule synthd, is_scheduled=true, \
+input_output_alias={ {}: (0, {}, may-alias) }
+
+ENTRY %main (p0: f32[64,64]) -> f32[64,64] {
+  %p0 = f32[64,64]{1,0} parameter(0)
+  ROOT %neg = f32[64,64]{1,0} negate(%p0)
+}
+"""
+
+_SYNTH_UNDONATED = _SYNTH_DONATED.replace(
+    ", input_output_alias={ {}: (0, {}, may-alias) }", "")
+
+
+class TestLivenessLedger:
+    def test_hand_computed_peak(self):
+        rep = memory.peak_live(_SYNTH, program="synth")
+        assert rep.peak_bytes == 4 * _KB16
+        assert rep.peak_index == 3
+        assert rep.peak_instruction == "mul"
+        assert rep.param_bytes == 2 * _KB16
+        assert rep.transient_bytes == 2 * _KB16
+        assert rep.schedule_len == 5
+        # the peak-point live set names all four buffers
+        assert {b.name for b in rep.live_at_peak} == {
+            "p0", "p1", "add", "mul"}
+        assert "synth" in rep.format()
+
+    def test_donated_output_counted_once(self):
+        don = memory.peak_live(_SYNTH_DONATED)
+        und = memory.peak_live(_SYNTH_UNDONATED)
+        # donated: root reuses the parameter's buffer -> one 16 KiB
+        # footprint; undonated: param + fresh output -> two
+        assert don.peak_bytes == _KB16
+        assert und.peak_bytes == 2 * _KB16
+        assert don.donated_param_bytes == _KB16
+        assert und.donated_param_bytes == 0
+        assert any(b.donated for b in don.intervals)
+
+    def test_devices_divisor(self):
+        rep = memory.peak_live(_SYNTH, devices=2)
+        assert rep.peak_bytes == 2 * _KB16
+        rep4 = memory.peak_live(_SYNTH, devices=4)
+        assert rep4.peak_bytes == _KB16
+
+    def test_alias_ops_cost_nothing(self):
+        # tuple/get-tuple-element produce views: same peak as _SYNTH
+        text = _SYNTH.replace(
+            "ROOT %out = f32[64,64]{1,0} negate(%mul)",
+            "%t = (f32[64,64]{1,0}) tuple(%mul)\n"
+            "  ROOT %out = f32[64,64]{1,0} get-tuple-element(%t), index=0")
+        rep = memory.peak_live(text)
+        assert rep.peak_bytes == 4 * _KB16
+
+    def test_real_jit_donation_counted_once(self):
+        x = jnp.ones((128, 128), jnp.float32)   # 64 KiB
+        don = _opt_hlo(jax.jit(lambda a: a * 2.0 + 1.0,
+                               donate_argnums=0), x)
+        und = _opt_hlo(jax.jit(lambda a: a * 2.0 + 1.0), x)
+        rd = memory.peak_live(don)
+        ru = memory.peak_live(und)
+        assert rd.donated_param_bytes == x.size * 4
+        # the donated program's peak is one buffer smaller than the
+        # undonated twin's (output reuses the input)
+        assert ru.peak_bytes - rd.peak_bytes == x.size * 4
+
+
+# ---------------------------------------------------------------------------
+# the seeded known-bad fixture: logits stacked across scan steps
+# ---------------------------------------------------------------------------
+
+
+def _scan_hlo(keep_all: bool) -> str:
+    W = jnp.ones((64, 1024), jnp.float32)
+    xs = jnp.ones((16, 4, 64), jnp.float32)
+
+    def step(carry, x):
+        logits = x @ W                       # [4, 1024] per step
+        if keep_all:
+            return carry, logits             # stacked: [16,4,1024]
+        return carry + logits.sum(), ()
+
+    def run(xs):
+        carry, ys = jax.lax.scan(step, jnp.float32(0), xs)
+        return ys[-1] if keep_all else carry
+
+    return _opt_hlo(jax.jit(run), xs)
+
+
+class TestLivenessBlowupFixture:
+    def test_stacked_logits_flagged(self):
+        bad = memory.peak_live(_scan_hlo(True), program="bad")
+        clean = memory.peak_live(_scan_hlo(False), program="clean")
+        # the stacked [16,4,1024] f32 block (256 KiB) dominates the bad
+        # program's peak; the reduced twin never materialises it
+        assert bad.peak_bytes - clean.peak_bytes >= 16 * 4 * 1024 * 4 // 2
+        hot = memory.hot_transients(bad)
+        assert hot, "stacked logits buffer must surface as a hotspot"
+        assert max(b.bytes for b in hot) >= 16 * 4 * 1024 * 4 // 2
+        assert memory.hot_transients(clean) == []
+
+    def test_peak_budget_catches_blowup(self):
+        bad = memory.peak_live(_scan_hlo(True), program="bad")
+        clean = memory.peak_live(_scan_hlo(False), program="clean")
+        budget = budgets.Budget(
+            peak_bytes_max=int(clean.peak_bytes * 1.05))
+        rep = auditor.AuditReport(program="scan_step")
+        rep.metrics["peak_bytes"] = clean.peak_bytes
+        assert budgets.check(rep, budget) == []
+        rep.metrics["peak_bytes"] = bad.peak_bytes
+        assert any("peak_bytes" in v for v in budgets.check(rep, budget))
+
+
+# ---------------------------------------------------------------------------
+# --memory on|off bit-identity + the canonical-program metric surface
+# ---------------------------------------------------------------------------
+
+
+class TestMemoryGateIdentity:
+    def test_audit_bit_identity_except_peak(self):
+        x = jnp.ones((64, 64), jnp.float32)
+        text = _opt_hlo(jax.jit(lambda a: jnp.tanh(a @ a)), x)
+        on = auditor.audit_static("p", text, memory=True)
+        off = auditor.audit_static("p", text, memory=False)
+        peak_keys = {"peak_bytes", "peak_transient_bytes"}
+        assert peak_keys <= set(on.metrics)
+        assert not (peak_keys & set(off.metrics))
+        on_rest = {k: v for k, v in on.metrics.items()
+                   if k not in peak_keys}
+        off_rest = dict(off.metrics)
+        assert on_rest == off_rest
+        # peak ceiling silently skipped when the metric is absent
+        b = budgets.Budget(peak_bytes_max=1)
+        assert not any("peak_bytes" in v for v in budgets.check(off, b))
+
+    def test_every_canonical_program_has_pinned_peak(self):
+        for name in programs.names():
+            b = budgets.budget_for(name)
+            assert b is not None, name
+            assert b.peak_bytes_max is not None, name
+
+
+# ---------------------------------------------------------------------------
+# satellite: the budget-registry completeness lint
+# ---------------------------------------------------------------------------
+
+
+class TestBudgetCoverageLint:
+    def test_live_registry_complete(self):
+        assert coverage.lint_budget_coverage() == []
+
+    def test_unregistered_program_fails(self):
+        out = coverage.lint_budget_coverage(
+            program_names=["not_a_program"])
+        assert out and "not_a_program" in out[0]
+
+    def test_unknown_family_fails(self):
+        out = coverage.lint_budget_coverage(program_names=[],
+                                            families=["bogus_family"])
+        assert out and "bogus_family" in out[0]
+
+    def test_every_family_names_a_budget_program(self):
+        from paddle_tpu.inference.program_space import PROGRAM_SPACE
+
+        for fam_name in PROGRAM_SPACE.families():
+            fam = PROGRAM_SPACE.family(fam_name)
+            assert fam.budget_program in programs.names(), fam_name
+
+
+# ---------------------------------------------------------------------------
+# the §3s chip-fit surface
+# ---------------------------------------------------------------------------
+
+
+class TestChipFit:
+    def test_pool_bytes_exact_vs_init_paged_pool(self, tiny):
+        cfg, _params = tiny
+        for quant in (None, "int8"):
+            pool = llama.init_paged_pool(cfg, 8, 16, quant=quant)
+            raw = sum(int(v.size) * v.dtype.itemsize
+                      for v in pool.values())
+            assert memory.pool_bytes_for(cfg, 8, 16, quant) == raw
+
+    def test_envelope_fits_both_ways(self, tiny):
+        cfg, params = tiny
+        fit = memory.chip_fit(cfg, params, page_size=16, num_pages=8,
+                              hbm_bytes=memory.V5E_HBM_BYTES)
+        assert fit["fits"] and fit["headroom_bytes"] > 0
+        assert fit["envelope_bytes"] == (fit["weights_bytes"]
+                                         + fit["pool_bytes"]
+                                         + fit["transient_bytes"])
+        tight = memory.chip_fit(cfg, params, page_size=16, num_pages=8,
+                                hbm_bytes=fit["envelope_bytes"] - 1)
+        assert not tight["fits"] and tight["headroom_bytes"] < 0
+        assert tight["headroom_pages"] == 0
+
+    def test_mesh_devices_divide_weights_and_pool(self, tiny):
+        cfg, params = tiny
+        one = memory.chip_fit(cfg, params, page_size=16, num_pages=8,
+                              hbm_bytes=memory.V5E_HBM_BYTES)
+        two = memory.chip_fit(cfg, params, page_size=16, num_pages=8,
+                              mesh_devices=2,
+                              hbm_bytes=memory.V5E_HBM_BYTES)
+        assert two["weights_bytes"] == -(-one["weights_bytes"] // 2)
+        assert two["pool_bytes"] == -(-one["pool_bytes"] // 2)
+
+    def test_transient_estimate_monotone(self, tiny):
+        cfg, _params = tiny
+        t = memory.transient_estimate
+        assert t(cfg, n_pad=4, s_max=64) > t(cfg, n_pad=2, s_max=64)
+        assert t(cfg, n_pad=4, s_max=64) > t(cfg, n_pad=4, s_max=32)
+        assert (t(cfg, n_pad=4, s_max=64, tokens_per_tick=2)
+                > t(cfg, n_pad=4, s_max=64))
+
+
+# ---------------------------------------------------------------------------
+# ±10% cross-validation vs the r18 PoolMonitor on a recorded serve
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def saturated(tiny):
+    """A serve that saturates a tight pool — the measured high-water the
+    static prediction is validated against (geometry shared with
+    test_capacity.py's saturated fixture for _SHARED_PROGS hits)."""
+    cfg, params = tiny
+    eng = _mk(cfg, params, slots=4, page_size=8)
+    pool = PoolMonitor(eng.pager).attach()
+    rng = np.random.RandomState(3)
+    arr = [Arrival(0.0, rng.randint(0, cfg.vocab_size, (8,))
+                   .astype(np.int32), 16) for _ in range(4)]
+    sch = OnlineScheduler(eng, seg_steps=16)
+    sch.serve(arr)
+    sch.results()
+    pool.detach()
+    return {"cfg": cfg, "params": params, "eng": eng, "pool": pool}
+
+
+class TestStaticEnvelopeValidation:
+    def test_kv_live_within_10pct_of_pool_monitor(self, saturated):
+        cfg, eng, pool = (saturated["cfg"], saturated["eng"],
+                          saturated["pool"])
+        fit = memory.chip_fit(
+            cfg, saturated["params"], page_size=8,
+            num_pages=eng.pager.num_pages,
+            hbm_bytes=memory.V5E_HBM_BYTES,
+            trace_stats={"mean_prompt_tokens": 8, "mean_new_tokens": 16,
+                         "concurrency": 4})
+        measured = pool.high_water_pages * fit["page_bytes"]
+        assert measured > 0
+        ratio = fit["kv_live_bytes"] / measured
+        assert abs(ratio - 1.0) <= 0.10, (fit["kv_live_bytes"], measured)
+
+    def test_capacity_plan_embeds_chip_fit(self, saturated):
+        cfg = saturated["cfg"]
+        plan = capacity_plan(
+            {"mean_prompt_tokens": 8, "mean_new_tokens": 16,
+             "concurrency": 4},
+            page_size=8, slots=4, cfg=cfg, params=saturated["params"],
+            hbm_bytes=memory.V5E_HBM_BYTES)
+        fit = plan["chip_fit"]
+        assert fit is not None and fit["fits"]
+        assert fit["envelope_bytes"] <= memory.V5E_HBM_BYTES
+        # without hbm_bytes the join stays off (r18 plan unchanged)
+        off = capacity_plan(
+            {"mean_prompt_tokens": 8, "mean_new_tokens": 16,
+             "concurrency": 4}, page_size=8, slots=4)
+        assert off["chip_fit"] is None
+
+    def test_family_envelopes_cover_reachable_space(self, saturated):
+        eng = saturated["eng"]
+        fams = memory.family_envelopes(
+            eng, eng.default_envelope(),
+            hbm_bytes=memory.V5E_HBM_BYTES)
+        assert fams, "the workload envelope reaches at least one family"
+        for name, entry in fams.items():
+            assert entry["keys"] >= 1, name
+            assert entry["budget_program"] in programs.names(), name
+            assert entry["fit"]["fits"], name
+            assert entry["fit"]["program_family"] == name

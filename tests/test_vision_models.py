@@ -31,6 +31,13 @@ r23 claw-back (ISSUE 18 satellite): ``squeezenet1_0`` (~36 s) joins the
 ``slow`` set — ``squeezenet1_1`` is the same fire-module family at a
 strictly smaller budget (~21 s) and keeps it tier-1-covered; the
 long-context serve tests ride inside the recovered time.
+
+r24 claw-back (ISSUE 19 satellite): full-width ``mobilenet_v1`` (~6 s,
+the fattest remaining tier-1 forward) joins the ``slow`` set — the
+``scale=0.25`` variant below is the same depthwise-separable stack at
+a quarter of the channel widths (strictly fewer compiled convs) and
+keeps the family tier-1-covered; the memory-analysis tests this round
+ride inside the recovered time.
 """
 
 import numpy as np
@@ -62,13 +69,23 @@ def _run(factory, size=64, classes=10):
     assert np.all(np.isfinite(out))
 
 
+def mobilenet_v1_x025(**kw):
+    # named wrapper (not functools.partial): _FWD_CACHE keys on
+    # factory.__name__, so the quarter-scale forward must cache under
+    # its own name, distinct from the full-width slow-marked one
+    return models.mobilenet_v1(scale=0.25, **kw)
+
+
 @pytest.mark.parametrize("factory,size", [
     (models.alexnet, 96),
     # squeezenet1_0 → slow (r23): squeezenet1_1 below is the same fire-
     # module family at a strictly smaller compile budget
     pytest.param(models.squeezenet1_0, 64, marks=pytest.mark.slow),
     (models.squeezenet1_1, 64),
-    (models.mobilenet_v1, 64),
+    # full-width mobilenet_v1 → slow (r24): the scale=0.25 cousin is
+    # the same depthwise-separable stack at strictly smaller widths
+    pytest.param(models.mobilenet_v1, 64, marks=pytest.mark.slow),
+    (mobilenet_v1_x025, 64),
     # the fattest zoo forwards run in the chip lane / -m slow only —
     # densenet121 + mobilenet_v3_small (~25 s + ~18 s, r19) and
     # googlenet (~17 s, r20; inception_v3 keeps the inception cell
