@@ -3270,6 +3270,191 @@ def run_longctx(model_name, cfg, params, llama, n=6, seed=0, slots=4,
     }
 
 
+# ---------------------------------------------------------------------------
+# elastic autoscaling: the 1x->4x->1x observable control loop (r25, ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def run_elastic(model_name, cfg, params, llama, seg_steps=4):
+    """The r25 elastic episode (ISSUE 20): one seeded step-load trace
+    served by a 4-replica paged fleet under the ``Autoscaler`` policy —
+    1x -> 4x on the t=0 burst's queue pressure (journal-sequence-ordered
+    BEFORE the first error-budget page), every added replica §3o-warmed
+    before it takes traffic, calm-triggered polite drains back to 1x
+    that strand zero requests and keep the repeat wave's prefix
+    hit-rate at 1.0 through the directory-aware hot-prefix migration,
+    and the whole episode — every journaled ``scale_decision`` included
+    — replayed bit-exactly from the journal in-lane."""
+    import tempfile
+
+    import jax
+
+    from paddle_tpu.inference.autoscaler import Autoscaler
+    from paddle_tpu.inference.fleet import FleetRouter, build_fleet
+    from paddle_tpu.inference.kv_tiers import HostTier
+    from paddle_tpu.inference.prefix_cache import PagedPrefixCache
+    from paddle_tpu.inference.scheduler import Arrival
+    from paddle_tpu.observability import journal as jmod
+    from paddle_tpu.observability import replay as rmod
+    from paddle_tpu.observability.capacity import CapacityMonitor
+    from paddle_tpu.observability.slo import Objective, SLOMonitor
+
+    _telemetry_section(reset=True)
+    n_replicas, n_groups = 4, 4
+    # the episode runs on a bucketed tiny-geometry fleet regardless of
+    # the picked model width: the evidence is control-loop ordering and
+    # bit-exact replay, not model-scale throughput
+    engines = build_fleet(cfg, params, n_replicas, slots=2, max_len=96,
+                          prompt_buckets=(8, 16, 32), paged=True,
+                          page_size=16)
+    pcs = [PagedPrefixCache(e.pager, capacity_pages=16,
+                            host_tier=HostTier(e.pager,
+                                               capacity_pages=64))
+           for e in engines]
+    asc = Autoscaler(min_replicas=1, max_replicas=n_replicas,
+                     initial_replicas=1, queue_high=2, queue_low=0,
+                     scale_down_after=2)
+    # tight-but-passable targets: the cold burst (queued behind the
+    # first compile) violates and pages; the warm waves pass, so the
+    # burn clears and the calm tail can drain back to 1x
+    slo = SLOMonitor({0: Objective(ttft_target_s=0.5, e2e_target_s=2.0)},
+                     fast_window=2, slow_window=3, warn_burn=2.0,
+                     page_burn=8.0, clear_after=1)
+    router = FleetRouter(engines, seg_steps=seg_steps, prefix_caches=pcs,
+                         directory=True, autoscaler=asc, slo_monitor=slo,
+                         capacity_monitor=CapacityMonitor(
+                             warn_horizon=0.5, page_horizon=0.1))
+
+    # four phases: t=0 burst (queue pressure -> 4x), a spread wave that
+    # populates the scaled-up replicas' prefix caches, a sparse repeat
+    # wave over the SAME prefixes riding through the drains, and an
+    # idle-gapped tail that guarantees the calm turns the last drains
+    # need to land back at 1x
+    rng = np.random.RandomState(7)
+    prefs = [rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+             for _ in range(n_groups)]
+
+    def req(pref, gen=5):
+        return (np.concatenate([pref, rng.randint(
+            0, cfg.vocab_size, (6,)).astype(np.int32)]), gen)
+
+    burst = [Arrival(0.0, *req(rng.randint(0, cfg.vocab_size, (12,)
+                                           ).astype(np.int32)))
+             for _ in range(12)]
+    spread = [Arrival(2.0 + 0.08 * i, *req(prefs[i % n_groups]))
+              for i in range(8)]
+    repeat = [Arrival(4.5 + 0.4 * i, *req(prefs[i % n_groups], gen=4))
+              for i in range(8)]
+    tail = [Arrival(8.2 + 0.6 * i, *req(prefs[i % n_groups], gen=3))
+            for i in range(3)]
+    trace = burst + spread + repeat + tail
+    n_before_repeat = len(burst) + len(spread)
+
+    jdir = tempfile.mkdtemp(prefix="journal_elastic_")
+    j = jmod.Journal(jdir)
+    j.params_info = {"prng_seed": 0}
+    t0 = time.time()
+    with jmod.attach(j):
+        rep = router.serve(trace)
+    wall = time.time() - t0
+    out = router.results()
+    j.close()
+    recs = jmod.read_journal(jdir)["records"]
+
+    # --- journal-ordered evidence ---------------------------------------
+    decs = [r for r in recs if r["kind"] == "scale_decision"]
+    ups = [r for r in decs if r["action"] == "scale_up"]
+    pages = [r for r in recs if r["kind"] == "slo_alert"
+             and r["level"] == "page"]
+    up_before_page = bool(ups and pages
+                          and ups[0]["gseq"] < pages[0]["gseq"])
+    warmed = [r for r in recs if r["kind"] == "replica_warmed"]
+    warm_before_traffic = len(warmed) == len(ups) and all(
+        not [r for r in recs if r["kind"] == "admit"
+             and r["replica"] == up["replica"]
+             and up["gseq"] < r["gseq"] < w["gseq"]]
+        for up, w in zip(ups, warmed))
+    repeats = [router._reqs[rid][1]
+               for rid in sorted(router._reqs)[n_before_repeat:]]
+    hits = [r.prefix_hit_len for r in repeats]
+    hit_rate = (sum(1 for h in hits if h == 16) / len(hits)
+                if hits else 0.0)
+    drain_moves = [r for r in recs if r["kind"] == "tier_migrate"
+                   and r.get("rid") is None]
+    lifecycles = {str(r.idx): r.lifecycle for r in router._replicas}
+    returned_to_1x = (asc.actual == 1 and asc.desired == 1
+                      and sum(1 for lc in lifecycles.values()
+                              if lc == "serving") == 1)
+    peak = max((d["inputs"]["n_serving"] for d in decs), default=1)
+    zero_stranded = (rep.n_requests == len(trace) == len(out)
+                     and all(out[rid] for rid in out)
+                     and router.leak_report() == [])
+    res = rmod.replay_serve(jdir, params=params)
+    log(f"elastic: {rep.scale_ups} ups / {rep.scale_downs} downs, peak "
+        f"{peak}x -> final {asc.actual}x, up-before-page "
+        f"{up_before_page}, repeat hit-rate {hit_rate:.2f}, "
+        f"{len(drain_moves)} drain migrations, replay_identical="
+        f"{res.identical} ({res.n_decisions} decisions)")
+
+    headline = {
+        "scale_ups": rep.scale_ups,
+        "scale_downs": rep.scale_downs,
+        "peak_replicas": peak,
+        "returned_to_1x": bool(returned_to_1x),
+        "scale_up_before_first_page": up_before_page,
+        "warmed_before_traffic": bool(warm_before_traffic),
+        "zero_stranded": bool(zero_stranded),
+        "repeat_hit_rate": round(hit_rate, 4),
+        "drain_migrations": len(drain_moves),
+        "replay_identical": bool(res.identical),
+        "pass": bool(rep.scale_ups >= 3 and rep.scale_downs >= 3
+                     and returned_to_1x and up_before_page
+                     and warm_before_traffic and zero_stranded
+                     and hit_rate == 1.0 and drain_moves
+                     and res.identical),
+    }
+    return {
+        "metric": "serving_elastic",
+        "model": model_name,
+        "platform": jax.default_backend(),
+        "seed": 7,
+        "replicas": n_replicas,
+        "n_requests": len(trace),
+        "trace": {"burst": len(burst), "spread": len(spread),
+                  "repeat": len(repeat), "tail": len(tail),
+                  "prefix_groups": n_groups, "seg_steps": seg_steps},
+        "policy": asc.describe(),
+        "wall_s": round(wall, 3),
+        "decisions": {
+            "total": len(decs),
+            "by_action": {a: sum(1 for d in decs if d["action"] == a)
+                          for a in ("scale_up", "scale_down",
+                                    "drain_complete", "refuse")},
+            "first_scale_up_gseq": ups[0]["gseq"] if ups else None,
+            "first_page_gseq": pages[0]["gseq"] if pages else None,
+            "last": asc.last_decision and {
+                "action": asc.last_decision["action"],
+                "reason": asc.last_decision["reason"]},
+        },
+        "warmups": [{"replica": w["replica"], "keys": w["keys"],
+                     "seconds": round(w["seconds"], 4)}
+                    for w in warmed],
+        "drains": {"completed": asc.drains_completed,
+                   "requeued": rep.requeued,
+                   "migrations": [{"src": m["src"], "dst": m["dst"],
+                                   "pages": m["pages"],
+                                   "bytes": m["bytes"]}
+                                  for m in drain_moves]},
+        "lifecycles": lifecycles,
+        "journal": {"records": j.total_records,
+                    "decisions": res.n_decisions,
+                    "replay_identical": bool(res.identical),
+                    "first_divergence": res.divergence},
+        "headline": headline,
+        "telemetry": _telemetry_section(),
+    }
+
+
 def smoke():
     """Tier-1 scheduler gate: serve a deterministic staggered trace on the
     tiny config and return an evidence dict the test asserts on — engine
@@ -3370,6 +3555,7 @@ def main():
     ap.add_argument("--quant", action="store_true")
     ap.add_argument("--disagg", action="store_true")
     ap.add_argument("--longctx", action="store_true")
+    ap.add_argument("--elastic", action="store_true")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--model", default="auto",
                     choices=("auto", "base", "small", "tiny"))
@@ -3427,6 +3613,8 @@ def main():
     elif args.longctx:
         print(json.dumps(run_longctx(model_name, cfg, params, llama,
                                      n=min(args.n, 6))))
+    elif args.elastic:
+        print(json.dumps(run_elastic(model_name, cfg, params, llama)))
     elif args.failover:
         print(json.dumps(run_failover(model_name, cfg, params, llama)))
     elif args.fleet:

@@ -83,6 +83,15 @@ Writes ``SERVING_r<N>.json`` at the repo root:
               one-fetch sync audit, and the bit-exact sp=2 journal
               replay...},
               (r23: long-context serving, ISSUE 18)
+   "elastic": {...llama_serving --elastic json: elastic autoscaling —
+              the seeded 1x->4x->1x step-load episode as an observable
+              control loop (scale-up journal-ordered before the first
+              error-budget page, every added replica §3o-warmed before
+              traffic, polite drains stranding zero requests with the
+              repeat wave's prefix hit-rate held at 1.0 through the
+              directory-aware migration, and the bit-exact elastic
+              journal replay, scale_decisions included)...},
+              (r25: elastic fleet autoscaling, ISSUE 20)
    "telemetry_headlines": {...r10 runtime-telemetry headlines per mode —
               queue depth / slot occupancy / prefix hit rate /
               backpressure counters from paddle_tpu.observability; the
@@ -217,6 +226,14 @@ def main() -> int:
         # zero-compile certificate, the one-fetch-per-segment sync
         # audit, and the bit-exact sp=2 journal replay
         "longctx": _run_json("llama_serving.py", args=("--longctx",)),
+        # r25 (ISSUE 20): elastic autoscaling — the 1x->4x->1x
+        # step-load episode as an observable control loop: scale-up
+        # journal-ordered before the first error-budget page, §3o
+        # warmup before traffic on every added replica, zero-strand
+        # polite drains holding the repeat wave's prefix hit-rate at
+        # 1.0 through the directory-aware migration, and the bit-exact
+        # elastic journal replay (scale_decisions included)
+        "elastic": _run_json("llama_serving.py", args=("--elastic",)),
     }
     result["platform"] = result["online"].get("platform", "unknown")
     # r10: lift each mode's runtime-telemetry headline (queue depth,
@@ -227,7 +244,7 @@ def main() -> int:
         k: (result[k].get("telemetry") or {}).get("headline")
         for k in ("online", "prefix", "paged", "fleet", "overload",
                   "failover", "slo", "spec", "quality", "capacity",
-                  "tiered", "quant", "disagg", "longctx")}
+                  "tiered", "quant", "disagg", "longctx", "elastic")}
     # r15: lift the speculative headline — the roofline-beating ratio
     # an operator (or the next round's reviewer) checks first
     spec = result["spec"].get("headline") or {}
@@ -333,6 +350,11 @@ def main() -> int:
     # reference, the spanning reservation, the spseg zero-compile
     # certificate and the sp=2 replay identity
     result["longctx_headline"] = result["longctx"].get("headline")
+    # r25 (ISSUE 20): lift the elastic headline — the control-loop
+    # ordering bars (scale-up before the first page, warmup before
+    # traffic, zero-strand drain with repeat hit-rate 1.0) and the
+    # bit-exact elastic replay a reviewer checks first
+    result["elastic_headline"] = result["elastic"].get("headline")
     path = os.path.join(ROOT, f"SERVING_r{rnd:02d}.json")
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
@@ -341,7 +363,7 @@ def main() -> int:
              for k in ("decode", "serving", "online", "prefix", "paged",
                        "fleet", "overload", "failover", "slo", "spec",
                        "quality", "capacity", "tiered", "aot", "quant",
-                       "disagg", "longctx"))
+                       "disagg", "longctx", "elastic"))
     return 0 if ok else 1
 
 

@@ -120,6 +120,12 @@ TPU_TEST_FILES = [
     # spanning-reservation continuation and the spseg AOT/zero-compile
     # certificate all gain their hardware half here
     "tests/test_longctx_serving.py",
+    # r25 (ISSUE 20): elastic autoscaling — on chip the §3o warmup of
+    # every scaled-up replica compiles the REAL ladder, chip_fit proves
+    # candidates against the real HBM envelope, and the zero-compile +
+    # sync-audit bars over the full elastic loop (scale-ups, drains,
+    # directory migrations) gain their hardware half here
+    "tests/test_autoscaler.py",
 ]
 
 

@@ -96,6 +96,16 @@ Usage::
                                                   # the liveness metric —
                                                   # every other budget is
                                                   # bit-identical
+    python -m paddle_tpu.analysis --gate --autoscale on # (default) the r25
+                                                  # contract: an ambient
+                                                  # elastic Autoscaler
+                                                  # ATTACHED on
+                                                  # SEGMENT_HOOKS (policy
+                                                  # evaluation per segment,
+                                                  # no fleet bound so no
+                                                  # scaling actions fire),
+                                                  # budgets bit-identical
+                                                  # to --autoscale off
     python -m paddle_tpu.analysis --gate --aot on # (default) the r20
                                                   # contract: program-space
                                                   # coverage + AOT warmup —
@@ -245,6 +255,13 @@ def main(argv=None) -> int:
                          "skips only the liveness metric; every other "
                          "budget is bit-identical either way (the pass "
                          "is pure text analysis)")
+    ap.add_argument("--autoscale", choices=("on", "off"), default="on",
+                    help="audit with the r25 elastic autoscaler attached "
+                         "in ambient mode: an unbound Autoscaler policy "
+                         "observing every engine segment "
+                         "(serving.SEGMENT_HOOKS) without a fleet to act "
+                         "on — budgets must be bit-identical to "
+                         "--autoscale off")
     ap.add_argument("--aot", choices=("on", "off"), default="on",
                     help="r20 program-space coverage: lint registry-only "
                          "key construction, prove the envelope "
@@ -286,6 +303,14 @@ def main(argv=None) -> int:
         tmeter = kv_tiers.TierMeter()
         kv_tiers.install(tmeter)
         print("tier meter attached on POOL_HOOKS + SEGMENT_HOOKS")
+    asc = None
+    if args.autoscale == "on":
+        from ..inference import autoscaler as _autoscaler
+
+        asc = _autoscaler.Autoscaler()
+        _autoscaler.install(asc)
+        print("autoscaler attached on SEGMENT_HOOKS (ambient, no fleet "
+              "bound)")
     hauditor = None
     if args.disagg == "on":
         from .tiers import HandoffAuditor
@@ -383,6 +408,12 @@ def main(argv=None) -> int:
         for v in hauditor.violations:
             print(f"  !! {v}")
         any_violation |= bool(hauditor.violations)
+    if asc is not None:
+        from ..inference import autoscaler as _autoscaler
+
+        _autoscaler.uninstall(asc)
+        print(f"autoscaler detached: saw {asc.segments_observed} "
+              f"segments, {len(asc.decision_log)} decisions")
     if tmeter is not None:
         from ..inference import kv_tiers
 

@@ -148,11 +148,16 @@ class DisaggRouter(FleetRouter):
                     "every disagg replica needs a PagedPrefixCache "
                     "with a host tier — export_host/import_host is the "
                     "handoff transport (the device_put seam)")
+        # r25 (ISSUE 20): a pool-scoped autoscaler's bind filters on
+        # pool tags, which only exist after construction — defer the
+        # attach until the tags are applied
+        ascs = kw.pop("autoscaler", None)
         super().__init__(engines, prefix_caches=pcs,
                          seg_steps=seg_steps, **kw)
         self.n_prefill = len(prefill_engines)
         for r in self._replicas:
             r.pool = "prefill" if r.idx < self.n_prefill else "decode"
+        self._attach_autoscalers(ascs)
         self.prefill_seg_steps = int(prefill_seg_steps or seg_steps)
         self.decode_seg_steps = int(decode_seg_steps or seg_steps)
         # the handoff ledger: every crossing, in decision order — the
@@ -209,8 +214,16 @@ class DisaggRouter(FleetRouter):
     # --- routing hooks (the fleet's pool-aware mode) ----------------------
     def _dispatch_candidates(self) -> List[_Replica]:
         # fresh prompts start on prefill; decode replicas take work
-        # only through the journaled handoff (or pool-kept failover)
-        return self.pool_replicas("prefill")
+        # only through the journaled handoff (or pool-kept failover).
+        # r25: composed with the elastic lifecycle filter — a warming/
+        # draining/offline prefill replica admits nothing
+        return [r for r in self.pool_replicas("prefill")
+                if r.lifecycle == "serving"]
+
+    def _warmup_envelope_for(self, rep: _Replica):
+        # r25: a standby warmed mid-serve compiles ITS pool's (smaller)
+        # r20 ladder, exactly what aot_warmup gave its pool-mates
+        return self.pool_envelope(rep.pool)
 
     def _seg_steps_for(self, rep: _Replica) -> int:
         return (self.prefill_seg_steps if rep.pool == "prefill"
@@ -228,7 +241,8 @@ class DisaggRouter(FleetRouter):
         un-full queue, then least-loaded (ties to lowest index — the
         same determinism rule as ``_route``)."""
         cands = [r for r in self._replicas
-                 if r.pool == "decode" and r.health == "healthy"]
+                 if r.pool == "decode" and r.health == "healthy"
+                 and r.lifecycle == "serving"]
         if not cands:
             return None
         span = len(req.prompt) + req.max_new_tokens - 1

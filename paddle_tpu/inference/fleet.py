@@ -41,6 +41,14 @@ device) with its OWN prefix cache and its OWN telemetry registry:
   traffic to a variant replica, compares per-class latency vs the
   control population, and auto-holds (weight → 0) on a failing
   journaled verdict.
+* **Elastic autoscaling (r25, ISSUE 20).** ``autoscaler=Autoscaler(...)``
+  attaches the §3t control loop: replicas carry a lifecycle
+  (offline/warming/serving/draining) orthogonal to r13 health, standby
+  replicas join the dispatch set only after a journaled
+  ``scale_decision`` (chip-fit proof + AOT warmup first), and
+  scale-downs drain politely — stop admitting, requeue the queue to
+  survivors, migrate hot prefixes through the host-tier seam, finish
+  live slots in place. See ``inference/autoscaler.py``.
 * **Rank-tagged telemetry.** Replica i's segment work records into its
   own ``metrics.Registry`` (``scoped_registry``), exactly as if it were
   launcher rank i; ``merged_telemetry()`` writes one
@@ -399,6 +407,11 @@ class FleetReport:
     dispatches_directory: int = 0
     tier_migrations: int = 0
     directory: Optional[dict] = None
+    # r25 (ISSUE 20): elastic autoscaling — scale actions this serve
+    # plus the attached policies' report (None when no autoscaler)
+    scale_ups: int = 0
+    scale_downs: int = 0
+    autoscaler: Optional[dict] = None
     per_replica: List[dict] = field(default_factory=list)
     telemetry: Optional[dict] = None   # merge_log_dir reduction
 
@@ -435,6 +448,16 @@ class _Replica:
         self.timeouts = 0                  # consecutive slow segments
         self.dead_since = 0.0
         self.probes = 0
+        # r25 elastic lifecycle (ISSUE 20), orthogonal to health:
+        # offline (warm standby, never dispatched) -> warming (chip-fit
+        # proved, AOT warmup running) -> serving (in the dispatch set)
+        # -> draining (stops admitting, live slots finish, queue
+        # requeued, prefixes migrated) -> offline. Without an
+        # autoscaler every replica stays "serving" and nothing changes.
+        self.lifecycle = "serving"
+        self.drain: Optional[dict] = None   # progress while draining
+        self.last_drain: Optional[dict] = None
+        self.warmed_s: Optional[float] = None
 
     def set_health(self, state: str) -> None:
         self.health = state
@@ -504,7 +527,8 @@ class FleetRouter:
                  probe_after_s: float = 0.05,
                  slo_monitor=None, perf_monitor=None,
                  shadow: Optional[Shadow] = None, canary=None,
-                 directory: bool = False):
+                 directory: bool = False, autoscaler=None,
+                 capacity_monitor=None):
         if not engines:
             raise ValueError("a fleet needs at least one engine")
         if prefix_caches == "auto":
@@ -603,6 +627,29 @@ class FleetRouter:
         self.last_retry_after_s: Optional[float] = None
         self._finished_count = 0
         self._serve_t0 = 0.0
+        # r25 elastic autoscaling (ISSUE 20): one policy for the whole
+        # fleet, or a list (the DisaggRouter attaches one per pool).
+        # The policy is a DECIDER — its config rides the journal header
+        # and replay rebuilds it. ``capacity_monitor`` is its r18
+        # capacity_alert input, fed fleet-wide at every segment finish
+        # (deterministic host ints, so the alert levels replay too).
+        self.capacity_monitor = capacity_monitor
+        self.autoscalers: list = []
+        self._attach_autoscalers(autoscaler)
+
+    def _attach_autoscalers(self, autoscaler) -> None:
+        """Normalize + bind scale policies. Split out of ``__init__``
+        so a pool-aware subclass can defer binding until after its
+        replicas carry pool tags (a pool-scoped policy's ``bind``
+        filters on them)."""
+        if autoscaler is None:
+            return
+        ascs = (list(autoscaler)
+                if isinstance(autoscaler, (list, tuple))
+                else [autoscaler])
+        self.autoscalers.extend(ascs)
+        for asc in ascs:
+            asc.bind(self)
 
     # --- AOT warmup (r20: ISSUE 15) --------------------------------------
     def aot_warmup(self, envelope=None) -> Dict[int, dict]:
@@ -648,8 +695,11 @@ class FleetRouter:
         fleet offers everyone; a pool-aware subclass (r22 DisaggRouter)
         narrows this to its prefill pool so prompts always start on
         prefill replicas and decode replicas take work only through the
-        journaled handoff path."""
-        return self._replicas
+        journaled handoff path. r25: only ``serving``-lifecycle
+        replicas take fresh traffic — warming replicas are not ready,
+        draining replicas are being emptied on purpose, and offline
+        standbys hold no live programs."""
+        return [r for r in self._replicas if r.lifecycle == "serving"]
 
     def _route(self, a: Arrival, dirinfo: Optional[dict] = None):
         """(replica, reason) for a due arrival, or (bill_target, None)
@@ -681,6 +731,7 @@ class FleetRouter:
         if can is not None:
             crep = self._replicas[can.replica]
             if (can.assign(self._next_rid) and crep.health == "healthy"
+                    and crep.lifecycle == "serving"
                     and crep.queue_depth < self.max_queue
                     and self._page_ready(crep, a)):
                 return crep, "canary"
@@ -784,8 +835,11 @@ class FleetRouter:
                 # a disaggregated dispatch record shows decode replicas
                 # present-but-ineligible for fresh prompts
                 owners = dirinfo["owners"] if dirinfo is not None else {}
+                # r25 (ISSUE 20): the ranking carries the lifecycle —
+                # an elastic dispatch record shows warming/draining/
+                # offline replicas present-but-ineligible
                 cands = [{"idx": x.idx, "health": x.health,
-                          "pool": x.pool,
+                          "pool": x.pool, "lifecycle": x.lifecycle,
                           "queue": x.queue_depth, "live": x.live,
                           "page_ready": self._page_ready(x, a),
                           "pages_free": (x.engine.pager.pages_free
@@ -1020,6 +1074,11 @@ class FleetRouter:
             now = _journal.now() - t0
             self._probe_dead()
             self._ingest(pending, now, t0)
+            # r25 (ISSUE 20): the elastic control loop runs on the
+            # turn's already-read clock — zero extra clock reads, so
+            # attaching a policy perturbs the decision stream only
+            # through the decisions it actually takes
+            self._autoscale(now)
             # r13: dead replicas are out of rotation entirely (abort
             # emptied them); suspects still drain their own backlog —
             # exclusion applies to NEW traffic in _route
@@ -1061,6 +1120,12 @@ class FleetRouter:
             r, h, t_disp = inflight.pop(0)
             if self._finish_one(r, h, t_disp):
                 segments += 1
+        if self.autoscalers:
+            # final policy step: finalize any drain whose replica just
+            # emptied (one recorded clock read — only when policies are
+            # attached, so autoscaler-free journals are byte-identical
+            # to r24's)
+            self._autoscale(_journal.now() - t0, final=True)
         makespan = _journal.now() - t0
         # r17: the shadow drains AFTER the primary makespan stamp (off
         # the critical path), and the canary issues its final verdict
@@ -1114,6 +1179,11 @@ class FleetRouter:
             failovers=self.failovers,
             requeued=self.requeued,
             replica_health={r.idx: r.health for r in reps},
+            scale_ups=sum(a.scale_ups for a in self.autoscalers),
+            scale_downs=sum(a.scale_downs for a in self.autoscalers),
+            autoscaler=({"policies": [a.report()
+                                      for a in self.autoscalers]}
+                        if self.autoscalers else None),
             retry_after_s=self.last_retry_after_s,
             cold_start_s=max(
                 (round(r.engine.cold_start_s, 4) for r in reps
@@ -1130,6 +1200,7 @@ class FleetRouter:
                 "segments": r.segments,
                 "ticks": r.engine.last_run_ticks,
                 "health": r.health,
+                "lifecycle": r.lifecycle,
                 "probes": r.probes,
                 "cold_start_s": (round(r.engine.cold_start_s, 4)
                                  if r.engine.cold_start_s is not None
@@ -1148,9 +1219,24 @@ class FleetRouter:
         """Fleet-level backoff hint for a refused client — same rule as
         ``OnlineScheduler.retry_after_hint`` (elapsed per finished
         request, clamped to [1 ms, 60 s]; 1 s before any finish), fed
-        by the fleet-wide finish counter."""
+        by the fleet-wide finish counter.
+
+        r25 drain-aware (ISSUE 20 satellite): draining replicas still
+        finish their backlog — inflating the fleet finish rate — but
+        admit nothing, so a retrying client can only land on the
+        ``serving`` subset. The hint scales by live/serving so it
+        quotes the capacity the retry can actually reach, not the
+        capacity that is being decommissioned under it."""
         if self._finished_count and now > 0:
-            return min(max(now / self._finished_count, 1e-3), 60.0)
+            base = now / self._finished_count
+            serving = [r for r in self._replicas
+                       if r.lifecycle == "serving" and r.health != "dead"]
+            live = [r for r in self._replicas
+                    if r.lifecycle in ("serving", "draining")
+                    and r.health != "dead"]
+            if serving and len(live) > len(serving):
+                base *= len(live) / len(serving)
+            return min(max(base, 1e-3), 60.0)
         return 1.0
 
     def _finish_one(self, rep: _Replica, h, t_disp: float) -> bool:
@@ -1225,6 +1311,37 @@ class FleetRouter:
             self.perf_monitor.note_segment(ev["steps"],
                                            ev.get("tokens", 0),
                                            elapsed_s=t_sync - t_disp)
+        # r25 (ISSUE 20): fleet-wide capacity feed — the autoscaler's
+        # capacity_alert input. The pages the just-admitted requests
+        # reserve are noted into the closing demand bucket, then a
+        # fresh segment opens on the SERVING pool's free/reclaimable
+        # sums (draining replicas are being emptied on purpose — their
+        # pages are not capacity a scale decision should count on).
+        # Every term is a host int evolving with the event stream, so
+        # the alert levels replay bit-exactly.
+        if self.capacity_monitor is not None:
+            cm = self.capacity_monitor
+            if rep.engine.paged and ev["admitted"]:
+                by_erid = {self._reqs[rid][1].rid: self._reqs[rid][1]
+                           for rid in rep.rids}
+                need = sum(
+                    rep.engine.pager.pages_needed(
+                        len(by_erid[erid].prompt)
+                        + by_erid[erid].max_new_tokens - 1)
+                    for erid in ev["admitted"])
+                cm.note_admission(need, admitted=len(ev["admitted"]))
+            cm.close_segment()
+            free = sum(x.engine.pager.pages_free
+                       for x in self._replicas
+                       if x.engine.paged and x.lifecycle == "serving"
+                       and x.health != "dead")
+            reclaim = sum(
+                x.prefix_cache.reclaimable_pages()
+                for x in self._replicas
+                if x.engine.paged and x.lifecycle == "serving"
+                and x.health != "dead" and x.prefix_cache is not None
+                and hasattr(x.prefix_cache, "reclaimable_pages"))
+            cm.begin_segment(free, reclaim)
         # r22 (ISSUE 17): post-segment hook — a no-op here; the
         # DisaggRouter's handoff sweep (prefill slots whose first token
         # just landed move to the decode pool) runs at exactly this
@@ -1318,7 +1435,9 @@ class FleetRouter:
             rep.prefix_cache.reset()
         if not orphans:
             return
-        survivors = [x for x in self._replicas if x.health == "healthy"]
+        survivors = [x for x in self._replicas
+                     if x.health == "healthy"
+                     and x.lifecycle == "serving"]
         if not survivors:
             raise RuntimeError(
                 f"replica {rep.idx} died with {len(orphans)} in-flight "
@@ -1375,6 +1494,168 @@ class FleetRouter:
                                via="probe", probes=rep.probes)
             else:
                 rep.dead_since = _journal.now()
+
+    # --- elastic lifecycle (r25 tentpole, ISSUE 20) -----------------------
+    def _autoscale(self, now: float, final: bool = False) -> None:
+        """One control-loop turn for every attached policy, on the
+        loop's already-read clock (zero extra clock reads)."""
+        for asc in self.autoscalers:
+            asc.step(now, final=final)
+
+    def _warmup_envelope_for(self, rep: _Replica):
+        """The envelope a replica activated mid-serve compiles. None =
+        the engine's default envelope; the r22 DisaggRouter returns the
+        replica's POOL envelope so a warmed standby joins its pool's
+        (smaller) r20 ladder."""
+        return None
+
+    def _activate_replica(self, rep: _Replica) -> dict:
+        """Bring an offline standby into the serving rotation,
+        PRE-PAYING its warmup: the full program ladder compiles (or —
+        the §3o fleet contract — re-registers against
+        ``serving._SHARED_PROGS``, microseconds per key) BEFORE the
+        lifecycle flips to ``serving``, so a scale-up can never cause a
+        mid-serve compile. The two ``journal.now()`` reads bracketing
+        the warmup are recorded clock reads — replay feeds them back,
+        so the measured cost rides the journal and the decision stream
+        stays bit-exact."""
+        assert rep.lifecycle == "offline", rep.lifecycle
+        rep.lifecycle = "warming"
+        env = self._warmup_envelope_for(rep)
+        t0 = _journal.now()
+        with _metrics.scoped_registry(rep.registry), \
+                _journal.rank_scope(rep.idx):
+            fams = rep.engine.aot_warmup(env,
+                                         prefix_cache=rep.prefix_cache)
+        warm_s = _journal.now() - t0
+        rep.lifecycle = "serving"
+        rep.warmed_s = warm_s
+        _flight.record("replica_warmed", replica=rep.idx,
+                       seconds=round(warm_s, 6),
+                       keys=sum(d["keys"] for d in fams.values()))
+        return {"seconds": warm_s, "families": fams}
+
+    def _begin_drain(self, rep: _Replica, now: float) -> dict:
+        """Start a polite scale-down of ``rep``: stop admitting (the
+        lifecycle flip removes it from ``_dispatch_candidates``),
+        migrate its hot prefixes to the survivors' host tiers
+        (directory-aware order), and requeue its QUEUED requests — the
+        r13 failover machinery run ON PURPOSE, not under a death. Live
+        slots finish in place; ``_finalize_drain`` runs from the policy
+        step once the replica empties."""
+        assert rep.lifecycle == "serving", rep.lifecycle
+        rep.lifecycle = "draining"
+        rep.drain = {"since": now, "requeued": 0,
+                     "prefixes_migrated": 0, "pages_migrated": 0}
+        survivors = [x for x in self._replicas
+                     if x is not rep and x.lifecycle == "serving"
+                     and x.health == "healthy"]
+        self._drain_prefixes(rep, survivors)
+        self._drain_requeue(rep, survivors)
+        return rep.drain
+
+    def _drain_prefixes(self, rep: _Replica,
+                        survivors: List[_Replica]) -> None:
+        """Migrate the draining replica's cached prefixes to survivor
+        host tiers through the r19 replica-portable seam
+        (``export_host`` → ``import_host``) so repeat traffic keeps
+        hitting after the replica goes away. With a directory attached
+        the HOT prefixes move first (touch-recency order off the
+        directory's placements for this replica); blind fleets move in
+        cache insertion order. Each move is a journaled
+        ``tier_migrate`` decision — the drain's data motion replays."""
+        pc = rep.prefix_cache
+        if (pc is None or not hasattr(pc, "export_host")
+                or getattr(pc, "host_tier", None) is None):
+            return
+        targets = [x for x in survivors
+                   if x.prefix_cache is not None
+                   and getattr(x.prefix_cache, "host_tier", None)
+                   is not None]
+        if not targets:
+            return
+        if self.directory is not None:
+            keys = sorted(
+                (k for k, owners in self.directory._owners.items()
+                 if rep.idx in owners),
+                key=lambda k: -self.directory._owners[k][rep.idx]["touch"])
+            seen = set(keys)
+            keys += [k for k in pc._entries if k not in seen]
+        else:
+            keys = list(pc._entries)
+        for key in keys:
+            exp = pc.export_host(key)
+            if exp is None:
+                continue        # never finished staging: can't move
+            dst = min(targets, key=lambda x: (x.load, x.idx))
+            planes = {p: exp[p] for p in exp
+                      if p not in ("tokens", "pages")}
+            if not dst.prefix_cache.import_host(exp["tokens"], planes):
+                continue        # survivor already holds it
+            n = int(exp["pages"])
+            nbytes = n * dst.prefix_cache.host_tier.page_bytes()
+            rep.drain["prefixes_migrated"] += 1
+            rep.drain["pages_migrated"] += n
+            self.tier_migrations += 1
+            _metrics.counter("fleet.tier_migrations").inc()
+            _flight.record("tier_migrate", rid=None, src=rep.idx,
+                           dst=dst.idx, pages=n, bytes=nbytes,
+                           rows=int(len(exp["tokens"])))
+
+    def _drain_requeue(self, rep: _Replica,
+                       survivors: List[_Replica]) -> None:
+        """Requeue the draining replica's QUEUED (never admitted)
+        requests onto survivors — the ``_kill_replica`` requeue
+        sequence (fresh engine-local rid, stable fleet rid). The
+        zero-strand contract: nothing is dropped; admitted slots keep
+        their pages and finish in place."""
+        queued = list(rep.engine._queue)
+        if not queued:
+            return
+        if not survivors:
+            raise RuntimeError(
+                f"draining replica {rep.idx} holds {len(queued)} queued "
+                f"requests with no serving survivor to requeue onto")
+        ids = {id(q) for q in queued}
+        rep.engine._queue.clear()
+        moved = sorted(((frid, req) for frid, (ridx, req)
+                        in self._reqs.items()
+                        if ridx == rep.idx and id(req) in ids),
+                       key=lambda t: t[0])
+        for frid, req in moved:
+            req.requeues += 1
+            if req.requeues > self.max_requeues:
+                raise RuntimeError(
+                    f"request {frid} exceeded {self.max_requeues} "
+                    f"requeues during drain")
+            tgt = self._failover_target(survivors, req)
+            if (len(req.prompt) + len(req.tokens)
+                    > max(tgt.engine.buckets)):
+                req.tokens = []
+            req.rid = tgt.engine._next_rid
+            tgt.engine._next_rid += 1
+            tgt.engine._queue.append(req)
+            self._reqs[frid] = (tgt.idx, req)
+            tgt.rids.append(frid)
+            rep.rids.remove(frid)
+            self.requeued += 1
+            rep.drain["requeued"] += 1
+            _metrics.counter("fleet.failover_requeued").inc()
+            _flight.record("failover_requeue", rid=frid, src=rep.idx,
+                           dst=tgt.idx, tokens_kept=len(req.tokens))
+
+    def _finalize_drain(self, rep: _Replica) -> dict:
+        """The drain's last act, once the replica is empty: release its
+        cache pages (evict listeners clear any directory placements)
+        and park it offline. Returns the drain ledger."""
+        assert not rep.busy, f"finalizing a busy replica {rep.idx}"
+        if rep.prefix_cache is not None:
+            rep.prefix_cache.reset()
+        rep.lifecycle = "offline"
+        info = rep.drain or {}
+        rep.last_drain = info
+        rep.drain = None
+        return info
 
     def _stamp(self, r: _Replica, ev: dict, t_sync: float) -> List[tuple]:
         """Per-request lifecycle stamping at the sync that surfaced each
@@ -1460,6 +1741,18 @@ class FleetRouter:
                 self._replicas[0].engine.cfg),
             "monitors": {"slo": self.slo_monitor is not None,
                          "perf": self.perf_monitor is not None},
+            # r25 (ISSUE 20): the autoscaler is a DECIDER — its full
+            # config AND its input monitors' configs ride the header so
+            # replay rebuilds the identical control loop (absent when
+            # no policy is attached: pre-r25 journals replay unchanged)
+            "autoscaler": ({
+                "policies": [a.describe() for a in self.autoscalers],
+                "slo": (self.slo_monitor.describe()
+                        if self.slo_monitor is not None else None),
+                "capacity": (self.capacity_monitor.describe()
+                             if self.capacity_monitor is not None
+                             else None),
+            } if self.autoscalers else None),
             "telemetry_enabled": _metrics.enabled(),
             "trace": _journal.describe_arrivals(arrivals),
         }
@@ -1495,6 +1788,10 @@ class FleetRouter:
             r.timeouts = 0
             r.probes = 0
             r.dead_since = 0.0
+            r.lifecycle = "serving"
+            r.drain = None
+            r.last_drain = None
+            r.warmed_s = None
         self.backpressure_events = 0
         self.failovers = 0
         self.requeued = 0
@@ -1517,6 +1814,13 @@ class FleetRouter:
             self.shadow.reset()
         if self.canary is not None:
             self.canary.reset()
+        if self.capacity_monitor is not None:
+            self.capacity_monitor.reset()
+        # AFTER the per-replica "serving" default above: each policy's
+        # reset re-applies its initial lifecycles (standbys go back
+        # offline) and zeroes its decision ledger
+        for asc in self.autoscalers:
+            asc.reset()
 
     def leak_report(self) -> List[str]:
         """Aggregated page-leak audit across replicas: with no live

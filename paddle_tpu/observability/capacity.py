@@ -549,6 +549,21 @@ class CapacityMonitor:
         """Zero windows/alert state (warm-run isolation)."""
         self._reset_state()
 
+    # r25 (ISSUE 20): with an autoscaler attached the monitor becomes a
+    # DECIDER (``capacity_alert`` is a scale-up input), so its config
+    # rides the journal header and replay rebuilds it from this.
+    def describe(self) -> dict:
+        """Rebuildable config snapshot for the journal header."""
+        return {"fast_window": self.fast_window,
+                "slow_window": self.slow_window,
+                "warn_horizon": self.warn_horizon,
+                "page_horizon": self.page_horizon,
+                "clear_after": self.clear_after}
+
+    @classmethod
+    def from_description(cls, d: dict) -> "CapacityMonitor":
+        return cls(**d)
+
 
 # ---------------------------------------------------------------------------
 # capacity planner: §3f pages-free arithmetic × §3g replica scaling

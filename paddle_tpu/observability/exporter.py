@@ -34,6 +34,11 @@ of the package enforces at the record path). Endpoints:
                    occupancy timeline) and per-replica page capacity;
                    ``?audit=1`` additionally runs the leak audit
                    (``leak_report``) and reports ``audit_clean``.
+``/autoscaler``    The r25 elastic control loop (ISSUE 20): per-policy
+                   desired vs actual replicas, lifecycle per replica,
+                   scale-up/down/refusal counters, total warmup paid,
+                   the last ``scale_decision`` (with its full input
+                   vector + reason) and live drain progress.
 ``/journal``       Deterministic-journal tail (r16, ISSUE 11): the
                    lossless decision stream's newest records, filtered
                    by ``?n=`` / ``?kind=`` / ``?rid=`` — reads the
@@ -84,7 +89,8 @@ class OpsServer:
                  slo_monitor=None, perf_monitor=None, fleet=None,
                  log_dir: Optional[str] = None, recorder=None,
                  journal=None, quality_monitor=None, canary=None,
-                 capacity_monitor=None, pool_monitor=None):
+                 capacity_monitor=None, pool_monitor=None,
+                 autoscaler=None):
         self.host = host
         self.port = int(port)
         self.registry = registry
@@ -104,6 +110,10 @@ class OpsServer:
         # ?audit=1 wiring the leak audit into the scrape surface)
         self.capacity_monitor = capacity_monitor
         self.pool_monitor = pool_monitor
+        # r25 (ISSUE 20): explicit autoscaler policy/policies; with a
+        # fleet attached, its bound policies are the fallback (the live
+        # wiring an operator actually has)
+        self.autoscaler = autoscaler
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -233,6 +243,12 @@ class OpsServer:
             pools = _pool_rollup(self.fleet)
             if pools:
                 body["pools"] = pools
+        scale = _scale_rollup(self._autoscalers())
+        if scale is not None:
+            # r25 (ISSUE 20 satellite): elastic state next to health —
+            # desired vs actual, per-replica lifecycle, the last scale
+            # decision + reason, and drain progress
+            body["scale"] = scale
         if self.slo_monitor is not None:
             body["slo_level"] = self.slo_monitor.worst_level()
         if self.capacity_monitor is not None:
@@ -340,6 +356,9 @@ class OpsServer:
                 out["pools"] = pools
             if getattr(self.fleet, "directory", None) is not None:
                 out["directory"] = self.fleet.directory.stats()
+        scale = _scale_rollup(self._autoscalers())
+        if scale is not None:
+            out["scale"] = scale    # r25: capacity is elastic now
         if audit:
             if self.fleet is not None:
                 out["audit"] = self.fleet.leak_report()
@@ -355,6 +374,26 @@ class OpsServer:
                 out["audit"] = []
             out["audit_clean"] = not out["audit"]
         return out
+
+    def _autoscalers(self) -> list:
+        if self.autoscaler is not None:
+            return (list(self.autoscaler)
+                    if isinstance(self.autoscaler, (list, tuple))
+                    else [self.autoscaler])
+        if self.fleet is not None:
+            return list(getattr(self.fleet, "autoscalers", []) or [])
+        return []
+
+    def payload_autoscaler(self) -> dict:
+        """The r25 elastic control loop's live state: one section per
+        policy (``Autoscaler.report()``) — desired vs actual, replica
+        lifecycles, action counters, last journaled decision with its
+        input vector + reason, and in-flight drain progress."""
+        ascs = self._autoscalers()
+        if not ascs:
+            return {"enabled": False}
+        return {"enabled": True,
+                "policies": [a.report() for a in ascs]}
 
     def payload_slo(self) -> dict:
         if self.slo_monitor is None:
@@ -389,6 +428,44 @@ def _pool_rollup(fleet) -> dict:
             if pc is not None and hasattr(pc, "reclaimable_pages"):
                 row["reclaimable"] += pc.reclaimable_pages()
     return pools
+
+
+def _scale_rollup(autoscalers) -> Optional[dict]:
+    """Fleet-level elastic rollup for /healthz and /capacity (r25,
+    ISSUE 20 satellite): desired vs actual across every attached
+    policy, per-replica lifecycle, the last journaled scale decision
+    (action + reason) and in-flight drain progress. ``None`` when no
+    policy is attached — the pre-elastic payloads are unchanged. All
+    host mirrors."""
+    if not autoscalers:
+        return None
+    out = {"desired": sum(a.desired for a in autoscalers),
+           "actual": sum(a.actual for a in autoscalers),
+           "drain_inflight": sum(a.drain_inflight
+                                 for a in autoscalers),
+           "scale_ups": sum(a.scale_ups for a in autoscalers),
+           "scale_downs": sum(a.scale_downs for a in autoscalers)}
+    lifecycles: dict = {}
+    drains: dict = {}
+    last = None
+    for a in autoscalers:
+        rep = a.report()
+        lifecycles.update(rep.get("lifecycles", {}))
+        drains.update(rep.get("drains", {}))
+        ld = rep.get("last_decision")
+        if ld is not None and (last is None or ld["t"] >= last["t"]):
+            last = ld
+    if lifecycles:
+        out["lifecycles"] = lifecycles
+    if drains:
+        out["drains"] = drains
+    if last is not None:
+        out["last_decision"] = {"t": last["t"],
+                                "action": last["action"],
+                                "pool": last["pool"],
+                                "replica": last["replica"],
+                                "reason": last["reason"]}
+    return out
 
 
 def _make_handler(srv: OpsServer):
@@ -439,6 +516,8 @@ def _make_handler(srv: OpsServer):
                     self._send_json(200, srv.payload_quality())
                 elif u.path == "/perf":
                     self._send_json(200, srv.payload_perf())
+                elif u.path == "/autoscaler":
+                    self._send_json(200, srv.payload_autoscaler())
                 elif u.path == "/journal":
                     n = int(q.get("n", ["64"])[0])
                     kind = q.get("kind", [None])[0]
@@ -454,7 +533,8 @@ def _make_handler(srv: OpsServer):
                         "endpoints": ["/metrics", "/snapshot.json",
                                       "/healthz", "/flight", "/slo",
                                       "/quality", "/perf", "/capacity",
-                                      "/journal", "/request/<rid>"]})
+                                      "/autoscaler", "/journal",
+                                      "/request/<rid>"]})
                 else:
                     self._send_json(404, {"error": f"no route {u.path}"})
             except FileNotFoundError as e:

@@ -102,6 +102,12 @@ DECISION_KINDS = frozenset({
     # pages, how many bytes) made from journaled state only, so the
     # cross-pool journey replays bit-exactly and the handoff is DIFFED
     "handoff",
+    # r25 elastic autoscaling (ISSUE 20): every scale decision carries
+    # its full input vector (burn rates, capacity level, queue depths,
+    # per-replica pages_free/health/lifecycle, chip-fit verdict) and is
+    # derived from journaled state + the fed clock only, so the whole
+    # 1x->4x->1x elastic episode replays bit-exactly and is DIFFED
+    "scale_decision",
 })
 
 

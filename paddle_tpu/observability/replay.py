@@ -239,6 +239,27 @@ def rebuild(header: dict, params):
             fault_injector=_injector_from(header.get("fault")),
             probe_after_s=fk["probe_after_s"],
             directory=bool(fk.get("directory", False)))
+        # r25 (ISSUE 20): the autoscaler is a DECIDER — rebuild the
+        # policies AND their input monitors from the recorded configs
+        # so the elastic control loop re-derives every scale decision
+        # from the fed clock + event stream (absent section: pre-r25
+        # journal, nothing to rebuild)
+        ak = header.get("autoscaler")
+        if ak is not None:
+            from ..inference.autoscaler import Autoscaler
+
+            kw["autoscaler"] = [Autoscaler.from_description(p)
+                                for p in ak["policies"]]
+            if ak.get("slo") is not None:
+                from .slo import SLOMonitor
+
+                kw["slo_monitor"] = SLOMonitor.from_description(
+                    ak["slo"])
+            if ak.get("capacity") is not None:
+                from .capacity import CapacityMonitor
+
+                kw["capacity_monitor"] = CapacityMonitor \
+                    .from_description(ak["capacity"])
         if driver == "disagg":
             # r22: the disaggregated fleet rebuilds from the header
             # alone — pool role per replica (index order is

@@ -366,6 +366,42 @@ class SLOMonitor:
                     + list(self._pools.values())),
                    key=lambda lvl: _LEVEL_RANK[lvl], default="ok")
 
+    # r25 (ISSUE 20): with an autoscaler attached the monitor becomes a
+    # DECIDER (its burn levels are scale-up inputs), so its config rides
+    # the journal header and replay rebuilds it from this round trip.
+    def describe(self) -> dict:
+        """Rebuildable config snapshot for the journal header."""
+        def _obj(o: Objective) -> dict:
+            return {"ttft_target_s": o.ttft_target_s,
+                    "e2e_target_s": o.e2e_target_s,
+                    "tbt_target_s": o.tbt_target_s,
+                    "compliance": o.compliance}
+        return {"objectives": {str(p): _obj(o)
+                               for p, o in self.objectives.items()},
+                "pool_objectives": {n: _obj(o) for n, o
+                                    in self.pool_objectives.items()},
+                "fast_window": self.fast_window,
+                "slow_window": self.slow_window,
+                "warn_burn": self.warn_burn,
+                "page_burn": self.page_burn,
+                "clear_after": self.clear_after,
+                "accept_drift": (dict(self.accept_drift)
+                                 if self.accept_drift is not None
+                                 else None)}
+
+    @classmethod
+    def from_description(cls, d: dict) -> "SLOMonitor":
+        pools = {n: Objective(**v)
+                 for n, v in (d.get("pool_objectives") or {}).items()}
+        return cls({int(p): Objective(**v)
+                    for p, v in (d.get("objectives") or {}).items()},
+                   fast_window=d["fast_window"],
+                   slow_window=d["slow_window"],
+                   warn_burn=d["warn_burn"], page_burn=d["page_burn"],
+                   clear_after=d["clear_after"],
+                   accept_drift=d.get("accept_drift"),
+                   pool_objectives=pools or None)
+
     def report(self) -> dict:
         """The ``/slo`` endpoint's payload: per-class budget/burn state
         plus the full alert timeline — all host data."""

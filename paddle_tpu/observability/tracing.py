@@ -24,7 +24,7 @@ from __future__ import annotations
 from ..profiler import _hooks
 
 __all__ = ["span", "step_span", "emit_request_trace",
-           "emit_journey_trace", "active"]
+           "emit_journey_trace", "emit_scaling_trace", "active"]
 
 span = _hooks.span          # re-export: the RAII host span
 active = _hooks.active
@@ -87,3 +87,39 @@ def emit_journey_trace(journey: dict) -> None:
             continue
         _hooks.emit(f"journey.{b['kind']}[req{rid}@r{b['rank']}]",
                     _ns(a["t"]), _ns(b["t"]), kind="serving.journey")
+
+
+def emit_scaling_trace(records: list) -> None:
+    """Emit an elastic episode's scaling timeline (r25, ISSUE 20) as
+    chrome-trace spans from its journaled ``scale_decision`` records
+    (``journal.tail(kind="scale_decision")`` rows or the policy's
+    ``decision_log``). Two span families:
+
+    * ``scaling.drain[r<idx>]`` — each replica's scale_down →
+      drain_complete window (the polite-drain cost, visible next to
+      the segments that finished inside it);
+    * ``scaling.<action>→<action>[...]`` — consecutive decisions as
+      intervals, so the viewer shows how long each fleet size held.
+
+    Stamps come from the records' ``t`` fields (journal write times —
+    the decision times). Free when no profiler collects."""
+    if not _hooks.COLLECTORS or not records:
+        return
+    recs = sorted(records, key=lambda r: r["t"])
+    drain_open: dict = {}
+    for r in recs:
+        if r["action"] == "scale_down":
+            drain_open[r["replica"]] = r["t"]
+        elif r["action"] == "drain_complete":
+            t0 = drain_open.pop(r["replica"], None)
+            if t0 is not None and r["t"] > t0:
+                _hooks.emit(f"scaling.drain[r{r['replica']}]",
+                            _ns(t0), _ns(r["t"]),
+                            kind="serving.scaling")
+    for a, b in zip(recs, recs[1:]):
+        if b["t"] <= a["t"]:
+            continue
+        tag = f"r{a['replica']}" if a.get("replica") is not None else ""
+        _hooks.emit(
+            f"scaling.{a['action']}→{b['action']}[{tag}]",
+            _ns(a["t"]), _ns(b["t"]), kind="serving.scaling")

@@ -1,0 +1,449 @@
+"""Elastic fleet autoscaling (r25 tentpole, ISSUE 20): the seeded
+1x->4x->1x step-load episode as an observable control loop. The
+acceptance bar is end-to-end evidence, all of it journal-ordered:
+scale-up lands BEFORE the first error-budget page (gseq-evidenced),
+every added replica warms (§3o) before it takes traffic, scale-down
+strands zero requests and keeps the repeat wave's prefix hit-rate at
+1.0 through the directory-aware drain, a candidate that fails
+``chip_fit`` is refused with a journaled reason, the whole elastic loop
+performs zero post-warmup backend compiles and zero flagged syncs, and
+``replay_serve`` certifies the full episode bit-exactly from the
+journal (every ``scale_decision`` with its input snapshot)."""
+
+import json as _json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.autoscaler import Autoscaler
+from paddle_tpu.inference.fleet import FleetRouter, build_fleet
+from paddle_tpu.inference.kv_tiers import HostTier
+from paddle_tpu.inference.prefix_cache import PagedPrefixCache
+from paddle_tpu.inference.scheduler import Arrival
+from paddle_tpu.observability import journal, replay
+from paddle_tpu.observability.capacity import CapacityMonitor
+from paddle_tpu.observability.exporter import OpsServer
+from paddle_tpu.observability.slo import Objective, SLOMonitor
+from paddle_tpu.parallel import set_mesh
+
+N_REPLICAS = 4
+N_PREFIX_GROUPS = 4
+
+
+def _elastic_fleet(cfg, params, **asc_kw):
+    """The episode fleet: 4 identical paged replicas with tiered
+    prefix caches + the cache directory (the r19 seam the drain
+    migrates through), one autoscaler policy, and the r14/r18 monitors
+    that feed its scale-up signals."""
+    engines = build_fleet(cfg, params, N_REPLICAS, slots=2, max_len=96,
+                          prompt_buckets=(8, 16, 32), paged=True,
+                          page_size=16)
+    pcs = [PagedPrefixCache(e.pager, capacity_pages=16,
+                            host_tier=HostTier(e.pager,
+                                               capacity_pages=64))
+           for e in engines]
+    kw = dict(min_replicas=1, max_replicas=N_REPLICAS,
+              initial_replicas=1, queue_high=2, queue_low=0,
+              scale_down_after=2)
+    kw.update(asc_kw)
+    asc = Autoscaler(**kw)
+    # tight-but-passable targets: the cold burst (queued behind the
+    # first compile) violates and pages; the warm repeat wave passes,
+    # so the burn clears and the calm tail can drain
+    slo = SLOMonitor({0: Objective(ttft_target_s=0.5, e2e_target_s=2.0)},
+                     fast_window=2, slow_window=3, warn_burn=2.0,
+                     page_burn=8.0, clear_after=1)
+    # lax horizons: the capacity input stays wired (its level rides
+    # every decision snapshot) but a 1x toy fleet's small pool must
+    # not re-pump the episode after the drain back to 1x
+    router = FleetRouter(engines, seg_steps=4, prefix_caches=pcs,
+                         directory=True, autoscaler=asc,
+                         slo_monitor=slo,
+                         capacity_monitor=CapacityMonitor(
+                             warn_horizon=0.5, page_horizon=0.1))
+    return router, asc
+
+
+def _episode_trace(cfg):
+    """Four phases: a t=0 burst (queue pressure -> scale to 4x), a
+    spread wave whose prefix groups populate the scaled-up replicas'
+    caches, a sparse repeat wave over the SAME prefixes that rides
+    through the calm-triggered drains, and a single-request tail whose
+    idle gaps guarantee the calm turns the last drains need to land
+    back at 1x before the trace ends."""
+    rng = np.random.RandomState(7)
+    prefs = [rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+             for _ in range(N_PREFIX_GROUPS)]
+
+    def req(pref, gen=5):
+        return (np.concatenate([pref, rng.randint(
+            0, cfg.vocab_size, (6,)).astype(np.int32)]), gen)
+
+    burst = [Arrival(0.0, *req(rng.randint(0, cfg.vocab_size, (12,)
+                                           ).astype(np.int32)))
+             for _ in range(12)]
+    spread = [Arrival(2.0 + 0.08 * i, *req(prefs[i % N_PREFIX_GROUPS]))
+              for i in range(8)]
+    repeat = [Arrival(4.5 + 0.4 * i,
+                      *req(prefs[i % N_PREFIX_GROUPS], gen=4))
+              for i in range(8)]
+    tail = [Arrival(8.2 + 0.6 * i, *req(prefs[i % N_PREFIX_GROUPS],
+                                        gen=3))
+            for i in range(3)]
+    return (burst + spread + repeat + tail,
+            len(burst) + len(spread), len(repeat) + len(tail))
+
+
+@pytest.fixture(scope="module")
+def episode(tiny_llama, tmp_path_factory):
+    """The recorded 1x->4x->1x elastic episode, served once and shared
+    by every journal-evidence test in this module."""
+    set_mesh(None)
+    cfg, params = tiny_llama
+    router, asc = _elastic_fleet(cfg, params)
+    trace, n_before_repeat, _ = _episode_trace(cfg)
+    jdir = str(tmp_path_factory.mktemp("elastic_journal"))
+    j = journal.Journal(jdir)
+    j.params_info = {"prng_seed": 0}
+    with journal.attach(j):
+        report = router.serve(trace)
+    j.close()
+    return {"router": router, "asc": asc, "report": report,
+            "trace": trace, "n_before_repeat": n_before_repeat,
+            "dir": jdir, "params": params, "cfg": cfg,
+            "records": journal.read_journal(jdir)["records"]}
+
+
+class TestElasticEpisode:
+    def test_scales_up_before_error_budget_page(self, episode):
+        """The control loop reacts to queue pressure on the first
+        ingest turn — journal-sequence-evidenced BEFORE the error
+        budget pages (the page still fires: the cold burst violates
+        its targets; the point is the scaler didn't wait for it)."""
+        recs = episode["records"]
+        ups = [r for r in recs if r["kind"] == "scale_decision"
+               and r["action"] == "scale_up"]
+        pages = [r for r in recs if r["kind"] == "slo_alert"
+                 and r["level"] == "page"]
+        assert ups and pages
+        assert ups[0]["gseq"] < pages[0]["gseq"], \
+            (ups[0]["gseq"], pages[0]["gseq"])
+        assert "queue depth" in ups[0]["reason"]
+
+    def test_reaches_4x_and_returns_to_1x(self, episode):
+        rep, asc = episode["report"], episode["asc"]
+        assert rep.scale_ups >= 3 and rep.scale_downs >= 3
+        assert asc.drains_completed == rep.scale_downs
+        assert asc.actual == 1 and asc.desired == 1
+        lifecycles = {r.idx: r.lifecycle
+                      for r in episode["router"]._replicas}
+        assert lifecycles == {0: "serving", 1: "offline",
+                              2: "offline", 3: "offline"}
+        # the episode peaked at the full fleet: some decision saw 4
+        # replicas serving in its input snapshot
+        n_serving = [r["inputs"]["n_serving"]
+                     for r in episode["records"]
+                     if r["kind"] == "scale_decision"]
+        assert max(n_serving) == N_REPLICAS
+
+    def test_warmup_before_traffic_and_estimate_matches(self, episode):
+        """§3o: every scaled-up replica AOT-warms before it admits —
+        no admit lands on the replica between the scale_up decision
+        and its replica_warmed record — and the decision's static
+        warmup estimate (enumerated keys) matches what the warmup
+        measured."""
+        recs = episode["records"]
+        ups = [r for r in recs if r["kind"] == "scale_decision"
+               and r["action"] == "scale_up"]
+        warmed = [r for r in recs if r["kind"] == "replica_warmed"]
+        assert len(warmed) == len(ups)
+        for up, w in zip(ups, warmed):
+            assert w["replica"] == up["replica"]
+            assert w["keys"] == up["warmup"]["keys"]
+            assert w["seconds"] >= 0.0
+            admits_between = [
+                r for r in recs if r["kind"] == "admit"
+                and r["replica"] == up["replica"]
+                and up["gseq"] < r["gseq"] < w["gseq"]]
+            assert admits_between == []
+
+    def test_drain_strands_zero_requests(self, episode):
+        router, trace = episode["router"], episode["trace"]
+        out = router.results()
+        assert len(out) == len(trace)
+        assert all(out[rid] for rid in out)
+        assert episode["report"].n_requests == len(trace)
+
+    def test_repeat_hit_rate_through_drain(self, episode):
+        """The repeat wave rides through the scale-downs with hit-rate
+        1.0: every repeat request resolves its full 16-token prefix
+        from a cache — live owner or drain-migrated survivor — and at
+        least one hot prefix moved through the directory-aware
+        export_host -> import_host drain seam."""
+        router = episode["router"]
+        n = episode["n_before_repeat"]
+        repeats = [router._reqs[rid][1]
+                   for rid in sorted(router._reqs)[n:]]
+        assert [r.prefix_hit_len for r in repeats] == [16] * len(repeats)
+        drain_moves = [r for r in episode["records"]
+                       if r["kind"] == "tier_migrate"
+                       and r.get("rid") is None]
+        assert drain_moves and all(m["pages"] > 0 for m in drain_moves)
+        assert router.leak_report() == []
+
+    def test_scale_decisions_carry_input_snapshots(self, episode):
+        """Every journaled decision is a complete observability object:
+        action, human-readable reason, and the full input vector."""
+        decs = [r for r in episode["records"]
+                if r["kind"] == "scale_decision"]
+        assert decs
+        for d in decs:
+            assert d["action"] in ("scale_up", "scale_down",
+                                   "drain_complete", "refuse")
+            assert d["reason"]
+            snap = d["inputs"]
+            for k in ("queue_sum", "n_serving", "slo_level",
+                      "capacity_level", "queue_depths", "pages_free",
+                      "health", "lifecycle"):
+                assert k in snap, (d["action"], k)
+            assert set(snap["lifecycle"]) == {"0", "1", "2", "3"}
+        drains = [d for d in decs if d["action"] == "drain_complete"]
+        assert drains and all("0 stranded" in d["reason"]
+                              for d in drains)
+
+    def test_replay_bit_exact(self, episode):
+        """The whole elastic episode — fleet-size changes included —
+        replays bit-exactly from the journal; the rebuilt driver
+        re-derives every scale_decision from the fed clock + event
+        stream."""
+        res = replay.replay_serve(episode["dir"],
+                                  params=episode["params"])
+        assert res.identical, (res.divergence, res.error)
+        n_dec = sum(1 for r in episode["records"]
+                    if r["kind"] == "scale_decision")
+        assert n_dec >= 6
+
+    def test_mutated_scale_decision_is_first_divergence(self, episode):
+        """Tamper-evidence: flip one recorded scale_decision's action
+        and the replay diff names scale_decision as the first
+        divergence instead of certifying."""
+        import copy
+
+        recs = copy.deepcopy(episode["records"])
+        victim = next(r for r in recs if r["kind"] == "scale_decision")
+        victim["action"] = "scale_down"
+        victim["desired"] = 99
+        res = replay.replay_serve({"records": recs},
+                                  params=episode["params"])
+        assert not res.identical
+        assert res.divergence["kind"] == "scale_decision"
+
+    def test_zero_compiles_and_clean_audit_over_elastic_loop(
+            self, episode):
+        """Fleet-wide §3o zero-compile budget + the r7 sync audit over
+        the FULL elastic loop: after the recorded episode warmed every
+        replica (shared programs), a reset re-serve — scale-ups,
+        warmups, drains, migrations and all — performs ZERO backend
+        compiles and zero flagged device->host syncs."""
+        from paddle_tpu.analysis import recompile, syncs
+
+        router = episode["router"]
+        router.reset()
+        with syncs.SyncAudit() as sa:
+            sa.phase = "elastic"
+            with recompile.enforce_zero_compiles("elastic re-serve"):
+                rep = router.serve(episode["trace"])
+        flagged = sa.flagged("elastic")
+        assert flagged == [], [f"{e.kind}@{e.site}" for e in flagged]
+        assert "serving.segment_event_fetch" in sa.allowed("elastic")
+        assert rep.scale_ups >= 3 and rep.n_requests == \
+            len(episode["trace"])
+
+
+class TestChipFitRefusal:
+    def test_unfit_candidate_refused_with_journaled_reason(
+            self, tiny_llama, tmp_path):
+        """A candidate that cannot prove it fits its HBM budget is
+        refused — a first-class journaled decision carrying the
+        chip_fit verdict — and is never retried."""
+        set_mesh(None)
+        cfg, params = tiny_llama
+        engines = build_fleet(cfg, params, 2, slots=2, max_len=96,
+                              prompt_buckets=(8, 16, 32), paged=True,
+                              page_size=16)
+        asc = Autoscaler(min_replicas=1, max_replicas=2,
+                         initial_replicas=1, queue_high=1,
+                         hbm_bytes=1024)     # nothing fits 1 KiB
+        router = FleetRouter(engines, seg_steps=8, autoscaler=asc)
+        rng = np.random.RandomState(13)
+        reqs = [Arrival(0.0, rng.randint(0, cfg.vocab_size, (12,)
+                                         ).astype(np.int32), 5)
+                for _ in range(4)]
+        jdir = str(tmp_path)
+        j = journal.Journal(jdir)
+        with journal.attach(j):
+            rep = router.serve(reqs)
+        j.close()
+        assert asc.refusals == 1 and rep.scale_ups == 0
+        assert asc.actual == 1
+        assert router._replicas[1].lifecycle == "offline"
+        recs = journal.read_journal(jdir)["records"]
+        refusals = [r for r in recs if r["kind"] == "scale_decision"
+                    and r["action"] == "refuse"]
+        # sustained pressure, but the unfit candidate is refused ONCE
+        assert len(refusals) == 1
+        d = refusals[0]
+        assert "chip_fit refused replica 1" in d["reason"]
+        assert d["fit"]["fits"] is False
+        assert d["fit"]["envelope_bytes"] > d["fit"]["hbm_bytes"] == 1024
+        # nothing stranded: the undersized fleet still finished
+        assert len(router.results()) == 4
+
+
+class TestDrainRequeue:
+    def test_scale_down_requeues_queued_requests(self, tiny_llama):
+        """The r13 failover machinery run ON PURPOSE: a drain victim's
+        queued (never-admitted) requests requeue onto the survivor —
+        journal-visible as failover_requeue records — and every request
+        finishes (the zero-strand contract)."""
+        set_mesh(None)
+        cfg, params = tiny_llama
+        engines = build_fleet(cfg, params, 2, slots=2, max_len=96,
+                              prompt_buckets=(8, 16, 32))
+        # queue_low=8: a backlog this small still reads as calm, so the
+        # scale-down fires while the victim holds queued work
+        asc = Autoscaler(min_replicas=1, max_replicas=2,
+                         initial_replicas=2, queue_high=50,
+                         queue_low=8, scale_down_after=1)
+        router = FleetRouter(engines, seg_steps=4, autoscaler=asc)
+        rng = np.random.RandomState(23)
+        reqs = [Arrival(0.0, rng.randint(0, cfg.vocab_size, (10,)
+                                         ).astype(np.int32), 5)
+                for _ in range(10)]
+        rep = router.serve(reqs)
+        assert rep.scale_downs == 1
+        victim = next(r for r in router._replicas
+                      if r.lifecycle == "offline")
+        assert victim.last_drain["requeued"] > 0
+        assert router.requeued == victim.last_drain["requeued"]
+        out = router.results()
+        assert len(out) == 10 and all(out[rid] for rid in out)
+        assert router._replicas[1 - victim.idx].lifecycle == "serving"
+
+
+class TestOpsSurface:
+    def test_autoscaler_endpoint_and_scale_rollup(self, episode):
+        """/autoscaler reports the policy; /healthz and /capacity gain
+        the fleet-level `scale` rollup (desired vs actual, per-replica
+        lifecycle, last decision + reason, drain progress)."""
+        router = episode["router"]
+        with OpsServer(port=0, fleet=router) as srv:
+            def get(path):
+                with urllib.request.urlopen(srv.url + path,
+                                            timeout=5) as r:
+                    return _json.loads(r.read())
+
+            auto = get("/autoscaler")
+            assert auto["enabled"] is True
+            pol = auto["policies"][0]
+            assert pol["scale_ups"] >= 3
+            assert pol["lifecycles"]["0"] == "serving"
+            for body in (get("/healthz"), get("/capacity")):
+                scale = body["scale"]
+                assert scale["scale_ups"] >= 3
+                assert scale["actual"] == sum(
+                    1 for lc in scale["lifecycles"].values()
+                    if lc == "serving")
+                assert set(scale["lifecycles"]) == {"0", "1", "2", "3"}
+                assert scale["last_decision"]["action"] in (
+                    "scale_up", "scale_down", "drain_complete")
+                assert scale["last_decision"]["reason"]
+
+    def test_retry_after_hint_excludes_draining_capacity(
+            self, tiny_llama):
+        """Satellite: a draining replica is leaving — the backoff hint
+        quoted to refused clients scales by live/serving so it prices
+        only the capacity a retry can actually reach."""
+        set_mesh(None)
+        cfg, params = tiny_llama
+        engines = build_fleet(cfg, params, 2, slots=2, max_len=96,
+                              prompt_buckets=(8, 16, 32))
+        router = FleetRouter(engines, seg_steps=8)
+        router._finished_count = 10
+        base = router.retry_after_hint(5.0)
+        assert base == pytest.approx(0.5)
+        router._replicas[1].lifecycle = "draining"
+        assert router.retry_after_hint(5.0) == pytest.approx(2 * base)
+        # fully offline capacity is NOT priced: live == serving again
+        router._replicas[1].lifecycle = "offline"
+        assert router.retry_after_hint(5.0) == pytest.approx(base)
+
+    def test_scaling_chrome_trace_spans(self, episode):
+        """The decision log renders as a chrome-trace scaling timeline:
+        drain windows (scale_down -> drain_complete) and fleet-size
+        intervals, in the same viewer as segments and op dispatch."""
+        from paddle_tpu.observability import tracing
+        from paddle_tpu.profiler import _hooks
+
+        spans = []
+
+        class _Coll:
+            def _host_event(self, name, t0, t1, kind):
+                spans.append((name, kind))
+
+        _hooks.COLLECTORS.append(_Coll())
+        try:
+            tracing.emit_scaling_trace(
+                episode["asc"].decision_log)
+        finally:
+            _hooks.COLLECTORS.pop()
+        names = [n for n, _ in spans]
+        assert any(n.startswith("scaling.drain[r") for n in names)
+        assert any("scale_up" in n for n in names)
+        assert all(k == "serving.scaling" for _, k in spans)
+
+
+class TestPolicyConfig:
+    def test_describe_round_trip(self):
+        asc = Autoscaler(min_replicas=2, max_replicas=6,
+                         initial_replicas=3, pool="prefill",
+                         queue_high=4, scale_down_after=5,
+                         hbm_bytes=1 << 30)
+        d = asc.describe()
+        clone = Autoscaler.from_description(d)
+        assert clone.describe() == d
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            Autoscaler(min_replicas=0)
+        with pytest.raises(ValueError):
+            Autoscaler(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError):
+            Autoscaler(queue_high=2, queue_low=5)
+
+    def test_ambient_install_counts_segments(self, tiny_llama):
+        """The gate's --autoscale mode: an UNBOUND policy observing
+        segments through SEGMENT_HOOKS — pure host counting, zero
+        decisions."""
+        from paddle_tpu.inference import autoscaler as asc_mod
+        from paddle_tpu.inference.serving import ServingEngine
+
+        set_mesh(None)
+        cfg, params = tiny_llama
+        asc = Autoscaler()
+        asc_mod.install(asc)
+        try:
+            eng = ServingEngine(cfg, params, slots=2, max_len=96,
+                                prompt_buckets=(8, 16, 32))
+            rng = np.random.RandomState(3)
+            eng.add_request(rng.randint(0, cfg.vocab_size, (8,)
+                                        ).astype(np.int32), 4)
+            for _ in range(8):
+                ev = eng.run_segment(4)
+                if ev["finished"]:
+                    break
+        finally:
+            asc_mod.uninstall(asc)
+        assert asc.segments_observed > 0
+        assert asc.decision_log == [] and asc.scale_ups == 0

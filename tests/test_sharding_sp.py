@@ -151,6 +151,41 @@ class TestSequenceParallel:
         assert getattr(ln.weight, "sequence_parallel", False)
 
 
+def test_sequence_parallel_ring_dispatch_lowering():
+    """Cheap tier-1 cousin of the full parity test below (r25 suite-time
+    claw-back): with sequence_parallel=True on a sep>1 hybrid mesh the
+    flagship model's attention DISPATCHES to the ring (context-parallel)
+    formulation — pinned on the lowered program text WITHOUT paying the
+    8-virtual-device XLA compile. Ring-op numerics (forward + grad
+    parity vs full attention) stay tier-1 in test_moe_ring.py; the
+    full-model fwd+grad parity runs as `slow` + in the chip lane."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.parallel import create_hybrid_mesh, set_mesh
+
+    cfg_sp = llama.LlamaConfig.tiny(sequence_parallel=True)
+    params = llama.init_params(cfg_sp, jax.random.PRNGKey(3))
+    toks = jnp.array(
+        np.random.RandomState(0).randint(0, cfg_sp.vocab_size, (4, 64)),
+        jnp.int32)
+    mesh = create_hybrid_mesh(dp=2, mp=2, sep=2, devices=jax.devices()[:8])
+    try:
+        ps = {k: NamedSharding(mesh, v)
+              for k, v in llama.param_specs(cfg_sp).items()}
+        params_s = jax.device_put(params, ps)
+        toks_s = jax.device_put(
+            toks, NamedSharding(mesh, P(("dp", "sharding"), None)))
+        fwd = jax.jit(lambda p, t: llama.forward(p, t, cfg_sp))
+        hlo = fwd.lower(params_s, toks_s).as_text()
+        assert "collective_permute" in hlo, "ring attention not dispatched"
+    finally:
+        set_mesh(None)
+
+
+@pytest.mark.slow
 def test_sequence_parallel_uses_ring_attention_with_parity():
     """With sequence_parallel=True and a sep>1 mesh, the flagship model's
     attention is the RING (context-parallel) formulation; forward and
